@@ -1,0 +1,28 @@
+"""mxnet_tpu: a TPU-native deep-learning framework with MXNet's capabilities.
+
+Brand-new implementation (NOT a port) of the Apache MXNet 1.5-dev surface —
+NDArray + autograd, Gluon, Symbol/Module, KVStore, IO — re-architected for
+TPU: tensors are PJRT buffers, eager ops run through an XLA compile-and-cache
+path, hybridized/symbolic graphs lower to single HLO modules, and the
+communication layer is XLA collectives over the ICI mesh. See SURVEY.md for
+the reference blueprint this is built to.
+
+Usage mirrors the reference::
+
+    import mxnet_tpu as mx
+    x = mx.nd.ones((2, 3), ctx=mx.tpu())
+    with mx.autograd.record():
+        y = (x * 2).sum()
+    y.backward()
+"""
+__version__ = "0.1.0"
+
+from .base import MXNetError  # noqa: F401
+from .context import (Context, cpu, gpu, tpu, cpu_pinned,  # noqa: F401
+                      current_context, num_gpus, num_tpus, device_list)
+from . import ndarray  # noqa: F401
+from . import ndarray as nd  # noqa: F401
+from . import autograd  # noqa: F401
+from . import random  # noqa: F401
+
+# `import mxnet_tpu as mx; mx.nd...` is the canonical spelling.

@@ -1,0 +1,391 @@
+"""Imperative autograd: tape recording + reverse pass.
+
+Reference: src/imperative/imperative.cc (RecordOp :191, Backward :278,
+MarkVariables :130) and python/mxnet/autograd.py (record/pause/train_mode/
+backward/grad/Function).
+
+TPU-native redesign: instead of building an NNVM backward graph and executing
+node-by-node through the engine, every recorded op keeps (a) a snapshot of its
+input ``jax.Array`` values (immutable, so "snapshot" is just a reference —
+versioned-mutation on NDArray cannot corrupt the tape) and (b) its pure op
+function. The reverse pass walks the tape topologically and calls ``jax.vjp``
+on each op — XLA jit-compiles each (op, params, shapes) vjp once and replays
+it. Whole-graph backward for hybridized blocks bypasses this tape entirely
+(CachedOp lowers fwd+bwd to a single HLO module — see cached_op.py).
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .base import MXNetError, check, hashable_params
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+           "is_training", "set_recording", "set_training", "mark_variables",
+           "backward", "grad", "Function", "get_symbol"]
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.recording = False
+        self.training = False
+
+
+_state = _State()
+
+
+def is_recording() -> bool:
+    return _state.recording
+
+
+def is_training() -> bool:
+    return _state.training
+
+
+def set_recording(is_rec: bool) -> bool:
+    prev, _state.recording = _state.recording, is_rec
+    return prev
+
+
+def set_training(train: bool) -> bool:
+    prev, _state.training = _state.training, train
+    return prev
+
+
+class _RecordingStateScope:
+    """(ref: python/mxnet/autograd.py _RecordingStateScope)"""
+
+    def __init__(self, is_record: Optional[bool], train_mode: Optional[bool]):
+        self._enter_is_record = is_record
+        self._enter_train_mode = train_mode
+        self._prev_is_record = None
+        self._prev_train_mode = None
+
+    def __enter__(self):
+        if self._enter_is_record is not None:
+            self._prev_is_record = set_recording(self._enter_is_record)
+        if self._enter_train_mode is not None:
+            self._prev_train_mode = set_training(self._enter_train_mode)
+
+    def __exit__(self, *args):
+        if self._enter_is_record is not None:
+            set_recording(self._prev_is_record)
+        if self._enter_train_mode is not None:
+            set_training(self._prev_train_mode)
+
+
+def record(train_mode: bool = True) -> _RecordingStateScope:
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode: bool = False) -> _RecordingStateScope:
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode() -> _RecordingStateScope:
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode() -> _RecordingStateScope:
+    return _RecordingStateScope(None, False)
+
+
+# ---------------------------------------------------------------------------
+# tape structures
+# ---------------------------------------------------------------------------
+
+class _VariableEntry:
+    """Leaf marked by mark_variables/attach_grad (ref AGInfo for variables)."""
+
+    __slots__ = ("array_ref", "grad_ref", "grad_req")
+
+    def __init__(self, array, grad, grad_req: str):
+        self.array_ref = weakref.ref(array)
+        self.grad_ref = weakref.ref(grad) if grad is not None else None
+        self.grad_req = grad_req
+
+    @property
+    def node(self):
+        return None
+
+
+class _TapeNode:
+    """One recorded op application (ref: nnvm node + AGInfo per output)."""
+
+    __slots__ = ("opdef", "params_key", "input_vals", "input_entries",
+                 "out_avals", "custom", "train_mode")
+
+    def __init__(self, opdef, params_key, input_vals, input_entries,
+                 out_avals, custom=None, train=False):
+        self.opdef = opdef
+        self.params_key = params_key
+        self.input_vals = input_vals        # tuple of jax arrays (immutable)
+        self.input_entries = input_entries  # per-input: _OutputEntry | _VariableEntry | None
+        self.out_avals = out_avals          # [(shape, dtype)]
+        self.custom = custom                # Function instance for custom grads
+        self.train_mode = train
+
+
+class _OutputEntry:
+    __slots__ = ("node", "index")
+
+    def __init__(self, node: _TapeNode, index: int):
+        self.node = node
+        self.index = index
+
+
+def mark_variables(variables: Sequence, gradients: Sequence,
+                   grad_reqs="write") -> None:
+    """Associate gradient buffers with arrays
+    (ref: MXAutogradMarkVariables -> Imperative::MarkVariables)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for var, g, req in zip(variables, gradients, grad_reqs):
+        var._tape_entry = _VariableEntry(var, g, req)
+        var._grad = g
+        var._grad_req = req
+
+
+def _record_op(opdef, params, nd_inputs, arrays, out_nds) -> None:
+    """Append one op to the tape (ref Imperative::RecordOp)."""
+    from .ops.registry import normalize_params
+    entries = [getattr(x, "_tape_entry", None) for x in nd_inputs]
+    if not any(e is not None for e in entries):
+        return  # nothing upstream requires grad: keep the tape sparse
+    node = _TapeNode(opdef, hashable_params(normalize_params(params)),
+                     tuple(arrays), entries,
+                     [(o.shape, o._data.dtype) for o in out_nds],
+                     train=is_training())
+    for i, o in enumerate(out_nds):
+        o._tape_entry = _OutputEntry(node, i)
+
+
+def _record_custom(function, nd_inputs, out_nds) -> None:
+    entries = [getattr(x, "_tape_entry", None) for x in nd_inputs]
+    node = _TapeNode(None, (), tuple(x._data for x in nd_inputs), entries,
+                     [(o.shape, o._data.dtype) for o in out_nds],
+                     custom=function, train=is_training())
+    for i, o in enumerate(out_nds):
+        o._tape_entry = _OutputEntry(node, i)
+
+
+# ---------------------------------------------------------------------------
+# reverse pass
+# ---------------------------------------------------------------------------
+
+_VJP_CACHE: Dict[Tuple, Any] = {}
+
+
+def _vjp_call(node: _TapeNode, cotangents: Tuple):
+    """jit-cached vjp of one op (the FGradient analog, compiled)."""
+    import jax
+    key = (node.opdef.name, node.params_key, node.train_mode)
+    fn = _VJP_CACHE.get(key)
+    if fn is None:
+        opdef = node.opdef
+        kwargs = dict(node.params_key)
+
+        def fwd(*ins):
+            out = opdef.fn(*ins, **kwargs)
+            return out if isinstance(out, tuple) else (out,)
+
+        def run(inputs, cots):
+            _, vjp = jax.vjp(fwd, *inputs)
+            return vjp(tuple(cots))
+
+        try:
+            fn = jax.jit(run)
+            _VJP_CACHE[key] = fn
+        except Exception:
+            fn = run
+    return fn(node.input_vals, cotangents)
+
+
+def _toposort(root_nodes: List[_TapeNode]) -> List[_TapeNode]:
+    order: List[_TapeNode] = []
+    seen = set()
+    stack = [(n, False) for n in root_nodes]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for e in node.input_entries:
+            if e is not None and getattr(e, "node", None) is not None \
+                    and id(e.node) not in seen:
+                stack.append((e.node, False))
+    return order
+
+
+def backward(heads: Sequence, head_grads: Optional[Sequence] = None,
+             retain_graph: bool = False, train_mode: bool = True) -> None:
+    """Run the reverse pass, accumulating into attached grad buffers
+    (ref: MXAutogradBackwardEx -> Imperative::Backward, imperative.cc:278)."""
+    _backward_impl(heads, head_grads, retain_graph, train_mode,
+                   variables=None)
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Return gradients of heads w.r.t. variables
+    (ref: python/mxnet/autograd.py:270)."""
+    check(not create_graph, "create_graph=True (higher-order autograd) is "
+                            "not supported yet on the eager tape")
+    if retain_graph is None:
+        retain_graph = create_graph
+    return _backward_impl(heads, head_grads, retain_graph, train_mode,
+                          variables=variables)
+
+
+def _backward_impl(heads, head_grads, retain_graph, train_mode_flag,
+                   variables=None):
+    import jax.numpy as jnp
+    from .ndarray.ndarray import NDArray
+
+    heads = list(heads)
+    for h in heads:
+        check(h._tape_entry is not None,
+              "cannot differentiate: output is not part of the recorded graph "
+              "(was it computed under autograd.record()?)")
+
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+
+    # grad accumulator keyed by tape entry identity
+    acc: Dict[int, Any] = {}
+    entry_of: Dict[int, Any] = {}
+
+    def add_grad(entry, g):
+        k = id(entry)
+        entry_of[k] = entry
+        if k in acc:
+            acc[k] = acc[k] + g
+        else:
+            acc[k] = g
+
+    root_nodes = []
+    for h, hg in zip(heads, head_grads):
+        e = h._tape_entry
+        g = hg._data if hg is not None else jnp.ones(h.shape, h._data.dtype)
+        add_grad(e, g)
+        if isinstance(e, _OutputEntry):
+            root_nodes.append(e.node)
+
+    order = _toposort(root_nodes)
+
+    for node in reversed(order):
+        # gather cotangents for this node's outputs
+        cots = []
+        has_any = False
+        for i, (shape, dtype) in enumerate(node.out_avals):
+            found = None
+            for k, e in list(entry_of.items()):
+                if isinstance(e, _OutputEntry) and e.node is node and e.index == i:
+                    found = acc.get(k)
+                    break
+            if found is not None:
+                has_any = True
+                cots.append(found)
+            else:
+                cots.append(jnp.zeros(shape, dtype))
+        if not has_any:
+            continue
+        if node.input_vals is None:
+            raise MXNetError("graph has already been freed; pass "
+                             "retain_graph=True to backward() to reuse it")
+        if node.custom is not None:
+            in_grads = node.custom._run_backward(cots)
+        else:
+            in_grads = _vjp_call(node, tuple(cots))
+        for e, g in zip(node.input_entries, in_grads):
+            if e is not None and g is not None:
+                add_grad(e, g)
+
+    # deliver to variables
+    results = None
+    if variables is not None:
+        results = []
+        for v in variables:
+            e = v._tape_entry
+            check(e is not None, "one of the variables was not marked "
+                                 "(call attach_grad())")
+            g = acc.get(id(e))
+            if g is None:
+                g = jnp.zeros(v.shape, v._data.dtype)
+            results.append(NDArray(g, ctx=v._ctx))
+    # accumulate into attached grad buffers
+    for k, e in entry_of.items():
+        if isinstance(e, _VariableEntry):
+            var = e.array_ref()
+            if var is None or e.grad_ref is None:
+                continue
+            gbuf = e.grad_ref()
+            if gbuf is None or e.grad_req == "null":
+                continue
+            g = acc[k]
+            if e.grad_req == "add":
+                gbuf._rebind(gbuf._data + g)
+            else:
+                gbuf._rebind(g.astype(gbuf._data.dtype))
+
+    if not retain_graph:
+        for node in order:
+            node.input_vals = None
+
+    return results
+
+
+def get_symbol(x):
+    """Trace the tape that produced ``x`` into a Symbol
+    (ref: MXAutogradGetSymbol). Minimal: returns a symbol listing the op
+    chain; full graph export lives on the Symbol/CachedOp path."""
+    raise NotImplementedError("get_symbol on the eager tape is not supported; "
+                              "use HybridBlock.export / symbol tracing")
+
+
+class Function:
+    """User-defined differentiable function
+    (ref: python/mxnet/autograd.py:365 Function + src/c_api/c_api_function.cc).
+
+    Subclass and implement ``forward(self, *inputs)`` and
+    ``backward(self, *output_grads)`` operating on NDArrays.
+    """
+
+    def __init__(self):
+        self._saved: Tuple = ()
+
+    def save_for_backward(self, *arrays):
+        self._saved = arrays
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def _run_backward(self, cotangents):
+        from .ndarray.ndarray import NDArray, from_jax
+        with pause():
+            grads = self.backward(*[from_jax(c) for c in cotangents])
+        if not isinstance(grads, (tuple, list)):
+            grads = (grads,)
+        return [g._data if isinstance(g, NDArray) else g for g in grads]
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray
+        with pause():
+            outputs = self.forward(*inputs)
+        single = not isinstance(outputs, (tuple, list))
+        out_t = (outputs,) if single else tuple(outputs)
+        if is_recording():
+            _record_custom(self, inputs, out_t)
+        return outputs

@@ -1,0 +1,176 @@
+"""Foundation utilities: errors, logging, env-config registry, typed params.
+
+TPU-native replacement for the dmlc-core substrate the reference is built on
+(ref: include/mxnet/base.h, dmlc/logging.h, dmlc/parameter.h). Instead of
+C++ CHECK macros and DMLC_DECLARE_PARAMETER structs, we provide:
+
+- :class:`MXNetError` — the framework exception (ref: python/mxnet/base.py).
+- ``check(cond, msg)`` — CHECK() analog raising MXNetError.
+- :class:`EnvRegistry` — central registry of ``MXNET_*`` environment
+  variables with typed defaults (ref: docs/faq/env_var.md lists ~72 vars;
+  the reference reads them ad-hoc via dmlc::GetEnv).
+- parameter coercion helpers used by the op registry to accept both python
+  values and the string forms found in serialized symbol JSON
+  (ref: dmlc::Parameter string kwargs -> struct parsing).
+"""
+from __future__ import annotations
+
+import ast
+import logging
+import os
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+__all__ = [
+    "MXNetError",
+    "check",
+    "env",
+    "EnvRegistry",
+    "numeric_types",
+    "string_types",
+    "classproperty",
+]
+
+numeric_types = (float, int)
+string_types = (str,)
+
+logger = logging.getLogger("mxnet_tpu")
+
+
+class MXNetError(RuntimeError):
+    """Framework-level error (ref: python/mxnet/base.py MXNetError)."""
+
+
+def check(cond: bool, msg: str = "check failed") -> None:
+    """CHECK() analog: raise :class:`MXNetError` when ``cond`` is false."""
+    if not cond:
+        raise MXNetError(msg)
+
+
+class EnvRegistry:
+    """Typed registry of MXNET_* environment variables.
+
+    The reference scatters ``dmlc::GetEnv("MXNET_FOO", default)`` reads across
+    the codebase; here every knob is declared once so ``mx.runtime`` can
+    enumerate them (ref: docs/faq/env_var.md).
+    """
+
+    def __init__(self) -> None:
+        self._defaults: Dict[str, Tuple[type, Any, str]] = {}
+
+    def declare(self, name: str, typ: type, default: Any, doc: str = "") -> None:
+        self._defaults[name] = (typ, default, doc)
+
+    def get(self, name: str, default: Any = None) -> Any:
+        if name in self._defaults:
+            typ, decl_default, _ = self._defaults[name]
+            raw = os.environ.get(name)
+            if raw is None:
+                return decl_default if default is None else default
+            if typ is bool:
+                return raw not in ("0", "false", "False", "")
+            return typ(raw)
+        raw = os.environ.get(name)
+        return raw if raw is not None else default
+
+    def items(self):
+        for name, (typ, default, doc) in sorted(self._defaults.items()):
+            yield name, typ, self.get(name), doc
+
+
+env = EnvRegistry()
+
+# Engine/executor knobs kept for API parity; on TPU most map to XLA behavior.
+env.declare("MXNET_ENGINE_TYPE", str, "ThreadedEnginePerDevice",
+            "Engine flavor: ThreadedEnginePerDevice|ThreadedEngine|NaiveEngine. "
+            "NaiveEngine synchronizes after every op (debug).")
+env.declare("MXNET_EXEC_BULK_EXEC_INFERENCE", bool, True,
+            "Fuse inference graphs into single XLA programs.")
+env.declare("MXNET_EXEC_BULK_EXEC_TRAIN", bool, True,
+            "Fuse training graphs into single XLA programs.")
+env.declare("MXNET_BACKWARD_DO_MIRROR", bool, False,
+            "Trade compute for memory in backward (jax.checkpoint remat).")
+env.declare("MXNET_UPDATE_ON_KVSTORE", bool, True,
+            "Run optimizer update inside the kvstore when supported.")
+env.declare("MXNET_KVSTORE_BIGARRAY_BOUND", int, 1000000,
+            "Threshold above which arrays are sharded across servers/devices.")
+env.declare("MXNET_ENFORCE_DETERMINISM", bool, False,
+            "Restrict to deterministic algorithms.")
+env.declare("MXNET_PROFILER_AUTOSTART", bool, False,
+            "Start the profiler at import time.")
+env.declare("MXNET_CPU_WORKER_NTHREADS", int, 1,
+            "Host-side worker threads (IO pipeline).")
+env.declare("MXNET_DEFAULT_DTYPE", str, "float32",
+            "Default dtype for created arrays.")
+env.declare("MXNET_TPU_MATMUL_PRECISION", str, "default",
+            "jax matmul precision: default|high|highest.")
+
+
+class classproperty:  # noqa: N801 - decorator style
+    def __init__(self, fget: Callable) -> None:
+        self.fget = fget
+
+    def __get__(self, obj, owner):
+        return self.fget(owner)
+
+
+# ---------------------------------------------------------------------------
+# Parameter coercion: accept python values or their string serialization, the
+# way dmlc::Parameter parses kwargs shipped through symbol JSON / C API.
+# ---------------------------------------------------------------------------
+
+_BOOL_STRINGS = {"true": True, "True": True, "1": True,
+                 "false": False, "False": False, "0": False}
+
+
+def coerce_param(value: Any) -> Any:
+    """Best-effort conversion of string-serialized op params to python values.
+
+    Symbol JSON stores every attr as a string (``"(2, 2)"``, ``"True"``,
+    ``"float32"``); imperative python passes real values. Both funnel through
+    here so op impls always see typed values (ref: dmlc parameter parsing +
+    legacy JSON loader src/nnvm/legacy_json_util.cc:222).
+    """
+    if not isinstance(value, str):
+        if isinstance(value, list):
+            return tuple(coerce_param(v) for v in value)
+        return value
+    s = value.strip()
+    if s in _BOOL_STRINGS:
+        return _BOOL_STRINGS[s]
+    if s in ("None", "none", "null"):
+        return None
+    try:
+        v = ast.literal_eval(s)
+        if isinstance(v, list):
+            v = tuple(v)
+        return v
+    except (ValueError, SyntaxError):
+        return s
+
+
+def hashable_params(params: Dict[str, Any]) -> Tuple[Tuple[str, Any], ...]:
+    """Normalize an op's kwargs into a hashable, jit-cache-friendly key."""
+    out = []
+    for k in sorted(params):
+        v = coerce_param(params[k])
+        if isinstance(v, list):
+            v = tuple(v)
+        elif isinstance(v, dict):
+            v = tuple(sorted(v.items()))
+        out.append((k, v))
+    return tuple(out)
+
+
+class _TLocal(threading.local):
+    pass
+
+
+tlocal = _TLocal()
+
+
+def getenv_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default

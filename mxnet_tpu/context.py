@@ -1,0 +1,156 @@
+"""Device contexts mapped onto the JAX/PJRT device model.
+
+Reference: include/mxnet/base.h:102-128 defines Context with device types
+kCPU=1, kGPU=2, kCPUPinned=3, kCPUShared=5 and python/mxnet/context.py keeps a
+thread-local "current context" stack.
+
+TPU-native redesign: a Context names a *logical* device backed by a
+``jax.Device``. ``mx.tpu(i)`` is the first-class accelerator context
+(the BASELINE north star's ``mx.tpu()``); ``mx.gpu(i)`` is kept as an alias
+for the i-th accelerator so reference scripts run unchanged. ``mx.cpu()`` is
+the host. Pinned/shared host memory distinctions collapse: PJRT manages host
+staging buffers itself, so kCPUPinned/kCPUShared map to plain host contexts
+(kept as distinct devtype ids for checkpoint/API compat).
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+from .base import MXNetError
+
+__all__ = ["Context", "cpu", "gpu", "tpu", "cpu_pinned", "current_context",
+           "num_gpus", "num_tpus", "device_list"]
+
+
+def _jax():
+    import jax
+    return jax
+
+
+class Context:
+    """A logical device. devtypes mirror the reference's enum with kTPU added."""
+
+    devtype2str = {1: "cpu", 2: "gpu", 3: "cpu_pinned", 5: "cpu_shared", 6: "tpu"}
+    devstr2type = {v: k for k, v in devtype2str.items()}
+    _default_ctx = threading.local()
+
+    def __init__(self, device_type, device_id: int = 0):
+        if isinstance(device_type, Context):
+            self.device_typeid = device_type.device_typeid
+            self.device_id = device_type.device_id
+        else:
+            if device_type not in Context.devstr2type:
+                raise MXNetError(f"unknown device type {device_type!r}")
+            self.device_typeid = Context.devstr2type[device_type]
+            self.device_id = device_id
+        self._old_ctx: Optional[Context] = None
+
+    @property
+    def device_type(self) -> str:
+        return Context.devtype2str[self.device_typeid]
+
+    # -- jax bridge -----------------------------------------------------
+    @property
+    def jax_device(self):
+        """The backing ``jax.Device``.
+
+        Accelerator contexts (tpu/gpu) resolve to the i-th non-CPU device if
+        one exists, else fall back to the i-th CPU device so code written for
+        accelerators still runs host-only (mirrors the reference's graceful
+        CPU fallback when built without CUDA).
+        """
+        jax = _jax()
+        if self.device_type in ("cpu", "cpu_pinned", "cpu_shared"):
+            cpus = _jax().devices("cpu") if _has_platform("cpu") else jax.devices()
+            return cpus[self.device_id % len(cpus)]
+        accels = _accelerator_devices()
+        if accels:
+            return accels[self.device_id % len(accels)]
+        return jax.devices()[self.device_id % len(jax.devices())]
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, Context)
+                and self.device_typeid == other.device_typeid
+                and self.device_id == other.device_id)
+
+    def __hash__(self):
+        return hash((self.device_typeid, self.device_id))
+
+    def __repr__(self) -> str:
+        return f"{self.device_type}({self.device_id})"
+
+    def __str__(self) -> str:
+        return self.__repr__()
+
+    def __enter__(self):
+        if not hasattr(Context._default_ctx, "value"):
+            Context._default_ctx.value = Context("cpu", 0)
+        self._old_ctx = Context._default_ctx.value
+        Context._default_ctx.value = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        Context._default_ctx.value = self._old_ctx
+
+    def empty_cache(self) -> None:
+        """Release cached device memory (ref: MXStorageEmptyCache).
+
+        PJRT owns the allocator; python-side we can only drop host references
+        and trigger a GC pass.
+        """
+        import gc
+        gc.collect()
+
+
+def _has_platform(name: str) -> bool:
+    jax = _jax()
+    try:
+        jax.devices(name)
+        return True
+    except RuntimeError:
+        return False
+
+
+def _accelerator_devices() -> List:
+    jax = _jax()
+    return [d for d in jax.devices() if d.platform != "cpu"]
+
+
+def cpu(device_id: int = 0) -> Context:
+    return Context("cpu", device_id)
+
+
+def cpu_pinned(device_id: int = 0) -> Context:
+    return Context("cpu_pinned", device_id)
+
+
+def gpu(device_id: int = 0) -> Context:
+    """Alias for the i-th accelerator (TPU chip here). Kept so reference
+    scripts using ``mx.gpu(i)`` run unchanged on TPU."""
+    return Context("gpu", device_id)
+
+
+def tpu(device_id: int = 0) -> Context:
+    """The first-class TPU context (north star: BASELINE.json `mx.tpu()`)."""
+    return Context("tpu", device_id)
+
+
+def current_context() -> Context:
+    if not hasattr(Context._default_ctx, "value"):
+        Context._default_ctx.value = Context("cpu", 0)
+    return Context._default_ctx.value
+
+
+def num_gpus() -> int:
+    """Number of accelerator chips visible (ref: mx.context.num_gpus)."""
+    return len(_accelerator_devices())
+
+
+def num_tpus() -> int:
+    return len(_accelerator_devices())
+
+
+def device_list() -> List[Context]:
+    n = num_gpus()
+    return [tpu(i) for i in range(n)] if n else [cpu(0)]

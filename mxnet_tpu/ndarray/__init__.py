@@ -1,0 +1,34 @@
+"""mx.nd — the imperative array API (ref: python/mxnet/ndarray/__init__.py).
+
+Exposes the NDArray type, creation functions, and one generated function per
+registered operator, plus the random/linalg/contrib/_internal/op
+sub-namespaces the reference provides.
+"""
+import sys
+import types
+
+from .ndarray import (NDArray, array, zeros, ones, full, empty, arange,
+                      linspace, eye, concatenate, stack, moveaxis, from_jax,
+                      waitall, imperative_invoke)
+from .utils import save, load
+from ..ops import registry as _registry  # ensure op modules are imported
+from .. import ops as _ops_pkg  # noqa: F401  (triggers op registration)
+from . import register as _register
+
+# build sub-namespace modules (mx.nd.random etc.)
+_this = sys.modules[__name__]
+_subnames = ["random", "linalg", "contrib", "_internal", "op", "sparse"]
+_submodules = {}
+for _n in _subnames:
+    _m = types.ModuleType(__name__ + "." + _n)
+    sys.modules[__name__ + "." + _n] = _m
+    setattr(_this, _n, _m)
+    _submodules[_n] = _m
+
+_register.populate(_this, _submodules)
+
+# creation/builtin helpers that shadow any op with the same name
+from .ndarray import (zeros, ones, full, empty, arange, linspace, eye,  # noqa
+                      array, concatenate, stack, moveaxis)
+
+NDArray = NDArray

@@ -1,0 +1,638 @@
+"""NDArray: the imperative tensor type, backed by ``jax.Array``.
+
+Reference: include/mxnet/ndarray.h (1467 lines) + src/ndarray/ndarray.cc.
+The reference NDArray is a ref-counted Chunk holding device storage plus an
+engine variable whose version orders async reads/writes; WaitToRead/
+WaitToWrite block the frontend at sync points (ndarray.h:359-371).
+
+TPU-native redesign:
+- storage = an immutable ``jax.Array`` (PJRT buffer). Mutation (``+=``,
+  ``x[i] = v``, ``out=``) rebinds the handle to a new functional value and
+  bumps ``_version`` — the engine-var version counter made explicit
+  (ref: src/engine/threaded_engine.h ThreadedVar versioning). XLA donates/
+  aliases buffers under jit so rebinding is not a copy in compiled paths.
+- async-by-default comes from JAX dispatch: every op returns immediately
+  with a future-like Array; ``wait_to_read`` = ``block_until_ready`` and
+  exceptions raised by device computation surface there, matching the
+  engine's exception_ptr rethrow-at-sync-point behavior
+  (ref: src/engine/threaded_engine.h:374,449-456).
+- autograd hooks (``attach_grad``/``_tape_entry``) mirror ndarray.h:321-323
+  (entry_/fresh_out_grad) but point into the python tape (autograd.py).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import numpy as _np
+
+from ..base import MXNetError, check, env
+from ..context import Context, current_context, cpu
+from ..ops import registry as _reg
+
+__all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
+           "linspace", "eye", "concatenate", "stack", "from_jax", "moveaxis",
+           "waitall", "imperative_invoke"]
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _jax():
+    import jax
+    return jax
+
+
+_PY_DTYPES = {float: _np.float32, int: _np.int32, bool: _np.bool_}
+
+
+def _as_dtype(dtype):
+    if dtype is None:
+        return _np.dtype(env.get("MXNET_DEFAULT_DTYPE"))
+    if dtype in _PY_DTYPES:
+        return _np.dtype(_PY_DTYPES[dtype])
+    import jax.numpy as jnp
+    if dtype is jnp.bfloat16 or str(dtype) == "bfloat16":
+        return jnp.bfloat16
+    return _np.dtype(dtype)
+
+
+class NDArray:
+    __slots__ = ("_data", "_ctx", "_version", "_grad", "_grad_req",
+                 "_tape_entry", "_stype", "__weakref__")
+
+    __array_priority__ = 100.0
+
+    def __init__(self, data, ctx: Optional[Context] = None):
+        self._data = data
+        self._ctx = ctx if ctx is not None else current_context()
+        self._version = 0
+        self._grad: Optional["NDArray"] = None
+        self._grad_req: Optional[str] = None
+        self._tape_entry = None  # set by autograd when recorded/marked
+        self._stype = "default"
+
+    # ------------------------------------------------------------------
+    # basic properties
+    # ------------------------------------------------------------------
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return _np.dtype(self._data.dtype) if self._data.dtype != _jnp().bfloat16 \
+            else self._data.dtype
+
+    @property
+    def size(self) -> int:
+        n = 1
+        for s in self.shape:
+            n *= s
+        return n
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    @property
+    def context(self) -> Context:
+        return self._ctx
+
+    ctx = context
+
+    @property
+    def stype(self) -> str:
+        return self._stype
+
+    @property
+    def T(self) -> "NDArray":
+        return self.transpose()
+
+    @property
+    def grad(self) -> Optional["NDArray"]:
+        return self._grad
+
+    @property
+    def handle(self):
+        """Engine-var analog: (id, version) identifies this array's buffer
+        generation (ref: include/mxnet/engine.h VarHandle)."""
+        return (id(self), self._version)
+
+    @property
+    def jax(self):
+        """The underlying ``jax.Array`` (zero-copy interop, dlpack analog:
+        ref MXNDArrayToDLPack in src/c_api/c_api.cc)."""
+        return self._data
+
+    # ------------------------------------------------------------------
+    # mutation / engine-var discipline
+    # ------------------------------------------------------------------
+    def _rebind(self, new_data) -> "NDArray":
+        """Write-op on the engine var: new buffer, version += 1."""
+        self._data = new_data
+        self._version += 1
+        return self
+
+    def wait_to_read(self) -> None:
+        """Block until pending computation lands (ref: ndarray.h:359)."""
+        try:
+            self._data.block_until_ready()
+        except AttributeError:
+            pass
+
+    def wait_to_write(self) -> None:
+        self.wait_to_read()
+
+    # ------------------------------------------------------------------
+    # conversion
+    # ------------------------------------------------------------------
+    def asnumpy(self) -> _np.ndarray:
+        self.wait_to_read()
+        return _np.asarray(self._data)
+
+    def asscalar(self):
+        check(self.size == 1, "The current array is not a scalar")
+        return self.asnumpy().reshape(())[()]
+
+    def item(self):
+        return self.asscalar()
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __bool__(self):
+        if self.size == 0:
+            return False
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise MXNetError("The truth value of an NDArray with multiple "
+                         "elements is ambiguous.")
+
+    def __len__(self) -> int:
+        check(self.ndim > 0, "len() of a 0-d NDArray")
+        return self.shape[0]
+
+    def __repr__(self) -> str:
+        return f"\n{self.asnumpy()}\n<NDArray {'x'.join(map(str, self.shape))} " \
+               f"@{self._ctx}>"
+
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def astype(self, dtype, copy: bool = True) -> "NDArray":
+        dtype = _as_dtype(dtype)
+        if not copy and self._data.dtype == dtype:
+            return self
+        return imperative_invoke("cast", (self,), {"dtype": _np.dtype(dtype).name
+                                                   if dtype != _jnp().bfloat16 else "bfloat16"})
+
+    def copy(self) -> "NDArray":
+        return self.copyto(self._ctx)
+
+    def copyto(self, other: Union[Context, "NDArray"]) -> "NDArray":
+        if isinstance(other, NDArray):
+            other._rebind(_jax().device_put(self._data, other._ctx.jax_device))
+            return other
+        out = NDArray(_jax().device_put(self._data, other.jax_device), ctx=other)
+        return out
+
+    def as_in_context(self, context: Context) -> "NDArray":
+        if context == self._ctx:
+            return self
+        return self.copyto(context)
+
+    as_in_ctx = as_in_context
+
+    def as_nd_ndarray(self):
+        return self
+
+    def to_dlpack_for_read(self):
+        return self._data.__dlpack__()
+
+    to_dlpack_for_write = to_dlpack_for_read
+
+    # ------------------------------------------------------------------
+    # autograd
+    # ------------------------------------------------------------------
+    def attach_grad(self, grad_req: str = "write", stype=None) -> None:
+        """Allocate gradient buffer and mark for autograd
+        (ref: Imperative::MarkVariables, src/imperative/imperative.cc:130)."""
+        from .. import autograd
+        grad = zeros(self.shape, ctx=self._ctx, dtype=self._data.dtype)
+        self._grad = grad
+        self._grad_req = grad_req
+        autograd.mark_variables([self], [grad], grad_req)
+
+    def detach(self) -> "NDArray":
+        out = NDArray(self._data, ctx=self._ctx)
+        return out
+
+    def backward(self, out_grad=None, retain_graph: bool = False,
+                 train_mode: bool = True) -> None:
+        from .. import autograd
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    # ------------------------------------------------------------------
+    # indexing
+    # ------------------------------------------------------------------
+    def __getitem__(self, key) -> "NDArray":
+        key = _canonical_index(key)
+        return imperative_invoke("_index", (self,), {"_idx": key})
+
+    def __setitem__(self, key, value) -> None:
+        key = _canonical_index(key)
+        if isinstance(value, NDArray):
+            out = imperative_invoke("_index_assign", (self, value), {"_idx": key})
+        else:
+            value = _np.asarray(value, dtype=self.dtype if self._data.dtype != _jnp().bfloat16 else _np.float32)
+            out = imperative_invoke("_index_assign_scalar", (self,),
+                                    {"_idx": key, "_val": value})
+        self._rebind(out._data)
+        self._tape_entry = out._tape_entry
+
+    # ------------------------------------------------------------------
+    # arithmetic — dispatch mirrors python/mxnet/ndarray/ndarray.py dunders,
+    # scalar forms route to the *_scalar ops like the reference.
+    # ------------------------------------------------------------------
+    def _binary(self, other, op: str, scalar_op: str, reverse: bool = False):
+        if isinstance(other, NDArray):
+            a, b = (other, self) if reverse else (self, other)
+            return imperative_invoke(op, (a, b), {})
+        if isinstance(other, (int, float, bool, _np.number)):
+            return imperative_invoke(scalar_op, (self,),
+                                     {"scalar": float(other), "reverse": reverse})
+        if isinstance(other, _np.ndarray):
+            return self._binary(array(other, ctx=self._ctx), op, scalar_op, reverse)
+        return NotImplemented
+
+    def __add__(self, o):  return self._binary(o, "broadcast_add", "_plus_scalar")
+    def __radd__(self, o): return self._binary(o, "broadcast_add", "_plus_scalar", True)
+    def __sub__(self, o):  return self._binary(o, "broadcast_sub", "_minus_scalar")
+    def __rsub__(self, o): return self._binary(o, "broadcast_sub", "_rminus_scalar", True)
+    def __mul__(self, o):  return self._binary(o, "broadcast_mul", "_mul_scalar")
+    def __rmul__(self, o): return self._binary(o, "broadcast_mul", "_mul_scalar", True)
+    def __truediv__(self, o):  return self._binary(o, "broadcast_div", "_div_scalar")
+    def __rtruediv__(self, o): return self._binary(o, "broadcast_div", "_rdiv_scalar", True)
+    def __mod__(self, o):  return self._binary(o, "broadcast_mod", "_mod_scalar")
+    def __rmod__(self, o): return self._binary(o, "broadcast_mod", "_rmod_scalar", True)
+    def __pow__(self, o):  return self._binary(o, "broadcast_power", "_power_scalar")
+    def __rpow__(self, o): return self._binary(o, "broadcast_power", "_rpower_scalar", True)
+    def __matmul__(self, o): return imperative_invoke("dot", (self, o), {})
+
+    def __iadd__(self, o): return self._inplace(self.__add__(o))
+    def __isub__(self, o): return self._inplace(self.__sub__(o))
+    def __imul__(self, o): return self._inplace(self.__mul__(o))
+    def __itruediv__(self, o): return self._inplace(self.__truediv__(o))
+
+    def _inplace(self, result: "NDArray") -> "NDArray":
+        self._rebind(result._data)
+        self._tape_entry = result._tape_entry
+        return self
+
+    def __neg__(self):
+        return imperative_invoke("negative", (self,), {})
+
+    def __abs__(self):
+        return imperative_invoke("abs", (self,), {})
+
+    def __eq__(self, o):  # noqa: returns array like the reference
+        if o is None:
+            return False
+        return self._binary(o, "broadcast_equal", "_equal_scalar")
+
+    def __ne__(self, o):
+        if o is None:
+            return True
+        return self._binary(o, "broadcast_not_equal", "_not_equal_scalar")
+
+    def __gt__(self, o): return self._binary(o, "broadcast_greater", "_greater_scalar")
+    def __ge__(self, o): return self._binary(o, "broadcast_greater_equal", "_greater_equal_scalar")
+    def __lt__(self, o): return self._binary(o, "broadcast_lesser", "_lesser_scalar")
+    def __le__(self, o): return self._binary(o, "broadcast_lesser_equal", "_lesser_equal_scalar")
+
+    def __hash__(self):
+        return id(self)
+
+    # ------------------------------------------------------------------
+    # method forms of common ops (generated namespace provides the rest)
+    # ------------------------------------------------------------------
+    def reshape(self, *shape, **kwargs) -> "NDArray":
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        if not shape:
+            shape = kwargs.get("shape", ())
+        return imperative_invoke("reshape", (self,), {"shape": tuple(shape)})
+
+    def reshape_like(self, other: "NDArray") -> "NDArray":
+        return imperative_invoke("reshape_like", (self, other), {})
+
+    def broadcast_to(self, shape) -> "NDArray":
+        return imperative_invoke("broadcast_to", (self,), {"shape": tuple(shape)})
+
+    def broadcast_like(self, other) -> "NDArray":
+        return imperative_invoke("broadcast_like", (self, other), {})
+
+    def transpose(self, *axes) -> "NDArray":
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return imperative_invoke("transpose", (self,),
+                                 {"axes": tuple(axes)} if axes else {})
+
+    def swapaxes(self, dim1, dim2):
+        return imperative_invoke("swapaxes", (self,), {"dim1": dim1, "dim2": dim2})
+
+    def flatten(self):
+        return imperative_invoke("flatten", (self,), {})
+
+    def expand_dims(self, axis):
+        return imperative_invoke("expand_dims", (self,), {"axis": axis})
+
+    def squeeze(self, axis=None):
+        return imperative_invoke("squeeze", (self,), {"axis": axis})
+
+    def flip(self, axis):
+        return imperative_invoke("flip", (self,), {"axis": axis})
+
+    def tile(self, reps):
+        return imperative_invoke("tile", (self,), {"reps": tuple(reps) if isinstance(reps, (tuple, list)) else (reps,)})
+
+    def repeat(self, repeats, axis=None):
+        return imperative_invoke("repeat", (self,), {"repeats": repeats, "axis": axis})
+
+    def pad(self, mode, pad_width, constant_value=0):
+        return imperative_invoke("Pad", (self,), {"mode": mode,
+                                                  "pad_width": tuple(pad_width),
+                                                  "constant_value": constant_value})
+
+    def clip(self, a_min, a_max):
+        return imperative_invoke("clip", (self,), {"a_min": a_min, "a_max": a_max})
+
+    def slice_axis(self, axis, begin, end):
+        return imperative_invoke("slice_axis", (self,),
+                                 {"axis": axis, "begin": begin, "end": end})
+
+    def take(self, indices, axis=0, mode="clip"):
+        return imperative_invoke("take", (self, indices),
+                                 {"axis": axis, "mode": mode})
+
+    def one_hot(self, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+        return imperative_invoke("one_hot", (self,),
+                                 {"depth": depth, "on_value": on_value,
+                                  "off_value": off_value, "dtype": dtype})
+
+    def as_np_ndarray(self):
+        return self
+
+    def tostype(self, stype: str) -> "NDArray":
+        check(stype == "default", "only default storage on dense NDArray")
+        return self
+
+    def zeros_like(self):
+        return imperative_invoke("zeros_like", (self,), {})
+
+    def ones_like(self):
+        return imperative_invoke("ones_like", (self,), {})
+
+
+# unary/reduce method forms generated onto the class ----------------------
+_UNARY_METHODS = [
+    "abs", "sign", "exp", "log", "log2", "log10", "log1p", "expm1", "sqrt",
+    "rsqrt", "cbrt", "square", "reciprocal", "sin", "cos", "tan", "arcsin",
+    "arccos", "arctan", "sinh", "cosh", "tanh", "arcsinh", "arccosh",
+    "arctanh", "sigmoid", "relu", "softsign", "round", "rint", "fix",
+    "floor", "ceil", "trunc", "erf", "erfinv", "gamma", "gammaln", "softmax",
+    "log_softmax",
+]
+_REDUCE_METHODS = ["sum", "mean", "prod", "max", "min", "nansum", "nanprod",
+                   "argmax", "argmin", "norm"]
+
+
+def _add_unary_method(name: str) -> None:
+    def m(self, **kwargs):
+        return imperative_invoke(name, (self,), kwargs)
+    m.__name__ = name
+    if not hasattr(NDArray, name):
+        setattr(NDArray, name, m)
+
+
+def _add_reduce_method(name: str) -> None:
+    def m(self, axis=None, keepdims=False, **kwargs):
+        kwargs.update({"axis": axis, "keepdims": keepdims})
+        return imperative_invoke(name, (self,), kwargs)
+    m.__name__ = name
+    if not hasattr(NDArray, name):
+        setattr(NDArray, name, m)
+
+
+for _n in _UNARY_METHODS:
+    _add_unary_method(_n)
+for _n in _REDUCE_METHODS:
+    _add_reduce_method(_n)
+
+
+# ---------------------------------------------------------------------------
+# imperative invoke: frontend -> registry -> (record on tape)
+# ---------------------------------------------------------------------------
+
+def imperative_invoke(op_name: str, nd_inputs: Sequence, params: dict,
+                      out=None):
+    """The python analog of MXImperativeInvokeEx -> Imperative::Invoke
+    (ref: src/c_api/c_api_ndarray.cc; src/imperative/imperative.cc:87).
+
+    Runs the op through the jit cache, wraps outputs, and appends a tape
+    node when autograd is recording (ref Imperative::RecordOp,
+    imperative.cc:191).
+    """
+    opdef = _reg.get_op(op_name)
+    nd_inputs = tuple(x if isinstance(x, NDArray) else array(x)
+                      for x in nd_inputs)
+    arrays = tuple(x._data for x in nd_inputs)
+    raw = _reg.invoke_jax(opdef, arrays, params)
+    outputs = _reg.as_tuple_outputs(raw)
+    ctx = nd_inputs[0]._ctx if nd_inputs else current_context()
+    out_nds = tuple(NDArray(o, ctx=ctx) for o in outputs)
+
+    from .. import autograd
+    if autograd.is_recording() and opdef.differentiable:
+        autograd._record_op(opdef, params, nd_inputs, arrays, out_nds)
+
+    if out is not None:
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        for dst, src in zip(outs, out_nds):
+            dst._rebind(src._data)
+            dst._tape_entry = src._tape_entry
+        return out
+    if len(out_nds) == 1:
+        return out_nds[0]
+    return out_nds
+
+
+def _canonical_index(key):
+    """Convert an indexing key into a hashable/jax-compatible form."""
+    def conv(k):
+        if isinstance(k, NDArray):
+            return _HashableArray(k._data)
+        if isinstance(k, _np.ndarray):
+            return _HashableArray(k)
+        if isinstance(k, (list,)):
+            return _HashableArray(_np.asarray(k))
+        return k
+    if isinstance(key, tuple):
+        return tuple(conv(k) for k in key)
+    return conv(key)
+
+
+class _HashableArray:
+    """Wrapper letting index arrays ride through static jit params."""
+    __slots__ = ("value",)
+
+    def __init__(self, value):
+        self.value = value
+
+    def __hash__(self):
+        return hash((tuple(self.value.shape), str(self.value.dtype)))
+
+    def __eq__(self, other):
+        if not isinstance(other, _HashableArray):
+            return False
+        try:
+            return bool(_np.array_equal(_np.asarray(self.value),
+                                        _np.asarray(other.value)))
+        except Exception:
+            return self is other
+
+
+def _unwrap_index(key):
+    def conv(k):
+        return k.value if isinstance(k, _HashableArray) else k
+    if isinstance(key, tuple):
+        return tuple(conv(k) for k in key)
+    return conv(key)
+
+
+# indexing ops registered here since they need _unwrap_index ---------------
+
+@_reg.register("_index")
+def _index_impl(x, _idx=None):
+    return x[_unwrap_index(_idx)]
+
+
+@_reg.register("_index_assign")
+def _index_assign_impl(x, v, _idx=None):
+    idx = _unwrap_index(_idx)
+    if idx is Ellipsis or (isinstance(idx, slice) and idx == slice(None)):
+        import jax.numpy as jnp
+        return jnp.broadcast_to(v, x.shape).astype(x.dtype)
+    return x.at[idx].set(v.astype(x.dtype) if hasattr(v, "astype") else v)
+
+
+@_reg.register("_index_assign_scalar")
+def _index_assign_scalar_impl(x, _idx=None, _val=None):
+    idx = _unwrap_index(_idx)
+    val = _val.value if isinstance(_val, _HashableArray) else _val
+    if idx is Ellipsis or (isinstance(idx, slice) and idx == slice(None)):
+        import jax.numpy as jnp
+        return jnp.full(x.shape, val, dtype=x.dtype)
+    return x.at[idx].set(val)
+
+
+# ---------------------------------------------------------------------------
+# creation functions (ref: python/mxnet/ndarray/ndarray.py + utils)
+# ---------------------------------------------------------------------------
+
+def _place(data, ctx: Optional[Context]):
+    ctx = ctx if ctx is not None else current_context()
+    return NDArray(_jax().device_put(data, ctx.jax_device), ctx=ctx)
+
+
+def array(source_array, ctx: Optional[Context] = None, dtype=None) -> NDArray:
+    if isinstance(source_array, NDArray):
+        src = source_array._data
+        if dtype is not None:
+            src = src.astype(_as_dtype(dtype))
+        return _place(src, ctx or source_array._ctx)
+    np_arr = _np.asarray(source_array)
+    if dtype is None:
+        dtype = _np.float32 if np_arr.dtype == _np.float64 else np_arr.dtype
+    np_arr = np_arr.astype(_as_dtype(dtype)) if np_arr.dtype != _as_dtype(dtype) else np_arr
+    return _place(np_arr, ctx)
+
+
+def from_jax(jarr, ctx: Optional[Context] = None) -> NDArray:
+    return NDArray(jarr, ctx=ctx or current_context())
+
+
+def empty(shape, ctx=None, dtype=None) -> NDArray:
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def zeros(shape, ctx=None, dtype=None, **kwargs) -> NDArray:
+    if isinstance(shape, int):
+        shape = (shape,)
+    return _place(_jnp().zeros(tuple(shape), dtype=_as_dtype(dtype)), ctx)
+
+
+def ones(shape, ctx=None, dtype=None, **kwargs) -> NDArray:
+    if isinstance(shape, int):
+        shape = (shape,)
+    return _place(_jnp().ones(tuple(shape), dtype=_as_dtype(dtype)), ctx)
+
+
+def full(shape, val, ctx=None, dtype=None, **kwargs) -> NDArray:
+    if isinstance(shape, int):
+        shape = (shape,)
+    return _place(_jnp().full(tuple(shape), val, dtype=_as_dtype(dtype)), ctx)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None) -> NDArray:
+    dtype = _as_dtype(dtype)
+    arr = _np.arange(start, stop, step).astype(dtype)
+    if repeat > 1:
+        arr = _np.repeat(arr, repeat)
+    return _place(arr, ctx)
+
+
+def linspace(start, stop, num, endpoint=True, ctx=None, dtype=None) -> NDArray:
+    return _place(_np.linspace(start, stop, num, endpoint=endpoint)
+                  .astype(_as_dtype(dtype)), ctx)
+
+
+def eye(N, M=0, k=0, ctx=None, dtype=None) -> NDArray:
+    return _place(_np.eye(N, M if M else None, k).astype(_as_dtype(dtype)), ctx)
+
+
+def concatenate(arrays, axis=0, always_copy=True) -> NDArray:
+    return imperative_invoke("concat", tuple(arrays),
+                             {"dim": axis, "num_args": len(arrays)})
+
+
+def stack(*arrays, axis=0) -> NDArray:
+    if len(arrays) == 1 and isinstance(arrays[0], (list, tuple)):
+        arrays = tuple(arrays[0])
+    return imperative_invoke("stack", tuple(arrays),
+                             {"axis": axis, "num_args": len(arrays)})
+
+
+def moveaxis(tensor, source, destination) -> NDArray:
+    return imperative_invoke("moveaxis", (tensor,),
+                             {"source": source, "destination": destination})
+
+
+def waitall() -> None:
+    """Engine::WaitForAll analog (ref include/mxnet/engine.h): fence every
+    pending computation. JAX tracks dispatch per-array, so this is a no-op
+    barrier retained for API compat; effectful users should call
+    ``wait_to_read`` on specific arrays."""
+    import jax
+    (jax.device_put(0.0) + 0).block_until_ready()

@@ -1,0 +1,116 @@
+"""Generated imperative op namespace.
+
+Reference: python/mxnet/ndarray/register.py generates one Python function per
+registered C++ op at import time from MXSymbolGetAtomicSymbolInfo metadata.
+Here the registry is python-native, so "codegen" is closure generation: one
+frontend function per OpDef, handling NDArray/scalar inputs, ``out=``,
+``ctx=`` placement for creation ops, PRNG-key injection for rng ops, and
+train-mode injection for mode-dependent ops (Dropout/BatchNorm).
+
+Namespaces mirror the reference layout: ``mx.nd.<op>``, ``mx.nd.random``,
+``mx.nd.linalg``, ``mx.nd.contrib``, ``mx.nd._internal``.
+"""
+from __future__ import annotations
+
+import inspect
+from typing import Any, Dict
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..ops import registry as _reg
+from . import ndarray as _nd
+
+_PARAM_NAMES_CACHE: Dict[int, set] = {}
+
+
+def _param_names(opdef) -> set:
+    names = _PARAM_NAMES_CACHE.get(id(opdef))
+    if names is None:
+        try:
+            sig = inspect.signature(opdef.fn)
+            names = {p.name for p in sig.parameters.values()
+                     if p.kind in (p.KEYWORD_ONLY, p.POSITIONAL_OR_KEYWORD)}
+        except (TypeError, ValueError):
+            names = set()
+        _PARAM_NAMES_CACHE[id(opdef)] = names
+    return names
+
+
+def make_nd_function(name: str, opdef):
+    takes_training = "_training" in _param_names(opdef)
+
+    def generic(*args, **kwargs):
+        out = kwargs.pop("out", None)
+        kwargs.pop("name", None)
+        ctx = kwargs.pop("ctx", None)
+        inputs = []
+        for a in args:
+            if isinstance(a, _nd.NDArray):
+                inputs.append(a)
+            elif isinstance(a, (_np.ndarray, list, tuple)) and not opdef.creation:
+                inputs.append(_nd.array(a))
+            elif isinstance(a, (int, float)) and not opdef.creation:
+                inputs.append(_nd.array(_np.asarray(a)))
+            else:
+                raise MXNetError(
+                    f"{name}: positional argument {a!r} is not an NDArray; "
+                    f"pass op parameters as keywords")
+        params = kwargs
+        if takes_training and "_training" not in params:
+            from .. import autograd
+            params["_training"] = autograd.is_training()
+        if opdef.rng:
+            from .. import random as _random
+            inputs.append(_nd.from_jax(_random.next_key()))
+        result = _nd.imperative_invoke(name, tuple(inputs), params, out=out)
+        if ctx is not None and out is None:
+            from ..context import Context
+            c = ctx if isinstance(ctx, Context) else Context(ctx)
+            if isinstance(result, _nd.NDArray):
+                result = result.as_in_context(c)
+            else:
+                result = tuple(r.as_in_context(c) for r in result)
+        return result
+
+    generic.__name__ = name
+    generic.__doc__ = opdef.doc
+    generic.__module__ = "mxnet_tpu.ndarray.op"
+    return generic
+
+
+_NAMESPACE: Dict[str, Any] = {}
+
+
+def registry_namespace() -> Dict[str, Any]:
+    return _NAMESPACE
+
+
+def populate(target_module, submodules: Dict[str, Any]) -> None:
+    """Build every frontend function and install it into mx.nd + friends."""
+    seen = {}
+    for name in _reg.list_ops():
+        opdef = _reg.get_op(name)
+        fn = seen.get(id(opdef))
+        if fn is None or opdef.name == name:
+            fn = make_nd_function(name, opdef)
+            if opdef.name == name:
+                seen[id(opdef)] = fn
+        _NAMESPACE[name] = fn
+        # route to sub-namespaces the way the reference does
+        if name.startswith("_contrib_"):
+            setattr(submodules["contrib"], name[len("_contrib_"):], fn)
+        elif name.startswith("_linalg_"):
+            setattr(submodules["linalg"], name[len("_linalg_"):], fn)
+        elif name.startswith("_np_"):
+            continue
+        if name.startswith("_"):
+            setattr(submodules["_internal"], name, fn)
+            # reference exposes some _random/_sample under mx.nd.random
+            if name.startswith("_random_"):
+                setattr(submodules["random"], name[len("_random_"):], fn)
+            elif name.startswith("_sample_"):
+                setattr(submodules["random"], name[len("_sample_"):], fn)
+        else:
+            setattr(target_module, name, fn)
+        setattr(submodules["op"], name, fn)

@@ -1,0 +1,78 @@
+"""NDArray serialization: save/load of arrays and name->array dicts.
+
+Reference: MXNDArraySave/MXNDArrayLoad (src/c_api/c_api.cc:313,336) write a
+magic-numbered binary of TBlobs; python surface mx.nd.save/load
+(python/mxnet/ndarray/utils.py:149-222).
+
+TPU-native format: a numpy ``.npz`` container (zip of .npy) — portable,
+mmap-friendly, and holds the same (names, arrays) payload. Keys are stored
+as ``idx:name`` to preserve both list order and dict names. bfloat16 is
+stored as a uint16 view with a ``__bf16__:`` marker since numpy lacks the
+dtype.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Union
+
+import numpy as _np
+
+from ..base import MXNetError
+from . import ndarray as _nd
+
+_BF16_PREFIX = "__bf16__:"
+
+
+def _to_numpy(arr) -> _np.ndarray:
+    import jax.numpy as jnp
+    data = arr._data if isinstance(arr, _nd.NDArray) else arr
+    if data.dtype == jnp.bfloat16:
+        return _np.asarray(data.astype(jnp.float32))
+    return _np.asarray(data)
+
+
+def _is_bf16(arr) -> bool:
+    import jax.numpy as jnp
+    data = arr._data if isinstance(arr, _nd.NDArray) else arr
+    return data.dtype == jnp.bfloat16
+
+
+def save(fname: str, data) -> None:
+    """Save a list or dict of NDArrays (ref: mx.nd.save)."""
+    if isinstance(data, _nd.NDArray):
+        data = [data]
+    payload = {}
+    if isinstance(data, dict):
+        for i, (k, v) in enumerate(data.items()):
+            if not isinstance(v, _nd.NDArray):
+                raise MXNetError("save expects NDArray values")
+            name = f"{i}:{_BF16_PREFIX if _is_bf16(v) else ''}{k}"
+            payload[name] = _to_numpy(v)
+    elif isinstance(data, (list, tuple)):
+        for i, v in enumerate(data):
+            if not isinstance(v, _nd.NDArray):
+                raise MXNetError("save expects NDArray values")
+            payload[f"{i}:{_BF16_PREFIX if _is_bf16(v) else ''}"] = _to_numpy(v)
+    else:
+        raise MXNetError("save expects NDArray, list or dict")
+    with open(fname, "wb") as f:
+        _np.savez(f, **payload)
+
+
+def load(fname: str) -> Union[List, Dict]:
+    """Load arrays saved by :func:`save` (ref: mx.nd.load)."""
+    import jax.numpy as jnp
+    with _np.load(fname, allow_pickle=False) as z:
+        entries = []
+        for key in z.files:
+            idx_s, _, name = key.partition(":")
+            arr = z[key]
+            if name.startswith(_BF16_PREFIX):
+                name = name[len(_BF16_PREFIX):]
+                nd = _nd.array(arr).astype(jnp.bfloat16)
+            else:
+                nd = _nd.array(arr, dtype=arr.dtype)
+            entries.append((int(idx_s), name, nd))
+    entries.sort(key=lambda e: e[0])
+    if any(name for _, name, _ in entries):
+        return {name: nd for _, name, nd in entries}
+    return [nd for _, _, nd in entries]

@@ -1,0 +1,17 @@
+"""Operator library: every module registers its ops on import.
+
+Layout mirrors the reference's src/operator/ families (SURVEY.md §2.1):
+elemwise/broadcast/reduce = tensor ops, nn = neural net ops, random_ops =
+samplers, ordering = sort/topk, optimizer_ops = fused updates.
+"""
+from . import registry  # noqa: F401
+from . import elemwise  # noqa: F401
+from . import broadcast  # noqa: F401
+from . import reduce  # noqa: F401
+from . import matrix  # noqa: F401
+from . import nn  # noqa: F401
+from . import random_ops  # noqa: F401
+from . import ordering  # noqa: F401
+from . import optimizer_ops  # noqa: F401
+
+from .registry import get_op, list_ops, register  # noqa: F401

@@ -1,0 +1,68 @@
+"""Broadcasting binary ops and broadcast shape manipulation.
+
+Reference: src/operator/tensor/elemwise_binary_broadcast_op_*.cc and
+broadcast_reduce_op.h. jnp broadcasting matches the reference's numpy-style
+semantics directly; XLA handles the implicit-broadcast fusion that the
+reference implements with dedicated kernels.
+"""
+from __future__ import annotations
+
+from .registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+_BCAST = {
+    "broadcast_add": lambda a, b: a + b,
+    "broadcast_sub": lambda a, b: a - b,
+    "broadcast_mul": lambda a, b: a * b,
+    "broadcast_div": lambda a, b: a / b,
+    "broadcast_mod": lambda a, b: _jnp().mod(a, b),
+    "broadcast_power": lambda a, b: _jnp().power(a, b),
+    "broadcast_maximum": lambda a, b: _jnp().maximum(a, b),
+    "broadcast_minimum": lambda a, b: _jnp().minimum(a, b),
+    "broadcast_hypot": lambda a, b: _jnp().hypot(a, b),
+    "broadcast_equal": lambda a, b: (a == b).astype(a.dtype),
+    "broadcast_not_equal": lambda a, b: (a != b).astype(a.dtype),
+    "broadcast_greater": lambda a, b: (a > b).astype(a.dtype),
+    "broadcast_greater_equal": lambda a, b: (a >= b).astype(a.dtype),
+    "broadcast_lesser": lambda a, b: (a < b).astype(a.dtype),
+    "broadcast_lesser_equal": lambda a, b: (a <= b).astype(a.dtype),
+    "broadcast_logical_and": lambda a, b: ((a != 0) & (b != 0)).astype(a.dtype),
+    "broadcast_logical_or": lambda a, b: ((a != 0) | (b != 0)).astype(a.dtype),
+    "broadcast_logical_xor": lambda a, b: ((a != 0) ^ (b != 0)).astype(a.dtype),
+}
+
+for _name, _fn in _BCAST.items():
+    register(_name)(_fn)
+
+register("broadcast_plus")(lambda a, b: a + b)
+register("broadcast_minus")(lambda a, b: a - b)
+
+
+@register("broadcast_to")
+def _broadcast_to(x, shape=None):
+    jnp = _jnp()
+    # reference semantics: 0 in target shape means "keep this dim"
+    tgt = tuple(s if t == 0 else t for s, t in zip(x.shape, shape)) \
+        if len(shape) == x.ndim else tuple(shape)
+    return jnp.broadcast_to(x, tgt)
+
+
+@register("broadcast_like")
+def _broadcast_like(x, y, lhs_axes=None, rhs_axes=None):
+    return _jnp().broadcast_to(x, y.shape)
+
+
+@register("broadcast_axis", aliases=("broadcast_axes",))
+def _broadcast_axis(x, axis=(), size=()):
+    jnp = _jnp()
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    sizes = (size,) if isinstance(size, int) else tuple(size)
+    tgt = list(x.shape)
+    for a, s in zip(axes, sizes):
+        tgt[a] = s
+    return jnp.broadcast_to(x, tuple(tgt))

@@ -1,0 +1,245 @@
+"""Elementwise unary/binary operators and their *_scalar forms.
+
+Reference inventory: src/operator/tensor/elemwise_unary_op_basic.cc,
+elemwise_binary_op_basic.cc, elemwise_binary_scalar_op_*.cc and the ~200
+scalar functors in src/operator/mshadow_op.h. Here each op is a one-line pure
+jnp expression; XLA fuses chains of them into single kernels, which subsumes
+the reference's mshadow expression templates and its operator_tune.cc
+serial-vs-OpenMP autotuner (src/operator/operator_tune.cc) — fusion decisions
+belong to the compiler on TPU.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .registry import register, alias
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _jsp():
+    import jax.scipy.special as jsp
+    return jsp
+
+
+def _lax():
+    import jax.lax as lax
+    return lax
+
+
+# ---------------------------------------------------------------------------
+# unary math (ref: elemwise_unary_op_basic.cc, *_trig.cc, *_logexp.cc, *_pow.cc)
+# ---------------------------------------------------------------------------
+
+_UNARY = {
+    "abs": lambda x: _jnp().abs(x),
+    "sign": lambda x: _jnp().sign(x),
+    "negative": lambda x: -x,
+    "reciprocal": lambda x: 1.0 / x,
+    "square": lambda x: x * x,
+    "sqrt": lambda x: _jnp().sqrt(x),
+    "rsqrt": lambda x: _lax().rsqrt(x),
+    "cbrt": lambda x: _jnp().cbrt(x),
+    "rcbrt": lambda x: 1.0 / _jnp().cbrt(x),
+    "exp": lambda x: _jnp().exp(x),
+    "log": lambda x: _jnp().log(x),
+    "log10": lambda x: _jnp().log10(x),
+    "log2": lambda x: _jnp().log2(x),
+    "log1p": lambda x: _jnp().log1p(x),
+    "expm1": lambda x: _jnp().expm1(x),
+    "sin": lambda x: _jnp().sin(x),
+    "cos": lambda x: _jnp().cos(x),
+    "tan": lambda x: _jnp().tan(x),
+    "arcsin": lambda x: _jnp().arcsin(x),
+    "arccos": lambda x: _jnp().arccos(x),
+    "arctan": lambda x: _jnp().arctan(x),
+    "sinh": lambda x: _jnp().sinh(x),
+    "cosh": lambda x: _jnp().cosh(x),
+    "tanh": lambda x: _jnp().tanh(x),
+    "arcsinh": lambda x: _jnp().arcsinh(x),
+    "arccosh": lambda x: _jnp().arccosh(x),
+    "arctanh": lambda x: _jnp().arctanh(x),
+    "degrees": lambda x: _jnp().degrees(x),
+    "radians": lambda x: _jnp().radians(x),
+    "floor": lambda x: _jnp().floor(x),
+    "ceil": lambda x: _jnp().ceil(x),
+    "trunc": lambda x: _jnp().trunc(x),
+    "round": lambda x: _jnp().round(x),
+    "rint": lambda x: _jnp().rint(x),
+    "fix": lambda x: _jnp().fix(x),
+    "sigmoid": lambda x: 1.0 / (1.0 + _jnp().exp(-x)),
+    "softsign": lambda x: x / (1.0 + _jnp().abs(x)),
+    "relu": lambda x: _jnp().maximum(x, 0),
+    "erf": lambda x: _jsp().erf(x),
+    "erfinv": lambda x: _jsp().erfinv(x),
+    "gamma": lambda x: _jnp().exp(_jsp().gammaln(x)),
+    "gammaln": lambda x: _jsp().gammaln(x),
+    "logical_not": lambda x: (x == 0).astype(x.dtype),
+    "size_array": lambda x: _jnp().array([x.size], dtype=_np.int64),
+    "shape_array": lambda x: _jnp().array(x.shape, dtype=_np.int64),
+}
+
+for _name, _fn in _UNARY.items():
+    register(_name)(_fn)
+
+@register("copy", aliases=("identity", "_copy"))
+def _copy(x):
+    # jax arrays are immutable, so sharing the buffer is a safe zero-cost copy
+    return x
+
+@register("BlockGrad", aliases=("stop_gradient",))
+def _block_grad(x):
+    import jax
+    return jax.lax.stop_gradient(x)
+
+
+@register("make_loss")
+def _make_loss(x, grad_scale: float = 1.0, **_):
+    import jax
+    return x  # gradient handled as head grad; MakeLoss marks a loss output
+
+
+@register("cast", aliases=("Cast",))
+def _cast(x, dtype="float32"):
+    import jax.numpy as jnp
+    d = jnp.bfloat16 if dtype in ("bfloat16",) else _np.dtype(dtype)
+    return x.astype(d)
+
+
+@register("amp_cast")
+def _amp_cast(x, dtype="float32"):
+    return _cast(x, dtype)
+
+
+@register("amp_multicast", num_outputs=lambda n, p: n, variadic=True)
+def _amp_multicast(*xs, num_outputs=None):
+    import jax.numpy as jnp
+    widest = jnp.result_type(*[x.dtype for x in xs])
+    return tuple(x.astype(widest) for x in xs)
+
+
+@register("zeros_like")
+def _zeros_like(x):
+    return _jnp().zeros_like(x)
+
+
+@register("ones_like")
+def _ones_like(x):
+    return _jnp().ones_like(x)
+
+
+@register("gamma_sample_grad_dummy", namespaces=())
+def _noop(x):
+    return x
+
+
+# ---------------------------------------------------------------------------
+# binary elementwise (same-shape) — ref: elemwise_binary_op_basic.cc
+# ---------------------------------------------------------------------------
+
+_BINARY = {
+    "elemwise_add": lambda a, b: a + b,
+    "elemwise_sub": lambda a, b: a - b,
+    "elemwise_mul": lambda a, b: a * b,
+    "elemwise_div": lambda a, b: a / b,
+    "_maximum": lambda a, b: _jnp().maximum(a, b),
+    "_minimum": lambda a, b: _jnp().minimum(a, b),
+    "_hypot": lambda a, b: _jnp().hypot(a, b),
+    "_power": lambda a, b: _jnp().power(a, b),
+    "_mod": lambda a, b: _jnp().mod(a, b),
+    "_equal": lambda a, b: (a == b).astype(a.dtype),
+    "_not_equal": lambda a, b: (a != b).astype(a.dtype),
+    "_greater": lambda a, b: (a > b).astype(a.dtype),
+    "_greater_equal": lambda a, b: (a >= b).astype(a.dtype),
+    "_lesser": lambda a, b: (a < b).astype(a.dtype),
+    "_lesser_equal": lambda a, b: (a <= b).astype(a.dtype),
+    "_logical_and": lambda a, b: ((a != 0) & (b != 0)).astype(a.dtype),
+    "_logical_or": lambda a, b: ((a != 0) | (b != 0)).astype(a.dtype),
+    "_logical_xor": lambda a, b: ((a != 0) ^ (b != 0)).astype(a.dtype),
+    "smooth_l1": lambda a, b=None: None,  # replaced below
+}
+del _BINARY["smooth_l1"]
+
+for _name, _fn in _BINARY.items():
+    register(_name)(_fn)
+
+alias("_add", "elemwise_add")
+alias("_plus", "elemwise_add")
+alias("_sub", "elemwise_sub")
+alias("_minus", "elemwise_sub")
+alias("_mul", "elemwise_mul")
+alias("_div", "elemwise_div")
+
+
+@register("_scatter_elemwise_div")
+def _scatter_elemwise_div(a, b):
+    return a / b
+
+
+@register("smooth_l1")
+def _smooth_l1(x, scalar: float = 1.0):
+    jnp = _jnp()
+    s2 = scalar * scalar
+    return jnp.where(jnp.abs(x) < 1.0 / s2,
+                     0.5 * s2 * x * x,
+                     jnp.abs(x) - 0.5 / s2)
+
+
+# ---------------------------------------------------------------------------
+# scalar forms — ref: elemwise_binary_scalar_op_*.cc
+# ---------------------------------------------------------------------------
+
+def _scalar_op(fwd, rev=None):
+    def impl(x, scalar: float = 1.0, reverse: bool = False):
+        if reverse and rev is not None:
+            return rev(x, scalar)
+        return fwd(x, scalar)
+    return impl
+
+
+_SCALAR = {
+    "_plus_scalar": _scalar_op(lambda x, s: x + s),
+    "_minus_scalar": _scalar_op(lambda x, s: x - s),
+    "_rminus_scalar": _scalar_op(lambda x, s: s - x, lambda x, s: s - x),
+    "_mul_scalar": _scalar_op(lambda x, s: x * s),
+    "_div_scalar": _scalar_op(lambda x, s: x / s),
+    "_rdiv_scalar": _scalar_op(lambda x, s: s / x, lambda x, s: s / x),
+    "_mod_scalar": _scalar_op(lambda x, s: _jnp().mod(x, s)),
+    "_rmod_scalar": _scalar_op(lambda x, s: _jnp().mod(s, x),
+                               lambda x, s: _jnp().mod(s, x)),
+    "_power_scalar": _scalar_op(lambda x, s: _jnp().power(x, s)),
+    "_rpower_scalar": _scalar_op(lambda x, s: _jnp().power(s, x),
+                                 lambda x, s: _jnp().power(s, x)),
+    "_maximum_scalar": _scalar_op(lambda x, s: _jnp().maximum(x, s)),
+    "_minimum_scalar": _scalar_op(lambda x, s: _jnp().minimum(x, s)),
+    "_hypot_scalar": _scalar_op(lambda x, s: _jnp().hypot(x, s)),
+    "_equal_scalar": _scalar_op(lambda x, s: (x == s).astype(x.dtype)),
+    "_not_equal_scalar": _scalar_op(lambda x, s: (x != s).astype(x.dtype)),
+    "_greater_scalar": _scalar_op(lambda x, s: (x > s).astype(x.dtype),
+                                  lambda x, s: (s > x).astype(x.dtype)),
+    "_greater_equal_scalar": _scalar_op(lambda x, s: (x >= s).astype(x.dtype),
+                                        lambda x, s: (s >= x).astype(x.dtype)),
+    "_lesser_scalar": _scalar_op(lambda x, s: (x < s).astype(x.dtype),
+                                 lambda x, s: (s < x).astype(x.dtype)),
+    "_lesser_equal_scalar": _scalar_op(lambda x, s: (x <= s).astype(x.dtype),
+                                       lambda x, s: (s <= x).astype(x.dtype)),
+    "_logical_and_scalar": _scalar_op(lambda x, s: ((x != 0) & bool(s)).astype(x.dtype)),
+    "_logical_or_scalar": _scalar_op(lambda x, s: ((x != 0) | bool(s)).astype(x.dtype)),
+    "_logical_xor_scalar": _scalar_op(lambda x, s: ((x != 0) ^ bool(s)).astype(x.dtype)),
+}
+
+for _name, _fn in _SCALAR.items():
+    register(_name)(_fn)
+
+
+@register("_scatter_plus_scalar")
+def _scatter_plus_scalar(x, scalar: float = 1.0, reverse: bool = False):
+    return x + scalar
+
+
+@register("_scatter_minus_scalar")
+def _scatter_minus_scalar(x, scalar: float = 1.0, reverse: bool = False):
+    return x - scalar

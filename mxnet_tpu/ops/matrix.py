@@ -1,0 +1,337 @@
+"""Shape manipulation, joining/splitting, indexing, and matrix products.
+
+Reference: src/operator/tensor/matrix_op.cc (reshape/transpose/slice/...),
+indexing_op.cc (take/gather/scatter/one_hot), dot-inl.h (dot/batch_dot),
+init_op.cc (*_like). The reference's reshape "magic codes" (0, -1, -2, -3,
+-4) are reimplemented exactly since Gluon layers and serialized symbols rely
+on them.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from .registry import register, alias
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _lax():
+    import jax.lax as lax
+    return lax
+
+
+def mx_reshape_shape(src_shape, target):
+    """Reference reshape semantics (src/operator/tensor/matrix_op-inl.h):
+    0 copy input dim; -1 infer; -2 copy all remaining; -3 merge two dims;
+    -4 split one dim into the next two values."""
+    target = list(target)
+    out = []
+    i = 0  # index into src
+    j = 0  # index into target
+    while j < len(target):
+        t = target[j]
+        if t > 0:
+            out.append(t)
+            i += 1
+        elif t == 0:
+            out.append(src_shape[i])
+            i += 1
+        elif t == -1:
+            out.append(-1)
+            i += 1
+        elif t == -2:
+            out.extend(src_shape[i:])
+            i = len(src_shape)
+        elif t == -3:
+            out.append(src_shape[i] * src_shape[i + 1])
+            i += 2
+        elif t == -4:
+            d1, d2 = target[j + 1], target[j + 2]
+            if d1 == -1:
+                d1 = src_shape[i] // d2
+            if d2 == -1:
+                d2 = src_shape[i] // d1
+            out.extend([d1, d2])
+            i += 1
+            j += 2
+        else:
+            raise MXNetError(f"invalid reshape code {t}")
+        j += 1
+    # resolve a single -1
+    if out.count(-1) > 1:
+        raise MXNetError("reshape can infer at most one dimension")
+    return tuple(out)
+
+
+@register("reshape", aliases=("Reshape",))
+def _reshape(x, shape=(), reverse: bool = False, **_):
+    shp = mx_reshape_shape(x.shape, tuple(shape))
+    return x.reshape(shp)
+
+
+@register("reshape_like")
+def _reshape_like(x, y, **_):
+    return x.reshape(y.shape)
+
+
+@register("flatten", aliases=("Flatten",))
+def _flatten(x):
+    n = 1
+    for s in x.shape[1:]:
+        n *= s
+    return x.reshape((x.shape[0], n))
+
+
+@register("transpose")
+def _transpose(x, axes=()):
+    jnp = _jnp()
+    return jnp.transpose(x, tuple(axes) if axes else None)
+
+
+@register("expand_dims")
+def _expand_dims(x, axis=0):
+    return _jnp().expand_dims(x, axis)
+
+
+@register("squeeze")
+def _squeeze(x, axis=None):
+    return _jnp().squeeze(x, axis if axis is None or isinstance(axis, int)
+                          else tuple(axis))
+
+
+@register("swapaxes", aliases=("SwapAxis",))
+def _swapaxes(x, dim1=0, dim2=0):
+    return _jnp().swapaxes(x, dim1, dim2)
+
+
+@register("moveaxis")
+def _moveaxis(x, source=0, destination=0):
+    return _jnp().moveaxis(x, source, destination)
+
+
+@register("slice", aliases=("crop",))
+def _slice(x, begin=(), end=(), step=()):
+    slices = []
+    step = step or (None,) * len(begin)
+    for b, e, s in zip(begin, end, step):
+        slices.append(slice(b, e, s))
+    return x[tuple(slices)]
+
+
+@register("slice_axis")
+def _slice_axis(x, axis=0, begin=0, end=None):
+    idx = [slice(None)] * x.ndim
+    idx[axis] = slice(begin, end)
+    return x[tuple(idx)]
+
+
+@register("slice_like")
+def _slice_like(x, y, axes=()):
+    ax = tuple(axes) if axes else tuple(range(min(x.ndim, y.ndim)))
+    idx = [slice(None)] * x.ndim
+    for a in ax:
+        idx[a] = slice(0, y.shape[a])
+    return x[tuple(idx)]
+
+
+@register("flip", aliases=("reverse",))
+def _flip(x, axis=0):
+    return _jnp().flip(x, axis if isinstance(axis, int) else tuple(axis))
+
+
+@register("tile")
+def _tile(x, reps=()):
+    return _jnp().tile(x, tuple(reps))
+
+
+@register("repeat")
+def _repeat(x, repeats=1, axis=None):
+    return _jnp().repeat(x, repeats, axis=axis)
+
+
+@register("Pad", aliases=("pad",))
+def _pad(x, mode="constant", pad_width=(), constant_value=0.0):
+    jnp = _jnp()
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(len(pad_width) // 2)]
+    if mode == "constant":
+        return jnp.pad(x, pw, mode="constant", constant_values=constant_value)
+    if mode == "edge":
+        return jnp.pad(x, pw, mode="edge")
+    if mode == "reflect":
+        return jnp.pad(x, pw, mode="reflect")
+    raise MXNetError(f"unsupported pad mode {mode}")
+
+
+@register("clip")
+def _clip(x, a_min=0.0, a_max=0.0):
+    return _jnp().clip(x, a_min, a_max)
+
+
+@register("concat", aliases=("Concat",), variadic=True)
+def _concat(*xs, dim=1, num_args=None):
+    return _jnp().concatenate(xs, axis=dim)
+
+
+@register("stack", variadic=True)
+def _stack(*xs, axis=0, num_args=None):
+    return _jnp().stack(xs, axis=axis)
+
+
+def _split_outputs(n_inputs, params):
+    return int(params.get("num_outputs", 1))
+
+
+@register("split", aliases=("SliceChannel",), num_outputs=_split_outputs)
+def _split(x, num_outputs=1, axis=1, squeeze_axis=False):
+    jnp = _jnp()
+    parts = jnp.split(x, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts) if num_outputs > 1 else parts[0]
+
+
+@register("take")
+def _take(x, indices, axis=0, mode="clip"):
+    jnp = _jnp()
+    idx = indices.astype(_np.int32)
+    n = x.shape[axis]
+    if mode == "clip":
+        idx = jnp.clip(idx, 0, n - 1)
+    elif mode == "wrap":
+        idx = jnp.mod(idx, n)
+    return jnp.take(x, idx, axis=axis)
+
+
+@register("pick")
+def _pick(x, index, axis=-1, keepdims=False, mode="clip"):
+    jnp = _jnp()
+    ax = axis % x.ndim
+    idx = jnp.clip(index.astype(_np.int32), 0, x.shape[ax] - 1)
+    idx_e = jnp.expand_dims(idx, ax)
+    out = jnp.take_along_axis(x, idx_e, axis=ax)
+    return out if keepdims else jnp.squeeze(out, axis=ax)
+
+
+@register("gather_nd")
+def _gather_nd(x, indices):
+    idx = tuple(indices.astype(_np.int32))
+    return x[idx]
+
+
+@register("scatter_nd")
+def _scatter_nd(data, indices, shape=()):
+    jnp = _jnp()
+    out = jnp.zeros(tuple(shape), dtype=data.dtype)
+    idx = tuple(indices.astype(_np.int32))
+    return out.at[idx].set(data)
+
+
+@register("_scatter_set_nd")
+def _scatter_set_nd(lhs, data, indices, shape=()):
+    idx = tuple(indices.astype(_np.int32))
+    return lhs.at[idx].set(data)
+
+
+@register("one_hot")
+def _one_hot(indices, depth=1, on_value=1.0, off_value=0.0, dtype="float32"):
+    jnp = _jnp()
+    ind = indices.astype(_np.int32)
+    oh = jnp.equal(jnp.expand_dims(ind, -1),
+                   jnp.arange(depth, dtype=_np.int32))
+    d = jnp.bfloat16 if dtype == "bfloat16" else _np.dtype(dtype)
+    return jnp.where(oh, on_value, off_value).astype(d)
+
+
+@register("where")
+def _where(cond, a, b):
+    return _jnp().where(cond != 0, a, b)
+
+
+@register("depth_to_space")
+def _depth_to_space(x, block_size=1):
+    jnp = _jnp()
+    n, c, h, w = x.shape
+    b = block_size
+    y = x.reshape((n, b, b, c // (b * b), h, w))
+    y = jnp.transpose(y, (0, 3, 4, 1, 5, 2))
+    return y.reshape((n, c // (b * b), h * b, w * b))
+
+
+@register("space_to_depth")
+def _space_to_depth(x, block_size=1):
+    jnp = _jnp()
+    n, c, h, w = x.shape
+    b = block_size
+    y = x.reshape((n, c, h // b, b, w // b, b))
+    y = jnp.transpose(y, (0, 3, 5, 1, 2, 4))
+    return y.reshape((n, c * b * b, h // b, w // b))
+
+
+@register("diag")
+def _diag(x, k=0, axis1=0, axis2=1):
+    jnp = _jnp()
+    if x.ndim == 1:
+        return jnp.diag(x, k)
+    return jnp.diagonal(x, offset=k, axis1=axis1, axis2=axis2)
+
+
+@register("ravel_multi_index", differentiable=False)
+def _ravel_multi_index(data, shape=()):
+    jnp = _jnp()
+    strides = []
+    acc = 1
+    for s in reversed(shape):
+        strides.append(acc)
+        acc *= s
+    strides = jnp.array(list(reversed(strides)), dtype=data.dtype)
+    return jnp.sum(data * strides[:, None], axis=0)
+
+
+@register("unravel_index", differentiable=False)
+def _unravel_index(data, shape=()):
+    jnp = _jnp()
+    out = []
+    rem = data.astype(_np.int64)
+    for s in reversed(shape):
+        out.append(rem % s)
+        rem = rem // s
+    return jnp.stack(list(reversed(out)), axis=0).astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# products — the MXU path. Accumulate in f32 via preferred_element_type when
+# inputs are bf16 (TPU-first: keep the systolic array fed, accumulate wide).
+# ---------------------------------------------------------------------------
+
+@register("dot")
+def _dot(a, b, transpose_a=False, transpose_b=False, forward_stype=None):
+    jnp = _jnp()
+    x = a.T if transpose_a and a.ndim == 2 else (
+        jnp.transpose(a) if transpose_a else a)
+    y = b.T if transpose_b and b.ndim == 2 else (
+        jnp.transpose(b) if transpose_b else b)
+    if x.ndim == 1 and y.ndim == 1:
+        return jnp.dot(x, y)
+    # reference dot on >2d: contract last axis of a with first axis of b
+    return jnp.tensordot(x, y, axes=([x.ndim - 1], [0]))
+
+
+@register("batch_dot")
+def _batch_dot(a, b, transpose_a=False, transpose_b=False, forward_stype=None):
+    jnp = _jnp()
+    x = jnp.swapaxes(a, -1, -2) if transpose_a else a
+    y = jnp.swapaxes(b, -1, -2) if transpose_b else b
+    return jnp.matmul(x, y)
+
+
+@register("khatri_rao", variadic=True)
+def _khatri_rao(*mats):
+    """Column-wise Kronecker product (ref: src/operator/contrib/krprod.cc)."""
+    out = mats[0]
+    for m in mats[1:]:
+        out = (out[:, None, :] * m[None, :, :]).reshape((-1, m.shape[1]))
+    return out

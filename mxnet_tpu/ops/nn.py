@@ -1,0 +1,587 @@
+"""Neural-network operators: the MXU-heavy family.
+
+Reference: src/operator/nn/ (Convolution, FullyConnected, BatchNorm, Pooling,
+Activation, Softmax, Dropout, LayerNorm, LRN, UpSampling, Embedding ...) plus
+legacy top-level ops (LeakyReLU, InstanceNorm, L2Normalization, Sequence*).
+
+TPU-native notes:
+- Convolution/FullyConnected lower to ``lax.conv_general_dilated`` /
+  ``jnp.dot`` which XLA tiles onto the MXU; there is no cuDNN-autotune
+  analog because XLA picks the layout/tiling (the reference's
+  MXNET_CUDNN_AUTOTUNE_DEFAULT knob is subsumed by the compiler).
+- Ops whose reference backward is *defined* rather than derived
+  (SoftmaxOutput, MakeLoss-style grad scaling) use ``jax.custom_vjp`` so both
+  the eager tape and whole-graph jit see identical gradients.
+- Stateful-RNG ops (Dropout) take an explicit PRNG key input (rng=True) —
+  functional randomness, reproducible under jit, instead of the reference's
+  per-device PRNG resource (ref: include/mxnet/resource.h kRandom).
+- BatchNorm returns (out, mean, var); moving-stat update is done by the
+  caller rebinding its running buffers (the reference mutates aux states
+  in-place inside the op — impossible and unnecessary in functional XLA).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from .registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _jax():
+    import jax
+    return jax
+
+
+def _lax():
+    import jax.lax as lax
+    return lax
+
+
+def _tuplify(v, n):
+    if v is None or v == ():
+        return (1,) * n
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(v)
+
+
+# ---------------------------------------------------------------------------
+# FullyConnected (ref: src/operator/nn/fully_connected.cc)
+# ---------------------------------------------------------------------------
+
+@register("FullyConnected", aliases=("fully_connected",))
+def _fully_connected(data, weight, *maybe_bias, num_hidden=1, no_bias=False,
+                     flatten=True):
+    jnp = _jnp()
+    x = data
+    if flatten and x.ndim > 2:
+        x = x.reshape((x.shape[0], -1))
+    elif not flatten and x.ndim > 2:
+        pass  # apply to last axis
+    out = jnp.matmul(x, weight.T)
+    if not no_bias and maybe_bias:
+        out = out + maybe_bias[0]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Convolution / Deconvolution (ref: src/operator/nn/convolution.cc,
+# deconvolution.cc; im2col replaced by XLA's native conv lowering)
+# ---------------------------------------------------------------------------
+
+_CONV_DN = {1: ("NCW", "OIW", "NCW"),
+            2: ("NCHW", "OIHW", "NCHW"),
+            3: ("NCDHW", "OIDHW", "NCDHW")}
+
+
+@register("Convolution", aliases=("conv2d",))
+def _convolution(data, weight, *maybe_bias, kernel=(), stride=(), dilate=(),
+                 pad=(), num_filter=1, num_group=1, workspace=1024,
+                 no_bias=False, cudnn_tune=None, cudnn_off=False, layout=None):
+    lax = _lax()
+    nd = len(kernel)
+    stride = _tuplify(stride, nd)
+    dilate = _tuplify(dilate, nd)
+    pad = _tuplify(pad if pad else 0, nd)
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape, _CONV_DN[nd])
+    out = lax.conv_general_dilated(
+        data, weight,
+        window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=num_group,
+    )
+    if not no_bias and maybe_bias:
+        bias = maybe_bias[0]
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+@register("Deconvolution")
+def _deconvolution(data, weight, *maybe_bias, kernel=(), stride=(), dilate=(),
+                   pad=(), adj=(), target_shape=(), num_filter=1, num_group=1,
+                   workspace=1024, no_bias=True, cudnn_tune=None,
+                   cudnn_off=False, layout=None):
+    lax = _lax()
+    nd = len(kernel)
+    stride = _tuplify(stride, nd)
+    pad = _tuplify(pad if pad else 0, nd)
+    adj = _tuplify(adj if adj else 0, nd)
+    # transposed conv = gradient of conv wrt input: lhs-dilate by stride.
+    pads = [(kernel[i] - 1 - pad[i], kernel[i] - 1 - pad[i] + adj[i])
+            for i in range(nd)]
+    dn = ("NCHW", "IOHW", "NCHW") if nd == 2 else (
+        ("NCW", "IOW", "NCW") if nd == 1 else ("NCDHW", "IODHW", "NCDHW"))
+    if num_group != 1:
+        raise MXNetError("grouped Deconvolution not yet supported")
+    out = lax.conv_transpose(data, weight, strides=stride, padding=pads,
+                             dimension_numbers=dn, transpose_kernel=True)
+    if not no_bias and maybe_bias:
+        out = out + maybe_bias[0].reshape((1, -1) + (1,) * nd)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pooling (ref: src/operator/nn/pooling.cc + pool.h)
+# ---------------------------------------------------------------------------
+
+@register("Pooling", aliases=("pooling",))
+def _pooling(data, kernel=(), pool_type="max", global_pool=False,
+             cudnn_off=False, pooling_convention="valid", stride=(), pad=(),
+             p_value=2, count_include_pad=True, layout=None):
+    jnp, lax = _jnp(), _lax()
+    nd = data.ndim - 2
+    if global_pool:
+        axes = tuple(range(2, data.ndim))
+        if pool_type == "max":
+            return jnp.max(data, axis=axes, keepdims=True)
+        if pool_type in ("avg", "sum"):
+            r = jnp.sum(data, axis=axes, keepdims=True)
+            if pool_type == "avg":
+                r = r / _np.prod([data.shape[a] for a in axes])
+            return r
+        if pool_type == "lp":
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(data), p_value),
+                                     axis=axes, keepdims=True), 1.0 / p_value)
+        raise MXNetError(f"unknown pool_type {pool_type}")
+
+    kernel = tuple(kernel)
+    stride = _tuplify(stride if stride else 1, nd)
+    pad = _tuplify(pad if pad else 0, nd)
+
+    # ceil ("full") convention: extra high-side padding so the last window fits
+    extra = [0] * nd
+    if pooling_convention == "full":
+        for i in range(nd):
+            in_i = data.shape[2 + i]
+            out_i = -(-(in_i + 2 * pad[i] - kernel[i]) // stride[i]) + 1  # ceil
+            need = (out_i - 1) * stride[i] + kernel[i] - in_i - 2 * pad[i]
+            extra[i] = max(0, need)
+
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    pads = ((0, 0), (0, 0)) + tuple((pad[i], pad[i] + extra[i]) for i in range(nd))
+
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else \
+            jnp.iinfo(data.dtype).min
+        return lax.reduce_window(data, init, lax.max, window, strides, pads)
+    if pool_type in ("avg", "sum"):
+        s = lax.reduce_window(data, 0.0, lax.add, window, strides, pads)
+        if pool_type == "sum":
+            return s
+        if count_include_pad:
+            return s / float(_np.prod(kernel))
+        ones = jnp.ones(data.shape, data.dtype)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, pads)
+        return s / cnt
+    if pool_type == "lp":
+        s = lax.reduce_window(jnp.power(jnp.abs(data), p_value), 0.0, lax.add,
+                              window, strides, pads)
+        return jnp.power(s, 1.0 / p_value)
+    raise MXNetError(f"unknown pool_type {pool_type}")
+
+
+# ---------------------------------------------------------------------------
+# Normalization (ref: batch_norm.cc, layer_norm.cc, instance_norm.cc,
+# l2_normalization.cc, lrn.cc)
+# ---------------------------------------------------------------------------
+
+@register("BatchNorm", aliases=("batch_norm",), num_outputs=3)
+def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
+                momentum=0.9, fix_gamma=True, use_global_stats=False,
+                output_mean_var=False, axis=1, cudnn_off=False,
+                _training=False):
+    jnp = _jnp()
+    ax = axis % data.ndim
+    red = tuple(i for i in range(data.ndim) if i != ax)
+    bshape = tuple(data.shape[ax] if i == ax else 1 for i in range(data.ndim))
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    if _training and not use_global_stats:
+        mean = jnp.mean(data, axis=red)
+        var = jnp.var(data, axis=red)
+    else:
+        mean, var = moving_mean, moving_var
+    inv = _lax().rsqrt(var + eps)
+    out = (data - mean.reshape(bshape)) * (inv * g).reshape(bshape) \
+        + beta.reshape(bshape)
+    return out, mean, var
+
+
+@register("LayerNorm", aliases=("layer_norm",), num_outputs=3)
+def _layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
+    jnp = _jnp()
+    ax = axis % data.ndim
+    mean = jnp.mean(data, axis=ax, keepdims=True)
+    var = jnp.var(data, axis=ax, keepdims=True)
+    inv = _lax().rsqrt(var + eps)
+    shape = tuple(data.shape[ax] if i == ax else 1 for i in range(data.ndim))
+    out = (data - mean) * inv * gamma.reshape(shape) + beta.reshape(shape)
+    return out, jnp.squeeze(mean, ax), jnp.squeeze(var, ax)
+
+
+@register("InstanceNorm")
+def _instance_norm(data, gamma, beta, eps=1e-3):
+    jnp = _jnp()
+    red = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=red, keepdims=True)
+    var = jnp.var(data, axis=red, keepdims=True)
+    shape = (1, -1) + (1,) * (data.ndim - 2)
+    return (data - mean) * _lax().rsqrt(var + eps) * gamma.reshape(shape) \
+        + beta.reshape(shape)
+
+
+@register("L2Normalization")
+def _l2_normalization(data, eps=1e-10, mode="instance"):
+    jnp = _jnp()
+    if mode == "instance":
+        red = tuple(range(1, data.ndim))
+        n = jnp.sqrt(jnp.sum(jnp.square(data), axis=red, keepdims=True) + eps)
+    elif mode == "channel":
+        n = jnp.sqrt(jnp.sum(jnp.square(data), axis=1, keepdims=True) + eps)
+    elif mode == "spatial":
+        red = tuple(range(2, data.ndim))
+        n = jnp.sqrt(jnp.sum(jnp.square(data), axis=red, keepdims=True) + eps)
+    else:
+        raise MXNetError(f"unknown L2Normalization mode {mode}")
+    return data / n
+
+
+@register("LRN")
+def _lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
+    jnp = _jnp()
+    sq = jnp.square(data)
+    half = nsize // 2
+    padded = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    c = data.shape[1]
+    acc = sum(padded[:, i:i + c] for i in range(nsize))
+    return data / jnp.power(knorm + alpha * acc / nsize, beta)
+
+
+# ---------------------------------------------------------------------------
+# Activations (ref: activation.cc, leaky_relu.cc)
+# ---------------------------------------------------------------------------
+
+@register("Activation", aliases=("activation",))
+def _activation(data, act_type="relu"):
+    jnp = _jnp()
+    if act_type == "relu":
+        return jnp.maximum(data, 0)
+    if act_type == "sigmoid":
+        return 1.0 / (1.0 + jnp.exp(-data))
+    if act_type == "tanh":
+        return jnp.tanh(data)
+    if act_type == "softrelu":
+        return jnp.logaddexp(data, 0.0)
+    if act_type == "softsign":
+        return data / (1.0 + jnp.abs(data))
+    raise MXNetError(f"unknown act_type {act_type}")
+
+
+@register("LeakyReLU")
+def _leaky_relu(data, *maybe_gamma, act_type="leaky", slope=0.25,
+                lower_bound=0.125, upper_bound=0.334):
+    jnp = _jnp()
+    if act_type == "leaky":
+        return jnp.where(data >= 0, data, slope * data)
+    if act_type == "elu":
+        return jnp.where(data >= 0, data, slope * (jnp.exp(data) - 1.0))
+    if act_type == "selu":
+        a, l = 1.6732632423543772, 1.0507009873554805
+        return l * jnp.where(data >= 0, data, a * (jnp.exp(data) - 1.0))
+    if act_type == "gelu":
+        import jax.scipy.special as jsp
+        return 0.5 * data * (1.0 + jsp.erf(data / _np.sqrt(2.0)))
+    if act_type == "prelu":
+        gamma = maybe_gamma[0]
+        shape = (1, -1) + (1,) * (data.ndim - 2) if data.ndim > 1 else (-1,)
+        g = gamma.reshape(shape) if gamma.ndim == 1 else gamma
+        return jnp.where(data >= 0, data, g * data)
+    if act_type == "rrelu":
+        mid = (lower_bound + upper_bound) / 2.0
+        return jnp.where(data >= 0, data, mid * data)
+    raise MXNetError(f"unknown act_type {act_type}")
+
+
+# ---------------------------------------------------------------------------
+# Softmax family (ref: softmax.cc, softmax_output.cc, softmax_activation.cc)
+# ---------------------------------------------------------------------------
+
+@register("softmax")
+def _softmax(data, *maybe_length, axis=-1, temperature=None, dtype=None,
+             use_length=False):
+    import jax
+    x = data if temperature in (None, 1.0) else data / temperature
+    out = jax.nn.softmax(x, axis=axis)
+    if dtype is not None:
+        out = out.astype(_np.dtype(dtype))
+    return out
+
+
+@register("log_softmax")
+def _log_softmax(data, axis=-1, temperature=None, dtype=None, use_length=False):
+    import jax
+    x = data if temperature in (None, 1.0) else data / temperature
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@register("softmin")
+def _softmin(data, axis=-1, temperature=None, dtype=None, use_length=False):
+    import jax
+    return jax.nn.softmax(-data, axis=axis)
+
+
+@register("SoftmaxActivation")
+def _softmax_activation(data, mode="instance"):
+    import jax
+    if mode == "channel":
+        return jax.nn.softmax(data, axis=1)
+    return jax.nn.softmax(data.reshape((data.shape[0], -1)),
+                          axis=-1).reshape(data.shape)
+
+
+@register("softmax_cross_entropy")
+def _softmax_cross_entropy(data, label):
+    import jax
+    logp = jax.nn.log_softmax(data, axis=-1)
+    lbl = label.astype(_np.int32)
+    nll = -_jnp().take_along_axis(logp, lbl[:, None], axis=-1)
+    return _jnp().sum(nll)
+
+
+def _make_softmax_output():
+    import jax
+
+    @jax.custom_vjp
+    def softmax_output(data, label, grad_scale, ignore_label, use_ignore,
+                       multi_output, normalization_id, smooth_alpha):
+        return jax.nn.softmax(data, axis=-1 if data.ndim == 2 else 1)
+
+    def fwd(data, label, grad_scale, ignore_label, use_ignore, multi_output,
+            normalization_id, smooth_alpha):
+        out = softmax_output(data, label, grad_scale, ignore_label,
+                             use_ignore, multi_output, normalization_id,
+                             smooth_alpha)
+        return out, (out, label, grad_scale, ignore_label, use_ignore,
+                     normalization_id, smooth_alpha)
+
+    def bwd(res, g):
+        jnp = _jnp()
+        out, label, grad_scale, ignore_label, use_ignore, norm_id, smooth = res
+        axis = -1 if out.ndim == 2 else 1
+        nclass = out.shape[axis]
+        lbl = label.astype(_np.int32)
+        onehot = jax.nn.one_hot(lbl, nclass, axis=axis, dtype=out.dtype)
+        if smooth > 0:
+            onehot = onehot * (1 - smooth) + smooth / (nclass - 1) * (1 - onehot)
+        grad = out - onehot
+        if use_ignore:
+            mask = (lbl != int(ignore_label)).astype(out.dtype)
+            grad = grad * jnp.expand_dims(mask, axis)
+        n = out.shape[0]
+        if norm_id == 2:  # valid
+            denom = jnp.maximum(jnp.sum(lbl != int(ignore_label)), 1) \
+                if use_ignore else n
+            grad = grad / denom
+        elif norm_id == 1:  # batch
+            grad = grad / n
+        grad = grad * grad_scale
+        return (grad, None, None, None, None, None, None, None)
+
+    softmax_output.defvjp(fwd, bwd)
+    return softmax_output
+
+
+_SOFTMAX_OUTPUT = None
+_NORM_IDS = {"null": 0, "batch": 1, "valid": 2}
+
+
+@register("SoftmaxOutput", aliases=("Softmax",))
+def _softmax_output_op(data, label, grad_scale=1.0, ignore_label=-1.0,
+                       multi_output=False, use_ignore=False,
+                       preserve_shape=False, normalization="null",
+                       out_grad=False, smooth_alpha=0.0):
+    """Softmax forward whose *defined* backward is (p - onehot(label)) —
+    the reference's fused softmax+CE gradient (ref:
+    src/operator/softmax_output-inl.h)."""
+    global _SOFTMAX_OUTPUT
+    if _SOFTMAX_OUTPUT is None:
+        _SOFTMAX_OUTPUT = _make_softmax_output()
+    return _SOFTMAX_OUTPUT(data, label, grad_scale, ignore_label,
+                           bool(use_ignore), bool(multi_output),
+                           _NORM_IDS.get(normalization, 0), smooth_alpha)
+
+
+@register("LinearRegressionOutput")
+def _linear_regression_output(data, label, grad_scale=1.0):
+    import jax
+
+    @jax.custom_vjp
+    def f(d, l):
+        return d
+
+    def fwd(d, l):
+        return d, (d, l)
+
+    def bwd(res, g):
+        d, l = res
+        return ((d - l.reshape(d.shape)) * grad_scale, None)
+
+    f.defvjp(fwd, bwd)
+    return f(data, label)
+
+
+@register("MAERegressionOutput")
+def _mae_regression_output(data, label, grad_scale=1.0):
+    import jax
+
+    @jax.custom_vjp
+    def f(d, l):
+        return d
+
+    def fwd(d, l):
+        return d, (d, l)
+
+    def bwd(res, g):
+        d, l = res
+        return (_jnp().sign(d - l.reshape(d.shape)) * grad_scale, None)
+
+    f.defvjp(fwd, bwd)
+    return f(data, label)
+
+
+@register("LogisticRegressionOutput")
+def _logistic_regression_output(data, label, grad_scale=1.0):
+    import jax
+
+    @jax.custom_vjp
+    def f(d, l):
+        return 1.0 / (1.0 + _jnp().exp(-d))
+
+    def fwd(d, l):
+        return f(d, l), (f(d, l), l)
+
+    def bwd(res, g):
+        p, l = res
+        return ((p - l.reshape(p.shape)) * grad_scale, None)
+
+    f.defvjp(fwd, bwd)
+    return f(data, label)
+
+
+# ---------------------------------------------------------------------------
+# Dropout (ref: src/operator/nn/dropout.cc) — explicit-key functional RNG
+# ---------------------------------------------------------------------------
+
+@register("Dropout", rng=True)
+def _dropout(data, _key, p=0.5, mode="training", axes=(), cudnn_off=False,
+             _training=False):
+    if (not _training and mode != "always") or p <= 0:
+        return data
+    import jax
+    # `axes` = variational dropout: mask is broadcast along the listed axes
+    if axes:
+        shape = [1 if i in tuple(axes) else data.shape[i]
+                 for i in range(data.ndim)]
+    else:
+        shape = list(data.shape)
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(_key, keep, tuple(shape)).astype(data.dtype)
+    return data * mask / keep
+
+
+# ---------------------------------------------------------------------------
+# Embedding & sequence ops
+# ---------------------------------------------------------------------------
+
+@register("Embedding")
+def _embedding(data, weight, input_dim=0, output_dim=0, dtype="float32",
+               sparse_grad=False):
+    idx = data.astype(_np.int32)
+    return weight[idx]
+
+
+@register("SequenceMask")
+def _sequence_mask(data, *maybe_len, use_sequence_length=False, value=0.0,
+                   axis=0):
+    jnp = _jnp()
+    if not use_sequence_length or not maybe_len:
+        return data
+    seq_len = maybe_len[0]
+    T = data.shape[axis]
+    pos = jnp.arange(T)
+    # axis is the time axis; batch is the other of {0,1}
+    if axis == 0:
+        mask = pos[:, None] < seq_len[None, :]
+        mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    else:
+        mask = pos[None, :] < seq_len[:, None]
+        mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, value)
+
+
+@register("SequenceLast")
+def _sequence_last(data, *maybe_len, use_sequence_length=False, axis=0):
+    jnp = _jnp()
+    if not use_sequence_length or not maybe_len:
+        return jnp.take(data, data.shape[axis] - 1, axis=axis)
+    seq_len = maybe_len[0].astype(_np.int32) - 1
+    if axis == 0:
+        batch = jnp.arange(data.shape[1])
+        return data[seq_len, batch]
+    batch = jnp.arange(data.shape[0])
+    return data[batch, seq_len]
+
+
+@register("SequenceReverse")
+def _sequence_reverse(data, *maybe_len, use_sequence_length=False, axis=0):
+    jnp = _jnp()
+    if not use_sequence_length or not maybe_len:
+        return jnp.flip(data, axis=0)
+    seq_len = maybe_len[0].astype(_np.int32)
+    T = data.shape[0]
+    pos = jnp.arange(T)[:, None]
+    rev = seq_len[None, :] - 1 - pos
+    idx = jnp.where(rev >= 0, rev, pos)
+    batch = jnp.arange(data.shape[1])[None, :]
+    return data[idx, batch]
+
+
+# ---------------------------------------------------------------------------
+# UpSampling / resize (ref: upsampling.cc; bilinear via jax.image)
+# ---------------------------------------------------------------------------
+
+@register("UpSampling", variadic=True)
+def _upsampling(*inputs, scale=1, sample_type="nearest", num_args=1,
+                num_filter=0, multi_input_mode="concat", workspace=512):
+    jnp = _jnp()
+    import jax
+    data = inputs[0]
+    n, c, h, w = data.shape
+    if sample_type == "nearest":
+        out = jnp.repeat(jnp.repeat(data, scale, axis=2), scale, axis=3)
+    else:
+        out = jax.image.resize(data, (n, c, h * scale, w * scale), "bilinear")
+    return out
+
+
+@register("GridGenerator")
+def _grid_generator(data, transform_type="affine", target_shape=(0, 0)):
+    jnp = _jnp()
+    if transform_type != "affine":
+        raise MXNetError("only affine GridGenerator supported")
+    h, w = target_shape
+    ys = jnp.linspace(-1, 1, h)
+    xs = jnp.linspace(-1, 1, w)
+    gx, gy = jnp.meshgrid(xs, ys)
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx.ravel(), gy.ravel(), ones.ravel()], axis=0)
+    theta = data.reshape((-1, 2, 3))
+    out = jnp.matmul(theta, base)
+    return out.reshape((-1, 2, h, w))

@@ -1,0 +1,218 @@
+"""Fused optimizer-update ops.
+
+Reference: src/operator/optimizer_op.cc (sgd_update :318, sgd_mom_update
+:351, adam_update :506, multi_sgd :654 etc.) — device-side fused updates so
+the frontend never materializes intermediate tensors.
+
+TPU-native: each update is a small pure function; XLA fuses the whole
+expression into one kernel. The reference mutates weight/state in place;
+here the op *returns* (weight', state'...) and the Optimizer frontend rebinds
+the NDArray handles (versioned-var discipline). Multi-tensor variants take
+interleaved inputs and return all updated tensors so one jit call covers the
+whole parameter group.
+"""
+from __future__ import annotations
+
+from .registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _prep_grad(grad, rescale_grad, clip_gradient, wd, weight):
+    jnp = _jnp()
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g + wd * weight
+
+
+@register("sgd_update")
+def _sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                clip_gradient=-1.0, lazy_update=True):
+    g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
+    return weight - lr * g
+
+
+@register("sgd_mom_update", num_outputs=2)
+def _sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
+    new_mom = momentum * mom - lr * g
+    return weight + new_mom, new_mom
+
+
+@register("mp_sgd_update", num_outputs=2)
+def _mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0, lazy_update=True):
+    g = _prep_grad(grad.astype(weight32.dtype), rescale_grad, clip_gradient,
+                   wd, weight32)
+    w32 = weight32 - lr * g
+    return w32.astype(weight.dtype), w32
+
+
+@register("mp_sgd_mom_update", num_outputs=3)
+def _mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
+                       wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                       lazy_update=True):
+    g = _prep_grad(grad.astype(weight32.dtype), rescale_grad, clip_gradient,
+                   wd, weight32)
+    new_mom = momentum * mom - lr * g
+    w32 = weight32 + new_mom
+    return w32.astype(weight.dtype), new_mom, w32
+
+
+@register("nag_mom_update", num_outputs=2)
+def _nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
+    new_mom = momentum * mom + g
+    return weight - lr * (g + momentum * new_mom), new_mom
+
+
+@register("adam_update", num_outputs=3)
+def _adam_update(weight, grad, mean, var, lr=0.01, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                 lazy_update=True):
+    jnp = _jnp()
+    g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * jnp.square(g)
+    w = weight - lr * m / (jnp.sqrt(v) + epsilon)
+    return w, m, v
+
+
+@register("signsgd_update")
+def _signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                    clip_gradient=-1.0):
+    jnp = _jnp()
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return weight - lr * (jnp.sign(g) + wd * weight)
+
+
+@register("signum_update", num_outputs=2)
+def _signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    jnp = _jnp()
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_mom = momentum * mom - (1 - momentum) * g
+    w = (1 - lr * wd_lh) * weight + lr * jnp.sign(new_mom) \
+        if wd_lh > 0 else weight + lr * jnp.sign(new_mom)
+    return w - lr * wd * weight, new_mom
+
+
+@register("rmsprop_update", num_outputs=2)
+def _rmsprop_update(weight, grad, n, lr=0.01, gamma1=0.95, epsilon=1e-8,
+                    wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                    clip_weights=-1.0):
+    jnp = _jnp()
+    g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
+    new_n = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    w = weight - lr * g / jnp.sqrt(new_n + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, new_n
+
+
+@register("rmspropalex_update", num_outputs=4)
+def _rmspropalex_update(weight, grad, n, g_avg, delta, lr=0.01, gamma1=0.95,
+                        gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                        clip_gradient=-1.0, clip_weights=-1.0):
+    jnp = _jnp()
+    g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
+    new_n = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    new_gavg = gamma1 * g_avg + (1 - gamma1) * g
+    new_delta = gamma2 * delta - lr * g / jnp.sqrt(
+        new_n - jnp.square(new_gavg) + epsilon)
+    return weight + new_delta, new_n, new_gavg, new_delta
+
+
+@register("ftrl_update", num_outputs=3)
+def _ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
+                 rescale_grad=1.0, clip_gradient=-1.0):
+    jnp = _jnp()
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_n = n + jnp.square(g)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    new_z = z + g - sigma * weight
+    w = jnp.where(jnp.abs(new_z) > lamda1,
+                  -(new_z - jnp.sign(new_z) * lamda1) /
+                  ((beta + jnp.sqrt(new_n)) / lr + wd),
+                  0.0)
+    return w, new_z, new_n
+
+
+@register("ftml_update", num_outputs=3)
+def _ftml_update(weight, grad, d, v, z, lr=0.01, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, t=1, wd=0.0, rescale_grad=1.0,
+                 clip_grad=-1.0):
+    jnp = _jnp()
+    g = grad * rescale_grad + wd * weight
+    if clip_grad is not None and clip_grad > 0:
+        g = jnp.clip(g, -clip_grad, clip_grad)
+    new_v = beta2 * v + (1 - beta2) * jnp.square(g)
+    d_t = (1 - beta1 ** t) / lr * (jnp.sqrt(new_v / (1 - beta2 ** t)) + epsilon)
+    sigma = d_t - beta1 * d
+    new_z = beta1 * z + (1 - beta1) * g - sigma * weight
+    return -new_z / d_t, d_t, new_v
+
+
+@register("_adamw_update", aliases=("adamw_update",), num_outputs=3)
+def _adamw_update(weight, grad, mean, var, rescale_grad_t, lr=0.01, beta1=0.9,
+                  beta2=0.999, epsilon=1e-8, wd=0.0, eta=1.0,
+                  clip_gradient=-1.0):
+    jnp = _jnp()
+    g = grad * rescale_grad_t
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * jnp.square(g)
+    w = weight - eta * (lr * m / (jnp.sqrt(v) + epsilon) + wd * weight)
+    return w, m, v
+
+
+def _multi_sgd_nout(n_inputs, params):
+    return int(params.get("num_weights", n_inputs // 2))
+
+
+@register("multi_sgd_update", num_outputs=_multi_sgd_nout, variadic=True)
+def _multi_sgd_update(*tensors, lrs=(), wds=(), rescale_grad=1.0,
+                      clip_gradient=-1.0, num_weights=1):
+    """Fused update of a whole parameter group in one XLA program
+    (ref: optimizer_op.cc:654 multi_sgd_update)."""
+    outs = []
+    for i in range(num_weights):
+        w, g = tensors[2 * i], tensors[2 * i + 1]
+        outs.append(_sgd_update(w, g, lr=lrs[i], wd=wds[i],
+                                rescale_grad=rescale_grad,
+                                clip_gradient=clip_gradient))
+    return tuple(outs)
+
+
+def _multi_sgd_mom_nout(n_inputs, params):
+    return 2 * int(params.get("num_weights", n_inputs // 3))
+
+
+@register("multi_sgd_mom_update", num_outputs=_multi_sgd_mom_nout,
+          variadic=True)
+def _multi_sgd_mom_update(*tensors, lrs=(), wds=(), momentum=0.0,
+                          rescale_grad=1.0, clip_gradient=-1.0,
+                          num_weights=1):
+    outs = []
+    moms = []
+    for i in range(num_weights):
+        w, g, m = tensors[3 * i], tensors[3 * i + 1], tensors[3 * i + 2]
+        nw, nm = _sgd_mom_update(w, g, m, lr=lrs[i], momentum=momentum,
+                                 wd=wds[i], rescale_grad=rescale_grad,
+                                 clip_gradient=clip_gradient)
+        outs.append(nw)
+        moms.append(nm)
+    return tuple(outs) + tuple(moms)

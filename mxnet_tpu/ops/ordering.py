@@ -1,0 +1,78 @@
+"""Ordering ops: sort / argsort / topk.
+
+Reference: src/operator/tensor/ordering_op.cc (cub/thrust sorts on GPU);
+XLA's sort lowering replaces all of that machinery on TPU.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from .registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+@register("sort")
+def _sort(data, axis=-1, is_ascend=True):
+    jnp = _jnp()
+    if axis is None:
+        out = jnp.sort(data.ravel())
+        return out if is_ascend else out[::-1]
+    out = jnp.sort(data, axis=axis)
+    return out if is_ascend else jnp.flip(out, axis=axis)
+
+
+@register("argsort", differentiable=False)
+def _argsort(data, axis=-1, is_ascend=True, dtype="float32"):
+    jnp = _jnp()
+    if axis is None:
+        idx = jnp.argsort(data.ravel())
+        idx = idx if is_ascend else idx[::-1]
+    else:
+        idx = jnp.argsort(data, axis=axis)
+        idx = idx if is_ascend else jnp.flip(idx, axis=axis)
+    return idx.astype(_np.dtype(dtype))
+
+
+def _topk_nout(n_inputs, params):
+    rt = params.get("ret_typ", "indices")
+    return 2 if rt == "both" else 1
+
+
+@register("topk", num_outputs=_topk_nout, differentiable=False)
+def _topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False,
+          dtype="float32"):
+    import jax
+    jnp = _jnp()
+    if axis is None:
+        flat = data.ravel()
+        axis_ = 0
+        data_ = flat
+    else:
+        axis_ = axis % data.ndim
+        data_ = jnp.moveaxis(data, axis_, -1)
+    vals_in = -data_ if is_ascend else data_
+    vals, idx = jax.lax.top_k(vals_in, k)
+    vals = -vals if is_ascend else vals
+    if axis is not None:
+        vals = jnp.moveaxis(vals, -1, axis_)
+        idx = jnp.moveaxis(idx, -1, axis_)
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "indices":
+        return idx.astype(_np.dtype(dtype))
+    if ret_typ == "mask":
+        oh = jnp.zeros(data_.shape, dtype=data.dtype)
+        oh = oh.at[..., 0].set(0)  # shape anchor
+        onehot = jnp.sum(jax.nn.one_hot(idx, data_.shape[-1],
+                                        dtype=data.dtype), axis=-2)
+        if axis is not None:
+            onehot = jnp.moveaxis(onehot, -1, axis_)
+        return onehot
+    if ret_typ == "both":
+        return vals, idx.astype(_np.dtype(dtype))
+    raise MXNetError(f"unknown ret_typ {ret_typ}")

@@ -1,0 +1,187 @@
+"""Random samplers: functional-key redesign of the reference PRNG resource.
+
+Reference: src/operator/random/sample_op.cc (+ multisample_op.cc,
+unique_sample_op.cc) built on per-device PRNG states handed out by the
+resource manager (include/mxnet/resource.h:38-46 kRandom/kParallelRandom).
+
+TPU-native: every sampler is a pure function of an explicit PRNG key
+(rng=True ops get a fresh split of the global ``mx.random`` state appended as
+their last input). Reproducible under jit/pjit by construction — the
+reference needed per-worker seeds; here a seed fixes the whole program.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .registry import register
+
+
+def _jr():
+    import jax.random as jr
+    return jr
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _dt(dtype, default="float32"):
+    import jax.numpy as jnp
+    if dtype is None or dtype == "None":
+        dtype = default
+    return jnp.bfloat16 if dtype == "bfloat16" else _np.dtype(dtype)
+
+
+# --- creation-style samplers (no array inputs) -----------------------------
+
+@register("_random_uniform", aliases=("uniform", "random_uniform"),
+          creation=True, rng=True, differentiable=False)
+def _random_uniform(_key, low=0.0, high=1.0, shape=(1,), dtype=None, **_):
+    return _jr().uniform(_key, tuple(shape), _dt(dtype), low, high)
+
+
+@register("_random_normal", aliases=("normal", "random_normal"),
+          creation=True, rng=True, differentiable=False)
+def _random_normal(_key, loc=0.0, scale=1.0, shape=(1,), dtype=None, **_):
+    return _jr().normal(_key, tuple(shape), _dt(dtype)) * scale + loc
+
+
+@register("_random_gamma", aliases=("gamma_sample", "random_gamma"),
+          creation=True, rng=True, differentiable=False)
+def _random_gamma(_key, alpha=1.0, beta=1.0, shape=(1,), dtype=None, **_):
+    return _jr().gamma(_key, alpha, tuple(shape), _dt(dtype)) * beta
+
+
+@register("_random_exponential", aliases=("random_exponential",),
+          creation=True, rng=True, differentiable=False)
+def _random_exponential(_key, lam=1.0, shape=(1,), dtype=None, **_):
+    return _jr().exponential(_key, tuple(shape), _dt(dtype)) / lam
+
+
+@register("_random_poisson", aliases=("random_poisson",),
+          creation=True, rng=True, differentiable=False)
+def _random_poisson(_key, lam=1.0, shape=(1,), dtype=None, **_):
+    return _jr().poisson(_key, lam, tuple(shape)).astype(_dt(dtype))
+
+
+@register("_random_negative_binomial", aliases=("random_negative_binomial",),
+          creation=True, rng=True, differentiable=False)
+def _random_negative_binomial(_key, k=1, p=1.0, shape=(1,), dtype=None, **_):
+    jr = _jr()
+    key1, key2 = jr.split(_key)
+    lam = jr.gamma(key1, float(k), tuple(shape)) * (1 - p) / p
+    return jr.poisson(key2, lam, tuple(shape)).astype(_dt(dtype))
+
+
+@register("_random_generalized_negative_binomial",
+          aliases=("random_generalized_negative_binomial",),
+          creation=True, rng=True, differentiable=False)
+def _random_gen_neg_binomial(_key, mu=1.0, alpha=1.0, shape=(1,), dtype=None, **_):
+    jr = _jr()
+    key1, key2 = jr.split(_key)
+    r = 1.0 / alpha
+    lam = jr.gamma(key1, r, tuple(shape)) * (mu * alpha)
+    return jr.poisson(key2, lam, tuple(shape)).astype(_dt(dtype))
+
+
+@register("_random_randint", aliases=("random_randint",),
+          creation=True, rng=True, differentiable=False)
+def _random_randint(_key, low=0, high=1, shape=(1,), dtype="int32", **_):
+    return _jr().randint(_key, tuple(shape), int(low), int(high),
+                         _np.dtype(dtype if dtype != "None" else "int32"))
+
+
+# --- samplers parameterized by input arrays (ref sample_op.cc _sample_*) ---
+
+@register("_sample_uniform", aliases=("sample_uniform",), rng=True,
+          differentiable=False)
+def _sample_uniform(low, high, _key, shape=(), dtype=None, **_):
+    jr = _jr()
+    s = tuple(shape) if shape else ()
+    out_shape = low.shape + s
+    u = jr.uniform(_key, out_shape, _dt(dtype))
+    b = low.reshape(low.shape + (1,) * len(s)).astype(u.dtype)
+    t = high.reshape(high.shape + (1,) * len(s)).astype(u.dtype)
+    return b + u * (t - b)
+
+
+@register("_sample_normal", aliases=("sample_normal",), rng=True,
+          differentiable=False)
+def _sample_normal(mu, sigma, _key, shape=(), dtype=None, **_):
+    jr = _jr()
+    s = tuple(shape) if shape else ()
+    z = jr.normal(_key, mu.shape + s, _dt(dtype))
+    m = mu.reshape(mu.shape + (1,) * len(s)).astype(z.dtype)
+    sd = sigma.reshape(sigma.shape + (1,) * len(s)).astype(z.dtype)
+    return m + z * sd
+
+
+@register("_sample_gamma", aliases=("sample_gamma",), rng=True,
+          differentiable=False)
+def _sample_gamma(alpha, beta, _key, shape=(), dtype=None, **_):
+    jr = _jr()
+    s = tuple(shape) if shape else ()
+    a = alpha.reshape(alpha.shape + (1,) * len(s))
+    b = beta.reshape(beta.shape + (1,) * len(s))
+    g = jr.gamma(_key, a, a.shape[:len(alpha.shape)] + s) \
+        if s else jr.gamma(_key, a, a.shape)
+    return (g * b).astype(_dt(dtype))
+
+
+@register("_sample_exponential", aliases=("sample_exponential",), rng=True,
+          differentiable=False)
+def _sample_exponential(lam, _key, shape=(), dtype=None, **_):
+    jr = _jr()
+    s = tuple(shape) if shape else ()
+    e = jr.exponential(_key, lam.shape + s, _dt(dtype))
+    return e / lam.reshape(lam.shape + (1,) * len(s)).astype(e.dtype)
+
+
+@register("_sample_poisson", aliases=("sample_poisson",), rng=True,
+          differentiable=False)
+def _sample_poisson(lam, _key, shape=(), dtype=None, **_):
+    s = tuple(shape) if shape else ()
+    lam_b = lam.reshape(lam.shape + (1,) * len(s))
+    out = _jr().poisson(_key, lam_b, lam.shape + s if s else lam.shape)
+    return out.astype(_dt(dtype))
+
+
+@register("_sample_multinomial", aliases=("sample_multinomial",), rng=True,
+          differentiable=False)
+def _sample_multinomial(data, _key, shape=(), get_prob=False, dtype="int32"):
+    jr, jnp = _jr(), _jnp()
+    s = shape if isinstance(shape, tuple) else ((shape,) if shape else ())
+    n = int(_np.prod(s)) if s else 1
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    if data.ndim == 1:
+        out = jr.categorical(_key, logits, shape=(n,))
+        out = out.reshape(s) if s else out.reshape(())
+    else:
+        out = jr.categorical(_key, logits[:, None, :], axis=-1,
+                             shape=(data.shape[0], n))
+        out = out.reshape((data.shape[0],) + s) if s else out.reshape((data.shape[0],))
+    out = out.astype(_np.dtype(dtype))
+    if get_prob:
+        lp = jnp.take_along_axis(
+            jnp.log(jnp.maximum(data, 1e-37)),
+            out.reshape(data.shape[0], -1).astype(_np.int32), axis=-1
+        ).reshape(out.shape) if data.ndim > 1 else \
+            jnp.log(jnp.maximum(data, 1e-37))[out.astype(_np.int32)]
+        return out, lp
+    return out
+
+
+@register("_shuffle", aliases=("shuffle",), rng=True, differentiable=False)
+def _shuffle(data, _key, **_):
+    return _jr().permutation(_key, data, axis=0)
+
+
+@register("sample_unique_zipfian", creation=True, rng=True,
+          differentiable=False)
+def _sample_unique_zipfian(_key, range_max=1, shape=(1,), **_):
+    # log-uniform (Zipfian) candidate sampler (ref: unique_sample_op.cc)
+    jr, jnp = _jr(), _jnp()
+    u = jr.uniform(_key, tuple(shape))
+    out = jnp.exp(u * _np.log(range_max)).astype(_np.int64) - 1
+    return jnp.clip(out, 0, range_max - 1)

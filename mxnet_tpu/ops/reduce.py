@@ -1,0 +1,76 @@
+"""Reduction operators with reference axis semantics (axis/keepdims/exclude).
+
+Reference: src/operator/tensor/broadcast_reduce_op_value.cc (+ the kernel
+machinery in broadcast_reduce-inl.h, which XLA's reduce lowering replaces
+wholesale on TPU).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _axes(x, axis, exclude: bool):
+    if axis is None or axis == ():
+        ax = tuple(range(x.ndim))
+    elif isinstance(axis, int):
+        ax = (axis % x.ndim,)
+    else:
+        ax = tuple(a % x.ndim for a in axis)
+    if exclude:
+        ax = tuple(i for i in range(x.ndim) if i not in ax)
+    return ax
+
+
+def _reduce(jfn):
+    def impl(x, axis=None, keepdims: bool = False, exclude: bool = False, **_):
+        return jfn(x, axis=_axes(x, axis, exclude), keepdims=keepdims)
+    return impl
+
+
+register("sum", aliases=("sum_axis",))(_reduce(lambda x, **k: _jnp().sum(x, **k)))
+register("mean")(_reduce(lambda x, **k: _jnp().mean(x, **k)))
+register("prod")(_reduce(lambda x, **k: _jnp().prod(x, **k)))
+register("nansum")(_reduce(lambda x, **k: _jnp().nansum(x, **k)))
+register("nanprod")(_reduce(lambda x, **k: _jnp().nanprod(x, **k)))
+register("max", aliases=("max_axis",))(_reduce(lambda x, **k: _jnp().max(x, **k)))
+register("min", aliases=("min_axis",))(_reduce(lambda x, **k: _jnp().min(x, **k)))
+
+
+@register("norm")
+def _norm(x, ord: int = 2, axis=None, keepdims: bool = False, **_):
+    jnp = _jnp()
+    if axis is None:
+        ax = tuple(range(x.ndim))
+    elif isinstance(axis, int):
+        ax = (axis,)
+    else:
+        ax = tuple(axis)
+    if ord == 1:
+        return jnp.sum(jnp.abs(x), axis=ax, keepdims=keepdims)
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=ax, keepdims=keepdims))
+
+
+@register("argmax", differentiable=False)
+def _argmax(x, axis=None, keepdims: bool = False, **_):
+    jnp = _jnp()
+    out = jnp.argmax(x, axis=axis, keepdims=keepdims)
+    return out.astype(jnp.float32)
+
+
+@register("argmin", differentiable=False)
+def _argmin(x, axis=None, keepdims: bool = False, **_):
+    jnp = _jnp()
+    return jnp.argmin(x, axis=axis, keepdims=keepdims).astype(jnp.float32)
+
+
+@register("argmax_channel", differentiable=False)
+def _argmax_channel(x, **_):
+    jnp = _jnp()
+    return jnp.argmax(x, axis=1).astype(jnp.float32)

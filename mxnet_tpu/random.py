@@ -1,0 +1,109 @@
+"""Global functional PRNG state.
+
+Reference: python/mxnet/random.py (mx.random.seed) over per-device PRNG
+resources (include/mxnet/resource.h kRandom). TPU-native: one root
+``jax.random`` key per process; every random op consumes a fresh split.
+``seed(n)`` makes the whole program reproducible (the reference needed
+per-device seeding; XLA's threefry is deterministic per key regardless of
+partitioning).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+__all__ = ["seed", "next_key", "uniform", "normal", "randint", "gamma",
+           "exponential", "poisson", "negative_binomial",
+           "generalized_negative_binomial", "multinomial", "shuffle"]
+
+_lock = threading.Lock()
+_key = None
+_seed_value = 0
+
+
+def _jr():
+    import jax.random as jr
+    return jr
+
+
+def seed(seed_state: int, ctx=None) -> None:
+    """Reset the root key (ref: python/mxnet/random.py seed)."""
+    global _key, _seed_value
+    with _lock:
+        _seed_value = int(seed_state)
+        _key = _jr().PRNGKey(_seed_value)
+
+
+def next_key():
+    """Split off a fresh subkey for one sampling op."""
+    global _key
+    with _lock:
+        if _key is None:
+            _key = _jr().PRNGKey(0)
+        _key, sub = _jr().split(_key)
+        return sub
+
+
+def _nd():
+    from .ndarray import register as ndreg
+    return ndreg.registry_namespace()
+
+
+def uniform(low=0, high=1, shape=(1,), dtype=None, ctx=None, out=None):
+    from .ndarray import op as _op
+    return _op._random_uniform(low=low, high=high, shape=shape, dtype=dtype,
+                               ctx=ctx, out=out)
+
+
+def normal(loc=0, scale=1, shape=(1,), dtype=None, ctx=None, out=None):
+    from .ndarray import op as _op
+    return _op._random_normal(loc=loc, scale=scale, shape=shape, dtype=dtype,
+                              ctx=ctx, out=out)
+
+
+def randint(low, high, shape=(1,), dtype="int32", ctx=None, out=None):
+    from .ndarray import op as _op
+    return _op._random_randint(low=low, high=high, shape=shape, dtype=dtype,
+                               ctx=ctx, out=out)
+
+
+def gamma(alpha=1, beta=1, shape=(1,), dtype=None, ctx=None, out=None):
+    from .ndarray import op as _op
+    return _op._random_gamma(alpha=alpha, beta=beta, shape=shape, dtype=dtype,
+                             ctx=ctx, out=out)
+
+
+def exponential(scale=1, shape=(1,), dtype=None, ctx=None, out=None):
+    from .ndarray import op as _op
+    return _op._random_exponential(lam=1.0 / scale, shape=shape, dtype=dtype,
+                                   ctx=ctx, out=out)
+
+
+def poisson(lam=1, shape=(1,), dtype=None, ctx=None, out=None):
+    from .ndarray import op as _op
+    return _op._random_poisson(lam=lam, shape=shape, dtype=dtype, ctx=ctx,
+                               out=out)
+
+
+def negative_binomial(k=1, p=1, shape=(1,), dtype=None, ctx=None, out=None):
+    from .ndarray import op as _op
+    return _op._random_negative_binomial(k=k, p=p, shape=shape, dtype=dtype,
+                                         ctx=ctx, out=out)
+
+
+def generalized_negative_binomial(mu=1, alpha=1, shape=(1,), dtype=None,
+                                  ctx=None, out=None):
+    from .ndarray import op as _op
+    return _op._random_generalized_negative_binomial(
+        mu=mu, alpha=alpha, shape=shape, dtype=dtype, ctx=ctx, out=out)
+
+
+def multinomial(data, shape=(), get_prob=False, out=None, dtype="int32"):
+    from .ndarray import op as _op
+    return _op._sample_multinomial(data, shape=shape, get_prob=get_prob,
+                                   dtype=dtype, out=out)
+
+
+def shuffle(data, out=None):
+    from .ndarray import op as _op
+    return _op._shuffle(data, out=out)

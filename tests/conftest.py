@@ -1,0 +1,25 @@
+"""Test harness: force an 8-device virtual CPU mesh.
+
+Mirrors the reference's test strategy of running the same suite against
+different backends by switching the default context
+(ref: tests/python/gpu/test_operator_gpu.py imports the CPU suite).
+Multi-device tests use the 8 virtual CPU devices as the stand-in TPU mesh.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The axon sitecustomize force-selects the TPU-tunnel platform via jax config
+# (overriding JAX_PLATFORMS); push it back to CPU before any backend spins up.
+try:
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,186 @@
+"""Autograd tape tests (model: tests/python/unittest/test_autograd.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+def test_simple_backward():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [2, 4, 6])
+
+
+def test_chain_rule():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x  # y = x^3, dy/dx = 3x^2
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [12.0])
+
+
+def test_multiple_variables():
+    a = nd.array([1.0, 2.0])
+    b = nd.array([3.0, 4.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = (a * b).sum()
+    c.backward()
+    assert np.allclose(a.grad.asnumpy(), [3, 4])
+    assert np.allclose(b.grad.asnumpy(), [1, 2])
+
+
+def test_head_gradient():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+    y.backward(nd.array([10.0, 100.0]))
+    assert np.allclose(x.grad.asnumpy(), [20, 200])
+
+
+def test_grad_req_add():
+    x = nd.array([1.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = x * 2
+        y.backward()
+    assert np.allclose(x.grad.asnumpy(), [6.0])
+
+
+def test_grad_req_write_overwrites():
+    x = nd.array([1.0])
+    x.attach_grad()
+    for _ in range(3):
+        with autograd.record():
+            y = x * 2
+        y.backward()
+    assert np.allclose(x.grad.asnumpy(), [2.0])
+
+
+def test_detach_blocks_gradient():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    assert np.allclose(x.grad.asnumpy(), [9.0])  # only d(y_const * x)/dx
+
+
+def test_stop_gradient_op():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.op.BlockGrad(x * x) * x
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [9.0])
+
+
+def test_is_recording_is_training():
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+    assert not autograd.is_recording()
+    with autograd.pause():
+        assert not autograd.is_recording()
+
+
+def test_grad_function():
+    x = nd.array([2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 2).sum()
+    g = autograd.grad([y], [x])
+    assert np.allclose(g[0].asnumpy(), [4, 6])
+
+
+def test_mutation_does_not_corrupt_tape():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+        # mutate x after recording: tape must keep the old value
+    x += 100
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [2, 4])
+
+
+def test_retain_graph():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    y.backward(retain_graph=True)
+    g1 = x.grad.asnumpy().copy()
+    y.backward()
+    assert np.allclose(g1, [4.0])
+    with pytest.raises(Exception):
+        y.backward()  # graph freed now
+
+
+def test_softmax_output_gradient():
+    data = nd.array(np.random.RandomState(0).randn(4, 5).astype(np.float32))
+    label = nd.array([0.0, 1.0, 2.0, 3.0])
+    data.attach_grad()
+    with autograd.record():
+        out = nd.op.SoftmaxOutput(data, label)
+    out.backward()
+    p = out.asnumpy()
+    expected = p.copy()
+    expected[np.arange(4), [0, 1, 2, 3]] -= 1
+    assert np.allclose(data.grad.asnumpy(), expected, atol=1e-5)
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = nd.op.sigmoid(x)
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            y, = self.saved_tensors
+            return dy * y * (1 - y)
+
+    f = Sigmoid()
+    x = nd.array([0.0, 1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    s = 1 / (1 + np.exp(-np.array([0.0, 1.0])))
+    assert np.allclose(x.grad.asnumpy(), s * (1 - s), atol=1e-6)
+
+
+def test_dropout_modes():
+    x = nd.ones((1000,))
+    with autograd.record(train_mode=True):
+        y = nd.op.Dropout(x, p=0.5)
+    kept = (y.asnumpy() != 0).mean()
+    assert 0.3 < kept < 0.7
+    with autograd.record(train_mode=False):
+        y2 = nd.op.Dropout(x, p=0.5)
+    assert (y2.asnumpy() == 1).all()
+    y3 = nd.op.Dropout(x, p=0.5)  # no record, not training
+    assert (y3.asnumpy() == 1).all()
+
+
+def test_second_use_of_head():
+    x = nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+        z = y + y
+    z.backward()
+    assert np.allclose(x.grad.asnumpy(), [6.0])

@@ -1,0 +1,229 @@
+"""NDArray core tests (model: tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_creation_basic():
+    x = nd.zeros((2, 3))
+    assert x.shape == (2, 3)
+    assert x.dtype == np.float32
+    assert (x.asnumpy() == 0).all()
+    y = nd.ones((4,), dtype="int32")
+    assert y.dtype == np.int32
+    z = nd.full((2, 2), 7.5)
+    assert (z.asnumpy() == 7.5).all()
+    a = nd.array([[1, 2], [3, 4]])
+    assert a.shape == (2, 2)
+    assert a.asnumpy().tolist() == [[1, 2], [3, 4]]
+
+
+def test_arange_linspace():
+    assert nd.arange(5).asnumpy().tolist() == [0, 1, 2, 3, 4]
+    assert nd.arange(2, 10, 2).shape == (4,)
+    assert np.allclose(nd.linspace(0, 1, 5).asnumpy(), np.linspace(0, 1, 5))
+
+
+def test_arithmetic():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = nd.array([[10.0, 20.0], [30.0, 40.0]])
+    assert np.allclose((a + b).asnumpy(), [[11, 22], [33, 44]])
+    assert np.allclose((b - a).asnumpy(), [[9, 18], [27, 36]])
+    assert np.allclose((a * b).asnumpy(), [[10, 40], [90, 160]])
+    assert np.allclose((b / a).asnumpy(), [[10, 10], [10, 10]])
+    assert np.allclose((a + 1).asnumpy(), [[2, 3], [4, 5]])
+    assert np.allclose((1 + a).asnumpy(), [[2, 3], [4, 5]])
+    assert np.allclose((10 - a).asnumpy(), [[9, 8], [7, 6]])
+    assert np.allclose((a ** 2).asnumpy(), [[1, 4], [9, 16]])
+    assert np.allclose((-a).asnumpy(), [[-1, -2], [-3, -4]])
+    assert np.allclose((a @ b).asnumpy(), np.array([[1., 2], [3, 4]]) @ np.array([[10., 20], [30, 40]]))
+
+
+def test_inplace_and_versioning():
+    a = nd.ones((2, 2))
+    v0 = a.handle[1]
+    a += 1
+    assert a.handle[1] == v0 + 1
+    assert (a.asnumpy() == 2).all()
+    a *= 3
+    assert (a.asnumpy() == 6).all()
+
+
+def test_broadcasting():
+    a = nd.ones((2, 1, 3))
+    b = nd.ones((1, 4, 3))
+    assert (a + b).shape == (2, 4, 3)
+
+
+def test_comparison_ops():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([2.0, 2.0, 2.0])
+    assert (a > b).asnumpy().tolist() == [0, 0, 1]
+    assert (a == b).asnumpy().tolist() == [0, 1, 0]
+    assert (a <= 2).asnumpy().tolist() == [1, 1, 0]
+
+
+def test_indexing_get():
+    a = nd.array(np.arange(24).reshape(2, 3, 4))
+    assert a[0].shape == (3, 4)
+    assert a[1, 2].shape == (4,)
+    assert float(a[1, 2, 3].asscalar()) == 23
+    assert a[:, 1].shape == (2, 4)
+    assert a[0, ::2].shape == (2, 4)
+    idx = nd.array([0, 1], dtype="int32")
+    assert a[idx].shape == (2, 3, 4)
+
+
+def test_indexing_set():
+    a = nd.zeros((3, 3))
+    a[1] = 5
+    assert a.asnumpy()[1].tolist() == [5, 5, 5]
+    a[0, 2] = 1
+    assert a.asnumpy()[0, 2] == 1
+    a[:, 0] = nd.array([7.0, 8.0, 9.0])
+    assert a.asnumpy()[:, 0].tolist() == [7, 8, 9]
+
+
+def test_reshape_semantics():
+    a = nd.zeros((2, 3, 4))
+    assert a.reshape((6, 4)).shape == (6, 4)
+    assert a.reshape((-1,)).shape == (24,)
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert a.reshape((-2,)).shape == (2, 3, 4)
+    assert a.reshape((-3, 4)).shape == (6, 4)
+    assert a.reshape((-4, 1, 2, 3, 4)).shape == (1, 2, 3, 4)
+    assert a.reshape(2, 12).shape == (2, 12)
+
+
+def test_transpose_and_shape_ops():
+    a = nd.zeros((2, 3, 4))
+    assert a.T.shape == (4, 3, 2)
+    assert a.transpose((0, 2, 1)).shape == (2, 4, 3)
+    assert a.swapaxes(0, 2).shape == (4, 3, 2)
+    assert a.expand_dims(1).shape == (2, 1, 3, 4)
+    assert a.flatten().shape == (2, 12)
+    assert nd.zeros((2, 1, 3)).squeeze(1).shape == (2, 3)
+    assert a.tile((2, 1, 1)).shape == (4, 3, 4)
+
+
+def test_reductions():
+    a = nd.array(np.arange(6).reshape(2, 3).astype(np.float32))
+    assert float(a.sum().asscalar()) == 15
+    assert a.sum(axis=0).asnumpy().tolist() == [3, 5, 7]
+    assert a.sum(axis=1, keepdims=True).shape == (2, 1)
+    assert float(a.mean().asscalar()) == 2.5
+    assert float(a.max().asscalar()) == 5
+    assert float(a.min().asscalar()) == 0
+    # exclude semantics
+    r = nd.op.sum(a, axis=0, exclude=True)
+    assert r.asnumpy().tolist() == [3, 12]
+    assert float(a.norm().asscalar()) == pytest.approx(np.sqrt(55), rel=1e-5)
+    assert a.argmax(axis=1).asnumpy().tolist() == [2, 2]
+
+
+def test_concat_stack_split():
+    a, b = nd.ones((2, 3)), nd.zeros((2, 3))
+    c = nd.concatenate([a, b], axis=0)
+    assert c.shape == (4, 3)
+    s = nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+    parts = nd.op.split(nd.zeros((4, 6)), num_outputs=2, axis=1)
+    assert len(parts) == 2 and parts[0].shape == (4, 3)
+
+
+def test_unary_math():
+    a = nd.array([1.0, 4.0, 9.0])
+    assert np.allclose(a.sqrt().asnumpy(), [1, 2, 3])
+    assert np.allclose(a.square().asnumpy(), [1, 16, 81])
+    assert np.allclose(nd.op.exp(nd.zeros((2,))).asnumpy(), [1, 1])
+    assert np.allclose(nd.op.log(a).asnumpy(), np.log([1, 4, 9]), rtol=1e-5)
+    assert np.allclose(nd.op.relu(nd.array([-1.0, 2.0])).asnumpy(), [0, 2])
+    assert np.allclose(nd.op.sigmoid(nd.zeros((1,))).asnumpy(), [0.5])
+
+
+def test_dtype_cast():
+    a = nd.ones((2,), dtype="float32")
+    b = a.astype("int32")
+    assert b.dtype == np.int32
+    c = a.astype("float16")
+    assert c.dtype == np.float16
+
+
+def test_copyto_and_context():
+    a = nd.ones((2, 2))
+    b = a.copy()
+    b += 1
+    assert (a.asnumpy() == 1).all()
+    assert (b.asnumpy() == 2).all()
+    c = a.as_in_context(mx.cpu())
+    assert c.context.device_type == "cpu"
+
+
+def test_save_load(tmp_path):
+    f = str(tmp_path / "arrs")
+    d = {"w": nd.ones((2, 2)), "b": nd.zeros((3,))}
+    nd.save(f, d)
+    loaded = nd.load(f)
+    assert set(loaded) == {"w", "b"}
+    assert (loaded["w"].asnumpy() == 1).all()
+    lst = [nd.ones((1,)), nd.zeros((2,))]
+    nd.save(f, lst)
+    loaded = nd.load(f)
+    assert isinstance(loaded, list) and len(loaded) == 2
+
+
+def test_take_pick_onehot():
+    a = nd.array(np.arange(12).reshape(3, 4).astype(np.float32))
+    idx = nd.array([0, 2], dtype="int32")
+    assert nd.op.take(a, idx).shape == (2, 4)
+    p = nd.op.pick(a, nd.array([1.0, 0.0, 3.0]), axis=1)
+    assert p.asnumpy().tolist() == [1, 4, 11]
+    oh = nd.op.one_hot(nd.array([0, 2], dtype="int32"), depth=3)
+    assert oh.asnumpy().tolist() == [[1, 0, 0], [0, 0, 1]]
+
+
+def test_where_clip():
+    cond = nd.array([1.0, 0.0, 1.0])
+    x = nd.array([1.0, 2.0, 3.0])
+    y = nd.array([10.0, 20.0, 30.0])
+    assert nd.op.where(cond, x, y).asnumpy().tolist() == [1, 20, 3]
+    assert nd.op.clip(y, 15, 25).asnumpy().tolist() == [15, 20, 25]
+
+
+def test_sort_topk():
+    a = nd.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]])
+    assert nd.op.sort(a, axis=1).asnumpy()[0].tolist() == [1, 2, 3]
+    assert nd.op.sort(a, axis=1, is_ascend=False).asnumpy()[0].tolist() == [3, 2, 1]
+    topv = nd.op.topk(a, axis=1, k=2, ret_typ="value")
+    assert topv.asnumpy()[0].tolist() == [3, 2]
+    both = nd.op.topk(a, axis=1, k=1, ret_typ="both")
+    assert both[0].asnumpy()[1].tolist() == [5]
+
+
+def test_random_reproducible():
+    mx.random.seed(42)
+    a = mx.random.uniform(shape=(100,))
+    mx.random.seed(42)
+    b = mx.random.uniform(shape=(100,))
+    assert np.allclose(a.asnumpy(), b.asnumpy())
+    assert a.asnumpy().min() >= 0 and a.asnumpy().max() <= 1
+    n = mx.random.normal(loc=2.0, scale=0.1, shape=(2000,))
+    assert abs(float(n.asnumpy().mean()) - 2.0) < 0.05
+
+
+def test_out_kwarg():
+    a = nd.ones((2, 2))
+    out = nd.zeros((2, 2))
+    nd.op.broadcast_add(a, a, out=out)
+    assert (out.asnumpy() == 2).all()
+
+
+def test_waitall_and_sync():
+    a = nd.ones((64, 64))
+    for _ in range(5):
+        a = a * 1.000001
+    nd.waitall()
+    a.wait_to_read()
+    assert a.asnumpy().shape == (64, 64)
