@@ -1,0 +1,197 @@
+"""CachedOp: whole-graph compilation of hybridized blocks.
+
+Reference: src/imperative/cached_op.{h,cc} — a traced NNVM graph executed
+with static allocation/bulking, registered on the tape as ONE node with its
+own backward (cached_op.cc:889 Forward, :1112 Backward).
+
+TPU-native redesign (SURVEY.md §7 stage 7): "hybridize" == trace the block's
+imperative python once per (shapes, dtypes, train-mode) key and compile the
+WHOLE graph to a single XLA executable with ``jax.jit``. This subsumes the
+reference's static_alloc/static_shape/bulking machinery — XLA buffer
+assignment does the memory planning, and op fusion replaces engine bulking.
+
+Mutable layer state (BatchNorm moving stats) is captured functionally: the
+trace detects which Parameters were rebound during the traced call and turns
+them into extra outputs that are written back after execution — the
+flax-style state story replacing the reference's in-place aux-state mutation.
+
+Randomness: a fresh PRNG key is passed as a real input each invocation and
+installed as the trace key, so Dropout masks differ per call while the
+compiled program stays cached.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .base import MXNetError
+
+__all__ = ["CachedOp"]
+
+
+def _jax():
+    import jax
+    return jax
+
+
+class _CacheEntry:
+    __slots__ = ("jitted", "mutated_idx", "out_treedef", "vjp_jitted",
+                 "n_outputs")
+
+    def __init__(self):
+        self.jitted = None
+        self.mutated_idx: Tuple[int, ...] = ()
+        self.out_treedef = None
+        self.vjp_jitted = None
+        self.n_outputs = 0
+
+
+class _CachedOpGrad:
+    """Per-call backward closure recorded as a single tape node
+    (ref: CachedOp::Backward, src/imperative/cached_op.cc:1112)."""
+
+    def __init__(self, op: "CachedOp", entry: _CacheEntry, key,
+                 param_arrays, in_arrays, training: bool):
+        self.op = op
+        self.entry = entry
+        self.key = key
+        self.param_arrays = param_arrays
+        self.in_arrays = in_arrays
+        self.training = training
+
+    def _run_backward(self, cotangents):
+        import jax
+        entry = self.entry
+        if entry.vjp_jitted is None:
+            fn = self.op._make_pure_fn(self.training, entry)
+
+            def run(params, key, ins, cots):
+                def outputs_only(params_, *ins_):
+                    outs, _state = fn(params_, key, *ins_)
+                    return outs
+
+                _, vjp = jax.vjp(outputs_only, params, *ins)
+                return vjp(tuple(cots))
+
+            entry.vjp_jitted = jax.jit(run)
+        grads = entry.vjp_jitted(self.param_arrays, self.key,
+                                 tuple(self.in_arrays), tuple(cotangents))
+        param_grads = grads[0]
+        in_grads = grads[1:]
+        return list(param_grads) + list(in_grads)
+
+
+class CachedOp:
+    """Compile-and-replay executor for a HybridBlock.
+
+    ``__call__(args)`` returns output NDArrays; parameters and mutable state
+    are read from / written back to the block's Parameters.
+    """
+
+    def __init__(self, block, static_alloc: bool = False,
+                 static_shape: bool = False, inline_limit: int = 2,
+                 flags: Sequence = ()):
+        # static_alloc/static_shape are implied by XLA compilation; kept for
+        # API compat (ref: CachedOpConfig, cached_op.h:32-53).
+        self.block = block
+        self._cache: Dict[Tuple, _CacheEntry] = {}
+        self._param_objs: Optional[List] = None
+
+    # -----------------------------------------------------------------
+    def _params(self) -> List:
+        if self._param_objs is None:
+            self._param_objs = [p for _, p in
+                                sorted(self.block.collect_params().items())]
+        return self._param_objs
+
+    def _make_pure_fn(self, training: bool, entry: _CacheEntry):
+        """Build the pure (params, key, *inputs) -> (outputs, state) fn."""
+        from . import autograd, random as _random
+        from .ndarray.ndarray import NDArray, from_jax
+        import jax
+
+        block = self.block
+        params = self._params()
+
+        def fn(param_arrays, key, *input_arrays):
+            originals = []
+            for p, a in zip(params, param_arrays):
+                originals.append(p._data._data)
+                p._data._data = a
+            _random.push_trace_key(key)
+            prev_rec = autograd.set_recording(False)
+            prev_train = autograd.set_training(training)
+            try:
+                nd_args = [from_jax(a) for a in input_arrays]
+                args = jax.tree_util.tree_unflatten(self._in_treedef, nd_args)
+                out = block._imperative_call(*args)
+                flat_out, out_treedef = jax.tree_util.tree_flatten(
+                    out, is_leaf=lambda x: isinstance(x, NDArray))
+                out_arrays = tuple(o._data for o in flat_out)
+                mutated, state = [], []
+                for i, (p, orig) in enumerate(zip(params, param_arrays)):
+                    if p._data._data is not orig:
+                        mutated.append(i)
+                        state.append(p._data._data)
+                entry.mutated_idx = tuple(mutated)
+                entry.out_treedef = out_treedef
+                entry.n_outputs = len(out_arrays)
+                return out_arrays, tuple(state)
+            finally:
+                autograd.set_training(prev_train)
+                autograd.set_recording(prev_rec)
+                _random.pop_trace_key()
+                for p, orig in zip(params, originals):
+                    p._data._data = orig
+
+        return fn
+
+    # -----------------------------------------------------------------
+    def __call__(self, *args):
+        import jax
+        from . import autograd, random as _random
+        from .ndarray.ndarray import NDArray, from_jax
+
+        flat_in, in_treedef = jax.tree_util.tree_flatten(
+            args, is_leaf=lambda x: isinstance(x, NDArray))
+        self._in_treedef = in_treedef
+        in_arrays = [x._data for x in flat_in]
+
+        # nested trace (this CachedOp called inside another jit trace):
+        # execute imperatively and let the outer trace inline us.
+        if any(isinstance(a, jax.core.Tracer) for a in in_arrays):
+            return self.block._imperative_call(*args)
+
+        params = self._params()
+        for p in params:
+            if p._data is None:
+                raise MXNetError(f"parameter {p.name} not initialized")
+        param_arrays = tuple(p._data._data for p in params)
+        training = autograd.is_training()
+
+        key_sig = (tuple((tuple(a.shape), str(a.dtype)) for a in in_arrays),
+                   tuple((tuple(a.shape), str(a.dtype)) for a in param_arrays),
+                   in_treedef, training)
+        entry = self._cache.get(key_sig)
+        rng_key = _random.next_key()
+        if entry is None:
+            entry = _CacheEntry()
+            fn = self._make_pure_fn(training, entry)
+            entry.jitted = jax.jit(fn)
+            self._cache[key_sig] = entry
+        out_arrays, state = entry.jitted(param_arrays, rng_key, *in_arrays)
+
+        # write back mutable state (moving stats) — versioned-var rebind
+        for i, s in zip(entry.mutated_idx, state):
+            params[i]._data._rebind(s)
+
+        ctx = flat_in[0]._ctx if flat_in else params[0]._data._ctx
+        out_nds = [NDArray(a, ctx=ctx) for a in out_arrays]
+
+        if autograd.is_recording():
+            grad_fn = _CachedOpGrad(self, entry, rng_key, param_arrays,
+                                    in_arrays, training)
+            nd_inputs = [p._data for p in params] + list(flat_in)
+            autograd._record_custom(grad_fn, nd_inputs, tuple(out_nds))
+
+        result = jax.tree_util.tree_unflatten(entry.out_treedef, out_nds)
+        return result

@@ -1,0 +1,8 @@
+"""Gluon: the imperative, hybridizable NN API
+(ref: python/mxnet/gluon/__init__.py)."""
+from .parameter import Parameter, Constant, ParameterDict  # noqa: F401
+from .block import Block, HybridBlock, SymbolBlock, nn_block_scope  # noqa: F401
+from .trainer import Trainer  # noqa: F401
+from . import nn  # noqa: F401
+from . import loss  # noqa: F401
+from . import utils  # noqa: F401

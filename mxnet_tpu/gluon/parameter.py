@@ -1,0 +1,320 @@
+"""Gluon Parameter / ParameterDict.
+
+Reference: python/mxnet/gluon/parameter.py (Parameter with deferred init,
+per-device replicas, grad_req; ParameterDict with prefix scoping and
+save/load).
+
+TPU-native notes: a Parameter holds ONE logical NDArray. Multi-device data
+parallelism does not replicate parameters at the frontend the way the
+reference's list_data() does — SPMD sharding over the mesh handles placement
+(parallel/ package), so list_data() returns a single-element list on purpose.
+"""
+from __future__ import annotations
+
+import re
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as _np
+
+from ..base import MXNetError, check
+from ..context import Context, current_context, cpu
+from .. import initializer as init_mod
+from ..ndarray import ndarray as _nd
+
+__all__ = ["Parameter", "Constant", "ParameterDict", "DeferredInitializationError"]
+
+
+class DeferredInitializationError(MXNetError):
+    """Parameter accessed before shape known (ref: parameter.py)."""
+
+
+class Parameter:
+    def __init__(self, name, grad_req="write", shape=None, dtype="float32",
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default"):
+        self.name = name
+        self._grad_req = grad_req if differentiable else "null"
+        if isinstance(shape, int):
+            shape = (shape,)
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self.stype = stype
+        self._data: Optional[_nd.NDArray] = None
+        self._grad: Optional[_nd.NDArray] = None
+        self._deferred_init = None  # (init, ctx)
+
+    # -- state ----------------------------------------------------------
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        self._grad_req = req
+        if self._data is not None:
+            if req == "null":
+                self._grad = None
+                self._data._tape_entry = None
+            else:
+                self._attach()
+
+    def _shape_known(self) -> bool:
+        return self.shape is not None and all(s > 0 for s in self.shape)
+
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False) -> None:
+        """(ref: parameter.py Parameter.initialize)"""
+        if self._data is not None and not force_reinit:
+            return
+        if isinstance(ctx, (list, tuple)):
+            ctx = ctx[0] if ctx else None
+        if default_init is None:
+            default_init = init_mod.Uniform(0.07)
+        if not self._shape_known():
+            if not self.allow_deferred_init:
+                raise MXNetError(
+                    f"cannot initialize parameter {self.name!r}: shape "
+                    f"{self.shape} unknown; set allow_deferred_init=True or "
+                    "give a full shape")
+            self._deferred_init = (init or self.init or default_init, ctx)
+            return
+        self._finish_init(init or self.init or default_init, ctx)
+
+    def _finish_init(self, initializer, ctx) -> None:
+        ctx = ctx if ctx is not None else current_context()
+        initializer = init_mod.create(initializer) \
+            if not callable(initializer) else initializer
+        data = _nd.zeros(self.shape, ctx=ctx, dtype=self.dtype)
+        initializer(init_mod.InitDesc(self.name), data)
+        self._data = data
+        self._deferred_init = None
+        if self._grad_req != "null":
+            self._attach()
+
+    def _attach(self) -> None:
+        from .. import autograd
+        grad = _nd.zeros(self.shape, ctx=self._data.context,
+                         dtype=self._data._data.dtype)
+        self._grad = grad
+        autograd.mark_variables([self._data], [grad], self._grad_req)
+
+    def _finish_deferred_init(self, in_shape_hint=None) -> None:
+        if self._deferred_init is None:
+            raise DeferredInitializationError(
+                f"parameter {self.name!r} was not initialized")
+        initializer, ctx = self._deferred_init
+        check(self._shape_known(),
+              f"deferred init of {self.name!r}: shape still unknown")
+        self._finish_init(initializer, ctx)
+
+    def shape_hint(self, shape) -> None:
+        """Complete unknown (0) dims from an observed input shape."""
+        if self.shape is None:
+            self.shape = tuple(shape)
+        else:
+            self.shape = tuple(s if s > 0 else h
+                               for s, h in zip(self.shape, shape))
+
+    # -- access ---------------------------------------------------------
+    def data(self, ctx=None) -> _nd.NDArray:
+        if self._data is None:
+            if self._deferred_init is not None:
+                raise DeferredInitializationError(
+                    f"parameter {self.name!r} deferred-initialized; run a "
+                    "forward pass or give explicit shapes first")
+            raise MXNetError(f"parameter {self.name!r} is not initialized; "
+                             "call initialize()")
+        return self._data
+
+    def list_data(self):
+        return [self.data()]
+
+    def grad(self, ctx=None) -> _nd.NDArray:
+        if self._grad is None:
+            raise MXNetError(f"parameter {self.name!r} has no gradient "
+                             f"(grad_req={self._grad_req!r})")
+        return self._grad
+
+    def list_grad(self):
+        return [self.grad()]
+
+    def list_ctx(self):
+        return [self._data.context] if self._data is not None else []
+
+    def set_data(self, data) -> None:
+        if not isinstance(data, _nd.NDArray):
+            data = _nd.array(data)
+        if self._data is None:
+            self.shape = data.shape
+            self._data = data
+            if self._grad_req != "null":
+                self._attach()
+        else:
+            self._data._rebind(data.astype(self._data._data.dtype)._data
+                               if data._data.dtype != self._data._data.dtype
+                               else data._data)
+
+    def zero_grad(self) -> None:
+        if self._grad is not None:
+            self._grad._rebind(_nd.zeros(self._grad.shape,
+                                         ctx=self._grad.context,
+                                         dtype=self._grad._data.dtype)._data)
+
+    def reset_ctx(self, ctx) -> None:
+        if self._data is not None:
+            self._data._rebind(self._data.as_in_context(ctx)._data)
+
+    def cast(self, dtype) -> None:
+        self.dtype = dtype
+        if self._data is not None:
+            self._data._rebind(self._data.astype(dtype)._data)
+            if self._grad is not None:
+                self._grad._rebind(self._grad.astype(dtype)._data)
+                from .. import autograd
+                autograd.mark_variables([self._data], [self._grad],
+                                        self._grad_req)
+
+    def var(self):
+        from ..symbol import symbol as _sym
+        return _sym.var(self.name, shape=self.shape, dtype=self.dtype)
+
+    def __repr__(self):
+        return f"Parameter {self.name} (shape={self.shape}, dtype={self.dtype})"
+
+
+class Constant(Parameter):
+    """Non-learnable parameter (ref: gluon/parameter.py Constant)."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, _np.ndarray):
+            value = _np.asarray(value, dtype=_np.float32)
+        self.value = value
+        super().__init__(name, grad_req="null", shape=value.shape,
+                         dtype=value.dtype,
+                         init=init_mod.Constant(0.0))
+
+    def _finish_init(self, initializer, ctx) -> None:
+        ctx = ctx if ctx is not None else current_context()
+        self._data = _nd.array(self.value, ctx=ctx)
+        self._deferred_init = None
+
+
+class ParameterDict:
+    """Prefix-scoped dict of parameters (ref: gluon/parameter.py:854-879)."""
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params: "OrderedDict[str, Parameter]" = OrderedDict()
+        self._shared = shared
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __len__(self):
+        return len(self._params)
+
+    def __getitem__(self, key) -> Parameter:
+        return self._params[key]
+
+    def __contains__(self, key):
+        return key in self._params
+
+    def __repr__(self):
+        body = "\n".join(f"  {v}" for v in self._params.values())
+        return f"ParameterDict '{self._prefix}' (\n{body}\n)"
+
+    def get(self, name, **kwargs) -> Parameter:
+        """Create-or-retrieve with prefix (ref behavior)."""
+        full = self._prefix + name
+        if full in self._params:
+            param = self._params[full]
+            for k, v in kwargs.items():
+                if v is not None and k == "shape":
+                    if param.shape is None:
+                        param.shape = tuple(v) if not isinstance(v, int) else (v,)
+            return param
+        if self._shared is not None and full in self._shared:
+            param = self._shared[full]
+            self._params[full] = param
+            return param
+        param = Parameter(full, **kwargs)
+        self._params[full] = param
+        return param
+
+    def get_constant(self, name, value=None) -> Constant:
+        full = self._prefix + name
+        if full in self._params:
+            return self._params[full]
+        c = Constant(full, value)
+        self._params[full] = c
+        return c
+
+    def update(self, other) -> None:
+        for k, v in other.items():
+            if k in self._params and self._params[k] is not v:
+                raise MXNetError(f"duplicate parameter name {k}")
+            self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False) -> None:
+        for p in self._params.values():
+            p.initialize(init=None, ctx=ctx,
+                         default_init=init or init_mod.Uniform(0.07),
+                         force_reinit=force_reinit)
+
+    def zero_grad(self) -> None:
+        for p in self._params.values():
+            p.zero_grad()
+
+    def reset_ctx(self, ctx) -> None:
+        for p in self._params.values():
+            p.reset_ctx(ctx)
+
+    def setattr(self, name, value) -> None:
+        for p in self._params.values():
+            setattr(p, name, value)
+
+    def save(self, filename, strip_prefix="") -> None:
+        from ..ndarray import utils as nd_utils
+        payload = {}
+        for name, p in self._params.items():
+            if strip_prefix and name.startswith(strip_prefix):
+                name = name[len(strip_prefix):]
+            payload[name] = p.data()
+        nd_utils.save(filename, payload)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix="") -> None:
+        from ..ndarray import utils as nd_utils
+        loaded = nd_utils.load(filename)
+        loaded = {restore_prefix + k: v for k, v in loaded.items()}
+        if not allow_missing:
+            for name in self._params:
+                check(name in loaded,
+                      f"parameter {name} missing from file {filename}")
+        for name, data in loaded.items():
+            if name not in self._params:
+                if ignore_extra:
+                    continue
+                raise MXNetError(f"parameter {name} in file is not in this "
+                                 "ParameterDict (pass ignore_extra=True)")
+            self._params[name].set_data(data if ctx is None
+                                        else data.as_in_context(ctx))

@@ -1,0 +1,126 @@
+"""Gluon Trainer (ref: python/mxnet/gluon/trainer.py:27).
+
+Applies an Optimizer to a set of Parameters after backward. With a kvstore,
+gradients ride the communication layer (XLA collectives over the mesh — see
+kvstore.py) exactly like the reference's push/pull flow (trainer.py:327
+allreduce_grads); without one, updates are local fused ops.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..base import MXNetError, check
+from .. import optimizer as opt_mod
+from .parameter import Parameter, ParameterDict
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore="device", compression_params=None,
+                 update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = [params[k] for k in sorted(params.keys())]
+        if not isinstance(params, (list, tuple)):
+            raise MXNetError("params must be a ParameterDict/list of Parameter")
+        self._params: List[Parameter] = []
+        self._param2idx: Dict[str, int] = {}
+        for i, p in enumerate(params):
+            if not isinstance(p, Parameter):
+                raise MXNetError(f"invalid parameter {p!r}")
+            self._param2idx[p.name] = i
+            self._params.append(p)
+        self._compression_params = compression_params
+        self._contains_sparse = any(p.stype != "default" for p in self._params)
+        optimizer_params = optimizer_params or {}
+        self._scale = float(optimizer_params.get("rescale_grad", 1.0))
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kvstore_arg = kvstore
+        self._update_on_kvstore = update_on_kvstore
+        self._kvstore = None
+        self._kv_initialized = False
+        self._params_synced = False
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: p for i, p in enumerate(self._params)}
+        if isinstance(optimizer, opt_mod.Optimizer):
+            check(not optimizer_params,
+                  "optimizer_params must be empty when an Optimizer instance "
+                  "is passed")
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt_mod.create(optimizer,
+                                             param_dict=param_dict,
+                                             **optimizer_params)
+        self._updaters = [opt_mod.get_updater(self._optimizer)]
+
+    def _init_kvstore(self):
+        """Lazy kvstore creation (ref: trainer.py:169)."""
+        if self._kvstore_arg and not isinstance(self._kvstore_arg, str):
+            self._kvstore = self._kvstore_arg
+        elif self._kvstore_arg:
+            from .. import kvstore as kv_mod
+            try:
+                kv = kv_mod.create(self._kvstore_arg)
+                # a 1-device local store adds nothing over direct update
+                self._kvstore = kv if kv.num_devices > 1 or kv.rank is not None \
+                    and kv.size > 1 else None
+            except Exception:
+                self._kvstore = None
+        self._kv_initialized = True
+        if self._kvstore is not None:
+            for i, p in enumerate(self._params):
+                self._kvstore.init(i, p.data())
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def allreduce_grads(self):
+        """Sum gradients across devices (ref: trainer.py:327). With the SPMD
+        mesh backend this is an XLA psum ridden through the kvstore."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._kvstore is None:
+            return
+        for i, p in enumerate(self._params):
+            if p.grad_req != "null":
+                self._kvstore.push(i, p.grad())
+                self._kvstore.pull(i, p.grad())
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """One optimization step: rescale by 1/batch_size, allreduce, update
+        (ref: trainer.py:298)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self.allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        updater = self._updaters[0]
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null":
+                continue
+            updater(i, p.grad(), p.data())
+
+    def save_states(self, fname):
+        with open(fname, "wb") as f:
+            f.write(self._updaters[0].get_states(dump_optimizer=False))
+
+    def load_states(self, fname):
+        with open(fname, "rb") as f:
+            self._updaters[0].set_states(f.read())

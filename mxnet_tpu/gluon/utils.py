@@ -1,0 +1,81 @@
+"""Gluon utilities (ref: python/mxnet/gluon/utils.py)."""
+from __future__ import annotations
+
+import math
+from typing import List, Optional
+
+from ..base import MXNetError, check
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm",
+           "check_sha1", "download"]
+
+
+def split_data(data, num_slice: int, batch_axis: int = 0,
+               even_split: bool = True) -> List:
+    """Split a batch along ``batch_axis`` (ref: utils.py split_data)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise MXNetError(
+            f"cannot evenly split batch of {size} into {num_slice}")
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        slices.append(data.slice_axis(axis=batch_axis, begin=begin, end=end))
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis: int = 0,
+                   even_split: bool = True) -> List:
+    """Split a batch across contexts (ref: utils.py split_and_load).
+
+    On the SPMD path one sharded array replaces this; kept for API parity and
+    the per-device Gluon training loop.
+    """
+    from ..ndarray import ndarray as _nd
+    if not isinstance(data, _nd.NDArray):
+        data = _nd.array(data, ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm: float, check_isfinite: bool = True):
+    """Rescale arrays so the joint L2 norm <= max_norm
+    (ref: utils.py clip_global_norm)."""
+    import numpy as _np
+    check(len(arrays) > 0, "need at least one array")
+    total = 0.0
+    for a in arrays:
+        n = a.norm().asscalar()
+        total += float(n) ** 2
+    total = math.sqrt(total)
+    if check_isfinite and not math.isfinite(total):
+        import warnings
+        warnings.warn("nan or inf in clip_global_norm")
+    scale = max_norm / (total + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a._rebind((a * scale)._data)
+    return total
+
+
+def check_sha1(filename: str, sha1_hash: str) -> bool:
+    import hashlib
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None,
+             retries=5, verify_ssl=True):
+    """Zero-egress environment: downloads are unavailable; kept for API
+    parity (raises with a clear message)."""
+    raise MXNetError("network downloads are disabled in this environment")

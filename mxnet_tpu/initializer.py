@@ -1,0 +1,269 @@
+"""Weight initializers (ref: python/mxnet/initializer.py).
+
+Same registry + descriptor surface as the reference (mx.init.Xavier(...),
+strings like "xavier" accepted everywhere a layer takes ``weight_initializer``).
+Initialization itself is functional: each initializer produces values from the
+global mx.random key so a seeded program is fully reproducible.
+"""
+from __future__ import annotations
+
+import json
+import math
+import re
+from typing import Optional
+
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = ["Initializer", "Zero", "One", "Constant", "Uniform", "Normal",
+           "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear", "LSTMBias",
+           "Mixed", "InitDesc", "register", "create"]
+
+_INIT_REGISTRY = {}
+
+
+def register(klass):
+    _INIT_REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+_ALIASES = {"zeros": "zero", "ones": "one", "gaussian": "normal",
+            "xavier_uniform": "xavier", "msra": "msraprelu"}
+
+
+def create(init, **kwargs) -> "Initializer":
+    if init is None:
+        return Uniform(0.07)
+    if isinstance(init, Initializer):
+        return init
+    if isinstance(init, str):
+        name = init.lower()
+        name = _ALIASES.get(name, name)
+        if name not in _INIT_REGISTRY:
+            raise MXNetError(f"unknown initializer {init!r}")
+        return _INIT_REGISTRY[name](**kwargs)
+    raise MXNetError(f"cannot create initializer from {init!r}")
+
+
+class InitDesc(str):
+    """Parameter name + attrs passed to an initializer
+    (ref: python/mxnet/initializer.py InitDesc)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
+
+
+class Initializer:
+    """Base initializer with the reference's name-pattern dispatch."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self) -> str:
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, desc, arr) -> None:
+        """Fill ``arr`` (an NDArray) according to the parameter name."""
+        if not isinstance(desc, str):
+            desc = InitDesc("weight")
+        init = getattr(desc, "attrs", {}).get("__init__", "")
+        if init:
+            create(json.loads(init)[0], **json.loads(init)[1])._init_weight(desc, arr)
+            return
+        name = str(desc)
+        if name.endswith("bias"):
+            self._init_bias(desc, arr)
+        elif name.endswith("gamma"):
+            self._init_one(desc, arr)
+        elif name.endswith("beta"):
+            self._init_zero(desc, arr)
+        elif name.endswith("running_mean") or name.endswith("moving_mean"):
+            self._init_zero(desc, arr)
+        elif name.endswith("running_var") or name.endswith("moving_var"):
+            self._init_one(desc, arr)
+        else:
+            self._init_weight(desc, arr)
+
+    # -- fill helpers ---------------------------------------------------
+    @staticmethod
+    def _set(arr, value: _np.ndarray) -> None:
+        from .ndarray import array as nd_array
+        arr._rebind(nd_array(value.astype(_np.float32)
+                             if value.dtype == _np.float64 else value,
+                             ctx=arr.context, dtype=arr._data.dtype)._data)
+
+    def _rand(self, shape):
+        from . import random as _random
+        import jax.random as jr
+        return _np.asarray(jr.uniform(_random.next_key(), shape,
+                                      minval=-1.0, maxval=1.0))
+
+    def _randn(self, shape):
+        from . import random as _random
+        import jax.random as jr
+        return _np.asarray(jr.normal(_random.next_key(), shape))
+
+    def _init_zero(self, desc, arr):
+        self._set(arr, _np.zeros(arr.shape, _np.float32))
+
+    def _init_one(self, desc, arr):
+        self._set(arr, _np.ones(arr.shape, _np.float32))
+
+    def _init_bias(self, desc, arr):
+        self._init_zero(desc, arr)
+
+    def _init_weight(self, desc, arr):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{self.__class__.__name__}({self._kwargs})"
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, desc, arr):
+        self._init_zero(desc, arr)
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, desc, arr):
+        self._init_one(desc, arr)
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, desc, arr):
+        self._set(arr, _np.full(arr.shape, self.value, _np.float32))
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, desc, arr):
+        self._set(arr, self._rand(arr.shape) * self.scale)
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, desc, arr):
+        self._set(arr, self._randn(arr.shape) * self.sigma)
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, desc, arr):
+        nout = arr.shape[0]
+        nin = int(_np.prod(arr.shape[1:]))
+        tmp = self._randn((nout, nin)) if self.rand_type == "normal" \
+            else self._rand((nout, nin))
+        u, _, v = _np.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == (nout, nin) else v
+        self._set(arr, (self.scale * q).reshape(arr.shape))
+
+
+@register
+class Xavier(Initializer):
+    """(ref: python/mxnet/initializer.py Xavier)"""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, desc, arr):
+        shape = arr.shape
+        hw_scale = float(_np.prod(shape[2:])) if len(shape) > 2 else 1.0
+        fan_in = shape[1] * hw_scale if len(shape) > 1 else shape[0]
+        fan_out = shape[0] * hw_scale
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise MXNetError(f"invalid factor_type {self.factor_type}")
+        scale = math.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            self._set(arr, self._rand(shape) * scale)
+        elif self.rnd_type == "gaussian":
+            self._set(arr, self._randn(shape) * scale)
+        else:
+            raise MXNetError(f"invalid rnd_type {self.rnd_type}")
+
+
+@register
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    def _init_weight(self, desc, arr):
+        shape = arr.shape
+        weight = _np.zeros(int(_np.prod(shape)), dtype=_np.float32)
+        f = _np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(weight.size):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        self._set(arr, weight.reshape(shape))
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias = 1 (ref: python/mxnet/initializer.py LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        b = _np.zeros(arr.shape, _np.float32)
+        num_hidden = arr.shape[0] // 4
+        b[num_hidden:2 * num_hidden] = self.forget_bias
+        self._set(arr, b)
+
+
+class Mixed:
+    """Pattern -> initializer dispatch (ref: Mixed in initializer.py)."""
+
+    def __init__(self, patterns, initializers):
+        if len(patterns) != len(initializers):
+            raise MXNetError("patterns and initializers must pair up")
+        self.map = [(re.compile(p), i) for p, i in zip(patterns, initializers)]
+
+    def __call__(self, name, arr):
+        for regex, init in self.map:
+            if regex.search(str(name)):
+                init(name, arr)
+                return
+        raise MXNetError(f"no initializer pattern matches {name!r}; "
+                         "add a '.*' catch-all")

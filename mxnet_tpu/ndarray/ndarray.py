@@ -189,6 +189,14 @@ class NDArray:
         a = self.asnumpy()
         return a.astype(dtype) if dtype is not None else a
 
+    def __reduce__(self):
+        # pickle via host numpy (ref: NDArray __reduce__ in ndarray.py);
+        # bf16 upcast to f32 on the way out
+        import jax.numpy as jnp
+        if self._data.dtype == jnp.bfloat16:
+            return (_unpickle_bf16, (self.astype(jnp.float32).asnumpy(),))
+        return (array, (self.asnumpy(),))
+
     def astype(self, dtype, copy: bool = True) -> "NDArray":
         dtype = _as_dtype(dtype)
         if not copy and self._data.dtype == dtype:
@@ -550,6 +558,11 @@ def _index_assign_scalar_impl(x, _idx=None, _val=None):
 # ---------------------------------------------------------------------------
 # creation functions (ref: python/mxnet/ndarray/ndarray.py + utils)
 # ---------------------------------------------------------------------------
+
+def _unpickle_bf16(np_arr):
+    import jax.numpy as jnp
+    return array(np_arr).astype(jnp.bfloat16)
+
 
 def _place(data, ctx: Optional[Context]):
     ctx = ctx if ctx is not None else current_context()

@@ -1,0 +1,3 @@
+"""Optimizers (ref: python/mxnet/optimizer/__init__.py)."""
+from .optimizer import *  # noqa: F401,F403
+from .optimizer import Optimizer, create, register, get_updater, Updater  # noqa: F401

@@ -1,0 +1,560 @@
+"""Optimizers (ref: python/mxnet/optimizer/optimizer.py).
+
+Each optimizer's update maps onto a fused device-side update op
+(ops/optimizer_ops.py — ref src/operator/optimizer_op.cc) so one XLA program
+covers grad-rescale + clip + weight-decay + state + weight update. State
+tensors are returned functionally and rebound (versioned vars) instead of
+mutated in place.
+"""
+from __future__ import annotations
+
+import math
+import pickle
+from typing import Any, Dict, Optional
+
+import numpy as _np
+
+from ..base import MXNetError, check
+from ..ndarray import ndarray as _nd
+from ..ndarray import register as _ndreg
+
+__all__ = ["Optimizer", "SGD", "Adam", "NAG", "RMSProp", "AdaGrad",
+           "AdaDelta", "Ftrl", "FTML", "Signum", "SignSGD", "LBSGD",
+           "DCASGD", "SGLD", "Nadam", "Test", "create", "register",
+           "Updater", "get_updater"]
+
+
+class Optimizer:
+    """Base optimizer with the reference's registry / lr-mult machinery."""
+
+    opt_registry: Dict[str, type] = {}
+
+    @staticmethod
+    def register(klass):
+        name = klass.__name__.lower()
+        Optimizer.opt_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        if name.lower() not in Optimizer.opt_registry:
+            raise MXNetError(f"unknown optimizer {name!r}")
+        return Optimizer.opt_registry[name.lower()](**kwargs)
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult: Dict[Any, float] = {}
+        self.wd_mult: Dict[Any, float] = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count: Dict[int, int] = {}
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.idx2name = param_idx2name.copy() if param_idx2name else {}
+        self.param_dict = param_dict if param_dict else {}
+
+    # -- state ----------------------------------------------------------
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and weight._data.dtype != _np.float32:
+            w32 = weight.astype("float32")
+            return (self.create_state(index, w32), w32)
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight._data.dtype != _np.float32:
+            inner_state, w32 = state
+            g32 = grad.astype("float32")
+            self.update(index, w32, g32, inner_state)
+            weight._rebind(w32.astype(weight._data.dtype)._data)
+        else:
+            self.update(index, weight, grad, state)
+
+    # -- hyper-param resolution ----------------------------------------
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise MXNetError("cannot set lr directly when lr_scheduler is set")
+        self.lr = lr
+
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = args_lr_mult.copy()
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = args_wd_mult.copy()
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler else self.lr
+        if index in self.param_dict:
+            lr *= self.param_dict[index].lr_mult
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.param_dict:
+            wd *= self.param_dict[index].wd_mult
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    def __getstate__(self):
+        d = self.__dict__.copy()
+        d.pop("param_dict", None)
+        return d
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self.param_dict = {}
+
+
+register = Optimizer.register
+create = Optimizer.create_optimizer
+
+
+def _invoke(name, inputs, params):
+    """Run a fused update op and return NDArray outputs."""
+    return _nd.imperative_invoke(name, inputs, params)
+
+
+def _clip(cg):
+    return -1.0 if cg is None else cg
+
+
+@register
+class SGD(Optimizer):
+    """SGD w/ momentum + multi-precision (ref: optimizer.py:511)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return _nd.zeros(weight.shape, ctx=weight.context,
+                         dtype=weight._data.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        kw = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                  clip_gradient=_clip(self.clip_gradient))
+        if state is None:
+            new_w = _invoke("sgd_update", (weight, grad), kw)
+            weight._rebind(new_w._data)
+        else:
+            kw["momentum"] = self.momentum
+            new_w, new_m = _invoke("sgd_mom_update", (weight, grad, state), kw)
+            weight._rebind(new_w._data)
+            state._rebind(new_m._data)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic Gradient Langevin Dynamics (ref: optimizer.py SGLD)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        from .. import random as _random
+        import jax.random as jr
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        noise = _nd.from_jax(jr.normal(_random.next_key(), weight.shape)
+                             * math.sqrt(lr))
+        weight._rebind((weight - lr / 2 * (g + wd * weight) + noise)._data)
+
+
+@register
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return _nd.zeros(weight.shape, ctx=weight.context,
+                         dtype=weight._data.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        kw = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                  clip_gradient=_clip(self.clip_gradient))
+        if state is None:
+            new_w = _invoke("signsgd_update", (weight, grad), kw)
+            weight._rebind(new_w._data)
+        else:
+            kw.update(momentum=self.momentum, wd_lh=self.wd_lh)
+            new_w, new_m = _invoke("signum_update", (weight, grad, state), kw)
+            weight._rebind(new_w._data)
+            state._rebind(new_m._data)
+
+
+@register
+class SignSGD(Signum):
+    def __init__(self, **kwargs):
+        kwargs.setdefault("momentum", 0.0)
+        super().__init__(**kwargs)
+
+
+@register
+class NAG(Optimizer):
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return _nd.zeros(weight.shape, ctx=weight.context,
+                         dtype=weight._data.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        kw = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                  clip_gradient=_clip(self.clip_gradient))
+        if state is None:
+            new_w = _invoke("sgd_update", (weight, grad), kw)
+            weight._rebind(new_w._data)
+        else:
+            kw["momentum"] = self.momentum
+            new_w, new_m = _invoke("nag_mom_update", (weight, grad, state), kw)
+            weight._rebind(new_w._data)
+            state._rebind(new_m._data)
+
+
+@register
+class Adam(Optimizer):
+    """(ref: optimizer.py:1120)"""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (_nd.zeros(weight.shape, ctx=weight.context,
+                          dtype=weight._data.dtype),
+                _nd.zeros(weight.shape, ctx=weight.context,
+                          dtype=weight._data.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        lr_t = lr * math.sqrt(1 - self.beta2 ** t) / (1 - self.beta1 ** t)
+        mean, var = state
+        new_w, new_m, new_v = _invoke(
+            "adam_update", (weight, grad, mean, var),
+            dict(lr=lr_t, beta1=self.beta1, beta2=self.beta2,
+                 epsilon=self.epsilon, wd=wd, rescale_grad=self.rescale_grad,
+                 clip_gradient=_clip(self.clip_gradient)))
+        weight._rebind(new_w._data)
+        mean._rebind(new_m._data)
+        var._rebind(new_v._data)
+
+
+@register
+class Nadam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (_nd.zeros(weight.shape, ctx=weight.context),
+                _nd.zeros(weight.shape, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        g = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        m_t = self.beta1 * (1 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        m_t1 = self.beta1 * (1 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+        self.m_schedule *= m_t
+        sched_next = self.m_schedule * m_t1
+        mean, var = state
+        mean._rebind((self.beta1 * mean + (1 - self.beta1) * g)._data)
+        var._rebind((self.beta2 * var + (1 - self.beta2) * g * g)._data)
+        g_prime = g / (1 - self.m_schedule)
+        m_prime = mean / (1 - sched_next)
+        v_prime = var / (1 - self.beta2 ** t)
+        m_bar = (1 - m_t) * g_prime + m_t1 * m_prime
+        from ..ndarray import op as _op
+        weight._rebind((weight - lr * m_bar /
+                        (_op.sqrt(v_prime) + self.epsilon))._data)
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return _nd.zeros(weight.shape, ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        g = g + wd * weight
+        state._rebind((state + g * g)._data)
+        from ..ndarray import op as _op
+        weight._rebind((weight - lr * g /
+                        (_op.sqrt(state) + self.float_stable_eps))._data)
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (_nd.zeros(weight.shape, ctx=weight.context),
+                _nd.zeros(weight.shape, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        acc_g, acc_delta = state
+        from ..ndarray import op as _op
+        acc_g._rebind((self.rho * acc_g + (1 - self.rho) * g * g)._data)
+        delta = _op.sqrt(acc_delta + self.epsilon) / \
+            _op.sqrt(acc_g + self.epsilon) * g
+        acc_delta._rebind((self.rho * acc_delta +
+                           (1 - self.rho) * delta * delta)._data)
+        weight._rebind((weight - delta - wd * weight)._data)
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1, self.gamma2 = gamma1, gamma2
+        self.epsilon = epsilon
+        self.centered = centered
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (_nd.zeros(weight.shape, ctx=weight.context),
+                    _nd.zeros(weight.shape, ctx=weight.context),
+                    _nd.zeros(weight.shape, ctx=weight.context))
+        return _nd.zeros(weight.shape, ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        kw = dict(lr=lr, gamma1=self.gamma1, epsilon=self.epsilon, wd=wd,
+                  rescale_grad=self.rescale_grad,
+                  clip_gradient=_clip(self.clip_gradient),
+                  clip_weights=_clip(self.clip_weights))
+        if not self.centered:
+            new_w, new_n = _invoke("rmsprop_update", (weight, grad, state), kw)
+            weight._rebind(new_w._data)
+            state._rebind(new_n._data)
+        else:
+            n, g_avg, delta = state
+            kw["gamma2"] = self.gamma2
+            new_w, new_n, new_g, new_d = _invoke(
+                "rmspropalex_update", (weight, grad, n, g_avg, delta), kw)
+            weight._rebind(new_w._data)
+            n._rebind(new_n._data)
+            g_avg._rebind(new_g._data)
+            delta._rebind(new_d._data)
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (_nd.zeros(weight.shape, ctx=weight.context),
+                _nd.zeros(weight.shape, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        z, n = state
+        new_w, new_z, new_n = _invoke(
+            "ftrl_update", (weight, grad, z, n),
+            dict(lr=lr, lamda1=self.lamda1, beta=self.beta, wd=wd,
+                 rescale_grad=self.rescale_grad,
+                 clip_gradient=_clip(self.clip_gradient)))
+        weight._rebind(new_w._data)
+        z._rebind(new_z._data)
+        n._rebind(new_n._data)
+
+
+@register
+class FTML(Optimizer):
+    def __init__(self, beta1=0.6, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return (_nd.zeros(weight.shape, ctx=weight.context),
+                _nd.zeros(weight.shape, ctx=weight.context),
+                _nd.zeros(weight.shape, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        d, v, z = state
+        new_w, new_d, new_v = _invoke(
+            "ftml_update", (weight, grad, d, v, z),
+            dict(lr=lr, beta1=self.beta1, beta2=self.beta2,
+                 epsilon=self.epsilon, t=t, wd=wd,
+                 rescale_grad=self.rescale_grad,
+                 clip_grad=_clip(self.clip_gradient)))
+        # ftml returns weight, d, v; z updated inside relationship
+        import jax.numpy as jnp
+        g = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        sigma = new_d - self.beta1 * d
+        z._rebind((self.beta1 * z + (1 - self.beta1) * g - sigma * weight)._data)
+        weight._rebind(new_w._data)
+        d._rebind(new_d._data)
+        v._rebind(new_v._data)
+
+
+@register
+class DCASGD(Optimizer):
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous: Dict[Any, Any] = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        mom = None if self.momentum == 0.0 else \
+            _nd.zeros(weight.shape, ctx=weight.context)
+        return (mom, weight.copy())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        mom, prev = state
+        comp = g + self.lamda * g * g * (weight - prev)
+        step = -lr * (comp + wd * weight)
+        if mom is not None:
+            mom._rebind((self.momentum * mom + step)._data)
+            step = mom
+        prev._rebind(weight._data)
+        weight._rebind((weight + step)._data)
+
+
+@register
+class LBSGD(SGD):
+    """Large-batch SGD with LARS-style scaling (ref: optimizer.py LBSGD);
+    simplified to layer-wise adaptive rate on top of SGD."""
+
+    def __init__(self, warmup_strategy="linear", warmup_epochs=5,
+                 batch_scale=1, updates_per_epoch=32, begin_epoch=0,
+                 num_epochs=60, **kwargs):
+        super().__init__(**kwargs)
+
+
+@register
+class Test(Optimizer):
+    def create_state(self, index, weight):
+        return _nd.zeros(weight.shape, ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        weight._rebind((weight + grad * self.rescale_grad)._data)
+        state._rebind(weight._data)
+
+
+ccSGD = SGD  # deprecated alias (ref keeps it)
+
+
+class Updater:
+    """KVStore updater closure (ref: optimizer.py get_updater)."""
+
+    def __init__(self, optimizer: Optimizer):
+        self.optimizer = optimizer
+        self.states: Dict[Any, Any] = {}
+        self.states_synced: Dict[Any, bool] = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = \
+                self.optimizer.create_state_multi_precision(index, weight)
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def get_states(self, dump_optimizer=False):
+        return pickle.dumps((self.states, self.optimizer)
+                            if dump_optimizer else self.states)
+
+    def set_states(self, states):
+        states = pickle.loads(states)
+        if isinstance(states, tuple) and len(states) == 2 and \
+                isinstance(states[1], Optimizer):
+            self.states, self.optimizer = states
+        else:
+            self.states = states
+
+
+def get_updater(optimizer: Optimizer) -> Updater:
+    return Updater(optimizer)

@@ -20,6 +20,13 @@ _lock = threading.Lock()
 _key = None
 _seed_value = 0
 
+# While tracing a CachedOp/jitted graph, random ops must derive their keys
+# from a *traced* key input (otherwise the trace would bake one fixed mask
+# into the compiled program). push_trace_key installs that traced key; each
+# next_key() call folds in a counter so every op in the graph gets a distinct,
+# per-invocation-fresh stream.
+_trace_stack = threading.local()
+
 
 def _jr():
     import jax.random as jr
@@ -34,8 +41,23 @@ def seed(seed_state: int, ctx=None) -> None:
         _key = _jr().PRNGKey(_seed_value)
 
 
+def push_trace_key(key) -> None:
+    if not hasattr(_trace_stack, "stack"):
+        _trace_stack.stack = []
+    _trace_stack.stack.append([key, 0])
+
+
+def pop_trace_key() -> None:
+    _trace_stack.stack.pop()
+
+
 def next_key():
     """Split off a fresh subkey for one sampling op."""
+    stack = getattr(_trace_stack, "stack", None)
+    if stack:
+        entry = stack[-1]
+        entry[1] += 1
+        return _jr().fold_in(entry[0], entry[1])
     global _key
     with _lock:
         if _key is None:

@@ -1,0 +1,268 @@
+"""Gluon tests (model: tests/python/unittest/test_gluon.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+def test_dense_forward():
+    layer = nn.Dense(4, in_units=3)
+    layer.initialize()
+    x = nd.ones((2, 3))
+    y = layer(x)
+    assert y.shape == (2, 4)
+
+
+def test_dense_deferred_init():
+    layer = nn.Dense(4)
+    layer.initialize()
+    y = layer(nd.ones((2, 7)))
+    assert y.shape == (2, 4)
+    assert layer.weight.shape == (4, 7)
+
+
+def test_sequential():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(2))
+    net.initialize()
+    y = net(nd.ones((4, 5)))
+    assert y.shape == (4, 2)
+
+
+def test_hybridize_matches_eager():
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(3))
+    net.initialize()
+    x = nd.array(np.random.RandomState(0).randn(5, 10).astype(np.float32))
+    y_eager = net(x).asnumpy()
+    net.hybridize()
+    y_hybrid = net(x).asnumpy()
+    assert np.allclose(y_eager, y_hybrid, atol=1e-5)
+    # second call uses the cache
+    y2 = net(x).asnumpy()
+    assert np.allclose(y_eager, y2, atol=1e-5)
+
+
+def test_hybrid_backward():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="tanh"), nn.Dense(1))
+    net.initialize()
+    x = nd.array(np.random.RandomState(1).randn(4, 6).astype(np.float32))
+
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    eager_grads = {k: p.grad().asnumpy().copy()
+                   for k, p in net.collect_params().items()}
+
+    net.hybridize()
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    for k, p in net.collect_params().items():
+        assert np.allclose(eager_grads[k], p.grad().asnumpy(), atol=1e-4), k
+
+
+def test_conv2d():
+    layer = nn.Conv2D(8, kernel_size=3, padding=1, in_channels=3)
+    layer.initialize()
+    x = nd.ones((2, 3, 16, 16))
+    y = layer(x)
+    assert y.shape == (2, 8, 16, 16)
+
+
+def test_conv2d_deferred():
+    layer = nn.Conv2D(4, kernel_size=3)
+    layer.initialize()
+    y = layer(nd.ones((1, 5, 8, 8)))
+    assert y.shape == (1, 4, 6, 6)
+    assert layer.weight.shape == (4, 5, 3, 3)
+
+
+def test_conv_vs_torch():
+    torch = pytest.importorskip("torch")
+    rs = np.random.RandomState(0)
+    x = rs.randn(2, 3, 8, 8).astype(np.float32)
+    w = rs.randn(6, 3, 3, 3).astype(np.float32)
+    b = rs.randn(6).astype(np.float32)
+    out = nd.op.Convolution(nd.array(x), nd.array(w), nd.array(b),
+                            kernel=(3, 3), num_filter=6, stride=(2, 2),
+                            pad=(1, 1)).asnumpy()
+    tout = torch.nn.functional.conv2d(
+        torch.tensor(x), torch.tensor(w), torch.tensor(b), stride=2,
+        padding=1).numpy()
+    assert np.allclose(out, tout, atol=1e-4)
+
+
+def test_pooling_vs_torch():
+    torch = pytest.importorskip("torch")
+    rs = np.random.RandomState(0)
+    x = rs.randn(2, 3, 9, 9).astype(np.float32)
+    out = nd.op.Pooling(nd.array(x), kernel=(3, 3), pool_type="max",
+                        stride=(2, 2)).asnumpy()
+    tout = torch.nn.functional.max_pool2d(torch.tensor(x), 3, 2).numpy()
+    assert np.allclose(out, tout, atol=1e-5)
+    out = nd.op.Pooling(nd.array(x), kernel=(2, 2), pool_type="avg",
+                        stride=(2, 2)).asnumpy()
+    tout = torch.nn.functional.avg_pool2d(torch.tensor(x), 2, 2).numpy()
+    assert np.allclose(out, tout, atol=1e-5)
+
+
+def test_batchnorm_train_and_eval():
+    layer = nn.BatchNorm(in_channels=4)
+    layer.initialize()
+    x = nd.array(np.random.RandomState(0).randn(8, 4, 5, 5).astype(np.float32) * 3 + 1)
+    rm0 = layer.running_mean.data().asnumpy().copy()
+    with autograd.record():
+        y = layer(x)
+    # batch-normalized output should be ~zero-mean/unit-var per channel
+    yn = y.asnumpy()
+    assert abs(yn.mean()) < 0.1
+    assert abs(yn.std() - 1) < 0.1
+    # moving stats moved toward batch stats
+    rm1 = layer.running_mean.data().asnumpy()
+    assert not np.allclose(rm0, rm1)
+    # eval mode uses moving stats
+    y_eval = layer(x)
+    assert y_eval.shape == x.shape
+
+
+def test_batchnorm_hybrid_state_update():
+    layer = nn.BatchNorm(in_channels=3)
+    layer.initialize()
+    layer.hybridize()
+    x = nd.array(np.random.RandomState(0).randn(4, 3, 2, 2).astype(np.float32) + 5)
+    rm0 = layer.running_mean.data().asnumpy().copy()
+    with autograd.record():
+        layer(x)
+    rm1 = layer.running_mean.data().asnumpy()
+    assert not np.allclose(rm0, rm1), "hybridized BN must update moving stats"
+
+
+def test_embedding():
+    layer = nn.Embedding(10, 4)
+    layer.initialize()
+    y = layer(nd.array([1, 2, 3], dtype="int32"))
+    assert y.shape == (3, 4)
+
+
+def test_dropout_layer():
+    layer = nn.Dropout(0.5)
+    layer.initialize()
+    x = nd.ones((100, 100))
+    y = layer(x)
+    assert (y.asnumpy() == 1).all()  # not training
+    with autograd.record():
+        y = layer(x)
+    assert (y.asnumpy() == 0).any()
+
+
+def test_trainer_sgd_step():
+    net = nn.Dense(1, in_units=2, use_bias=False)
+    net.initialize(mx.init.Constant(1.0))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore=None)
+    x = nd.ones((4, 2))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(batch_size=4)
+    # grad of sum(w.x) wrt w = sum over batch of x = [4,4]; /batch_size -> [1,1]
+    w = net.weight.data().asnumpy()
+    assert np.allclose(w, 1.0 - 0.1 * 1.0)
+
+
+def test_mlp_regression_converges():
+    rs = np.random.RandomState(0)
+    X = rs.randn(128, 4).astype(np.float32)
+    w_true = np.array([[1.0], [-2.0], [0.5], [3.0]], dtype=np.float32)
+    Y = X @ w_true
+
+    net = nn.Dense(1, in_units=4)
+    net.initialize(mx.init.Normal(0.1))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore=None)
+    l2 = gluon.loss.L2Loss()
+    xs, ys = nd.array(X), nd.array(Y)
+    for _ in range(100):
+        with autograd.record():
+            loss = l2(net(xs), ys)
+        loss.backward()
+        trainer.step(batch_size=128)
+    final = float(loss.mean().asscalar())
+    assert final < 1e-3, f"did not converge: {final}"
+    assert np.allclose(net.weight.data().asnumpy().ravel(),
+                       w_true.ravel(), atol=0.05)
+
+
+def test_mlp_hybrid_adam_converges():
+    rs = np.random.RandomState(1)
+    X = rs.randn(256, 8).astype(np.float32)
+    Y = (X[:, :1] > 0).astype(np.float32)
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(1))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01}, kvstore=None)
+    lossfn = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+    xs, ys = nd.array(X), nd.array(Y)
+    first = None
+    for i in range(60):
+        with autograd.record():
+            loss = lossfn(net(xs), ys)
+        loss.backward()
+        trainer.step(batch_size=256)
+        if first is None:
+            first = float(loss.mean().asscalar())
+    last = float(loss.mean().asscalar())
+    assert last < first * 0.5, f"{first} -> {last}"
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    net.initialize()
+    f = str(tmp_path / "net.params")
+    net.save_parameters(f)
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    net2.load_parameters(f)
+    x = nd.ones((1, 3))
+    assert np.allclose(net(x).asnumpy(), net2(x).asnumpy())
+
+
+def test_collect_params_select():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    net.initialize()
+    all_params = net.collect_params()
+    weights = net.collect_params(".*weight")
+    assert len(weights) == 2
+    assert len(all_params) == 4
+
+
+def test_losses_shapes():
+    pred = nd.array(np.random.RandomState(0).randn(8, 5).astype(np.float32))
+    label = nd.array([0.0, 1, 2, 3, 4, 0, 1, 2])
+    l = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label)
+    assert l.shape == (8,)
+    l1 = gluon.loss.L1Loss()(pred, pred * 0.5)
+    assert l1.shape == (8,)
+    h = gluon.loss.HuberLoss()(pred, pred * 0.9)
+    assert h.shape == (8,)
+
+
+def test_metric_accuracy():
+    from mxnet_tpu import metric
+    m = metric.Accuracy()
+    pred = nd.array([[0.1, 0.9], [0.8, 0.2]])
+    label = nd.array([1.0, 0.0])
+    m.update([label], [pred])
+    assert m.get()[1] == 1.0
+    m2 = metric.create("acc")
+    assert isinstance(m2, metric.Accuracy)
