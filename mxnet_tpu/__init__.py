@@ -30,5 +30,13 @@ from . import optimizer  # noqa: F401
 from . import lr_scheduler  # noqa: F401
 from . import metric  # noqa: F401
 from . import gluon  # noqa: F401
+from . import symbol  # noqa: F401
+from . import symbol as sym  # noqa: F401
+from . import io  # noqa: F401
+from . import module  # noqa: F401
+from . import module as mod  # noqa: F401
+from . import callback  # noqa: F401
+from . import model  # noqa: F401
+from .executor_compat import Executor  # noqa: F401
 
 # `import mxnet_tpu as mx; mx.nd...` is the canonical spelling.

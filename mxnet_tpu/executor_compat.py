@@ -1,0 +1,2 @@
+"""Top-level Executor re-export (ref: mx.executor.Executor)."""
+from .symbol.executor import Executor  # noqa: F401

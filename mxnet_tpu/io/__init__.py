@@ -1,0 +1,3 @@
+"""mx.io — data iterators (ref: python/mxnet/io/__init__.py)."""
+from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, CSVIter,  # noqa
+                 MNISTIter, ResizeIter, PrefetchingIter)

@@ -1,0 +1,416 @@
+"""Data iterators.
+
+Reference: src/io/ (C++ iterator registry, MNIST/CSV/ImageRecord iters,
+BatchLoader/Prefetcher composition) + python/mxnet/io.py (DataIter:178,
+NDArrayIter, MXDataIter, PrefetchingIter:345, ResizeIter).
+
+TPU-native: host-side pipelines produce numpy batches that are device_put
+onto the chip; the C++ RecordIO reader + threaded prefetcher lives in
+src/ (this repo) and is wrapped by ImageRecordIter in record_io.py. Batches
+keep static shapes (pad/discard) so every step replays a compiled program.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import queue as _queue
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as _np
+
+from ..base import MXNetError, check
+from ..ndarray import ndarray as _nd
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
+           "MNISTIter", "ResizeIter", "PrefetchingIter"]
+
+
+class DataDesc(collections.namedtuple("DataDesc", ["name", "shape", "dtype",
+                                                   "layout"])):
+    def __new__(cls, name, shape, dtype=_np.float32, layout="NCHW"):
+        return super().__new__(cls, name, tuple(shape), dtype, layout)
+
+    @staticmethod
+    def get_batch_axis(layout):
+        if layout is None:
+            return 0
+        return layout.find("N")
+
+
+class DataBatch:
+    """(ref: python/mxnet/io.py DataBatch)"""
+
+    def __init__(self, data, label=None, pad=0, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        if data is not None and not isinstance(data, (list, tuple)):
+            data = [data]
+        if label is not None and not isinstance(label, (list, tuple)):
+            label = [label]
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+    def __str__(self):
+        shapes = [d.shape for d in self.data] if self.data else []
+        return f"DataBatch: data shapes {shapes} pad {self.pad}"
+
+
+class DataIter:
+    """(ref: python/mxnet/io.py DataIter:178)"""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self) -> DataBatch:
+        if self.iter_next():
+            return DataBatch(self.getdata(), self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self) -> bool:
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        return 0
+
+
+def _init_data(data, allow_empty, default_name):
+    """Normalize input data to list of (name, numpy array)
+    (ref: python/mxnet/io.py _init_data)."""
+    if data is None:
+        check(allow_empty, "data cannot be None")
+        return []
+    if isinstance(data, (_np.ndarray, _nd.NDArray)):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        if not allow_empty:
+            check(len(data) > 0, "empty data")
+        if len(data) == 1:
+            data = {default_name: data[0]}
+        else:
+            data = {f"_{i}_{default_name}": d for i, d in enumerate(data)}
+    if not isinstance(data, dict):
+        raise MXNetError("data must be array, list or dict of arrays")
+    out = []
+    for k, v in data.items():
+        if isinstance(v, _nd.NDArray):
+            v = v.asnumpy()
+        out.append((k, _np.asarray(v)))
+    return out
+
+
+class NDArrayIter(DataIter):
+    """In-memory iterator (ref: python/mxnet/io.py NDArrayIter)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, False, data_name)
+        self.label = _init_data(label, True, label_name)
+        self.num_data = self.data[0][1].shape[0]
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.cursor = -batch_size
+        self._order = _np.arange(self.num_data)
+        if shuffle:
+            _np.random.shuffle(self._order)
+        if last_batch_handle == "discard":
+            self.num_batches = self.num_data // batch_size
+        else:
+            self.num_batches = (self.num_data + batch_size - 1) // batch_size
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:],
+                         v.dtype) for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:],
+                         v.dtype) for k, v in self.label]
+
+    def reset(self):
+        self.cursor = -self.batch_size
+        if self.shuffle:
+            _np.random.shuffle(self._order)
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        if self.last_batch_handle == "discard":
+            return self.cursor + self.batch_size <= self.num_data
+        return self.cursor < self.num_data
+
+    def _slice(self, arrays):
+        out = []
+        for k, v in arrays:
+            idx = self._order[self.cursor:self.cursor + self.batch_size]
+            part = v[idx]
+            if part.shape[0] < self.batch_size:  # pad with wraparound
+                extra = self.batch_size - part.shape[0]
+                pad_idx = self._order[:extra]
+                part = _np.concatenate([part, v[pad_idx]], axis=0)
+            out.append(_nd.array(part, dtype=part.dtype))
+        return out
+
+    def getdata(self):
+        return self._slice(self.data)
+
+    def getlabel(self):
+        return self._slice(self.label)
+
+    def getpad(self):
+        if self.last_batch_handle == "pad" and \
+                self.cursor + self.batch_size > self.num_data:
+            return self.cursor + self.batch_size - self.num_data
+        return 0
+
+
+class CSVIter(DataIter):
+    """CSV reader (ref: src/io/iter_csv.cc:218)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, round_batch=True, dtype="float32", **kwargs):
+        super().__init__(batch_size)
+        data = _np.loadtxt(data_csv, delimiter=",",
+                           dtype=_np.dtype(dtype), ndmin=2)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = _np.loadtxt(label_csv, delimiter=",",
+                                dtype=_np.float32, ndmin=2)
+            label = label.reshape((-1,) + tuple(label_shape))
+        else:
+            label = _np.zeros((data.shape[0], 1), dtype=_np.float32)
+        self._inner = NDArrayIter(data, label, batch_size,
+                                  last_batch_handle="pad")
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+    def iter_next(self):
+        return self._inner.iter_next()
+
+
+class MNISTIter(DataIter):
+    """MNIST idx-format reader (ref: src/io/iter_mnist.cc:260).
+
+    Reads the standard idx(.gz) files; `flat` controls (N,784) vs (N,1,28,28).
+    """
+
+    def __init__(self, image="train-images-idx3-ubyte", label="train-labels-idx1-ubyte",
+                 batch_size=128, shuffle=True, flat=False, silent=False,
+                 seed=0, **kwargs):
+        super().__init__(batch_size)
+        import gzip
+        import os
+        import struct
+
+        def _open(path):
+            if os.path.exists(path):
+                return open(path, "rb")
+            if os.path.exists(path + ".gz"):
+                return gzip.open(path + ".gz", "rb")
+            raise MXNetError(f"MNIST file not found: {path}")
+
+        with _open(image) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            check(magic == 2051, "bad idx image magic")
+            imgs = _np.frombuffer(f.read(), dtype=_np.uint8)
+            imgs = imgs.reshape(n, rows, cols).astype(_np.float32) / 255.0
+        with _open(label) as f:
+            magic, n2 = struct.unpack(">II", f.read(8))
+            check(magic == 2049, "bad idx label magic")
+            labels = _np.frombuffer(f.read(), dtype=_np.uint8).astype(_np.float32)
+        if flat:
+            imgs = imgs.reshape(n, rows * cols)
+        else:
+            imgs = imgs.reshape(n, 1, rows, cols)
+        if shuffle:
+            rng = _np.random.RandomState(seed)
+            order = rng.permutation(n)
+            imgs, labels = imgs[order], labels[order]
+        self._inner = NDArrayIter(imgs, labels, batch_size,
+                                  last_batch_handle="discard",
+                                  label_name="softmax_label")
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+    def iter_next(self):
+        return self._inner.iter_next()
+
+
+class ResizeIter(DataIter):
+    """Truncate/extend an iterator to a fixed number of batches
+    (ref: python/mxnet/io.py ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+
+class PrefetchingIter(DataIter):
+    """Double-buffering thread over one or more iterators
+    (ref: python/mxnet/io.py PrefetchingIter:345; the C++ analog is
+    src/io/iter_prefetcher.h)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 prefetch_depth: int = 2):
+        if not isinstance(iters, (list, tuple)):
+            iters = [iters]
+        super().__init__(iters[0].batch_size)
+        self.iters = iters
+        self.rename_data = rename_data
+        self.rename_label = rename_label
+        self._queue: _queue.Queue = _queue.Queue(maxsize=prefetch_depth)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._start()
+
+    @property
+    def provide_data(self):
+        if self.rename_data is None:
+            return sum([i.provide_data for i in self.iters], [])
+        return sum([[DataDesc(self.rename_data[i].get(d.name, d.name),
+                              d.shape, d.dtype)
+                     for d in it.provide_data]
+                    for i, it in enumerate(self.iters)], [])
+
+    @property
+    def provide_label(self):
+        if self.rename_label is None:
+            return sum([i.provide_label for i in self.iters], [])
+        return sum([[DataDesc(self.rename_label[i].get(d.name, d.name),
+                              d.shape, d.dtype)
+                     for d in it.provide_label]
+                    for i, it in enumerate(self.iters)], [])
+
+    def _start(self):
+        def worker():
+            try:
+                while not self._stop.is_set():
+                    batches = []
+                    try:
+                        for it in self.iters:
+                            batches.append(it.next())
+                    except StopIteration:
+                        self._queue.put(None)
+                        return
+                    data = sum([b.data for b in batches], [])
+                    label = sum([(b.label or []) for b in batches], [])
+                    merged = DataBatch(data, label, pad=batches[0].pad,
+                                       index=batches[0].index)
+                    self._queue.put(merged)
+            except Exception as e:  # surface errors at next()
+                self._queue.put(e)
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        self._stop.set()
+        try:
+            while True:
+                self._queue.get_nowait()
+        except _queue.Empty:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        for it in self.iters:
+            it.reset()
+        self._stop = threading.Event()
+        self._queue = _queue.Queue(maxsize=2)
+        self._start()
+
+    def next(self):
+        item = self._queue.get()
+        if item is None:
+            raise StopIteration
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+    def iter_next(self):
+        try:
+            self._peek = self.next()
+            return True
+        except StopIteration:
+            return False

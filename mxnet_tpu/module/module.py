@@ -1,0 +1,298 @@
+"""Module: bind a Symbol to data shapes and train it.
+
+Reference: python/mxnet/module/module.py (868 lines) +
+executor_group.py DataParallelExecutorGroup.
+
+TPU-native: one Executor per Module — the reference's per-device executor
+group (batch slicing + gradient reduce over kvstore) is replaced by the SPMD
+mesh path for multi-chip (parallel/), so `context` lists collapse to their
+first entry here and data parallelism across chips is expressed with sharded
+arrays rather than frontend slicing.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional
+
+from ..base import MXNetError, check
+from ..context import Context, cpu, current_context
+from ..ndarray import ndarray as _nd
+from .. import optimizer as opt_mod
+from ..symbol.executor import Executor
+from .base_module import BaseModule
+
+__all__ = ["Module"]
+
+
+class Module(BaseModule):
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None, group2ctxs=None,
+                 compression_params=None):
+        super().__init__(logger=logger)
+        self._symbol = symbol
+        if context is None:
+            context = current_context()
+        if isinstance(context, (list, tuple)):
+            context = context[0]
+        self._context = context
+        self._data_names = list(data_names or [])
+        self._label_names = list(label_names or [])
+        self._fixed_param_names = list(fixed_param_names or [])
+        self._state_names = list(state_names or [])
+        arg_names = symbol.list_arguments()
+        input_names = set(self._data_names) | set(self._label_names) | \
+            set(self._state_names)
+        self._param_names = [n for n in arg_names if n not in input_names]
+        self._aux_names = symbol.list_auxiliary_states()
+        self._exec: Optional[Executor] = None
+        self._optimizer = None
+        self._updater = None
+        self._kvstore = None
+        self._update_on_kvstore = False
+        self._data_shapes = None
+        self._label_shapes = None
+        self._grad_req = "write"
+
+    # -- properties -----------------------------------------------------
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._symbol.list_outputs()
+
+    @property
+    def data_shapes(self):
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        shapes = {d.name: d.shape for d in self._data_shapes or []}
+        shapes.update({d.name: d.shape for d in self._label_shapes or []})
+        _, out_shapes, _ = self._symbol.infer_shape(**shapes)
+        return list(zip(self.output_names, out_shapes))
+
+    # -- bind ------------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        """(ref: module.py bind -> simple_bind per device)"""
+        if self.binded and not force_rebind:
+            return
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self._grad_req = grad_req
+
+        shapes: Dict[str, tuple] = {}
+        descs = []
+        for d in data_shapes:
+            if isinstance(d, tuple) and not hasattr(d, "name"):
+                from ..io import DataDesc
+                d = DataDesc(d[0], d[1])
+            descs.append(d)
+            shapes[d.name] = tuple(d.shape)
+        self._data_shapes = descs
+        label_descs = []
+        if label_shapes:
+            for d in label_shapes:
+                if isinstance(d, tuple) and not hasattr(d, "name"):
+                    from ..io import DataDesc
+                    d = DataDesc(d[0], d[1])
+                label_descs.append(d)
+                shapes[d.name] = tuple(d.shape)
+        self._label_shapes = label_descs or None
+
+        req: Dict[str, str] = {}
+        for n in self._symbol.list_arguments():
+            if n in self._param_names and n not in self._fixed_param_names \
+                    and for_training:
+                req[n] = grad_req
+            elif inputs_need_grad and n in self._data_names:
+                req[n] = grad_req
+            else:
+                req[n] = "null"
+        shared = shared_module._exec if shared_module is not None else None
+        self._exec = Executor.simple_bind(self._symbol, self._context,
+                                          grad_req=req, shared_exec=shared,
+                                          **shapes)
+        self.binded = True
+        if shared_module is not None and shared_module.params_initialized:
+            self.params_initialized = True
+
+    # -- params ----------------------------------------------------------
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        check(self.binded, "bind() before init_params()")
+        if self.params_initialized and not force_init:
+            return
+        from .. import initializer as init_mod
+        if initializer is None:
+            initializer = init_mod.Uniform(0.01)
+        if arg_params is None and hasattr(self, "_preloaded"):
+            arg_params, aux_params = self._preloaded
+        for name in self._param_names:
+            arr = self._exec.arg_dict[name]
+            if arg_params is not None and name in arg_params:
+                arr._rebind(arg_params[name].as_in_context(
+                    arr.context)._data)
+            else:
+                check(allow_missing or arg_params is None,
+                      f"parameter {name} missing and allow_missing=False")
+                initializer(init_mod.InitDesc(name), arr)
+        for name in self._aux_names:
+            arr = self._exec.aux_dict[name]
+            if aux_params is not None and name in aux_params:
+                arr._rebind(aux_params[name]._data)
+            else:
+                initializer(init_mod.InitDesc(name), arr)
+        self.params_initialized = True
+
+    def get_params(self):
+        check(self.binded and self.params_initialized, "bind+init first")
+        arg = {n: self._exec.arg_dict[n].copy() for n in self._param_names}
+        aux = {n: self._exec.aux_dict[n].copy() for n in self._aux_names}
+        return arg, aux
+
+    # -- optimizer --------------------------------------------------------
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        check(self.binded and self.params_initialized, "bind+init first")
+        if self.optimizer_initialized and not force_init:
+            return
+        if not isinstance(optimizer_params, dict):
+            optimizer_params = dict(optimizer_params)
+        if isinstance(optimizer, str):
+            # default grad rescale to 1/batch (ref: module.py init_optimizer)
+            batch_size = self._data_shapes[0].shape[0] \
+                if self._data_shapes else 1
+            optimizer_params.setdefault("rescale_grad", 1.0 / batch_size)
+            idx2name = {i: n for i, n in enumerate(self._param_names)}
+            optimizer = opt_mod.create(optimizer, param_idx2name=idx2name,
+                                       **optimizer_params)
+        self._optimizer = optimizer
+        self._updater = opt_mod.get_updater(optimizer)
+        if isinstance(kvstore, str) and kvstore not in (None, "local",
+                                                        "device"):
+            from .. import kvstore as kv_mod
+            try:
+                self._kvstore = kv_mod.create(kvstore)
+                self._kvstore.set_optimizer(optimizer)
+                self._update_on_kvstore = True
+                for i, name in enumerate(self._param_names):
+                    self._kvstore.init(i, self._exec.arg_dict[name])
+            except Exception:
+                self._kvstore = None
+        self.optimizer_initialized = True
+
+    # -- execution --------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        check(self.binded and self.params_initialized, "bind+init first")
+        if is_train is None:
+            is_train = self.for_training
+        feed: Dict[str, _nd.NDArray] = {}
+        for name, arr in zip(self._data_names, data_batch.data or []):
+            feed[name] = arr
+        if data_batch.label:
+            for name, arr in zip(self._label_names, data_batch.label):
+                feed[name] = arr
+        # shape change (bucketing / final batch) -> rebind sharing params
+        for name, arr in feed.items():
+            cur = self._exec.arg_dict.get(name)
+            if cur is not None and cur.shape != arr.shape:
+                self._exec = self._exec.reshape(
+                    **{n: a.shape for n, a in feed.items()})
+                break
+        self._exec.forward(is_train=is_train, **feed)
+
+    def backward(self, out_grads=None):
+        check(self.binded, "bind first")
+        self._exec.backward(out_grads=out_grads)
+
+    def update(self):
+        """(ref: module.py:644 update)"""
+        check(self.optimizer_initialized, "init_optimizer first")
+        if self._kvstore is not None and self._update_on_kvstore:
+            for i, name in enumerate(self._param_names):
+                w = self._exec.arg_dict[name]
+                g = self._exec.grad_dict.get(name)
+                if g is None:
+                    continue
+                self._kvstore.push(i, g)
+                self._kvstore.pull(i, w)
+            return
+        for i, name in enumerate(self._param_names):
+            g = self._exec.grad_dict.get(name)
+            if g is None:
+                continue
+            self._updater(i, g, self._exec.arg_dict[name])
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._exec.outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        check(self.inputs_need_grad, "bind with inputs_need_grad=True")
+        return [self._exec.grad_dict[n] for n in self._data_names]
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        eval_metric.update_dict(
+            dict(zip(self._label_names, labels or [])),
+            dict(zip(self.output_names, self.get_outputs())))
+
+    # -- checkpointing (ref: module.py save_checkpoint + model.py) --------
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        self._symbol.save(f"{prefix}-symbol.json")
+        arg, aux = self.get_params()
+        payload = {f"arg:{k}": v for k, v in arg.items()}
+        payload.update({f"aux:{k}": v for k, v in aux.items()})
+        _nd_save(f"{prefix}-{epoch:04d}.params", payload)
+        if save_optimizer_states and self._updater is not None:
+            with open(f"{prefix}-{epoch:04d}.states", "wb") as f:
+                f.write(self._updater.get_states())
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        from ..symbol import load as sym_load
+        sym = sym_load(f"{prefix}-symbol.json")
+        mod = Module(sym, **kwargs)
+        arg, aux = load_checkpoint_params(f"{prefix}-{epoch:04d}.params")
+        mod._preloaded = (arg, aux)
+        mod._preloaded_states = f"{prefix}-{epoch:04d}.states" \
+            if load_optimizer_states else None
+        return mod
+
+    def set_states(self, states=None, value=None):
+        pass
+
+    def install_monitor(self, mon):
+        mon.install(self._exec)
+
+
+def _nd_save(fname, payload):
+    from ..ndarray import utils as nd_utils
+    nd_utils.save(fname, payload)
+
+
+def load_checkpoint_params(fname):
+    from ..ndarray import utils as nd_utils
+    loaded = nd_utils.load(fname)
+    arg, aux = {}, {}
+    for k, v in loaded.items():
+        if k.startswith("arg:"):
+            arg[k[4:]] = v
+        elif k.startswith("aux:"):
+            aux[k[4:]] = v
+        else:
+            arg[k] = v
+    return arg, aux

@@ -54,14 +54,18 @@ class OpDef:
 
     __slots__ = ("name", "fn", "num_outputs", "differentiable", "creation",
                  "namespaces", "_jit_cache", "doc", "variadic", "backward_fn",
-                 "rng")
+                 "rng", "aux_inputs")
 
     def __init__(self, name: str, fn: Callable, num_outputs=1,
                  differentiable: bool = True, creation: bool = False,
                  namespaces: Sequence[str] = ("op",), variadic: bool = False,
                  backward_fn: Optional[Callable] = None, doc: str = "",
-                 rng: bool = False):
+                 rng: bool = False, aux_inputs: Sequence[int] = ()):
         self.rng = rng
+        # input slots that are auxiliary states in symbolic graphs
+        # (ref: OperatorProperty::ListAuxiliaryStates — e.g. BatchNorm's
+        # moving_mean/moving_var)
+        self.aux_inputs = tuple(aux_inputs)
         self.name = name
         self.fn = fn
         self.num_outputs = num_outputs

@@ -1,0 +1,25 @@
+"""mx.sym — the symbolic API (ref: python/mxnet/symbol/__init__.py)."""
+import sys
+import types
+
+from .symbol import Symbol, var, Variable, Group, load, load_json  # noqa: F401
+from .. import ops as _ops_pkg  # noqa: F401  (ensure registration)
+from . import register as _register
+
+_this = sys.modules[__name__]
+_subnames = ["random", "linalg", "contrib", "_internal", "op", "sparse"]
+_submodules = {}
+for _n in _subnames:
+    _m = types.ModuleType(__name__ + "." + _n)
+    sys.modules[__name__ + "." + _n] = _m
+    setattr(_this, _n, _m)
+    _submodules[_n] = _m
+
+_register.populate(_this, _submodules)
+
+from .symbol import var, Variable, Group, load, load_json  # noqa: F401,E402
+from .executor import Executor  # noqa: F401,E402
+
+# mark BatchNorm aux inputs for symbolic graphs
+from ..ops import registry as _reg
+_reg.get_op("BatchNorm").aux_inputs = (3, 4)

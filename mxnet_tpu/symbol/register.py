@@ -1,0 +1,52 @@
+"""Generated symbolic op namespace (ref: python/mxnet/symbol/register.py)."""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from ..ops import registry as _reg
+from . import symbol as _sym
+
+
+def make_sym_function(name: str, opdef):
+    def generic(*args, **kwargs):
+        node_name = kwargs.pop("name", None)
+        kwargs.pop("attr", None)
+        inputs = []
+        params: Dict[str, Any] = {}
+        from ..base import MXNetError
+        for a in args:
+            if isinstance(a, _sym.Symbol):
+                inputs.append(a)
+            else:
+                raise MXNetError(f"sym.{name}: positional args must be "
+                                 "Symbols; pass parameters as keywords")
+        for k, v in kwargs.items():
+            if isinstance(v, _sym.Symbol):
+                inputs.append(v)
+            else:
+                params[k] = v
+        return _sym.create(name, inputs, params, name=node_name)
+
+    generic.__name__ = name
+    generic.__doc__ = opdef.doc
+    generic.__module__ = "mxnet_tpu.symbol.op"
+    return generic
+
+
+def populate(target_module, submodules: Dict[str, Any]) -> None:
+    for name in _reg.list_ops():
+        opdef = _reg.get_op(name)
+        fn = make_sym_function(name, opdef)
+        if name.startswith("_contrib_"):
+            setattr(submodules["contrib"], name[len("_contrib_"):], fn)
+        elif name.startswith("_linalg_"):
+            setattr(submodules["linalg"], name[len("_linalg_"):], fn)
+        if name.startswith("_"):
+            setattr(submodules["_internal"], name, fn)
+            if name.startswith("_random_"):
+                setattr(submodules["random"], name[len("_random_"):], fn)
+            elif name.startswith("_sample_"):
+                setattr(submodules["random"], name[len("_sample_"):], fn)
+        else:
+            setattr(target_module, name, fn)
+        setattr(submodules["op"], name, fn)

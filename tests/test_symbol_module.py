@@ -1,0 +1,189 @@
+"""Symbol/Executor/Module tests (model: tests/python/unittest/test_symbol.py,
+test_module.py, tests/python/train/test_mlp.py — BASELINE config #1 shape)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.io import NDArrayIter, DataBatch
+from mxnet_tpu.module import Module, BucketingModule
+
+
+def _mlp_symbol(num_hidden=32, num_classes=10):
+    data = sym.var("data")
+    net = sym.FullyConnected(data, name="fc1", num_hidden=num_hidden)
+    net = sym.Activation(net, name="relu1", act_type="relu")
+    net = sym.FullyConnected(net, name="fc2", num_hidden=num_classes)
+    label = sym.var("softmax_label")
+    return sym.SoftmaxOutput(net, label, name="softmax")
+
+
+def test_symbol_compose_and_lists():
+    s = _mlp_symbol()
+    args = s.list_arguments()
+    assert "data" in args and "softmax_label" in args
+    assert "fc1_weight" not in args  # our sym ops don't auto-create weights
+    # explicit weight vars
+    data = sym.var("data")
+    w = sym.var("w")
+    out = sym.FullyConnected(data, w, num_hidden=4, no_bias=True)
+    assert set(out.list_arguments()) == {"data", "w"}
+    assert out.list_outputs()[0].endswith("_output")
+
+
+def test_symbol_infer_shape():
+    data = sym.var("data")
+    w = sym.var("w")
+    out = sym.FullyConnected(data, w, num_hidden=4, no_bias=True)
+    arg_shapes, out_shapes, _ = out.infer_shape(data=(8, 16), w=(4, 16))
+    assert out_shapes == [(8, 4)]
+    assert arg_shapes[out.list_arguments().index("w")] == (4, 16)
+
+
+def test_symbol_json_roundtrip(tmp_path):
+    s = _mlp_symbol()
+    f = str(tmp_path / "net-symbol.json")
+    s.save(f)
+    s2 = sym.load(f)
+    assert s2.list_arguments() == s.list_arguments()
+    assert s2.list_outputs() == s.list_outputs()
+
+
+def test_executor_forward_backward():
+    data = sym.var("data")
+    w = sym.var("w")
+    out = sym.FullyConnected(data, w, num_hidden=3, no_bias=True)
+    loss = sym.sum(out)
+    x = nd.ones((2, 5))
+    wv = nd.ones((3, 5))
+    ex = loss.bind(mx.cpu(), args={"data": x, "w": wv},
+                   grad_req={"w": "write", "data": "null"})
+    outs = ex.forward(is_train=True)
+    assert float(outs[0].asscalar()) == 30.0
+    ex.backward()
+    assert np.allclose(ex.grad_dict["w"].asnumpy(), 2.0)
+
+
+def test_executor_simple_bind():
+    s = _mlp_symbol()
+    # give weight vars explicit names via generated symbols
+    data = sym.var("data")
+    fc1_w = sym.var("fc1_weight")
+    fc1_b = sym.var("fc1_bias")
+    net = sym.FullyConnected(data, fc1_w, fc1_b, num_hidden=8)
+    label = sym.var("softmax_label")
+    net = sym.SoftmaxOutput(net, label)
+    ex = net.simple_bind(mx.cpu(), data=(4, 6), softmax_label=(4,))
+    assert ex.arg_dict["fc1_weight"].shape == (8, 6)
+    ex.arg_dict["data"]._rebind(nd.ones((4, 6))._data)
+    outs = ex.forward(is_train=False)
+    assert outs[0].shape == (4, 8)
+
+
+def _make_symbol_with_vars(num_hidden, num_classes):
+    data = sym.var("data")
+    w1, b1 = sym.var("fc1_weight"), sym.var("fc1_bias")
+    h = sym.FullyConnected(data, w1, b1, num_hidden=num_hidden, name="fc1")
+    h = sym.Activation(h, act_type="relu")
+    w2, b2 = sym.var("fc2_weight"), sym.var("fc2_bias")
+    h = sym.FullyConnected(h, w2, b2, num_hidden=num_classes, name="fc2")
+    return sym.SoftmaxOutput(h, sym.var("softmax_label"), name="softmax")
+
+
+def _synthetic_mnist(n=512, d=16, classes=10, seed=0):
+    rs = np.random.RandomState(seed)
+    centers = rs.randn(classes, d).astype(np.float32) * 3
+    labels = rs.randint(0, classes, n)
+    data = centers[labels] + rs.randn(n, d).astype(np.float32)
+    return data, labels.astype(np.float32)
+
+
+def test_module_train_converges():
+    data, labels = _synthetic_mnist()
+    train = NDArrayIter(data, labels, batch_size=64, shuffle=True)
+    net = _make_symbol_with_vars(32, 10)
+    mod = Module(net, context=mx.cpu())
+    mod.fit(train, num_epoch=5, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5},
+            eval_metric="acc")
+    score = mod.score(train, "acc")
+    acc = dict(score)["accuracy"]
+    assert acc > 0.85, f"module training failed to converge: acc={acc}"
+
+
+def test_module_predict_and_checkpoint(tmp_path):
+    data, labels = _synthetic_mnist(128)
+    it = NDArrayIter(data, labels, batch_size=32)
+    net = _make_symbol_with_vars(16, 10)
+    mod = Module(net, context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    preds = mod.predict(it)
+    assert preds.shape == (128, 10)
+    prefix = str(tmp_path / "model")
+    mod.save_checkpoint(prefix, 3)
+    mod2 = Module.load(prefix, 3, context=mx.cpu())
+    mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod2.init_params()
+    preds2 = mod2.predict(it)
+    assert np.allclose(preds.asnumpy(), preds2.asnumpy(), atol=1e-5)
+
+
+def test_module_batchnorm_aux_states():
+    data = sym.var("data")
+    g, b = sym.var("gamma"), sym.var("beta")
+    out, _, _ = tuple(sym.BatchNorm(data, g, b, fix_gamma=False,
+                                    name="bn"))[0:1] + (None, None)
+    net = sym.Group([sym.BatchNorm(data, g, b, fix_gamma=False, name="bn2")[0]])
+    assert "bn2_moving_mean" in net.list_auxiliary_states()
+    assert "bn2_moving_var" in net.list_auxiliary_states()
+    ex = net.simple_bind(mx.cpu(), data=(8, 4), gamma=(4,), beta=(4,))
+    # init aux to identity transform
+    ex.aux_dict["bn2_moving_var"]._rebind(nd.ones((4,))._data)
+    ex.arg_dict["gamma"]._rebind(nd.ones((4,))._data)
+    ex.arg_dict["data"]._rebind(
+        nd.array(np.random.RandomState(0).randn(8, 4).astype(np.float32) + 7)._data)
+    mm0 = ex.aux_dict["bn2_moving_mean"].asnumpy().copy()
+    ex.forward(is_train=True)
+    _ = ex.outputs
+    mm1 = ex.aux_dict["bn2_moving_mean"].asnumpy()
+    assert not np.allclose(mm0, mm1), "aux moving_mean should update in train"
+
+
+def test_bucketing_module():
+    def sym_gen(seq_len):
+        # weight is bucket-independent (applied per time step); buckets
+        # differ only in sequence length — the real RNN bucketing shape
+        data = sym.var("data")
+        w = sym.var("w")
+        h = sym.FullyConnected(data, w, num_hidden=4, no_bias=True,
+                               flatten=False)
+        h = sym.reshape(h, shape=(-3, 4))
+        out = sym.SoftmaxOutput(h, sym.var("softmax_label"))
+        return out, ("data",), ("softmax_label",)
+
+    bm = BucketingModule(sym_gen, default_bucket_key=8)
+    bm.bind(data_shapes=[("data", (2, 8, 6))],
+            label_shapes=[("softmax_label", (16,))])
+    bm.init_params()
+    bm.init_optimizer(optimizer="sgd")
+    for key, n in [(8, 8), (4, 4), (8, 8)]:
+        batch = DataBatch([nd.ones((2, n, 6))], [nd.zeros((2 * n,))],
+                          bucket_key=key)
+        bm.forward(batch, is_train=True)
+        bm.backward()
+        bm.update()
+    # weights shared: bucket 4 and 8 use same param arrays
+    w8 = bm._buckets[8]._exec.arg_dict["w"]
+    w4 = bm._buckets[4]._exec.arg_dict["w"]
+    assert w8 is w4
+
+
+def test_grouped_symbol():
+    a = sym.var("a")
+    b = sym.var("b")
+    g = sym.Group([a + b, a * b])
+    ex = g.bind(mx.cpu(), args={"a": nd.array([2.0]), "b": nd.array([3.0])})
+    outs = ex.forward()
+    assert float(outs[0].asscalar()) == 5.0
+    assert float(outs[1].asscalar()) == 6.0
