@@ -1,0 +1,93 @@
+"""Benchmark: ResNet-50 training throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline: 298.51 img/s — MXNet ResNet-50 training, batch 32 fp32, 1x V100
+(BASELINE.md / docs/faq/perf.md:227-237). The whole train step (fwd+bwd+SGD
+momentum update, bf16 compute) is one fused XLA program with donated buffers.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE_IMGS_PER_SEC = 298.51
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def run(batch=128, warmup=3, iters=10, dtype="bfloat16"):
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn, loss as gloss
+    from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+    from mxnet_tpu.parallel import SPMDTrainer
+    from mxnet_tpu import nd
+
+    mx.random.seed(0)
+    net = resnet50_v1()
+    net.initialize(mx.init.Xavier())
+
+    trainer = SPMDTrainer(net, gloss.SoftmaxCrossEntropyLoss(),
+                          mesh=None, optimizer="sgd",
+                          optimizer_params={"learning_rate": 0.05,
+                                            "momentum": 0.9},
+                          dtype=jnp.bfloat16 if dtype == "bfloat16" else None)
+
+    rs = np.random.RandomState(0)
+    data = jnp.asarray(rs.randn(batch, 3, 224, 224).astype(np.float32))
+    label = jnp.asarray(rs.randint(0, 1000, batch).astype(np.float32))
+
+    log(f"compiling train step (batch={batch}, {dtype}) ...")
+    t0 = time.time()
+    loss = trainer.step(data, label)
+    loss.block_until_ready()
+    log(f"first step (compile) took {time.time() - t0:.1f}s, "
+        f"loss={float(loss):.3f}")
+    for _ in range(warmup - 1):
+        loss = trainer.step(data, label)
+    loss.block_until_ready()
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = trainer.step(data, label)
+    loss.block_until_ready()
+    dt = time.perf_counter() - t0
+    imgs_per_sec = batch * iters / dt
+    log(f"{imgs_per_sec:.1f} img/s over {iters} steps "
+        f"({dt / iters * 1000:.1f} ms/step)")
+    return imgs_per_sec
+
+
+def main():
+    batches = [128, 64, 32]
+    last_err = None
+    for batch in batches:
+        try:
+            value = run(batch=batch)
+            print(json.dumps({
+                "metric": "resnet50_train_imgs_per_sec",
+                "value": round(value, 2),
+                "unit": "img/s",
+                "vs_baseline": round(value / BASELINE_IMGS_PER_SEC, 3),
+            }))
+            return
+        except Exception as e:  # OOM or backend issue: try smaller batch
+            last_err = e
+            log(f"batch {batch} failed: {e}")
+    print(json.dumps({
+        "metric": "resnet50_train_imgs_per_sec",
+        "value": 0.0,
+        "unit": "img/s",
+        "vs_baseline": 0.0,
+        "error": str(last_err)[:200],
+    }))
+
+
+if __name__ == "__main__":
+    main()

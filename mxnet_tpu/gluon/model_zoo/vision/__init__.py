@@ -1,0 +1,45 @@
+"""Gluon model zoo (ref: python/mxnet/gluon/model_zoo/vision/__init__.py)."""
+from .resnet import *  # noqa: F401,F403
+from .alexnet import alexnet  # noqa: F401
+from .vgg import (vgg11, vgg13, vgg16, vgg19, vgg11_bn, vgg13_bn,  # noqa
+                  vgg16_bn, vgg19_bn, VGG)
+from .squeezenet import squeezenet1_0, squeezenet1_1, SqueezeNet  # noqa
+from .mobilenet import (mobilenet1_0, mobilenet0_75, mobilenet0_5,  # noqa
+                        mobilenet0_25, mobilenet_v2_1_0, MobileNet,
+                        MobileNetV2)
+from .densenet import (densenet121, densenet161, densenet169,  # noqa
+                       densenet201, DenseNet)
+
+from ....base import MXNetError
+
+_models = {}
+
+
+def _register_models():
+    import sys
+    mod = sys.modules[__name__]
+    for name in ["resnet18_v1", "resnet34_v1", "resnet50_v1", "resnet101_v1",
+                 "resnet152_v1", "resnet18_v2", "resnet34_v2", "resnet50_v2",
+                 "resnet101_v2", "resnet152_v2", "alexnet", "vgg11", "vgg13",
+                 "vgg16", "vgg19", "vgg11_bn", "vgg13_bn", "vgg16_bn",
+                 "vgg19_bn", "squeezenet1.0", "squeezenet1.1",
+                 "mobilenet1.0", "mobilenet0.75", "mobilenet0.5",
+                 "mobilenet0.25", "mobilenetv2_1.0", "densenet121",
+                 "densenet161", "densenet169", "densenet201"]:
+        attr = name.replace(".", "_").replace("squeezenet1_0", "squeezenet1_0")
+        fn = getattr(mod, attr, None)
+        if fn is None and name.startswith("mobilenetv2"):
+            fn = getattr(mod, "mobilenet_v2_1_0", None)
+        if fn is not None:
+            _models[name] = fn
+
+
+def get_model(name, **kwargs):
+    """(ref: model_zoo/__init__.py get_model)"""
+    if not _models:
+        _register_models()
+    name = name.lower()
+    if name not in _models:
+        raise MXNetError(
+            f"model {name!r} not in zoo: {sorted(_models)}")
+    return _models[name](**kwargs)

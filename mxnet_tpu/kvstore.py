@@ -1,0 +1,284 @@
+"""KVStore: the data-parallel communication abstraction.
+
+Reference: include/mxnet/kvstore.h (Init/Push/Pull/PullRowSparse/Barrier/
+rank/num_workers + string factory kvstore.cc:40-75) with implementations
+KVStoreLocal/CommCPU/CommDevice (src/kvstore/kvstore_local.h, comm.h), NCCL
+(kvstore_nccl.h) and the ps-lite parameter server (kvstore_dist.h,
+kvstore_dist_server.h).
+
+TPU-native redesign (SURVEY.md §5.8): the API is preserved so Trainer/Module
+code is unchanged, but every transport collapses onto XLA collectives:
+
+- 'local'/'device': in-process reduction. Multi-device values are merged
+  with one fused jit program (the CommDevice analog); XLA handles placement.
+- 'dist_tpu_sync' (also answers to 'dist_sync'/'dist_device_sync'/'dist'):
+  synchronous data parallelism over the mesh. rank/size come from the JAX
+  distributed runtime (process_index/process_count) — the ps-lite
+  scheduler/Postoffice collapses into JAX's coordination service. Push is
+  an allreduce ridden on ICI/DCN by GSPMD; there are no server processes to
+  shard keys across (EncodeDefaultKey key-chopping is obsolete: collectives
+  are already bandwidth-optimal on the torus).
+- 'dist_async' maps to the same sync collectives (documented emulation —
+  SURVEY.md §2.3 decision matrix): async PS staleness has no profitable
+  analog when collectives are this fast.
+
+The optimizer-on-server story (MXKVStoreSetUpdater) is preserved:
+set_optimizer installs an updater and push then updates stored weights
+in place, exactly like kvstore_dist_server.h:346 ApplyUpdates.
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from .base import MXNetError, check
+from .ndarray import ndarray as _nd
+
+__all__ = ["KVStore", "KVStoreLocal", "KVStoreDistTPU", "create"]
+
+
+def _group(keys, values):
+    """Normalize (key(s), value(s)) into [(key, [vals...])]
+    (ref: KVStoreLocal::GroupKVPairs)."""
+    if isinstance(keys, (list, tuple)):
+        check(len(keys) == len(values), "key/value count mismatch")
+        out = []
+        for k, v in zip(keys, values):
+            out.extend(_group(k, v))
+        return out
+    if isinstance(values, (list, tuple)):
+        return [(keys, list(values))]
+    return [(keys, [values])]
+
+
+class KVStoreBase:
+    """Common surface (ref: include/mxnet/kvstore.h)."""
+
+    def __init__(self):
+        self._store: Dict[Any, _nd.NDArray] = {}
+        self._updater = None
+        self._optimizer = None
+        self._compression_params = None
+
+    # -- identity -------------------------------------------------------
+    @property
+    def type(self) -> str:
+        raise NotImplementedError
+
+    @property
+    def rank(self) -> int:
+        return 0
+
+    @property
+    def num_workers(self) -> int:
+        return 1
+
+    @property
+    def num_devices(self) -> int:
+        import jax
+        return len(jax.devices())
+
+    # -- core ops -------------------------------------------------------
+    def init(self, key, value) -> None:
+        for k, vals in _group(key, value):
+            if k in self._store:
+                continue
+            v = vals[0]
+            self._store[k] = v.copy() if isinstance(v, _nd.NDArray) \
+                else _nd.array(v)
+
+    def _merge(self, vals: List[_nd.NDArray]) -> _nd.NDArray:
+        """Sum a list of per-device values with one fused program
+        (ref: CommDevice::Reduce, src/kvstore/comm.h:503)."""
+        if len(vals) == 1:
+            return vals[0]
+        import jax
+        arrays = [v._data for v in vals]
+        total = jax.jit(lambda xs: sum(xs[1:], xs[0]))(arrays)
+        return _nd.NDArray(total, ctx=vals[0]._ctx)
+
+    def push(self, key, value, priority: int = 0) -> None:
+        for k, vals in _group(key, value):
+            check(k in self._store, f"kvstore key {k} not initialized")
+            merged = self._merge(vals)
+            merged = self._reduce_global(merged)
+            if self._updater is not None:
+                self._updater(_key_int(k), merged, self._store[k])
+            else:
+                self._store[k]._rebind(merged._data)
+
+    def pull(self, key, out=None, priority: int = 0,
+             ignore_sparse: bool = True) -> None:
+        check(out is not None, "pull requires out=")
+        for k, outs in _group(key, out):
+            check(k in self._store, f"kvstore key {k} not initialized")
+            src = self._store[k]
+            for o in outs:
+                o._rebind(src.as_in_context(o.context)._data)
+
+    def pushpull(self, key, value, out=None, priority: int = 0) -> None:
+        self.push(key, value, priority)
+        if out is not None:
+            self.pull(key, out, priority)
+
+    def row_sparse_pull(self, key, out=None, priority: int = 0,
+                        row_ids=None) -> None:
+        """Pull only the rows named by row_ids (ref: KVStore::PullRowSparse,
+        kvstore.h:209 — the sharded-embedding access path)."""
+        check(out is not None and row_ids is not None,
+              "row_sparse_pull requires out= and row_ids=")
+        if not isinstance(out, (list, tuple)):
+            out = [out]
+        if not isinstance(row_ids, (list, tuple)):
+            row_ids = [row_ids] * len(out)
+        src = self._store[key if not isinstance(key, (list, tuple)) else key[0]]
+        from .ndarray import sparse as _sp
+        for o, rid in zip(out, row_ids):
+            rows = _nd.imperative_invoke("take", (src, rid),
+                                         {"axis": 0, "mode": "clip"})
+            if isinstance(o, _sp.RowSparseNDArray):
+                o._update(rows._data, rid._data)
+            else:
+                o._rebind(rows._data)
+
+    # -- optimizer / updater -------------------------------------------
+    def set_updater(self, updater) -> None:
+        self._updater = updater
+
+    def _set_updater(self, updater) -> None:
+        self._updater = updater
+
+    def set_optimizer(self, optimizer) -> None:
+        """Ship the optimizer 'to the server' (ref: MXKVStoreSetUpdater +
+        pickled-optimizer command, python/mxnet/kvstore.py). Here the
+        'server' is this process: push applies updates in place."""
+        from . import optimizer as opt_mod
+        # round-trip through pickle to mirror reference semantics (the
+        # optimizer state must be serializable to reach servers)
+        optimizer = pickle.loads(pickle.dumps(optimizer))
+        self._optimizer = optimizer
+        self._updater = opt_mod.get_updater(optimizer)
+
+    def set_gradient_compression(self, compression_params) -> None:
+        self._compression_params = dict(compression_params)
+
+    def save_optimizer_states(self, fname, dump_optimizer=False) -> None:
+        check(self._updater is not None, "no optimizer set")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname) -> None:
+        check(self._updater is not None, "no optimizer set")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    # -- distributed hooks ---------------------------------------------
+    def _reduce_global(self, merged: _nd.NDArray) -> _nd.NDArray:
+        return merged
+
+    def barrier(self) -> None:
+        from .parallel.collectives import barrier as _barrier
+        _barrier()
+
+    def _send_command_to_servers(self, head, body) -> None:
+        pass
+
+
+def _key_int(k):
+    try:
+        return int(k)
+    except (TypeError, ValueError):
+        return k
+
+
+class KVStoreLocal(KVStoreBase):
+    """In-process store (ref: src/kvstore/kvstore_local.h:69)."""
+
+    def __init__(self, contexts=None):
+        super().__init__()
+
+    @property
+    def type(self):
+        return "local"
+
+
+class KVStoreDevice(KVStoreLocal):
+    """Device-resident merge (ref: CommDevice; NCCL type folds in here —
+    XLA owns the reduction algorithm on TPU)."""
+
+    @property
+    def type(self):
+        return "device"
+
+
+class KVStoreDistTPU(KVStoreBase):
+    """Synchronous distributed KVStore over the TPU mesh
+    (the BASELINE north star's `dist_tpu_sync`).
+
+    Cross-process (multi-host) reduction uses jax.distributed global arrays;
+    single-process multi-device values are already merged by _merge. The
+    worker/server/scheduler role split of ps-lite collapses: every process
+    is a worker, reduction is a collective, rendezvous is JAX's coordination
+    service (jax.distributed.initialize from env/args — the DMLC_ROLE env
+    protocol of tools/launch.py maps onto it).
+    """
+
+    def __init__(self, contexts=None):
+        super().__init__()
+        import jax
+        self._nproc = jax.process_count()
+        self._rank = jax.process_index()
+        self._mesh = None
+        if self._nproc > 1:
+            from .parallel import make_mesh
+            self._mesh = make_mesh({"hosts": self._nproc * 0 + -1})
+
+    @property
+    def type(self):
+        return "dist_tpu_sync"
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._nproc
+
+    def _reduce_global(self, merged: _nd.NDArray) -> _nd.NDArray:
+        if self._mesh is None:
+            return merged
+        from .parallel.collectives import allreduce
+        return _nd.NDArray(allreduce(merged._data, self._mesh, axis="hosts"),
+                           ctx=merged._ctx)
+
+    def barrier(self) -> None:
+        from .parallel.collectives import barrier as _barrier
+        _barrier(self._mesh)
+
+
+KVStore = KVStoreBase  # surface alias (ref: python/mxnet/kvstore.py KVStore)
+
+_TYPES = {
+    "local": KVStoreLocal,
+    "local_update_cpu": KVStoreLocal,
+    "local_allreduce_cpu": KVStoreLocal,
+    "device": KVStoreDevice,
+    "local_allreduce_device": KVStoreDevice,
+    "nccl": KVStoreDevice,          # NCCL reduction -> XLA collectives
+    "dist": KVStoreDistTPU,
+    "dist_sync": KVStoreDistTPU,
+    "dist_device_sync": KVStoreDistTPU,
+    "dist_sync_device": KVStoreDistTPU,
+    "dist_async": KVStoreDistTPU,   # documented sync emulation
+    "dist_tpu_sync": KVStoreDistTPU,
+}
+
+
+def create(name: str = "local") -> KVStoreBase:
+    """String factory (ref: src/kvstore/kvstore.cc:40-75)."""
+    check(isinstance(name, str), "kvstore name must be a string")
+    key = name.lower()
+    if key not in _TYPES:
+        raise MXNetError(f"unknown KVStore type {name!r}")
+    return _TYPES[key]()

@@ -1,0 +1,6 @@
+"""Model families: mesh-native flagships (transformer LM) plus re-exports of
+the Gluon vision zoo (ref: python/mxnet/gluon/model_zoo)."""
+from . import transformer  # noqa: F401
+from ..gluon.model_zoo.vision import (  # noqa: F401
+    get_resnet, resnet50_v1, resnet18_v1, resnet101_v1, resnet152_v1,
+    alexnet, vgg16, get_model)

@@ -1,0 +1,221 @@
+"""Transformer LM: the long-context / distributed flagship.
+
+The reference's sequence-model story is the fused cuDNN RNN + bucketing
+(src/operator/rnn.cc, example/rnn/word_lm); the TPU-native framework adds a
+transformer family designed for the mesh from day one:
+
+- weights carry Megatron-style tp shardings (column/row parallel),
+- activations are sharded (dp, sp, -) with explicit constraints,
+- attention runs as ring attention over the 'sp' axis for long context
+  (parallel/ring_attention.py) or plain attention when sp=1,
+- the train step is ONE pjit'd program: loss, psum'd grads (inserted by
+  GSPMD), and optimizer update fused.
+
+Pure-jax parameter pytree (not Gluon Blocks) so every tensor can carry a
+PartitionSpec; the Gluon layer zoo covers the eager/imperative use case.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+__all__ = ["TransformerConfig", "init_params", "forward", "loss_fn",
+           "make_train_step", "param_specs"]
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 4
+    d_ff: int = 2048
+    max_seq_len: int = 2048
+    dtype: Any = None  # e.g. jnp.bfloat16 for MXU-friendly compute
+    causal: bool = True
+    remat: bool = False  # jax.checkpoint each layer (HBM <-> FLOPs trade)
+
+
+def _dt(config):
+    import jax.numpy as jnp
+    return config.dtype or jnp.float32
+
+
+def init_params(key, config: TransformerConfig) -> Dict[str, Any]:
+    import jax
+    import jax.numpy as jnp
+    k = jax.random.split(key, 2 + config.n_layers)
+    d, h, f = config.d_model, config.n_heads, config.d_ff
+    dt = _dt(config)
+    params: Dict[str, Any] = {
+        "embed": (jax.random.normal(k[0], (config.vocab_size, d)) * 0.02
+                  ).astype(dt),
+        "ln_f_scale": jnp.ones((d,), dt),
+        "ln_f_bias": jnp.zeros((d,), dt),
+    }
+    for i in range(config.n_layers):
+        kk = jax.random.split(k[2 + i], 6)
+        s = 0.02
+        params[f"layer{i}"] = {
+            "ln1_scale": jnp.ones((d,), dt),
+            "ln1_bias": jnp.zeros((d,), dt),
+            "w_qkv": (jax.random.normal(kk[0], (d, 3 * d)) * s).astype(dt),
+            "wo": (jax.random.normal(kk[1], (d, d)) * s /
+                   math.sqrt(2 * config.n_layers)).astype(dt),
+            "ln2_scale": jnp.ones((d,), dt),
+            "ln2_bias": jnp.zeros((d,), dt),
+            "ffn_in": (jax.random.normal(kk[2], (d, f)) * s).astype(dt),
+            "ffn_in_b": jnp.zeros((f,), dt),
+            "ffn_out": (jax.random.normal(kk[3], (f, d)) * s /
+                        math.sqrt(2 * config.n_layers)).astype(dt),
+            "ffn_out_b": jnp.zeros((d,), dt),
+        }
+    return params
+
+
+def param_specs(config: TransformerConfig, mesh) -> Dict[str, Any]:
+    """Megatron-style tp shardings: qkv/ffn_in column-parallel, wo/ffn_out
+    row-parallel; embedding sharded over vocab on tp."""
+    from jax.sharding import PartitionSpec as P
+    has_tp = "tp" in mesh.axis_names
+    tp = "tp" if has_tp else None
+    vec = P()
+    specs: Dict[str, Any] = {
+        "embed": P(tp, None),
+        "ln_f_scale": vec, "ln_f_bias": vec,
+    }
+    for i in range(config.n_layers):
+        specs[f"layer{i}"] = {
+            "ln1_scale": vec, "ln1_bias": vec,
+            "w_qkv": P(None, tp),
+            "wo": P(tp, None),
+            "ln2_scale": vec, "ln2_bias": vec,
+            "ffn_in": P(None, tp),
+            "ffn_in_b": P(tp),
+            "ffn_out": P(tp, None),
+            "ffn_out_b": vec,
+        }
+    return specs
+
+
+def _layernorm(x, scale, bias, eps=1e-5):
+    import jax.numpy as jnp
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mean) / jnp.sqrt(var + eps)
+    return (y * scale + bias).astype(x.dtype)
+
+
+def _block(x, lp, config: TransformerConfig, mesh, act_spec):
+    import jax
+    import jax.numpy as jnp
+    b, t, d = x.shape
+    h = config.n_heads
+    hd = d // h
+
+    y = _layernorm(x, lp["ln1_scale"], lp["ln1_bias"])
+    qkv = jnp.einsum("btd,de->bte", y, lp["w_qkv"])
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, t, h, hd)
+    k = k.reshape(b, t, h, hd)
+    v = v.reshape(b, t, h, hd)
+    from ..parallel.ring_attention import attention, ring_attention
+    if mesh is not None and "sp" in mesh.axis_names and \
+            dict(zip(mesh.axis_names, mesh.devices.shape))["sp"] > 1:
+        attn = ring_attention(q, k, v, mesh, axis="sp", causal=config.causal)
+    else:
+        attn = attention(q, k, v, causal=config.causal)
+    attn = attn.reshape(b, t, d)
+    x = x + jnp.einsum("btd,de->bte", attn, lp["wo"])
+    if act_spec is not None:
+        x = jax.lax.with_sharding_constraint(x, act_spec)
+
+    y = _layernorm(x, lp["ln2_scale"], lp["ln2_bias"])
+    hdn = jnp.einsum("btd,df->btf", y, lp["ffn_in"]) + lp["ffn_in_b"]
+    hdn = jax.nn.gelu(hdn)
+    x = x + jnp.einsum("btf,fd->btd", hdn, lp["ffn_out"]) + lp["ffn_out_b"]
+    if act_spec is not None:
+        x = jax.lax.with_sharding_constraint(x, act_spec)
+    return x
+
+
+def forward(params, tokens, config: TransformerConfig, mesh=None):
+    """tokens (B, T) int32 -> logits (B, T, vocab)."""
+    import jax
+    import jax.numpy as jnp
+    act_spec = None
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        act_spec = NamedSharding(
+            mesh, P("dp" if "dp" in sizes else None,
+                    "sp" if "sp" in sizes else None, None))
+    x = params["embed"][tokens]  # (B, T, D)
+    # positions: rotary-free learned-less sinusoidal to stay stateless
+    d = config.d_model
+    pos = jnp.arange(tokens.shape[1])[:, None]
+    dim = jnp.arange(d // 2)[None, :]
+    angle = pos / jnp.power(10000.0, 2 * dim / d)
+    pe = jnp.concatenate([jnp.sin(angle), jnp.cos(angle)], axis=-1)
+    x = x + pe[None].astype(x.dtype)
+    if act_spec is not None:
+        x = jax.lax.with_sharding_constraint(x, act_spec)
+
+    blk = _block
+    if config.remat:
+        blk = jax.checkpoint(_block, static_argnums=(2,))
+
+    for i in range(config.n_layers):
+        x = blk(x, params[f"layer{i}"], config, mesh, act_spec)
+    x = _layernorm(x, params["ln_f_scale"], params["ln_f_bias"])
+    logits = jnp.einsum("btd,vd->btv", x, params["embed"])
+    return logits
+
+
+def loss_fn(params, tokens, targets, config: TransformerConfig, mesh=None):
+    import jax
+    import jax.numpy as jnp
+    logits = forward(params, tokens, config, mesh)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return jnp.mean(nll)
+
+
+def make_train_step(config: TransformerConfig, mesh=None, lr: float = 1e-3):
+    """Returns (jitted_step, shard_params_fn). step(params, tokens, targets)
+    -> (loss, new_params). One XLA program: fwd+bwd+sgd, GSPMD collectives."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def step(params, tokens, targets):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets,
+                                                  config, mesh)
+        new_params = jax.tree_util.tree_map(
+            lambda w, g: w - lr * g.astype(w.dtype), params, grads)
+        return loss, new_params
+
+    if mesh is None:
+        return jax.jit(step, donate_argnums=(0,)), lambda p: p
+
+    specs = param_specs(config, mesh)
+    param_shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda s: isinstance(s, P))
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    tok_sharding = NamedSharding(
+        mesh, P("dp" if "dp" in sizes else None,
+                "sp" if "sp" in sizes else None))
+
+    def shard_params(params):
+        return jax.tree_util.tree_map(jax.device_put, params,
+                                      param_shardings)
+
+    jitted = jax.jit(step,
+                     in_shardings=(param_shardings, tok_sharding,
+                                   tok_sharding),
+                     out_shardings=(NamedSharding(mesh, P()),
+                                    param_shardings),
+                     donate_argnums=(0,))
+    return jitted, shard_params

@@ -17,7 +17,7 @@ from . import register as _register
 
 # build sub-namespace modules (mx.nd.random etc.)
 _this = sys.modules[__name__]
-_subnames = ["random", "linalg", "contrib", "_internal", "op", "sparse"]
+_subnames = ["random", "linalg", "contrib", "_internal", "op"]
 _submodules = {}
 for _n in _subnames:
     _m = types.ModuleType(__name__ + "." + _n)
@@ -26,6 +26,9 @@ for _n in _subnames:
     _submodules[_n] = _m
 
 _register.populate(_this, _submodules)
+
+from . import sparse  # noqa: E402,F401
+_submodules["sparse"] = sparse
 
 # creation/builtin helpers that shadow any op with the same name
 from .ndarray import (zeros, ones, full, empty, arange, linspace, eye,  # noqa

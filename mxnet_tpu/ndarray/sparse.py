@@ -1,0 +1,234 @@
+"""Sparse NDArray storage types: row_sparse and csr.
+
+Reference: include/mxnet/ndarray.h:61-82 (kRowSparseStorage/kCSRStorage with
+aux arrays) + python/mxnet/ndarray/sparse.py.
+
+TPU-native: there is no native sparse tensor support in XLA, so these are
+*structured dense* containers — data + index arrays that stay compact in
+HBM — and ops follow the reference's storage-fallback discipline
+(src/common/exec_utils.h): anything without a dedicated sparse kernel
+densifies. The dedicated paths that matter for performance are
+gather/scatter-based: sparse embedding gradients, row_sparse optimizer
+updates, and row_sparse pull (kvstore), all of which map onto XLA
+gather/scatter/segment_sum.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as _np
+
+from ..base import MXNetError, check
+from ..context import Context, current_context
+from . import ndarray as _nd
+
+__all__ = ["BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
+           "row_sparse_array", "csr_matrix", "zeros", "array"]
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+class BaseSparseNDArray:
+    def __init__(self, shape, ctx=None):
+        self._shape = tuple(shape)
+        self._ctx = ctx if ctx is not None else current_context()
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def context(self):
+        return self._ctx
+
+    ctx = context
+
+    @property
+    def dtype(self):
+        return _np.dtype(self._dtype())
+
+    def asnumpy(self) -> _np.ndarray:
+        return _np.asarray(self.todense()._data)
+
+    def wait_to_read(self):
+        pass
+
+    def __repr__(self):
+        return (f"\n<{type(self).__name__} "
+                f"{'x'.join(map(str, self._shape))} @{self._ctx}>")
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """(ref: python/mxnet/ndarray/sparse.py RowSparseNDArray)"""
+
+    stype = "row_sparse"
+
+    def __init__(self, data, indices, shape, ctx=None):
+        super().__init__(shape, ctx)
+        jnp = _jnp()
+        self._data = data if not isinstance(data, _nd.NDArray) else data._data
+        self._indices = indices if not isinstance(indices, _nd.NDArray) \
+            else indices._data
+        self._indices = jnp.asarray(self._indices, dtype=_np.int32)
+
+    def _dtype(self):
+        return self._data.dtype
+
+    @property
+    def data(self) -> _nd.NDArray:
+        return _nd.from_jax(self._data, ctx=self._ctx)
+
+    @property
+    def indices(self) -> _nd.NDArray:
+        return _nd.from_jax(self._indices, ctx=self._ctx)
+
+    def _update(self, data, indices):
+        self._data = data
+        self._indices = indices
+
+    def todense(self) -> _nd.NDArray:
+        jnp = _jnp()
+        out = jnp.zeros(self._shape, self._data.dtype)
+        out = out.at[self._indices].set(self._data)
+        return _nd.from_jax(out, ctx=self._ctx)
+
+    tostype_map = {"default": "todense"}
+
+    def tostype(self, stype):
+        if stype == "default":
+            return self.todense()
+        if stype == "row_sparse":
+            return self
+        raise MXNetError(f"cannot convert row_sparse to {stype}")
+
+    def copyto(self, other):
+        if isinstance(other, RowSparseNDArray):
+            other._update(self._data, self._indices)
+            return other
+        return self.todense().copyto(other)
+
+    def retain(self, row_ids) -> "RowSparseNDArray":
+        """Keep only the listed rows (ref: sparse_retain op)."""
+        jnp = _jnp()
+        rid = row_ids._data if isinstance(row_ids, _nd.NDArray) else row_ids
+        rid = jnp.asarray(rid, _np.int32)
+        dense = self.todense()._data
+        return RowSparseNDArray(dense[rid], rid, self._shape, self._ctx)
+
+    def __add__(self, other):
+        if isinstance(other, RowSparseNDArray):
+            return self.todense() + other.todense()
+        return self.todense() + other
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """(ref: python/mxnet/ndarray/sparse.py CSRNDArray)"""
+
+    stype = "csr"
+
+    def __init__(self, data, indices, indptr, shape, ctx=None):
+        super().__init__(shape, ctx)
+        jnp = _jnp()
+        conv = lambda a: a._data if isinstance(a, _nd.NDArray) else jnp.asarray(a)
+        self._data = conv(data)
+        self._indices = jnp.asarray(conv(indices), _np.int32)
+        self._indptr = jnp.asarray(conv(indptr), _np.int32)
+
+    def _dtype(self):
+        return self._data.dtype
+
+    @property
+    def data(self):
+        return _nd.from_jax(self._data, ctx=self._ctx)
+
+    @property
+    def indices(self):
+        return _nd.from_jax(self._indices, ctx=self._ctx)
+
+    @property
+    def indptr(self):
+        return _nd.from_jax(self._indptr, ctx=self._ctx)
+
+    def todense(self) -> _nd.NDArray:
+        jnp = _jnp()
+        rows, cols = self._shape
+        # expand indptr -> row ids via searchsorted (static-shape friendly)
+        nnz = self._data.shape[0]
+        row_ids = jnp.searchsorted(self._indptr[1:],
+                                   jnp.arange(nnz), side="right")
+        out = jnp.zeros((rows, cols), self._data.dtype)
+        out = out.at[row_ids, self._indices].set(self._data)
+        return _nd.from_jax(out, ctx=self._ctx)
+
+    def tostype(self, stype):
+        if stype == "default":
+            return self.todense()
+        if stype == "csr":
+            return self
+        raise MXNetError(f"cannot convert csr to {stype}")
+
+    def __getitem__(self, idx):
+        return self.todense()[idx]
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """(ref: mx.nd.sparse.row_sparse_array)"""
+    jnp = _jnp()
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        data = _nd.array(data, dtype=dtype)._data
+        indices = jnp.asarray(_np.asarray(indices), _np.int32)
+        check(shape is not None, "shape required")
+        return RowSparseNDArray(data, indices, shape, ctx)
+    # from dense
+    dense = _nd.array(arg1, dtype=dtype)
+    np_d = dense.asnumpy()
+    nz_rows = _np.where(_np.any(np_d != 0, axis=tuple(range(1, np_d.ndim))))[0]
+    return RowSparseNDArray(jnp.asarray(np_d[nz_rows]),
+                            jnp.asarray(nz_rows, _np.int32),
+                            np_d.shape, ctx)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """(ref: mx.nd.sparse.csr_matrix)"""
+    jnp = _jnp()
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        check(shape is not None, "shape required")
+        return CSRNDArray(_nd.array(data, dtype=dtype)._data,
+                          _np.asarray(indices), _np.asarray(indptr),
+                          shape, ctx)
+    dense = _np.asarray(arg1, dtype=dtype or _np.float32)
+    check(dense.ndim == 2, "csr requires 2D")
+    indptr = [0]
+    indices = []
+    data = []
+    for r in range(dense.shape[0]):
+        cols = _np.nonzero(dense[r])[0]
+        indices.extend(cols.tolist())
+        data.extend(dense[r, cols].tolist())
+        indptr.append(len(indices))
+    return CSRNDArray(jnp.asarray(_np.asarray(data, dense.dtype)),
+                      _np.asarray(indices, _np.int32),
+                      _np.asarray(indptr, _np.int32), dense.shape, ctx)
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    jnp = _jnp()
+    dtype = _np.dtype(dtype or _np.float32)
+    if stype == "row_sparse":
+        return RowSparseNDArray(jnp.zeros((0,) + tuple(shape[1:]), dtype),
+                                jnp.zeros((0,), _np.int32), shape, ctx)
+    if stype == "csr":
+        return CSRNDArray(jnp.zeros((0,), dtype), jnp.zeros((0,), _np.int32),
+                          jnp.zeros((shape[0] + 1,), _np.int32), shape, ctx)
+    return _nd.zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def array(source, ctx=None, dtype=None):
+    if isinstance(source, (RowSparseNDArray, CSRNDArray)):
+        return source
+    return _nd.array(source, ctx=ctx, dtype=dtype)

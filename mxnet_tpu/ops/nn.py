@@ -202,16 +202,21 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     ax = axis % data.ndim
     red = tuple(i for i in range(data.ndim) if i != ax)
     bshape = tuple(data.shape[ax] if i == ax else 1 for i in range(data.ndim))
-    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    # statistics always in f32 (bf16 inputs would lose too much precision;
+    # matches the reference's fp16 BatchNorm running in fp32 internally)
+    x32 = data.astype(jnp.float32)
+    g = jnp.ones(gamma.shape, jnp.float32) if fix_gamma \
+        else gamma.astype(jnp.float32)
     if _training and not use_global_stats:
-        mean = jnp.mean(data, axis=red)
-        var = jnp.var(data, axis=red)
+        mean = jnp.mean(x32, axis=red)
+        var = jnp.var(x32, axis=red)
     else:
-        mean, var = moving_mean, moving_var
+        mean = moving_mean.astype(jnp.float32)
+        var = moving_var.astype(jnp.float32)
     inv = _lax().rsqrt(var + eps)
-    out = (data - mean.reshape(bshape)) * (inv * g).reshape(bshape) \
-        + beta.reshape(bshape)
-    return out, mean, var
+    out = (x32 - mean.reshape(bshape)) * (inv * g).reshape(bshape) \
+        + beta.astype(jnp.float32).reshape(bshape)
+    return out.astype(data.dtype), mean, var
 
 
 @register("LayerNorm", aliases=("layer_norm",), num_outputs=3)
@@ -317,18 +322,21 @@ def _leaky_relu(data, *maybe_gamma, act_type="leaky", slope=0.25,
 def _softmax(data, *maybe_length, axis=-1, temperature=None, dtype=None,
              use_length=False):
     import jax
+    jnp = _jnp()
     x = data if temperature in (None, 1.0) else data / temperature
-    out = jax.nn.softmax(x, axis=axis)
-    if dtype is not None:
-        out = out.astype(_np.dtype(dtype))
+    out = jax.nn.softmax(x.astype(jnp.float32), axis=axis)
+    out = out.astype(_np.dtype(dtype)) if dtype is not None \
+        else out.astype(data.dtype)
     return out
 
 
 @register("log_softmax")
 def _log_softmax(data, axis=-1, temperature=None, dtype=None, use_length=False):
     import jax
+    jnp = _jnp()
     x = data if temperature in (None, 1.0) else data / temperature
-    return jax.nn.log_softmax(x, axis=axis)
+    return jax.nn.log_softmax(x.astype(jnp.float32),
+                              axis=axis).astype(data.dtype)
 
 
 @register("softmin")

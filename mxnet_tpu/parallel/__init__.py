@@ -1,0 +1,12 @@
+"""Parallelism toolkit: mesh, collectives, shardings, SPMD training, ring
+attention (SURVEY.md §2.3 — the TPU-native mapping of every reference
+communication strategy)."""
+from .mesh import (make_mesh, auto_mesh, local_devices, MeshScope,  # noqa
+                   current_mesh, axis_size)
+from .collectives import (allreduce, allgather, reduce_scatter,  # noqa
+                          broadcast, ppermute_ring, all_to_all, barrier,
+                          device_allreduce, measure_allreduce_bandwidth)
+from .sharding import (P, named_sharding, shard_batch, replicate,  # noqa
+                       ShardingPlan, MP_RULES_TRANSFORMER)
+from .spmd import SPMDTrainer  # noqa: F401
+from .ring_attention import attention, ring_attention  # noqa: F401

@@ -1,0 +1,92 @@
+"""Device-mesh management: the TPU replacement for device lists.
+
+Reference counterpart: the reference enumerates GPUs into per-device
+executors and reduces with kvstore comm trees
+(src/kvstore/comm.h, gpu_topology.h). On TPU the topology is the ICI torus
+and XLA's collectives already know it, so "topology-aware tree reduction"
+(SURVEY.md §2.3) is subsumed: we just declare a ``jax.sharding.Mesh`` and
+let GSPMD place collectives on ICI links.
+
+Axis-name conventions used across the framework:
+  dp = data parallel, tp = tensor parallel, pp = pipeline stage,
+  sp = sequence/context parallel, ep = expert parallel.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as _np
+
+from ..base import MXNetError, check
+
+__all__ = ["make_mesh", "auto_mesh", "local_devices", "MeshScope",
+           "current_mesh", "axis_size"]
+
+_CURRENT: list = []
+
+
+def local_devices():
+    import jax
+    return jax.devices()
+
+
+def make_mesh(axes: Dict[str, int], devices=None):
+    """Build a Mesh from {axis_name: size}. Sizes must multiply to the
+    device count (one axis may be -1 = infer)."""
+    import jax
+    from jax.sharding import Mesh
+    devices = list(devices if devices is not None else jax.devices())
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    n = len(devices)
+    if -1 in sizes:
+        known = int(_np.prod([s for s in sizes if s != -1]))
+        check(n % known == 0, f"cannot infer mesh axis: {n} devices, {axes}")
+        sizes[sizes.index(-1)] = n // known
+    total = int(_np.prod(sizes))
+    check(total <= n,
+          f"mesh {dict(zip(names, sizes))} needs {total} devices, have {n}")
+    dev_array = _np.asarray(devices[:total]).reshape(sizes)
+    return Mesh(dev_array, tuple(names))
+
+
+def auto_mesh(n_devices: Optional[int] = None,
+              prefer: Sequence[str] = ("dp", "tp")) -> "jax.sharding.Mesh":
+    """Sensible default mesh: split devices between dp and tp (tp innermost
+    so tensor-parallel collectives ride the fastest links)."""
+    import jax
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    devices = devices[:n]
+    tp = 1
+    for cand in (4, 2):
+        if n % cand == 0 and n // cand >= 1 and len(prefer) > 1:
+            tp = cand
+            break
+    dp = n // tp
+    axes = {prefer[0]: dp}
+    if len(prefer) > 1:
+        axes[prefer[1]] = tp
+    return make_mesh(axes, devices)
+
+
+class MeshScope:
+    """Context manager installing a mesh as current."""
+
+    def __init__(self, mesh):
+        self.mesh = mesh
+
+    def __enter__(self):
+        _CURRENT.append(self.mesh)
+        return self.mesh
+
+    def __exit__(self, *a):
+        _CURRENT.pop()
+
+
+def current_mesh():
+    return _CURRENT[-1] if _CURRENT else None
+
+
+def axis_size(mesh, axis: str) -> int:
+    return dict(zip(mesh.axis_names, mesh.devices.shape)).get(axis, 1)

@@ -1,0 +1,102 @@
+"""Ring attention: sequence/context parallelism over the mesh.
+
+Absent in the reference (SURVEY.md §2.3 marks sequence parallelism as a gap
+the TPU design fills "for free"); this provides it as a first-class op: the
+sequence axis is sharded across the 'sp' mesh axis, K/V blocks rotate around
+the ring with ``lax.ppermute`` while each device accumulates its queries'
+attention with a numerically-stable online softmax (blockwise attention, cf.
+Liu et al. 2310.01889). Communication overlaps compute: each step's ppermute
+rides ICI while the current block's QK^T occupies the MXU.
+
+Also provides all_to_all "Ulysses-style" sequence parallelism
+(see collectives.all_to_all) and a plain jax attention for single-device.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+from ..base import MXNetError, check
+
+__all__ = ["attention", "ring_attention"]
+
+
+def attention(q, k, v, causal: bool = False, scale: Optional[float] = None):
+    """Plain softmax attention. q,k,v: (B, T, H, D)."""
+    import jax
+    import jax.numpy as jnp
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bthd,bshd->bhts", q, k) * scale
+    if causal:
+        t, s = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((t, s), bool))
+        logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.einsum("bhts,bshd->bthd", probs.astype(v.dtype), v)
+
+
+def ring_attention(q, k, v, mesh, axis: str = "sp", causal: bool = False,
+                   scale: Optional[float] = None):
+    """Ring attention over sequence-sharded q,k,v of shape (B, T, H, D).
+
+    Inputs are globally-shaped arrays sharded along T on `axis`; the result
+    has the same sharding. The per-device working set is T/n so sequences n×
+    longer than single-chip memory fit.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    n = sizes[axis]
+    if n == 1:
+        return attention(q, k, v, causal=causal, scale=scale)
+    sc = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    # (B, T, H, D): batch rides dp, sequence rides the ring axis, heads ride
+    # tp when present — composes with tensor parallelism transparently.
+    spec = P("dp" if "dp" in sizes else None, axis,
+             "tp" if "tp" in sizes else None, None)
+
+    def local(qb, kb, vb):
+        b, t_loc, h, d = qb.shape
+        my = jax.lax.axis_index(axis)
+        q32 = qb.astype(jnp.float32)
+
+        def body(i, carry):
+            k_cur, v_cur, o, m, l = carry
+            src = (my - i) % n  # who produced the block we currently hold
+            logits = jnp.einsum("bthd,bshd->bhts", q32,
+                                k_cur.astype(jnp.float32)) * sc
+            mask = None
+            if causal:
+                qpos = my * t_loc + jnp.arange(t_loc)
+                kpos = src * t_loc + jnp.arange(t_loc)
+                mask = (qpos[:, None] >= kpos[None, :])[None, None]
+                logits = jnp.where(mask, logits, -1e30)
+            m_blk = jnp.max(logits, axis=-1)
+            m_new = jnp.maximum(m, m_blk)
+            p = jnp.exp(logits - m_new[..., None])
+            if mask is not None:
+                # kill the exp(0)=1 artifact on fully-masked rows
+                p = p * mask.astype(p.dtype)
+            corr = jnp.exp(m - m_new)
+            l_new = l * corr + jnp.sum(p, axis=-1)
+            o_new = o * corr[..., None] + jnp.einsum(
+                "bhts,bshd->bthd", p, v_cur.astype(jnp.float32)
+            ).transpose(0, 2, 1, 3)
+            k_nxt = jax.lax.ppermute(k_cur, axis, perm)
+            v_nxt = jax.lax.ppermute(v_cur, axis, perm)
+            return (k_nxt, v_nxt, o_new, m_new, l_new)
+
+        o0 = jnp.zeros((b, h, t_loc, d), jnp.float32)
+        m0 = jnp.full((b, h, t_loc), -jnp.inf, jnp.float32)
+        l0 = jnp.zeros((b, h, t_loc), jnp.float32)
+        _, _, o, m, l = jax.lax.fori_loop(0, n, body, (kb, vb, o0, m0, l0))
+        out = o / l[..., None]
+        return out.transpose(0, 2, 1, 3).astype(qb.dtype)
+
+    return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)(q, k, v)

@@ -1,0 +1,79 @@
+"""Sharding rules: how logical tensors map onto the mesh.
+
+Replaces the reference's frontend data-parallel plumbing
+(_split_input_slice / DataParallelExecutorGroup batch slicing,
+python/mxnet/module/executor_group.py:28-56) and the group2ctx model-parallel
+placement (src/executor/graph_executor.cc:898-915): instead of slicing at
+the python layer, arrays carry NamedShardings and GSPMD splits the program.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..base import MXNetError
+
+__all__ = ["P", "named_sharding", "shard_batch", "replicate",
+           "ShardingPlan", "MP_RULES_TRANSFORMER"]
+
+
+def P(*specs):
+    from jax.sharding import PartitionSpec
+    return PartitionSpec(*specs)
+
+
+def named_sharding(mesh, *specs):
+    from jax.sharding import NamedSharding, PartitionSpec
+    return NamedSharding(mesh, PartitionSpec(*specs))
+
+
+def shard_batch(x, mesh, axis: str = "dp", batch_dim: int = 0):
+    """Place a host batch onto the mesh sharded along the batch axis."""
+    import jax
+    spec = [None] * getattr(x, "ndim", len(x.shape))
+    spec[batch_dim] = axis
+    data = x._data if hasattr(x, "_data") else x
+    return jax.device_put(data, named_sharding(mesh, *spec))
+
+
+def replicate(tree, mesh):
+    """Replicate a pytree of arrays onto every device of the mesh."""
+    import jax
+    sh = named_sharding(mesh)
+    return jax.tree_util.tree_map(lambda a: jax.device_put(a, sh), tree)
+
+
+class ShardingPlan:
+    """Regex name -> PartitionSpec rules (the group2ctx analog: declarative
+    placement instead of per-node ctx assignment)."""
+
+    def __init__(self, rules: Sequence[Tuple[str, Any]], default=None):
+        self.rules = [(re.compile(pat), spec) for pat, spec in rules]
+        from jax.sharding import PartitionSpec
+        self.default = default if default is not None else PartitionSpec()
+
+    def spec_for(self, name: str, shape: Tuple[int, ...]):
+        for pat, spec in self.rules:
+            if pat.search(name):
+                if len(spec) > len(shape):
+                    continue
+                return spec
+        return self.default
+
+    def shard_params(self, named_arrays: Dict[str, Any], mesh):
+        import jax
+        from jax.sharding import NamedSharding
+        out = {}
+        for name, arr in named_arrays.items():
+            spec = self.spec_for(name, arr.shape)
+            out[name] = jax.device_put(arr, NamedSharding(mesh, spec))
+        return out
+
+
+# Megatron-style tensor-parallel rules for transformer weights:
+# column-parallel qkv/up projections, row-parallel out/down projections.
+MP_RULES_TRANSFORMER = [
+    (r"(wq|wk|wv|w_qkv|query|key|value|up_proj|fc1|ffn_in)", P(None, "tp")),
+    (r"(wo|out_proj|down_proj|fc2|ffn_out)", P("tp", None)),
+    (r"(embed|embedding|lm_head)", P(None, "tp")),
+]

@@ -1,0 +1,220 @@
+"""SPMDTrainer: one fused, sharded XLA program per training step.
+
+This is the TPU-native replacement for the reference's whole training-loop
+machinery: DataParallelExecutorGroup batch slicing + per-device executors +
+kvstore push/pull + per-param optimizer ops
+(python/mxnet/module/executor_group.py, src/kvstore/comm.h) become ONE
+jit-compiled step over a Mesh:
+
+    loss+grads+optimizer-update = single HLO module,
+    batch sharded on 'dp', params replicated (or sharded by a ShardingPlan),
+    gradient reduction = the psum GSPMD inserts because the loss averages
+    over a dp-sharded batch. Buffer donation recycles parameter memory.
+
+Works with any Gluon HybridBlock + loss Block.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..base import MXNetError, check
+
+__all__ = ["SPMDTrainer"]
+
+
+class SPMDTrainer:
+    def __init__(self, block, loss_fn, mesh=None, optimizer: str = "sgd",
+                 optimizer_params: Optional[dict] = None,
+                 plan=None, dtype=None):
+        import jax
+        self.block = block
+        self.loss_fn = loss_fn
+        self.mesh = mesh
+        self.plan = plan
+        opt_params = dict(optimizer_params or {})
+        self.lr = float(opt_params.get("learning_rate", 0.01))
+        self.momentum = float(opt_params.get("momentum", 0.0))
+        self.wd = float(opt_params.get("wd", 0.0))
+        self.optimizer = optimizer
+        check(optimizer in ("sgd", "adam"),
+              "SPMDTrainer supports sgd/adam (use gluon.Trainer otherwise)")
+        self.beta1 = float(opt_params.get("beta1", 0.9))
+        self.beta2 = float(opt_params.get("beta2", 0.999))
+        self.epsilon = float(opt_params.get("epsilon", 1e-8))
+
+        self._param_objs: Optional[list] = None
+        self._trainable: list = []
+        self._aux: list = []
+        self._compute_dtype = dtype
+        self._step_fns: Dict[Tuple, Any] = {}
+        self._opt_state = None
+        self._t = 0
+
+    def _collect(self, sample_data=None):
+        """Resolve deferred-init params (probe forward) then place on mesh."""
+        items = sorted(self.block.collect_params().items())
+        if any(p._data is None for _, p in items) and sample_data is not None:
+            from ..ndarray.ndarray import from_jax
+            from .. import autograd
+            with autograd.pause():
+                self.block._imperative_call(from_jax(sample_data))
+            items = sorted(self.block.collect_params().items())
+        self._param_objs = [p for _, p in items]
+        self._trainable = [p for p in self._param_objs if p.grad_req != "null"]
+        self._aux = [p for p in self._param_objs if p.grad_req == "null"]
+        if self.mesh is not None:
+            self._place_params()
+
+    # ------------------------------------------------------------------
+    def _place_params(self):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+        repl = NamedSharding(self.mesh, PartitionSpec())
+        for p in self._param_objs:
+            arr = p._data._data
+            if self.plan is not None:
+                spec = self.plan.spec_for(p.name, arr.shape)
+                sh = NamedSharding(self.mesh, spec)
+            else:
+                sh = repl
+            p._data._rebind(jax.device_put(arr, sh))
+
+    def _init_opt_state(self, train_arrays):
+        import jax.numpy as jnp
+        if self.optimizer == "sgd":
+            if self.momentum == 0.0:
+                return ()
+            return tuple(jnp.zeros_like(a) for a in train_arrays)
+        # adam: (means, vars)
+        return (tuple(jnp.zeros_like(a) for a in train_arrays),
+                tuple(jnp.zeros_like(a) for a in train_arrays))
+
+    def _make_step(self, treedef_key):
+        import jax
+        import jax.numpy as jnp
+        from ..ndarray.ndarray import NDArray, from_jax
+        from .. import autograd, random as _random
+
+        block = self.block
+        loss_fn = self.loss_fn
+        trainable = self._trainable
+        aux = self._aux
+        lr, momentum, wd = self.lr, self.momentum, self.wd
+        optimizer = self.optimizer
+        beta1, beta2, eps = self.beta1, self.beta2, self.epsilon
+        compute_dtype = self._compute_dtype
+
+        def step(train_arrays, aux_arrays, opt_state, key, t, data, label):
+            aux_updates: Dict[int, Any] = {}
+
+            def loss_of(params):
+                originals = []
+                for p, a in zip(trainable, params):
+                    originals.append(p._data._data)
+                    # mixed precision: master f32 weights, compute-dtype
+                    # replicas inside the graph (grads come back f32)
+                    if compute_dtype is not None and \
+                            a.dtype == jnp.float32:
+                        a = a.astype(compute_dtype)
+                    p._data._data = a
+                aux_orig = []
+                for p, a in zip(aux, aux_arrays):
+                    aux_orig.append(p._data._data)
+                    p._data._data = a
+                _random.push_trace_key(key)
+                prev_r = autograd.set_recording(False)
+                prev_t = autograd.set_training(True)
+                try:
+                    x = from_jax(data if compute_dtype is None
+                                 else data.astype(compute_dtype))
+                    out = block._imperative_call(x)
+                    loss = loss_fn(out, from_jax(label))
+                    loss_val = jnp.mean(loss._data.astype(jnp.float32))
+                    for i, (p, o) in enumerate(zip(aux, aux_orig)):
+                        if p._data._data is not aux_arrays[i]:
+                            aux_updates[i] = p._data._data
+                    return loss_val
+                finally:
+                    autograd.set_training(prev_t)
+                    autograd.set_recording(prev_r)
+                    _random.pop_trace_key()
+                    for p, o in zip(trainable, originals):
+                        p._data._data = o
+                    for p, o in zip(aux, aux_orig):
+                        p._data._data = o
+
+            loss, grads = jax.value_and_grad(loss_of)(tuple(train_arrays))
+
+            new_params = []
+            if optimizer == "sgd":
+                if momentum == 0.0:
+                    for w, g in zip(train_arrays, grads):
+                        gw = g.astype(w.dtype)
+                        new_params.append(w - lr * (gw + wd * w))
+                    new_opt = opt_state
+                else:
+                    new_mom = []
+                    for w, g, m in zip(train_arrays, grads, opt_state):
+                        gw = g.astype(w.dtype) + wd * w
+                        nm = momentum * m - lr * gw
+                        new_mom.append(nm)
+                        new_params.append(w + nm)
+                    new_opt = tuple(new_mom)
+            else:  # adam
+                means, vars_ = opt_state
+                bc1 = 1 - beta1 ** t
+                bc2 = 1 - beta2 ** t
+                lr_t = lr * jnp.sqrt(bc2) / bc1
+                new_m, new_v = [], []
+                for w, g, m, v in zip(train_arrays, grads, means, vars_):
+                    gw = g.astype(w.dtype) + wd * w
+                    nm = beta1 * m + (1 - beta1) * gw
+                    nv = beta2 * v + (1 - beta2) * jnp.square(gw)
+                    new_m.append(nm)
+                    new_v.append(nv)
+                    new_params.append(w - lr_t * nm / (jnp.sqrt(nv) + eps))
+                new_opt = (tuple(new_m), tuple(new_v))
+
+            new_aux = tuple(aux_updates.get(i, a)
+                            for i, a in enumerate(aux_arrays))
+            return loss, tuple(new_params), new_aux, new_opt
+
+        donate = (0, 1, 2)
+        return jax.jit(step, donate_argnums=donate)
+
+    # ------------------------------------------------------------------
+    def step(self, data, label):
+        """Run one training step; returns the (device) scalar loss."""
+        import jax
+        from .. import random as _random
+        from ..ndarray.ndarray import NDArray
+
+        data = data._data if isinstance(data, NDArray) else data
+        label = label._data if isinstance(label, NDArray) else label
+        if self._param_objs is None:
+            self._collect(sample_data=data)
+        if self.mesh is not None:
+            from .sharding import shard_batch
+            data = shard_batch(data, self.mesh)
+            label = shard_batch(label, self.mesh)
+
+        train_arrays = tuple(p._data._data for p in self._trainable)
+        aux_arrays = tuple(p._data._data for p in self._aux)
+        if self._opt_state is None:
+            self._opt_state = self._init_opt_state(train_arrays)
+        self._t += 1
+
+        sig = (tuple((a.shape, str(a.dtype)) for a in (data, label)),)
+        fn = self._step_fns.get(sig)
+        if fn is None:
+            fn = self._step_fns[sig] = self._make_step(sig)
+        import jax.numpy as jnp
+        loss, new_params, new_aux, new_opt = fn(
+            train_arrays, aux_arrays, self._opt_state, _random.next_key(),
+            jnp.asarray(self._t, jnp.int32), data, label)
+        for p, a in zip(self._trainable, new_params):
+            p._data._rebind(a)
+        for p, a in zip(self._aux, new_aux):
+            p._data._rebind(a)
+        self._opt_state = new_opt
+        return loss

@@ -1,0 +1,111 @@
+"""KVStore tests (model: tests/python/unittest/test_kvstore.py +
+tests/nightly/dist_sync_kvstore.py patterns)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import kvstore as kv_mod
+
+
+def test_create_types():
+    for name in ["local", "device", "nccl", "dist_sync", "dist_tpu_sync",
+                 "dist_async"]:
+        kv = kv_mod.create(name)
+        assert kv.num_workers >= 1
+        assert kv.rank == 0
+    with pytest.raises(Exception):
+        kv_mod.create("bogus")
+
+
+def test_init_push_pull_single():
+    kv = kv_mod.create("local")
+    kv.init(3, nd.ones((2, 3)))
+    out = nd.zeros((2, 3))
+    kv.pull(3, out=out)
+    assert (out.asnumpy() == 1).all()
+    kv.push(3, nd.full((2, 3), 5.0))
+    kv.pull(3, out=out)
+    assert (out.asnumpy() == 5).all()
+
+
+def test_push_aggregates_multi_device_values():
+    kv = kv_mod.create("device")
+    kv.init("w", nd.zeros((4,)))
+    # 4 'workers' push different values -> sum (ref: CommDevice::Reduce)
+    vals = [nd.full((4,), float(i)) for i in range(4)]
+    kv.push("w", vals)
+    out = nd.zeros((4,))
+    kv.pull("w", out=out)
+    assert (out.asnumpy() == 6).all()  # 0+1+2+3
+
+
+def test_list_keys():
+    kv = kv_mod.create("local")
+    kv.init([1, 2], [nd.ones((2,)), nd.zeros((2,))])
+    outs = [nd.zeros((2,)), nd.zeros((2,))]
+    kv.pull([1, 2], out=outs)
+    assert outs[0].asnumpy().tolist() == [1, 1]
+
+
+def test_updater_on_kvstore():
+    kv = kv_mod.create("local")
+    kv.init(0, nd.full((2,), 10.0))
+
+    def sgd_like(key, grad, weight):
+        weight._rebind((weight - 0.1 * grad)._data)
+
+    kv.set_updater(sgd_like)
+    kv.push(0, nd.ones((2,)))
+    out = nd.zeros((2,))
+    kv.pull(0, out=out)
+    assert np.allclose(out.asnumpy(), 9.9)
+
+
+def test_set_optimizer_pickles():
+    kv = kv_mod.create("dist_tpu_sync")
+    kv.init(0, nd.full((3,), 1.0))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+    kv.push(0, nd.ones((3,)))  # grad=1 -> w = 1 - 0.1*1
+    out = nd.zeros((3,))
+    kv.pull(0, out=out)
+    assert np.allclose(out.asnumpy(), 0.9, atol=1e-6)
+
+
+def test_row_sparse_pull():
+    kv = kv_mod.create("local")
+    w = nd.array(np.arange(12).reshape(4, 3).astype(np.float32))
+    kv.init("emb", w)
+    out = nd.zeros((2, 3))
+    rid = nd.array([1, 3], dtype="int64")
+    kv.row_sparse_pull("emb", out=out, row_ids=rid)
+    assert out.asnumpy().tolist() == [[3, 4, 5], [9, 10, 11]]
+
+
+def test_trainer_with_kvstore():
+    from mxnet_tpu.gluon import nn, Trainer
+    from mxnet_tpu import autograd
+    net = nn.Dense(1, in_units=2, use_bias=False)
+    net.initialize(mx.init.Constant(1.0))
+    kv = kv_mod.create("device")
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.1}, kvstore=kv)
+    x = nd.ones((4, 2))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(batch_size=4)
+    assert np.allclose(net.weight.data().asnumpy(), 0.9)
+
+
+def test_sparse_ndarray_roundtrip():
+    from mxnet_tpu.ndarray import sparse
+    dense = np.array([[0, 0, 1], [0, 0, 0], [2, 3, 0]], dtype=np.float32)
+    rs = sparse.row_sparse_array(dense)
+    assert rs.stype == "row_sparse"
+    assert np.allclose(rs.asnumpy(), dense)
+    assert rs.indices.asnumpy().tolist() == [0, 2]
+    csr = sparse.csr_matrix(dense)
+    assert np.allclose(csr.asnumpy(), dense)
+    z = sparse.zeros("row_sparse", (3, 3))
+    assert np.allclose(z.asnumpy(), 0)
