@@ -1,0 +1,326 @@
+"""Gluon RNN cells (ref: python/mxnet/gluon/rnn/rnn_cell.py)."""
+from __future__ import annotations
+
+from ...base import MXNetError, check
+from ..block import HybridBlock
+
+__all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "DropoutCell", "BidirectionalCell",
+           "ResidualCell", "ZoneoutCell"]
+
+
+class RecurrentCell(HybridBlock):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+        for cell in self._children.values():
+            if isinstance(cell, RecurrentCell):
+                cell.reset()
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        from ... import ndarray as F
+        func = func or F.zeros
+        states = []
+        for info in self.state_info(batch_size):
+            self._init_counter += 1
+            shape = info["shape"]
+            states.append(func(shape, **kwargs))
+        return states
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        return self.forward(inputs, states)
+
+    def forward(self, inputs, states):
+        return self._imperative_call(inputs, states)
+
+    def _imperative_call(self, inputs, states):
+        from ... import ndarray as F
+        try:
+            params = self._resolved_params()
+        except Exception:
+            self.infer_shape_from_inputs(inputs, states)
+            for _, p in self._params.items():
+                if p._deferred_init is not None:
+                    p._finish_deferred_init()
+            params = self._resolved_params()
+        return self.hybrid_forward(F, inputs, states, **params)
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """(ref: rnn_cell.py BaseRNNCell.unroll)"""
+        from ... import ndarray as F
+        self.reset()
+        axis = layout.find("T")
+        batch_axis = layout.find("N")
+        if not isinstance(inputs, (list, tuple)):
+            batch = inputs.shape[batch_axis]
+            if length > 1:
+                seq = list(F.op.split(inputs, num_outputs=length, axis=axis,
+                                      squeeze_axis=True))
+            else:
+                seq = [F.op.squeeze(inputs, axis=axis)]
+        else:
+            seq = list(inputs)
+            batch = seq[0].shape[0]
+        if begin_state is None:
+            begin_state = self.begin_state(batch_size=batch)
+        states = begin_state
+        outputs = []
+        for i in range(length):
+            out, states = self(seq[i], states)
+            outputs.append(out)
+        if merge_outputs:
+            outputs = F.stack(*outputs, axis=axis)
+        return outputs, states
+
+
+class _BaseCell(RecurrentCell):
+    def __init__(self, hidden_size, num_gates, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        g = num_gates
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(g * hidden_size, input_size),
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(g * hidden_size, hidden_size),
+            init=h2h_weight_initializer)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(g * hidden_size,),
+            init=i2h_bias_initializer)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(g * hidden_size,),
+            init=h2h_bias_initializer)
+
+    def infer_shape_from_inputs(self, inputs, states=None):
+        self.i2h_weight.shape_hint(
+            (self.i2h_weight.shape[0], inputs.shape[-1]))
+
+
+class RNNCell(_BaseCell):
+    def __init__(self, hidden_size, activation="tanh", input_size=0, **kw):
+        super().__init__(hidden_size, 1, input_size, **kw)
+        self._activation = activation
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=self._hidden_size)
+        out = F.Activation(i2h + h2h, act_type=self._activation)
+        return out, [out]
+
+
+class LSTMCell(_BaseCell):
+    def __init__(self, hidden_size, input_size=0, **kw):
+        super().__init__(hidden_size, 4, input_size, **kw)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        h = self._hidden_size
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias, num_hidden=4 * h)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=4 * h)
+        gates = i2h + h2h
+        slices = F.op.split(gates, num_outputs=4, axis=1)
+        i = F.sigmoid(slices[0])
+        f = F.sigmoid(slices[1])
+        g = F.tanh(slices[2])
+        o = F.sigmoid(slices[3])
+        c = f * states[1] + i * g
+        out = o * F.tanh(c)
+        return out, [out, c]
+
+
+class GRUCell(_BaseCell):
+    def __init__(self, hidden_size, input_size=0, **kw):
+        super().__init__(hidden_size, 3, input_size, **kw)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        h = self._hidden_size
+        prev = states[0]
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias, num_hidden=3 * h)
+        h2h = F.FullyConnected(prev, h2h_weight, h2h_bias, num_hidden=3 * h)
+        i2h_s = F.op.split(i2h, num_outputs=3, axis=1)
+        h2h_s = F.op.split(h2h, num_outputs=3, axis=1)
+        r = F.sigmoid(i2h_s[0] + h2h_s[0])
+        z = F.sigmoid(i2h_s[1] + h2h_s[1])
+        n = F.tanh(i2h_s[2] + r * h2h_s[2])
+        out = (1 - z) * n + z * prev
+        return out, [out]
+
+
+class SequentialRNNCell(RecurrentCell):
+    """(ref: rnn_cell.py SequentialRNNCell)"""
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        out = []
+        for cell in self._children.values():
+            out.extend(cell.state_info(batch_size))
+        return out
+
+    def begin_state(self, batch_size=0, **kwargs):
+        out = []
+        for cell in self._children.values():
+            out.extend(cell.begin_state(batch_size=batch_size, **kwargs))
+        return out
+
+    def forward(self, inputs, states):
+        next_states = []
+        p = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            inputs, st = cell(inputs, states[p:p + n])
+            next_states.extend(st)
+            p += n
+        return inputs, next_states
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, i):
+        return list(self._children.values())[i]
+
+
+class DropoutCell(RecurrentCell):
+    def __init__(self, rate, axes=(), **kw):
+        super().__init__(**kw)
+        self._rate = rate
+        self._axes = axes
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def forward(self, inputs, states):
+        from ... import ndarray as F
+        if self._rate > 0:
+            inputs = F.Dropout(inputs, p=self._rate, axes=self._axes)
+        return inputs, states
+
+
+class BidirectionalCell(RecurrentCell):
+    def __init__(self, l_cell, r_cell, **kw):
+        super().__init__(**kw)
+        self.register_child(l_cell, "l_cell")
+        self.register_child(r_cell, "r_cell")
+
+    def state_info(self, batch_size=0):
+        return (self._children["l_cell"].state_info(batch_size) +
+                self._children["r_cell"].state_info(batch_size))
+
+    def begin_state(self, batch_size=0, **kwargs):
+        return (self._children["l_cell"].begin_state(batch_size=batch_size,
+                                                     **kwargs) +
+                self._children["r_cell"].begin_state(batch_size=batch_size,
+                                                     **kwargs))
+
+    def __call__(self, inputs, states):
+        raise MXNetError("BidirectionalCell supports only unroll()")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        from ... import ndarray as F
+        l_cell = self._children["l_cell"]
+        r_cell = self._children["r_cell"]
+        if begin_state is None:
+            batch = inputs.shape[layout.find("N")] \
+                if not isinstance(inputs, (list, tuple)) else inputs[0].shape[0]
+            begin_state = self.begin_state(batch_size=batch)
+        nl = len(l_cell.state_info())
+        l_out, l_states = l_cell.unroll(length, inputs,
+                                        begin_state[:nl], layout, False)
+        if isinstance(inputs, (list, tuple)):
+            rev = list(reversed(inputs))
+        else:
+            rev = F.op.SequenceReverse(inputs.swapaxes(0, 1) if layout == "NTC"
+                                       else inputs)
+            rev = rev.swapaxes(0, 1) if layout == "NTC" else rev
+        r_out, r_states = r_cell.unroll(length, rev, begin_state[nl:],
+                                        layout, False)
+        r_out = list(reversed(r_out))
+        outputs = [F.concatenate([l, r], axis=1)
+                   for l, r in zip(l_out, r_out)]
+        if merge_outputs:
+            outputs = F.stack(*outputs, axis=layout.find("T"))
+        return outputs, l_states + r_states
+
+
+class ResidualCell(RecurrentCell):
+    def __init__(self, base_cell, **kw):
+        super().__init__(**kw)
+        self.register_child(base_cell, "base_cell")
+
+    def state_info(self, batch_size=0):
+        return self._children["base_cell"].state_info(batch_size)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        return self._children["base_cell"].begin_state(batch_size=batch_size,
+                                                       **kwargs)
+
+    def forward(self, inputs, states):
+        out, states = self._children["base_cell"](inputs, states)
+        return out + inputs, states
+
+
+class ZoneoutCell(RecurrentCell):
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0,
+                 **kw):
+        super().__init__(**kw)
+        self.register_child(base_cell, "base_cell")
+        self._zo = zoneout_outputs
+        self._zs = zoneout_states
+        self._prev_output = None
+
+    def state_info(self, batch_size=0):
+        return self._children["base_cell"].state_info(batch_size)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        return self._children["base_cell"].begin_state(batch_size=batch_size,
+                                                       **kwargs)
+
+    def forward(self, inputs, states):
+        from ... import ndarray as F
+        from ... import autograd
+        cell = self._children["base_cell"]
+        out, next_states = cell(inputs, states)
+        if autograd.is_training():
+            if self._zo > 0:
+                mask = F.Dropout(F.ones_like(out), p=self._zo) > 0
+                prev = self._prev_output if self._prev_output is not None \
+                    else F.zeros_like(out)
+                out = F.op.where(mask, out, prev)
+            if self._zs > 0:
+                next_states = [
+                    F.op.where(F.Dropout(F.ones_like(ns), p=self._zs) > 0,
+                               ns, s)
+                    for ns, s in zip(next_states, states)]
+        self._prev_output = out
+        return out, next_states

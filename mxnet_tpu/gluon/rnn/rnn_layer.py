@@ -1,0 +1,178 @@
+"""Gluon fused RNN layers (ref: python/mxnet/gluon/rnn/rnn_layer.py —
+the layers that dispatch to the fused RNN op instead of unrolled cells)."""
+from __future__ import annotations
+
+from ...base import MXNetError, check
+from ..block import HybridBlock
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, mode,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        check(layout in ("TNC", "NTC"), f"invalid layout {layout}")
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._mode = mode
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        g = _GATES[mode]
+        ng = g * hidden_size
+        for layer in range(num_layers):
+            for d in range(self._dir):
+                suffix = ["l", "r"][d] + str(layer)
+                in_sz = input_size if layer == 0 else hidden_size * self._dir
+                setattr(self, f"{suffix}_i2h_weight", self.params.get(
+                    f"{suffix}_i2h_weight", shape=(ng, in_sz),
+                    init=i2h_weight_initializer, allow_deferred_init=True))
+                setattr(self, f"{suffix}_h2h_weight", self.params.get(
+                    f"{suffix}_h2h_weight", shape=(ng, hidden_size),
+                    init=h2h_weight_initializer))
+                setattr(self, f"{suffix}_i2h_bias", self.params.get(
+                    f"{suffix}_i2h_bias", shape=(ng,),
+                    init=i2h_bias_initializer))
+                setattr(self, f"{suffix}_h2h_bias", self.params.get(
+                    f"{suffix}_h2h_bias", shape=(ng,),
+                    init=h2h_bias_initializer))
+
+    def infer_shape_from_inputs(self, inputs, *rest):
+        in_sz = inputs.shape[2] if self._layout == "TNC" else inputs.shape[2]
+        for layer in range(self._num_layers):
+            for d in range(self._dir):
+                suffix = ["l", "r"][d] + str(layer)
+                p = self._params[self._prefix + f"{suffix}_i2h_weight"]
+                if layer == 0:
+                    p.shape_hint((p.shape[0], in_sz))
+
+    def state_info(self, batch_size=0):
+        shape = (self._num_layers * self._dir, batch_size, self._hidden_size)
+        if self._mode == "lstm":
+            return [{"shape": shape}, {"shape": shape}]
+        return [{"shape": shape}]
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        from ... import ndarray as F
+        func = func or F.zeros
+        return [func(info["shape"], **kwargs)
+                for info in self.state_info(batch_size)]
+
+    def _pack(self, F, params):
+        """Flatten per-layer weights into the fused-op layout
+        (weights then biases — ops/rnn_op.py packing)."""
+        weights = []
+        biases = []
+        for layer in range(self._num_layers):
+            for d in range(self._dir):
+                suffix = ["l", "r"][d] + str(layer)
+                weights.append(params[f"{suffix}_i2h_weight"].reshape((-1,)))
+                weights.append(params[f"{suffix}_h2h_weight"].reshape((-1,)))
+        for layer in range(self._num_layers):
+            for d in range(self._dir):
+                suffix = ["l", "r"][d] + str(layer)
+                biases.append(params[f"{suffix}_i2h_bias"])
+                biases.append(params[f"{suffix}_h2h_bias"])
+        return F.concatenate(weights + biases, axis=0)
+
+    def hybrid_forward(self, F, inputs, *states, **params):
+        states = list(states)
+        if states and isinstance(states[0], (list, tuple)):
+            states = list(states[0])
+        x = inputs
+        if self._layout == "NTC":
+            x = x.swapaxes(0, 1)
+        batch = x.shape[1]
+        if not states:
+            states = self.begin_state(batch, ctx=None)
+        packed = self._pack(F, params)
+        args = [x, packed, states[0]]
+        if self._mode == "lstm":
+            args.append(states[1] if len(states) > 1
+                        else F.zeros(states[0].shape))
+        outs = F.RNN(*args, state_size=self._hidden_size,
+                     num_layers=self._num_layers, mode=self._mode,
+                     bidirectional=self._dir == 2, p=self._dropout,
+                     state_outputs=True)
+        if self._mode == "lstm":
+            out, h, c = outs
+            new_states = [h, c]
+        else:
+            out, h = outs
+            new_states = [h]
+        if self._layout == "NTC":
+            out = out.swapaxes(0, 1)
+        return out, new_states
+
+    def forward(self, inputs, states=None):
+        explicit = states is not None
+        if self._active:
+            if self._cached_op is None:
+                from ...cached_op import CachedOp
+                try:
+                    self._collect_deferred_check()
+                except Exception:
+                    self._imperative_call(inputs, states)
+                self._cached_op = CachedOp(self)
+            out, new_states = self._cached_op(inputs, states) if explicit \
+                else self._cached_op(inputs)
+        else:
+            out, new_states = self._imperative_call(inputs, states) \
+                if explicit else self._imperative_call(inputs)
+        return (out, new_states) if explicit else out
+
+    def _imperative_call(self, inputs, states=None):
+        from ... import ndarray as F
+        try:
+            params = self._resolved_params()
+        except Exception:
+            self.infer_shape_from_inputs(
+                inputs if self._layout == "TNC" else inputs.swapaxes(0, 1))
+            for _, p in self._params.items():
+                if p._deferred_init is not None:
+                    p._finish_deferred_init()
+            params = self._resolved_params()
+        if states is None:
+            return self.hybrid_forward(F, inputs, **params)
+        return self.hybrid_forward(F, inputs, states, **params)
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self._hidden_size}, "
+                f"layers={self._num_layers}, bidir={self._dir == 2})")
+
+
+class RNN(_RNNLayer):
+    """(ref: gluon.rnn.RNN)"""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False, input_size=0,
+                 **kwargs):
+        mode = "rnn_relu" if activation == "relu" else "rnn_tanh"
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, mode, **kwargs)
+
+
+class LSTM(_RNNLayer):
+    """(ref: gluon.rnn.LSTM — the word_language_model workhorse,
+    BASELINE config #3)"""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, "lstm", **kwargs)
+
+
+class GRU(_RNNLayer):
+    """(ref: gluon.rnn.GRU)"""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, "gru", **kwargs)

@@ -1,0 +1,183 @@
+"""Fused multi-layer RNN op: the cuDNN RNN replacement.
+
+Reference: src/operator/rnn.cc + rnn-inl.h:380 (RNNOp: modes
+rnn_relu/rnn_tanh/lstm/gru, multi-layer, bidirectional, single packed
+parameter vector, cuDNN fast path cudnn_rnn-inl.h:267-296).
+
+TPU-native: the time loop is ``lax.scan`` (compiler-friendly, unrolled into
+one XLA while-op with hoisted weights); gates for all 4 (LSTM) / 3 (GRU)
+projections are computed as ONE fused matmul per step so the MXU sees large
+GEMMs. Parameter packing follows the reference layout (weights then biases,
+layer-major, direction-minor) so checkpoints trained against the reference
+shape-match.
+
+Gate order (cuDNN compatible): LSTM i,f,g,o; GRU r,z,n.
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as _np
+
+from ..base import MXNetError
+from .registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def rnn_param_size(num_layers: int, input_size: int, state_size: int,
+                   bidirectional: bool, mode: str) -> int:
+    """Total packed parameter count (ref: rnn-inl.h GetRnnParamSize)."""
+    g = _GATES[mode]
+    d = 2 if bidirectional else 1
+    size = 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else state_size * d
+        size += d * g * state_size * (in_sz + state_size + 2)
+    return size
+
+
+def _unpack(params, num_layers, input_size, state_size, bidirectional, mode):
+    """Split the flat vector into per-(layer, direction) (W, R, bW, bR)."""
+    g = _GATES[mode]
+    d = 2 if bidirectional else 1
+    h = state_size
+    out = []
+    off = 0
+    # weights first, then biases (reference/cuDNN packing)
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else h * d
+        for _dir in range(d):
+            w = params[off:off + g * h * in_sz].reshape(g * h, in_sz)
+            off += g * h * in_sz
+            r = params[off:off + g * h * h].reshape(g * h, h)
+            off += g * h * h
+            out.append([w, r, None, None])
+    i = 0
+    for layer in range(num_layers):
+        for _dir in range(d):
+            out[i][2] = params[off:off + g * h]
+            off += g * h
+            out[i][3] = params[off:off + g * h]
+            off += g * h
+            i += 1
+    return out
+
+
+def _cell_step(mode, x_proj, h_prev, c_prev, r_weight, r_bias):
+    """One time step given the precomputed input projection."""
+    import jax
+    jnp = _jnp()
+    h = h_prev.shape[-1]
+    gates = x_proj + h_prev @ r_weight.T + r_bias
+    if mode == "rnn_relu":
+        return jnp.maximum(gates, 0), None
+    if mode == "rnn_tanh":
+        return jnp.tanh(gates), None
+    if mode == "lstm":
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c = f * c_prev + i * g
+        return o * jnp.tanh(c), c
+    if mode == "gru":
+        # cuDNN-style GRU: n gate uses r * (h @ Rn + bRn)
+        xr, xz, xn = jnp.split(gates - (h_prev @ r_weight.T + r_bias), 3,
+                               axis=-1)
+        hr, hz, hn = jnp.split(h_prev @ r_weight.T + r_bias, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        n = jnp.tanh(xn + r * hn)
+        return (1 - z) * n + z * h_prev, None
+    raise MXNetError(f"unknown RNN mode {mode}")
+
+
+def _run_layer(mode, x, w, r, bw, br, h0, c0, reverse=False):
+    """Scan one direction of one layer. x: (T, N, I) -> (T, N, H)."""
+    import jax
+    jnp = _jnp()
+    # one big fused input projection for the whole sequence (MXU-friendly)
+    x_proj = jnp.einsum("tni,gi->tng", x, w) + bw
+    if reverse:
+        x_proj = jnp.flip(x_proj, axis=0)
+
+    def step(carry, xp):
+        h_prev, c_prev = carry
+        h_new, c_new = _cell_step(mode, xp, h_prev, c_prev, r, br)
+        return (h_new, c_new if c_new is not None else c_prev), h_new
+
+    (h_last, c_last), ys = jax.lax.scan(step, (h0, c0), x_proj)
+    if reverse:
+        ys = jnp.flip(ys, axis=0)
+    return ys, h_last, c_last
+
+
+def _rnn_impl(data, params, state, state_cell, state_size, num_layers, mode,
+              bidirectional, p, _key, _training):
+    import jax
+    jnp = _jnp()
+    d = 2 if bidirectional else 1
+    t, n, input_size = data.shape
+    layers = _unpack(params, num_layers, input_size, state_size,
+                     bidirectional, mode)
+    x = data
+    h_states: List = []
+    c_states: List = []
+    for layer in range(num_layers):
+        outs = []
+        for _dir in range(d):
+            idx = layer * d + _dir
+            w, r, bw, br = layers[idx]
+            h0 = state[idx]
+            c0 = state_cell[idx] if mode == "lstm" else jnp.zeros_like(h0)
+            ys, h_last, c_last = _run_layer(mode, x, w, r, bw, br, h0, c0,
+                                            reverse=(_dir == 1))
+            outs.append(ys)
+            h_states.append(h_last)
+            c_states.append(c_last)
+        x = outs[0] if d == 1 else jnp.concatenate(outs, axis=-1)
+        if p > 0 and _training and layer < num_layers - 1:
+            keep = 1.0 - p
+            mask = jax.random.bernoulli(
+                jax.random.fold_in(_key, layer), keep, x.shape
+            ).astype(x.dtype)
+            x = x * mask / keep
+    h_out = jnp.stack(h_states, axis=0)
+    c_out = jnp.stack(c_states, axis=0)
+    return x, h_out, c_out
+
+
+def _rnn_nout(n_inputs, params):
+    if not params.get("state_outputs", False):
+        return 1
+    return 3 if params.get("mode") == "lstm" else 2
+
+
+@register("RNN", num_outputs=_rnn_nout, rng=True)
+def _rnn(data, parameters, state, *maybe_cell_and_key, state_size=0,
+         num_layers=1, mode="lstm", bidirectional=False, p=0.0,
+         state_outputs=False, projection_size=None, lstm_state_clip_min=None,
+         lstm_state_clip_max=None, lstm_state_clip_nan=False,
+         use_sequence_length=False, _training=False):
+    """Fused RNN (ref: src/operator/rnn.cc registration).
+
+    data (T,N,I); parameters flat; state (L*D,N,H); for lstm an extra
+    state_cell input precedes the injected rng key.
+    """
+    rest = list(maybe_cell_and_key)
+    _key = rest.pop()  # rng key is always appended last
+    state_cell = rest.pop(0) if mode == "lstm" and rest else \
+        _jnp().zeros_like(state)
+    out, h, c = _rnn_impl(data, parameters, state, state_cell, state_size,
+                          num_layers, mode, bidirectional, p, _key, _training)
+    if not state_outputs:
+        return out
+    if mode == "lstm":
+        return out, h, c
+    return out, h
