@@ -38,5 +38,16 @@ from . import module as mod  # noqa: F401
 from . import callback  # noqa: F401
 from . import model  # noqa: F401
 from .executor_compat import Executor  # noqa: F401
+from . import kvstore  # noqa: F401
+from . import kvstore as kv  # noqa: F401
+from . import engine  # noqa: F401
+from . import profiler  # noqa: F401
+from . import runtime  # noqa: F401
+from . import recordio  # noqa: F401
+from . import test_utils  # noqa: F401
+from . import contrib  # noqa: F401
+from . import parallel  # noqa: F401
+from . import models  # noqa: F401
+from . import lr_scheduler as _lr  # noqa: F401
 
 # `import mxnet_tpu as mx; mx.nd...` is the canonical spelling.

@@ -30,9 +30,47 @@ class _State(threading.local):
     def __init__(self):
         self.recording = False
         self.training = False
+        self.capture_stack = []
 
 
 _state = _State()
+
+
+class _CaptureScope:
+    """Discovers grad-relevant free NDArrays used inside a traced construct
+    (the analog of NNVM subgraph free-variable capture in
+    src/operator/subgraph_op_common.cc)."""
+
+    def __init__(self):
+        self.order: list = []
+        self._seen = set()
+        self._internal = set()
+
+    def observe(self, inputs, outputs) -> None:
+        for x in inputs:
+            if getattr(x, "_tape_entry", None) is not None and \
+                    id(x) not in self._internal and id(x) not in self._seen:
+                self._seen.add(id(x))
+                self.order.append(x)
+        for o in outputs:
+            self._internal.add(id(o))
+
+
+class capture:
+    """Context manager collecting captured free variables."""
+
+    def __enter__(self) -> _CaptureScope:
+        scope = _CaptureScope()
+        _state.capture_stack.append(scope)
+        return scope
+
+    def __exit__(self, *a):
+        _state.capture_stack.pop()
+
+
+def _observe_capture(inputs, outputs) -> None:
+    if _state.capture_stack:
+        _state.capture_stack[-1].observe(inputs, outputs)
 
 
 def is_recording() -> bool:

@@ -1,0 +1,16 @@
+"""Contrib namespace (ref: python/mxnet/contrib/)."""
+from . import control_flow  # noqa: F401
+from .control_flow import foreach, while_loop, cond  # noqa: F401
+
+# surface on mx.nd.contrib / mx.sym.contrib like the reference
+def _install():
+    import sys
+    for modname in ("mxnet_tpu.ndarray.contrib", "mxnet_tpu.symbol.contrib"):
+        m = sys.modules.get(modname)
+        if m is not None:
+            m.foreach = foreach
+            m.while_loop = while_loop
+            m.cond = cond
+
+
+_install()

@@ -1,0 +1,248 @@
+"""Functional control flow: foreach / while_loop / cond.
+
+Reference: src/operator/control_flow.cc (_foreach :1255, _while_loop :1316,
+_cond :1378 — subgraph ops with hand-written backward) and the python sugar
+python/mxnet/symbol/contrib.py + ndarray/contrib.py.
+
+TPU-native: these ARE ``lax.scan`` / ``lax.cond`` (while_loop is a masked
+scan over max_iterations so it stays reverse-differentiable and
+static-shaped). The body is traced once; free NDArrays the body closes over
+are discovered by a probe run (autograd.capture — the analog of NNVM
+subgraph free-variable capture) and become explicit inputs, so gradients
+flow to them. The whole construct is ONE tape node (like CachedOp) whose
+backward is jax.vjp over the traced function.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Sequence, Tuple
+
+from ..base import MXNetError, check
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+def _nd():
+    from ..ndarray import ndarray as nd
+    return nd
+
+
+class _Construct:
+    """jit + single-tape-node wrapper around a pure jax fn whose closure
+    NDArrays (``captured``) are rebound to tracers during the trace."""
+
+    def __init__(self, fn: Callable, captured: Sequence):
+        self.fn = fn
+        self.captured = list(captured)
+        self._jitted = None
+
+    def _full_fn(self):
+        captured = self.captured
+
+        def run(cap_arrays, *arrays):
+            originals = [c._data for c in captured]
+            for c, a in zip(captured, cap_arrays):
+                c._data = a
+            try:
+                return self.fn(*arrays)
+            finally:
+                for c, o in zip(captured, originals):
+                    c._data = o
+
+        return run
+
+    def __call__(self, nd_inputs: Sequence) -> Tuple:
+        import jax
+        from .. import autograd
+        nd = _nd()
+        arrays = tuple(x._data for x in nd_inputs)
+        cap_arrays = tuple(c._data for c in self.captured)
+        if self._jitted is None:
+            self._jitted = jax.jit(self._full_fn())
+        outs = self._jitted(cap_arrays, *arrays)
+        ctx = nd_inputs[0]._ctx if nd_inputs else \
+            (self.captured[0]._ctx if self.captured else None)
+        out_nds = tuple(nd.NDArray(o, ctx=ctx) for o in outs)
+        if autograd.is_recording():
+            grad_closure = _ConstructGrad(self._full_fn(), cap_arrays, arrays)
+            autograd._record_custom(grad_closure,
+                                    list(self.captured) + list(nd_inputs),
+                                    out_nds)
+        return out_nds
+
+
+class _ConstructGrad:
+    def __init__(self, fn, cap_arrays, arrays):
+        self.fn = fn
+        self.cap_arrays = cap_arrays
+        self.arrays = arrays
+
+    def _run_backward(self, cotangents):
+        import jax
+        _, vjp = jax.vjp(self.fn, self.cap_arrays, *self.arrays)
+        grads = vjp(tuple(cotangents))
+        return list(grads[0]) + list(grads[1:])
+
+
+def _probe_captures(run_probe, explicit):
+    from .. import autograd
+    with autograd.pause():
+        with autograd.capture() as cap:
+            run_probe()
+    explicit_ids = {id(x) for x in explicit}
+    return [c for c in cap.order if id(c) not in explicit_ids]
+
+
+def foreach(body: Callable, data, init_states):
+    """Scan ``body`` over the leading axis of ``data``
+    (ref: mx.nd.contrib.foreach / control_flow.cc:1255).
+
+    body(item, states) -> (out, new_states); returns (stacked_outs, states).
+    """
+    import jax
+    nd = _nd()
+    from .. import autograd
+
+    single_data = not isinstance(data, (list, tuple))
+    datas = [data] if single_data else list(data)
+    single_state = not isinstance(init_states, (list, tuple))
+    states = [init_states] if single_state else list(init_states)
+    n_data = len(datas)
+    n_state = len(states)
+    meta = {}
+
+    captured = _probe_captures(
+        lambda: body(datas[0][0] if single_data else [d[0] for d in datas],
+                     init_states),
+        datas + states)
+
+    def scan_fn(*arrays):
+        xs = arrays[:n_data]
+        init = arrays[n_data:]
+
+        def step(carry, slices):
+            prev = autograd.set_recording(False)
+            try:
+                item_nd = [nd.from_jax(s) for s in slices]
+                state_nd = [nd.from_jax(c) for c in carry]
+                out, new_states = body(
+                    item_nd[0] if single_data else item_nd,
+                    state_nd[0] if single_state else state_nd)
+                outs = [out] if not isinstance(out, (list, tuple)) \
+                    else list(out)
+                ns = [new_states] if not isinstance(new_states,
+                                                    (list, tuple)) \
+                    else list(new_states)
+                meta["n_out"] = len(outs)
+                return tuple(x._data for x in ns), \
+                    tuple(x._data for x in outs)
+            finally:
+                autograd.set_recording(prev)
+
+        final, stacked = jax.lax.scan(step, tuple(init), tuple(xs))
+        return tuple(stacked) + tuple(final)
+
+    construct = _Construct(scan_fn, captured)
+    results = construct(datas + states)
+    n_out = meta.get("n_out", len(results) - n_state)
+    outs = results[:n_out]
+    fin = results[n_out:]
+    out = outs[0] if n_out == 1 else list(outs)
+    fin_states = fin[0] if single_state else list(fin)
+    return out, fin_states
+
+
+def while_loop(cond_fn: Callable, func: Callable, loop_vars,
+               max_iterations: int):
+    """Bounded while loop (ref: control_flow.cc:1316 _while_loop).
+
+    func(*loop_vars) -> (step_output(s), new_loop_vars). Step outputs land
+    in a max_iterations buffer; also returns final loop vars.
+    """
+    import jax
+    import jax.numpy as jnp
+    nd = _nd()
+    from .. import autograd
+
+    check(max_iterations is not None and max_iterations > 0,
+          "while_loop requires max_iterations")
+    single_var = not isinstance(loop_vars, (list, tuple))
+    lvars = [loop_vars] if single_var else list(loop_vars)
+    meta = {}
+
+    captured = _probe_captures(
+        lambda: (cond_fn(*lvars), func(*lvars)), lvars)
+
+    def wl_fn(*arrays):
+        prev = autograd.set_recording(False)
+        try:
+            def step(carry, _):
+                i, done, vars_ = carry
+                var_nds = [nd.from_jax(v) for v in vars_]
+                outs, new_vars = func(*var_nds)
+                outs_l = [outs] if not isinstance(outs, (list, tuple)) \
+                    else list(outs)
+                nv = [new_vars] if not isinstance(new_vars, (list, tuple)) \
+                    else list(new_vars)
+                meta["n_out"] = len(outs_l)
+                c = cond_fn(*var_nds)
+                cval = (c._data if hasattr(c, "_data") else jnp.asarray(c)) \
+                    .reshape(()).astype(bool)
+                active = jnp.logical_and(jnp.logical_not(done), cval)
+                sel_vars = tuple(jnp.where(active, n._data, v)
+                                 for n, v in zip(nv, vars_))
+                ys = tuple(jnp.where(active, o._data,
+                                     jnp.zeros_like(o._data))
+                           for o in outs_l)
+                count = i + active.astype(i.dtype)
+                return (count, jnp.logical_not(active), sel_vars), ys
+
+            (i, _, final_vars), stacked = jax.lax.scan(
+                step, (jnp.asarray(0), jnp.asarray(False), tuple(arrays)),
+                None, length=max_iterations)
+            return tuple(stacked) + tuple(final_vars) + (i,)
+        finally:
+            autograd.set_recording(prev)
+
+    construct = _Construct(wl_fn, captured)
+    results = construct(lvars)
+    n_out = meta["n_out"]
+    outs = results[:n_out]
+    fin = results[n_out:-1]
+    out = outs[0] if n_out == 1 else list(outs)
+    fin_vars = fin[0] if single_var else list(fin)
+    return out, fin_vars
+
+
+def cond(pred, then_func: Callable, else_func: Callable, inputs=None):
+    """Conditional execution (ref: control_flow.cc:1378 _cond).
+
+    Branch functions are zero-arg closures over NDArrays (reference calling
+    convention); both branches must produce matching shapes/dtypes.
+    """
+    import jax
+    nd = _nd()
+    from .. import autograd
+
+    pred_nd = pred if hasattr(pred, "_data") else _nd().array(pred)
+    captured = _probe_captures(lambda: (then_func(), else_func()), [pred_nd])
+
+    def cond_fn(pred_array):
+        prev = autograd.set_recording(False)
+        try:
+            def run(branch):
+                def _inner(_):
+                    out = branch()
+                    outs = [out] if not isinstance(out, (list, tuple)) \
+                        else list(out)
+                    return tuple(x._data for x in outs)
+                return _inner
+
+            return jax.lax.cond(pred_array.reshape(()).astype(bool),
+                                run(then_func), run(else_func),
+                                operand=None)
+        finally:
+            autograd.set_recording(prev)
+
+    construct = _Construct(cond_fn, captured)
+    results = construct([pred_nd])
+    return results[0] if len(results) == 1 else list(results)

@@ -1,0 +1,61 @@
+"""Engine surface: the async-execution control API.
+
+Reference: include/mxnet/engine.h + src/engine/ — the dependency engine that
+schedules every op by var read/write sets on per-device worker threads, with
+NaiveEngine as the serialize-everything debug mode (engine.cc:33-46) and
+bulk scopes batching sync ops (python/mxnet/engine.py).
+
+TPU-native mapping: XLA's async dispatch queue IS the engine — ops return
+futures, program order per device is preserved, and data dependencies are
+explicit in the dataflow. What remains at this layer:
+- NaiveEngine debug semantics (block after every op) via MXNET_ENGINE_TYPE,
+- bulk scopes (no-op: whole-graph jit supersedes engine bulking),
+- WaitForAll / WaitForVar fences,
+- exception propagation to sync points (JAX raises device errors at
+  block_until_ready — the exception_ptr rethrow analog,
+  ref threaded_engine.h:449-456).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+
+from .base import env
+
+__all__ = ["set_bulk_size", "bulk", "wait_for_all", "engine_type",
+           "set_engine_type"]
+
+_bulk_size = 15
+
+
+def set_bulk_size(size: int) -> int:
+    """(ref: MXEngineSetBulkSize; python/mxnet/engine.py) — retained for
+    API compat; graph compilation replaces engine-level bulking."""
+    global _bulk_size
+    prev, _bulk_size = _bulk_size, size
+    return prev
+
+
+@contextlib.contextmanager
+def bulk(size: int):
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)
+
+
+def wait_for_all() -> None:
+    """Engine::WaitForAll (ref: engine.h:232)."""
+    from .ndarray import waitall
+    waitall()
+
+
+def engine_type() -> str:
+    return env.get("MXNET_ENGINE_TYPE")
+
+
+def set_engine_type(name: str) -> None:
+    """Switch scheduling mode. 'NaiveEngine' blocks after every eager op —
+    the standard way to localize async failures (ref: engine.cc:33-46)."""
+    os.environ["MXNET_ENGINE_TYPE"] = name

@@ -1,0 +1,7 @@
+"""Gluon data API (ref: python/mxnet/gluon/data/__init__.py)."""
+from .dataset import (Dataset, SimpleDataset, ArrayDataset,  # noqa
+                      RecordFileDataset)
+from .sampler import (Sampler, SequentialSampler, RandomSampler,  # noqa
+                      BatchSampler)
+from .dataloader import DataLoader  # noqa: F401
+from . import vision  # noqa: F401

@@ -1,0 +1,88 @@
+"""DataLoader (ref: python/mxnet/gluon/data/dataloader.py:595).
+
+The reference forks worker processes that exchange NDArrays over POSIX
+shared memory (ForkingPickler reductions :26-68, backed by
+cpu_shared_storage_manager.h). TPU-native: batches are assembled on the host
+with a *thread* pool — the heavy lifting (augmentation) is numpy which
+releases the GIL, and the device transfer is one ``device_put`` per batch;
+multiprocess + shm adds copies without wins here. ``num_workers`` therefore
+sizes a thread pool. Batchify semantics match the reference.
+"""
+from __future__ import annotations
+
+import concurrent.futures
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+from ...base import MXNetError, check
+from ...ndarray import ndarray as _nd
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (ref: dataloader.py default_batchify_fn)."""
+    if isinstance(data[0], _nd.NDArray):
+        return _nd.stack(*data, axis=0)
+    if isinstance(data[0], tuple):
+        return tuple(default_batchify_fn(list(x)) for x in zip(*data))
+    arr = np.asarray(data)
+    if arr.dtype == np.float64:
+        arr = arr.astype(np.float32)
+    return _nd.array(arr, dtype=arr.dtype)
+
+
+class DataLoader:
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, prefetch=None,
+                 thread_pool=True):
+        self._dataset = dataset
+        if batch_sampler is None:
+            check(batch_size is not None,
+                  "batch_size required when batch_sampler is None")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle \
+                    else SequentialSampler(len(dataset))
+            elif shuffle:
+                raise MXNetError("shuffle conflicts with explicit sampler")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                        last_batch or "keep")
+        elif (batch_size is not None or shuffle or sampler is not None or
+              last_batch is not None):
+            raise MXNetError("batch_sampler conflicts with batch_size/"
+                             "shuffle/sampler/last_batch")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._num_workers = max(0, num_workers)
+        self._prefetch = max(0, prefetch if prefetch is not None
+                             else 2 * self._num_workers)
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def _load(self, indices):
+        return self._batchify_fn([self._dataset[i] for i in indices])
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for indices in self._batch_sampler:
+                yield self._load(indices)
+            return
+        with concurrent.futures.ThreadPoolExecutor(self._num_workers) as ex:
+            pending = []
+            it = iter(self._batch_sampler)
+            try:
+                for _ in range(self._prefetch or self._num_workers):
+                    pending.append(ex.submit(self._load, next(it)))
+            except StopIteration:
+                pass
+            while pending:
+                fut = pending.pop(0)
+                try:
+                    pending.append(ex.submit(self._load, next(it)))
+                except StopIteration:
+                    pass
+                yield fut.result()

@@ -1,0 +1,204 @@
+"""Vision transforms (ref: python/mxnet/gluon/data/vision/transforms.py).
+
+Implemented over nd ops (numpy-free where possible) so transforms can also
+run inside compiled pipelines.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ....base import MXNetError, check
+from ...block import Block, HybridBlock
+from ...nn import Sequential, HybridSequential
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize",
+           "CenterCrop", "RandomResizedCrop", "RandomFlipLeftRight",
+           "RandomFlipTopBottom", "RandomBrightness", "RandomContrast",
+           "RandomSaturation", "RandomLighting", "RandomColorJitter"]
+
+
+class Compose(Sequential):
+    """(ref: transforms.py Compose)"""
+
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return F.cast(x, dtype=self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (ref: ToTensor)."""
+
+    def hybrid_forward(self, F, x):
+        x = F.cast(x, dtype="float32") / 255.0
+        if x.ndim == 3:
+            return F.transpose(x, axes=(2, 0, 1))
+        return F.transpose(x, axes=(0, 3, 1, 2))
+
+
+class Normalize(HybridBlock):
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = mean
+        self._std = std
+
+    def hybrid_forward(self, F, x):
+        import numpy as _np
+        mean = _np.asarray(self._mean, _np.float32).reshape(-1, 1, 1)
+        std = _np.asarray(self._std, _np.float32).reshape(-1, 1, 1)
+        from ....ndarray import array
+        return (x - array(mean)) / array(std)
+
+
+class Resize(Block):
+    """Bilinear resize (ref: Resize; image_io/resize)."""
+
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def forward(self, x):
+        import jax
+        from ....ndarray import from_jax
+        data = x._data
+        h, w = self._size[1], self._size[0]
+        if data.ndim == 3:
+            out = jax.image.resize(data.astype("float32"),
+                                   (h, w, data.shape[2]), "bilinear")
+        else:
+            out = jax.image.resize(data.astype("float32"),
+                                   (data.shape[0], h, w, data.shape[3]),
+                                   "bilinear")
+        return from_jax(out.astype(data.dtype))
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def forward(self, x):
+        w, h = self._size
+        H, W = x.shape[-3], x.shape[-2]
+        y0 = max(0, (H - h) // 2)
+        x0 = max(0, (W - w) // 2)
+        return x[..., y0:y0 + h, x0:x0 + w, :]
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._scale = scale
+        self._ratio = ratio
+        self._resize = Resize(self._size)
+
+    def forward(self, x):
+        H, W = x.shape[-3], x.shape[-2]
+        area = H * W
+        for _ in range(10):
+            target = np.random.uniform(*self._scale) * area
+            ratio = np.random.uniform(*self._ratio)
+            w = int(round(np.sqrt(target * ratio)))
+            h = int(round(np.sqrt(target / ratio)))
+            if w <= W and h <= H:
+                x0 = np.random.randint(0, W - w + 1)
+                y0 = np.random.randint(0, H - h + 1)
+                crop = x[..., y0:y0 + h, x0:x0 + w, :]
+                return self._resize(crop)
+        return self._resize(x)
+
+
+class _RandomFlip(Block):
+    _axis = -2
+
+    def __init__(self, p=0.5):
+        super().__init__()
+        self._p = p
+
+    def forward(self, x):
+        if np.random.rand() < self._p:
+            return x.flip(axis=x.ndim + self._axis)
+        return x
+
+
+class RandomFlipLeftRight(_RandomFlip):
+    _axis = -2
+
+
+class RandomFlipTopBottom(_RandomFlip):
+    _axis = -3
+
+
+class _ColorJitterBase(Block):
+    def __init__(self, magnitude):
+        super().__init__()
+        self._m = magnitude
+
+    def _alpha(self):
+        return 1.0 + np.random.uniform(-self._m, self._m)
+
+
+class RandomBrightness(_ColorJitterBase):
+    def forward(self, x):
+        return (x.astype("float32") * self._alpha()).clip(0, 255) \
+            .astype(x.dtype)
+
+
+class RandomContrast(_ColorJitterBase):
+    def forward(self, x):
+        alpha = self._alpha()
+        xf = x.astype("float32")
+        gray = xf.mean()
+        return (xf * alpha + gray * (1 - alpha)).clip(0, 255).astype(x.dtype)
+
+
+class RandomSaturation(_ColorJitterBase):
+    def forward(self, x):
+        alpha = self._alpha()
+        xf = x.astype("float32")
+        gray = xf.mean(axis=-1, keepdims=True)
+        return (xf * alpha + gray * (1 - alpha)).clip(0, 255).astype(x.dtype)
+
+
+class RandomLighting(_ColorJitterBase):
+    """AlexNet-style PCA noise (ref: RandomLighting)."""
+
+    _eigval = np.array([55.46, 4.794, 1.148], np.float32)
+    _eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                        [-0.5808, -0.0045, -0.8140],
+                        [-0.5836, -0.6948, 0.4203]], np.float32)
+
+    def forward(self, x):
+        alpha = np.random.normal(0, self._m, 3).astype(np.float32)
+        rgb = (self._eigvec @ (alpha * self._eigval)).astype(np.float32)
+        from ....ndarray import array
+        return (x.astype("float32") + array(rgb)).clip(0, 255).astype(x.dtype)
+
+
+class RandomColorJitter(Block):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._ts = []
+        if brightness:
+            self._ts.append(RandomBrightness(brightness))
+        if contrast:
+            self._ts.append(RandomContrast(contrast))
+        if saturation:
+            self._ts.append(RandomSaturation(saturation))
+
+    def forward(self, x):
+        order = np.random.permutation(len(self._ts))
+        for i in order:
+            x = self._ts[i](x)
+        return x

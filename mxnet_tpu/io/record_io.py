@@ -1,0 +1,219 @@
+"""ctypes binding for the native RecordIO library, with python fallback.
+
+Reference: dmlc-core RecordIO + python/mxnet/recordio.py. The native side
+(src/recordio.cc) provides reader/writer and a multithreaded prefetching
+pipeline with (part_index, num_parts) sharding. Builds lazily with make on
+first use; the pure-python path keeps everything working without a
+toolchain.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+import threading
+from typing import Optional
+
+from ..base import MXNetError, check
+
+_LIB = None
+_LIB_TRIED = False
+_SRC_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "src")
+
+_MAGIC = 0xCED7230A
+
+
+def _load_lib():
+    global _LIB, _LIB_TRIED
+    if _LIB is not None or _LIB_TRIED:
+        return _LIB
+    _LIB_TRIED = True
+    so = os.path.join(_SRC_DIR, "libmxtpu_io.so")
+    if not os.path.exists(so):
+        try:
+            subprocess.run(["make", "-C", _SRC_DIR], check=True,
+                           capture_output=True, timeout=120)
+        except Exception:
+            return None
+    try:
+        lib = ctypes.CDLL(so)
+    except OSError:
+        return None
+    lib.recio_writer_open.restype = ctypes.c_void_p
+    lib.recio_writer_open.argtypes = [ctypes.c_char_p]
+    lib.recio_writer_write.restype = ctypes.c_int
+    lib.recio_writer_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       ctypes.c_uint64]
+    lib.recio_writer_tell.restype = ctypes.c_int64
+    lib.recio_writer_tell.argtypes = [ctypes.c_void_p]
+    lib.recio_writer_close.argtypes = [ctypes.c_void_p]
+    lib.recio_reader_open.restype = ctypes.c_void_p
+    lib.recio_reader_open.argtypes = [ctypes.c_char_p]
+    lib.recio_reader_next.restype = ctypes.c_int64
+    lib.recio_reader_next.argtypes = [ctypes.c_void_p]
+    lib.recio_reader_data.restype = ctypes.POINTER(ctypes.c_char)
+    lib.recio_reader_data.argtypes = [ctypes.c_void_p]
+    lib.recio_reader_seek.restype = ctypes.c_int
+    lib.recio_reader_seek.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.recio_reader_tell.restype = ctypes.c_int64
+    lib.recio_reader_tell.argtypes = [ctypes.c_void_p]
+    lib.recio_reader_close.argtypes = [ctypes.c_void_p]
+    lib.recio_pipeline_create.restype = ctypes.c_void_p
+    lib.recio_pipeline_create.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                          ctypes.c_int, ctypes.c_int,
+                                          ctypes.c_int, ctypes.c_uint64]
+    lib.recio_pipeline_size.restype = ctypes.c_int64
+    lib.recio_pipeline_size.argtypes = [ctypes.c_void_p]
+    lib.recio_pipeline_next.restype = ctypes.c_int64
+    lib.recio_pipeline_next.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                        ctypes.c_int64]
+    lib.recio_pipeline_reset.argtypes = [ctypes.c_void_p]
+    lib.recio_pipeline_destroy.argtypes = [ctypes.c_void_p]
+    _LIB = lib
+    return _LIB
+
+
+def native_available() -> bool:
+    return _load_lib() is not None
+
+
+class RecordWriter:
+    """Sequential record writer (native when available)."""
+
+    def __init__(self, path: str):
+        self._lib = _load_lib()
+        self._path = path
+        if self._lib is not None:
+            self._h = self._lib.recio_writer_open(path.encode())
+            check(self._h, f"cannot open {path} for writing")
+            self._fp = None
+        else:
+            self._fp = open(path, "wb")
+            self._h = None
+
+    def write(self, data: bytes) -> None:
+        if self._h is not None:
+            check(self._lib.recio_writer_write(self._h, data, len(data)) == 0,
+                  "recordio write failed")
+        else:
+            lrec = len(data) & ((1 << 29) - 1)
+            self._fp.write(struct.pack("<II", _MAGIC, lrec))
+            self._fp.write(data)
+            pad = (4 - (len(data) & 3)) & 3
+            if pad:
+                self._fp.write(b"\x00" * pad)
+
+    def tell(self) -> int:
+        if self._h is not None:
+            return self._lib.recio_writer_tell(self._h)
+        return self._fp.tell()
+
+    def close(self) -> None:
+        if self._h is not None:
+            self._lib.recio_writer_close(self._h)
+            self._h = None
+        elif self._fp is not None:
+            self._fp.close()
+            self._fp = None
+
+
+class RecordReader:
+    """Sequential record reader (native when available)."""
+
+    def __init__(self, path: str):
+        self._lib = _load_lib()
+        self._path = path
+        if self._lib is not None:
+            self._h = self._lib.recio_reader_open(path.encode())
+            check(self._h, f"cannot open {path}")
+            self._fp = None
+        else:
+            self._fp = open(path, "rb")
+            self._h = None
+
+    def read(self) -> Optional[bytes]:
+        if self._h is not None:
+            n = self._lib.recio_reader_next(self._h)
+            if n < 0:
+                return None
+            return ctypes.string_at(self._lib.recio_reader_data(self._h), n)
+        parts = []
+        while True:
+            head = self._fp.read(8)
+            if len(head) < 8:
+                return None
+            magic, lrec = struct.unpack("<II", head)
+            if magic != _MAGIC:
+                return None
+            length = lrec & ((1 << 29) - 1)
+            flag = lrec >> 29
+            parts.append(self._fp.read(length))
+            pad = (4 - (length & 3)) & 3
+            if pad:
+                self._fp.read(pad)
+            if flag in (0, 3):
+                break
+        return b"".join(parts)
+
+    def seek(self, pos: int) -> None:
+        if self._h is not None:
+            self._lib.recio_reader_seek(self._h, pos)
+        else:
+            self._fp.seek(pos)
+
+    def tell(self) -> int:
+        if self._h is not None:
+            return self._lib.recio_reader_tell(self._h)
+        return self._fp.tell()
+
+    def close(self) -> None:
+        if self._h is not None:
+            self._lib.recio_reader_close(self._h)
+            self._h = None
+        elif self._fp is not None:
+            self._fp.close()
+            self._fp = None
+
+
+class RecordPipeline:
+    """Threaded prefetching pipeline over a .rec file with distributed
+    sharding (ref: iter_image_recordio_2.cc part_index/num_parts)."""
+
+    def __init__(self, path: str, num_threads: int = 4, part_index: int = 0,
+                 num_parts: int = 1, shuffle: bool = False, seed: int = 0,
+                 max_record: int = 1 << 24):
+        self._lib = _load_lib()
+        check(self._lib is not None,
+              "native IO library unavailable (g++ build failed)")
+        self._h = self._lib.recio_pipeline_create(
+            path.encode(), num_threads, part_index, num_parts,
+            1 if shuffle else 0, seed)
+        check(self._h, f"cannot open pipeline on {path}")
+        self._buf = ctypes.create_string_buffer(max_record)
+
+    def __len__(self):
+        return self._lib.recio_pipeline_size(self._h)
+
+    def next(self) -> Optional[bytes]:
+        n = self._lib.recio_pipeline_next(self._h, self._buf,
+                                          len(self._buf))
+        if n < 0:
+            return None
+        check(n <= len(self._buf), "record larger than pipeline buffer")
+        return self._buf.raw[:n]
+
+    def reset(self) -> None:
+        self._lib.recio_pipeline_reset(self._h)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.recio_pipeline_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -472,6 +472,7 @@ def imperative_invoke(op_name: str, nd_inputs: Sequence, params: dict,
     out_nds = tuple(NDArray(o, ctx=ctx) for o in outputs)
 
     from .. import autograd
+    autograd._observe_capture(nd_inputs, out_nds)
     if autograd.is_recording() and opdef.differentiable:
         autograd._record_op(opdef, params, nd_inputs, arrays, out_nds)
 

@@ -158,6 +158,9 @@ def invoke_jax(opdef: OpDef, arrays: Sequence, params: Dict[str, Any]):
     """
     params = normalize_params(params)
     key = hashable_params(params)
+    from .. import profiler as _prof
+    profiling = _prof.is_active()
+    t0 = __import__("time").perf_counter() if profiling else 0.0
     try:
         out = opdef.jitted(key)(*arrays)
     except TypeError:
@@ -167,6 +170,9 @@ def invoke_jax(opdef: OpDef, arrays: Sequence, params: Dict[str, Any]):
     if _naive_engine():
         import jax
         jax.block_until_ready(out)
+    if profiling:
+        _prof.record_span(opdef.name, "operator", t0,
+                          __import__("time").perf_counter())
     return out
 
 

@@ -1,0 +1,220 @@
+"""Profiler: chrome://tracing output + aggregate stats.
+
+Reference: src/profiler/profiler.h (Profiler singleton, ProfileTask/Event/
+Counter/Domain objects, chrome-trace JSON default profile.json :456,
+aggregate stats table dumped by mx.profiler.dumps(); python surface
+python/mxnet/profiler.py:42-64).
+
+TPU-native: two layers of tracing.
+1. Framework level (this module): every eager op dispatch, CachedOp/
+   Executor invocation and custom scope is recorded with wall-clock spans
+   into chrome-trace JSON + an aggregate table — same artifact formats as
+   the reference.
+2. Device level: XLA/TPU execution detail comes from the JAX profiler;
+   ``start_xla_trace(logdir)`` / ``stop_xla_trace`` wrap it (TensorBoard/
+   perfetto consumable) — the analog of the reference's VTune/NVTX hooks.
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import threading
+import time
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+from .base import MXNetError, check, env
+
+__all__ = ["set_config", "set_state", "state", "dump", "dumps", "pause",
+           "resume", "Domain", "Task", "Event", "Frame", "Counter",
+           "Marker", "record_span", "start_xla_trace", "stop_xla_trace"]
+
+_lock = threading.Lock()
+_config = {"filename": "profile.json", "profile_all": False,
+           "profile_symbolic": True, "profile_imperative": True,
+           "profile_memory": False, "profile_api": False,
+           "aggregate_stats": False, "continuous_dump": False}
+_state = {"running": False, "paused": False}
+_events: List[Dict[str, Any]] = []
+_agg: Dict[str, List[float]] = defaultdict(list)
+_t0 = time.perf_counter()
+
+
+def set_config(**kwargs) -> None:
+    """(ref: MXSetProcessProfilerConfig / python profiler.set_config)"""
+    for k, v in kwargs.items():
+        _config[k] = v
+
+
+def set_state(state_name: str = "stop", profile_process: str = "worker") -> None:
+    check(state_name in ("run", "stop"), "state must be run|stop")
+    was = _state["running"]
+    _state["running"] = state_name == "run"
+    if was and not _state["running"] and _config.get("continuous_dump"):
+        dump()
+
+
+def state() -> str:
+    return "run" if _state["running"] else "stop"
+
+
+def pause(profile_process: str = "worker") -> None:
+    _state["paused"] = True
+
+
+def resume(profile_process: str = "worker") -> None:
+    _state["paused"] = False
+
+
+def is_active() -> bool:
+    return _state["running"] and not _state["paused"]
+
+
+def record_span(name: str, category: str, t_start: float, t_end: float,
+                args: Optional[dict] = None) -> None:
+    """Append one complete event (chrome trace 'X' phase)."""
+    if not is_active():
+        return
+    with _lock:
+        _events.append({
+            "name": name, "cat": category, "ph": "X",
+            "ts": (t_start - _t0) * 1e6,
+            "dur": (t_end - t_start) * 1e6,
+            "pid": 0, "tid": threading.get_ident() % 100000,
+            "args": args or {},
+        })
+        if _config.get("aggregate_stats"):
+            _agg[f"{category}::{name}"].append((t_end - t_start) * 1e3)
+
+
+def dump(finished: bool = True, profile_process: str = "worker") -> None:
+    """Write chrome-trace JSON (ref: profiler.h:437 dump to profile.json)."""
+    with _lock:
+        payload = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
+    with open(_config["filename"], "w") as f:
+        json.dump(payload, f)
+
+
+def dumps(reset: bool = False) -> str:
+    """Aggregate stats table (ref: AggregateStats dump, mx.profiler.dumps)."""
+    with _lock:
+        lines = [f"{'Name':<50}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>10}"
+                 f"{'Min':>10}{'Max':>10}"]
+        for name, times in sorted(_agg.items(),
+                                  key=lambda kv: -sum(kv[1])):
+            lines.append(f"{name[:50]:<50}{len(times):>8}"
+                         f"{sum(times):>12.3f}"
+                         f"{sum(times) / len(times):>10.3f}"
+                         f"{min(times):>10.3f}{max(times):>10.3f}")
+        if reset:
+            _agg.clear()
+    return "\n".join(lines)
+
+
+class Domain:
+    """(ref: profiler.h ProfileDomain)"""
+
+    def __init__(self, name: str):
+        self.name = name
+
+
+class _Scope:
+    _category = "scope"
+
+    def __init__(self, name: str, domain: Optional[Domain] = None):
+        self.name = name if domain is None else f"{domain.name}:{name}"
+        self._start = None
+
+    def start(self):
+        self._start = time.perf_counter()
+
+    def stop(self):
+        if self._start is not None:
+            record_span(self.name, self._category, self._start,
+                        time.perf_counter())
+            self._start = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *a):
+        self.stop()
+
+
+class Task(_Scope):
+    _category = "task"
+
+
+class Event(_Scope):
+    _category = "event"
+
+
+class Frame(_Scope):
+    _category = "frame"
+
+
+class Marker:
+    def __init__(self, name: str, domain: Optional[Domain] = None):
+        self.name = name
+
+    def mark(self, scope: str = "process") -> None:
+        if is_active():
+            with _lock:
+                _events.append({"name": self.name, "ph": "i",
+                                "ts": (time.perf_counter() - _t0) * 1e6,
+                                "pid": 0, "tid": 0, "s": "g"})
+
+
+class Counter:
+    """(ref: profiler.h ProfileCounter)"""
+
+    def __init__(self, name: str, domain: Optional[Domain] = None, value=0):
+        self.name = name
+        self.value = value
+
+    def set_value(self, value) -> None:
+        self.value = value
+        if is_active():
+            with _lock:
+                _events.append({"name": self.name, "ph": "C",
+                                "ts": (time.perf_counter() - _t0) * 1e6,
+                                "pid": 0,
+                                "args": {"value": value}})
+
+    def increment(self, delta=1):
+        self.set_value(self.value + delta)
+
+    def decrement(self, delta=1):
+        self.set_value(self.value - delta)
+
+    __iadd__ = lambda self, d: (self.increment(d), self)[1]
+    __isub__ = lambda self, d: (self.decrement(d), self)[1]
+
+
+# -- XLA/TPU device-level tracing ------------------------------------------
+
+_xla_trace_dir = None
+
+
+def start_xla_trace(logdir: str = "/tmp/mxnet_tpu_trace") -> None:
+    """Device-level profile via the JAX profiler (TensorBoard format)."""
+    global _xla_trace_dir
+    import jax
+    jax.profiler.start_trace(logdir)
+    _xla_trace_dir = logdir
+
+
+def stop_xla_trace() -> Optional[str]:
+    global _xla_trace_dir
+    import jax
+    if _xla_trace_dir is not None:
+        jax.profiler.stop_trace()
+        d, _xla_trace_dir = _xla_trace_dir, None
+        return d
+    return None
+
+
+if env.get("MXNET_PROFILER_AUTOSTART"):
+    set_state("run")
+    atexit.register(dump)

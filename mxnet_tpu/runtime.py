@@ -1,0 +1,67 @@
+"""Runtime feature detection (ref: src/libinfo.cc + python/mxnet/runtime.py).
+
+``Features`` enumerates what this build/runtime supports; on TPU the
+interesting axes are the backend platform, available device kinds, and
+which subsystems are compiled in (always-on here, since the framework is
+pure-python + XLA + the native IO library).
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+__all__ = ["Feature", "Features", "feature_list"]
+
+
+class Feature:
+    def __init__(self, name: str, enabled: bool):
+        self.name = name
+        self.enabled = enabled
+
+    def __repr__(self):
+        return f"[{'✔' if self.enabled else '✖'} {self.name}]"
+
+
+class Features(dict):
+    """(ref: python/mxnet/runtime.py Features)"""
+
+    def __init__(self):
+        import jax
+        platforms = {d.platform for d in jax.devices()}
+        feats = {
+            "TPU": any(p not in ("cpu",) for p in platforms),
+            "CPU": True,
+            "XLA": True,
+            "PALLAS": True,
+            "BF16": True,
+            "INT8": True,
+            "DIST_KVSTORE": True,
+            "SPMD_MESH": True,
+            "RING_ATTENTION": True,
+            "OPENCV": False,
+            "CUDA": False,
+            "CUDNN": False,
+            "MKLDNN": False,
+            "TENSORRT": False,
+            "NATIVE_IO": _native_io_available(),
+            "SIGNAL_HANDLER": True,
+            "PROFILER": True,
+        }
+        super().__init__({k: Feature(k, v) for k, v in feats.items()})
+
+    def is_enabled(self, name: str) -> bool:
+        return self[name.upper()].enabled
+
+    def __repr__(self):
+        return "[" + ", ".join(repr(v) for v in self.values()) + "]"
+
+
+def _native_io_available() -> bool:
+    try:
+        from .io import record_io
+        return record_io.native_available()
+    except Exception:
+        return False
+
+
+def feature_list():
+    return list(Features().values())

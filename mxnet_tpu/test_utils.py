@@ -1,0 +1,212 @@
+"""Testing fixtures (ref: python/mxnet/test_utils.py).
+
+The reference's op-correctness strategy (SURVEY.md §4): numeric-gradient
+checking + cross-backend consistency rather than golden files. Both are
+provided here; "backends" on TPU means cpu-vs-tpu and dtype sweeps.
+"""
+from __future__ import annotations
+
+import numbers
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .base import MXNetError, check
+from .context import Context, cpu, current_context
+from .ndarray import ndarray as _nd
+
+__all__ = ["default_context", "set_default_context", "assert_almost_equal",
+           "almost_equal", "rand_ndarray", "rand_shape_2d", "rand_shape_3d",
+           "rand_shape_nd", "check_numeric_gradient", "check_consistency",
+           "numeric_grad", "simple_forward", "same", "random_arrays",
+           "assert_exception", "retry"]
+
+_default_ctx: List[Context] = []
+
+
+def default_context() -> Context:
+    """(ref: test_utils.py:52)"""
+    return _default_ctx[-1] if _default_ctx else current_context()
+
+
+def set_default_context(ctx: Context) -> None:
+    _default_ctx.clear()
+    _default_ctx.append(ctx)
+
+
+def same(a, b) -> bool:
+    return np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def almost_equal(a, b, rtol=1e-5, atol=1e-20) -> bool:
+    return np.allclose(np.asarray(a), np.asarray(b), rtol=rtol, atol=atol)
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-20, names=("a", "b")) -> None:
+    """(ref: test_utils.py:474)"""
+    a = a.asnumpy() if isinstance(a, _nd.NDArray) else np.asarray(a)
+    b = b.asnumpy() if isinstance(b, _nd.NDArray) else np.asarray(b)
+    if not np.allclose(a, b, rtol=rtol, atol=atol):
+        idx = np.unravel_index(np.argmax(np.abs(a - b)), a.shape) \
+            if a.shape else ()
+        raise AssertionError(
+            f"{names[0]} and {names[1]} differ: max abs err "
+            f"{np.max(np.abs(a - b)):.3e} at {idx} "
+            f"({a[idx] if a.shape else a} vs {b[idx] if b.shape else b}), "
+            f"rtol={rtol} atol={atol}")
+
+
+def random_arrays(*shapes) -> List[np.ndarray]:
+    arrays = [np.random.randn(*s).astype(np.float32) if s else
+              np.float32(np.random.randn()) for s in shapes]
+    return arrays
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return tuple(np.random.randint(1, d + 1) for d in (dim0, dim1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return tuple(np.random.randint(1, d + 1) for d in (dim0, dim1, dim2))
+
+
+def rand_shape_nd(num_dim, dim=10):
+    return tuple(np.random.randint(1, dim + 1, size=num_dim))
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype=None,
+                 ctx=None, distribution="uniform") -> Any:
+    """(ref: test_utils.py:343 — incl. sparse densities)"""
+    dtype = dtype or np.float32
+    if distribution == "uniform":
+        arr = np.random.uniform(-1, 1, shape).astype(dtype)
+    else:
+        arr = np.random.randn(*shape).astype(dtype)
+    if stype == "default":
+        return _nd.array(arr, ctx=ctx)
+    density = 0.5 if density is None else density
+    mask = np.random.rand(shape[0]) < density
+    arr[~mask] = 0
+    from .ndarray import sparse
+    if stype == "row_sparse":
+        return sparse.row_sparse_array(arr, ctx=ctx)
+    if stype == "csr":
+        flat_mask = np.random.rand(*shape) < density
+        arr = arr * flat_mask
+        return sparse.csr_matrix(arr, ctx=ctx)
+    raise MXNetError(f"unknown stype {stype}")
+
+
+def numeric_grad(f, inputs: Sequence[np.ndarray], eps=1e-4) -> List[np.ndarray]:
+    """Central-difference gradients of scalar-valued f(*inputs)."""
+    grads = []
+    for i, x in enumerate(inputs):
+        g = np.zeros_like(x, dtype=np.float64)
+        flat = x.reshape(-1)
+        gf = g.reshape(-1)
+        for j in range(flat.size):
+            orig = flat[j]
+            flat[j] = orig + eps
+            fp = float(f(*inputs))
+            flat[j] = orig - eps
+            fm = float(f(*inputs))
+            flat[j] = orig
+            gf[j] = (fp - fm) / (2 * eps)
+        grads.append(g.astype(x.dtype))
+    return grads
+
+
+def check_numeric_gradient(op_name_or_fn, inputs: Sequence[np.ndarray],
+                           params: Optional[dict] = None, rtol=1e-2,
+                           atol=1e-4, eps=1e-3) -> None:
+    """Compare autograd gradients against finite differences
+    (ref: test_utils.py:801 check_numeric_gradient).
+
+    ``op_name_or_fn``: registered op name, or a callable taking NDArrays.
+    The op output is reduced with sum() to get a scalar.
+    """
+    from . import autograd
+    params = params or {}
+
+    def run(*np_inputs):
+        nds = [_nd.array(a) for a in np_inputs]
+        if callable(op_name_or_fn):
+            out = op_name_or_fn(*nds)
+        else:
+            out = _nd.imperative_invoke(op_name_or_fn, tuple(nds), params)
+        if isinstance(out, (list, tuple)):
+            out = out[0]
+        return out.asnumpy().astype(np.float64).sum()
+
+    # autograd gradients
+    nds = [_nd.array(a) for a in inputs]
+    for x in nds:
+        x.attach_grad()
+    with autograd.record():
+        if callable(op_name_or_fn):
+            out = op_name_or_fn(*nds)
+        else:
+            out = _nd.imperative_invoke(op_name_or_fn, tuple(nds), params)
+        if isinstance(out, (list, tuple)):
+            out = out[0]
+        loss = out.sum()
+    loss.backward()
+    sym_grads = [x.grad.asnumpy() for x in nds]
+
+    num_grads = numeric_grad(run, [a.astype(np.float64) for a in inputs],
+                             eps=eps)
+    for i, (sg, ng) in enumerate(zip(sym_grads, num_grads)):
+        assert_almost_equal(sg, ng.astype(sg.dtype), rtol=rtol, atol=atol,
+                            names=(f"autograd_grad[{i}]",
+                                   f"numeric_grad[{i}]"))
+
+
+def check_consistency(fn, inputs: Sequence[np.ndarray],
+                      dtypes=(np.float32, np.float64), rtol=1e-3,
+                      atol=1e-5) -> None:
+    """Run the same computation across dtypes and cross-check
+    (ref: test_utils.py:1224 check_consistency across ctx/dtype combos)."""
+    results = []
+    for dt in dtypes:
+        nds = [_nd.array(a.astype(dt) if a.dtype.kind == "f" else a)
+               for a in inputs]
+        out = fn(*nds)
+        if isinstance(out, (list, tuple)):
+            out = out[0]
+        results.append(out.asnumpy().astype(np.float64))
+    for r in results[1:]:
+        assert_almost_equal(results[0], r, rtol=rtol, atol=atol)
+
+
+def simple_forward(sym, ctx=None, is_train=False, **inputs):
+    """(ref: test_utils.py simple_forward)"""
+    ex = sym.bind(ctx or default_context(),
+                  args={k: _nd.array(v) for k, v in inputs.items()})
+    outs = ex.forward(is_train=is_train)
+    outs = [o.asnumpy() for o in outs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def assert_exception(f, exception_type, *args, **kwargs) -> None:
+    try:
+        f(*args, **kwargs)
+    except exception_type:
+        return
+    raise AssertionError(f"expected {exception_type}")
+
+
+def retry(n):
+    """Retry decorator for flaky statistical tests (ref: test_utils.retry)."""
+    def deco(f):
+        import functools
+
+        @functools.wraps(f)
+        def wrapper(*args, **kwargs):
+            for i in range(n):
+                try:
+                    return f(*args, **kwargs)
+                except AssertionError:
+                    if i == n - 1:
+                        raise
+        return wrapper
+    return deco

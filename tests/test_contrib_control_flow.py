@@ -1,0 +1,98 @@
+"""Control-flow tests (model: tests/python/unittest/test_contrib_control_flow.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.contrib import foreach, while_loop, cond
+
+
+def test_foreach_cumsum():
+    data = nd.array(np.arange(5).astype(np.float32))
+    init = nd.zeros((1,))
+
+    def body(item, state):
+        new = state + item
+        return new, new
+
+    outs, final = foreach(body, data, init)
+    assert outs.shape == (5, 1)
+    assert outs.asnumpy().ravel().tolist() == [0, 1, 3, 6, 10]
+    assert float(final.asscalar()) == 10
+
+
+def test_foreach_rnn_like():
+    T, N, H = 4, 2, 3
+    x = nd.array(np.random.RandomState(0).randn(T, N, H).astype(np.float32))
+    h0 = nd.zeros((N, H))
+    w = nd.array(np.eye(H, dtype=np.float32) * 0.5)
+
+    def body(xt, h):
+        new_h = nd.op.tanh(xt + nd.op.dot(h, w))
+        return new_h, new_h
+
+    outs, hT = foreach(body, x, h0)
+    assert outs.shape == (T, N, H)
+    # manual replay
+    h = np.zeros((N, H), np.float32)
+    xs = x.asnumpy()
+    for t in range(T):
+        h = np.tanh(xs[t] + h @ (np.eye(H) * 0.5))
+    assert np.allclose(hT.asnumpy(), h, atol=1e-5)
+
+
+def test_foreach_backward():
+    data = nd.array([1.0, 2.0, 3.0])
+    data.attach_grad()
+
+    def body(item, state):
+        new = state + item * item
+        return new, new
+
+    with autograd.record():
+        outs, final = foreach(body, data, nd.zeros((1,)))
+        loss = final.sum()
+    loss.backward()
+    assert np.allclose(data.grad.asnumpy(), [2, 4, 6])
+
+
+def test_while_loop():
+    def cond_fn(i, s):
+        return i < 5
+
+    def func(i, s):
+        return s + i, [i + 1, s + i]
+
+    outs, (i, s) = while_loop(cond_fn, func,
+                              [nd.array([0.0]), nd.array([0.0])],
+                              max_iterations=10)
+    assert float(i.asscalar()) == 5
+    assert float(s.asscalar()) == 10  # 0+1+2+3+4
+    assert outs.shape == (10, 1)
+
+
+def test_while_loop_backward():
+    x = nd.array([2.0])
+    x.attach_grad()
+
+    def cond_fn(i, s):
+        return i < 3
+
+    def func(i, s):
+        return s, [i + 1, s * x]
+
+    with autograd.record():
+        _, (i, s) = while_loop(cond_fn, func,
+                               [nd.array([0.0]), nd.array([1.0])],
+                               max_iterations=5)
+        loss = s.sum()  # s = x^3
+    loss.backward()
+    assert np.allclose(x.grad.asnumpy(), [12.0])  # 3x^2
+
+
+def test_cond():
+    a = nd.array([5.0])
+    b = nd.array([3.0])
+    out = cond(a > b, lambda: a * 2, lambda: b * 10)
+    assert float(out.asscalar()) == 10.0
+    out = cond(a < b, lambda: a * 2, lambda: b * 10)
+    assert float(out.asscalar()) == 30.0

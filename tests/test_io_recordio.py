@@ -1,0 +1,153 @@
+"""IO + RecordIO tests (model: tests/python/unittest/test_recordio.py,
+test_io.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import recordio
+from mxnet_tpu.io import NDArrayIter, CSVIter, ResizeIter, PrefetchingIter
+from mxnet_tpu.io.record_io import RecordPipeline, native_available
+
+
+def test_ndarray_iter_basic():
+    data = np.arange(40).reshape(10, 4).astype(np.float32)
+    label = np.arange(10).astype(np.float32)
+    it = NDArrayIter(data, label, batch_size=4, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (4, 4)
+    assert batches[2].pad == 2
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_ndarray_iter_discard_and_shuffle():
+    data = np.arange(30).reshape(10, 3).astype(np.float32)
+    it = NDArrayIter(data, None, batch_size=4, shuffle=True,
+                     last_batch_handle="discard")
+    batches = list(it)
+    assert len(batches) == 2
+
+
+def test_csv_iter(tmp_path):
+    f = str(tmp_path / "data.csv")
+    np.savetxt(f, np.arange(12).reshape(4, 3), delimiter=",")
+    it = CSVIter(data_csv=f, data_shape=(3,), batch_size=2)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (2, 3)
+
+
+def test_resize_iter():
+    data = np.zeros((8, 2), np.float32)
+    it = ResizeIter(NDArrayIter(data, None, batch_size=2), size=7)
+    assert len(list(it)) == 7
+
+
+def test_prefetching_iter():
+    data = np.arange(16).reshape(8, 2).astype(np.float32)
+    base = NDArrayIter(data, np.zeros(8, np.float32), batch_size=2)
+    it = PrefetchingIter(base)
+    batches = list(it)
+    assert len(batches) == 4
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "test.rec")
+    w = recordio.MXRecordIO(path, "w")
+    for i in range(20):
+        w.write(f"record-{i}".encode() * (i + 1))
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    for i in range(20):
+        rec = r.read()
+        assert rec == f"record-{i}".encode() * (i + 1)
+    assert r.read() is None
+    r.close()
+
+
+def test_indexed_recordio(tmp_path):
+    path = str(tmp_path / "test.rec")
+    idx_path = str(tmp_path / "test.idx")
+    w = recordio.MXIndexedRecordIO(idx_path, path, "w")
+    for i in range(10):
+        w.write_idx(i, f"item{i}".encode())
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx_path, path, "r")
+    assert r.read_idx(7) == b"item7"
+    assert r.read_idx(2) == b"item2"
+    assert len(r.keys) == 10
+    r.close()
+
+
+def test_pack_unpack():
+    h = recordio.IRHeader(0, 3.0, 7, 0)
+    s = recordio.pack(h, b"payload")
+    h2, payload = recordio.unpack(s)
+    assert payload == b"payload"
+    assert h2.label == 3.0
+    assert h2.id == 7
+    # vector label
+    h = recordio.IRHeader(0, [1.0, 2.0, 3.0], 1, 0)
+    h2, payload = recordio.unpack(recordio.pack(h, b"x"))
+    assert list(h2.label) == [1, 2, 3]
+
+
+def test_pack_img_roundtrip():
+    img = (np.random.RandomState(0).rand(4, 5, 3) * 255).astype(np.uint8)
+    s = recordio.pack_img(recordio.IRHeader(0, 1.0, 0, 0), img)
+    h, img2 = recordio.unpack_img(s)
+    assert np.array_equal(img, img2)
+
+
+@pytest.mark.skipif(not native_available(), reason="native lib not built")
+def test_native_pipeline(tmp_path):
+    path = str(tmp_path / "pipe.rec")
+    w = recordio.MXRecordIO(path, "w")
+    n = 100
+    for i in range(n):
+        w.write(struct_pack_i(i))
+    w.close()
+    pipe = RecordPipeline(path, num_threads=3)
+    assert len(pipe) == n
+    seen = set()
+    while True:
+        rec = pipe.next()
+        if rec is None:
+            break
+        seen.add(int.from_bytes(rec[:4], "little"))
+    assert seen == set(range(n))
+    # reset -> second epoch works
+    pipe.reset()
+    count = 0
+    while pipe.next() is not None:
+        count += 1
+    assert count == n
+    pipe.close()
+
+
+@pytest.mark.skipif(not native_available(), reason="native lib not built")
+def test_native_pipeline_sharding(tmp_path):
+    path = str(tmp_path / "shard.rec")
+    w = recordio.MXRecordIO(path, "w")
+    for i in range(10):
+        w.write(struct_pack_i(i))
+    w.close()
+    all_seen = set()
+    for part in range(2):
+        pipe = RecordPipeline(path, num_threads=1, part_index=part,
+                              num_parts=2)
+        while True:
+            rec = pipe.next()
+            if rec is None:
+                break
+            all_seen.add(int.from_bytes(rec[:4], "little"))
+        pipe.close()
+    assert all_seen == set(range(10))
+
+
+def struct_pack_i(i):
+    return i.to_bytes(4, "little") + b"data" * 10
