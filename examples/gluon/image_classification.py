@@ -1,0 +1,82 @@
+"""BASELINE config #2: Gluon model-zoo ResNet training with hybridize()
+(ref: example/gluon/image_classification.py).
+
+--spmd uses the fused SPMDTrainer path (one XLA program per step) over a
+data-parallel mesh; default path is the classic Gluon loop
+(autograd.record + Trainer.step).
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, gluon
+from mxnet_tpu.gluon import nn, loss as gloss
+from mxnet_tpu.gluon.model_zoo.vision import get_model
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet50_v1")
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--num-steps", type=int, default=20)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--spmd", action="store_true",
+                    help="fused SPMD train step over the device mesh")
+    ap.add_argument("--bf16", action="store_true")
+    args = ap.parse_args()
+
+    mx.random.seed(0)
+    net = get_model(args.model)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+
+    rs = np.random.RandomState(0)
+    data = rs.randn(args.batch_size, 3, args.image_size,
+                    args.image_size).astype(np.float32)
+    label = rs.randint(0, 1000, args.batch_size).astype(np.float32)
+    lossfn = gloss.SoftmaxCrossEntropyLoss()
+
+    if args.spmd:
+        import jax.numpy as jnp
+        from mxnet_tpu.parallel import SPMDTrainer, auto_mesh
+        mesh = auto_mesh(prefer=("dp",)) if mx.num_tpus() > 1 else None
+        trainer = SPMDTrainer(net, lossfn, mesh=mesh, optimizer="sgd",
+                              optimizer_params={"learning_rate": args.lr,
+                                                "momentum": 0.9},
+                              dtype=jnp.bfloat16 if args.bf16 else None)
+        step = lambda: trainer.step(nd.array(data), nd.array(label))
+    else:
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": args.lr, "momentum": 0.9},
+                                kvstore="device")
+
+        def step():
+            with autograd.record():
+                loss = lossfn(net(nd.array(data)), nd.array(label))
+            loss.backward()
+            trainer.step(args.batch_size)
+            return loss.mean()
+
+    print("compiling...")
+    loss = step()
+    loss.wait_to_read() if hasattr(loss, "wait_to_read") else None
+    t0 = time.perf_counter()
+    for i in range(args.num_steps):
+        loss = step()
+    (loss.wait_to_read() if hasattr(loss, "wait_to_read")
+     else loss.block_until_ready())
+    dt = time.perf_counter() - t0
+    print(f"{args.model}: {args.batch_size * args.num_steps / dt:.1f} img/s "
+          f"(loss={float(loss if not hasattr(loss, 'asscalar') else loss.asscalar()):.3f})")
+
+
+if __name__ == "__main__":
+    main()

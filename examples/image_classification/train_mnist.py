@@ -1,0 +1,86 @@
+"""BASELINE config #1: LeNet on MNIST via the Module API
+(ref: example/image-classification/train_mnist.py).
+
+Uses real MNIST idx files when present under --data-dir, else a synthetic
+stand-in (zero-egress environment).
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+from mxnet_tpu.io import NDArrayIter, MNISTIter
+from mxnet_tpu.module import Module
+
+
+def lenet_symbol():
+    """LeNet-5 graph (ref: example/image-classification/symbols/lenet.py)."""
+    data = sym.var("data")
+    c1 = sym.Convolution(data, sym.var("conv1_weight"), sym.var("conv1_bias"),
+                         kernel=(5, 5), num_filter=20, name="conv1")
+    p1 = sym.Pooling(sym.Activation(c1, act_type="tanh"), kernel=(2, 2),
+                     pool_type="max", stride=(2, 2))
+    c2 = sym.Convolution(p1, sym.var("conv2_weight"), sym.var("conv2_bias"),
+                         kernel=(5, 5), num_filter=50, name="conv2")
+    p2 = sym.Pooling(sym.Activation(c2, act_type="tanh"), kernel=(2, 2),
+                     pool_type="max", stride=(2, 2))
+    f1 = sym.FullyConnected(sym.flatten(p2), sym.var("fc1_weight"),
+                            sym.var("fc1_bias"), num_hidden=500, name="fc1")
+    f2 = sym.FullyConnected(sym.Activation(f1, act_type="tanh"),
+                            sym.var("fc2_weight"), sym.var("fc2_bias"),
+                            num_hidden=10, name="fc2")
+    return sym.SoftmaxOutput(f2, sym.var("softmax_label"), name="softmax")
+
+
+def get_iters(data_dir, batch_size):
+    train_img = os.path.join(data_dir, "train-images-idx3-ubyte")
+    if os.path.exists(train_img) or os.path.exists(train_img + ".gz"):
+        train = MNISTIter(image=train_img,
+                          label=os.path.join(data_dir,
+                                             "train-labels-idx1-ubyte"),
+                          batch_size=batch_size, shuffle=True)
+        val = MNISTIter(image=os.path.join(data_dir, "t10k-images-idx3-ubyte"),
+                        label=os.path.join(data_dir, "t10k-labels-idx1-ubyte"),
+                        batch_size=batch_size, shuffle=False)
+        return train, val
+    # synthetic stand-in: 10 gaussian digit prototypes
+    rs = np.random.RandomState(0)
+    protos = rs.rand(10, 1, 28, 28).astype(np.float32)
+    y = rs.randint(0, 10, 2048)
+    x = protos[y] + 0.1 * rs.randn(2048, 1, 28, 28).astype(np.float32)
+    train = NDArrayIter(x[:1792], y[:1792].astype(np.float32),
+                        batch_size, shuffle=True)
+    val = NDArrayIter(x[1792:], y[1792:].astype(np.float32), batch_size)
+    return train, val
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data-dir", default=os.path.expanduser(
+        "~/.mxnet/datasets/mnist"))
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--num-epochs", type=int, default=3)
+    ap.add_argument("--ctx", default="cpu", choices=["cpu", "tpu", "gpu"])
+    args = ap.parse_args()
+
+    import logging
+    logging.basicConfig(level=logging.INFO)
+    ctx = {"cpu": mx.cpu, "tpu": mx.tpu, "gpu": mx.gpu}[args.ctx]()
+    train, val = get_iters(args.data_dir, args.batch_size)
+    mod = Module(lenet_symbol(), context=ctx)
+    mod.fit(train, eval_data=val, num_epoch=args.num_epochs,
+            optimizer="sgd", initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 20))
+    print("final accuracy:", dict(mod.score(val, "acc")))
+
+
+if __name__ == "__main__":
+    main()

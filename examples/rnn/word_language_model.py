@@ -1,0 +1,126 @@
+"""BASELINE config #3: LSTM word language model
+(ref: example/rnn/word_lm/train.py; cuDNN RNN -> fused lax.scan RNN).
+
+Trains on a local text corpus when given, else a synthetic char-level
+corpus. Embedding -> multi-layer fused LSTM -> tied-vocab decoder.
+"""
+import argparse
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, gluon
+from mxnet_tpu.gluon import nn, rnn, loss as gloss
+
+
+class RNNModel(gluon.HybridBlock):
+    """(ref: example/rnn/word_lm/model.py RNNModel)"""
+
+    def __init__(self, vocab_size, num_embed, num_hidden, num_layers,
+                 dropout=0.2, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.drop = nn.Dropout(dropout)
+            self.encoder = nn.Embedding(vocab_size, num_embed)
+            self.rnn = rnn.LSTM(num_hidden, num_layers, dropout=dropout,
+                                input_size=num_embed)
+            self.decoder = nn.Dense(vocab_size, in_units=num_hidden,
+                                    flatten=False)
+            self.num_hidden = num_hidden
+
+    def _imperative_call(self, inputs, hidden=None):
+        emb = self.drop(self.encoder(inputs))
+        if hidden is None:
+            output = self.rnn(emb)
+            new_hidden = None
+        else:
+            output, new_hidden = self.rnn(emb, hidden)
+        output = self.drop(output)
+        decoded = self.decoder(output)
+        if hidden is None:
+            return decoded
+        return decoded, new_hidden
+
+    def begin_state(self, batch_size):
+        return self.rnn.begin_state(batch_size)
+
+
+def batchify(ids, batch_size, seq_len):
+    n = (len(ids) - 1) // (batch_size * seq_len) * batch_size * seq_len
+    x = ids[:n].reshape(batch_size, -1).T  # (T_total, N)
+    y = ids[1:n + 1].reshape(batch_size, -1).T
+    for t0 in range(0, x.shape[0] - seq_len + 1, seq_len):
+        yield x[t0:t0 + seq_len], y[t0:t0 + seq_len]
+
+
+def load_corpus(path):
+    if path and os.path.exists(path):
+        with open(path) as f:
+            text = f.read()
+        vocab = sorted(set(text.split()))
+        stoi = {w: i for i, w in enumerate(vocab)}
+        ids = np.array([stoi[w] for w in text.split()], np.int32)
+        return ids, len(vocab)
+    rs = np.random.RandomState(0)
+    # synthetic markov-ish corpus: next token depends on current
+    V = 200
+    trans = rs.randint(0, V, (V, 4))
+    ids = [0]
+    for _ in range(60000):
+        ids.append(trans[ids[-1], rs.randint(0, 4)])
+    return np.array(ids, np.int32), V
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data", default=None, help="path to corpus txt")
+    ap.add_argument("--emsize", type=int, default=128)
+    ap.add_argument("--nhid", type=int, default=256)
+    ap.add_argument("--nlayers", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--bptt", type=int, default=35)
+    ap.add_argument("--lr", type=float, default=1.0)
+    ap.add_argument("--clip", type=float, default=0.25)
+    ap.add_argument("--epochs", type=int, default=2)
+    args = ap.parse_args()
+
+    ids, vocab = load_corpus(args.data)
+    print(f"corpus: {len(ids)} tokens, vocab {vocab}")
+    model = RNNModel(vocab, args.emsize, args.nhid, args.nlayers)
+    model.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(model.collect_params(), "sgd",
+                            {"learning_rate": args.lr}, kvstore=None)
+    lossfn = gloss.SoftmaxCrossEntropyLoss()
+
+    for epoch in range(args.epochs):
+        total_loss, n_batches = 0.0, 0
+        hidden = model.begin_state(args.batch_size)
+        t0 = time.time()
+        for x, y in batchify(ids, args.batch_size, args.bptt):
+            xb = nd.array(x, dtype="int32")
+            yb = nd.array(y.reshape(-1).astype(np.float32))
+            hidden = [h.detach() for h in hidden]
+            with autograd.record():
+                out, hidden = model._imperative_call(xb, hidden)
+                loss = lossfn(out.reshape((-1, vocab)), yb)
+            loss.backward()
+            grads = [p.grad() for p in model.collect_params().values()
+                     if p.grad_req != "null"]
+            gluon.utils.clip_global_norm(grads, args.clip * args.batch_size)
+            trainer.step(args.batch_size)
+            total_loss += float(loss.mean().asscalar())
+            n_batches += 1
+        ppl = math.exp(total_loss / n_batches)
+        wps = n_batches * args.batch_size * args.bptt / (time.time() - t0)
+        print(f"epoch {epoch}: ppl {ppl:.2f}, {wps:.0f} words/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,106 @@
+"""BASELINE config #4: sparse linear classification with a distributed
+kvstore (ref: example/sparse/linear_classification/train.py — csr data,
+row_sparse weight, kvstore dist_sync push/pull + row_sparse_pull).
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu import kvstore as kv_mod
+from mxnet_tpu.ndarray import sparse
+
+
+def synthetic_libsvm(num_samples=4096, num_features=10000, nnz=32, seed=0):
+    """Sparse binary classification data (stand-in for kdda/avazu)."""
+    rs = np.random.RandomState(seed)
+    w_true = rs.randn(num_features).astype(np.float32) * \
+        (rs.rand(num_features) < 0.05)
+    rows = []
+    labels = []
+    for _ in range(num_samples):
+        idx = rs.choice(num_features, nnz, replace=False)
+        val = rs.randn(nnz).astype(np.float32)
+        score = float(w_true[idx] @ val)
+        rows.append((idx, val))
+        labels.append(1.0 if score > 0 else 0.0)
+    return rows, np.array(labels, np.float32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--kvstore", default="dist_tpu_sync")
+    ap.add_argument("--num-features", type=int, default=10000)
+    ap.add_argument("--batch-size", type=int, default=256)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--lr", type=float, default=0.5)
+    args = ap.parse_args()
+
+    rows, labels = synthetic_libsvm(num_features=args.num_features)
+    kv = kv_mod.create(args.kvstore)
+    print(f"kvstore type={kv.type} rank={kv.rank}/{kv.num_workers}")
+
+    # weight lives in the store; workers row_sparse_pull only touched rows
+    weight = nd.zeros((args.num_features, 1))
+    kv.init("weight", weight)
+    # server-side additive update (the kvstore_dist_server ApplyUpdates
+    # analog): pushed values are deltas merged into the stored weight
+    kv.set_updater(lambda key, delta, stored:
+                   stored._rebind((stored + delta)._data))
+
+    n = len(labels)
+    steps = 0
+    for epoch in range(args.epochs):
+        t0 = time.time()
+        correct = 0
+        for b0 in range(0, n - args.batch_size + 1, args.batch_size):
+            batch = rows[b0:b0 + args.batch_size]
+            y = labels[b0:b0 + args.batch_size]
+            # active rows of this batch
+            all_idx = np.unique(np.concatenate([idx for idx, _ in batch]))
+            rid = nd.array(all_idx, dtype="int64")
+            w_rows = nd.zeros((len(all_idx), 1))
+            kv.row_sparse_pull("weight", out=w_rows, row_ids=rid)
+            remap = {int(i): k for k, i in enumerate(all_idx)}
+
+            # dense-per-batch computation over the active feature subspace
+            X = np.zeros((len(batch), len(all_idx)), np.float32)
+            for r, (idx, val) in enumerate(batch):
+                for i, v in zip(idx, val):
+                    X[r, remap[int(i)]] = v
+            Xn = nd.array(X)
+            yn = nd.array(y)
+            w_rows.attach_grad()
+            with autograd.record():
+                logits = nd.op.dot(Xn, w_rows).reshape((-1,))
+                loss = nd.op.relu(logits) - logits * yn + \
+                    nd.op.Activation(-nd.op.abs(logits), act_type="softrelu")
+                loss = loss.mean()
+            loss.backward()
+            # push row_sparse gradient for the touched rows only
+            grad_rows = w_rows.grad
+            scatter = sparse.RowSparseNDArray(
+                (grad_rows * args.lr * -1.0)._data, rid._data,
+                (args.num_features, 1))
+            # apply: pull full rows, add update, push back via updater
+            updated = w_rows - args.lr * grad_rows
+            dense_update = nd.zeros((args.num_features, 1))
+            dense_update[rid] = updated - w_rows
+            kv.push("weight", dense_update)
+            pred = (logits.asnumpy() > 0).astype(np.float32)
+            correct += int((pred == y).sum())
+            steps += 1
+        acc = correct / (steps and (n // args.batch_size) * args.batch_size)
+        print(f"epoch {epoch}: accuracy {correct / ((n // args.batch_size) * args.batch_size):.3f} "
+              f"({time.time() - t0:.1f}s)")
+
+
+if __name__ == "__main__":
+    main()

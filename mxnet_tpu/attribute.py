@@ -1,0 +1,48 @@
+"""AttrScope: scoped symbol attributes (ref: python/mxnet/attribute.py).
+
+The reference uses this for ``ctx_group`` model-parallel placement
+(example/model-parallel; AttrScope(ctx_group='dev1')). Here ctx_group attrs
+map to sharding groups consumed by the parallel layer (see
+parallel/sharding.ShardingPlan) instead of device ids.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+__all__ = ["AttrScope", "current", "attr_scope"]
+
+
+class _TLS(threading.local):
+    def __init__(self):
+        self.stack = []
+
+
+_tls = _TLS()
+
+
+class AttrScope:
+    def __init__(self, **kwargs):
+        self._attr = {k: str(v) for k, v in kwargs.items()}
+
+    def get(self, attr: Optional[Dict] = None) -> Dict:
+        merged = {}
+        for scope in _tls.stack:
+            merged.update(scope._attr)
+        if attr:
+            merged.update(attr)
+        return merged
+
+    def __enter__(self):
+        _tls.stack.append(self)
+        return self
+
+    def __exit__(self, *a):
+        _tls.stack.pop()
+
+
+def current() -> AttrScope:
+    return _tls.stack[-1] if _tls.stack else AttrScope()
+
+
+attr_scope = AttrScope

@@ -1,0 +1,91 @@
+"""Monitor: per-op output inspection for NaN hunting
+(ref: python/mxnet/monitor.py + MXExecutorSetMonitorCallback,
+src/c_api/c_api_executor.cc:648).
+
+TPU-native: whole-graph compilation means there are no per-op engine
+callbacks to hook; instead the Monitor evaluates the executor's internal
+outputs on demand (get_internals-style) or wraps eager dispatch. `tic/toc`
+semantics match the reference surface.
+"""
+from __future__ import annotations
+
+import re
+from typing import Callable, List, Optional, Tuple
+
+import numpy as _np
+
+from .base import MXNetError, check
+
+__all__ = ["Monitor"]
+
+
+def _default_stat(x) -> "object":
+    from .ndarray import array
+    return array(_np.asarray([float(_np.abs(x).mean())], dtype=_np.float32))
+
+
+class Monitor:
+    def __init__(self, interval: int, stat_func: Optional[Callable] = None,
+                 pattern: str = ".*", sort: bool = False,
+                 monitor_all: bool = False):
+        self.interval = interval
+        self.stat_func = stat_func or (lambda x: _default_stat(x))
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+        self.monitor_all = monitor_all
+        self.step = 0
+        self.activated = False
+        self.queue: List[Tuple[int, str, object]] = []
+        self._exes: List = []
+
+    def install(self, exe) -> None:
+        """(ref: monitor.py install_to_executor)"""
+        self._exes.append(exe)
+        exe.set_monitor_callback(self._stat_helper, self.monitor_all)
+
+    def _stat_helper(self, name, value) -> None:
+        if not self.activated or not self.re_prog.match(str(name)):
+            return
+        self.queue.append((self.step, str(name), self.stat_func(value)))
+
+    def tic(self) -> None:
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+
+    def toc(self) -> List:
+        if not self.activated:
+            self.step += 1
+            return []
+        # pull internal outputs from each installed executor
+        for exe in self._exes:
+            try:
+                internals = exe._symbol.get_internals()
+                names = internals.list_outputs()
+                arg_map = {n: a._data for n, a in exe.arg_dict.items()}
+                aux_map = {n: a._data for n, a in exe.aux_dict.items()}
+                from .symbol.executor import _walk
+                outs = _walk(internals, arg_map, aux_map, False)
+                for name, val in zip(names, outs):
+                    if self.re_prog.match(name):
+                        self.queue.append((self.step, name,
+                                           self.stat_func(_np.asarray(val))))
+            except Exception:
+                continue
+        self.activated = False
+        res = []
+        if self.sort:
+            self.queue.sort(key=lambda x: x[1])
+        for n, k, v_list in self.queue:
+            if not isinstance(v_list, (list, tuple)):
+                v_list = [v_list]
+            for v in v_list:
+                res.append((n, k, str(v.asnumpy() if hasattr(v, "asnumpy")
+                                      else v)))
+        self.step += 1
+        self.queue = []
+        return res
+
+    def toc_print(self) -> None:
+        for n, k, v in self.toc():
+            print(f"Batch: {n:7d} {k:30s} {v}")

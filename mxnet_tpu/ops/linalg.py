@@ -1,0 +1,176 @@
+"""Linear-algebra operators (ref: src/operator/tensor/la_op.cc on LAPACK /
+src/operator/c_lapack_api.h). XLA provides native lowerings for all of
+these on TPU; names/semantics mirror the reference's _linalg_* family
+(batch dims leading, lower-triangular convention)."""
+from __future__ import annotations
+
+import numpy as _np
+
+from .registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _jsl():
+    import jax.scipy.linalg as jsl
+    return jsl
+
+
+@register("_linalg_gemm2", aliases=("linalg_gemm2",))
+def _gemm2(a, b, transpose_a=False, transpose_b=False, alpha=1.0, axis=-2):
+    jnp = _jnp()
+    x = jnp.swapaxes(a, -1, -2) if transpose_a else a
+    y = jnp.swapaxes(b, -1, -2) if transpose_b else b
+    return alpha * jnp.matmul(x, y)
+
+
+@register("_linalg_gemm", aliases=("linalg_gemm",))
+def _gemm(a, b, c, transpose_a=False, transpose_b=False, alpha=1.0,
+          beta=1.0, axis=-2):
+    return _gemm2(a, b, transpose_a, transpose_b, alpha) + beta * c
+
+
+@register("_linalg_potrf", aliases=("linalg_potrf",))
+def _potrf(a, lower=True):
+    jnp = _jnp()
+    l = jnp.linalg.cholesky(a)
+    return l if lower else jnp.swapaxes(l, -1, -2)
+
+
+@register("_linalg_potri", aliases=("linalg_potri",))
+def _potri(l, lower=True):
+    # inverse of A from its cholesky factor: A^-1 = (L L^T)^-1
+    jnp = _jnp()
+    eye = jnp.broadcast_to(jnp.eye(l.shape[-1], dtype=l.dtype), l.shape)
+    linv = _jsl().solve_triangular(l, eye, lower=True)
+    return jnp.matmul(jnp.swapaxes(linv, -1, -2), linv)
+
+
+@register("_linalg_trsm", aliases=("linalg_trsm",))
+def _trsm(a, b, transpose=False, rightside=False, lower=True, alpha=1.0):
+    jsl, jnp = _jsl(), _jnp()
+    if rightside:
+        # X A = alpha B  ->  A^T X^T = alpha B^T
+        xt = jsl.solve_triangular(jnp.swapaxes(a, -1, -2),
+                                  jnp.swapaxes(alpha * b, -1, -2),
+                                  lower=not lower,
+                                  trans=1 if transpose else 0)
+        return jnp.swapaxes(xt, -1, -2)
+    return jsl.solve_triangular(a, alpha * b, lower=lower,
+                                trans=1 if transpose else 0)
+
+
+@register("_linalg_trmm", aliases=("linalg_trmm",))
+def _trmm(a, b, transpose=False, rightside=False, lower=True, alpha=1.0):
+    jnp = _jnp()
+    tri = jnp.tril(a) if lower else jnp.triu(a)
+    if transpose:
+        tri = jnp.swapaxes(tri, -1, -2)
+    return alpha * (jnp.matmul(b, tri) if rightside else jnp.matmul(tri, b))
+
+
+@register("_linalg_syrk", aliases=("linalg_syrk",))
+def _syrk(a, transpose=False, alpha=1.0):
+    jnp = _jnp()
+    at = jnp.swapaxes(a, -1, -2)
+    return alpha * (jnp.matmul(at, a) if transpose else jnp.matmul(a, at))
+
+
+@register("_linalg_gelqf", aliases=("linalg_gelqf",), num_outputs=2)
+def _gelqf(a):
+    # LQ: A = L Q with Q orthonormal rows — via QR of A^T
+    jnp = _jnp()
+    q, r = jnp.linalg.qr(jnp.swapaxes(a, -1, -2))
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+
+@register("_linalg_syevd", aliases=("linalg_syevd",), num_outputs=2)
+def _syevd(a):
+    jnp = _jnp()
+    w, v = jnp.linalg.eigh(a)
+    return jnp.swapaxes(v, -1, -2), w
+
+
+@register("_linalg_sumlogdiag", aliases=("linalg_sumlogdiag",))
+def _sumlogdiag(a):
+    jnp = _jnp()
+    d = jnp.diagonal(a, axis1=-2, axis2=-1)
+    return jnp.sum(jnp.log(d), axis=-1)
+
+
+@register("_linalg_extractdiag", aliases=("linalg_extractdiag",))
+def _extractdiag(a, offset=0):
+    return _jnp().diagonal(a, offset=offset, axis1=-2, axis2=-1)
+
+
+@register("_linalg_makediag", aliases=("linalg_makediag",))
+def _makediag(a, offset=0):
+    jnp = _jnp()
+    n = a.shape[-1] + abs(offset)
+    out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+    idx = jnp.arange(a.shape[-1])
+    if offset >= 0:
+        return out.at[..., idx, idx + offset].set(a)
+    return out.at[..., idx - offset, idx].set(a)
+
+
+@register("_linalg_extracttrian", aliases=("linalg_extracttrian",))
+def _extracttrian(a, offset=0, lower=True):
+    jnp = _jnp()
+    n = a.shape[-1]
+    rows, cols = jnp.tril_indices(n, k=offset) if lower \
+        else jnp.triu_indices(n, k=offset)
+    return a[..., rows, cols]
+
+
+@register("_linalg_maketrian", aliases=("linalg_maketrian",))
+def _maketrian(a, offset=0, lower=True):
+    jnp = _jnp()
+    # infer n from vector length: len = n(n+1)/2 for offset 0
+    ln = a.shape[-1]
+    n = int((_np.sqrt(8 * ln + 1) - 1) / 2) + abs(offset)
+    rows, cols = jnp.tril_indices(n, k=offset) if lower \
+        else jnp.triu_indices(n, k=offset)
+    out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+    return out.at[..., rows, cols].set(a)
+
+
+@register("_linalg_inverse", aliases=("linalg_inverse", "inverse"))
+def _inverse(a):
+    return _jnp().linalg.inv(a)
+
+
+@register("_linalg_det", aliases=("linalg_det", "det"))
+def _det(a):
+    return _jnp().linalg.det(a)
+
+
+@register("_linalg_slogdet", aliases=("linalg_slogdet", "slogdet"),
+          num_outputs=2)
+def _slogdet(a):
+    sign, logdet = _jnp().linalg.slogdet(a)
+    return sign, logdet
+
+
+@register("moments", num_outputs=2)
+def _moments(data, axes=None, keepdims=False):
+    jnp = _jnp()
+    ax = tuple(axes) if axes is not None else None
+    return jnp.mean(data, axis=ax, keepdims=keepdims), \
+        jnp.var(data, axis=ax, keepdims=keepdims)
+
+
+@register("histogram", differentiable=False, num_outputs=2)
+def _histogram(data, *maybe_bins, bin_cnt=None, range=None):
+    jnp = _jnp()
+    if maybe_bins:
+        hist, edges = jnp.histogram(data.ravel(), bins=maybe_bins[0])
+    else:
+        lo, hi = range if range is not None else (float(data.min()),
+                                                  float(data.max()))
+        hist, edges = jnp.histogram(data.ravel(), bins=bin_cnt,
+                                    range=(lo, hi))
+    return hist, edges

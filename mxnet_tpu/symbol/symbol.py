@@ -513,6 +513,10 @@ def var(name: str, attr=None, shape=None, lr_mult=None, wd_mult=None,
         dtype=None, init=None, stype=None, **kwargs) -> Symbol:
     """(ref: mx.sym.var / Variable)"""
     node = _Node(None, name, {}, [])
+    from ..attribute import current as _attr_current
+    scope_attrs = _attr_current().get()
+    if scope_attrs:
+        node.extra["attr"] = dict(scope_attrs)
     if shape is not None:
         node.extra["shape"] = tuple(shape)
     if dtype is not None:
@@ -561,6 +565,10 @@ def create(op_name: str, input_syms: Sequence[Symbol], params: Dict[str, Any],
             aux_node.extra["aux"] = True
             inputs.append((aux_node, 0))
     node = _Node(opdef, name, dict(params), inputs)
+    from ..attribute import current as _attr_current
+    scope_attrs = _attr_current().get()
+    if scope_attrs:
+        node.extra["attr"] = dict(scope_attrs)
     # mark already-supplied aux inputs
     for aux_i in opdef.aux_inputs:
         if aux_i < len(node.inputs):

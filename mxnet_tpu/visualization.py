@@ -1,0 +1,88 @@
+"""Network visualization (ref: python/mxnet/visualization.py —
+print_summary + plot_network graphviz)."""
+from __future__ import annotations
+
+import json
+from typing import Dict, Optional
+
+import numpy as _np
+
+from .base import MXNetError, check
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def print_summary(symbol, shape: Optional[Dict] = None,
+                  line_length: int = 120, positions=(.44, .64, .74, 1.)):
+    """Tabular per-layer summary (ref: visualization.py print_summary)."""
+    out_shapes = {}
+    if shape is not None:
+        internals = symbol.get_internals()
+        _, outs, _ = internals._infer_shape_impl(True, **shape)
+        for name, s in zip(internals.list_outputs(), outs):
+            out_shapes[name] = s
+
+    positions = [int(line_length * p) for p in positions]
+    fields = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    def print_row(values, pos):
+        line = ""
+        for v, p in zip(values, pos):
+            line = (line + str(v))[:p - 1].ljust(p)
+        print(line)
+
+    print("_" * line_length)
+    print_row(fields, positions)
+    print("=" * line_length)
+    total_params = 0
+    for node in symbol._topo():
+        if node.is_variable:
+            continue
+        name = node.name
+        op = node.op.name
+        key = f"{name}_output"
+        oshape = out_shapes.get(key, "")
+        n_params = 0
+        for inp, _ in node.inputs:
+            if inp.is_variable and not inp.extra.get("aux", False) and \
+                    "weight" in inp.name or "bias" in inp.name:
+                s = out_shapes.get(f"{inp.name}_output")
+                if s:
+                    n_params += int(_np.prod(s))
+        total_params += n_params
+        prev = ",".join(i.name for i, _ in node.inputs[:2])
+        print_row([f"{name} ({op})", oshape, n_params, prev], positions)
+    print("=" * line_length)
+    print(f"Total params: {total_params}")
+    print("_" * line_length)
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 dtype=None, node_attrs=None, hide_weights=True):
+    """Graphviz digraph of the symbol (ref: visualization.py plot_network).
+    Returns a graphviz.Digraph; requires the graphviz package at call time."""
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise MXNetError("plot_network requires the graphviz package")
+    dot = Digraph(name=title)
+    for node in symbol._topo():
+        if node.is_variable:
+            if hide_weights and ("weight" in node.name or "bias" in node.name
+                                 or node.extra.get("aux", False)):
+                continue
+            dot.node(node.name, node.name, shape="oval",
+                     fillcolor="#8dd3c7", style="filled")
+        else:
+            dot.node(node.name, f"{node.name}\n{node.op.name}", shape="box",
+                     fillcolor="#fb8072", style="filled")
+    for node in symbol._topo():
+        if node.is_variable:
+            continue
+        for inp, _ in node.inputs:
+            if hide_weights and inp.is_variable and \
+                    ("weight" in inp.name or "bias" in inp.name or
+                     inp.extra.get("aux", False)):
+                continue
+            dot.edge(inp.name, node.name)
+    return dot
