@@ -49,5 +49,13 @@ from . import contrib  # noqa: F401
 from . import parallel  # noqa: F401
 from . import models  # noqa: F401
 from . import lr_scheduler as _lr  # noqa: F401
+from . import image  # noqa: F401
+from . import rnn  # noqa: F401
+from . import attribute  # noqa: F401
+from .attribute import AttrScope  # noqa: F401
+from . import monitor  # noqa: F401
+from .monitor import Monitor  # noqa: F401
+from . import visualization  # noqa: F401
+from . import visualization as viz  # noqa: F401
 
 # `import mxnet_tpu as mx; mx.nd...` is the canonical spelling.

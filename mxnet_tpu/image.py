@@ -1,0 +1,314 @@
+"""mx.image — image manipulation + augmenters + ImageIter.
+
+Reference: python/mxnet/image/{image.py,detection.py} (OpenCV-backed
+imdecode/imresize + augmenter list + ImageIter). OpenCV is absent here;
+decode/resize are numpy/jax.image based. JPEG decode requires an image
+library — raw/npy-encoded records are supported natively, which is what
+the in-tree im2rec_np tool writes.
+"""
+from __future__ import annotations
+
+import os
+import random as _pyrandom
+from typing import List, Optional
+
+import numpy as _np
+
+from .base import MXNetError, check
+from .ndarray import ndarray as _nd
+from .io.io import DataIter, DataBatch, DataDesc
+
+__all__ = ["imresize", "imdecode", "fixed_crop", "center_crop",
+           "random_crop", "color_normalize", "resize_short", "HorizontalFlipAug",
+           "CastAug", "ColorNormalizeAug", "RandomCropAug", "CenterCropAug",
+           "ResizeAug", "ForceResizeAug", "BrightnessJitterAug",
+           "ContrastJitterAug", "SaturationJitterAug", "LightingAug",
+           "ColorJitterAug", "CreateAugmenter", "ImageIter"]
+
+
+def imdecode(buf, flag=1, to_rgb=True, out=None):
+    """Decode an npy-encoded image buffer (see recordio.pack_img)."""
+    import io as _io
+    arr = _np.load(_io.BytesIO(bytes(buf)), allow_pickle=False)
+    return _nd.array(arr)
+
+
+def imresize(src, w, h, interp=1):
+    import jax
+    data = src._data if isinstance(src, _nd.NDArray) else src
+    out = jax.image.resize(data.astype("float32"),
+                           (h, w) + tuple(data.shape[2:]), "bilinear")
+    return _nd.from_jax(out.astype(data.dtype))
+
+
+def resize_short(src, size, interp=2):
+    h, w = src.shape[:2]
+    if h > w:
+        new_w, new_h = size, int(size * h / w)
+    else:
+        new_w, new_h = int(size * w / h), size
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    out = src[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        out = imresize(out, size[0], size[1], interp)
+    return out
+
+
+def random_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = min(size[0], w), min(size[1], h)
+    x0 = _pyrandom.randint(0, w - new_w)
+    y0 = _pyrandom.randint(0, h - new_h)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = min(size[0], w), min(size[1], h)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    src = src - mean
+    if std is not None:
+        src = src / std
+    return src
+
+
+class Augmenter:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size = size
+
+    def __call__(self, src):
+        return resize_short(src, self.size)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size = size
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1])
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size = size
+
+    def __call__(self, src):
+        return random_crop(src, self.size)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size = size
+
+    def __call__(self, src):
+        return center_crop(src, self.size)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _pyrandom.random() < self.p:
+            return src.flip(axis=1)
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(typ=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return src.astype(self.typ)
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__()
+        self.mean = _nd.array(mean) if mean is not None else None
+        self.std = _nd.array(std) if std is not None else None
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__()
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + _pyrandom.uniform(-self.brightness, self.brightness)
+        return src * alpha
+
+
+class ContrastJitterAug(Augmenter):
+    def __init__(self, contrast):
+        super().__init__()
+        self.contrast = contrast
+        self.coef = _np.array([[[0.299, 0.587, 0.114]]], _np.float32)
+
+    def __call__(self, src):
+        alpha = 1.0 + _pyrandom.uniform(-self.contrast, self.contrast)
+        gray = (src * _nd.array(self.coef)).sum()
+        gray = (3.0 * (1.0 - alpha) / src.size) * gray
+        return src * alpha + gray
+
+
+class SaturationJitterAug(Augmenter):
+    def __init__(self, saturation):
+        super().__init__()
+        self.saturation = saturation
+        self.coef = _np.array([[[0.299, 0.587, 0.114]]], _np.float32)
+
+    def __call__(self, src):
+        alpha = 1.0 + _pyrandom.uniform(-self.saturation, self.saturation)
+        gray = (src * _nd.array(self.coef)).sum(axis=2, keepdims=True)
+        return src * alpha + gray * (1.0 - alpha)
+
+
+class LightingAug(Augmenter):
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__()
+        self.alphastd = alphastd
+        self.eigval = _np.asarray(eigval, _np.float32)
+        self.eigvec = _np.asarray(eigvec, _np.float32)
+
+    def __call__(self, src):
+        alpha = _np.random.normal(0, self.alphastd, size=(3,))
+        rgb = (self.eigvec * alpha * self.eigval).sum(axis=1)
+        return src + _nd.array(rgb.astype(_np.float32))
+
+
+class ColorJitterAug(Augmenter):
+    def __init__(self, brightness, contrast, saturation):
+        super().__init__()
+        self.augs = []
+        if brightness:
+            self.augs.append(BrightnessJitterAug(brightness))
+        if contrast:
+            self.augs.append(ContrastJitterAug(contrast))
+        if saturation:
+            self.augs.append(SaturationJitterAug(saturation))
+
+    def __call__(self, src):
+        for a in _np.random.permutation(len(self.augs)):
+            src = self.augs[a](src)
+        return src
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
+    """(ref: image.py CreateAugmenter)"""
+    auglist: List[Augmenter] = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if pca_noise > 0:
+        eigval = [55.46, 4.794, 1.148]
+        eigvec = [[-0.5675, 0.7192, 0.4009],
+                  [-0.5808, -0.0045, -0.8140],
+                  [-0.5836, -0.6948, 0.4203]]
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if mean is True:
+        mean = _np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = _np.array([58.395, 57.12, 57.375])
+    if mean is not None and len(_np.atleast_1d(mean)) > 0:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(DataIter):
+    """Image iterator over a .rec (npy-payload) or image list
+    (ref: image.py ImageIter; the C++ fast path is ImageRecordIter via
+    io.record_io.RecordPipeline)."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 shuffle=False, aug_list=None, part_index=0, num_parts=1,
+                 data_name="data", label_name="softmax_label", **kwargs):
+        super().__init__(batch_size)
+        check(path_imgrec is not None, "ImageIter requires path_imgrec")
+        check(len(data_shape) == 3, "data_shape must be (C, H, W)")
+        self.data_shape = tuple(data_shape)
+        from .io.record_io import RecordPipeline
+        self._pipe = RecordPipeline(path_imgrec, num_threads=4,
+                                    part_index=part_index,
+                                    num_parts=num_parts, shuffle=shuffle)
+        self.auglist = aug_list if aug_list is not None else \
+            CreateAugmenter(data_shape)
+        self._data_name = data_name
+        self._label_name = label_name
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self._data_name,
+                         (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(self._label_name, (self.batch_size,))]
+
+    def reset(self):
+        self._pipe.reset()
+
+    def next(self):
+        from .recordio import unpack_img
+        c, h, w = self.data_shape
+        batch = _np.zeros((self.batch_size, c, h, w), _np.float32)
+        labels = _np.zeros((self.batch_size,), _np.float32)
+        i = 0
+        while i < self.batch_size:
+            rec = self._pipe.next()
+            if rec is None:
+                if i == 0:
+                    raise StopIteration
+                break  # partial final batch: pad with wrap
+            header, img = unpack_img(rec)
+            x = _nd.array(img.astype(_np.float32))
+            for aug in self.auglist:
+                x = aug(x)
+            arr = x.asnumpy()
+            if arr.ndim == 3 and arr.shape[2] in (1, 3):
+                arr = arr.transpose(2, 0, 1)
+            batch[i] = arr
+            labels[i] = float(header.label) if _np.isscalar(header.label) \
+                or getattr(header.label, "size", 1) == 1 else header.label[0]
+            i += 1
+        return DataBatch([_nd.array(batch)], [_nd.array(labels)],
+                         pad=self.batch_size - i)

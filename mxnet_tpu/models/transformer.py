@@ -42,6 +42,11 @@ def _dt(config):
     return config.dtype or jnp.float32
 
 
+def _on_tpu() -> bool:
+    import jax
+    return jax.devices()[0].platform == "tpu"
+
+
 def init_params(key, config: TransformerConfig) -> Dict[str, Any]:
     import jax
     import jax.numpy as jnp
@@ -125,6 +130,10 @@ def _block(x, lp, config: TransformerConfig, mesh, act_spec):
     if mesh is not None and "sp" in mesh.axis_names and \
             dict(zip(mesh.axis_names, mesh.devices.shape))["sp"] > 1:
         attn = ring_attention(q, k, v, mesh, axis="sp", causal=config.causal)
+    elif _on_tpu() and t % 128 == 0 and hd >= 64:
+        # single-chip hot path: fused Pallas attention (no (T,T) in HBM)
+        from ..ops.pallas_kernels import flash_attention
+        attn = flash_attention(q, k, v, causal=config.causal)
     else:
         attn = attention(q, k, v, causal=config.causal)
     attn = attn.reshape(b, t, d)

@@ -15,5 +15,6 @@ from . import ordering  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import rnn_op  # noqa: F401
 from . import linalg  # noqa: F401
+from . import pallas_kernels  # noqa: F401
 
 from .registry import get_op, list_ops, register  # noqa: F401

@@ -1,0 +1,144 @@
+"""Pallas TPU kernels for hot ops.
+
+Where the reference hand-writes CUDA (src/operator/*.cu) or leans on cuDNN,
+the TPU build leans on XLA — except where fusion across the softmax is
+needed: attention. This module provides a fused attention kernel
+(flash-style: per-query-block compute with K/V streamed through VMEM, the
+(T, T) score matrix never hits HBM), following the playbook in
+/opt/skills/guides/pallas_guide.md.
+
+On non-TPU backends the kernel runs in interpret mode (correct, slow) so
+the test suite exercises the same code path.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import numpy as _np
+
+from ..base import MXNetError
+from .registry import register
+
+_BQ = 128  # query block (MXU-aligned)
+
+
+def _interpret_mode() -> bool:
+    import jax
+    return jax.devices()[0].platform not in ("tpu",)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_flash(t: int, d: int, causal: bool, scale: float, interpret: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+        vmem = pltpu.VMEM
+    except Exception:  # pragma: no cover
+        vmem = None
+
+    bq = min(_BQ, t)
+
+    def kernel(q_ref, k_ref, v_ref, o_ref):
+        qi = pl.program_id(1)
+        q = q_ref[0].astype(jnp.float32)          # (bq, d)
+        k = k_ref[0].astype(jnp.float32)          # (t, d)
+        v = v_ref[0].astype(jnp.float32)          # (t, d)
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (bq, t)
+        if causal:
+            qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, t), 0)
+            kpos = jax.lax.broadcasted_iota(jnp.int32, (bq, t), 1)
+            logits = jnp.where(qpos >= kpos, logits, -1e30)
+        m = jnp.max(logits, axis=-1, keepdims=True)
+        p = jnp.exp(logits - m)
+        l = jnp.sum(p, axis=-1, keepdims=True)
+        o = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32) / l
+        o_ref[0] = o.astype(o_ref.dtype)
+
+    def call(q, k, v):
+        bh = q.shape[0]
+        grid = (bh, t // bq if t % bq == 0 else -(-t // bq))
+        specs_kv = pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0))
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+            grid=grid,
+            in_specs=[pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
+                      specs_kv, specs_kv],
+            out_specs=pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
+            interpret=interpret,
+        )(q, k, v)
+
+    return call
+
+
+def flash_attention(q, k, v, causal: bool = False, scale=None):
+    """Fused attention. q,k,v: (B, T, H, D) -> (B, T, H, D).
+
+    Forward is the Pallas kernel; backward recomputes through the reference
+    jax formulation (jax.custom_vjp) — numerically identical, and XLA fuses
+    the recompute well.
+    """
+    import jax
+    import jax.numpy as jnp
+    from ..parallel.ring_attention import attention as ref_attention
+
+    b, t, h, d = q.shape
+    sc = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    @jax.custom_vjp
+    def _op(q, k, v):
+        qt = q.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+        kt = k.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+        vt = v.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+        call = _build_flash(t, d, causal, sc, _interpret_mode())
+        o = call(qt, kt, vt)
+        return o.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+    def fwd(q, k, v):
+        return _op(q, k, v), (q, k, v)
+
+    def bwd(res, g):
+        q, k, v = res
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: ref_attention(q_, k_, v_, causal=causal,
+                                             scale=sc), q, k, v)
+        return vjp(g)
+
+    _op.defvjp(fwd, bwd)
+    return _op(q, k, v)
+
+
+@register("_contrib_flash_attention", aliases=("flash_attention",))
+def _flash_attention_op(q, k, v, causal=False, scale=None):
+    return flash_attention(q, k, v, causal=causal, scale=scale)
+
+
+@register("_contrib_interleaved_matmul_selfatt_qk")
+def _interleaved_qk(qkv, heads=1):
+    """(ref: src/operator/contrib/transformer.cc interleaved matmul helpers)
+    qkv: (T, B, 3*H*D) interleaved; returns (B*H, T, T) scores."""
+    import jax.numpy as jnp
+    t, b, three_hd = qkv.shape
+    d = three_hd // (3 * heads)
+    x = qkv.reshape(t, b, heads, 3, d)
+    q = x[:, :, :, 0].transpose(1, 2, 0, 3).reshape(b * heads, t, d)
+    k = x[:, :, :, 1].transpose(1, 2, 0, 3).reshape(b * heads, t, d)
+    return jnp.matmul(q, k.transpose(0, 2, 1)) / math.sqrt(d)
+
+
+@register("_contrib_interleaved_matmul_selfatt_valatt")
+def _interleaved_valatt(qkv, att, heads=1):
+    import jax.numpy as jnp
+    t, b, three_hd = qkv.shape
+    d = three_hd // (3 * heads)
+    x = qkv.reshape(t, b, heads, 3, d)
+    v = x[:, :, :, 2].transpose(1, 2, 0, 3).reshape(b * heads, t, d)
+    out = jnp.matmul(att, v)  # (B*H, T, D)
+    return out.reshape(b, heads, t, d).transpose(2, 0, 1, 3) \
+        .reshape(t, b, heads * d)

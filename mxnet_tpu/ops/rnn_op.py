@@ -31,6 +31,22 @@ def _jnp():
 _GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
 
 
+@register("_state_zeros")
+def _state_zeros(x, num_hidden=1, batch_axis=0):
+    """Zero initial cell state shaped from a data symbol — keeps symbolic
+    shape inference forward-only (the reference fills state shapes with
+    bidirectional inference; we derive them instead)."""
+    jnp = _jnp()
+    return jnp.zeros((x.shape[batch_axis], num_hidden), jnp.float32)
+
+
+@register("_rnn_state_zeros")
+def _rnn_state_zeros(x, num_states=1, state_size=1):
+    """Zero fused-RNN state (L*D, N, H) derived from TNC data."""
+    jnp = _jnp()
+    return jnp.zeros((num_states, x.shape[1], state_size), jnp.float32)
+
+
 def rnn_param_size(num_layers: int, input_size: int, state_size: int,
                    bidirectional: bool, mode: str) -> int:
     """Total packed parameter count (ref: rnn-inl.h GetRnnParamSize)."""

@@ -1,0 +1,8 @@
+"""Legacy mx.rnn module (ref: python/mxnet/rnn/ — symbol-era RNN cells +
+BucketSentenceIter). The modern API is gluon.rnn; this provides surface
+parity for Module-based bucketing training (BASELINE config #3's
+example/rnn/bucketing path)."""
+from .rnn_cell import (BaseRNNCell, RNNCell, LSTMCell, GRUCell,  # noqa
+                       FusedRNNCell, SequentialRNNCell, BidirectionalCell,
+                       DropoutCell, ZoneoutCell, ResidualCell)
+from .io import BucketSentenceIter, encode_sentences  # noqa: F401

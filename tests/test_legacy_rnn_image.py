@@ -1,0 +1,94 @@
+"""Legacy mx.rnn + mx.image tests (model: tests/python/unittest/test_rnn.py,
+test_image.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu import rnn as mxrnn
+from mxnet_tpu.module import Module
+
+
+def test_symbol_lstm_cell_unroll():
+    cell = mxrnn.LSTMCell(16, prefix="l_")
+    data = sym.var("data")
+    outputs, states = cell.unroll(3, inputs=data, layout="NTC",
+                                  merge_outputs=True)
+    args = outputs.list_arguments()
+    assert "l_i2h_weight" in args and "l_h2h_weight" in args
+    ex = outputs.simple_bind(mx.cpu(), data=(2, 3, 8))
+    outs = ex.forward()
+    assert outs[0].shape == (2, 3, 16)
+
+
+def test_fused_rnn_cell_symbol():
+    cell = mxrnn.FusedRNNCell(12, num_layers=2, mode="lstm",
+                              get_next_state=True)
+    data = sym.var("data")
+    out, states = cell.unroll(5, inputs=data, layout="TNC")
+    ex = out.simple_bind(mx.cpu(), data=(5, 3, 6))
+    outs = ex.forward()
+    assert outs[0].shape == (5, 3, 12)
+    assert len(states) == 2
+
+
+def test_bucket_sentence_iter():
+    sentences = [[1, 2, 3], [4, 5, 6, 7, 8], [1, 2], [3] * 12]
+    it = mxrnn.BucketSentenceIter(sentences, batch_size=2, buckets=[5, 15],
+                                  invalid_label=0)
+    assert it.default_bucket_key == 15
+    batch = next(iter(it))
+    assert batch.data[0].shape[0] == 2
+
+
+def test_encode_sentences():
+    res, vocab = mxrnn.encode_sentences([["a", "b"], ["b", "c"]])
+    assert len(vocab) >= 3
+    assert res[0][1] == res[1][0]  # "b" same id
+
+
+def test_image_resize_crop():
+    from mxnet_tpu import image
+    img = nd.array(np.random.RandomState(0).rand(40, 60, 3).astype(np.float32))
+    r = image.imresize(img, 30, 20)
+    assert r.shape == (20, 30, 3)
+    c, rect = image.center_crop(img, (20, 20))
+    assert c.shape == (20, 20, 3)
+    rc, _ = image.random_crop(img, (16, 16))
+    assert rc.shape == (16, 16, 3)
+    s = image.resize_short(img, 30)
+    assert min(s.shape[:2]) == 30
+
+
+def test_image_augmenters():
+    from mxnet_tpu import image
+    augs = image.CreateAugmenter((3, 24, 24), rand_mirror=True,
+                                 brightness=0.1, mean=True, std=True)
+    img = nd.array(np.random.RandomState(0).rand(32, 32, 3).astype(np.float32) * 255)
+    for aug in augs:
+        img = aug(img)
+    assert img.shape == (24, 24, 3)
+
+
+def test_image_iter_over_rec(tmp_path):
+    from mxnet_tpu import image, recordio
+    path = str(tmp_path / "imgs.rec")
+    w = recordio.MXRecordIO(path, "w")
+    rs = np.random.RandomState(0)
+    for i in range(20):
+        img = (rs.rand(32, 32, 3) * 255).astype(np.uint8)
+        w.write(recordio.pack_img(recordio.IRHeader(0, float(i % 4), i, 0),
+                                  img))
+    w.close()
+    it = image.ImageIter(batch_size=4, data_shape=(3, 24, 24),
+                         path_imgrec=path,
+                         aug_list=image.CreateAugmenter((3, 24, 24)))
+    batch = it.next()
+    assert batch.data[0].shape == (4, 3, 24, 24)
+    assert batch.label[0].shape == (4,)
+
+
+def test_attr_scope_ctx_group():
+    with mx.AttrScope(ctx_group="dev1"):
+        a = sym.var("a")
+    assert a.attr("ctx_group") == "dev1"
