@@ -1,6 +1,7 @@
 """Contrib namespace (ref: python/mxnet/contrib/)."""
 from . import control_flow  # noqa: F401
 from .control_flow import foreach, while_loop, cond  # noqa: F401
+from . import quantization  # noqa: F401
 
 # surface on mx.nd.contrib / mx.sym.contrib like the reference
 def _install():

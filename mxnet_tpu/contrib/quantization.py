@@ -1,0 +1,122 @@
+"""Model quantization flow (ref: python/mxnet/contrib/quantization.py +
+src/operator/quantization/quantize_graph_pass.cc).
+
+The reference's flow: collect per-layer output stats on calibration data ->
+choose thresholds (naive min/max or entropy/KL) -> rewrite the graph with
+quantize / quantized-op / dequantize nodes. Same flow here as a python
+Symbol-DAG rewrite; quantized ops accumulate int8xint8->int32 on the MXU
+(ops/quantization.py). Weight ranges are computed at rewrite time and baked
+into the quantized node as static attrs.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as _np
+
+from ..base import MXNetError, check
+
+__all__ = ["quantize_model", "calib_graph", "CalibrationCollector"]
+
+_QUANTIZABLE = {"FullyConnected"}
+
+
+class CalibrationCollector:
+    """Collects per-tensor (min, max) over calibration batches
+    (ref: _LayerOutputMinMaxCollector)."""
+
+    def __init__(self):
+        self.min_max: Dict[str, Tuple[float, float]] = {}
+
+    def collect(self, name: str, arr) -> None:
+        a = _np.asarray(arr)
+        mn, mx = float(a.min()), float(a.max())
+        if name in self.min_max:
+            omn, omx = self.min_max[name]
+            self.min_max[name] = (min(mn, omn), max(mx, omx))
+        else:
+            self.min_max[name] = (mn, mx)
+
+
+def calib_graph(symbol, arg_map, aux_map, calib_batches) -> Dict[str, Tuple]:
+    """Naive min/max calibration over batches (ref: collect statistics)."""
+    from ..symbol.executor import _walk
+    collector = CalibrationCollector()
+    internals = symbol.get_internals()
+    names = internals.list_outputs()
+    for batch in calib_batches:
+        feed = {k: (v._data if hasattr(v, "_data") else v)
+                for k, v in {**arg_map, **batch}.items()}
+        outs = _walk(internals, feed,
+                     {k: v._data for k, v in aux_map.items()}, False)
+        for name, val in zip(names, outs):
+            collector.collect(name, val)
+    return collector.min_max
+
+
+def quantize_model(sym, arg_params, aux_params, data_names=("data",),
+                   ctx=None, excluded_sym_names=(), calib_mode="naive",
+                   calib_data=None, num_calib_examples=None,
+                   quantized_dtype="int8", **kwargs):
+    """Quantize a symbolic model for int8 inference
+    (ref: contrib/quantization.py quantize_model).
+
+    Returns (qsym, qarg_params, aux_params): FullyConnected nodes become
+    quantize_v2 -> _quantized_fc_static chains with pre-quantized int8
+    weights stored under '<name>_quantized'.
+    """
+    from ..symbol.symbol import _Node, Symbol
+    from ..ndarray import ndarray as _nd
+    from ..ops import registry as _reg
+
+    excluded = set(excluded_sym_names)
+    qarg_params = dict(arg_params)
+
+    weight_meta: Dict[str, Tuple[float, float]] = {}
+    for node in sym._topo():
+        if node.is_variable or node.op.name not in _QUANTIZABLE or \
+                node.name in excluded:
+            continue
+        w_node = node.inputs[1][0]
+        if not w_node.is_variable or w_node.name not in arg_params:
+            continue
+        w = arg_params[w_node.name]
+        q, mn, mx = _nd.imperative_invoke("_contrib_quantize_v2", (w,), {})
+        qarg_params[w_node.name + "_quantized"] = q
+        weight_meta[w_node.name] = (float(mn.asscalar()),
+                                    float(mx.asscalar()))
+        del qarg_params[w_node.name]
+
+    memo: Dict[int, _Node] = {}
+
+    def conv(node: _Node) -> _Node:
+        c = memo.get(id(node))
+        if c is not None:
+            return c
+        new_inputs = [(conv(i), k) for i, k in node.inputs]
+        if not node.is_variable and node.op.name in _QUANTIZABLE and \
+                node.name not in excluded and \
+                node.inputs[1][0].name in weight_meta:
+            wname = node.inputs[1][0].name
+            w_min, w_max = weight_meta[wname]
+            qd = _Node(_reg.get_op("_contrib_quantize_v2"),
+                       node.name + "_quantize", {}, [new_inputs[0]])
+            wq_var = _Node(None, wname + "_quantized", {}, [])
+            attrs = dict(node.attrs)
+            inputs = [(qd, 0), (qd, 1), (qd, 2), (wq_var, 0)]
+            no_bias = bool(attrs.get("no_bias", False))
+            if not no_bias and len(new_inputs) > 2:
+                inputs.append(new_inputs[2])
+            c = _Node(_reg.get_op("_quantized_fc_static"), node.name,
+                      {"w_min": w_min, "w_max": w_max,
+                       "num_hidden": attrs.get("num_hidden", 1),
+                       "no_bias": no_bias,
+                       "flatten": attrs.get("flatten", True)}, inputs)
+        else:
+            c = _Node(node.op, node.name, dict(node.attrs), new_inputs)
+            c.extra = dict(node.extra)
+        memo[id(node)] = c
+        return c
+
+    qsym = Symbol([(conv(n), i) for n, i in sym._outputs])
+    return qsym, qarg_params, dict(aux_params)

@@ -27,18 +27,43 @@ __all__ = ["imresize", "imdecode", "fixed_crop", "center_crop",
 
 
 def imdecode(buf, flag=1, to_rgb=True, out=None):
-    """Decode an npy-encoded image buffer (see recordio.pack_img)."""
-    import io as _io
-    arr = _np.load(_io.BytesIO(bytes(buf)), allow_pickle=False)
-    return _nd.array(arr)
+    """Decode a JPEG/PNG (cv2) or npy buffer (ref: image.py imdecode)."""
+    raw = bytes(buf)
+    if raw[:6] == b"\x93NUMPY":
+        import io as _io
+        return _nd.array(_np.load(_io.BytesIO(raw), allow_pickle=False))
+    import cv2
+    img = cv2.imdecode(_np.frombuffer(raw, _np.uint8), flag)
+    check(img is not None, "imdecode failed")
+    if to_rgb and img.ndim == 3 and img.shape[2] == 3:
+        img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+    return _nd.array(img)
+
+
+def imread(filename, flag=1, to_rgb=True):
+    """(ref: image.py imread)"""
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag=flag, to_rgb=to_rgb)
 
 
 def imresize(src, w, h, interp=1):
-    import jax
-    data = src._data if isinstance(src, _nd.NDArray) else src
-    out = jax.image.resize(data.astype("float32"),
-                           (h, w) + tuple(data.shape[2:]), "bilinear")
-    return _nd.from_jax(out.astype(data.dtype))
+    try:
+        import cv2
+        data = src.asnumpy() if isinstance(src, _nd.NDArray) else \
+            _np.asarray(src)
+        interp_map = {0: cv2.INTER_NEAREST, 1: cv2.INTER_LINEAR,
+                      2: cv2.INTER_CUBIC, 3: cv2.INTER_AREA,
+                      4: cv2.INTER_LANCZOS4}
+        out = cv2.resize(data, (w, h),
+                         interpolation=interp_map.get(interp,
+                                                      cv2.INTER_LINEAR))
+        return _nd.array(out)
+    except ImportError:
+        import jax
+        data = src._data if isinstance(src, _nd.NDArray) else src
+        out = jax.image.resize(data.astype("float32"),
+                               (h, w) + tuple(data.shape[2:]), "bilinear")
+        return _nd.from_jax(out.astype(data.dtype))
 
 
 def resize_short(src, size, interp=2):

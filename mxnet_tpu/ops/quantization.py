@@ -1,0 +1,165 @@
+"""INT8 quantization operators.
+
+Reference: src/operator/quantization/ (quantize_v2/dequantize/requantize,
+quantized conv/FC, calibration). TPU-native: int8 matmuls/convs feed the MXU
+natively via ``preferred_element_type=int32`` accumulation — the role MKLDNN/
+cuDNN int8 kernels play in the reference.
+
+Quantization scheme: symmetric int8 with float (min, max) calibration range,
+matching the reference's (data, min_range, max_range) triple convention.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _scale(mn, mx):
+    jnp = _jnp()
+    amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+    return jnp.maximum(amax, 1e-8) / 127.0
+
+
+@register("_contrib_quantize_v2", aliases=("quantize_v2",), num_outputs=3,
+          differentiable=False)
+def _quantize_v2(data, min_calib_range=None, max_calib_range=None,
+                 out_type="int8"):
+    jnp = _jnp()
+    if min_calib_range is None or max_calib_range is None:
+        mn = jnp.min(data)
+        mx = jnp.max(data)
+    else:
+        mn = jnp.asarray(min_calib_range, jnp.float32)
+        mx = jnp.asarray(max_calib_range, jnp.float32)
+    s = _scale(mn, mx)
+    q = jnp.clip(jnp.round(data / s), -127, 127).astype(jnp.int8)
+    return q, -s * 127.0, s * 127.0
+
+
+@register("_contrib_dequantize", aliases=("dequantize",),
+          differentiable=False)
+def _dequantize(data, min_range, max_range, out_type="float32"):
+    jnp = _jnp()
+    s = _scale(min_range, max_range)
+    return data.astype(jnp.float32) * s
+
+
+@register("_contrib_requantize", aliases=("requantize",), num_outputs=3,
+          differentiable=False)
+def _requantize(data, min_range, max_range, min_calib_range=None,
+                max_calib_range=None, out_type="int8"):
+    jnp = _jnp()
+    # int32 accumulators -> int8 with a new range
+    in_scale = _scale(min_range, max_range) / 127.0  # int32 per-unit scale
+    f = data.astype(jnp.float32) * _scale(min_range, max_range) / (127.0 * 127.0)
+    if min_calib_range is None:
+        mn, mx = jnp.min(f), jnp.max(f)
+    else:
+        mn = jnp.asarray(min_calib_range, jnp.float32)
+        mx = jnp.asarray(max_calib_range, jnp.float32)
+    s = _scale(mn, mx)
+    q = jnp.clip(jnp.round(f / s), -127, 127).astype(jnp.int8)
+    return q, -s * 127.0, s * 127.0
+
+
+@register("_contrib_quantized_fully_connected",
+          aliases=("quantized_fully_connected",), num_outputs=3,
+          differentiable=False)
+def _quantized_fc(data, weight, bias, data_min, data_max, w_min, w_max,
+                  b_min=None, b_max=None, num_hidden=1, no_bias=False,
+                  flatten=True):
+    """int8 x int8 -> int32 matmul on the MXU."""
+    jnp = _jnp()
+    import jax
+    x = data
+    if flatten and x.ndim > 2:
+        x = x.reshape(x.shape[0], -1)
+    acc = jax.lax.dot_general(x, weight,
+                              (((x.ndim - 1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.int32)
+    sx = _scale(data_min, data_max)
+    sw = _scale(w_min, w_max)
+    out = acc.astype(jnp.float32) * (sx * sw)
+    if not no_bias and bias is not None:
+        sb = _scale(b_min, b_max)
+        out = out + bias.astype(jnp.float32) * sb
+    omax = jnp.max(jnp.abs(out))
+    return out, -omax, omax
+
+
+@register("_contrib_quantized_conv", aliases=("quantized_conv",),
+          num_outputs=3, differentiable=False)
+def _quantized_conv(data, weight, bias, data_min, data_max, w_min, w_max,
+                    b_min=None, b_max=None, kernel=(), stride=(), dilate=(),
+                    pad=(), num_filter=1, num_group=1, no_bias=False,
+                    layout="NCHW"):
+    import jax
+    jnp = _jnp()
+    nd = len(kernel)
+    stride = tuple(stride) if stride else (1,) * nd
+    dilate = tuple(dilate) if dilate else (1,) * nd
+    pad = tuple(pad) if pad else (0,) * nd
+    dn = jax.lax.conv_dimension_numbers(
+        data.shape, weight.shape, ("NCHW", "OIHW", "NCHW"))
+    acc = jax.lax.conv_general_dilated(
+        data.astype(jnp.int8), weight.astype(jnp.int8),
+        window_strides=stride, padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=num_group,
+        preferred_element_type=jnp.int32)
+    sx = _scale(data_min, data_max)
+    sw = _scale(w_min, w_max)
+    out = acc.astype(jnp.float32) * (sx * sw)
+    if not no_bias and bias is not None:
+        sb = _scale(b_min, b_max)
+        out = out + (bias.astype(jnp.float32) * sb).reshape(
+            (1, -1) + (1,) * nd)
+    omax = jnp.max(jnp.abs(out))
+    return out, -omax, omax
+
+
+@register("_quantized_fc_static", differentiable=False)
+def _quantized_fc_static(qdata, dmin, dmax, qweight, *maybe_bias,
+                         w_min=0.0, w_max=0.0, num_hidden=1, no_bias=False,
+                         flatten=True):
+    """Quantized FC with weight range baked in at graph-rewrite time
+    (what quantize_graph_pass produces); returns dequantized f32."""
+    import jax
+    jnp = _jnp()
+    x = qdata
+    if flatten and x.ndim > 2:
+        x = x.reshape(x.shape[0], -1)
+    acc = jax.lax.dot_general(x, qweight,
+                              (((x.ndim - 1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.int32)
+    sx = _scale(dmin, dmax)
+    sw = max(abs(w_min), abs(w_max), 1e-8) / 127.0
+    out = acc.astype(jnp.float32) * (sx * sw)
+    if not no_bias and maybe_bias:
+        out = out + maybe_bias[0].astype(jnp.float32)
+    return out
+
+
+@register("_contrib_quantized_pooling", aliases=("quantized_pooling",),
+          num_outputs=3, differentiable=False)
+def _quantized_pooling(data, data_min, data_max, kernel=(), pool_type="max",
+                       global_pool=False, stride=(), pad=(),
+                       pooling_convention="valid", **_):
+    from .nn import _pooling
+    out = _pooling(data.astype(_jnp().float32), kernel=kernel,
+                   pool_type=pool_type, global_pool=global_pool,
+                   stride=stride, pad=pad,
+                   pooling_convention=pooling_convention)
+    return out.astype(data.dtype), data_min, data_max
+
+
+@register("_contrib_quantized_flatten", aliases=("quantized_flatten",),
+          num_outputs=3, differentiable=False)
+def _quantized_flatten(data, data_min, data_max):
+    return data.reshape(data.shape[0], -1), data_min, data_max

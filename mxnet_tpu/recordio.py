@@ -156,22 +156,37 @@ def unpack(s: bytes):
 
 def pack_img(header: IRHeader, img, quality: int = 95,
              img_fmt: str = ".jpg") -> bytes:
-    """Pack a numpy image. Without OpenCV (absent here) images are stored as
-    raw npy bytes — unpack_img reverses it. JPEG-encoded records written by
-    the reference's im2rec can still be unpacked by user code with PIL."""
-    import io as _io
-    buf = _io.BytesIO()
-    np.save(buf, np.asarray(img), allow_pickle=False)
-    return pack(header, buf.getvalue())
+    """Encode an image into a record (ref: recordio.py pack_img).
+
+    Uses OpenCV JPEG/PNG encoding (wire-compatible with im2rec datasets);
+    falls back to npy bytes when cv2 is unavailable.
+    """
+    try:
+        import cv2
+        fmt = img_fmt.lower()
+        params = [cv2.IMWRITE_JPEG_QUALITY, quality] \
+            if fmt in (".jpg", ".jpeg") else \
+            ([cv2.IMWRITE_PNG_COMPRESSION, 3] if fmt == ".png" else [])
+        ok, buf = cv2.imencode(fmt, np.asarray(img), params)
+        check(ok, "image encode failed")
+        return pack(header, buf.tobytes())
+    except ImportError:
+        import io as _io
+        buf = _io.BytesIO()
+        np.save(buf, np.asarray(img), allow_pickle=False)
+        return pack(header, buf.getvalue())
 
 
 def unpack_img(s: bytes, iscolor: int = -1):
+    """(ref: recordio.py unpack_img)"""
     import io as _io
     header, payload = unpack(s)
-    buf = _io.BytesIO(payload)
+    if payload[:6] == b"\x93NUMPY":
+        return header, np.load(_io.BytesIO(payload), allow_pickle=False)
     try:
-        img = np.load(buf, allow_pickle=False)
-    except Exception:
-        raise MXNetError("record payload is not npy-encoded (JPEG decode "
-                         "requires an image library not present here)")
-    return header, img
+        import cv2
+        img = cv2.imdecode(np.frombuffer(payload, np.uint8), iscolor)
+        check(img is not None, "image decode failed")
+        return header, img
+    except ImportError:
+        raise MXNetError("record payload needs cv2 to decode")

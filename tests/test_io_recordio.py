@@ -98,9 +98,15 @@ def test_pack_unpack():
 
 def test_pack_img_roundtrip():
     img = (np.random.RandomState(0).rand(4, 5, 3) * 255).astype(np.uint8)
-    s = recordio.pack_img(recordio.IRHeader(0, 1.0, 0, 0), img)
+    # png is lossless -> exact roundtrip; jpg would be approximate
+    s = recordio.pack_img(recordio.IRHeader(0, 1.0, 0, 0), img,
+                          img_fmt=".png")
     h, img2 = recordio.unpack_img(s)
     assert np.array_equal(img, img2)
+    s = recordio.pack_img(recordio.IRHeader(0, 1.0, 0, 0), img,
+                          img_fmt=".jpg", quality=95)
+    h, img3 = recordio.unpack_img(s)
+    assert img3.shape == img.shape
 
 
 @pytest.mark.skipif(not native_available(), reason="native lib not built")
