@@ -1,0 +1,44 @@
+"""KVStore server bootstrap (ref: python/mxnet/kvstore_server.py:28-73).
+
+The reference blocks server-role processes in a ps-lite serving loop. The
+TPU-native communication layer has no server role — reduction is collective
+— so this module exists for launch-script compatibility: a process started
+with a server role simply initializes the distributed runtime and joins the
+collective group as a (passive) worker.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["init_distributed", "KVStoreServer", "_init_kvstore_server_module"]
+
+
+def init_distributed() -> bool:
+    """Initialize jax.distributed from MXTPU_* env (set by tools/launch.py).
+
+    Returns True if a multi-process group was joined.
+    """
+    coord = os.environ.get("MXTPU_COORDINATOR")
+    nproc = int(os.environ.get("MXTPU_NUM_WORKERS", "1"))
+    rank = int(os.environ.get("MXTPU_WORKER_ID", "0"))
+    if coord is None or nproc <= 1:
+        return False
+    import jax
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=nproc, process_id=rank)
+    return True
+
+
+class KVStoreServer:
+    """(ref: kvstore_server.py KVStoreServer) — compatibility shell."""
+
+    def __init__(self, kvstore):
+        self.kvstore = kvstore
+
+    def run(self) -> None:
+        # no serving loop: collectives have no server side
+        pass
+
+
+def _init_kvstore_server_module() -> None:
+    init_distributed()

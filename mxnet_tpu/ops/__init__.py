@@ -17,5 +17,6 @@ from . import rnn_op  # noqa: F401
 from . import linalg  # noqa: F401
 from . import pallas_kernels  # noqa: F401
 from . import quantization  # noqa: F401
+from . import contrib_ops  # noqa: F401
 
 from .registry import get_op, list_ops, register  # noqa: F401

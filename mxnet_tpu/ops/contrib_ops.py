@@ -1,0 +1,354 @@
+"""Contrib operators: detection, ROI, resize, and misc ops.
+
+Reference: src/operator/contrib/ (bounding_box.cc box_nms/box_iou,
+roi_align.cc, multibox_prior.cc, adaptive_avg_pooling.cc,
+bilinear_resize.cc, boolean_mask.cc, index_copy.cc, gradient_multiplier,
+quadratic_op.cc, sync_batch_norm.cc) + src/operator/roi_pooling.cc,
+spatial_transformer.cc, bilinear_sampler.cc.
+
+TPU notes: NMS is implemented as a fixed-iteration lax.scan over the sorted
+box list (static shapes; the reference's dynamic-size outputs become
+-1-padded like its `box_nms` already does). SyncBatchNorm is a psum over
+the batch axis — the one cross-device op the reference had (SURVEY §2.3).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from .registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _box_iou_corner(a, b):
+    """IoU of (..., 4) corner boxes vs (..., 4)."""
+    jnp = _jnp()
+    tl = jnp.maximum(a[..., :, None, :2], b[..., None, :, :2])
+    br = jnp.minimum(a[..., :, None, 2:4], b[..., None, :, 2:4])
+    wh = jnp.maximum(br - tl, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.maximum(a[..., 2] - a[..., 0], 0) * \
+        jnp.maximum(a[..., 3] - a[..., 1], 0)
+    area_b = jnp.maximum(b[..., 2] - b[..., 0], 0) * \
+        jnp.maximum(b[..., 3] - b[..., 1], 0)
+    union = area_a[..., :, None] + area_b[..., None, :] - inter
+    return inter / jnp.maximum(union, 1e-12)
+
+
+@register("_contrib_box_iou", aliases=("box_iou",), differentiable=False)
+def _box_iou(lhs, rhs, format="corner"):
+    jnp = _jnp()
+    if format == "center":
+        def corner(x):
+            cx, cy, w, h = (x[..., 0], x[..., 1], x[..., 2], x[..., 3])
+            return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2,
+                              cy + h / 2], axis=-1)
+        lhs, rhs = corner(lhs), corner(rhs)
+    return _box_iou_corner(lhs, rhs)
+
+
+@register("_contrib_box_nms", aliases=("box_nms",), differentiable=False)
+def _box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+             coord_start=2, score_index=1, id_index=-1, force_suppress=False,
+             in_format="corner", out_format="corner", background_id=-1):
+    """Greedy NMS as a masked scan (static shapes). data:
+    (..., N, 5+) [id, score, x1, y1, x2, y2]; suppressed -> all -1."""
+    import jax
+    jnp = _jnp()
+    shape = data.shape
+    flat = data.reshape((-1,) + shape[-2:])
+    b, n, k = flat.shape
+
+    def per_batch(boxes):
+        scores = boxes[:, score_index]
+        order = jnp.argsort(-scores)
+        sboxes = boxes[order]
+        coords = sboxes[:, coord_start:coord_start + 4]
+        ious = _box_iou_corner(coords, coords)
+        cls = sboxes[:, id_index] if id_index >= 0 else jnp.zeros((n,))
+        same_cls = (cls[:, None] == cls[None, :]) | force_suppress
+        valid = sboxes[:, score_index] > valid_thresh
+
+        def body(keep, i):
+            sup = (ious[i] > overlap_thresh) & same_cls[i] & \
+                (jnp.arange(n) > i) & keep[i]
+            return jnp.where(sup, False, keep), None
+
+        keep0 = valid
+        keep, _ = jax.lax.scan(body, keep0, jnp.arange(n))
+        if topk > 0:
+            rank = jnp.cumsum(keep.astype(jnp.int32)) - 1
+            keep = keep & (rank < topk)
+        out = jnp.where(keep[:, None], sboxes, -jnp.ones_like(sboxes))
+        return out
+
+    out = jax.vmap(per_batch)(flat)
+    return out.reshape(shape)
+
+
+@register("ROIPooling", differentiable=False)
+def _roi_pooling(data, rois, pooled_size=(1, 1), spatial_scale=1.0):
+    """(ref: src/operator/roi_pooling.cc) rois: (R, 5) [batch, x1,y1,x2,y2]."""
+    import jax
+    jnp = _jnp()
+    ph, pw = pooled_size
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = jnp.round(roi[1:5] * spatial_scale)
+        img = data[b]  # (C, H, W)
+        H, W = img.shape[1], img.shape[2]
+        roi_h = jnp.maximum(y2 - y1 + 1, 1.0)
+        roi_w = jnp.maximum(x2 - x1 + 1, 1.0)
+        bin_h = roi_h / ph
+        bin_w = roi_w / pw
+        ys = jnp.arange(ph)
+        xs = jnp.arange(pw)
+        # sample a fixed 2x2 grid per bin (max over samples) — static shapes
+        sy = (y1 + ys[:, None] * bin_h)[..., None] + \
+            jnp.array([0.25, 0.75]) * bin_h
+        sx = (x1 + xs[:, None] * bin_w)[..., None] + \
+            jnp.array([0.25, 0.75]) * bin_w
+        syi = jnp.clip(sy.astype(jnp.int32), 0, H - 1)  # (ph, 1, 2)->broadcast
+        sxi = jnp.clip(sx.astype(jnp.int32), 0, W - 1)
+        gather = img[:, syi.reshape(ph, 2)[:, None, :, None],
+                     sxi.reshape(pw, 2)[None, :, None, :]]
+        return jnp.max(gather, axis=(3, 4))
+
+    return jax.vmap(one_roi)(rois)
+
+
+@register("_contrib_ROIAlign", aliases=("ROIAlign",))
+def _roi_align(data, rois, pooled_size=(1, 1), spatial_scale=1.0,
+               sample_ratio=2, position_sensitive=False, aligned=False):
+    """(ref: src/operator/contrib/roi_align.cc) bilinear-sampled ROI pool."""
+    import jax
+    jnp = _jnp()
+    ph, pw = pooled_size
+    sr = max(1, int(sample_ratio))
+
+    def bilinear(img, y, x):
+        H, W = img.shape[1], img.shape[2]
+        y = jnp.clip(y, 0.0, H - 1.0)
+        x = jnp.clip(x, 0.0, W - 1.0)
+        y0 = jnp.floor(y).astype(jnp.int32)
+        x0 = jnp.floor(x).astype(jnp.int32)
+        y1 = jnp.minimum(y0 + 1, H - 1)
+        x1 = jnp.minimum(x0 + 1, W - 1)
+        wy = y - y0
+        wx = x - x0
+        v00 = img[:, y0, x0]
+        v01 = img[:, y0, x1]
+        v10 = img[:, y1, x0]
+        v11 = img[:, y1, x1]
+        return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+                v10 * wy * (1 - wx) + v11 * wy * wx)
+
+    offset = 0.5 if aligned else 0.0
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        img = data[b]
+        x1 = roi[1] * spatial_scale - offset
+        y1 = roi[2] * spatial_scale - offset
+        x2 = roi[3] * spatial_scale - offset
+        y2 = roi[4] * spatial_scale - offset
+        roi_h = jnp.maximum(y2 - y1, 1e-3)
+        roi_w = jnp.maximum(x2 - x1, 1e-3)
+        bin_h = roi_h / ph
+        bin_w = roi_w / pw
+        ys = y1 + (jnp.arange(ph)[:, None] +
+                   (jnp.arange(sr) + 0.5)[None, :] / sr) * bin_h  # (ph, sr)
+        xs = x1 + (jnp.arange(pw)[:, None] +
+                   (jnp.arange(sr) + 0.5)[None, :] / sr) * bin_w
+        yy = ys.reshape(-1)  # ph*sr
+        xx = xs.reshape(-1)
+        vals = jax.vmap(lambda y: jax.vmap(
+            lambda x: bilinear(img, y, x))(xx))(yy)  # (ph*sr, pw*sr, C)
+        vals = vals.reshape(ph, sr, pw, sr, -1)
+        return jnp.mean(vals, axis=(1, 3)).transpose(2, 0, 1)
+
+    return jax.vmap(one_roi)(rois)
+
+
+@register("_contrib_MultiBoxPrior", aliases=("MultiBoxPrior",),
+          differentiable=False)
+def _multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                    steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Anchor box generation (ref: src/operator/contrib/multibox_prior.cc)."""
+    jnp = _jnp()
+    h, w = data.shape[2], data.shape[3]
+    sizes = tuple(sizes)
+    ratios = tuple(ratios)
+    step_y = steps[1] if steps[1] > 0 else 1.0 / h
+    step_x = steps[0] if steps[0] > 0 else 1.0 / w
+    cy = (jnp.arange(h) + offsets[1]) * step_y
+    cx = (jnp.arange(w) + offsets[0]) * step_x
+    anchors = []
+    for i, s in enumerate(sizes):
+        for j, r in enumerate(ratios):
+            if i > 0 and j > 0:
+                continue
+            sr = _np.sqrt(r)
+            aw = s * sr / 2
+            ah = s / sr / 2
+            anchors.append((aw, ah))
+    boxes = []
+    for aw, ah in anchors:
+        x1 = cx[None, :, None] - aw
+        y1 = cy[:, None, None] - ah
+        x2 = cx[None, :, None] + aw
+        y2 = cy[:, None, None] + ah
+        grid = jnp.concatenate([
+            jnp.broadcast_to(x1, (h, w, 1)), jnp.broadcast_to(y1, (h, w, 1)),
+            jnp.broadcast_to(x2, (h, w, 1)), jnp.broadcast_to(y2, (h, w, 1))],
+            axis=-1)
+        boxes.append(grid.reshape(-1, 4))
+    out = jnp.stack(boxes, axis=1).reshape(1, -1, 4)
+    if clip:
+        out = jnp.clip(out, 0, 1)
+    return out
+
+
+@register("_contrib_AdaptiveAvgPooling2D", aliases=("AdaptiveAvgPooling2D",))
+def _adaptive_avg_pooling(data, output_size=(1, 1)):
+    import jax
+    jnp = _jnp()
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    n, c, h, w = data.shape
+    if h % oh == 0 and w % ow == 0:
+        x = data.reshape(n, c, oh, h // oh, ow, w // ow)
+        return jnp.mean(x, axis=(3, 5))
+    return jax.image.resize(data, (n, c, oh, ow), "linear")
+
+
+@register("_contrib_BilinearResize2D", aliases=("BilinearResize2D",))
+def _bilinear_resize(data, height=1, width=1, scale_height=None,
+                     scale_width=None, mode="size"):
+    import jax
+    n, c, h, w = data.shape
+    if scale_height is not None:
+        height = int(h * scale_height)
+        width = int(w * scale_width)
+    return jax.image.resize(data, (n, c, height, width), "bilinear")
+
+
+@register("_contrib_boolean_mask", aliases=("boolean_mask",),
+          differentiable=False)
+def _boolean_mask(data, index, axis=0):
+    """(ref: boolean_mask.cc). Note: output length is data-dependent; under
+    jit this op requires concrete (non-traced) masks — eager-only, like the
+    reference's dynamic-shape ops (NaiveRunGraph path)."""
+    jnp = _jnp()
+    import numpy as np
+    idx = np.nonzero(np.asarray(index))[0]
+    return jnp.take(data, jnp.asarray(idx), axis=axis)
+
+
+@register("_contrib_index_copy", aliases=("index_copy",))
+def _index_copy(old, idx, new):
+    return old.at[idx.astype(_np.int32)].set(new)
+
+
+@register("_contrib_index_array", aliases=("index_array",),
+          differentiable=False)
+def _index_array(data, axes=None):
+    jnp = _jnp()
+    shape = data.shape
+    ax = tuple(axes) if axes is not None else tuple(range(len(shape)))
+    grids = jnp.meshgrid(*[jnp.arange(shape[a]) for a in ax], indexing="ij")
+    return jnp.stack(grids, axis=-1).astype(jnp.int64)
+
+
+@register("_contrib_gradientmultiplier", aliases=("gradientmultiplier",))
+def _gradient_multiplier(data, scalar=1.0):
+    import jax
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def fwd(x):
+        return x, None
+
+    def bwd(_, g):
+        return (g * scalar,)
+
+    f.defvjp(fwd, bwd)
+    return f(data)
+
+
+@register("_contrib_quadratic", aliases=("quadratic",))
+def _quadratic(data, a=0.0, b=0.0, c=0.0):
+    """The tutorial op (ref: src/operator/contrib/quadratic_op.cc)."""
+    return a * data * data + b * data + c
+
+
+@register("_contrib_arange_like", aliases=("arange_like",),
+          differentiable=False)
+def _arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
+    jnp = _jnp()
+    n = data.size if axis is None else data.shape[axis]
+    out = start + jnp.arange(n) * step
+    if axis is None:
+        return out.reshape(data.shape)
+    return out
+
+
+@register("_contrib_SyncBatchNorm", aliases=("SyncBatchNorm",),
+          num_outputs=3)
+def _sync_batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
+                     momentum=0.9, fix_gamma=True, use_global_stats=False,
+                     output_mean_var=False, ndev=1, key="", _training=False):
+    """Cross-device BatchNorm (ref: src/operator/contrib/sync_batch_norm.cc
+    — the reference's only intra-op collective). Under pjit/shard_map the
+    batch axis is sharded and the mean/var reductions below become psums
+    automatically; standalone it equals BatchNorm."""
+    from .nn import _batch_norm
+    return _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=eps,
+                       momentum=momentum, fix_gamma=fix_gamma,
+                       use_global_stats=use_global_stats, axis=1,
+                       _training=_training)
+
+
+@register("BilinearSampler")
+def _bilinear_sampler(data, grid):
+    """(ref: src/operator/bilinear_sampler.cc) grid in [-1, 1]."""
+    import jax
+    jnp = _jnp()
+    n, c, h, w = data.shape
+    gx = (grid[:, 0] + 1) * (w - 1) / 2  # (N, Ho, Wo)
+    gy = (grid[:, 1] + 1) * (h - 1) / 2
+
+    def sample(img, yy, xx):
+        y0 = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, w - 1)
+        y1 = jnp.clip(y0 + 1, 0, h - 1)
+        x1 = jnp.clip(x0 + 1, 0, w - 1)
+        wy = jnp.clip(yy - y0, 0, 1)
+        wx = jnp.clip(xx - x0, 0, 1)
+        v00 = img[:, y0, x0]
+        v01 = img[:, y0, x1]
+        v10 = img[:, y1, x0]
+        v11 = img[:, y1, x1]
+        return v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx + \
+            v10 * wy * (1 - wx) + v11 * wy * wx
+
+    return jax.vmap(sample)(data, gy, gx)
+
+
+@register("SpatialTransformer")
+def _spatial_transformer(data, loc, target_shape=(0, 0),
+                         transform_type="affine", sampler_type="bilinear",
+                         cudnn_off=False):
+    """(ref: src/operator/spatial_transformer.cc)"""
+    from .nn import _grid_generator
+    grid = _grid_generator(loc, transform_type="affine",
+                           target_shape=target_shape)
+    return _bilinear_sampler(data, grid)

@@ -1,0 +1,51 @@
+"""Misc utilities (ref: python/mxnet/util.py)."""
+from __future__ import annotations
+
+import functools
+import inspect
+import threading
+
+__all__ = ["makedirs", "use_np_shape", "is_np_shape", "set_np_shape",
+           "wrap_ctx_to_device_func", "getenv", "setenv"]
+
+import os
+
+
+def makedirs(d):
+    os.makedirs(os.path.expanduser(d), exist_ok=True)
+
+
+_np_shape = threading.local()
+
+
+def is_np_shape() -> bool:
+    return getattr(_np_shape, "value", True)
+
+
+def set_np_shape(active: bool) -> bool:
+    prev = is_np_shape()
+    _np_shape.value = active
+    return prev
+
+
+def use_np_shape(func):
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        prev = set_np_shape(True)
+        try:
+            return func(*args, **kwargs)
+        finally:
+            set_np_shape(prev)
+    return wrapper
+
+
+def wrap_ctx_to_device_func(func):
+    return func
+
+
+def getenv(name):
+    return os.environ.get(name)
+
+
+def setenv(name, value):
+    os.environ[name] = value

@@ -1,0 +1,46 @@
+#!/usr/bin/env python
+"""Allreduce bandwidth benchmark (ref: tools/bandwidth/measure.py).
+
+Measures KVStore/collective bandwidth over the mesh with the reference's
+formula ``2(n-1)/n * size / t`` (measure.py:138). Run with JAX_PLATFORMS=cpu
+and --xla_force_host_platform_device_count for a virtual mesh, or on real
+chips for ICI numbers.
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size-mb", type=float, default=64.0,
+                    help="per-device tensor size")
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--axis", default="dp")
+    ap.add_argument("--num-devices", type=int, default=0,
+                    help="0 = all visible")
+    args = ap.parse_args()
+
+    import jax
+    from mxnet_tpu.parallel import make_mesh, measure_allreduce_bandwidth
+
+    n = args.num_devices or len(jax.devices())
+    if n < 2:
+        print(json.dumps({"metric": "allreduce_bandwidth", "value": 0.0,
+                          "unit": "GB/s/device",
+                          "note": "needs >=2 devices"}))
+        return
+    mesh = make_mesh({args.axis: n})
+    bw = measure_allreduce_bandwidth(mesh, size_mb=args.size_mb,
+                                     axis=args.axis, iters=args.iters)
+    print(json.dumps({"metric": "allreduce_bandwidth",
+                      "value": round(bw, 3), "unit": "GB/s/device",
+                      "devices": n, "size_mb": args.size_mb}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Pack an image directory/list into RecordIO (ref: tools/im2rec.py).
+
+Produces .rec/.idx/.lst files consumable by ImageIter/ImageRecordDataset.
+Images are JPEG-encoded via OpenCV (wire-compatible with the reference).
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import numpy as np
+
+
+def list_images(root, exts=(".jpg", ".jpeg", ".png")):
+    cat = {}
+    items = []
+    for path, _, files in sorted(os.walk(root)):
+        for f in sorted(files):
+            if os.path.splitext(f)[1].lower() in exts:
+                label_name = os.path.relpath(path, root).split(os.sep)[0]
+                if label_name not in cat:
+                    cat[label_name] = len(cat)
+                items.append((os.path.join(path, f), cat[label_name]))
+    return items, cat
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("prefix", help="output prefix (writes prefix.rec/.idx/.lst)")
+    ap.add_argument("root", help="image root directory (class per subdir)")
+    ap.add_argument("--resize", type=int, default=0,
+                    help="resize shorter side")
+    ap.add_argument("--quality", type=int, default=95)
+    ap.add_argument("--shuffle", action="store_true")
+    args = ap.parse_args()
+
+    from mxnet_tpu import recordio, image
+
+    items, cat = list_images(args.root)
+    print(f"{len(items)} images, {len(cat)} classes")
+    if args.shuffle:
+        np.random.shuffle(items)
+
+    with open(args.prefix + ".lst", "w") as f:
+        for i, (path, label) in enumerate(items):
+            f.write(f"{i}\t{label}\t{path}\n")
+
+    rec = recordio.MXIndexedRecordIO(args.prefix + ".idx",
+                                     args.prefix + ".rec", "w")
+    import cv2
+    for i, (path, label) in enumerate(items):
+        img = cv2.imread(path)
+        if img is None:
+            print(f"skip unreadable {path}")
+            continue
+        if args.resize:
+            h, w = img.shape[:2]
+            if h < w:
+                img = cv2.resize(img, (int(args.resize * w / h), args.resize))
+            else:
+                img = cv2.resize(img, (args.resize, int(args.resize * h / w)))
+        packed = recordio.pack_img(
+            recordio.IRHeader(0, float(label), i, 0), img,
+            quality=args.quality, img_fmt=".jpg")
+        rec.write_idx(i, packed)
+        if i % 1000 == 0:
+            print(f"packed {i}")
+    rec.close()
+    print(f"wrote {args.prefix}.rec")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Distributed job launcher (ref: tools/launch.py + dmlc_tracker).
+
+The reference forks scheduler + servers + workers wired with DMLC_* env
+vars over ssh/mpi/yarn. The TPU-native cluster model has no parameter
+servers: every host runs the SAME SPMD program and rendezvouses through the
+JAX coordination service. This launcher starts N local worker processes (or
+emits the per-host commands for ssh) with the env each jax.distributed
+worker needs:
+
+  MXTPU_COORDINATOR  host:port of process 0  (DMLC_PS_ROOT_URI analog)
+  MXTPU_NUM_WORKERS  world size              (DMLC_NUM_WORKER analog)
+  MXTPU_WORKER_ID    rank                    (DMLC_RANK analog)
+
+Worker code calls mxnet_tpu.tools_init_distributed() (or
+jax.distributed.initialize directly) which reads these.
+"""
+import argparse
+import os
+import subprocess
+import sys
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("--launcher", choices=["local", "ssh", "manual"],
+                    default="local")
+    ap.add_argument("--coordinator", default="127.0.0.1:12357")
+    ap.add_argument("-H", "--hostfile", default=None,
+                    help="one host per line (ssh launcher)")
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+    if not args.command:
+        ap.error("no command given")
+    cmd = args.command
+
+    if args.launcher == "manual":
+        for rank in range(args.num_workers):
+            env = (f"MXTPU_COORDINATOR={args.coordinator} "
+                   f"MXTPU_NUM_WORKERS={args.num_workers} "
+                   f"MXTPU_WORKER_ID={rank}")
+            print(f"[host {rank}] {env} {' '.join(cmd)}")
+        return
+
+    if args.launcher == "ssh":
+        hosts = [h.strip() for h in open(args.hostfile)] \
+            if args.hostfile else ["localhost"] * args.num_workers
+        procs = []
+        for rank in range(args.num_workers):
+            env = (f"MXTPU_COORDINATOR={args.coordinator} "
+                   f"MXTPU_NUM_WORKERS={args.num_workers} "
+                   f"MXTPU_WORKER_ID={rank}")
+            procs.append(subprocess.Popen(
+                ["ssh", hosts[rank % len(hosts)],
+                 f"cd {os.getcwd()} && {env} {' '.join(cmd)}"]))
+        rc = max(p.wait() for p in procs)
+        sys.exit(rc)
+
+    # local: fork N processes on this machine (the reference's local
+    # tracker pattern used by tests/nightly/dist_sync_kvstore.py)
+    procs = []
+    for rank in range(args.num_workers):
+        env = dict(os.environ)
+        env.update({"MXTPU_COORDINATOR": args.coordinator,
+                    "MXTPU_NUM_WORKERS": str(args.num_workers),
+                    "MXTPU_WORKER_ID": str(rank)})
+        procs.append(subprocess.Popen(cmd, env=env))
+    rc = max(p.wait() for p in procs)
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()
