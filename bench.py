@@ -19,6 +19,33 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+def _init_backend(timeout_s=900):
+    """Initialize the JAX backend with a watchdog: if device discovery
+    hangs (e.g. a wedged TPU tunnel), emit an error JSON instead of
+    blocking the driver forever."""
+    import threading
+    result = {}
+
+    def probe():
+        try:
+            import jax
+            result["devices"] = jax.devices()
+        except Exception as e:
+            result["error"] = e
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if "devices" in result:
+        log(f"backend: {result['devices']}")
+        return True
+    err = result.get("error", f"backend init timed out after {timeout_s}s")
+    print(json.dumps({"metric": "resnet50_train_imgs_per_sec", "value": 0.0,
+                      "unit": "img/s", "vs_baseline": 0.0,
+                      "error": str(err)[:200]}), flush=True)
+    return False
+
+
 def run(batch=128, warmup=3, iters=10, dtype="bfloat16"):
     import numpy as np
     import jax
@@ -65,7 +92,10 @@ def run(batch=128, warmup=3, iters=10, dtype="bfloat16"):
 
 
 def main():
-    batches = [128, 64, 32]
+    if not _init_backend():
+        os._exit(0)
+    batches = [int(b) for b in
+               os.environ.get("MXTPU_BENCH_BATCHES", "128,64,32").split(",")]
     last_err = None
     for batch in batches:
         try:

@@ -59,6 +59,7 @@ class KVStoreBase:
         self._updater = None
         self._optimizer = None
         self._compression_params = None
+        self._compressor = None
 
     # -- identity -------------------------------------------------------
     @property
@@ -101,6 +102,12 @@ class KVStoreBase:
         for k, vals in _group(key, value):
             check(k in self._store, f"kvstore key {k} not initialized")
             merged = self._merge(vals)
+            if self._compressor is not None:
+                # compress->decompress round trip with error feedback
+                # (ref: push-path quantization, gradient_compression.cc)
+                merged = _nd.NDArray(
+                    self._compressor.roundtrip(k, merged._data),
+                    ctx=merged._ctx)
             merged = self._reduce_global(merged)
             if self._updater is not None:
                 self._updater(_key_int(k), merged, self._store[k])
@@ -160,7 +167,13 @@ class KVStoreBase:
         self._updater = opt_mod.get_updater(optimizer)
 
     def set_gradient_compression(self, compression_params) -> None:
-        self._compression_params = dict(compression_params)
+        """(ref: mx.kv.set_gradient_compression -> gradient_compression.cc)"""
+        from .gradient_compression import GradientCompression
+        params = dict(compression_params)
+        self._compression_params = params
+        self._compressor = GradientCompression(
+            type=params.get("type", "2bit"),
+            threshold=float(params.get("threshold", 0.5)))
 
     def save_optimizer_states(self, fname, dump_optimizer=False) -> None:
         check(self._updater is not None, "no optimizer set")

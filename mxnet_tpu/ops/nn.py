@@ -116,8 +116,11 @@ def _deconvolution(data, weight, *maybe_bias, kernel=(), stride=(), dilate=(),
     # transposed conv = gradient of conv wrt input: lhs-dilate by stride.
     pads = [(kernel[i] - 1 - pad[i], kernel[i] - 1 - pad[i] + adj[i])
             for i in range(nd)]
-    dn = ("NCHW", "IOHW", "NCHW") if nd == 2 else (
-        ("NCW", "IOW", "NCW") if nd == 1 else ("NCDHW", "IODHW", "NCDHW"))
+    # weight layout is (C_in, num_filter, *k); with transpose_kernel=True
+    # lax treats the "OIHW" spec relative to the FORWARD conv, giving the
+    # exact gradient-of-conv semantics the reference implements
+    dn = ("NCHW", "OIHW", "NCHW") if nd == 2 else (
+        ("NCW", "OIW", "NCW") if nd == 1 else ("NCDHW", "OIDHW", "NCDHW"))
     if num_group != 1:
         raise MXNetError("grouped Deconvolution not yet supported")
     out = lax.conv_transpose(data, weight, strides=stride, padding=pads,

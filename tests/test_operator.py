@@ -1,0 +1,217 @@
+"""Broad operator correctness (model: tests/python/unittest/test_operator.py
+— numpy cross-check + numeric gradient checking, SURVEY.md §4 strategy)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import (assert_almost_equal,
+                                  check_numeric_gradient, check_consistency)
+
+RS = np.random.RandomState(7)
+
+
+UNARY_CASES = [
+    ("abs", np.abs, (-2, 2)), ("exp", np.exp, (-1, 1)),
+    ("log", np.log, (0.1, 3)), ("sqrt", np.sqrt, (0.1, 4)),
+    ("square", np.square, (-2, 2)), ("sin", np.sin, (-3, 3)),
+    ("cos", np.cos, (-3, 3)), ("tanh", np.tanh, (-2, 2)),
+    ("sigmoid", lambda x: 1 / (1 + np.exp(-x)), (-3, 3)),
+    ("relu", lambda x: np.maximum(x, 0), (-2, 2)),
+    ("floor", np.floor, (-3, 3)), ("ceil", np.ceil, (-3, 3)),
+    ("log1p", np.log1p, (-0.5, 3)), ("expm1", np.expm1, (-1, 1)),
+    ("rsqrt", lambda x: 1 / np.sqrt(x), (0.5, 4)),
+    ("arctan", np.arctan, (-2, 2)), ("sign", np.sign, (-2, 2)),
+    ("gammaln", None, (0.5, 4)), ("erf", None, (-2, 2)),
+]
+
+
+@pytest.mark.parametrize("name,npfn,rng", UNARY_CASES,
+                         ids=[c[0] for c in UNARY_CASES])
+def test_unary_vs_numpy(name, npfn, rng):
+    x = RS.uniform(rng[0], rng[1], (3, 4)).astype(np.float32)
+    out = getattr(nd.op, name)(nd.array(x)).asnumpy()
+    if npfn is None:
+        import scipy.special as sp
+        npfn = {"gammaln": sp.gammaln, "erf": sp.erf}[name]
+    assert_almost_equal(out, npfn(x).astype(np.float32), rtol=1e-4,
+                        atol=1e-5)
+
+
+BINARY_CASES = [
+    ("broadcast_add", np.add), ("broadcast_sub", np.subtract),
+    ("broadcast_mul", np.multiply), ("broadcast_div", np.divide),
+    ("broadcast_maximum", np.maximum), ("broadcast_minimum", np.minimum),
+    ("broadcast_power", np.power), ("broadcast_hypot", np.hypot),
+]
+
+
+@pytest.mark.parametrize("name,npfn", BINARY_CASES,
+                         ids=[c[0] for c in BINARY_CASES])
+def test_binary_vs_numpy(name, npfn):
+    a = RS.uniform(0.5, 2, (2, 1, 4)).astype(np.float32)
+    b = RS.uniform(0.5, 2, (1, 3, 4)).astype(np.float32)
+    out = getattr(nd.op, name)(nd.array(a), nd.array(b)).asnumpy()
+    assert_almost_equal(out, npfn(a, b).astype(np.float32), rtol=1e-4,
+                        atol=1e-5)
+
+
+GRAD_OPS = [
+    ("sigmoid", {}), ("tanh", {}), ("exp", {}), ("square", {}),
+    ("log_softmax", {"axis": -1}), ("softmax", {"axis": -1}),
+    ("L2Normalization", {}), ("smooth_l1", {"scalar": 1.0}),
+]
+
+
+@pytest.mark.parametrize("name,params", GRAD_OPS,
+                         ids=[c[0] for c in GRAD_OPS])
+def test_numeric_gradient(name, params):
+    x = RS.uniform(-1, 1, (3, 5)).astype(np.float32)
+    check_numeric_gradient(name, [x], params, rtol=2e-2, atol=2e-3)
+
+
+def test_fc_numeric_gradient():
+    x = RS.randn(4, 6).astype(np.float32)
+    w = RS.randn(3, 6).astype(np.float32)
+    b = RS.randn(3).astype(np.float32)
+    check_numeric_gradient(
+        lambda x_, w_, b_: nd.op.FullyConnected(x_, w_, b_, num_hidden=3),
+        [x, w, b], rtol=2e-2, atol=2e-3)
+
+
+def test_conv_numeric_gradient():
+    x = RS.randn(2, 2, 5, 5).astype(np.float32)
+    w = RS.randn(3, 2, 3, 3).astype(np.float32)
+    check_numeric_gradient(
+        lambda x_, w_: nd.op.Convolution(x_, w_, kernel=(3, 3),
+                                         num_filter=3, no_bias=True),
+        [x, w], rtol=2e-2, atol=2e-3)
+
+
+def test_batchnorm_numeric_gradient():
+    x = RS.randn(4, 3, 2, 2).astype(np.float32)
+    gamma = np.abs(RS.randn(3)).astype(np.float32) + 0.5
+    beta = RS.randn(3).astype(np.float32)
+    mm = np.zeros(3, np.float32)
+    mv = np.ones(3, np.float32)
+
+    def f(x_, g_, b_):
+        out = nd.op.BatchNorm(x_, g_, b_, nd.array(mm), nd.array(mv),
+                              fix_gamma=False, _training=True)
+        return out[0]
+
+    check_numeric_gradient(f, [x, gamma, beta], rtol=5e-2, atol=5e-3)
+
+
+def test_consistency_across_dtypes():
+    a = RS.randn(4, 4).astype(np.float32)
+    check_consistency(lambda x: nd.op.softmax(x, axis=-1), [a])
+    check_consistency(lambda x: nd.op.sum(x, axis=0), [a])
+
+
+def test_deconvolution_vs_torch():
+    torch = pytest.importorskip("torch")
+    x = RS.randn(2, 3, 5, 5).astype(np.float32)
+    w = RS.randn(3, 4, 3, 3).astype(np.float32)  # (in, out, kh, kw)
+    out = nd.op.Deconvolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                              num_filter=4, stride=(2, 2),
+                              pad=(1, 1), adj=(1, 1)).asnumpy()
+    tout = torch.nn.functional.conv_transpose2d(
+        torch.tensor(x), torch.tensor(w), stride=2, padding=1,
+        output_padding=1).numpy()
+    assert_almost_equal(out, tout, rtol=1e-3, atol=1e-4)
+
+
+def test_embedding_grad_scatter():
+    from mxnet_tpu import autograd
+    w = nd.array(RS.randn(10, 4).astype(np.float32))
+    w.attach_grad()
+    idx = nd.array([1, 1, 3], dtype="int32")
+    with autograd.record():
+        out = nd.op.Embedding(idx, w, input_dim=10, output_dim=4)
+        loss = out.sum()
+    loss.backward()
+    g = w.grad.asnumpy()
+    assert np.allclose(g[1], 2.0)  # row 1 used twice
+    assert np.allclose(g[3], 1.0)
+    assert np.allclose(g[0], 0.0)
+
+
+def test_layer_norm_matches_manual():
+    x = RS.randn(4, 6).astype(np.float32)
+    gamma = np.abs(RS.randn(6)).astype(np.float32)
+    beta = RS.randn(6).astype(np.float32)
+    out = nd.op.LayerNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                          axis=-1, eps=1e-5)[0].asnumpy()
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    ref = (x - mu) / np.sqrt(var + 1e-5) * gamma + beta
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_sequence_ops():
+    x = nd.array(np.arange(24).reshape(4, 2, 3).astype(np.float32))
+    slen = nd.array([2.0, 4.0])
+    m = nd.op.SequenceMask(x, slen, use_sequence_length=True, value=-1)
+    mn = m.asnumpy()
+    assert (mn[2:, 0] == -1).all()
+    assert (mn[:, 1] != -1).all()
+    last = nd.op.SequenceLast(x, slen, use_sequence_length=True)
+    assert np.allclose(last.asnumpy()[0], x.asnumpy()[1, 0])
+    rev = nd.op.SequenceReverse(x, slen, use_sequence_length=True)
+    assert np.allclose(rev.asnumpy()[0, 0], x.asnumpy()[1, 0])
+
+
+def test_optimizer_ops_match_reference_math():
+    w = np.array([1.0, 2.0], np.float32)
+    g = np.array([0.1, -0.2], np.float32)
+    out = nd.op.sgd_update(nd.array(w), nd.array(g), lr=0.1, wd=0.01)
+    ref = w - 0.1 * (g + 0.01 * w)
+    assert_almost_equal(out.asnumpy(), ref, rtol=1e-6, atol=1e-7)
+
+    mom = np.array([0.5, 0.5], np.float32)
+    new_w, new_m = nd.op.sgd_mom_update(nd.array(w), nd.array(g),
+                                        nd.array(mom), lr=0.1, momentum=0.9)
+    m_ref = 0.9 * mom - 0.1 * g
+    assert_almost_equal(new_m.asnumpy(), m_ref, rtol=1e-6, atol=1e-7)
+    assert_almost_equal(new_w.asnumpy(), w + m_ref, rtol=1e-6, atol=1e-7)
+
+
+def test_topk_mask_and_ravel():
+    a = nd.array([[1.0, 3.0, 2.0]])
+    m = nd.op.topk(a, axis=1, k=2, ret_typ="mask")
+    assert m.asnumpy().tolist() == [[0, 1, 1]]
+    r = nd.op.ravel_multi_index(nd.array([[1.0], [2.0]]), shape=(3, 4))
+    assert float(r.asnumpy()[0]) == 6
+    u = nd.op.unravel_index(nd.array([6.0]), shape=(3, 4))
+    assert u.asnumpy().ravel().tolist() == [1, 2]
+
+
+def test_gradient_compression_roundtrip():
+    from mxnet_tpu.gradient_compression import GradientCompression
+    import jax.numpy as jnp
+    g = jnp.asarray(RS.randn(1000).astype(np.float32))
+    # threshold must bound |g| for error feedback to keep up (same
+    # constraint as the reference's 2-bit scheme)
+    thr = float(jnp.abs(g).max()) * 1.1
+    gc = GradientCompression("2bit", threshold=thr)
+    total = jnp.zeros_like(g)
+    acc = jnp.zeros_like(g)
+    # error feedback: accumulated compressed grads track accumulated truth
+    for _ in range(200):
+        out = gc.roundtrip("k", g)
+        total = total + out
+        acc = acc + g
+    rel = float(jnp.abs(total - acc).mean() / jnp.abs(acc).mean())
+    assert rel < 0.1, rel
+
+
+def test_kvstore_compressed_push():
+    from mxnet_tpu import kvstore as kv_mod
+    kv = kv_mod.create("device")
+    kv.init("w", nd.zeros((4,)))
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.push("w", nd.array([1.0, -1.0, 0.1, 0.0]))
+    out = nd.zeros((4,))
+    kv.pull("w", out=out)
+    assert out.asnumpy().tolist() == [0.5, -0.5, 0.0, 0.0]
