@@ -59,3 +59,89 @@ def set_engine_type(name: str) -> None:
     """Switch scheduling mode. 'NaiveEngine' blocks after every eager op —
     the standard way to localize async failures (ref: engine.cc:33-46)."""
     os.environ["MXNET_ENGINE_TYPE"] = name
+
+
+class NativeEngine:
+    """The native host-task dependency engine (src/engine.cc).
+
+    Same contract as the reference core engine (include/mxnet/engine.h):
+    ``new_var()``, ``push(fn, read_vars, write_vars)``, ``wait_for_var``,
+    ``wait_all``; vars carry version counters bumped per write. Schedules
+    host-side work (IO, batch assembly, checkpoint writes) on C++ worker
+    threads — device-side ordering belongs to XLA's async dispatch.
+    """
+
+    def __init__(self, num_workers: int = 4):
+        import ctypes
+        from .io.record_io import _load_lib
+        lib = _load_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._configure(lib)
+        self._h = lib.mxtpu_engine_create(num_workers)
+        self._keepalive = []  # trampoline refs (freed on wait_all)
+        self._cb_type = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+
+    @staticmethod
+    def _configure(lib):
+        import ctypes
+        if getattr(lib, "_engine_configured", False):
+            return
+        lib.mxtpu_engine_create.restype = ctypes.c_void_p
+        lib.mxtpu_engine_create.argtypes = [ctypes.c_int]
+        lib.mxtpu_engine_destroy.argtypes = [ctypes.c_void_p]
+        lib.mxtpu_engine_new_var.restype = ctypes.c_void_p
+        lib.mxtpu_engine_new_var.argtypes = [ctypes.c_void_p]
+        lib.mxtpu_engine_push.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_void_p), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_void_p), ctypes.c_int]
+        lib.mxtpu_engine_wait_var.argtypes = [ctypes.c_void_p,
+                                              ctypes.c_void_p,
+                                              ctypes.c_uint64]
+        lib.mxtpu_engine_wait_all.argtypes = [ctypes.c_void_p]
+        lib.mxtpu_engine_var_version.restype = ctypes.c_uint64
+        lib.mxtpu_engine_var_version.argtypes = [ctypes.c_void_p,
+                                                 ctypes.c_void_p]
+        lib._engine_configured = True
+
+    def new_var(self):
+        return self._lib.mxtpu_engine_new_var(self._h)
+
+    def push(self, fn, read_vars=(), write_vars=()) -> None:
+        """Schedule ``fn()`` after its dependencies
+        (ref: Engine::PushAsync, engine.h:115)."""
+        import ctypes
+
+        def tramp(_):
+            fn()
+
+        cb = self._cb_type(tramp)
+        self._keepalive.append(cb)
+        reads = (ctypes.c_void_p * max(1, len(read_vars)))(*read_vars)
+        writes = (ctypes.c_void_p * max(1, len(write_vars)))(*write_vars)
+        self._lib.mxtpu_engine_push(
+            self._h, ctypes.cast(cb, ctypes.c_void_p), None,
+            reads, len(read_vars), writes, len(write_vars))
+
+    def wait_for_var(self, var, version: int = 0) -> None:
+        self._lib.mxtpu_engine_wait_var(self._h, var, version)
+
+    def wait_all(self) -> None:
+        self._lib.mxtpu_engine_wait_all(self._h)
+        self._keepalive.clear()
+
+    def var_version(self, var) -> int:
+        return self._lib.mxtpu_engine_var_version(self._h, var)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.mxtpu_engine_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,242 @@
+// Native dependency engine: async task scheduler ordered by variable
+// read/write sets.
+//
+// TPU-native role: XLA's async dispatch owns device-side ordering, so this
+// engine schedules the HOST side of the framework — IO pipelines, batch
+// assembly, checkpoint writes, callback fan-out — with the same contract as
+// the reference's core scheduler (include/mxnet/engine.h: NewVariable,
+// PushAsync(read_vars, write_vars), WaitForVar, WaitForAll; version-counted
+// vars as in src/engine/threaded_engine.h ThreadedVar). Fresh
+// implementation: a single MPMC ready-queue + per-var FIFO waiters, with
+// sequential-write/concurrent-read admission (readers admitted together,
+// writers exclusive).
+//
+// Exposed over a C ABI for ctypes. Tasks are C function pointers
+// (fn(void* arg)); the python wrapper passes trampolines for host work.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+using TaskFn = void (*)(void*);
+
+struct Opr;
+
+struct Var {
+  std::mutex mu;
+  // queue of pending ops on this var, in program order
+  struct Waiter {
+    Opr* opr;
+    bool is_write;
+  };
+  std::deque<Waiter> queue;
+  int active_readers = 0;
+  bool active_writer = false;
+  uint64_t version = 0;
+  std::condition_variable cv;  // for WaitForVar
+};
+
+struct Opr {
+  TaskFn fn = nullptr;
+  void* arg = nullptr;
+  std::vector<Var*> reads;
+  std::vector<Var*> writes;
+  std::atomic<int> pending{0};  // vars not yet granted
+};
+
+class Engine {
+ public:
+  explicit Engine(int num_workers) : stop_(false), inflight_(0) {
+    for (int i = 0; i < num_workers; ++i)
+      workers_.emplace_back([this] { WorkerLoop(); });
+  }
+
+  ~Engine() {
+    {
+      std::unique_lock<std::mutex> lk(qmu_);
+      stop_ = true;
+      qcv_.notify_all();
+    }
+    for (auto& t : workers_) t.join();
+    for (auto* v : vars_) delete v;
+  }
+
+  Var* NewVar() {
+    auto* v = new Var();
+    std::unique_lock<std::mutex> lk(vars_mu_);
+    vars_.push_back(v);
+    return v;
+  }
+
+  void Push(TaskFn fn, void* arg, Var** reads, int n_reads, Var** writes,
+            int n_writes) {
+    auto* opr = new Opr();
+    opr->fn = fn;
+    opr->arg = arg;
+    opr->reads.assign(reads, reads + n_reads);
+    opr->writes.assign(writes, writes + n_writes);
+    inflight_.fetch_add(1);
+    int deps = static_cast<int>(opr->reads.size() + opr->writes.size());
+    if (deps == 0) {
+      Ready(opr);
+      return;
+    }
+    opr->pending.store(deps);
+    // enqueue on each var; grant immediately where possible
+    for (Var* v : opr->reads) Enqueue(v, opr, false);
+    for (Var* v : opr->writes) Enqueue(v, opr, true);
+  }
+
+  void WaitForVar(Var* v, uint64_t version_at_least) {
+    std::unique_lock<std::mutex> lk(v->mu);
+    v->cv.wait(lk, [v, version_at_least] {
+      return v->queue.empty() && !v->active_writer &&
+             v->active_readers == 0 && v->version >= version_at_least;
+    });
+  }
+
+  void WaitAll() {
+    std::unique_lock<std::mutex> lk(done_mu_);
+    done_cv_.wait(lk, [this] { return inflight_.load() == 0; });
+  }
+
+  uint64_t Version(Var* v) {
+    std::unique_lock<std::mutex> lk(v->mu);
+    return v->version;
+  }
+
+ private:
+  void Enqueue(Var* v, Opr* opr, bool is_write) {
+    bool granted = false;
+    {
+      std::unique_lock<std::mutex> lk(v->mu);
+      if (v->queue.empty() && !v->active_writer &&
+          (!is_write ? true : v->active_readers == 0)) {
+        // immediate admission
+        if (is_write)
+          v->active_writer = true;
+        else
+          v->active_readers += 1;
+        granted = true;
+      } else {
+        v->queue.push_back({opr, is_write});
+      }
+    }
+    if (granted) Granted(opr);
+  }
+
+  void Granted(Opr* opr) {
+    if (opr->pending.fetch_sub(1) == 1) Ready(opr);
+  }
+
+  void Ready(Opr* opr) {
+    std::unique_lock<std::mutex> lk(qmu_);
+    ready_.push_back(opr);
+    qcv_.notify_one();
+  }
+
+  void Release(Var* v, bool was_write) {
+    std::vector<Opr*> to_grant;
+    {
+      std::unique_lock<std::mutex> lk(v->mu);
+      if (was_write) {
+        v->active_writer = false;
+        v->version += 1;
+      } else {
+        v->active_readers -= 1;
+      }
+      // admit next waiters: either one writer, or a run of readers
+      while (!v->queue.empty()) {
+        auto& w = v->queue.front();
+        if (w.is_write) {
+          if (v->active_readers == 0 && !v->active_writer) {
+            v->active_writer = true;
+            to_grant.push_back(w.opr);
+            v->queue.pop_front();
+          }
+          break;
+        }
+        if (v->active_writer) break;
+        v->active_readers += 1;
+        to_grant.push_back(w.opr);
+        v->queue.pop_front();
+      }
+      v->cv.notify_all();
+    }
+    for (Opr* o : to_grant) Granted(o);
+  }
+
+  void WorkerLoop() {
+    while (true) {
+      Opr* opr = nullptr;
+      {
+        std::unique_lock<std::mutex> lk(qmu_);
+        qcv_.wait(lk, [this] { return stop_ || !ready_.empty(); });
+        if (stop_ && ready_.empty()) return;
+        opr = ready_.front();
+        ready_.pop_front();
+      }
+      if (opr->fn) opr->fn(opr->arg);
+      for (Var* v : opr->reads) Release(v, false);
+      for (Var* v : opr->writes) Release(v, true);
+      delete opr;
+      if (inflight_.fetch_sub(1) == 1) {
+        std::unique_lock<std::mutex> lk(done_mu_);
+        done_cv_.notify_all();
+      }
+    }
+  }
+
+  std::vector<std::thread> workers_;
+  std::deque<Opr*> ready_;
+  std::mutex qmu_;
+  std::condition_variable qcv_;
+  bool stop_;
+  std::atomic<int> inflight_;
+  std::mutex done_mu_;
+  std::condition_variable done_cv_;
+  std::mutex vars_mu_;
+  std::vector<Var*> vars_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* mxtpu_engine_create(int num_workers) {
+  return new Engine(num_workers < 1 ? 1 : num_workers);
+}
+
+void mxtpu_engine_destroy(void* e) { delete static_cast<Engine*>(e); }
+
+void* mxtpu_engine_new_var(void* e) {
+  return static_cast<Engine*>(e)->NewVar();
+}
+
+void mxtpu_engine_push(void* e, void (*fn)(void*), void* arg, void** reads,
+                       int n_reads, void** writes, int n_writes) {
+  static_cast<Engine*>(e)->Push(fn, arg,
+                                reinterpret_cast<Var**>(reads), n_reads,
+                                reinterpret_cast<Var**>(writes), n_writes);
+}
+
+void mxtpu_engine_wait_var(void* e, void* v, uint64_t version) {
+  static_cast<Engine*>(e)->WaitForVar(static_cast<Var*>(v), version);
+}
+
+void mxtpu_engine_wait_all(void* e) { static_cast<Engine*>(e)->WaitAll(); }
+
+uint64_t mxtpu_engine_var_version(void* e, void* v) {
+  return static_cast<Engine*>(e)->Version(static_cast<Var*>(v));
+}
+
+}  // extern "C"
