@@ -2,6 +2,9 @@
 from . import control_flow  # noqa: F401
 from .control_flow import foreach, while_loop, cond  # noqa: F401
 from . import quantization  # noqa: F401
+from . import text  # noqa: F401
+from . import svrg_optimization  # noqa: F401
+from . import onnx  # noqa: F401
 
 # surface on mx.nd.contrib / mx.sym.contrib like the reference
 def _install():

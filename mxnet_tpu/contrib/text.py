@@ -1,0 +1,144 @@
+"""Text utilities: vocabulary + embeddings
+(ref: python/mxnet/contrib/text/{vocab.py,embedding.py,utils.py}).
+
+Pretrained-embedding downloads are unavailable (zero egress); embeddings
+load from local files in the standard GloVe/fastText text format.
+"""
+from __future__ import annotations
+
+import collections
+import re
+from typing import Dict, List, Optional
+
+import numpy as _np
+
+from ..base import MXNetError, check
+from ..ndarray import ndarray as _nd
+
+__all__ = ["Vocabulary", "CustomEmbedding", "count_tokens_from_str"]
+
+
+def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
+                          to_lower=False, counter_to_update=None):
+    """(ref: contrib/text/utils.py count_tokens_from_str)"""
+    source_str = re.sub(f"[{token_delim}{seq_delim}]+", " ", source_str)
+    if to_lower:
+        source_str = source_str.lower()
+    counter = counter_to_update if counter_to_update is not None \
+        else collections.Counter()
+    counter.update(source_str.split())
+    return counter
+
+
+class Vocabulary:
+    """Token <-> index mapping (ref: contrib/text/vocab.py Vocabulary)."""
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        check(min_freq > 0, "min_freq must be positive")
+        self._unknown_token = unknown_token
+        self._reserved_tokens = list(reserved_tokens or [])
+        self._idx_to_token = [unknown_token] + self._reserved_tokens
+        self._token_to_idx = {t: i for i, t in enumerate(self._idx_to_token)}
+        if counter is not None:
+            pairs = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
+            if most_freq_count is not None:
+                pairs = pairs[:most_freq_count]
+            for tok, freq in pairs:
+                if freq < min_freq or tok in self._token_to_idx:
+                    continue
+                self._token_to_idx[tok] = len(self._idx_to_token)
+                self._idx_to_token.append(tok)
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        out = [self._token_to_idx.get(t, 0) for t in toks]
+        return out[0] if single else out
+
+    def to_tokens(self, indices):
+        single = isinstance(indices, int)
+        idxs = [indices] if single else indices
+        for i in idxs:
+            check(0 <= i < len(self), f"index {i} out of range")
+        out = [self._idx_to_token[i] for i in idxs]
+        return out[0] if single else out
+
+
+class CustomEmbedding:
+    """Embedding matrix loaded from a local GloVe-format text file
+    (ref: contrib/text/embedding.py CustomEmbedding)."""
+
+    def __init__(self, pretrained_file_path=None, elem_delim=" ",
+                 encoding="utf8", vocabulary=None, init_unknown_vec=None):
+        self._token_to_idx: Dict[str, int] = {}
+        self._idx_to_token: List[str] = []
+        self._vecs: List[_np.ndarray] = []
+        self._dim = None
+        if pretrained_file_path is not None:
+            self._load(pretrained_file_path, elem_delim, encoding)
+        self._vocab = vocabulary
+
+    def _load(self, path, delim, encoding):
+        with open(path, encoding=encoding) as f:
+            for line in f:
+                parts = line.rstrip().split(delim)
+                if len(parts) < 2:
+                    continue
+                tok = parts[0]
+                vec = _np.asarray([float(x) for x in parts[1:]], _np.float32)
+                if self._dim is None:
+                    self._dim = vec.size
+                elif vec.size != self._dim:
+                    continue
+                self._token_to_idx[tok] = len(self._idx_to_token)
+                self._idx_to_token.append(tok)
+                self._vecs.append(vec)
+
+    @property
+    def vec_len(self):
+        return self._dim or 0
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        out = []
+        for t in toks:
+            i = self._token_to_idx.get(t)
+            if i is None and lower_case_backup:
+                i = self._token_to_idx.get(t.lower())
+            out.append(self._vecs[i] if i is not None
+                       else _np.zeros(self.vec_len, _np.float32))
+        arr = _np.stack(out)
+        res = _nd.array(arr[0] if single else arr)
+        return res
+
+    def update_token_vectors(self, tokens, new_vectors):
+        if isinstance(tokens, str):
+            tokens = [tokens]
+        vecs = new_vectors.asnumpy() if hasattr(new_vectors, "asnumpy") \
+            else _np.asarray(new_vectors)
+        if vecs.ndim == 1:
+            vecs = vecs[None]
+        for t, v in zip(tokens, vecs):
+            check(t in self._token_to_idx, f"unknown token {t}")
+            self._vecs[self._token_to_idx[t]] = v.astype(_np.float32)
