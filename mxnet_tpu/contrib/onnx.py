@@ -56,6 +56,171 @@ ONNX2MX_OP_MAP: Dict[str, str] = {v: k for k, v in
                                   reversed(list(MX2ONNX_OP_MAP.items()))}
 
 
+def _pair(v):
+    """ONNX spatial attrs are lists; MXNet wants tuples."""
+    return tuple(int(x) for x in v)
+
+
+def _begin_end_pads(pads):
+    """ONNX pads = [x1_begin, x2_begin, ..., x1_end, x2_end, ...];
+    MXNet Convolution/Pooling only support symmetric pads."""
+    n = len(pads) // 2
+    begin, end = pads[:n], pads[n:]
+    if tuple(begin) != tuple(end):
+        raise MXNetError(f"asymmetric ONNX pads {pads} unsupported")
+    return _pair(begin)
+
+
+def _conv(inputs, attrs, w_shape=None):
+    mx_attrs = {"kernel": _pair(attrs["kernel_shape"]),
+                "num_filter": int(w_shape[0]) if w_shape else 0,
+                "no_bias": len(inputs) < 3}
+    if "strides" in attrs:
+        mx_attrs["stride"] = _pair(attrs["strides"])
+    if "pads" in attrs:
+        mx_attrs["pad"] = _begin_end_pads(attrs["pads"])
+    if "dilations" in attrs:
+        mx_attrs["dilate"] = _pair(attrs["dilations"])
+    if "group" in attrs:
+        mx_attrs["num_group"] = int(attrs["group"])
+    return "Convolution", inputs, mx_attrs
+
+
+def _pool(pool_type):
+    def tr(inputs, attrs, w_shape=None):
+        mx_attrs = {"kernel": _pair(attrs["kernel_shape"]),
+                    "pool_type": pool_type}
+        if "strides" in attrs:
+            mx_attrs["stride"] = _pair(attrs["strides"])
+        if "pads" in attrs:
+            mx_attrs["pad"] = _begin_end_pads(attrs["pads"])
+        return "Pooling", inputs, mx_attrs
+    return tr
+
+
+def _global_pool(pool_type):
+    def tr(inputs, attrs, w_shape=None):
+        return "Pooling", inputs, {"kernel": (1, 1), "global_pool": True,
+                                   "pool_type": pool_type}
+    return tr
+
+
+def _gemm(inputs, attrs, w_shape=None):
+    # ONNX Gemm: Y = alpha*A'*B' + beta*C. The FullyConnected mapping is
+    # valid for the (overwhelmingly common) alpha=beta=1, transA=0 export;
+    # transB decides whether B arrives as (out, in) like MXNet's weight.
+    if attrs.get("alpha", 1.0) != 1.0 or attrs.get("beta", 1.0) != 1.0 \
+            or attrs.get("transA", 0):
+        raise MXNetError(f"Gemm with attrs {attrs} unsupported")
+    a, b = inputs[0], inputs[1]
+    trans_b = attrs.get("transB", 0)
+    if not trans_b:
+        from ..symbol.symbol import create
+        b = create("transpose", [b], {"axes": (1, 0)})
+    new_inputs = [a, b] + list(inputs[2:])
+    mx_attrs = {"no_bias": len(inputs) < 3, "flatten": False}
+    if w_shape:
+        mx_attrs["num_hidden"] = int(w_shape[0] if trans_b else w_shape[1])
+    return "FullyConnected", new_inputs, mx_attrs
+
+
+def _gather(inputs, attrs, w_shape=None):
+    if attrs.get("axis", 0) != 0:
+        raise MXNetError("Gather with axis != 0 unsupported")
+    # ONNX Gather(table, indices) -> Embedding(indices, table)
+    return "Embedding", [inputs[1], inputs[0]], {}
+
+
+def _batch_norm(inputs, attrs, w_shape=None):
+    mx_attrs = {"fix_gamma": False}
+    if "epsilon" in attrs:
+        mx_attrs["eps"] = float(attrs["epsilon"])
+    if "momentum" in attrs:
+        mx_attrs["momentum"] = float(attrs["momentum"])
+    return "BatchNorm", inputs, mx_attrs
+
+
+def _simple(mx_op, **fixed):
+    def tr(inputs, attrs, w_shape=None):
+        out = dict(fixed)
+        out.update(attrs)
+        return mx_op, inputs, out
+    return tr
+
+
+def _dropout(inputs, attrs, w_shape=None):
+    a = {}
+    if "ratio" in attrs:
+        a["p"] = float(attrs["ratio"])
+    return "Dropout", inputs, a
+
+
+def _leaky_relu(inputs, attrs, w_shape=None):
+    a = {"act_type": "leaky"}
+    if "alpha" in attrs:
+        a["slope"] = float(attrs["alpha"])
+    return "LeakyReLU", inputs, a
+
+
+def _reshape(inputs, attrs, w_shape=None):
+    if "shape" in attrs:  # opset < 5 carries shape as an attribute
+        return "reshape", inputs[:1], {"shape": _pair(attrs["shape"])}
+    raise MXNetError("Reshape with dynamic shape input unsupported; "
+                     "re-export with shape as attribute (opset 1-4 style)")
+
+
+def _transpose(inputs, attrs, w_shape=None):
+    a = {}
+    if "perm" in attrs:
+        a["axes"] = _pair(attrs["perm"])
+    return "transpose", inputs, a
+
+
+def _flatten(inputs, attrs, w_shape=None):
+    if attrs.get("axis", 1) != 1:
+        raise MXNetError("Flatten with axis != 1 unsupported")
+    return "flatten", inputs, {}
+
+
+def _concat(inputs, attrs, w_shape=None):
+    return "concat", inputs, {"dim": int(attrs.get("axis", 1)),
+                              "num_args": len(inputs)}
+
+
+def _softmax(inputs, attrs, w_shape=None):
+    return "softmax", inputs, {"axis": int(attrs.get("axis", -1))}
+
+
+# ONNX op_type -> fn(inputs, attrs) -> (mx_op, inputs, mx_attrs).
+# Ops not listed fall back to ONNX2MX_OP_MAP with attrs passed through
+# (safe only for attr-free elementwise ops).
+ONNX2MX_TRANSLATORS = {
+    "Conv": _conv,
+    "MaxPool": _pool("max"),
+    "AveragePool": _pool("avg"),
+    "GlobalMaxPool": _global_pool("max"),
+    "GlobalAveragePool": _global_pool("avg"),
+    "Gemm": _gemm,
+    "Gather": _gather,
+    "BatchNormalization": _batch_norm,
+    "Dropout": _dropout,
+    "LeakyRelu": _leaky_relu,
+    "Relu": _simple("relu"),
+    "Sigmoid": _simple("sigmoid"),
+    "Tanh": _simple("tanh"),
+    "Reshape": _reshape,
+    "Transpose": _transpose,
+    "Flatten": _flatten,
+    "Concat": _concat,
+    "Softmax": _softmax,
+    "Add": _simple("broadcast_add"),
+    "Sub": _simple("broadcast_sub"),
+    "Mul": _simple("broadcast_mul"),
+    "Div": _simple("broadcast_div"),
+    "MatMul": _simple("dot"),
+}
+
+
 def _require_onnx():
     try:
         import onnx  # noqa: F401
@@ -86,15 +251,22 @@ def import_model(model_file: str):
     for inp in graph.input:
         if inp.name not in tensors:
             tensors[inp.name] = sym_mod.var(inp.name)
+    from ..symbol.symbol import create
     for node in graph.node:
-        mx_op = ONNX2MX_OP_MAP.get(node.op_type)
-        if mx_op is None:
-            raise MXNetError(f"unsupported ONNX op {node.op_type}")
         inputs = [tensors[i] for i in node.input if i in tensors]
         attrs = {a.name: onnx.helper.get_attribute_value(a)
                  for a in node.attribute}
-        from ..symbol.symbol import create
-        out = create(mx_op, inputs, attrs, name=node.name or None)
+        w_shape = None
+        if len(node.input) > 1 and node.input[1] in arg_params:
+            w_shape = tuple(arg_params[node.input[1]].shape)
+        tr = ONNX2MX_TRANSLATORS.get(node.op_type)
+        if tr is not None:
+            mx_op, inputs, mx_attrs = tr(inputs, attrs, w_shape)
+        elif node.op_type in ONNX2MX_OP_MAP:
+            mx_op, mx_attrs = ONNX2MX_OP_MAP[node.op_type], attrs
+        else:
+            raise MXNetError(f"unsupported ONNX op {node.op_type}")
+        out = create(mx_op, inputs, mx_attrs, name=node.name or None)
         for i, oname in enumerate(node.output):
             tensors[oname] = out[i] if len(node.output) > 1 else out
     outputs = [tensors[o.name] for o in graph.output]

@@ -48,22 +48,19 @@ class SVRGModule(Module):
                 if g is None:
                     continue
                 if name in sums:
-                    sums[name]._rebind((sums[name] + g)._data)
+                    sums[name] = sums[name] + g
                 else:
                     sums[name] = g.copy()
             n_batches += 1
+        check(n_batches > 0, "take_snapshot: train_data yielded no batches")
         self._full_grads = {k: v / n_batches for k, v in sums.items()}
         train_data.reset()
 
     def _svrg_grad(self, batch) -> Dict[str, _nd.NDArray]:
         """g_i(W) - g_i(W~) + mu for the current batch."""
-        # gradient at current weights
-        self.forward(batch, is_train=True)
-        self.backward()
-        cur = {k: self._exec.grad_dict[k].copy()
-               for k in self._param_names if k in self._exec.grad_dict}
-        # gradient at snapshot weights
-        saved = {k: self._exec.arg_dict[k].copy()
+        # gradient at snapshot weights first, so the executor's outputs and
+        # weights are left at the *current* model for update_metric
+        saved = {k: self._exec.arg_dict[k]._data
                  for k in self._param_names}
         for k, v in self._snapshot_params.items():
             if k in self._exec.arg_dict:
@@ -73,7 +70,12 @@ class SVRGModule(Module):
         snap = {k: self._exec.grad_dict[k].copy()
                 for k in self._param_names if k in self._exec.grad_dict}
         for k, v in saved.items():
-            self._exec.arg_dict[k]._rebind(v._data)
+            self._exec.arg_dict[k]._rebind(v)
+        # gradient at current weights (outputs stay bound to these weights)
+        self.forward(batch, is_train=True)
+        self.backward()
+        cur = {k: self._exec.grad_dict[k].copy()
+               for k in self._param_names if k in self._exec.grad_dict}
         out = {}
         for k in cur:
             out[k] = cur[k] - snap[k] + self._full_grads.get(k, cur[k] * 0)

@@ -21,7 +21,8 @@ __all__ = ["Vocabulary", "CustomEmbedding", "count_tokens_from_str"]
 def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
                           to_lower=False, counter_to_update=None):
     """(ref: contrib/text/utils.py count_tokens_from_str)"""
-    source_str = re.sub(f"[{token_delim}{seq_delim}]+", " ", source_str)
+    source_str = re.sub(
+        f"[{re.escape(token_delim)}{re.escape(seq_delim)}]+", " ", source_str)
     if to_lower:
         source_str = source_str.lower()
     counter = counter_to_update if counter_to_update is not None \
@@ -94,15 +95,23 @@ class CustomEmbedding:
         self._idx_to_token: List[str] = []
         self._vecs: List[_np.ndarray] = []
         self._dim = None
+        self._init_unknown_vec = init_unknown_vec
         if pretrained_file_path is not None:
             self._load(pretrained_file_path, elem_delim, encoding)
         self._vocab = vocabulary
+        if vocabulary is not None:
+            # restrict/reorder rows to the vocabulary's index space
+            self._build_for_vocab(vocabulary)
 
     def _load(self, path, delim, encoding):
         with open(path, encoding=encoding) as f:
-            for line in f:
+            for lineno, line in enumerate(f):
                 parts = line.rstrip().split(delim)
                 if len(parts) < 2:
+                    continue
+                # fastText .vec files start with a "num_tokens dim" header
+                if lineno == 0 and len(parts) == 2 and \
+                        all(p.isdigit() for p in parts):
                     continue
                 tok = parts[0]
                 vec = _np.asarray([float(x) for x in parts[1:]], _np.float32)
@@ -113,6 +122,24 @@ class CustomEmbedding:
                 self._token_to_idx[tok] = len(self._idx_to_token)
                 self._idx_to_token.append(tok)
                 self._vecs.append(vec)
+
+    def _unknown_vec(self):
+        if self._init_unknown_vec is not None:
+            v = self._init_unknown_vec(shape=(self.vec_len,))
+            return v.asnumpy().astype(_np.float32) if hasattr(v, "asnumpy") \
+                else _np.asarray(v, _np.float32)
+        return _np.zeros(self.vec_len, _np.float32)
+
+    def _build_for_vocab(self, vocab):
+        """Reindex rows so row i corresponds to vocab.idx_to_token[i]."""
+        vecs, t2i, i2t = [], {}, []
+        for i, tok in enumerate(vocab.idx_to_token):
+            j = self._token_to_idx.get(tok)
+            vecs.append(self._vecs[j] if j is not None
+                        else self._unknown_vec())
+            t2i[tok] = i
+            i2t.append(tok)
+        self._vecs, self._token_to_idx, self._idx_to_token = vecs, t2i, i2t
 
     @property
     def vec_len(self):
@@ -127,7 +154,7 @@ class CustomEmbedding:
             if i is None and lower_case_backup:
                 i = self._token_to_idx.get(t.lower())
             out.append(self._vecs[i] if i is not None
-                       else _np.zeros(self.vec_len, _np.float32))
+                       else self._unknown_vec())
         arr = _np.stack(out)
         res = _nd.array(arr[0] if single else arr)
         return res

@@ -1,0 +1,4 @@
+"""Gluon contrib namespace (ref: python/mxnet/gluon/contrib/__init__.py)."""
+from . import nn
+from . import rnn
+from . import data

@@ -1,0 +1,199 @@
+"""Contrib layers (ref: python/mxnet/gluon/contrib/nn/basic_layers.py).
+
+TPU notes: SyncBatchNorm lowers to the `_contrib_SyncBatchNorm` op whose
+batch reductions become XLA psums when the batch axis is sharded over the
+mesh — `ndev`/`key` are kept for API parity but the mesh, not a comm key,
+decides the reduction group. PixelShuffle is pure reshape/transpose, which
+XLA fuses into the surrounding convolution's output layout change.
+"""
+from __future__ import annotations
+
+from ...block import Block, HybridBlock
+from ..nn import __name__ as _  # noqa: F401  (package anchor)
+from ....base import check
+from ...nn.basic_layers import Sequential, HybridSequential, BatchNorm
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
+           "SyncBatchNorm", "PixelShuffle1D", "PixelShuffle2D",
+           "PixelShuffle3D"]
+
+
+class Concurrent(Sequential):
+    """Runs children on the same input, concatenates outputs on `axis`
+    (ref: contrib/nn/basic_layers.py:31)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x):
+        from .... import ndarray as F
+        out = [child(x) for child in self._children.values()]
+        return F.concatenate(out, axis=self.axis)
+
+
+class HybridConcurrent(HybridSequential):
+    """Hybridizable Concurrent (ref: contrib/nn/basic_layers.py:64)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def _imperative_call(self, x):
+        from .... import ndarray as F
+        out = [child._imperative_call(x) if isinstance(child, HybridBlock)
+               else child(x) for child in self._children.values()]
+        return F.concatenate(out, axis=self.axis)
+
+    def hybrid_forward(self, F, x):
+        out = [child(x) for child in self._children.values()]
+        return F.concatenate(out, axis=self.axis)
+
+
+class Identity(HybridBlock):
+    """Identity mapping (ref: contrib/nn/basic_layers.py:97)."""
+
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SparseEmbedding(Block):
+    """Embedding with row-sparse gradient (ref: contrib/nn/basic_layers.py:118).
+
+    On TPU the gradient is computed as a dense scatter-add; the row-sparse
+    contract (only touched rows updated) is preserved by the optimizer's
+    lazy-update path for rows whose gradient is exactly zero.
+    """
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._input_dim = input_dim
+        self._output_dim = output_dim
+        self.weight = self.params.get("weight",
+                                      shape=(input_dim, output_dim),
+                                      init=weight_initializer, dtype=dtype,
+                                      stype="row_sparse",
+                                      grad_stype="row_sparse")
+
+    def forward(self, x):
+        from .... import ndarray as F
+        return F.Embedding(x, self.weight.data(), sparse_grad=True,
+                           input_dim=self._input_dim,
+                           output_dim=self._output_dim)
+
+    def __repr__(self):
+        return f"SparseEmbedding({self._input_dim} -> {self._output_dim})"
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device synchronized BatchNorm (ref: contrib/nn/basic_layers.py:165
+    -> src/operator/contrib/sync_batch_norm.cc).
+
+    TPU-native: the op's batch-statistics reductions become `psum`s over the
+    data-parallel mesh axis under pjit/shard_map, so the `num_devices`/key
+    machinery of the reference collapses into the sharding annotation.
+    """
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, center=True, scale=True,
+                 use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", prefix=None,
+                 params=None):
+        super().__init__(axis=1, momentum=momentum, epsilon=epsilon,
+                         center=center, scale=scale,
+                         use_global_stats=use_global_stats,
+                         beta_initializer=beta_initializer,
+                         gamma_initializer=gamma_initializer,
+                         running_mean_initializer=running_mean_initializer,
+                         running_variance_initializer=running_variance_initializer,
+                         in_channels=in_channels, prefix=prefix,
+                         params=params)
+        self._num_devices = num_devices if num_devices is not None else 1
+
+    def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
+        from .... import autograd
+        out, mean, var = F.contrib.SyncBatchNorm(
+            x, gamma, beta, running_mean, running_var,
+            eps=self._epsilon, momentum=self._momentum,
+            fix_gamma=not self._scale,
+            use_global_stats=self._use_global_stats,
+            ndev=self._num_devices, key=self.name)
+        if autograd.is_training() and not self._use_global_stats:
+            with autograd.pause():
+                m = self._momentum
+                running_mean._rebind((running_mean * m + mean * (1 - m))._data)
+                running_var._rebind((running_var * m + var * (1 - m))._data)
+        return out
+
+
+class PixelShuffle1D(HybridBlock):
+    """(N, f*C, W) -> (N, C, W*f) (ref: contrib/nn/basic_layers.py:244)."""
+
+    def __init__(self, factor, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._factor = int(factor)
+
+    def hybrid_forward(self, F, x):
+        f = self._factor
+        # (N, f*C, W) -> (N, f, C, W) -> (N, C, W, f) -> (N, C, W*f)
+        x = F.reshape(x, shape=(0, -4, f, -1, 0))
+        x = F.transpose(x, axes=(0, 2, 3, 1))
+        return F.reshape(x, shape=(0, 0, -3))
+
+    def __repr__(self):
+        return f"PixelShuffle1D({self._factor})"
+
+
+class PixelShuffle2D(HybridBlock):
+    """(N, f1*f2*C, H, W) -> (N, C, H*f1, W*f2)
+    (ref: contrib/nn/basic_layers.py:292)."""
+
+    def __init__(self, factor, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        if isinstance(factor, (list, tuple)):
+            self._factors = (int(factor[0]), int(factor[1]))
+        else:
+            self._factors = (int(factor),) * 2
+
+    def hybrid_forward(self, F, x):
+        f1, f2 = self._factors
+        # (N, f1*f2*C, H, W) -> (N, f1, f2, C, H, W)
+        x = F.reshape(x, shape=(0, -4, f1 * f2, -1, 0, 0))
+        x = F.reshape(x, shape=(0, -4, f1, f2, 0, 0, 0))
+        # -> (N, C, H, f1, W, f2)
+        x = F.transpose(x, axes=(0, 3, 4, 1, 5, 2))
+        # -> (N, C, H*f1, W*f2)
+        x = F.reshape(x, shape=(0, 0, -3, -3))
+        return x
+
+    def __repr__(self):
+        return f"PixelShuffle2D({self._factors})"
+
+
+class PixelShuffle3D(HybridBlock):
+    """(N, f1*f2*f3*C, D, H, W) -> (N, C, D*f1, H*f2, W*f3)
+    (ref: contrib/nn/basic_layers.py:354)."""
+
+    def __init__(self, factor, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        if isinstance(factor, (list, tuple)):
+            check(len(factor) == 3, "factor must be int or 3-tuple")
+            self._factors = tuple(int(f) for f in factor)
+        else:
+            self._factors = (int(factor),) * 3
+
+    def hybrid_forward(self, F, x):
+        f1, f2, f3 = self._factors
+        x = F.reshape(x, shape=(0, -4, f1 * f2 * f3, -1, 0, 0, 0))
+        x = F.reshape(x, shape=(0, -4, f1, f2 * f3, 0, 0, 0, 0))
+        x = F.reshape(x, shape=(0, 0, -4, f2, f3, 0, 0, 0, 0))
+        # now (N, f1, f2, f3, C, D, H, W)
+        x = F.transpose(x, axes=(0, 4, 5, 1, 6, 2, 7, 3))
+        x = F.reshape(x, shape=(0, 0, -3, -3, -3))
+        return x
+
+    def __repr__(self):
+        return f"PixelShuffle3D({self._factors})"

@@ -1,0 +1,212 @@
+"""Convolutional RNN/LSTM/GRU cells
+(ref: python/mxnet/gluon/contrib/rnn/conv_rnn_cell.py).
+
+All cells keep spatial dims fixed across steps: the state-to-state (h2h)
+convolution uses same-padding (odd kernels required, as in the reference),
+and the input-to-state (i2h) convolution's output spatial shape — set by the
+user's i2h kernel/pad/dilate — defines the state shape. On TPU both convs
+land on the MXU; the gate arithmetic fuses into their epilogues under jit.
+"""
+from __future__ import annotations
+
+from ....base import MXNetError, check
+from ...rnn.rnn_cell import RecurrentCell
+
+__all__ = ["Conv1DRNNCell", "Conv2DRNNCell", "Conv3DRNNCell",
+           "Conv1DLSTMCell", "Conv2DLSTMCell", "Conv3DLSTMCell",
+           "Conv1DGRUCell", "Conv2DGRUCell", "Conv3DGRUCell"]
+
+
+def _tuplify(x, ndim):
+    if isinstance(x, (list, tuple)):
+        check(len(x) == ndim, f"expected length-{ndim} tuple, got {x}")
+        return tuple(int(v) for v in x)
+    return (int(x),) * ndim
+
+
+class _BaseConvRNNCell(RecurrentCell):
+    """(ref: conv_rnn_cell.py:37 _BaseConvRNNCell)"""
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad, i2h_dilate, h2h_dilate, num_gates, activation,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 conv_layout="NCHW", prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        ndim = len(conv_layout) - 2
+        self._input_shape = tuple(input_shape)
+        self._hidden_channels = hidden_channels
+        self._i2h_kernel = _tuplify(i2h_kernel, ndim)
+        self._h2h_kernel = _tuplify(h2h_kernel, ndim)
+        for k in self._h2h_kernel:
+            check(k % 2 == 1,
+                  f"h2h_kernel dims must be odd for same-padding, got "
+                  f"{self._h2h_kernel}")
+        self._i2h_pad = _tuplify(i2h_pad, ndim)
+        self._i2h_dilate = _tuplify(i2h_dilate, ndim)
+        self._h2h_dilate = _tuplify(h2h_dilate, ndim)
+        self._h2h_pad = tuple(d * (k - 1) // 2 for d, k in
+                              zip(self._h2h_dilate, self._h2h_kernel))
+        self._num_gates = num_gates
+        self._activation = activation
+        self._conv_layout = conv_layout
+        in_channels = self._input_shape[0]
+        spatial = self._input_shape[1:]
+        # state spatial dims = i2h conv output dims (stride 1)
+        self._state_shape = (hidden_channels,) + tuple(
+            (s + 2 * p - d * (k - 1) - 1) + 1
+            for s, p, d, k in zip(spatial, self._i2h_pad, self._i2h_dilate,
+                                  self._i2h_kernel))
+        g = num_gates
+        self.i2h_weight = self.params.get(
+            "i2h_weight",
+            shape=(g * hidden_channels, in_channels) + self._i2h_kernel,
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight",
+            shape=(g * hidden_channels, hidden_channels) + self._h2h_kernel,
+            init=h2h_weight_initializer)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(g * hidden_channels,),
+            init=i2h_bias_initializer)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(g * hidden_channels,),
+            init=h2h_bias_initializer)
+
+    def infer_shape_from_inputs(self, inputs, states=None):
+        pass  # shapes fully specified by input_shape at construction
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size,) + self._state_shape,
+                 "__layout__": self._conv_layout}]
+
+    def _conv_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                      i2h_bias, h2h_bias):
+        g = self._num_gates
+        c = self._hidden_channels
+        i2h = F.Convolution(inputs, i2h_weight, i2h_bias,
+                            kernel=self._i2h_kernel, stride=(1,) *
+                            len(self._i2h_kernel), pad=self._i2h_pad,
+                            dilate=self._i2h_dilate, num_filter=g * c)
+        h2h = F.Convolution(states[0], h2h_weight, h2h_bias,
+                            kernel=self._h2h_kernel, stride=(1,) *
+                            len(self._h2h_kernel), pad=self._h2h_pad,
+                            dilate=self._h2h_dilate, num_filter=g * c)
+        return i2h, h2h
+
+    def _act(self, F, x):
+        return F.Activation(x, act_type=self._activation)
+
+    def __repr__(self):
+        return (f"{type(self).__name__}(in={self._input_shape}, "
+                f"hidden={self._hidden_channels})")
+
+
+class _ConvRNNCell(_BaseConvRNNCell):
+    """(ref: conv_rnn_cell.py:177)"""
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad, i2h_dilate, h2h_dilate, activation, conv_layout,
+                 **kw):
+        super().__init__(input_shape, hidden_channels, i2h_kernel,
+                         h2h_kernel, i2h_pad, i2h_dilate, h2h_dilate,
+                         num_gates=1, activation=activation,
+                         conv_layout=conv_layout, **kw)
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._conv_forward(F, inputs, states, i2h_weight,
+                                      h2h_weight, i2h_bias, h2h_bias)
+        out = self._act(F, i2h + h2h)
+        return out, [out]
+
+
+class _ConvLSTMCell(_BaseConvRNNCell):
+    """(ref: conv_rnn_cell.py:420; Shi et al. 2015 "Convolutional LSTM")"""
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad, i2h_dilate, h2h_dilate, activation, conv_layout,
+                 **kw):
+        super().__init__(input_shape, hidden_channels, i2h_kernel,
+                         h2h_kernel, i2h_pad, i2h_dilate, h2h_dilate,
+                         num_gates=4, activation=activation,
+                         conv_layout=conv_layout, **kw)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size,) + self._state_shape,
+                 "__layout__": self._conv_layout}] * 2
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._conv_forward(F, inputs, states, i2h_weight,
+                                      h2h_weight, i2h_bias, h2h_bias)
+        gates = i2h + h2h
+        slices = F.op.split(gates, num_outputs=4, axis=1)
+        i = F.sigmoid(slices[0])
+        f = F.sigmoid(slices[1])
+        g = self._act(F, slices[2])
+        o = F.sigmoid(slices[3])
+        c = f * states[1] + i * g
+        out = o * self._act(F, c)
+        return out, [out, c]
+
+
+class _ConvGRUCell(_BaseConvRNNCell):
+    """(ref: conv_rnn_cell.py:704)"""
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad, i2h_dilate, h2h_dilate, activation, conv_layout,
+                 **kw):
+        super().__init__(input_shape, hidden_channels, i2h_kernel,
+                         h2h_kernel, i2h_pad, i2h_dilate, h2h_dilate,
+                         num_gates=3, activation=activation,
+                         conv_layout=conv_layout, **kw)
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        prev = states[0]
+        i2h, h2h = self._conv_forward(F, inputs, states, i2h_weight,
+                                      h2h_weight, i2h_bias, h2h_bias)
+        i2h_s = F.op.split(i2h, num_outputs=3, axis=1)
+        h2h_s = F.op.split(h2h, num_outputs=3, axis=1)
+        r = F.sigmoid(i2h_s[0] + h2h_s[0])
+        z = F.sigmoid(i2h_s[1] + h2h_s[1])
+        n = self._act(F, i2h_s[2] + r * h2h_s[2])
+        out = (1 - z) * n + z * prev
+        return out, [out]
+
+
+def _make(base, ndim, name, doc_line):
+    layout = {1: "NCW", 2: "NCHW", 3: "NCDHW"}[ndim]
+
+    class Cell(base):
+        def __init__(self, input_shape, hidden_channels, i2h_kernel,
+                     h2h_kernel, i2h_pad=0, i2h_dilate=1, h2h_dilate=1,
+                     activation="tanh", conv_layout=layout, **kw):
+            super().__init__(input_shape, hidden_channels, i2h_kernel,
+                             h2h_kernel, i2h_pad, i2h_dilate, h2h_dilate,
+                             activation, conv_layout, **kw)
+
+    Cell.__name__ = Cell.__qualname__ = name
+    Cell.__doc__ = doc_line
+    return Cell
+
+
+Conv1DRNNCell = _make(_ConvRNNCell, 1, "Conv1DRNNCell",
+                      "1D conv RNN cell (ref: conv_rnn_cell.py:218)")
+Conv2DRNNCell = _make(_ConvRNNCell, 2, "Conv2DRNNCell",
+                      "2D conv RNN cell (ref: conv_rnn_cell.py:285)")
+Conv3DRNNCell = _make(_ConvRNNCell, 3, "Conv3DRNNCell",
+                      "3D conv RNN cell (ref: conv_rnn_cell.py:352)")
+Conv1DLSTMCell = _make(_ConvLSTMCell, 1, "Conv1DLSTMCell",
+                       "1D conv LSTM cell (ref: conv_rnn_cell.py:473)")
+Conv2DLSTMCell = _make(_ConvLSTMCell, 2, "Conv2DLSTMCell",
+                       "2D conv LSTM cell (ref: conv_rnn_cell.py:550)")
+Conv3DLSTMCell = _make(_ConvLSTMCell, 3, "Conv3DLSTMCell",
+                       "3D conv LSTM cell (ref: conv_rnn_cell.py:627)")
+Conv1DGRUCell = _make(_ConvGRUCell, 1, "Conv1DGRUCell",
+                      "1D conv GRU cell (ref: conv_rnn_cell.py:762)")
+Conv2DGRUCell = _make(_ConvGRUCell, 2, "Conv2DGRUCell",
+                      "2D conv GRU cell (ref: conv_rnn_cell.py:834)")
+Conv3DGRUCell = _make(_ConvGRUCell, 3, "Conv3DGRUCell",
+                      "3D conv GRU cell (ref: conv_rnn_cell.py:906)")

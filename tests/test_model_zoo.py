@@ -1,0 +1,19 @@
+"""Model zoo tests (ref: tests/python/unittest/test_gluon_model_zoo.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.model_zoo.vision import get_model
+
+
+def test_get_model_names():
+    for name in ["resnet18_v1", "vgg11", "squeezenet1.0", "mobilenet0.25",
+                 "densenet121", "inceptionv3", "alexnet"]:
+        net = get_model(name, classes=10)
+        assert net is not None
+
+
+def test_inception_v3_forward():
+    net = get_model("inceptionv3", classes=10)
+    net.initialize()
+    x = mx.nd.array(np.random.rand(1, 3, 299, 299).astype(np.float32))
+    assert net(x).shape == (1, 10)
