@@ -72,8 +72,13 @@ def _begin_end_pads(pads):
 
 
 def _conv(inputs, attrs, w_shape=None):
+    if not w_shape:
+        raise MXNetError(
+            "ONNX Conv import requires the weight to be a graph "
+            "initializer (weights produced by another node or passed "
+            "as runtime inputs are unsupported)")
     mx_attrs = {"kernel": _pair(attrs["kernel_shape"]),
-                "num_filter": int(w_shape[0]) if w_shape else 0,
+                "num_filter": int(w_shape[0]),
                 "no_bias": len(inputs) < 3}
     if "strides" in attrs:
         mx_attrs["stride"] = _pair(attrs["strides"])
@@ -217,7 +222,10 @@ ONNX2MX_TRANSLATORS = {
     "Sub": _simple("broadcast_sub"),
     "Mul": _simple("broadcast_mul"),
     "Div": _simple("broadcast_div"),
-    "MatMul": _simple("dot"),
+    # ONNX MatMul is numpy-style batched matmul; the reference's 'dot'
+    # does tensordot (last axis x first axis) on >2-D inputs, so map to
+    # the dedicated matmul op instead.
+    "MatMul": _simple("matmul"),
 }
 
 

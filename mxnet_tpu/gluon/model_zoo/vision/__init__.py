@@ -19,6 +19,9 @@ _models = {}
 def _register_models():
     import sys
     mod = sys.modules[__name__]
+    # zoo names whose registry key differs from the function name
+    aliases = {"mobilenetv2_1.0": "mobilenet_v2_1_0",
+               "inceptionv3": "inception_v3"}
     for name in ["resnet18_v1", "resnet34_v1", "resnet50_v1", "resnet101_v1",
                  "resnet152_v1", "resnet18_v2", "resnet34_v2", "resnet50_v2",
                  "resnet101_v2", "resnet152_v2", "alexnet", "vgg11", "vgg13",
@@ -28,13 +31,8 @@ def _register_models():
                  "mobilenet0.25", "mobilenetv2_1.0", "densenet121",
                  "densenet161", "densenet169", "densenet201",
                  "inceptionv3"]:
-        if name == "inceptionv3":
-            _models[name] = inception_v3
-            continue
-        attr = name.replace(".", "_").replace("squeezenet1_0", "squeezenet1_0")
+        attr = aliases.get(name, name.replace(".", "_"))
         fn = getattr(mod, attr, None)
-        if fn is None and name.startswith("mobilenetv2"):
-            fn = getattr(mod, "mobilenet_v2_1_0", None)
         if fn is not None:
             _models[name] = fn
 

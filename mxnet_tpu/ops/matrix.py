@@ -328,6 +328,14 @@ def _batch_dot(a, b, transpose_a=False, transpose_b=False, forward_stype=None):
     return jnp.matmul(x, y)
 
 
+@register("matmul")
+def _matmul(a, b):
+    """numpy/ONNX matmul semantics (batched over leading axes) — the
+    target for ONNX MatMul import, which is NOT the reference's tensordot
+    'dot' on >2-D inputs."""
+    return _jnp().matmul(a, b)
+
+
 @register("khatri_rao", variadic=True)
 def _khatri_rao(*mats):
     """Column-wise Kronecker product (ref: src/operator/contrib/krprod.cc)."""

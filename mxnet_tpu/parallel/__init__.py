@@ -10,3 +10,5 @@ from .sharding import (P, named_sharding, shard_batch, replicate,  # noqa
                        ShardingPlan, MP_RULES_TRANSFORMER)
 from .spmd import SPMDTrainer  # noqa: F401
 from .ring_attention import attention, ring_attention  # noqa: F401
+from .moe import init_moe_params, moe_param_specs, moe_ffn  # noqa: F401
+from .pipeline import pipeline_apply  # noqa: F401

@@ -1,0 +1,116 @@
+"""Mixture-of-Experts with expert parallelism (the 'ep' mesh axis).
+
+The reference has no MoE (SURVEY.md §2.3: expert parallelism **Absent**);
+this is a capability the TPU-native design adds as a first-class
+parallelism strategy. Design is the dense Switch/GShard formulation that
+GSPMD shards well:
+
+- expert weights are stacked on a leading E axis and sharded
+  ``P('ep', ...)`` — each ep slice owns E/ep experts,
+- token dispatch/combine are einsums against a (tokens, E, capacity)
+  one-hot dispatch tensor, so the cross-expert exchange lowers to the
+  all-to-all-style collectives GSPMD inserts on the ep axis,
+- top-1 (Switch) or top-2 (GShard) routing with capacity dropping and the
+  standard load-balancing auxiliary loss.
+
+Everything is static-shaped (capacity fixes the per-expert token count) so
+the whole layer stays MXU/XLA friendly — no dynamic gather loops.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+__all__ = ["init_moe_params", "moe_param_specs", "moe_ffn"]
+
+
+def init_moe_params(key, d_model: int, d_ff: int, n_experts: int,
+                    dtype=None) -> Dict[str, Any]:
+    """Stacked expert FFN weights: leading axis = expert."""
+    import jax
+    import jax.numpy as jnp
+    dt = dtype or jnp.float32
+    k = jax.random.split(key, 3)
+    s = 0.02
+    return {
+        "gate": (jax.random.normal(k[0], (d_model, n_experts)) * s
+                 ).astype(jnp.float32),
+        "w_in": (jax.random.normal(k[1], (n_experts, d_model, d_ff)) * s
+                 ).astype(dt),
+        "b_in": jnp.zeros((n_experts, d_ff), dt),
+        "w_out": (jax.random.normal(k[2], (n_experts, d_ff, d_model)) * s
+                  ).astype(dt),
+        "b_out": jnp.zeros((n_experts, d_model), dt),
+    }
+
+
+def moe_param_specs(mesh) -> Dict[str, Any]:
+    """ep-sharded expert stacking; gate replicated. tp (if present) shards
+    the expert hidden dim, composing ep x tp."""
+    from jax.sharding import PartitionSpec as P
+    names = mesh.axis_names if mesh is not None else ()
+    ep = "ep" if "ep" in names else None
+    tp = "tp" if "tp" in names else None
+    return {
+        "gate": P(),
+        "w_in": P(ep, None, tp),
+        "b_in": P(ep, tp),
+        "w_out": P(ep, tp, None),
+        "b_out": P(ep, None),
+    }
+
+
+def moe_ffn(x, params: Dict[str, Any], n_experts: int,
+            capacity_factor: float = 1.25, k: int = 1,
+            act=None) -> Tuple[Any, Any]:
+    """Apply the expert-parallel FFN.
+
+    x: (B, T, D) -> (out (B, T, D), aux_loss scalar).
+    aux_loss is the Switch load-balance loss (mean over tokens of
+    fraction_routed * mean_gate_prob, scaled by E); add it to the task
+    loss with a small coefficient (~1e-2).
+    """
+    import jax
+    import jax.numpy as jnp
+    act = act or jax.nn.gelu
+    b, t, d = x.shape
+    n = b * t
+    e = n_experts
+    cap = max(1, int(math.ceil(n * capacity_factor * k / e)))
+
+    xf = x.reshape(n, d)
+    scores = xf.astype(jnp.float32) @ params["gate"]          # (N, E)
+    probs = jax.nn.softmax(scores, axis=-1)
+
+    dispatch = jnp.zeros((n, e), jnp.float32)
+    combine_w = jnp.zeros((n, e), jnp.float32)
+    remaining = probs
+    for _ in range(k):
+        idx = jnp.argmax(remaining, axis=-1)                  # (N,)
+        oh = jax.nn.one_hot(idx, e, dtype=jnp.float32)        # (N, E)
+        combine_w = combine_w + remaining * oh
+        dispatch = dispatch + oh
+        remaining = remaining * (1.0 - oh)
+
+    # position of each token within its expert's buffer (per expert-slot)
+    pos = jnp.cumsum(dispatch, axis=0) * dispatch             # (N, E), 1-based
+    keep = (pos > 0) & (pos <= cap)
+    pos0 = jnp.clip(pos - 1.0, 0, cap - 1).astype(jnp.int32)
+    slot = jax.nn.one_hot(pos0, cap, dtype=jnp.float32)       # (N, E, C)
+    disp = slot * keep[..., None]                             # (N, E, C)
+
+    # load-balance aux loss (Switch eq. 4): E * sum_e f_e * P_e
+    frac = jnp.mean(dispatch, axis=0)                         # (E,)
+    mean_prob = jnp.mean(probs, axis=0)                       # (E,)
+    aux = e * jnp.sum(frac / max(k, 1) * mean_prob)
+
+    # dispatch -> expert compute -> combine (all einsums; ep collectives
+    # are inserted by GSPMD from the P('ep',...) weight shardings)
+    xe = jnp.einsum("nec,nd->ecd", disp.astype(x.dtype), xf)  # (E, C, D)
+    h = act(jnp.einsum("ecd,edf->ecf", xe, params["w_in"])
+            + params["b_in"][:, None, :])
+    ye = jnp.einsum("ecf,efd->ecd", h, params["w_out"]) \
+        + params["b_out"][:, None, :]                         # (E, C, D)
+    comb = (disp * combine_w[..., None]).astype(x.dtype)      # (N, E, C)
+    out = jnp.einsum("nec,ecd->nd", comb, ye)                 # (N, D)
+    return out.reshape(b, t, d), aux

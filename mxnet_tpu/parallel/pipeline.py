@@ -1,0 +1,84 @@
+"""Pipeline parallelism over the 'pp' mesh axis (GPipe microbatch schedule).
+
+The reference has no first-class pipeline parallelism (SURVEY.md §2.3:
+closest is PartialForward stepping + the dependency engine's DAG overlap,
+include/mxnet/executor.h). The TPU-native design provides it as a real
+strategy: layer stacks are sharded over 'pp' (each slice owns a stage) and
+microbatches flow through stages via ``lax.ppermute`` inside a
+partial-manual ``jax.shard_map`` — the rotation pattern rides neighbor ICI
+links, while every other mesh axis (dp/tp/sp/ep) stays under automatic
+GSPMD partitioning inside the stage body.
+
+Schedule: classic GPipe fill-drain. With S stages and M microbatches the
+scan runs M+S-1 ticks; bubble fraction = (S-1)/(M+S-1), so pick M >= S.
+The whole schedule is one ``lax.scan`` => one XLA while-loop, fully
+differentiable (ppermute/psum have transpose rules), so fwd+bwd+update
+still compile into a single program.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from ..base import check
+from .mesh import axis_size
+
+__all__ = ["pipeline_apply"]
+
+
+def pipeline_apply(stage_fn: Callable, stage_params: Any, x, mesh,
+                   axis: str = "pp", n_microbatches: Optional[int] = None):
+    """Run ``x`` through S pipeline stages.
+
+    stage_fn(local_stage_params, x_mb) -> y_mb, shape/dtype preserving.
+    stage_params: pytree whose leaves have leading axis S (stage-stacked),
+        placed with ``P('pp', ...)`` sharding.
+    x: (B, ...) activations (replicated over 'pp'; may be sharded on other
+        mesh axes — those stay automatic inside the stage body).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    S = axis_size(mesh, axis)
+    if S == 1:
+        local = jax.tree_util.tree_map(lambda a: a[0], stage_params)
+        return stage_fn(local, x)
+
+    M = int(n_microbatches or S)
+    B = x.shape[0]
+    check(B % M == 0, f"batch {B} not divisible by {M} microbatches")
+    mb = x.reshape(M, B // M, *x.shape[1:])
+
+    def local_fn(sp, mb):
+        lp = jax.tree_util.tree_map(lambda a: a[0], sp)  # this stage's slice
+        stage = jax.lax.axis_index(axis)
+        zero = jnp.zeros_like(mb[0])
+        in0 = jnp.where(stage == 0, mb[0], zero)
+        outs0 = jnp.zeros_like(mb)
+        perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def tick(carry, t):
+            in_buf, outs = carry
+            y = stage_fn(lp, in_buf)
+            y_prev = jax.lax.ppermute(y, axis, perm)
+            nxt = jax.lax.dynamic_index_in_dim(
+                mb, jnp.clip(t + 1, 0, M - 1), 0, keepdims=False)
+            in_next = jnp.where(stage == 0, nxt, y_prev)
+            # last stage emits microbatch t-(S-1) at tick t
+            oidx = jnp.clip(t - (S - 1), 0, M - 1)
+            cur = jax.lax.dynamic_index_in_dim(outs, oidx, 0, keepdims=False)
+            outs = jax.lax.dynamic_update_index_in_dim(
+                outs, jnp.where(t >= S - 1, y, cur), oidx, 0)
+            return (in_next, outs), None
+
+        (_, outs), _ = jax.lax.scan(tick, (in0, outs0),
+                                    jnp.arange(M + S - 1))
+        # only the last stage's buffer is real; broadcast it to all stages
+        outs = jnp.where(stage == S - 1, outs, jnp.zeros_like(outs))
+        return jax.lax.psum(outs, axis)
+
+    f = jax.shard_map(local_fn, mesh=mesh,
+                      in_specs=(P(axis), P()), out_specs=P(),
+                      axis_names={axis}, check_vma=False)
+    y = f(stage_params, mb)
+    return y.reshape(B, *y.shape[2:])

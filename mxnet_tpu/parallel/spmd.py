@@ -105,8 +105,6 @@ class SPMDTrainer:
         compute_dtype = self._compute_dtype
 
         def step(train_arrays, aux_arrays, opt_state, key, t, data, label):
-            aux_updates: Dict[int, Any] = {}
-
             def loss_of(params):
                 originals = []
                 for p, a in zip(trainable, params):
@@ -130,10 +128,11 @@ class SPMDTrainer:
                     out = block._imperative_call(x)
                     loss = loss_fn(out, from_jax(label))
                     loss_val = jnp.mean(loss._data.astype(jnp.float32))
-                    for i, (p, o) in enumerate(zip(aux, aux_orig)):
-                        if p._data._data is not aux_arrays[i]:
-                            aux_updates[i] = p._data._data
-                    return loss_val
+                    # BatchNorm & friends rebind running stats during the
+                    # forward; surface them as a has_aux output so the
+                    # tracers stay inside the value_and_grad scope.
+                    new_aux = tuple(p._data._data for p in aux)
+                    return loss_val, new_aux
                 finally:
                     autograd.set_training(prev_t)
                     autograd.set_recording(prev_r)
@@ -143,7 +142,8 @@ class SPMDTrainer:
                     for p, o in zip(aux, aux_orig):
                         p._data._data = o
 
-            loss, grads = jax.value_and_grad(loss_of)(tuple(train_arrays))
+            (loss, new_aux), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(tuple(train_arrays))
 
             new_params = []
             if optimizer == "sgd":
@@ -175,8 +175,6 @@ class SPMDTrainer:
                     new_params.append(w - lr_t * nm / (jnp.sqrt(nv) + eps))
                 new_opt = (tuple(new_m), tuple(new_v))
 
-            new_aux = tuple(aux_updates.get(i, a)
-                            for i, a in enumerate(aux_arrays))
             return loss, tuple(new_params), new_aux, new_opt
 
         donate = (0, 1, 2)
