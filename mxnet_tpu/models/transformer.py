@@ -221,7 +221,8 @@ def forward(params, tokens, config: TransformerConfig, mesh=None,
 
     blk = _block
     if config.remat:
-        blk = jax.checkpoint(_block, static_argnums=(2,))
+        # config, Mesh and NamedSharding are all hashable non-array args
+        blk = jax.checkpoint(_block, static_argnums=(2, 3, 4))
 
     aux = jnp.zeros((), jnp.float32)
     for i in range(config.n_layers):
@@ -310,7 +311,8 @@ def make_pipeline_train_step(config: TransformerConfig, mesh,
           f"n_layers={L} must divide over {S} pipeline stages")
     lps = L // max(S, 1)
     if config.n_experts > 0:
-        check(all(config.is_moe_layer(i) for i in range(L)),
+        moe_flags = [config.is_moe_layer(i) for i in range(L)]
+        check(all(moe_flags) or not any(moe_flags),
               "pipeline stacking needs uniform layers (set moe_every=1)")
 
     names = mesh.axis_names
