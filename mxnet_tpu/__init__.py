@@ -48,6 +48,9 @@ from . import test_utils  # noqa: F401
 from . import contrib  # noqa: F401
 from . import parallel  # noqa: F401
 from . import models  # noqa: F401
+# already imported via ops/__init__ (registration must precede nd codegen);
+# re-imported here to declare mx.operator as public API surface
+from . import operator  # noqa: F401
 from . import lr_scheduler as _lr  # noqa: F401
 from . import image  # noqa: F401
 from . import rnn  # noqa: F401

@@ -1,0 +1,332 @@
+"""Custom Python operators (``mx.operator``).
+
+TPU-native re-design of the reference custom-op host
+(ref: src/operator/custom/custom-inl.h:51,117,153 — a CustomOperator
+singleton with its own callback thread pool so user Python code never
+blocks the dependency engine; python/mxnet/operator.py — the
+CustomOp/CustomOpProp/register user API).
+
+Here the same contract rides on ``jax.pure_callback``: the op's Python
+``forward``/``backward`` run on the host, invoked by the XLA runtime at the
+right point in the device program (TPU host callbacks go over the
+outfeed/infeed channel), so the device pipeline is not serialized by
+Python — the pure_callback node is just another async op to XLA, which is
+exactly the role the reference's callback thread pool plays for its engine.
+Autograd integration uses ``jax.custom_vjp`` so a Custom node works under
+eager autograd, hybridized CachedOp graphs, and the symbolic executor
+alike (all three funnel through the one registered op fn).
+
+User API matches the reference:
+
+    @mx.operator.register("my_relu")
+    class MyReluProp(mx.operator.CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=True)
+        def list_arguments(self): return ["data"]
+        def list_outputs(self): return ["output"]
+        def infer_shape(self, in_shape): return in_shape, [in_shape[0]], []
+        def create_operator(self, ctx, shapes, dtypes): return MyRelu()
+
+    class MyRelu(mx.operator.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            self.assign(out_data[0], req[0], mx.nd.maximum(in_data[0], 0))
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            g = out_grad[0] * (in_data[0] > 0)
+            self.assign(in_grad[0], req[0], g)
+
+    y = mx.nd.Custom(x, op_type="my_relu")
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as _np
+
+from .base import MXNetError, check
+from .ops import registry as _reg
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered",
+           "NumpyOp", "NDArrayOp"]
+
+_REGISTRY: Dict[str, type] = {}
+_REG_LOCK = threading.Lock()
+
+
+class CustomOp:
+    """Base class for custom operators (ref: python/mxnet/operator.py
+    ``class CustomOp``). Override ``forward`` and ``backward``."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        """Compute outputs from ``in_data`` into ``out_data``."""
+        raise NotImplementedError("CustomOp.forward must be overridden")
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        """Compute input gradients into ``in_grad``; default: zero grads."""
+        for i, g in enumerate(in_grad):
+            self.assign(g, req[i] if i < len(req) else "write",
+                        _zeros_like_nd(g))
+
+    @staticmethod
+    def assign(dst, req, src):
+        """Write ``src`` into ``dst`` honoring the write request
+        (ref OpReqType: null / write / inplace / add)."""
+        if req in ("null", 0):
+            return
+        if req in ("add", "add_to", 3):
+            dst[:] = dst + src
+        else:  # write / inplace
+            dst[:] = src
+
+
+class CustomOpProp:
+    """Declarative half of a custom op (ref ``class CustomOpProp``):
+    names, shapes, types, and the factory for the imperative half."""
+
+    def __init__(self, need_top_grad: bool = True):
+        self.need_top_grad_ = bool(need_top_grad)
+        # string kwargs from the call site, set by the host before use
+        # (mirrors the reference passing op params as strings).
+        self._kwargs: Dict[str, str] = {}
+
+    # -- declarations ----------------------------------------------------
+    def list_arguments(self) -> List[str]:
+        return ["data"]
+
+    def list_outputs(self) -> List[str]:
+        return ["output"]
+
+    def list_auxiliary_states(self) -> List[str]:
+        return []
+
+    def infer_shape(self, in_shape):
+        """Default: all inputs equal-shaped; one output of that shape."""
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        t = in_type[0] if in_type else _np.float32
+        return ([t] * len(in_type),
+                [t] * len(self.list_outputs()),
+                [t] * len(self.list_auxiliary_states()))
+
+    def infer_storage_type(self, in_stype):
+        return (in_stype, ["default"] * len(self.list_outputs()),
+                ["default"] * len(self.list_auxiliary_states()))
+
+    def infer_storage_type_backward(self, ograd_stype, in_stype, out_stype,
+                                    igrad_stype, aux_stype):
+        return (ograd_stype, in_stype, out_stype,
+                ["default"] * len(igrad_stype), aux_stype)
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        """Which arrays backward needs (ref: used for memory release
+        planning; here XLA's liveness analysis plans memory, so this is
+        honored but purely declarative)."""
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes) -> CustomOp:
+        raise NotImplementedError("CustomOpProp.create_operator must be "
+                                  "overridden")
+
+
+def register(reg_name: str):
+    """Class decorator registering a ``CustomOpProp`` subclass under
+    ``op_type=reg_name`` (ref: mx.operator.register)."""
+
+    def deco(prop_cls: type) -> type:
+        check(isinstance(prop_cls, type) and
+              issubclass(prop_cls, CustomOpProp),
+              f"register({reg_name!r}) expects a CustomOpProp subclass, "
+              f"got {prop_cls!r}")
+        with _REG_LOCK:
+            _REGISTRY[reg_name] = prop_cls
+        return prop_cls
+
+    return deco
+
+
+def get_all_registered() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+def _get_prop(op_type: str, kwargs: Dict[str, str]) -> CustomOpProp:
+    try:
+        cls = _REGISTRY[op_type]
+    except KeyError:
+        raise MXNetError(
+            f"custom op type {op_type!r} is not registered; known: "
+            f"{get_all_registered()}") from None
+    # the reference passes user kwargs to the prop constructor as strings
+    try:
+        prop = cls(**kwargs)
+    except TypeError:
+        prop = cls()
+    prop._kwargs = dict(kwargs)
+    return prop
+
+
+def _zeros_like_nd(arr):
+    from . import ndarray as nd
+    return nd.zeros(arr.shape, dtype=arr.dtype)
+
+
+def _to_ndarrays(np_arrays: Sequence[_np.ndarray]):
+    """Host-side: wrap callback numpy buffers as framework NDArrays so user
+    forward/backward code can use the full mx.nd API."""
+    from .ndarray.ndarray import array
+    return [array(a) for a in np_arrays]
+
+
+def _shapes_key(arrays) -> Tuple:
+    return tuple((tuple(a.shape), _np.dtype(a.dtype).name) for a in arrays)
+
+
+class _OpInstanceCache:
+    """One live CustomOp instance per (op_type, kwargs, input signature),
+    shared between the forward and backward callbacks — the analog of the
+    reference creating the operator once at bind time
+    (ref: custom.cc CreateState)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cache: Dict[Tuple, CustomOp] = {}
+
+    def get(self, op_type: str, kwargs_key: Tuple, sig: Tuple,
+            prop: CustomOpProp, shapes, dtypes) -> CustomOp:
+        key = (op_type, kwargs_key, sig)
+        with self._lock:
+            inst = self._cache.get(key)
+            if inst is None:
+                from .context import current_context
+                inst = prop.create_operator(current_context(), shapes, dtypes)
+                self._cache[key] = inst
+            return inst
+
+
+_INSTANCES = _OpInstanceCache()
+
+
+def _split_str_kwargs(params: Dict[str, Any]) -> Dict[str, str]:
+    return {k: str(v) for k, v in params.items()}
+
+
+def _custom_impl(*inputs, op_type: str, _training: bool = False, **kwargs):
+    """The registered ``Custom`` op body: a pure-jax function whose forward
+    and backward are host callbacks into the user's CustomOp.
+
+    ``_training`` is injected by the frontend wrapper (like Dropout/
+    BatchNorm) so the jit cache keys eager train vs eval mode separately.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    is_train = bool(_training)
+    str_kwargs = _split_str_kwargs(kwargs)
+    kwargs_key = tuple(sorted(str_kwargs.items()))
+    prop = _get_prop(op_type, str_kwargs)
+
+    n_args = len(prop.list_arguments())
+    n_aux = len(prop.list_auxiliary_states())
+    n_out = len(prop.list_outputs())
+    check(len(inputs) == n_args + n_aux,
+          f"Custom({op_type}): expected {n_args} arguments + {n_aux} "
+          f"auxiliary states, got {len(inputs)} inputs")
+    data_in = inputs[:n_args]
+    aux_in = inputs[n_args:]
+
+    in_shapes = [tuple(x.shape) for x in data_in]
+    ishapes, oshapes, ashapes = prop.infer_shape([list(s) for s in in_shapes])
+    itypes, otypes, _atypes = prop.infer_type(
+        [_np.dtype(x.dtype) for x in data_in])
+    out_specs = tuple(jax.ShapeDtypeStruct(tuple(s), _np.dtype(t))
+                      for s, t in zip(oshapes, otypes))
+    sig = _shapes_key(data_in)
+
+    def _operator():
+        return _INSTANCES.get(op_type, kwargs_key, sig, prop,
+                              [list(s) for s in ishapes],
+                              [_np.dtype(t) for t in itypes])
+
+    def host_forward(*host_arrays):
+        host_arrays = [_np.asarray(a) for a in host_arrays]
+        nd_in = _to_ndarrays(host_arrays[:n_args])
+        nd_aux = _to_ndarrays(host_arrays[n_args:])
+        nd_out = _to_ndarrays([_np.zeros(tuple(s), _np.dtype(t))
+                               for s, t in zip(oshapes, otypes)])
+        op = _operator()
+        op.forward(is_train, ["write"] * n_out, nd_in, nd_out, nd_aux)
+        return tuple(o.asnumpy().astype(t, copy=False)
+                     for o, t in zip(nd_out, otypes))
+
+    def host_backward(*host_arrays):
+        host_arrays = [_np.asarray(a) for a in host_arrays]
+        grads = host_arrays[:n_out]
+        dins = host_arrays[n_out:n_out + n_args]
+        auxs = host_arrays[n_out + n_args:n_out + n_args + n_aux]
+        outs = host_arrays[n_out + n_args + n_aux:]
+        nd_og = _to_ndarrays(grads) if prop.need_top_grad_ else []
+        nd_in = _to_ndarrays(dins)
+        nd_out = _to_ndarrays(outs)
+        nd_aux = _to_ndarrays(auxs)
+        nd_ig = _to_ndarrays([_np.zeros_like(a) for a in dins])
+        op = _operator()
+        op.backward(["write"] * n_args, nd_og, nd_in, nd_out, nd_ig, nd_aux)
+        return tuple(g.asnumpy().astype(a.dtype, copy=False)
+                     for g, a in zip(nd_ig, dins))
+
+    @jax.custom_vjp
+    def run(data_in, aux_in):
+        outs = jax.pure_callback(host_forward, out_specs,
+                                 *data_in, *aux_in)
+        return tuple(outs)
+
+    def run_fwd(data_in, aux_in):
+        outs = run(data_in, aux_in)
+        return outs, (data_in, aux_in, outs)
+
+    def run_bwd(res, cots):
+        data_in_r, aux_in_r, outs_r = res
+        in_specs = tuple(jax.ShapeDtypeStruct(tuple(x.shape),
+                                              _np.dtype(x.dtype))
+                         for x in data_in_r)
+        grads = jax.pure_callback(host_backward, in_specs, *cots,
+                                  *data_in_r, *aux_in_r, *outs_r)
+        aux_grads = tuple(jnp.zeros(a.shape, a.dtype) for a in aux_in_r)
+        return (tuple(grads), aux_grads)
+
+    run.defvjp(run_fwd, run_bwd)
+
+    result = run(tuple(data_in), tuple(aux_in))
+    return result if n_out > 1 else result[0]
+
+
+def _custom_n_out(n_inputs: int, params: Dict[str, Any]) -> int:
+    op_type = params.get("op_type")
+    if op_type is None:
+        raise MXNetError("Custom requires an op_type= keyword")
+    prop = _get_prop(str(op_type),
+                     _split_str_kwargs({k: v for k, v in params.items()
+                                        if k not in ("op_type", "_training")}))
+    return len(prop.list_outputs())
+
+
+_reg.register("Custom", num_outputs=_custom_n_out, variadic=True,
+              doc=__doc__)(_custom_impl)
+
+
+class NumpyOp:
+    """Deprecated in the reference (python/mxnet/operator.py PythonOp);
+    kept as a named stub pointing users at CustomOp."""
+
+    def __init__(self, *a, **kw):
+        raise MXNetError("NumpyOp/PythonOp are deprecated upstream; "
+                         "subclass mx.operator.CustomOp + CustomOpProp "
+                         "and mx.operator.register instead")
+
+
+NDArrayOp = NumpyOp
