@@ -17,6 +17,7 @@ from . import rnn_op  # noqa: F401
 from . import linalg  # noqa: F401
 from . import pallas_kernels  # noqa: F401
 from . import quantization  # noqa: F401
+from . import ctc  # noqa: F401
 from . import contrib_ops  # noqa: F401
 from .. import operator as _custom_host  # noqa: F401  (registers Custom)
 

@@ -1,0 +1,111 @@
+"""CTCLoss vs torch.nn.functional.ctc_loss as numerical oracle
+(model: tests/python/unittest/test_operator.py check_ctc_loss, which checks
+against a numpy forward-algorithm implementation)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+torch = pytest.importorskip("torch")
+
+
+def torch_ctc(acts, labels, data_len, label_len, blank):
+    lp = torch.log_softmax(torch.tensor(acts, dtype=torch.float32), dim=-1)
+    lp.requires_grad_(True)
+    loss = torch.nn.functional.ctc_loss(
+        lp, torch.tensor(labels, dtype=torch.long),
+        torch.tensor(data_len, dtype=torch.long),
+        torch.tensor(label_len, dtype=torch.long),
+        blank=blank, reduction="none", zero_infinity=False)
+    return loss.detach().numpy()
+
+
+def test_ctc_loss_matches_torch_blank_first():
+    rs = np.random.RandomState(0)
+    T, B, A, L = 20, 4, 6, 5
+    acts = rs.randn(T, B, A).astype(np.float32)
+    # blank_label='first': blank id 0, labels in 1..A-1, padding 0
+    label_len = np.array([5, 3, 4, 1], dtype=np.int32)
+    labels = np.zeros((B, L), dtype=np.int32)
+    for b in range(B):
+        labels[b, :label_len[b]] = rs.randint(1, A, size=label_len[b])
+    data_len = np.full((B,), T, dtype=np.int32)
+
+    out = nd.CTCLoss(nd.array(acts), nd.array(labels)).asnumpy()
+    ref = torch_ctc(acts, labels, data_len, label_len, blank=0)
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_ctc_loss_variable_lengths_blank_last():
+    rs = np.random.RandomState(1)
+    T, B, A, L = 15, 3, 5, 4
+    acts = rs.randn(T, B, A).astype(np.float32)
+    data_len = np.array([15, 10, 8], dtype=np.int32)
+    label_len = np.array([4, 2, 3], dtype=np.int32)
+    labels = np.full((B, L), -1, dtype=np.int32)
+    for b in range(B):
+        labels[b, :label_len[b]] = rs.randint(0, A - 1, size=label_len[b])
+
+    out = nd.CTCLoss(nd.array(acts), nd.array(labels),
+                     nd.array(data_len), nd.array(label_len),
+                     use_data_lengths=True, use_label_lengths=True,
+                     blank_label="last").asnumpy()
+    ref = torch_ctc(acts, np.where(labels < 0, 0, labels), data_len,
+                    label_len, blank=A - 1)
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_ctc_loss_gradient_matches_torch():
+    rs = np.random.RandomState(2)
+    T, B, A, L = 12, 2, 5, 3
+    acts = rs.randn(T, B, A).astype(np.float32)
+    labels = np.array([[1, 2, 1], [3, 1, 0]], dtype=np.int32)
+    label_len = np.array([3, 2], dtype=np.int64)
+    data_len = np.full((B,), T, dtype=np.int64)
+
+    x = nd.array(acts)
+    x.attach_grad()
+    with autograd.record():
+        loss = nd.CTCLoss(x, nd.array(labels))
+        total = nd.sum(loss)
+    total.backward()
+
+    t = torch.tensor(acts, requires_grad=True)
+    lp = torch.log_softmax(t, dim=-1)
+    tl = torch.nn.functional.ctc_loss(
+        lp, torch.tensor(labels, dtype=torch.long),
+        torch.tensor(data_len), torch.tensor(label_len),
+        blank=0, reduction="sum", zero_infinity=False)
+    tl.backward()
+    assert_almost_equal(x.grad.asnumpy(), t.grad.numpy(),
+                        rtol=1e-3, atol=1e-4)
+
+
+def test_gluon_ctc_loss_layout():
+    rs = np.random.RandomState(3)
+    from mxnet_tpu.gluon.loss import CTCLoss
+    B, T, A = 2, 10, 5
+    acts_ntc = rs.randn(B, T, A).astype(np.float32)
+    labels = np.array([[0, 1, 2], [2, 3, -1]], dtype=np.int32)
+    loss_fn = CTCLoss(layout="NTC", label_layout="NT")
+    out = loss_fn(nd.array(acts_ntc), nd.array(labels)).asnumpy()
+    ref = torch_ctc(np.swapaxes(acts_ntc, 0, 1),
+                    np.where(labels < 0, 0, labels),
+                    np.full((B,), T, dtype=np.int32),
+                    np.array([3, 2], dtype=np.int32), blank=A - 1)
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_ctc_loss_mid_row_padding_is_packed():
+    # padding embedded mid-row must be removed, not treated as labels
+    # (ref: ctc_loss.cc LabelTensorToPackedVector)
+    rs = np.random.RandomState(4)
+    T, B, A = 10, 1, 4
+    acts = rs.randn(T, B, A).astype(np.float32)
+    out = nd.CTCLoss(nd.array(acts),
+                     nd.array(np.array([[1, 0, 2]], dtype=np.int32))).asnumpy()
+    ref = torch_ctc(acts, np.array([[1, 2]], dtype=np.int32),
+                    np.array([T]), np.array([2]), blank=0)
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-4)
