@@ -226,44 +226,63 @@ ONNX2MX_TRANSLATORS = {
     # does tensordot (last axis x first axis) on >2-D inputs, so map to
     # the dedicated matmul op instead.
     "MatMul": _simple("matmul"),
+    "Clip": lambda inputs, attrs, w_shape=None: (
+        "clip", inputs, {"a_min": float(attrs.get("min", -3.4e38)),
+                         "a_max": float(attrs.get("max", 3.4e38))}),
+    "Identity": _simple("_copy"),
+    "LogSoftmax": lambda inputs, attrs, w_shape=None: (
+        "log_softmax", inputs, {"axis": int(attrs.get("axis", -1))}),
+    "Abs": _simple("abs"),
+    "Neg": _simple("negative"),
+    "Exp": _simple("exp"),
+    "Log": _simple("log"),
+    "Sqrt": _simple("sqrt"),
+    "Softplus": _simple("Activation", act_type="softrelu"),
+    "Softsign": _simple("Activation", act_type="softsign"),
 }
-
-
-def _require_onnx():
-    try:
-        import onnx  # noqa: F401
-        return onnx
-    except ImportError:
-        raise MXNetError(
-            "the onnx package is not installed in this environment; "
-            "ONNX import/export is unavailable (op mapping tables in "
-            "mxnet_tpu.contrib.onnx remain usable)")
 
 
 def import_model(model_file: str):
     """ONNX graph -> (sym, arg_params, aux_params)
-    (ref: onnx2mx/import_model.py)."""
-    onnx = _require_onnx()
+    (ref: onnx2mx/import_model.py). Parsing runs on the self-contained
+    protobuf codec in onnx_proto.py — no onnx package needed."""
+    from . import onnx_proto as oproto
     from .. import symbol as sym_mod
     from ..ndarray import ndarray as _nd
     import numpy as np
 
-    model = onnx.load(model_file)
+    model = oproto.load(model_file)
     graph = model.graph
     tensors: Dict[str, Any] = {}
+    init_vals: Dict[str, Any] = {}
     arg_params: Dict[str, Any] = {}
     for init in graph.initializer:
-        arr = onnx.numpy_helper.to_array(init)
-        arg_params[init.name] = _nd.array(np.ascontiguousarray(arr))
+        arr = np.ascontiguousarray(oproto.to_array(init))
+        init_vals[init.name] = arr
+        arg_params[init.name] = _nd.array(arr)
         tensors[init.name] = sym_mod.var(init.name)
     for inp in graph.input:
         if inp.name not in tensors:
             tensors[inp.name] = sym_mod.var(inp.name)
     from ..symbol.symbol import create
     for node in graph.node:
-        inputs = [tensors[i] for i in node.input if i in tensors]
-        attrs = {a.name: onnx.helper.get_attribute_value(a)
+        attrs = {a.name: oproto.attribute_value(a)
                  for a in node.attribute}
+        in_names = list(node.input)
+        if node.op_type == "Reshape" and len(in_names) > 1 \
+                and in_names[1] in init_vals and "shape" not in attrs:
+            # opset >= 5: shape arrives as an int64 initializer input
+            attrs["shape"] = tuple(int(x) for x in init_vals[in_names[1]])
+            in_names = in_names[:1]
+        elif node.op_type == "Clip" and len(in_names) > 1:
+            # opset >= 11: min/max arrive as (optional, possibly empty-named)
+            # tensor inputs
+            if len(in_names) > 1 and in_names[1] and in_names[1] in init_vals:
+                attrs["min"] = float(init_vals[in_names[1]])
+            if len(in_names) > 2 and in_names[2] and in_names[2] in init_vals:
+                attrs["max"] = float(init_vals[in_names[2]])
+            in_names = in_names[:1]
+        inputs = [tensors[i] for i in in_names if i in tensors]
         w_shape = None
         if len(node.input) > 1 and node.input[1] in arg_params:
             w_shape = tuple(arg_params[node.input[1]].shape)
@@ -276,15 +295,412 @@ def import_model(model_file: str):
             raise MXNetError(f"unsupported ONNX op {node.op_type}")
         out = create(mx_op, inputs, mx_attrs, name=node.name or None)
         for i, oname in enumerate(node.output):
-            tensors[oname] = out[i] if len(node.output) > 1 else out
+            # a multi-output mx op (e.g. BatchNorm's out/mean/var) may back
+            # a single-output ONNX node: use output 0
+            tensors[oname] = out[i] if len(out) > 1 else out
     outputs = [tensors[o.name] for o in graph.output]
     final = outputs[0] if len(outputs) == 1 else sym_mod.Group(outputs)
+    used = set(final.list_inputs())
+    arg_params = {k: v for k, v in arg_params.items() if k in used}
     return final, arg_params, {}
+
+
+# ---------------------------------------------------------------------------
+# mx2onnx export (ref: python/mxnet/contrib/onnx/mx2onnx/)
+# ---------------------------------------------------------------------------
+
+def _ints(v):
+    if isinstance(v, (tuple, list)):
+        return [int(x) for x in v]
+    if isinstance(v, str):
+        return [int(x) for x in v.strip("()[] ").split(",") if x.strip()]
+    return [int(v)]
+
+
+def _to_bool(v):
+    if isinstance(v, str):
+        return v.strip().lower() in ("true", "1")
+    return bool(v)
+
+
+class _Emitter:
+    """Per-graph export state: tensor naming + extra initializers."""
+
+    def __init__(self, params):
+        self.params = params          # name -> numpy
+        self.extra_inits = []         # TensorProto list
+        self._uid = 0
+
+    def fresh(self, hint):
+        self._uid += 1
+        return f"_{hint}_{self._uid}"
+
+    def add_init(self, hint, arr):
+        from . import onnx_proto as oproto
+        name = self.fresh(hint)
+        self.extra_inits.append(oproto.from_array(arr, name=name))
+        return name
+
+
+def _out_name(node, idx=0):
+    if node.is_variable or node.num_outputs() == 1:
+        return node.name
+    return node.name if idx == 0 else f"{node.name}_out{idx}"
+
+
+def _in_names(node):
+    return [_out_name(n, i) for n, i in node.inputs]
+
+
+def _mk_node(op_type, inputs, outputs, name, **attrs):
+    from . import onnx_proto as oproto
+    n = oproto.NodeProto(op_type=op_type, input=list(inputs),
+                         output=list(outputs), name=name)
+    n.attribute = [oproto.make_attribute(k, v) for k, v in attrs.items()
+                   if v is not None]
+    return n
+
+
+def _emit_conv(node, em):
+    a = node.attrs
+    ins = _in_names(node)
+    attrs = {"kernel_shape": _ints(a["kernel"]),
+             "group": int(a.get("num_group", 1))}
+    if "stride" in a:
+        attrs["strides"] = _ints(a["stride"])
+    if "pad" in a:
+        p = _ints(a["pad"])
+        attrs["pads"] = p + p
+    if "dilate" in a:
+        attrs["dilations"] = _ints(a["dilate"])
+    op = "ConvTranspose" if node.op.name == "Deconvolution" else "Conv"
+    if op == "ConvTranspose" and "adj" in a:
+        attrs["output_padding"] = _ints(a["adj"])
+    if _to_bool(a.get("no_bias", False)):
+        ins = ins[:2]
+    return [_mk_node(op, ins, [_out_name(node)], node.name, **attrs)]
+
+
+def _emit_fc(node, em):
+    a = node.attrs
+    ins = _in_names(node)
+    if _to_bool(a.get("no_bias", False)):
+        ins = ins[:2]
+    nodes = []
+    data = ins[0]
+    if _to_bool(a.get("flatten", True)):
+        flat = em.fresh(f"{node.name}_flat")
+        nodes.append(_mk_node("Flatten", [data], [flat],
+                              f"{node.name}_flatten", axis=1))
+        nodes.append(_mk_node("Gemm", [flat] + ins[1:], [_out_name(node)],
+                              node.name, alpha=1.0, beta=1.0,
+                              transA=0, transB=1))
+        return nodes
+    # flatten=False applies the weight to the last axis keeping leading
+    # dims; Gemm is rank-2-only, so emit MatMul(data, W^T) (+ Add bias)
+    wt = em.fresh(f"{node.name}_wT")
+    nodes.append(_mk_node("Transpose", [ins[1]], [wt],
+                          f"{node.name}_transpose", perm=[1, 0]))
+    if len(ins) > 2:
+        mm = em.fresh(f"{node.name}_mm")
+        nodes.append(_mk_node("MatMul", [data, wt], [mm],
+                              f"{node.name}_matmul"))
+        nodes.append(_mk_node("Add", [mm, ins[2]], [_out_name(node)],
+                              node.name))
+    else:
+        nodes.append(_mk_node("MatMul", [data, wt], [_out_name(node)],
+                              node.name))
+    return nodes
+
+
+def _emit_bn(node, em):
+    import numpy as np
+    a = node.attrs
+    if node.num_outputs() > 1:
+        pass  # only output 0 may be referenced; checked by caller
+    ins = _in_names(node)
+    if _to_bool(a.get("fix_gamma", True)):
+        gshape = em.params.get(ins[1])
+        shape = gshape.shape if gshape is not None else None
+        if shape is None:
+            mm = em.params.get(ins[3])
+            shape = mm.shape if mm is not None else None
+        if shape is None:
+            raise MXNetError(f"BatchNorm {node.name}: fix_gamma export "
+                             "needs gamma or moving_mean in params")
+        ins[1] = em.add_init(f"{node.name}_gamma_fixed",
+                             np.ones(shape, np.float32))
+    return [_mk_node("BatchNormalization", ins, [_out_name(node)], node.name,
+                     epsilon=float(a.get("eps", 1e-3)),
+                     momentum=float(a.get("momentum", 0.9)))]
+
+
+_ACT_MAP = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+            "softrelu": "Softplus", "softsign": "Softsign"}
+
+
+def _emit_act(node, em):
+    act = str(node.attrs.get("act_type", "relu"))
+    if act not in _ACT_MAP:
+        raise MXNetError(f"Activation {act} has no ONNX mapping")
+    return [_mk_node(_ACT_MAP[act], _in_names(node), [_out_name(node)],
+                     node.name)]
+
+
+def _emit_pool(node, em):
+    a = node.attrs
+    ptype = str(a.get("pool_type", "max"))
+    if ptype not in ("max", "avg"):
+        raise MXNetError(f"Pooling type {ptype} has no ONNX mapping")
+    ins = _in_names(node)
+    if _to_bool(a.get("global_pool", False)):
+        op = "GlobalMaxPool" if ptype == "max" else "GlobalAveragePool"
+        return [_mk_node(op, ins, [_out_name(node)], node.name)]
+    op = "MaxPool" if ptype == "max" else "AveragePool"
+    attrs = {"kernel_shape": _ints(a["kernel"])}
+    if "stride" in a:
+        attrs["strides"] = _ints(a["stride"])
+    if "pad" in a:
+        p = _ints(a["pad"])
+        attrs["pads"] = p + p
+    if op == "AveragePool":
+        attrs["count_include_pad"] = 1
+    return [_mk_node(op, ins, [_out_name(node)], node.name, **attrs)]
+
+
+def _emit_softmax(node, em):
+    axis = int(node.attrs.get("axis", -1))
+    # SoftmaxOutput carries a label input that prediction graphs drop
+    ins = _in_names(node)[:1]
+    return [_mk_node("Softmax", ins, [_out_name(node)], node.name,
+                     axis=axis)]
+
+
+def _emit_flatten(node, em):
+    return [_mk_node("Flatten", _in_names(node), [_out_name(node)],
+                     node.name, axis=1)]
+
+
+def _emit_dropout(node, em):
+    return [_mk_node("Dropout", _in_names(node)[:1], [_out_name(node)],
+                     node.name, ratio=float(node.attrs.get("p", 0.5)))]
+
+
+def _emit_concat(node, em):
+    return [_mk_node("Concat", _in_names(node), [_out_name(node)],
+                     node.name, axis=int(node.attrs.get("dim", 1)))]
+
+
+def _emit_reshape(node, em):
+    import numpy as np
+    shape = _ints(node.attrs.get("shape", ()))
+    if not shape:
+        raise MXNetError(f"reshape {node.name}: export needs a static "
+                         "shape attr")
+    sname = em.add_init(f"{node.name}_shape",
+                        np.asarray(shape, dtype=np.int64))
+    return [_mk_node("Reshape", _in_names(node)[:1] + [sname],
+                     [_out_name(node)], node.name)]
+
+
+def _emit_transpose(node, em):
+    attrs = {}
+    if "axes" in node.attrs:
+        attrs["perm"] = _ints(node.attrs["axes"])
+    return [_mk_node("Transpose", _in_names(node), [_out_name(node)],
+                     node.name, **attrs)]
+
+
+def _emit_clip(node, em):
+    import numpy as np
+    # opset >= 11 Clip: min/max are tensor inputs, not attributes
+    mn = em.add_init(f"{node.name}_min",
+                     np.asarray(float(node.attrs.get("a_min", -3.4e38)),
+                                np.float32))
+    mx_ = em.add_init(f"{node.name}_max",
+                      np.asarray(float(node.attrs.get("a_max", 3.4e38)),
+                                 np.float32))
+    return [_mk_node("Clip", _in_names(node) + [mn, mx_],
+                     [_out_name(node)], node.name)]
+
+
+def _emit_leaky(node, em):
+    act = str(node.attrs.get("act_type", "leaky"))
+    if act != "leaky":
+        raise MXNetError(f"LeakyReLU act_type {act} has no ONNX mapping")
+    return [_mk_node("LeakyRelu", _in_names(node), [_out_name(node)],
+                     node.name, alpha=float(node.attrs.get("slope", 0.25)))]
+
+
+def _emit_embedding(node, em):
+    ins = _in_names(node)  # (indices, table) -> Gather(table, indices)
+    return [_mk_node("Gather", [ins[1], ins[0]], [_out_name(node)],
+                     node.name, axis=0)]
+
+
+def _emit_reduce(onnx_op):
+    def emit(node, em):
+        attrs = {"keepdims": int(_to_bool(node.attrs.get("keepdims",
+                                                         False)))}
+        if node.attrs.get("axis") is not None:
+            attrs["axes"] = _ints(node.attrs["axis"])
+        return [_mk_node(onnx_op, _in_names(node), [_out_name(node)],
+                         node.name, **attrs)]
+    return emit
+
+
+def _emit_simple(onnx_op):
+    def emit(node, em):
+        return [_mk_node(onnx_op, _in_names(node), [_out_name(node)],
+                         node.name)]
+    return emit
+
+
+def _emit_scalar(onnx_op, reverse=False):
+    """_plus_scalar family: materialize the scalar as an initializer."""
+    def emit(node, em):
+        import numpy as np
+        s = em.add_init(f"{node.name}_scalar",
+                        np.asarray(float(node.attrs.get("scalar", 0.0)),
+                                   dtype=np.float32))
+        ins = _in_names(node)
+        pair = [s, ins[0]] if reverse else [ins[0], s]
+        return [_mk_node(onnx_op, pair, [_out_name(node)], node.name)]
+    return emit
+
+
+MX2ONNX_EMITTERS = {
+    "Convolution": _emit_conv,
+    "Deconvolution": _emit_conv,
+    "FullyConnected": _emit_fc,
+    "BatchNorm": _emit_bn,
+    "Activation": _emit_act,
+    "Pooling": _emit_pool,
+    "softmax": _emit_softmax,
+    "SoftmaxOutput": _emit_softmax,
+    "log_softmax": _emit_simple("LogSoftmax"),
+    "flatten": _emit_flatten,
+    "Flatten": _emit_flatten,
+    "Dropout": _emit_dropout,
+    "concat": _emit_concat,
+    "Concat": _emit_concat,
+    "reshape": _emit_reshape,
+    "Reshape": _emit_reshape,
+    "transpose": _emit_transpose,
+    "clip": _emit_clip,
+    "LeakyReLU": _emit_leaky,
+    "Embedding": _emit_embedding,
+    "elemwise_add": _emit_simple("Add"),
+    "elemwise_sub": _emit_simple("Sub"),
+    "elemwise_mul": _emit_simple("Mul"),
+    "elemwise_div": _emit_simple("Div"),
+    "broadcast_add": _emit_simple("Add"),
+    "broadcast_sub": _emit_simple("Sub"),
+    "broadcast_mul": _emit_simple("Mul"),
+    "broadcast_div": _emit_simple("Div"),
+    "_plus_scalar": _emit_scalar("Add"),
+    "_minus_scalar": _emit_scalar("Sub"),
+    "_rminus_scalar": _emit_scalar("Sub", reverse=True),
+    "_mul_scalar": _emit_scalar("Mul"),
+    "_div_scalar": _emit_scalar("Div"),
+    "_rdiv_scalar": _emit_scalar("Div", reverse=True),
+    "relu": _emit_simple("Relu"),
+    "sigmoid": _emit_simple("Sigmoid"),
+    "tanh": _emit_simple("Tanh"),
+    "exp": _emit_simple("Exp"),
+    "log": _emit_simple("Log"),
+    "sqrt": _emit_simple("Sqrt"),
+    "abs": _emit_simple("Abs"),
+    "negative": _emit_simple("Neg"),
+    "dot": _emit_simple("MatMul"),
+    "matmul": _emit_simple("MatMul"),
+    "batch_dot": _emit_simple("MatMul"),
+    "sum": _emit_reduce("ReduceSum"),
+    "mean": _emit_reduce("ReduceMean"),
+    "max": _emit_reduce("ReduceMax"),
+    "min": _emit_reduce("ReduceMin"),
+    "identity": _emit_simple("Identity"),
+    "_copy": _emit_simple("Identity"),
+    "BlockGrad": _emit_simple("Identity"),
+}
 
 
 def export_model(sym, params, input_shape, input_type=None,
                  onnx_file_path="model.onnx", verbose=False):
-    """Symbol + params -> ONNX file (ref: mx2onnx/export_model.py)."""
-    onnx = _require_onnx()
-    raise MXNetError("mx2onnx emission lands in a future round; import is "
-                     "available when onnx is installed")
+    """Symbol + params -> ONNX file (ref: mx2onnx/export_model.py).
+
+    `sym` may be a Symbol or a path to a ``-symbol.json`` file; `params`
+    a {name: NDArray} dict (``arg:``/``aux:`` prefixes accepted) or a
+    path to a ``.params`` file. `input_shape` is a list of shapes for
+    the non-parameter inputs, in graph order. Emission runs on the
+    self-contained codec in onnx_proto.py; returns onnx_file_path.
+    """
+    import numpy as np
+    from . import onnx_proto as oproto
+    from ..symbol import symbol as sym_mod
+
+    if isinstance(sym, str):
+        sym = sym_mod.load(sym)
+    if isinstance(params, str):
+        from ..ndarray import utils as nd_utils
+        params = nd_utils.load(params)
+    np_params = {}
+    for k, v in params.items():
+        name = k.split(":", 1)[1] if ":" in k else k
+        np_params[name] = np.ascontiguousarray(
+            v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v))
+
+    if input_type is None:
+        input_type = np.float32
+    elem_type = oproto.NP_TO_ONNX[np.dtype(input_type)]
+    if input_shape and not isinstance(input_shape[0], (tuple, list)):
+        input_shape = [input_shape]
+
+    em = _Emitter(np_params)
+    onnx_nodes = []
+    initializers = []
+    graph_inputs = []
+    data_idx = 0
+    order = sym._topo()
+    for node in order:
+        if node.is_variable:
+            if node.name in np_params:
+                initializers.append(
+                    oproto.from_array(np_params[node.name], name=node.name))
+            else:
+                if data_idx >= len(input_shape):
+                    raise MXNetError(
+                        f"input_shape provides {len(input_shape)} shapes "
+                        f"but graph has more data inputs ({node.name})")
+                graph_inputs.append(oproto.make_tensor_value_info(
+                    node.name, elem_type, input_shape[data_idx]))
+                data_idx += 1
+            continue
+        emitter = MX2ONNX_EMITTERS.get(node.op.name)
+        if emitter is None:
+            raise MXNetError(
+                f"mx2onnx: op {node.op.name} ({node.name}) has no emitter")
+        onnx_nodes.extend(emitter(node, em))
+    initializers.extend(em.extra_inits)
+
+    outputs = []
+    for n, i in sym._outputs:
+        if i != 0 and n.op is not None and n.op.name == "BatchNorm":
+            raise MXNetError("cannot export BatchNorm mean/var outputs")
+        outputs.append(oproto.make_tensor_value_info(
+            _out_name(n, i), elem_type, []))
+
+    graph = oproto.GraphProto(name=getattr(sym, "name", "mxnet_tpu_graph"),
+                              node=onnx_nodes, initializer=initializers,
+                              input=graph_inputs, output=outputs)
+    # opset 11: Gemm-without-C and input-form Clip need >=11; Dropout's
+    # ratio attribute (<12) and ReduceSum's axes attribute (<13) cap it
+    model = oproto.ModelProto(
+        ir_version=7, producer_name="mxnet_tpu",
+        producer_version="0.1", graph=graph,
+        opset_import=[oproto.OperatorSetIdProto(domain="", version=11)])
+    oproto.save(model, onnx_file_path)
+    if verbose:
+        print(f"exported {len(onnx_nodes)} nodes, "
+              f"{len(initializers)} initializers -> {onnx_file_path}")
+    return onnx_file_path

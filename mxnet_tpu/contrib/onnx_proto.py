@@ -1,0 +1,497 @@
+"""Minimal self-contained ONNX protobuf codec.
+
+The environment has no `onnx` (or `protobuf`) package, so ONNX interchange
+(ref: python/mxnet/contrib/onnx/) is implemented over a hand-rolled
+protobuf wire codec covering exactly the message subset ONNX models use:
+ModelProto / GraphProto / NodeProto / AttributeProto / TensorProto /
+ValueInfoProto / TypeProto / TensorShapeProto / OperatorSetIdProto.
+Field numbers follow the public onnx.proto3 schema; files written here
+load in stock onnx/netron and vice versa.
+
+Wire-format notes: varint (wire 0) for ints/enums/bools, 64-bit (wire 1)
+for doubles, length-delimited (wire 2) for strings/bytes/submessages and
+packed scalars, 32-bit (wire 5) for floats. Negative int64 varints are
+10-byte two's-complement. Repeated scalars decode both packed and
+unpacked forms; encoding always packs.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+__all__ = [
+    "ModelProto", "GraphProto", "NodeProto", "AttributeProto",
+    "TensorProto", "ValueInfoProto", "TypeProto", "TensorTypeProto",
+    "TensorShapeProto", "DimensionProto", "OperatorSetIdProto",
+    "load", "save", "to_array", "from_array", "make_attribute",
+    "attribute_value", "DATA_TYPES", "NP_TO_ONNX", "ONNX_TO_NP",
+    "ATTR_FLOAT", "ATTR_INT", "ATTR_STRING", "ATTR_TENSOR", "ATTR_GRAPH",
+    "ATTR_FLOATS", "ATTR_INTS", "ATTR_STRINGS",
+]
+
+# ---------------------------------------------------------------------------
+# low-level wire helpers
+# ---------------------------------------------------------------------------
+
+def _enc_varint(v: int) -> bytes:
+    if v < 0:
+        v += 1 << 64
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _dec_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _signed64(v: int) -> int:
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _enc_varint((field << 3) | wire)
+
+
+def _enc_int(field: int, v: int) -> bytes:
+    return _tag(field, 0) + _enc_varint(int(v))
+
+
+def _enc_bytes(field: int, v: bytes) -> bytes:
+    return _tag(field, 2) + _enc_varint(len(v)) + v
+
+
+def _enc_str(field: int, v: str) -> bytes:
+    return _enc_bytes(field, v.encode("utf-8"))
+
+
+def _enc_float(field: int, v: float) -> bytes:
+    return _tag(field, 5) + struct.pack("<f", v)
+
+
+def _enc_packed_varints(field: int, vals) -> bytes:
+    payload = b"".join(_enc_varint(int(v)) for v in vals)
+    return _enc_bytes(field, payload)
+
+
+def _enc_packed_floats(field: int, vals) -> bytes:
+    return _enc_bytes(field, struct.pack(f"<{len(vals)}f", *vals))
+
+
+def _skip(buf: bytes, pos: int, wire: int) -> int:
+    if wire == 0:
+        _, pos = _dec_varint(buf, pos)
+    elif wire == 1:
+        pos += 8
+    elif wire == 2:
+        n, pos = _dec_varint(buf, pos)
+        pos += n
+    elif wire == 5:
+        pos += 4
+    else:
+        raise ValueError(f"unsupported wire type {wire}")
+    return pos
+
+
+# ---------------------------------------------------------------------------
+# declarative message base
+# ---------------------------------------------------------------------------
+# FIELDS: field_number -> (attr_name, kind, repeated)
+# kind: 'int' | 'sint' (signed varint) | 'float' | 'double' | 'string'
+#       | 'bytes' | message class
+
+class _Message:
+    FIELDS: Dict[int, Tuple[str, Any, bool]] = {}
+
+    def __init__(self, **kwargs):
+        for name, kind, repeated in self.FIELDS.values():
+            if repeated:
+                setattr(self, name, [])
+            elif isinstance(kind, type) and issubclass(kind, _Message):
+                setattr(self, name, None)
+            elif kind in ("string",):
+                setattr(self, name, "")
+            elif kind == "bytes":
+                setattr(self, name, b"")
+            elif kind in ("float", "double"):
+                setattr(self, name, 0.0)
+            else:
+                setattr(self, name, 0)
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    # -- encode ------------------------------------------------------------
+    def encode(self) -> bytes:
+        out = bytearray()
+        for num, (name, kind, repeated) in sorted(self.FIELDS.items()):
+            val = getattr(self, name)
+            if repeated:
+                if not val:
+                    continue
+                if isinstance(kind, type) and issubclass(kind, _Message):
+                    for item in val:
+                        out += _enc_bytes(num, item.encode())
+                elif kind == "string":
+                    for item in val:
+                        out += _enc_str(num, item)
+                elif kind == "bytes":
+                    for item in val:
+                        out += _enc_bytes(num, item)
+                elif kind == "float":
+                    out += _enc_packed_floats(num, val)
+                elif kind == "double":
+                    out += _enc_bytes(num,
+                                      struct.pack(f"<{len(val)}d", *val))
+                else:  # int
+                    out += _enc_packed_varints(num, val)
+            else:
+                if isinstance(kind, type) and issubclass(kind, _Message):
+                    if val is not None:
+                        out += _enc_bytes(num, val.encode())
+                elif kind == "string":
+                    if val:
+                        out += _enc_str(num, val)
+                elif kind == "bytes":
+                    if val:
+                        out += _enc_bytes(num, val)
+                elif kind == "float":
+                    if val:
+                        out += _enc_float(num, val)
+                else:
+                    if val:
+                        out += _enc_int(num, val)
+        return bytes(out)
+
+    # -- decode ------------------------------------------------------------
+    @classmethod
+    def decode(cls, buf: bytes):
+        msg = cls()
+        pos, end = 0, len(buf)
+        while pos < end:
+            key, pos = _dec_varint(buf, pos)
+            num, wire = key >> 3, key & 7
+            spec = cls.FIELDS.get(num)
+            if spec is None:
+                pos = _skip(buf, pos, wire)
+                continue
+            name, kind, repeated = spec
+            if isinstance(kind, type) and issubclass(kind, _Message):
+                n, pos = _dec_varint(buf, pos)
+                sub = kind.decode(buf[pos:pos + n])
+                pos += n
+                if repeated:
+                    getattr(msg, name).append(sub)
+                else:
+                    setattr(msg, name, sub)
+            elif kind == "string":
+                n, pos = _dec_varint(buf, pos)
+                s = buf[pos:pos + n].decode("utf-8")
+                pos += n
+                if repeated:
+                    getattr(msg, name).append(s)
+                else:
+                    setattr(msg, name, s)
+            elif kind == "bytes":
+                n, pos = _dec_varint(buf, pos)
+                b = bytes(buf[pos:pos + n])
+                pos += n
+                if repeated:
+                    getattr(msg, name).append(b)
+                else:
+                    setattr(msg, name, b)
+            elif kind == "float":
+                if wire == 2:  # packed
+                    n, pos = _dec_varint(buf, pos)
+                    vals = struct.unpack(f"<{n // 4}f", buf[pos:pos + n])
+                    pos += n
+                    getattr(msg, name).extend(vals)
+                else:
+                    (v,) = struct.unpack("<f", buf[pos:pos + 4])
+                    pos += 4
+                    if repeated:
+                        getattr(msg, name).append(v)
+                    else:
+                        setattr(msg, name, v)
+            elif kind == "double":
+                if wire == 2:
+                    n, pos = _dec_varint(buf, pos)
+                    vals = struct.unpack(f"<{n // 8}d", buf[pos:pos + n])
+                    pos += n
+                    getattr(msg, name).extend(vals)
+                else:
+                    (v,) = struct.unpack("<d", buf[pos:pos + 8])
+                    pos += 8
+                    if repeated:
+                        getattr(msg, name).append(v)
+                    else:
+                        setattr(msg, name, v)
+            else:  # int / enum
+                if wire == 2 and repeated:  # packed
+                    n, pos = _dec_varint(buf, pos)
+                    stop = pos + n
+                    vals = []
+                    while pos < stop:
+                        v, pos = _dec_varint(buf, pos)
+                        vals.append(_signed64(v))
+                    getattr(msg, name).extend(vals)
+                else:
+                    v, pos = _dec_varint(buf, pos)
+                    v = _signed64(v)
+                    if repeated:
+                        getattr(msg, name).append(v)
+                    else:
+                        setattr(msg, name, v)
+        return msg
+
+    def __repr__(self):
+        parts = []
+        for name, _, _ in self.FIELDS.values():
+            v = getattr(self, name)
+            if v not in (None, "", b"", 0, 0.0, []):
+                parts.append(f"{name}={v!r}")
+        return f"{type(self).__name__}({', '.join(parts)})"
+
+
+# ---------------------------------------------------------------------------
+# ONNX messages (field numbers from onnx.proto3)
+# ---------------------------------------------------------------------------
+
+class OperatorSetIdProto(_Message):
+    FIELDS = {1: ("domain", "string", False),
+              2: ("version", "int", False)}
+
+
+class TensorProto(_Message):
+    FIELDS = {1: ("dims", "int", True),
+              2: ("data_type", "int", False),
+              4: ("float_data", "float", True),
+              5: ("int32_data", "int", True),
+              6: ("string_data", "bytes", True),
+              7: ("int64_data", "int", True),
+              8: ("name", "string", False),
+              9: ("raw_data", "bytes", False),
+              10: ("double_data", "double", True),
+              11: ("uint64_data", "int", True),
+              12: ("doc_string", "string", False)}
+
+
+class DimensionProto(_Message):
+    FIELDS = {1: ("dim_value", "int", False),
+              2: ("dim_param", "string", False)}
+
+
+class TensorShapeProto(_Message):
+    FIELDS = {1: ("dim", DimensionProto, True)}
+
+
+class TensorTypeProto(_Message):
+    FIELDS = {1: ("elem_type", "int", False),
+              2: ("shape", TensorShapeProto, False)}
+
+
+class TypeProto(_Message):
+    FIELDS = {1: ("tensor_type", TensorTypeProto, False)}
+
+
+class ValueInfoProto(_Message):
+    FIELDS = {1: ("name", "string", False),
+              2: ("type", TypeProto, False),
+              3: ("doc_string", "string", False)}
+
+
+class AttributeProto(_Message):
+    FIELDS = {1: ("name", "string", False),
+              2: ("f", "float", False),
+              3: ("i", "int", False),
+              4: ("s", "bytes", False),
+              7: ("floats", "float", True),
+              8: ("ints", "int", True),
+              9: ("strings", "bytes", True),
+              13: ("doc_string", "string", False),
+              20: ("type", "int", False)}
+
+
+class NodeProto(_Message):
+    FIELDS = {1: ("input", "string", True),
+              2: ("output", "string", True),
+              3: ("name", "string", False),
+              4: ("op_type", "string", False),
+              5: ("attribute", AttributeProto, True),
+              6: ("doc_string", "string", False),
+              7: ("domain", "string", False)}
+
+
+class GraphProto(_Message):
+    FIELDS = {1: ("node", NodeProto, True),
+              2: ("name", "string", False),
+              5: ("initializer", TensorProto, True),
+              10: ("doc_string", "string", False),
+              11: ("input", ValueInfoProto, True),
+              12: ("output", ValueInfoProto, True),
+              13: ("value_info", ValueInfoProto, True)}
+
+
+# AttributeProto.t / .g come after GraphProto exists (mutual recursion).
+AttributeProto.FIELDS = dict(AttributeProto.FIELDS)
+AttributeProto.FIELDS[5] = ("t", TensorProto, False)
+AttributeProto.FIELDS[6] = ("g", GraphProto, False)
+
+
+class ModelProto(_Message):
+    FIELDS = {1: ("ir_version", "int", False),
+              2: ("producer_name", "string", False),
+              3: ("producer_version", "string", False),
+              4: ("domain", "string", False),
+              5: ("model_version", "int", False),
+              6: ("doc_string", "string", False),
+              7: ("graph", GraphProto, False),
+              8: ("opset_import", OperatorSetIdProto, True)}
+
+
+# ---------------------------------------------------------------------------
+# enums + numpy bridging
+# ---------------------------------------------------------------------------
+
+DATA_TYPES = {"FLOAT": 1, "UINT8": 2, "INT8": 3, "UINT16": 4, "INT16": 5,
+              "INT32": 6, "INT64": 7, "STRING": 8, "BOOL": 9, "FLOAT16": 10,
+              "DOUBLE": 11, "UINT32": 12, "UINT64": 13, "BFLOAT16": 16}
+
+NP_TO_ONNX = {np.dtype(np.float32): 1, np.dtype(np.uint8): 2,
+              np.dtype(np.int8): 3, np.dtype(np.uint16): 4,
+              np.dtype(np.int16): 5, np.dtype(np.int32): 6,
+              np.dtype(np.int64): 7, np.dtype(np.bool_): 9,
+              np.dtype(np.float16): 10, np.dtype(np.float64): 11,
+              np.dtype(np.uint32): 12, np.dtype(np.uint64): 13}
+
+ONNX_TO_NP = {v: k for k, v in NP_TO_ONNX.items()}
+
+(ATTR_FLOAT, ATTR_INT, ATTR_STRING, ATTR_TENSOR, ATTR_GRAPH,
+ ATTR_FLOATS, ATTR_INTS, ATTR_STRINGS) = 1, 2, 3, 4, 5, 6, 7, 8
+
+
+def from_array(arr: np.ndarray, name: str = "") -> TensorProto:
+    arr = np.ascontiguousarray(arr)
+    if arr.dtype not in NP_TO_ONNX:
+        raise ValueError(f"unsupported dtype {arr.dtype}")
+    t = TensorProto(name=name, data_type=NP_TO_ONNX[arr.dtype],
+                    dims=list(arr.shape))
+    t.raw_data = arr.astype(arr.dtype.newbyteorder("<")).tobytes()
+    return t
+
+
+def to_array(t: TensorProto) -> np.ndarray:
+    if t.data_type not in ONNX_TO_NP:
+        raise ValueError(f"unsupported ONNX data_type {t.data_type}")
+    dtype = ONNX_TO_NP[t.data_type]
+    shape = tuple(t.dims)
+    if t.raw_data:
+        return np.frombuffer(t.raw_data,
+                             dtype=dtype.newbyteorder("<")).reshape(shape)
+    # typed-data fallbacks (how stock onnx stores small tensors sometimes)
+    if t.float_data:
+        return np.asarray(t.float_data, dtype=dtype).reshape(shape)
+    if t.int64_data:
+        return np.asarray(t.int64_data, dtype=dtype).reshape(shape)
+    if t.double_data:
+        return np.asarray(t.double_data, dtype=dtype).reshape(shape)
+    if t.int32_data:
+        # int32_data also carries (u)int8/16, bool and fp16 payloads
+        if dtype == np.dtype(np.float16):
+            return np.asarray(t.int32_data,
+                              dtype=np.uint16).view(np.float16).reshape(shape)
+        return np.asarray(t.int32_data, dtype=dtype).reshape(shape)
+    if t.uint64_data:
+        return np.asarray(t.uint64_data, dtype=dtype).reshape(shape)
+    return np.zeros(shape, dtype=dtype)
+
+
+def make_attribute(name: str, value: Any) -> AttributeProto:
+    a = AttributeProto(name=name)
+    if isinstance(value, bool):
+        a.type, a.i = ATTR_INT, int(value)
+    elif isinstance(value, (int, np.integer)):
+        a.type, a.i = ATTR_INT, int(value)
+    elif isinstance(value, (float, np.floating)):
+        a.type, a.f = ATTR_FLOAT, float(value)
+    elif isinstance(value, str):
+        a.type, a.s = ATTR_STRING, value.encode("utf-8")
+    elif isinstance(value, bytes):
+        a.type, a.s = ATTR_STRING, value
+    elif isinstance(value, TensorProto):
+        a.type, a.t = ATTR_TENSOR, value
+    elif isinstance(value, GraphProto):
+        a.type, a.g = ATTR_GRAPH, value
+    elif isinstance(value, (list, tuple, np.ndarray)):
+        vals = list(value)
+        if all(isinstance(v, (int, np.integer)) for v in vals):
+            a.type = ATTR_INTS
+            a.ints = [int(v) for v in vals]
+        elif all(isinstance(v, (int, float, np.floating, np.integer))
+                 for v in vals):
+            a.type = ATTR_FLOATS
+            a.floats = [float(v) for v in vals]
+        elif all(isinstance(v, (str, bytes)) for v in vals):
+            a.type = ATTR_STRINGS
+            a.strings = [v.encode("utf-8") if isinstance(v, str) else v
+                         for v in vals]
+        else:
+            raise ValueError(f"mixed attribute list for {name}: {value!r}")
+    else:
+        raise ValueError(f"cannot make attribute from {type(value)}")
+    return a
+
+
+def attribute_value(a: AttributeProto) -> Any:
+    if a.type == ATTR_FLOAT:
+        return a.f
+    if a.type == ATTR_INT:
+        return a.i
+    if a.type == ATTR_STRING:
+        return a.s.decode("utf-8")
+    if a.type == ATTR_TENSOR:
+        return a.t
+    if a.type == ATTR_GRAPH:
+        return a.g
+    if a.type == ATTR_FLOATS:
+        return list(a.floats)
+    if a.type == ATTR_INTS:
+        return list(a.ints)
+    if a.type == ATTR_STRINGS:
+        return [s.decode("utf-8") for s in a.strings]
+    raise ValueError(f"unsupported attribute type {a.type}")
+
+
+def make_tensor_value_info(name: str, elem_type: int,
+                           shape) -> ValueInfoProto:
+    dims = [DimensionProto(dim_param=d) if isinstance(d, str)
+            else DimensionProto(dim_value=int(d)) for d in shape]
+    return ValueInfoProto(
+        name=name,
+        type=TypeProto(tensor_type=TensorTypeProto(
+            elem_type=elem_type, shape=TensorShapeProto(dim=dims))))
+
+
+def save(model: ModelProto, path: str) -> None:
+    with open(path, "wb") as f:
+        f.write(model.encode())
+
+
+def load(path: str) -> ModelProto:
+    with open(path, "rb") as f:
+        return ModelProto.decode(f.read())

@@ -246,7 +246,24 @@ class HybridBlock(Block):
             f"{type(self).__name__} has uninitialized-shape parameters and "
             "no shape inference rule; initialize with explicit shapes")
 
+    def _symbolic_call(self, *args):
+        """Trace hybrid_forward with Symbol proxies: params become named
+        vars, the return is a Symbol graph (ref: block.py:748 _build_cache
+        tracing with symbol inputs)."""
+        from .. import symbol as F
+        params = {}
+        for k, p in self._params.items():
+            short = k[len(self._prefix):]
+            v = F.var(p.name)
+            if not getattr(p, "_differentiable", True):
+                v._outputs[0][0].extra["aux"] = True
+            params[short] = v
+        return self.hybrid_forward(F, *args, **params)
+
     def forward(self, *args):
+        from ..symbol.symbol import Symbol
+        if any(isinstance(a, Symbol) for a in args):
+            return self._symbolic_call(*args)
         if self._active:
             if self._cached_op is None:
                 from ..cached_op import CachedOp
@@ -267,24 +284,68 @@ class HybridBlock(Block):
     def hybrid_forward(self, F, *args, **kwargs):
         raise NotImplementedError
 
-    def export(self, path: str, epoch: int = 0) -> None:
-        """Serialize for deployment (ref: block.py:868 export -> symbol json
-        + params). Emits params now; symbol JSON lands with the symbol layer."""
+    def export(self, path: str, epoch: int = 0, input_names=("data",)):
+        """Serialize for deployment (ref: block.py:868 export): traces the
+        block symbolically and writes ``path-symbol.json`` +
+        ``path-{epoch:04d}.params`` with ``arg:``/``aux:`` keyed entries —
+        the reference checkpoint layout, reloadable by SymbolBlock.imports,
+        Module, the C predict API, and contrib.onnx.export_model."""
+        from .. import symbol as F
+        from ..symbol import symbol as sym_mod
         from ..ndarray import utils as nd_utils
-        params = self._collect_params_with_prefix()
-        nd_utils.save(f"{path}-{epoch:04d}.params",
-                      {k: v.data() for k, v in params.items()})
+        self._collect_deferred_check()
+        sym = self._symbolic_call(*[F.var(n) for n in input_names])
+        if isinstance(sym, (list, tuple)):
+            sym = sym_mod.Group(list(sym))
+        sym.save(f"{path}-symbol.json")
+        aux_names = set(sym.list_auxiliary_states())
+        payload = {}
+        for _, p in sorted(self.collect_params().items()):
+            kind = "aux" if p.name in aux_names else "arg"
+            payload[f"{kind}:{p.name}"] = p.data()
+        nd_utils.save(f"{path}-{epoch:04d}.params", payload)
+        return sym
 
 
 class SymbolBlock(HybridBlock):
-    """Run a loaded symbolic graph as a block (ref: block.py:1082).
-    Full implementation arrives with the symbol layer."""
+    """Run a loaded symbolic graph as a block (ref: block.py:1082)."""
 
     def __init__(self, outputs, inputs, params=None):
         super().__init__(prefix="", params=params)
-        self._outputs = outputs
-        self._inputs = inputs
+        from ..symbol.symbol import Symbol
+        if isinstance(inputs, Symbol):
+            inputs = [inputs]
+        self._sym_outputs = outputs
+        self._sym_inputs = [i.name if isinstance(i, Symbol) else i
+                            for i in inputs]
+        # every non-input variable becomes a Parameter of this block
+        aux = set(outputs.list_auxiliary_states())
+        for name in outputs.list_inputs():
+            if name in self._sym_inputs:
+                continue
+            self.params.get(name, grad_req="null" if name in aux else "write",
+                            allow_deferred_init=True,
+                            differentiable=name not in aux)
+
+    @classmethod
+    def imports(cls, symbol_file: str, input_names, param_file=None,
+                ctx=None):
+        """Load an exported model (ref: block.py SymbolBlock.imports)."""
+        from ..symbol import symbol as sym_mod
+        from ..ndarray import utils as nd_utils
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        sym = sym_mod.load(symbol_file)
+        net = cls(sym, list(input_names))
+        if param_file is not None:
+            loaded = nd_utils.load(param_file)
+            for k, v in loaded.items():
+                name = k.split(":", 1)[1] if ":" in k else k
+                if name in net._params:
+                    net._params.get(name).set_data(
+                        v if ctx is None else v.as_in_context(ctx))
+        return net
 
     def hybrid_forward(self, F, *args, **params):
         from ..symbol.executor import eval_symbol
-        return eval_symbol(self._outputs, self._inputs, args, params)
+        return eval_symbol(self._sym_outputs, self._sym_inputs, args, params)

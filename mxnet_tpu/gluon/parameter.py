@@ -34,6 +34,10 @@ class Parameter:
                  lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
                  differentiable=True, stype="default", grad_stype="default"):
         self.name = name
+        # true aux states (BatchNorm moving stats) are differentiable=False;
+        # user-frozen weights (grad_req='null') stay differentiable and must
+        # still export as args, not aux
+        self._differentiable = differentiable
         self._grad_req = grad_req if differentiable else "null"
         if isinstance(shape, int):
             shape = (shape,)

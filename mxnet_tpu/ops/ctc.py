@@ -87,6 +87,9 @@ def _ctc_loss(data, label, *maybe_lengths, use_data_lengths=False,
     """CTC negative log likelihood (ref: src/operator/nn/ctc_loss.cc)."""
     import jax
     jnp = _jnp()
+    from ..base import check
+    check(blank_label in ("first", "last"),
+          f"blank_label must be 'first' or 'last', got {blank_label!r}")
     T, B, A = data.shape
     blank = 0 if blank_label == "first" else A - 1
     pad = 0 if blank_label == "first" else -1

@@ -99,9 +99,12 @@ def _walk(symbol, arg_map: Dict[str, Any], aux_map: Dict[str, Any],
 
 def eval_symbol(symbol, input_names, input_arrays, param_arrays):
     """Used by SymbolBlock: evaluate with positional inputs + named params."""
+    aux_names = set(symbol.list_auxiliary_states())
     arg_map = dict(zip(input_names, [a._data for a in input_arrays]))
-    arg_map.update({k: v._data for k, v in param_arrays.items()})
-    outs = _walk(symbol, arg_map, {}, False)
+    aux_map = {}
+    for k, v in param_arrays.items():
+        (aux_map if k in aux_names else arg_map)[k] = v._data
+    outs = _walk(symbol, arg_map, aux_map, False)
     res = [_nd.from_jax(o) for o in outs]
     return res[0] if len(res) == 1 else res
 

@@ -109,3 +109,12 @@ def test_ctc_loss_mid_row_padding_is_packed():
     ref = torch_ctc(acts, np.array([[1, 2]], dtype=np.int32),
                     np.array([T]), np.array([2]), blank=0)
     assert_almost_equal(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_ctc_loss_invalid_blank_label_raises():
+    import pytest
+    from mxnet_tpu.base import MXNetError
+    acts = np.zeros((4, 1, 3), dtype=np.float32)
+    labels = np.array([[1, 2]], dtype=np.int32)
+    with pytest.raises(MXNetError, match="blank_label"):
+        nd.CTCLoss(nd.array(acts), nd.array(labels), blank_label="First")
