@@ -256,6 +256,7 @@ def import_model(model_file: str):
     tensors: Dict[str, Any] = {}
     init_vals: Dict[str, Any] = {}
     arg_params: Dict[str, Any] = {}
+    unavailable: set = set()
     for init in graph.initializer:
         arr = np.ascontiguousarray(oproto.to_array(init))
         init_vals[init.name] = arr
@@ -276,12 +277,23 @@ def import_model(model_file: str):
             in_names = in_names[:1]
         elif node.op_type == "Clip" and len(in_names) > 1:
             # opset >= 11: min/max arrive as (optional, possibly empty-named)
-            # tensor inputs
-            if len(in_names) > 1 and in_names[1] and in_names[1] in init_vals:
-                attrs["min"] = float(init_vals[in_names[1]])
-            if len(in_names) > 2 and in_names[2] and in_names[2] in init_vals:
-                attrs["max"] = float(init_vals[in_names[2]])
+            # tensor inputs; only constant (initializer) bounds map to the
+            # mx clip op — computed bounds would silently vanish otherwise
+            for slot, key in ((1, "min"), (2, "max")):
+                if len(in_names) > slot and in_names[slot]:
+                    if in_names[slot] not in init_vals:
+                        raise MXNetError(
+                            f"ONNX Clip node {node.name!r}: {key} input "
+                            f"{in_names[slot]!r} is not a constant "
+                            "initializer; computed clip bounds are "
+                            "unsupported")
+                    attrs[key] = float(init_vals[in_names[slot]])
             in_names = in_names[:1]
+        for i in in_names:
+            if i in unavailable:
+                raise MXNetError(
+                    f"ONNX node {node.name!r} consumes {i!r}, an extra "
+                    "output the mapped mx op does not produce")
         inputs = [tensors[i] for i in in_names if i in tensors]
         w_shape = None
         if len(node.input) > 1 and node.input[1] in arg_params:
@@ -296,13 +308,27 @@ def import_model(model_file: str):
         out = create(mx_op, inputs, mx_attrs, name=node.name or None)
         for i, oname in enumerate(node.output):
             # a multi-output mx op (e.g. BatchNorm's out/mean/var) may back
-            # a single-output ONNX node: use output 0
-            tensors[oname] = out[i] if len(out) > 1 else out
+            # a single-output ONNX node (use output 0); the reverse (ONNX
+            # declares more outputs, e.g. Dropout's mask) is fine as long
+            # as nothing downstream consumes the missing ones
+            if i < len(out):
+                tensors[oname] = out[i] if len(out) > 1 else out
+            else:
+                unavailable.add(oname)
+    for o in graph.output:
+        if o.name in unavailable:
+            raise MXNetError(
+                f"ONNX graph output {o.name!r} is an extra output the "
+                "mapped mx op does not produce")
     outputs = [tensors[o.name] for o in graph.output]
     final = outputs[0] if len(outputs) == 1 else sym_mod.Group(outputs)
     used = set(final.list_inputs())
-    arg_params = {k: v for k, v in arg_params.items() if k in used}
-    return final, arg_params, {}
+    aux_names = set(final.list_auxiliary_states())
+    aux_params = {k: v for k, v in arg_params.items()
+                  if k in used and k in aux_names}
+    arg_params = {k: v for k, v in arg_params.items()
+                  if k in used and k not in aux_names}
+    return final, arg_params, aux_params
 
 
 # ---------------------------------------------------------------------------
@@ -416,8 +442,6 @@ def _emit_fc(node, em):
 def _emit_bn(node, em):
     import numpy as np
     a = node.attrs
-    if node.num_outputs() > 1:
-        pass  # only output 0 may be referenced; checked by caller
     ins = _in_names(node)
     if _to_bool(a.get("fix_gamma", True)):
         gshape = em.params.get(ins[1])
@@ -658,37 +682,56 @@ def export_model(sym, params, input_shape, input_type=None,
 
     em = _Emitter(np_params)
     onnx_nodes = []
-    initializers = []
-    graph_inputs = []
-    data_idx = 0
     order = sym._topo()
     for node in order:
         if node.is_variable:
-            if node.name in np_params:
-                initializers.append(
-                    oproto.from_array(np_params[node.name], name=node.name))
-            else:
-                if data_idx >= len(input_shape):
-                    raise MXNetError(
-                        f"input_shape provides {len(input_shape)} shapes "
-                        f"but graph has more data inputs ({node.name})")
-                graph_inputs.append(oproto.make_tensor_value_info(
-                    node.name, elem_type, input_shape[data_idx]))
-                data_idx += 1
             continue
+        # emitters declare output 0 only; a graph consuming output idx>0
+        # of a multi-output op (BatchNorm mean/var, ...) would reference
+        # an undefined tensor
+        for inp, idx in node.inputs:
+            if idx != 0 and not inp.is_variable:
+                raise MXNetError(
+                    f"mx2onnx: {node.name} consumes output {idx} of "
+                    f"{inp.name} ({inp.op.name}); only output 0 of "
+                    "multi-output ops is exportable")
         emitter = MX2ONNX_EMITTERS.get(node.op.name)
         if emitter is None:
             raise MXNetError(
                 f"mx2onnx: op {node.op.name} ({node.name}) has no emitter")
         onnx_nodes.extend(emitter(node, em))
-    initializers.extend(em.extra_inits)
 
     outputs = []
     for n, i in sym._outputs:
-        if i != 0 and n.op is not None and n.op.name == "BatchNorm":
-            raise MXNetError("cannot export BatchNorm mean/var outputs")
+        if i != 0:
+            raise MXNetError(
+                f"cannot export output {i} of multi-output op {n.name}")
         outputs.append(oproto.make_tensor_value_info(
             _out_name(n, i), elem_type, []))
+
+    # declare only variables the emitted nodes (or graph outputs) actually
+    # reference — emitters may drop inputs (SoftmaxOutput's label), which
+    # must not become dangling required graph inputs
+    referenced = {i for n in onnx_nodes for i in n.input}
+    referenced.update(o.name for o in outputs)
+    initializers = []
+    graph_inputs = []
+    data_idx = 0
+    for node in order:
+        if not node.is_variable or node.name not in referenced:
+            continue
+        if node.name in np_params:
+            initializers.append(
+                oproto.from_array(np_params[node.name], name=node.name))
+        else:
+            if data_idx >= len(input_shape):
+                raise MXNetError(
+                    f"input_shape provides {len(input_shape)} shapes "
+                    f"but graph has more data inputs ({node.name})")
+            graph_inputs.append(oproto.make_tensor_value_info(
+                node.name, elem_type, input_shape[data_idx]))
+            data_idx += 1
+    initializers.extend(em.extra_inits)
 
     graph = oproto.GraphProto(name=getattr(sym, "name", "mxnet_tpu_graph"),
                               node=onnx_nodes, initializer=initializers,

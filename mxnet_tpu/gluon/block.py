@@ -327,6 +327,13 @@ class SymbolBlock(HybridBlock):
                             allow_deferred_init=True,
                             differentiable=name not in aux)
 
+    def _symbolic_call(self, *args):
+        # splice the stored graph into the outer symbolic trace by
+        # composing input vars with the caller's symbols (params stay as
+        # named vars, so a parent block's export sees them)
+        subs = {name: a for name, a in zip(self._sym_inputs, args)}
+        return self._sym_outputs(**subs)
+
     @classmethod
     def imports(cls, symbol_file: str, input_names, param_file=None,
                 ctx=None):

@@ -255,8 +255,21 @@ class NDArray:
     # indexing
     # ------------------------------------------------------------------
     def __getitem__(self, key) -> "NDArray":
+        # jax gathers clamp out-of-bounds indices; python indexing (and the
+        # iterator protocol, which stops on IndexError) requires a raise —
+        # matching the reference NDArray's behavior.
+        if isinstance(key, (int, _np.integer)):
+            n = self.shape[0] if self.ndim > 0 else 0
+            if not -n <= key < n:
+                raise IndexError(
+                    f"index {key} is out of bounds for axis 0 with "
+                    f"size {n}")
         key = _canonical_index(key)
         return imperative_invoke("_index", (self,), {"_idx": key})
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
 
     def __setitem__(self, key, value) -> None:
         key = _canonical_index(key)

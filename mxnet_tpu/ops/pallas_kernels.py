@@ -23,9 +23,51 @@ from .registry import register
 _BQ = 128  # query block (MXU-aligned)
 
 
+_INTERPRET_CACHE = {}
+
+
 def _interpret_mode() -> bool:
+    """True when compiled Pallas lowering is unavailable.
+
+    Platform strings are unreliable here (the axon TPU tunnel reports
+    'tpu' while a JAX_PLATFORMS=cpu override can still route lowering to
+    the CPU rules), so probe the real capability once: compile a trivial
+    kernel; any failure means run in interpret mode.
+    """
     import jax
-    return jax.devices()[0].platform not in ("tpu",)
+    key = jax.default_backend()
+    cached = _INTERPRET_CACHE.get(key)
+    if cached is None:
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def _probe(x_ref, o_ref):
+            o_ref[...] = x_ref[...]
+
+        try:
+            x = jnp.zeros((8, 128), jnp.float32)
+            jax.jit(pl.pallas_call(
+                _probe,
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype)))(
+                    x).block_until_ready()
+            cached = False
+        except Exception:
+            cached = True
+        _INTERPRET_CACHE[key] = cached
+    return cached
+
+
+def _interpret_for(x) -> bool:
+    """Per-array interpret decision: an array living on a non-TPU device
+    lowers with that device's rules regardless of the default backend
+    (mx.cpu() context arrays inside a TPU-default process)."""
+    try:
+        dev = next(iter(x.devices())) if hasattr(x, "devices") else x.device
+        if dev.platform != "tpu":
+            return True
+    except Exception:
+        pass
+    return _interpret_mode()
 
 
 @functools.lru_cache(maxsize=None)
@@ -96,7 +138,7 @@ def flash_attention(q, k, v, causal: bool = False, scale=None):
         qt = q.transpose(0, 2, 1, 3).reshape(b * h, t, d)
         kt = k.transpose(0, 2, 1, 3).reshape(b * h, t, d)
         vt = v.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-        call = _build_flash(t, d, causal, sc, _interpret_mode())
+        call = _build_flash(t, d, causal, sc, _interpret_for(q))
         o = call(qt, kt, vt)
         return o.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 

@@ -227,3 +227,19 @@ def test_waitall_and_sync():
     nd.waitall()
     a.wait_to_read()
     assert a.asnumpy().shape == (64, 64)
+
+
+def test_ndarray_iteration_terminates():
+    # jax clamps OOB gathers; __getitem__ must raise IndexError so the
+    # iterator protocol stops (regression: `for x in arr` used to loop
+    # forever repeating the last element)
+    a = nd.array(np.array([1.0, 2.0, 3.0], dtype=np.float32))
+    vals = [float(x.asscalar()) for x in a]
+    assert vals == [1.0, 2.0, 3.0]
+    import pytest
+    with pytest.raises(IndexError):
+        a[3]
+    with pytest.raises(IndexError):
+        a[-4]
+    rows = list(nd.array(np.arange(6, dtype=np.float32).reshape(3, 2)))
+    assert len(rows) == 3 and rows[1].shape == (2,)

@@ -96,8 +96,8 @@ def _roundtrip(net, shape, tmp_path, name, tol=1e-4):
     onnx_path = path + ".onnx"
     mxonnx.export_model(path + "-symbol.json", path + "-0000.params",
                         [shape], onnx_file_path=onnx_path)
-    sym, arg_params, _ = mxonnx.import_model(onnx_path)
-    y1 = eval_symbol(sym, ["data"], [x], dict(arg_params))
+    sym, arg_params, aux_params = mxonnx.import_model(onnx_path)
+    y1 = eval_symbol(sym, ["data"], [x], {**arg_params, **aux_params})
     y1 = y1[0] if isinstance(y1, list) else y1
     np.testing.assert_allclose(y0.asnumpy(), y1.asnumpy(),
                                rtol=tol, atol=tol)
@@ -171,7 +171,7 @@ def test_clip_tensor_inputs_roundtrip(tmp_path):
     clip_nodes = [n for n in model.graph.node if n.op_type == "Clip"]
     assert len(clip_nodes) == 1 and len(clip_nodes[0].input) == 3
     assert not clip_nodes[0].attribute
-    sym2, arg_params, _ = mxonnx.import_model(path)
+    sym2, arg_params, aux2 = mxonnx.import_model(path)
     x = mx.nd.array(np.linspace(-2, 2, 8).reshape(2, 4).astype(np.float32))
     y = eval_symbol(sym2, ["data"], [x], dict(arg_params))
     y = y[0] if isinstance(y, list) else y
@@ -195,8 +195,8 @@ def test_dense_no_flatten_roundtrip(tmp_path):
                         [(2, 3, 4)], onnx_file_path=onnx_path)
     ops = [n.op_type for n in oproto.load(onnx_path).graph.node]
     assert "Gemm" not in ops and "MatMul" in ops
-    sym, arg_params, _ = mxonnx.import_model(onnx_path)
-    y1 = eval_symbol(sym, ["data"], [x], dict(arg_params))
+    sym, arg_params, aux_params = mxonnx.import_model(onnx_path)
+    y1 = eval_symbol(sym, ["data"], [x], {**arg_params, **aux_params})
     y1 = y1[0] if isinstance(y1, list) else y1
     np.testing.assert_allclose(y0.asnumpy(), y1.asnumpy(),
                                rtol=1e-5, atol=1e-5)
@@ -234,3 +234,101 @@ def test_export_params_layout(tmp_path):
     aux = [k for k in loaded if k.startswith("aux:")]
     assert any("running_mean" in k for k in aux)
     assert any("running_var" in k for k in aux)
+
+
+def test_import_splits_aux_params(tmp_path):
+    """BN moving stats come back in aux_params, matching the symbol's
+    own arg/aux classification (the reference import contract)."""
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(4, kernel_size=1), nn.BatchNorm())
+    net.initialize()
+    with autograd.pause():
+        net(mx.nd.zeros((1, 2, 4, 4)))
+    path = str(tmp_path / "m")
+    net.export(path)
+    mxonnx.export_model(path + "-symbol.json", path + "-0000.params",
+                        [(1, 2, 4, 4)], onnx_file_path=path + ".onnx")
+    sym, arg_params, aux_params = mxonnx.import_model(path + ".onnx")
+    assert set(aux_params) == set(sym.list_auxiliary_states())
+    assert len(aux_params) == 2  # moving mean + var
+    assert not set(arg_params) & set(aux_params)
+
+
+def test_softmaxoutput_label_not_exported(tmp_path):
+    """The dropped label input must not become a dangling graph input."""
+    from mxnet_tpu.symbol.symbol import create
+    from mxnet_tpu import symbol as S
+    fc = create("FullyConnected", [S.var("data"), S.var("w"), S.var("b")],
+                {"num_hidden": 3})
+    out = create("SoftmaxOutput", [fc, S.var("softmax_label")], {})
+    rs = np.random.RandomState(0)
+    params = {"w": mx.nd.array(rs.randn(3, 4).astype(np.float32)),
+              "b": mx.nd.array(np.zeros(3, np.float32))}
+    path = str(tmp_path / "so.onnx")
+    # only ONE input shape: the label consumes no slot
+    mxonnx.export_model(out, params, [(2, 4)], onnx_file_path=path)
+    model = oproto.load(path)
+    assert [i.name for i in model.graph.input] == ["data"]
+
+
+def test_export_internal_multi_output_consumption_raises(tmp_path):
+    from mxnet_tpu.symbol.symbol import create
+    from mxnet_tpu import symbol as S
+    bn = create("BatchNorm", [S.var("data"), S.var("g"), S.var("b"),
+                              S.var("mm"), S.var("mv")],
+                {"fix_gamma": False})
+    uses_mean = create("relu", [bn[1]], {})
+    from mxnet_tpu.base import MXNetError
+    with pytest.raises(MXNetError, match="output 1"):
+        mxonnx.export_model(uses_mean, {}, [(1, 2, 4, 4)],
+                            onnx_file_path=str(tmp_path / "x.onnx"))
+
+
+def test_import_dropout_mask_unused_ok_consumed_raises(tmp_path):
+    base = oproto.GraphProto(name="g")
+    base.node.append(oproto.NodeProto(op_type="Dropout", input=["x"],
+                                      output=["y", "mask"], name="d0"))
+    base.input.append(oproto.make_tensor_value_info("x", 1, (2, 3)))
+    base.output.append(oproto.make_tensor_value_info("y", 1, (2, 3)))
+    m = oproto.ModelProto(ir_version=7, graph=base,
+                          opset_import=[oproto.OperatorSetIdProto(version=11)])
+    p = str(tmp_path / "ok.onnx")
+    oproto.save(m, p)
+    sym, _, _ = mxonnx.import_model(p)  # unused mask: fine
+
+    base.node.append(oproto.NodeProto(op_type="Relu", input=["mask"],
+                                      output=["z"], name="r0"))
+    base.output.append(oproto.make_tensor_value_info("z", 1, (2, 3)))
+    p2 = str(tmp_path / "bad.onnx")
+    oproto.save(m, p2)
+    from mxnet_tpu.base import MXNetError
+    with pytest.raises(MXNetError, match="mask"):
+        mxonnx.import_model(p2)
+
+
+def test_symbolblock_nested_export(tmp_path):
+    """A SymbolBlock inside a parent block must trace symbolically
+    (regression: eval_symbol crashed on Symbol inputs)."""
+    inner = nn.HybridSequential()
+    inner.add(nn.Dense(6, activation="relu"))
+    inner.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.RandomState(0).randn(2, 4).astype(np.float32))
+    with autograd.pause():
+        inner(x)
+    ipath = str(tmp_path / "inner")
+    inner.export(ipath)
+    sb = gluon.SymbolBlock.imports(ipath + "-symbol.json", ["data"],
+                                   ipath + "-0000.params")
+    outer = nn.HybridSequential()
+    outer.add(sb, nn.Dense(3))
+    outer.initialize(mx.init.Xavier())
+    with autograd.pause():
+        y0 = outer(x)
+    opath = str(tmp_path / "outer")
+    outer.export(opath)
+    reloaded = gluon.SymbolBlock.imports(opath + "-symbol.json", ["data"],
+                                         opath + "-0000.params")
+    with autograd.pause():
+        y1 = reloaded(x)
+    np.testing.assert_allclose(y0.asnumpy(), y1.asnumpy(),
+                               rtol=1e-5, atol=1e-5)

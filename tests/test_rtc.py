@@ -1,0 +1,73 @@
+"""Runtime kernel compilation (ref: tests/python/gpu/test_rtc.py —
+CudaModule compile + launch; here the TPU-native PallasModule)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.base import MXNetError
+
+
+AXPY_SRC = """
+def axpy(a_ref, x_ref, y_ref, o_ref):
+    o_ref[...] = a_ref[...] * x_ref[...] + y_ref[...]
+
+def scale2(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0
+"""
+
+
+def test_pallas_module_launch():
+    mod = mx.rtc.PallasModule(AXPY_SRC)
+    n = 16
+    rs = np.random.RandomState(0)
+    a = rs.randn(n).astype(np.float32)
+    x = rs.randn(n).astype(np.float32)
+    y = rs.randn(n).astype(np.float32)
+    k = mod.get_kernel("axpy", out_shape=(n,), out_dtype="float32")
+    out = k.launch([nd.array(a), nd.array(x), nd.array(y)])
+    np.testing.assert_allclose(out.asnumpy(), a * x + y, rtol=1e-6)
+
+
+def test_pallas_module_exports_filter():
+    mod = mx.rtc.PallasModule(AXPY_SRC, exports=("scale2",))
+    k = mod.get_kernel("scale2", out_shape=(4,))
+    out = k(nd.array(np.arange(4, dtype=np.float32)))
+    np.testing.assert_allclose(out.asnumpy(), np.arange(4) * 2.0)
+    with pytest.raises(MXNetError, match="not found"):
+        mod.get_kernel("axpy", out_shape=(4,))
+
+
+def test_pallas_module_grid():
+    """Gridded kernel with BlockSpecs: each program scales one row."""
+    src = """
+def rowscale(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * (pl.program_id(0) + 1)
+"""
+    from jax.experimental import pallas as pl
+    mod = mx.rtc.PallasModule(src)
+    x = np.ones((4, 8), np.float32)
+    k = mod.get_kernel(
+        "rowscale", out_shape=(4, 8), grid=(4,),
+        in_specs=[pl.BlockSpec((1, 8), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((1, 8), lambda i: (i, 0)))
+    out = k.launch([nd.array(x)])
+    np.testing.assert_allclose(out.asnumpy(),
+                               np.arange(1, 5)[:, None] * np.ones((4, 8)))
+
+
+def test_pallas_module_errors():
+    with pytest.raises(MXNetError, match="no kernel functions"):
+        mx.rtc.PallasModule("x = 1")
+    with pytest.raises(MXNetError, match="parse"):
+        mx.rtc.PallasModule("def broken(:")
+    with pytest.raises(MXNetError, match="exports"):
+        mx.rtc.PallasModule(AXPY_SRC, exports=("nope",))
+    mod = mx.rtc.PallasModule(AXPY_SRC)
+    with pytest.raises(MXNetError, match="out_shape"):
+        mod.get_kernel("axpy")
+
+
+def test_cuda_module_shim_points_to_pallas():
+    with pytest.raises(MXNetError, match="PallasModule"):
+        mx.rtc.CudaModule("__global__ void k(){}")

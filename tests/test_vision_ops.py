@@ -1,0 +1,233 @@
+"""Correlation / DeformableConvolution / fft / count_sketch
+(ref: tests/python/unittest/test_operator.py test_correlation,
+tests/python/gpu/test_operator_gpu.py deformable conv + fft tests)."""
+import math
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+# ---------------------------------------------------------------------------
+# numpy oracles (straight loop ports of the reference kernel semantics)
+# ---------------------------------------------------------------------------
+
+def np_correlation(d1, d2, kernel_size, max_displacement, stride1, stride2,
+                   pad_size, is_multiply):
+    N, C, H, W = d1.shape
+    kr = (kernel_size - 1) // 2
+    border = max_displacement + kr
+    Hp, Wp = H + 2 * pad_size, W + 2 * pad_size
+    th = int(math.ceil(float(Hp - 2 * border) / stride1))
+    tw = int(math.ceil(float(Wp - 2 * border) / stride1))
+    r = max_displacement // stride2
+    gw = 2 * r + 1
+    p1 = np.zeros((N, C, Hp, Wp), d1.dtype)
+    p2 = np.zeros((N, C, Hp, Wp), d1.dtype)
+    p1[:, :, pad_size:pad_size + H, pad_size:pad_size + W] = d1
+    p2[:, :, pad_size:pad_size + H, pad_size:pad_size + W] = d2
+    out = np.zeros((N, gw * gw, th, tw), np.float32)
+    sumelems = kernel_size * kernel_size * C
+    for i in range(th):
+        for j in range(tw):
+            x1 = j * stride1 + max_displacement
+            y1 = i * stride1 + max_displacement
+            for tc in range(gw * gw):
+                s2o = (tc % gw - r) * stride2
+                s2p = (tc // gw - r) * stride2
+                x2, y2 = x1 + s2o, y1 + s2p
+                a = p1[:, :, y1:y1 + kernel_size, x1:x1 + kernel_size]
+                b = p2[:, :, y2:y2 + kernel_size, x2:x2 + kernel_size]
+                v = a * b if is_multiply else np.abs(a - b)
+                out[:, tc, i, j] = v.sum(axis=(1, 2, 3)) / sumelems
+    return out
+
+
+def np_deform_conv(data, offset, weight, bias, stride, pad, dilate, ng, dg):
+    N, C, H, W = data.shape
+    F, _, kh, kw = weight.shape
+    sh, sw = stride
+    ph, pw = pad
+    dh, dw = dilate
+    K = kh * kw
+    Ho = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    Wo = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    cg = C // dg
+    cpg, fpg = C // ng, F // ng
+
+    def sample(img, y, x):  # img (H, W), bilinear w/ zero pad
+        y0, x0 = int(np.floor(y)), int(np.floor(x))
+        val = 0.0
+        for oy in (0, 1):
+            for ox in (0, 1):
+                yy, xx = y0 + oy, x0 + ox
+                w = (1 - abs(y - yy)) * (1 - abs(x - xx))
+                if 0 <= yy < H and 0 <= xx < W:
+                    val += w * img[yy, xx]
+        return val
+
+    out = np.zeros((N, F, Ho, Wo), np.float32)
+    for n in range(N):
+        for i in range(Ho):
+            for j in range(Wo):
+                samp = np.zeros((C, K), np.float32)
+                for ki in range(kh):
+                    for kj in range(kw):
+                        k = ki * kw + kj
+                        for c in range(C):
+                            g = c // cg
+                            oy = offset[n, (g * K + k) * 2, i, j]
+                            ox = offset[n, (g * K + k) * 2 + 1, i, j]
+                            y = i * sh - ph + ki * dh + oy
+                            x = j * sw - pw + kj * dw + ox
+                            samp[c, k] = sample(data[n, c], y, x)
+                for f in range(F):
+                    g = f // fpg
+                    w = weight[f].reshape(cpg, K)
+                    s = samp[g * cpg:(g + 1) * cpg]
+                    out[n, f, i, j] = (w * s).sum() + \
+                        (bias[f] if bias is not None else 0.0)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Correlation
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("cfg", [
+    dict(kernel_size=1, max_displacement=2, stride1=1, stride2=1,
+         pad_size=2, is_multiply=True),
+    dict(kernel_size=3, max_displacement=2, stride1=2, stride2=2,
+         pad_size=2, is_multiply=True),
+    dict(kernel_size=1, max_displacement=1, stride1=1, stride2=1,
+         pad_size=0, is_multiply=False),
+])
+def test_correlation_matches_reference_loop(cfg):
+    rs = np.random.RandomState(0)
+    d1 = rs.randn(2, 3, 8, 7).astype(np.float32)
+    d2 = rs.randn(2, 3, 8, 7).astype(np.float32)
+    out = nd.Correlation(nd.array(d1), nd.array(d2), **cfg).asnumpy()
+    ref = np_correlation(d1, d2, **cfg)
+    assert out.shape == ref.shape
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_correlation_even_kernel_raises():
+    x = nd.zeros((1, 1, 6, 6))
+    with pytest.raises(MXNetError, match="odd"):
+        nd.Correlation(x, x, kernel_size=2)
+
+
+def test_correlation_gradients_flow():
+    from mxnet_tpu import autograd
+    rs = np.random.RandomState(1)
+    a = nd.array(rs.randn(1, 2, 6, 6).astype(np.float32))
+    b = nd.array(rs.randn(1, 2, 6, 6).astype(np.float32))
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        y = nd.Correlation(a, b, kernel_size=1, max_displacement=1)
+        loss = (y * y).sum()
+    loss.backward()
+    assert np.isfinite(a.grad.asnumpy()).all()
+    assert np.abs(b.grad.asnumpy()).sum() > 0
+
+
+# ---------------------------------------------------------------------------
+# DeformableConvolution
+# ---------------------------------------------------------------------------
+
+def test_deformable_conv_zero_offset_is_conv():
+    rs = np.random.RandomState(0)
+    data = rs.randn(2, 4, 7, 7).astype(np.float32)
+    weight = rs.randn(6, 4, 3, 3).astype(np.float32)
+    bias = rs.randn(6).astype(np.float32)
+    offset = np.zeros((2, 2 * 9, 5, 5), np.float32)
+    out = nd.contrib.DeformableConvolution(
+        nd.array(data), nd.array(offset), nd.array(weight), nd.array(bias),
+        kernel=(3, 3), num_filter=6).asnumpy()
+    ref = nd.Convolution(nd.array(data), nd.array(weight), nd.array(bias),
+                         kernel=(3, 3), num_filter=6).asnumpy()
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_conv_matches_reference_loop():
+    rs = np.random.RandomState(2)
+    N, C, H, W = 1, 4, 6, 6
+    F, kh, kw = 4, 3, 3
+    ng, dg = 2, 2
+    sh, sw, ph, pw, dh, dw = 1, 1, 1, 1, 1, 1
+    Ho = (H + 2 * ph - kh) // sh + 1
+    Wo = (W + 2 * pw - kw) // sw + 1
+    data = rs.randn(N, C, H, W).astype(np.float32)
+    weight = rs.randn(F, C // ng, kh, kw).astype(np.float32)
+    offset = (rs.randn(N, dg * 2 * kh * kw, Ho, Wo) * 0.7).astype(np.float32)
+    out = nd.contrib.DeformableConvolution(
+        nd.array(data), nd.array(offset), nd.array(weight),
+        kernel=(kh, kw), num_filter=F, num_group=ng,
+        num_deformable_group=dg, pad=(ph, pw), no_bias=True).asnumpy()
+    ref = np_deform_conv(data, offset, weight, None, (sh, sw), (ph, pw),
+                         (dh, dw), ng, dg)
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_conv_offset_channel_mismatch_raises():
+    with pytest.raises(MXNetError, match="offset channels"):
+        nd.contrib.DeformableConvolution(
+            nd.zeros((1, 2, 5, 5)), nd.zeros((1, 4, 3, 3)),
+            nd.zeros((3, 2, 3, 3)), kernel=(3, 3), num_filter=3,
+            no_bias=True)
+
+
+# ---------------------------------------------------------------------------
+# fft / ifft
+# ---------------------------------------------------------------------------
+
+def test_fft_matches_numpy():
+    rs = np.random.RandomState(0)
+    x = rs.randn(3, 8).astype(np.float32)
+    out = nd.contrib.fft(nd.array(x)).asnumpy()
+    spec = np.fft.fft(x, axis=-1)
+    ref = np.stack([spec.real, spec.imag], axis=-1).reshape(3, 16)
+    assert_almost_equal(out, ref.astype(np.float32), rtol=1e-4, atol=1e-4)
+
+
+def test_fft_ifft_roundtrip_unnormalized():
+    rs = np.random.RandomState(1)
+    x = rs.randn(2, 3, 4, 8).astype(np.float32)
+    y = nd.contrib.ifft(nd.contrib.fft(nd.array(x))).asnumpy()
+    # cuFFT convention: ifft(fft(x)) == x * d  (ref: contrib.ifft docs)
+    assert_almost_equal(y, x * 8, rtol=1e-4, atol=1e-4)
+
+
+def test_ifft_odd_width_raises():
+    with pytest.raises(MXNetError, match="even"):
+        nd.contrib.ifft(nd.zeros((2, 7)))
+
+
+# ---------------------------------------------------------------------------
+# count_sketch
+# ---------------------------------------------------------------------------
+
+def test_count_sketch_matches_numpy():
+    rs = np.random.RandomState(0)
+    n, in_dim, out_dim = 4, 10, 6
+    x = rs.randn(n, in_dim).astype(np.float32)
+    h = rs.randint(0, out_dim, size=(1, in_dim)).astype(np.float32)
+    s = (rs.randint(0, 2, size=(1, in_dim)) * 2 - 1).astype(np.float32)
+    out = nd.contrib.count_sketch(nd.array(x), nd.array(h), nd.array(s),
+                                  out_dim=out_dim).asnumpy()
+    ref = np.zeros((n, out_dim), np.float32)
+    for i in range(in_dim):
+        ref[:, int(h[0, i])] += s[0, i] * x[:, i]
+    assert_almost_equal(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_count_sketch_requires_out_dim():
+    with pytest.raises(MXNetError, match="out_dim"):
+        nd.contrib.count_sketch(nd.zeros((2, 4)), nd.zeros((1, 4)),
+                                nd.ones((1, 4)))
