@@ -1,0 +1,61 @@
+/* Standalone consumer of libmxtpu_predict.so — no Python host process. */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+typedef unsigned int mx_uint;
+typedef float mx_float;
+typedef void *PredictorHandle;
+
+extern const char *MXGetLastError();
+extern int MXPredCreate(const char *, const void *, int, int, int, mx_uint,
+                        const char **, const mx_uint *, const mx_uint *,
+                        PredictorHandle *);
+extern int MXPredSetInput(PredictorHandle, const char *, const mx_float *, mx_uint);
+extern int MXPredForward(PredictorHandle);
+extern int MXPredGetOutputShape(PredictorHandle, mx_uint, mx_uint **, mx_uint *);
+extern int MXPredGetOutput(PredictorHandle, mx_uint, mx_float *, mx_uint);
+extern int MXPredFree(PredictorHandle);
+
+static char *read_file(const char *path, long *size) {
+  FILE *f = fopen(path, "rb");
+  if (!f) { fprintf(stderr, "cannot open %s\n", path); exit(1); }
+  fseek(f, 0, SEEK_END); *size = ftell(f); fseek(f, 0, SEEK_SET);
+  char *buf = malloc(*size + 1);
+  if (fread(buf, 1, *size, f) != (size_t)*size) exit(1);
+  buf[*size] = 0; fclose(f);
+  return buf;
+}
+
+int main(int argc, char **argv) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: %s model-symbol.json model-0000.params\n", argv[0]);
+    return 2;
+  }
+  long sym_size, param_size;
+  char *sym = read_file(argv[1], &sym_size);
+  char *params = read_file(argv[2], &param_size);
+  const char *keys[] = {"data"};
+  mx_uint indptr[] = {0, 2};
+  mx_uint shape[] = {2, 8};
+  PredictorHandle h;
+  if (MXPredCreate(sym, params, (int)param_size, 1, 0, 1, keys, indptr, shape, &h)) {
+    fprintf(stderr, "create failed: %s\n", MXGetLastError()); return 1;
+  }
+  mx_float input[16];
+  for (int i = 0; i < 16; i++) input[i] = (mx_float)i * 0.1f;
+  if (MXPredSetInput(h, "data", input, 16)) { fprintf(stderr, "%s\n", MXGetLastError()); return 1; }
+  if (MXPredForward(h)) { fprintf(stderr, "forward failed: %s\n", MXGetLastError()); return 1; }
+  mx_uint *oshape, ondim;
+  if (MXPredGetOutputShape(h, 0, &oshape, &ondim)) return 1;
+  mx_uint total = 1;
+  printf("output shape: ");
+  for (mx_uint i = 0; i < ondim; i++) { printf("%u ", oshape[i]); total *= oshape[i]; }
+  printf("\n");
+  mx_float *out = malloc(total * sizeof(mx_float));
+  if (MXPredGetOutput(h, 0, out, total)) return 1;
+  printf("out[0..3]: %f %f %f %f\n", out[0], out[1], out[2], out[3]);
+  MXPredFree(h);
+  printf("STANDALONE_OK\n");
+  return 0;
+}

@@ -1,0 +1,143 @@
+"""Python backend for the native C predict API (src/c_predict_api.cc).
+
+Reference: include/mxnet/c_predict_api.h + src/c_api/c_predict_api.cc —
+a standalone, frontend-free predictor over exported models
+(symbol JSON + params). The native library embeds CPython and drives the
+functions here; buffers cross the boundary as raw float32 pointers
+(the reference's mx_float), shapes as uint32 vectors.
+
+Kept deliberately numpy-in/numpy-out so the C side needs no jax or
+NDArray knowledge.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .base import MXNetError, check
+
+__all__ = ["Predictor", "load_ndlist"]
+
+
+def _load_params_bytes(param_bytes: bytes) -> Dict[str, np.ndarray]:
+    """Parse a .params payload (arg:/aux: keyed, nd_utils.save layout)."""
+    from .ndarray import utils as nd_utils
+    import tempfile
+    import os
+    # nd_utils.load reads from a path; the C API hands us bytes
+    with tempfile.NamedTemporaryFile(suffix=".params", delete=False) as f:
+        f.write(param_bytes)
+        path = f.name
+    try:
+        loaded = nd_utils.load(path)
+    finally:
+        os.unlink(path)
+    if isinstance(loaded, list):
+        raise MXNetError("params file must contain named arrays")
+    return {k: v.asnumpy() for k, v in loaded.items()}
+
+
+class Predictor:
+    """One PredictorHandle (ref: c_predict_api.cc PredictorObj)."""
+
+    def __init__(self, symbol_json: str, param_bytes: bytes,
+                 dev_type: int = 1, dev_id: int = 0,
+                 input_keys: Optional[List[str]] = None,
+                 input_shapes: Optional[List[List[int]]] = None,
+                 output_keys: Optional[List[str]] = None):
+        from .symbol import symbol as sym_mod
+        sym = sym_mod.load_json(symbol_json)
+        if output_keys:
+            internals = sym.get_internals()
+            outs = [internals[k if k.endswith("_output") else k + "_output"]
+                    for k in output_keys]
+            sym = sym_mod.Group(outs) if len(outs) > 1 else outs[0]
+        self._sym = sym
+        params = _load_params_bytes(param_bytes) if param_bytes else {}
+        self._params = {}
+        for k, v in params.items():
+            name = k.split(":", 1)[1] if ":" in k else k
+            self._params[name] = v
+        self._input_keys = list(input_keys or [])
+        self._input_shapes = {k: tuple(int(d) for d in s)
+                              for k, s in zip(self._input_keys,
+                                              input_shapes or [])}
+        all_inputs = sym.list_inputs()
+        for k in self._input_keys:
+            check(k in all_inputs,
+                  f"input key {k!r} is not an input of the graph "
+                  f"({all_inputs})")
+        self._inputs: Dict[str, np.ndarray] = {}
+        self._outputs: Optional[List[np.ndarray]] = None
+
+    # -- the C API surface ------------------------------------------------
+    def set_input(self, key: str, data: np.ndarray) -> None:
+        check(key in self._input_keys,
+              f"unknown input {key!r}; declared inputs: {self._input_keys}")
+        want = self._input_shapes.get(key)
+        data = np.ascontiguousarray(data, dtype=np.float32)
+        if want and int(np.prod(want)) != data.size:
+            raise MXNetError(
+                f"input {key!r}: got {data.size} elements, expected "
+                f"shape {want}")
+        self._inputs[key] = data.reshape(want) if want else data
+        self._outputs = None
+
+    def reshaped(self, input_keys: List[str],
+                 input_shapes: List[List[int]]) -> "Predictor":
+        """(ref: MXPredReshape) — a NEW predictor with re-declared input
+        shapes, sharing the graph and weights; the original handle stays
+        fully usable with its own shapes (the reference contract)."""
+        clone = Predictor.__new__(Predictor)
+        clone._sym = self._sym
+        clone._params = self._params
+        clone._input_keys = list(input_keys)
+        clone._input_shapes = {k: tuple(int(d) for d in s)
+                               for k, s in zip(input_keys, input_shapes)}
+        all_inputs = self._sym.list_inputs()
+        for k in clone._input_keys:
+            check(k in all_inputs,
+                  f"input key {k!r} is not an input of the graph "
+                  f"({all_inputs})")
+        clone._inputs = {}
+        clone._outputs = None
+        return clone
+
+    def forward(self) -> None:
+        missing = [k for k in self._input_keys if k not in self._inputs]
+        check(not missing, f"inputs not set: {missing}")
+        from .ndarray import ndarray as _nd
+        from .symbol.executor import eval_symbol
+        arrays = {k: _nd.array(v) for k, v in self._inputs.items()}
+        param_nd = {k: _nd.array(v) for k, v in self._params.items()
+                    if k not in arrays}
+        outs = eval_symbol(self._sym, list(arrays.keys()),
+                           list(arrays.values()), param_nd)
+        if not isinstance(outs, list):
+            outs = [outs]
+        self._outputs = [np.asarray(o.asnumpy(), dtype=np.float32)
+                         for o in outs]
+
+    def num_outputs(self) -> int:
+        return len(self._sym.list_outputs())
+
+    def get_output_shape(self, index: int) -> List[int]:
+        check(self._outputs is not None, "call forward() first")
+        check(0 <= index < len(self._outputs), f"bad output index {index}")
+        return list(self._outputs[index].shape)
+
+    def get_output(self, index: int) -> np.ndarray:
+        check(self._outputs is not None, "call forward() first")
+        check(0 <= index < len(self._outputs), f"bad output index {index}")
+        return self._outputs[index]
+
+
+def load_ndlist(nd_bytes: bytes):
+    """(ref: MXNDListCreate) — returns (names, arrays) from a saved
+    NDArray file. Arrays are coerced to float32 C-contiguous because the
+    C side (MXNDListGet) exposes the raw buffer as mx_float*."""
+    arrs = _load_params_bytes(nd_bytes)
+    names = list(arrs.keys())
+    return names, [np.ascontiguousarray(arrs[n], dtype=np.float32)
+                   for n in names]

@@ -1,0 +1,396 @@
+// Standalone C predict API over exported models.
+//
+// Reference: include/mxnet/c_predict_api.h (MXPredCreate/SetInput/Forward/
+// GetOutput/Free + MXNDList*) and src/c_api/c_predict_api.cc.
+//
+// TPU-native design: the reference builds a GraphExecutor in-process; here
+// the executor IS the Python/JAX runtime (XLA owns compilation), so this
+// library embeds CPython and drives mxnet_tpu.c_predict.Predictor. The C
+// surface — signatures, shape indptr encoding, float32 buffers, last-error
+// contract — matches the reference so existing c_predict_api consumers
+// port by relinking. Works both standalone (initializes the interpreter;
+// set MXTPU_HOME to the repo/package root) and when loaded into an
+// already-running Python process (pytest/ctypes: uses PyGILState).
+//
+// Build: make -C src  (libmxtpu_predict.so, links libpython3.12)
+
+#include <Python.h>
+
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+typedef unsigned int mx_uint;
+typedef float mx_float;
+typedef void *PredictorHandle;
+typedef void *NDListHandle;
+
+#define MXTPU_API extern "C" __attribute__((visibility("default")))
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void set_error(const std::string &msg) { g_last_error = msg; }
+
+// ---------------------------------------------------------------------------
+// interpreter lifecycle
+// ---------------------------------------------------------------------------
+
+std::once_flag g_init_flag;
+bool g_we_initialized = false;
+
+void ensure_interpreter() {
+  std::call_once(g_init_flag, []() {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      g_we_initialized = true;
+      // release the GIL acquired by Py_Initialize so ScopedGIL below
+      // can manage it uniformly
+      PyEval_SaveThread();
+    }
+  });
+}
+
+class ScopedGIL {
+ public:
+  ScopedGIL() : state_(PyGILState_Ensure()) {}
+  ~ScopedGIL() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+// fetch the python exception as a string and clear it
+std::string py_error() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value) {
+    PyObject *s = PyObject_Str(value);
+    if (s) {
+      const char *c = PyUnicode_AsUTF8(s);
+      if (c) msg = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  return msg;
+}
+
+PyObject *get_predict_module() {
+  const char *home = getenv("MXTPU_HOME");
+  if (home != nullptr) {
+    PyObject *sys_path = PySys_GetObject("path");  // borrowed
+    if (sys_path != nullptr) {
+      PyObject *p = PyUnicode_FromString(home);
+      bool found = false;
+      for (Py_ssize_t i = 0; i < PyList_Size(sys_path); ++i) {
+        PyObject *item = PyList_GetItem(sys_path, i);
+        if (item && PyUnicode_Compare(item, p) == 0) { found = true; break; }
+      }
+      if (!found) PyList_Insert(sys_path, 0, p);
+      Py_DECREF(p);
+    }
+  }
+  return PyImport_ImportModule("mxnet_tpu.c_predict");
+}
+
+struct PredictorObj {
+  PyObject *pred;  // mxnet_tpu.c_predict.Predictor
+  // per-handle shape storage: valid until the next MXPred call on THIS
+  // handle (the reference keeps out_shapes inside PredictorObj likewise)
+  std::vector<mx_uint> shape_buf;
+};
+
+struct NDListObj {
+  PyObject *names;   // list[str]
+  PyObject *arrays;  // list[np.ndarray float32 C-contiguous]
+  std::vector<mx_uint> shape_buf;
+};
+
+// call a method returning a new reference; nullptr on python error
+PyObject *call_method(PyObject *obj, const char *name, PyObject *args) {
+  PyObject *fn = PyObject_GetAttrString(obj, name);
+  if (!fn) return nullptr;
+  PyObject *out = PyObject_CallObject(fn, args);
+  Py_DECREF(fn);
+  return out;
+}
+
+}  // namespace
+
+MXTPU_API const char *MXGetLastError() { return g_last_error.c_str(); }
+
+MXTPU_API int MXPredCreatePartialOut(
+    const char *symbol_json_str, const void *param_bytes, int param_size,
+    int dev_type, int dev_id, mx_uint num_input_nodes,
+    const char **input_keys, const mx_uint *input_shape_indptr,
+    const mx_uint *input_shape_data, mx_uint num_output_nodes,
+    const char **output_keys, PredictorHandle *out) {
+  ensure_interpreter();
+  ScopedGIL gil;
+  PyObject *mod = get_predict_module();
+  if (!mod) { set_error(py_error()); return -1; }
+
+  PyObject *keys = PyList_New(num_input_nodes);
+  PyObject *shapes = PyList_New(num_input_nodes);
+  for (mx_uint i = 0; i < num_input_nodes; ++i) {
+    PyList_SetItem(keys, i, PyUnicode_FromString(input_keys[i]));
+    mx_uint lo = input_shape_indptr[i], hi = input_shape_indptr[i + 1];
+    PyObject *shape = PyList_New(hi - lo);
+    for (mx_uint j = lo; j < hi; ++j)
+      PyList_SetItem(shape, j - lo, PyLong_FromUnsignedLong(
+          input_shape_data[j]));
+    PyList_SetItem(shapes, i, shape);
+  }
+  PyObject *outputs = Py_None;
+  Py_INCREF(Py_None);
+  if (num_output_nodes > 0) {
+    Py_DECREF(Py_None);
+    outputs = PyList_New(num_output_nodes);
+    for (mx_uint i = 0; i < num_output_nodes; ++i)
+      PyList_SetItem(outputs, i, PyUnicode_FromString(output_keys[i]));
+  }
+  PyObject *params = PyBytes_FromStringAndSize(
+      static_cast<const char *>(param_bytes), param_size);
+  PyObject *cls = PyObject_GetAttrString(mod, "Predictor");
+  Py_DECREF(mod);
+  PyObject *args = Py_BuildValue("(sOiiOOO)", symbol_json_str, params,
+                                 dev_type, dev_id, keys, shapes, outputs);
+  Py_DECREF(params);
+  Py_DECREF(keys);
+  Py_DECREF(shapes);
+  Py_DECREF(outputs);
+  if (!cls || !args) {
+    Py_XDECREF(cls);
+    Py_XDECREF(args);
+    set_error(py_error());
+    return -1;
+  }
+  PyObject *pred = PyObject_CallObject(cls, args);
+  Py_DECREF(cls);
+  Py_DECREF(args);
+  if (!pred) { set_error(py_error()); return -1; }
+  auto *h = new PredictorObj{};
+  h->pred = pred;
+  *out = h;
+  return 0;
+}
+
+MXTPU_API int MXPredCreate(const char *symbol_json_str,
+                           const void *param_bytes, int param_size,
+                           int dev_type, int dev_id,
+                           mx_uint num_input_nodes, const char **input_keys,
+                           const mx_uint *input_shape_indptr,
+                           const mx_uint *input_shape_data,
+                           PredictorHandle *out) {
+  return MXPredCreatePartialOut(symbol_json_str, param_bytes, param_size,
+                                dev_type, dev_id, num_input_nodes,
+                                input_keys, input_shape_indptr,
+                                input_shape_data, 0, nullptr, out);
+}
+
+MXTPU_API int MXPredSetInput(PredictorHandle handle, const char *key,
+                             const mx_float *data, mx_uint size) {
+  ScopedGIL gil;
+  auto *h = static_cast<PredictorObj *>(handle);
+  // hand the buffer over as bytes; python reshapes to the declared shape
+  PyObject *mod = PyImport_ImportModule("numpy");
+  if (!mod) { set_error(py_error()); return -1; }
+  PyObject *frombuffer = PyObject_GetAttrString(mod, "frombuffer");
+  Py_DECREF(mod);
+  PyObject *buf = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char *>(data), size * sizeof(mx_float));
+  PyObject *args = Py_BuildValue("(O)", buf);
+  PyObject *kw = Py_BuildValue("{s:s}", "dtype", "float32");
+  PyObject *arr = PyObject_Call(frombuffer, args, kw);
+  Py_DECREF(frombuffer);
+  Py_DECREF(buf);
+  Py_DECREF(args);
+  Py_DECREF(kw);
+  if (!arr) { set_error(py_error()); return -1; }
+  PyObject *cargs = Py_BuildValue("(sO)", key, arr);
+  Py_DECREF(arr);
+  PyObject *res = call_method(h->pred, "set_input", cargs);
+  Py_DECREF(cargs);
+  if (!res) { set_error(py_error()); return -1; }
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXPredForward(PredictorHandle handle) {
+  ScopedGIL gil;
+  auto *h = static_cast<PredictorObj *>(handle);
+  PyObject *res = call_method(h->pred, "forward", nullptr);
+  if (!res) { set_error(py_error()); return -1; }
+  Py_DECREF(res);
+  return 0;
+}
+
+// the reference's PartialForward steps the graph node by node; whole-graph
+// XLA execution has no per-node stepping, so one step == full forward
+MXTPU_API int MXPredPartialForward(PredictorHandle handle, int step,
+                                   int *step_left) {
+  int rc = MXPredForward(handle);
+  if (step_left) *step_left = 0;
+  return rc;
+}
+
+MXTPU_API int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                                   mx_uint **shape_data,
+                                   mx_uint *shape_ndim) {
+  ScopedGIL gil;
+  auto *h = static_cast<PredictorObj *>(handle);
+  PyObject *args = Py_BuildValue("(I)", index);
+  PyObject *res = call_method(h->pred, "get_output_shape", args);
+  Py_DECREF(args);
+  if (!res) { set_error(py_error()); return -1; }
+  Py_ssize_t n = PyList_Size(res);
+  h->shape_buf.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    h->shape_buf[i] = static_cast<mx_uint>(
+        PyLong_AsUnsignedLong(PyList_GetItem(res, i)));
+  Py_DECREF(res);
+  *shape_data = h->shape_buf.data();
+  *shape_ndim = static_cast<mx_uint>(n);
+  return 0;
+}
+
+MXTPU_API int MXPredGetOutput(PredictorHandle handle, mx_uint index,
+                              mx_float *data, mx_uint size) {
+  ScopedGIL gil;
+  auto *h = static_cast<PredictorObj *>(handle);
+  PyObject *args = Py_BuildValue("(I)", index);
+  PyObject *arr = call_method(h->pred, "get_output", args);
+  Py_DECREF(args);
+  if (!arr) { set_error(py_error()); return -1; }
+  PyObject *tobytes = call_method(arr, "tobytes", nullptr);
+  Py_DECREF(arr);
+  if (!tobytes) { set_error(py_error()); return -1; }
+  char *buf = nullptr;
+  Py_ssize_t len = 0;
+  PyBytes_AsStringAndSize(tobytes, &buf, &len);
+  if (static_cast<size_t>(len) != size * sizeof(mx_float)) {
+    Py_DECREF(tobytes);
+    set_error("output size mismatch: have " + std::to_string(len / 4) +
+              " elements, caller asked for " + std::to_string(size));
+    return -1;
+  }
+  std::memcpy(data, buf, len);
+  Py_DECREF(tobytes);
+  return 0;
+}
+
+MXTPU_API int MXPredReshape(mx_uint num_input_nodes, const char **input_keys,
+                            const mx_uint *input_shape_indptr,
+                            const mx_uint *input_shape_data,
+                            PredictorHandle handle, PredictorHandle *out) {
+  ScopedGIL gil;
+  auto *h = static_cast<PredictorObj *>(handle);
+  PyObject *keys = PyList_New(num_input_nodes);
+  PyObject *shapes = PyList_New(num_input_nodes);
+  for (mx_uint i = 0; i < num_input_nodes; ++i) {
+    PyList_SetItem(keys, i, PyUnicode_FromString(input_keys[i]));
+    mx_uint lo = input_shape_indptr[i], hi = input_shape_indptr[i + 1];
+    PyObject *shape = PyList_New(hi - lo);
+    for (mx_uint j = lo; j < hi; ++j)
+      PyList_SetItem(shape, j - lo,
+                     PyLong_FromUnsignedLong(input_shape_data[j]));
+    PyList_SetItem(shapes, i, shape);
+  }
+  PyObject *args = Py_BuildValue("(OO)", keys, shapes);
+  Py_DECREF(keys);
+  Py_DECREF(shapes);
+  // reference returns a NEW handle sharing weights; the original stays
+  // usable with its own shapes
+  PyObject *clone = call_method(h->pred, "reshaped", args);
+  Py_DECREF(args);
+  if (!clone) { set_error(py_error()); return -1; }
+  auto *nh = new PredictorObj{};
+  nh->pred = clone;
+  *out = nh;
+  return 0;
+}
+
+MXTPU_API int MXPredFree(PredictorHandle handle) {
+  ScopedGIL gil;
+  auto *h = static_cast<PredictorObj *>(handle);
+  Py_XDECREF(h->pred);
+  delete h;
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// MXNDList*: read a saved NDArray map (ref: c_predict_api.h:252-277)
+// ---------------------------------------------------------------------------
+
+MXTPU_API int MXNDListCreate(const char *nd_file_bytes, int nd_file_size,
+                             NDListHandle *out, mx_uint *out_length) {
+  ensure_interpreter();
+  ScopedGIL gil;
+  PyObject *mod = get_predict_module();
+  if (!mod) { set_error(py_error()); return -1; }
+  PyObject *fn = PyObject_GetAttrString(mod, "load_ndlist");
+  Py_DECREF(mod);
+  PyObject *bytes = PyBytes_FromStringAndSize(nd_file_bytes, nd_file_size);
+  PyObject *args = Py_BuildValue("(O)", bytes);
+  Py_DECREF(bytes);
+  PyObject *res = fn ? PyObject_CallObject(fn, args) : nullptr;
+  Py_XDECREF(fn);
+  Py_DECREF(args);
+  if (!res) { set_error(py_error()); return -1; }
+  PyObject *names = PySequence_GetItem(res, 0);
+  PyObject *arrays = PySequence_GetItem(res, 1);
+  Py_DECREF(res);
+  auto *h = new NDListObj{};
+  h->names = names;
+  h->arrays = arrays;
+  *out = h;
+  *out_length = static_cast<mx_uint>(PyList_Size(names));
+  return 0;
+}
+
+MXTPU_API int MXNDListGet(NDListHandle handle, mx_uint index,
+                          const char **out_key, const mx_float **out_data,
+                          const mx_uint **out_shape, mx_uint *out_ndim) {
+  ScopedGIL gil;
+  auto *h = static_cast<NDListObj *>(handle);
+  if (index >= static_cast<mx_uint>(PyList_Size(h->names))) {
+    set_error("MXNDListGet: index out of range");
+    return -1;
+  }
+  *out_key = PyUnicode_AsUTF8(PyList_GetItem(h->names, index));
+  PyObject *arr = PyList_GetItem(h->arrays, index);  // borrowed
+  // ensure float32 C-contiguous via numpy (stored that way by load_ndlist)
+  PyObject *iface = PyObject_GetAttrString(arr, "ctypes");
+  PyObject *dataptr = iface ? PyObject_GetAttrString(iface, "data") : nullptr;
+  Py_XDECREF(iface);
+  if (!dataptr) { set_error(py_error()); return -1; }
+  *out_data = reinterpret_cast<const mx_float *>(PyLong_AsSize_t(dataptr));
+  Py_DECREF(dataptr);
+  PyObject *shape = PyObject_GetAttrString(arr, "shape");
+  if (!shape) { set_error(py_error()); return -1; }
+  Py_ssize_t n = PyTuple_Size(shape);
+  h->shape_buf.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    h->shape_buf[i] = static_cast<mx_uint>(
+        PyLong_AsUnsignedLong(PyTuple_GetItem(shape, i)));
+  Py_DECREF(shape);
+  *out_shape = h->shape_buf.data();
+  *out_ndim = static_cast<mx_uint>(n);
+  return 0;
+}
+
+MXTPU_API int MXNDListFree(NDListHandle handle) {
+  ScopedGIL gil;
+  auto *h = static_cast<NDListObj *>(handle);
+  Py_XDECREF(h->names);
+  Py_XDECREF(h->arrays);
+  delete h;
+  return 0;
+}

@@ -1,0 +1,186 @@
+"""Native C predict API (ref: include/mxnet/c_predict_api.h consumers;
+tests drive src/libmxtpu_predict.so through ctypes exactly the way an
+external C program would)."""
+import ctypes
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.gluon import nn
+
+_LIB_PATH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "src", "libmxtpu_predict.so")
+
+
+@pytest.fixture(scope="module")
+def lib():
+    if not os.path.exists(_LIB_PATH):
+        import subprocess
+        subprocess.run(["make", "-C", os.path.dirname(_LIB_PATH)],
+                       check=False, capture_output=True, timeout=180)
+    if not os.path.exists(_LIB_PATH):
+        pytest.skip("libmxtpu_predict.so not built (make -C src)")
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    return lib
+
+
+@pytest.fixture(scope="module")
+def exported_model(tmp_path_factory):
+    d = tmp_path_factory.mktemp("cpredict")
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.RandomState(0).randn(2, 8).astype(np.float32))
+    with autograd.pause():
+        y = net(x)
+    path = str(d / "mlp")
+    net.export(path)
+    return path, x.asnumpy(), y.asnumpy()
+
+
+def _create(lib, sym_json, param_bytes, key, shape):
+    handle = ctypes.c_void_p()
+    keys = (ctypes.c_char_p * 1)(key.encode())
+    indptr = (ctypes.c_uint * 2)(0, len(shape))
+    sdata = (ctypes.c_uint * len(shape))(*shape)
+    rc = lib.MXPredCreate(
+        sym_json.encode(), param_bytes, len(param_bytes), 1, 0, 1,
+        keys, indptr, sdata, ctypes.byref(handle))
+    return rc, handle
+
+
+def test_c_predict_end_to_end(lib, exported_model):
+    path, x, y_ref = exported_model
+    with open(path + "-symbol.json") as f:
+        sym_json = f.read()
+    with open(path + "-0000.params", "rb") as f:
+        param_bytes = f.read()
+
+    rc, handle = _create(lib, sym_json, param_bytes, "data", x.shape)
+    assert rc == 0, lib.MXGetLastError()
+
+    flat = np.ascontiguousarray(x, dtype=np.float32).ravel()
+    rc = lib.MXPredSetInput(
+        handle, b"data",
+        flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), flat.size)
+    assert rc == 0, lib.MXGetLastError()
+
+    assert lib.MXPredForward(handle) == 0, lib.MXGetLastError()
+
+    shape_data = ctypes.POINTER(ctypes.c_uint)()
+    ndim = ctypes.c_uint()
+    rc = lib.MXPredGetOutputShape(handle, 0, ctypes.byref(shape_data),
+                                  ctypes.byref(ndim))
+    assert rc == 0, lib.MXGetLastError()
+    shape = tuple(shape_data[i] for i in range(ndim.value))
+    assert shape == y_ref.shape
+
+    out = np.zeros(int(np.prod(shape)), np.float32)
+    rc = lib.MXPredGetOutput(
+        handle, 0, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out.size)
+    assert rc == 0, lib.MXGetLastError()
+    np.testing.assert_allclose(out.reshape(shape), y_ref,
+                               rtol=1e-5, atol=1e-5)
+    assert lib.MXPredFree(handle) == 0
+
+
+def test_c_predict_error_contract(lib, exported_model):
+    path, x, _ = exported_model
+    with open(path + "-symbol.json") as f:
+        sym_json = f.read()
+    with open(path + "-0000.params", "rb") as f:
+        param_bytes = f.read()
+    rc, handle = _create(lib, sym_json, param_bytes, "data", x.shape)
+    assert rc == 0
+    # forward without setting input -> error + message via MXGetLastError
+    assert lib.MXPredForward(handle) != 0
+    assert b"inputs not set" in lib.MXGetLastError()
+    # bad input key
+    buf = np.zeros(4, np.float32)
+    rc = lib.MXPredSetInput(
+        handle, b"nonsense",
+        buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), buf.size)
+    assert rc != 0
+    assert b"unknown input" in lib.MXGetLastError()
+    lib.MXPredFree(handle)
+    # broken symbol json
+    rc, _ = _create(lib, "{not json", param_bytes, "data", x.shape)
+    assert rc != 0
+
+
+def test_c_predict_reshape(lib, exported_model):
+    path, x, _ = exported_model
+    with open(path + "-symbol.json") as f:
+        sym_json = f.read()
+    with open(path + "-0000.params", "rb") as f:
+        param_bytes = f.read()
+    rc, handle = _create(lib, sym_json, param_bytes, "data", x.shape)
+    assert rc == 0
+    new_shape = (5, 8)
+    keys = (ctypes.c_char_p * 1)(b"data")
+    indptr = (ctypes.c_uint * 2)(0, 2)
+    sdata = (ctypes.c_uint * 2)(*new_shape)
+    new_handle = ctypes.c_void_p()
+    rc = lib.MXPredReshape(1, keys, indptr, sdata, handle,
+                           ctypes.byref(new_handle))
+    assert rc == 0, lib.MXGetLastError()
+    xb = np.random.RandomState(1).randn(*new_shape).astype(np.float32)
+    flat = xb.ravel()
+    assert lib.MXPredSetInput(
+        new_handle, b"data",
+        flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        flat.size) == 0
+    assert lib.MXPredForward(new_handle) == 0, lib.MXGetLastError()
+    shape_data = ctypes.POINTER(ctypes.c_uint)()
+    ndim = ctypes.c_uint()
+    assert lib.MXPredGetOutputShape(new_handle, 0, ctypes.byref(shape_data),
+                                    ctypes.byref(ndim)) == 0
+    assert tuple(shape_data[i] for i in range(ndim.value)) == (5, 10)
+    # the ORIGINAL handle must remain usable with its own shapes
+    # (reference contract: MXPredReshape returns a new handle)
+    flat0 = np.random.RandomState(2).randn(*x.shape) \
+        .astype(np.float32).ravel()
+    assert lib.MXPredSetInput(
+        handle, b"data",
+        flat0.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        flat0.size) == 0, lib.MXGetLastError()
+    assert lib.MXPredForward(handle) == 0, lib.MXGetLastError()
+    old_shape = ctypes.POINTER(ctypes.c_uint)()
+    old_ndim = ctypes.c_uint()
+    assert lib.MXPredGetOutputShape(handle, 0, ctypes.byref(old_shape),
+                                    ctypes.byref(old_ndim)) == 0
+    assert tuple(old_shape[i] for i in range(old_ndim.value)) == (2, 10)
+    # per-handle shape buffers: the new handle's result is not clobbered
+    assert tuple(shape_data[i] for i in range(ndim.value)) == (5, 10)
+    lib.MXPredFree(new_handle)
+    lib.MXPredFree(handle)
+
+
+def test_ndlist_api(lib, exported_model):
+    path, _, _ = exported_model
+    with open(path + "-0000.params", "rb") as f:
+        param_bytes = f.read()
+    handle = ctypes.c_void_p()
+    length = ctypes.c_uint()
+    rc = lib.MXNDListCreate(param_bytes, len(param_bytes),
+                            ctypes.byref(handle), ctypes.byref(length))
+    assert rc == 0, lib.MXGetLastError()
+    assert length.value == 4  # 2 dense layers x (weight, bias)
+    key = ctypes.c_char_p()
+    data = ctypes.POINTER(ctypes.c_float)()
+    shape = ctypes.POINTER(ctypes.c_uint)()
+    ndim = ctypes.c_uint()
+    rc = lib.MXNDListGet(handle, 0, ctypes.byref(key), ctypes.byref(data),
+                         ctypes.byref(shape), ctypes.byref(ndim))
+    assert rc == 0, lib.MXGetLastError()
+    assert key.value.startswith(b"arg:")
+    dims = tuple(shape[i] for i in range(ndim.value))
+    assert all(d > 0 for d in dims)
+    vals = np.ctypeslib.as_array(data, shape=dims)
+    assert np.isfinite(vals).all()
+    assert lib.MXNDListFree(handle) == 0
