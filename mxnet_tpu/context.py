@@ -61,13 +61,19 @@ class Context:
         CPU fallback when built without CUDA).
         """
         jax = _jax()
+        # multi-process: only THIS process's devices are addressable
+        # (jax.devices() lists the whole cluster)
         if self.device_type in ("cpu", "cpu_pinned", "cpu_shared"):
-            cpus = _jax().devices("cpu") if _has_platform("cpu") else jax.devices()
+            # local_devices() with no backend lists only default-backend
+            # devices — ask the cpu backend explicitly
+            cpus = jax.local_devices(backend="cpu") \
+                if _has_platform("cpu") else jax.local_devices()
             return cpus[self.device_id % len(cpus)]
         accels = _accelerator_devices()
         if accels:
             return accels[self.device_id % len(accels)]
-        return jax.devices()[self.device_id % len(jax.devices())]
+        return jax.local_devices()[
+            self.device_id % len(jax.local_devices())]
 
     def __eq__(self, other) -> bool:
         return (isinstance(other, Context)
@@ -114,7 +120,7 @@ def _has_platform(name: str) -> bool:
 
 def _accelerator_devices() -> List:
     jax = _jax()
-    return [d for d in jax.devices() if d.platform != "cpu"]
+    return [d for d in jax.local_devices() if d.platform != "cpu"]
 
 
 def cpu(device_id: int = 0) -> Context:

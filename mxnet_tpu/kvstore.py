@@ -243,8 +243,8 @@ class KVStoreDistTPU(KVStoreBase):
         self._rank = jax.process_index()
         self._mesh = None
         if self._nproc > 1:
-            from .parallel import make_mesh
-            self._mesh = make_mesh({"hosts": self._nproc * 0 + -1})
+            from .parallel.collectives import make_host_mesh
+            self._mesh = make_host_mesh()
 
     @property
     def type(self):
@@ -261,9 +261,10 @@ class KVStoreDistTPU(KVStoreBase):
     def _reduce_global(self, merged: _nd.NDArray) -> _nd.NDArray:
         if self._mesh is None:
             return merged
-        from .parallel.collectives import allreduce
-        return _nd.NDArray(allreduce(merged._data, self._mesh, axis="hosts"),
-                           ctx=merged._ctx)
+        from .parallel.collectives import cross_process_allreduce
+        out = cross_process_allreduce(merged.asnumpy(), self._mesh,
+                                      axis="hosts")
+        return _nd.array(out, ctx=merged._ctx)
 
     def barrier(self) -> None:
         from .parallel.collectives import barrier as _barrier

@@ -46,6 +46,71 @@ def allreduce(x, mesh, axis: str = "dp", op: str = "sum"):
                      check_vma=False)(x)
 
 
+def make_host_mesh():
+    """A 1-D "hosts" mesh with exactly ONE device per process — the
+    communication domain for per-process values (dist kvstore). Using all
+    devices would make psum overcount by devices-per-process."""
+    import jax
+    import numpy as _np2
+    from jax.sharding import Mesh
+    per_proc = {}
+    for d in jax.devices():
+        per_proc.setdefault(d.process_index, d)
+    devs = [per_proc[i] for i in sorted(per_proc)]
+    return Mesh(_np2.asarray(devs), ("hosts",))
+
+
+@functools.lru_cache(maxsize=None)
+def _cross_process_fn(mesh, axis, op, ndim):
+    """Compiled psum-over-hosts program, cached per (mesh, axis, op,
+    rank) so the per-key, per-iteration kvstore push path does not
+    re-trace (shapes vary per key but jit caches per shape under one
+    function object)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    def f(v):
+        red = {"sum": jax.lax.psum, "mean": jax.lax.pmean,
+               "max": jax.lax.pmax}[op]
+        return red(v[0], axis)
+
+    # multi-host shard_map must run under jit (eager mode tries to copy
+    # the operand to non-addressable devices)
+    return jax.jit(shard_map(f, mesh=mesh, in_specs=(P(axis),),
+                             out_specs=P(*([None] * ndim)),
+                             check_vma=False))
+
+
+def cross_process_allreduce(local, mesh, axis: str = "hosts",
+                            op: str = "sum"):
+    """AllReduce of per-PROCESS local values over a one-device-per-process
+    mesh (make_host_mesh): the dist kvstore push path — each worker holds
+    its own merged gradient; the result is the sum, replicated to every
+    worker.
+
+    The local array is lifted into a global array with one shard per
+    process on `axis` (jax.make_array_from_process_local_data), psum'd
+    with shard_map, and the replicated result is returned as host numpy.
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    nproc = mesh.devices.size
+    check(nproc == jax.process_count(),
+          f"cross_process_allreduce needs a one-device-per-process mesh "
+          f"(make_host_mesh); got {nproc} devices for "
+          f"{jax.process_count()} processes")
+    local = np.asarray(local)[None]
+    gshape = (nproc,) + local.shape[1:]
+    garr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P(axis)), local, gshape)
+    out = _cross_process_fn(mesh, axis, op, local.ndim - 1)(garr)
+    # fully replicated -> every process can materialize it
+    return np.asarray(out)
+
+
 def device_allreduce(arrays, mesh, axis: str = "dp", op: str = "sum"):
     """Fused allreduce of a list of arrays (one compiled program for the
     whole gradient bucket, like the reference's grouped NCCL launches,
@@ -151,6 +216,12 @@ def barrier(mesh=None) -> None:
     import jax
     if mesh is None:
         (jax.device_put(0) + 0).block_until_ready()
+        return
+    if jax.process_count() > 1:
+        import numpy as np
+        # the collective itself is the rendezvous
+        cross_process_allreduce(np.zeros((), np.float32), mesh,
+                                axis=mesh.axis_names[0])
         return
     import jax.numpy as jnp
     allreduce(jnp.zeros(()), mesh, axis=mesh.axis_names[0]).block_until_ready()

@@ -1,0 +1,54 @@
+"""Multi-process dist kvstore worker (ref: tests/nightly/dist_sync_kvstore.py —
+plain worker script run N-way by tools/launch.py local; asserts
+rank-dependent deterministic values after push/pull rounds)."""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+
+def main():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "..", ".."))
+    from mxnet_tpu.kvstore_server import init_distributed
+    assert init_distributed(), "MXTPU_* env missing (run via tools/launch.py)"
+    import mxnet_tpu as mx
+
+    kv = mx.kv.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+    assert nw == int(os.environ["MXTPU_NUM_WORKERS"])
+
+    # round 1: dense push/pull — value summed over workers
+    shape = (3, 4)
+    kv.init("w0", mx.nd.zeros(shape))
+    for rnd in range(3):
+        grad = mx.nd.array(np.full(shape, rank + 1.0 + rnd, np.float32))
+        kv.push("w0", grad)
+        out = mx.nd.zeros(shape)
+        kv.pull("w0", out=out)
+        expected = sum(r + 1.0 + rnd for r in range(nw))
+        got = out.asnumpy()
+        assert np.allclose(got, expected), (rank, rnd, got[0, 0], expected)
+
+    # round 2: multiple keys, different shapes
+    keys = ["a", "b"]
+    shapes = [(2, 3), (5,)]
+    for k, s in zip(keys, shapes):
+        kv.init(k, mx.nd.zeros(s))
+    for k, s in zip(keys, shapes):
+        kv.push(k, mx.nd.array(np.full(s, float(rank), np.float32)))
+        out = mx.nd.zeros(s)
+        kv.pull(k, out=out)
+        expected = sum(float(r) for r in range(nw))
+        assert np.allclose(out.asnumpy(), expected), (rank, k)
+
+    kv.barrier()
+    print(f"worker {rank}/{nw}: dist kvstore checks passed", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,30 @@
+"""Multi-process dist_sync kvstore (SURVEY §4 point 3: distributed = the
+same worker script forked N-way locally by the launcher, the reference's
+`launch.py -n N --launcher local dist_sync_kvstore.py` CI pattern)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.parametrize("n", [2])
+def test_dist_sync_kvstore_multiprocess(n):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # one cpu device per process
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "launch.py"),
+         "-n", str(n), "--launcher", "local",
+         "--coordinator", "127.0.0.1:12417",
+         sys.executable,
+         os.path.join(_ROOT, "tests", "dist",
+                      "dist_sync_kvstore_worker.py")],
+        capture_output=True, text=True, timeout=300, env=env, cwd=_ROOT)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-3000:]
+    for r in range(n):
+        assert f"worker {r}/{n}: dist kvstore checks passed" in out, \
+            out[-3000:]
