@@ -1,0 +1,172 @@
+"""Exception propagation + thread-locality
+(ref: tests/python/unittest/test_exc_handling.py — engine exceptions
+rethrown at sync points; test_thread_local.py — per-thread
+Context/AttrScope/autograd state)."""
+import threading
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.base import MXNetError
+
+
+# ---------------------------------------------------------------------------
+# exception propagation
+# ---------------------------------------------------------------------------
+
+def test_bad_op_args_raise_mxneterror():
+    with pytest.raises(MXNetError):
+        nd.imperative_invoke("this_op_does_not_exist", (), {})
+
+
+def test_shape_mismatch_raises_before_sync():
+    a = nd.zeros((2, 3))
+    b = nd.zeros((4, 5))
+    with pytest.raises(Exception):
+        y = nd.elemwise_add(a, b)
+        y.asnumpy()  # at latest, the sync point must surface it
+
+
+def test_exception_inside_hybridized_block():
+    from mxnet_tpu.gluon import nn
+
+    class Bad(nn.HybridSequential):
+        def _imperative_call(self, x):
+            raise ValueError("boom inside forward")
+
+    net = Bad()
+    net.hybridize()
+    with pytest.raises(ValueError, match="boom"):
+        net(nd.zeros((1, 2)))
+
+
+def test_exception_in_recorded_scope_resets_state():
+    # an exception inside autograd.record() must not leave the
+    # thread-local recording flag stuck on
+    with pytest.raises(ValueError):
+        with autograd.record():
+            raise ValueError("interrupted step")
+    assert not autograd.is_recording()
+    assert not autograd.is_training()
+
+
+def test_nan_does_not_hang_sync():
+    a = nd.array(np.array([1.0, 0.0], np.float32))
+    out = (a / a).asnumpy()  # 0/0 -> nan, must return, not raise/hang
+    assert np.isnan(out[1])
+
+
+# ---------------------------------------------------------------------------
+# thread-local state (ref: test_thread_local.py)
+# ---------------------------------------------------------------------------
+
+def test_context_is_thread_local():
+    results = {}
+
+    def worker():
+        # the spawned thread starts from the default, not the main
+        # thread's override
+        results["inner_before"] = mx.current_context()
+        with mx.Context(mx.cpu(1)) if hasattr(mx.Context, "__enter__") \
+                else mx.cpu(1):
+            pass
+        results["inner_after"] = mx.current_context()
+
+    with mx.Context(mx.cpu(3)) if hasattr(mx.Context, "__enter__") \
+            else mx.cpu(3):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        results["outer"] = mx.current_context()
+
+    assert results["inner_before"].device_id == 0
+    assert results["outer"].device_id == 3
+
+
+def test_attrscope_is_thread_local():
+    from mxnet_tpu import symbol as S
+    got = {}
+
+    def worker():
+        v = S.var("w_thread")
+        got["thread_attrs"] = v._outputs[0][0].extra.get("attr", {})
+
+    with mx.AttrScope(ctx_group="dev1"):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        v = S.var("w_main")
+        got["main_attrs"] = v._outputs[0][0].extra.get("attr", {})
+
+    assert got["main_attrs"].get("ctx_group") == "dev1"
+    assert "ctx_group" not in got["thread_attrs"]
+
+
+def test_autograd_recording_is_thread_local():
+    flags = {}
+
+    def worker():
+        flags["thread"] = autograd.is_recording()
+
+    with autograd.record():
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        flags["main"] = autograd.is_recording()
+    assert flags["main"] is True
+    assert flags["thread"] is False
+
+
+def test_concurrent_imperative_ops():
+    # frontend thread-safety stress
+    # (ref: tests/nightly/test_tlocal_racecondition.py)
+    errors = []
+    n_threads, n_iter = 4, 20
+
+    def worker(seed):
+        try:
+            rs = np.random.RandomState(seed)
+            for _ in range(n_iter):
+                a = nd.array(rs.randn(8, 8).astype(np.float32))
+                b = nd.array(rs.randn(8, 8).astype(np.float32))
+                c = nd.dot(a, b) + nd.relu(a) * 2.0
+                expected = a.asnumpy() @ b.asnumpy() + \
+                    np.maximum(a.asnumpy(), 0) * 2.0
+                np.testing.assert_allclose(c.asnumpy(), expected,
+                                           rtol=1e-4, atol=1e-4)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+
+
+def test_concurrent_autograd():
+    errors = []
+
+    def worker(seed):
+        try:
+            rs = np.random.RandomState(seed)
+            x = nd.array(rs.randn(4, 4).astype(np.float32))
+            x.attach_grad()
+            with autograd.record():
+                y = (x * x).sum()
+            y.backward()
+            np.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy(),
+                                       rtol=1e-5, atol=1e-5)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
