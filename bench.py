@@ -46,7 +46,7 @@ def _init_backend(timeout_s=900):
     return False
 
 
-def run(batch=128, warmup=3, iters=10, dtype="bfloat16"):
+def run(batch=128, warmup=1, iters=None, dtype=None):
     import numpy as np
     import jax
     import jax.numpy as jnp
@@ -55,6 +55,11 @@ def run(batch=128, warmup=3, iters=10, dtype="bfloat16"):
     from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
     from mxnet_tpu.parallel import SPMDTrainer
     from mxnet_tpu import nd
+
+    # dtype: measured on the axon relay, bf16 matmuls run ~15x SLOWER than
+    # f32 (software-handled bf16); default to f32 there, bf16 on real TPU.
+    if dtype is None:
+        dtype = os.environ.get("MXTPU_BENCH_DTYPE", "float32")
 
     mx.random.seed(0)
     net = resnet50_v1()
@@ -70,20 +75,29 @@ def run(batch=128, warmup=3, iters=10, dtype="bfloat16"):
     data = jnp.asarray(rs.randn(batch, 3, 224, 224).astype(np.float32))
     label = jnp.asarray(rs.randint(0, 1000, batch).astype(np.float32))
 
+    def sync(loss):
+        # on the tunneled backend block_until_ready can return before the
+        # device finishes; fetching the scalar is the only true sync
+        return float(loss)
+
     log(f"compiling train step (batch={batch}, {dtype}) ...")
     t0 = time.time()
-    loss = trainer.step(data, label)
-    loss.block_until_ready()
+    loss_val = sync(trainer.step(data, label))
     log(f"first step (compile) took {time.time() - t0:.1f}s, "
-        f"loss={float(loss):.3f}")
-    for _ in range(warmup - 1):
-        loss = trainer.step(data, label)
-    loss.block_until_ready()
+        f"loss={loss_val:.3f}")
+    t0 = time.time()
+    for _ in range(warmup):
+        sync(trainer.step(data, label))
+    step_est = (time.time() - t0) / max(warmup, 1)
+    if iters is None:
+        # enough steps for a stable number, capped at ~180s of measurement
+        iters = max(3, min(10, int(180.0 / max(step_est, 1e-3))))
+    log(f"~{step_est:.2f}s/step -> {iters} timed iters")
 
     t0 = time.perf_counter()
-    for _ in range(iters):
-        loss = trainer.step(data, label)
-    loss.block_until_ready()
+    for _ in range(iters - 1):
+        trainer.step(data, label)
+    sync(trainer.step(data, label))
     dt = time.perf_counter() - t0
     imgs_per_sec = batch * iters / dt
     log(f"{imgs_per_sec:.1f} img/s over {iters} steps "
@@ -91,9 +105,18 @@ def run(batch=128, warmup=3, iters=10, dtype="bfloat16"):
     return imgs_per_sec
 
 
+def _enable_compile_cache():
+    """Persistent XLA compilation cache: full-graph ResNet-50 compiles
+    take ~15 min through the tunnel; the cache cuts reruns to seconds."""
+    from mxnet_tpu.util import enable_compile_cache
+    if not enable_compile_cache():
+        log("compile cache unavailable")
+
+
 def main():
     if not _init_backend():
         os._exit(0)
+    _enable_compile_cache()
     batches = [int(b) for b in
                os.environ.get("MXTPU_BENCH_BATCHES", "128,64,32").split(",")]
     last_err = None

@@ -1,3 +1,5 @@
 """mx.io — data iterators (ref: python/mxnet/io/__init__.py)."""
 from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, CSVIter,  # noqa
                  MNISTIter, ResizeIter, PrefetchingIter)
+from .image_record import (ImageRecordIter, ImageDetRecordIter,  # noqa
+                           LibSVMIter)

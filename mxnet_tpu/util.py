@@ -49,3 +49,21 @@ def getenv(name):
 
 def setenv(name, value):
     os.environ[name] = value
+
+
+def enable_compile_cache(cache_dir=None):
+    """Persistent XLA compilation cache (whole-graph compiles through the
+    TPU tunnel are slow; reruns hit the cache). Shared by bench.py and
+    __graft_entry__.py; MXTPU_COMPILE_CACHE overrides the location."""
+    try:
+        import jax
+        if cache_dir is None:
+            cache_dir = os.environ.get(
+                "MXTPU_COMPILE_CACHE",
+                os.path.join(os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))), ".jax_cache"))
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        return True
+    except Exception:
+        return False

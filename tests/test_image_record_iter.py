@@ -1,0 +1,152 @@
+"""ImageRecordIter / ImageDetRecordIter / LibSVMIter
+(ref: tests/python/unittest/test_io.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import io as mxio
+from mxnet_tpu import recordio
+from mxnet_tpu.base import MXNetError
+
+
+def _make_rec(path, n, size=12, det=False, seed=0):
+    rs = np.random.RandomState(seed)
+    writer = recordio.MXRecordIO(str(path), "w")
+    for i in range(n):
+        img = rs.randint(0, 255, (size, size, 3), np.uint8)
+        if det:
+            # [header_width=2, obj_width=5, obj(id,x1,y1,x2,y2) x n_obj]
+            n_obj = 1 + i % 3
+            objs = []
+            for j in range(n_obj):
+                objs += [float(j), 0.1, 0.1, 0.5, 0.5]
+            label = np.array([2, 5] + objs, np.float32)
+        else:
+            label = float(i % 10)
+        writer.write(recordio.pack_img(
+            recordio.IRHeader(0, label, i, 0), img, quality=95))
+    writer.close()
+
+
+def test_image_record_iter_basic(tmp_path):
+    rec = tmp_path / "d.rec"
+    _make_rec(rec, 10)
+    it = mxio.ImageRecordIter(path_imgrec=str(rec), data_shape=(3, 8, 8),
+                              batch_size=4, resize=8, rand_crop=False,
+                              rand_mirror=False, preprocess_threads=2)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (4, 3, 8, 8)
+    assert batches[0].label[0].shape == (4,)
+    assert batches[-1].pad == 2  # 10 % 4
+    # labels are the class ids written above (order preserved, no shuffle)
+    lab = np.concatenate([b.label[0].asnumpy() for b in batches])
+    assert list(lab[:10]) == [float(i % 10) for i in range(10)]
+    it.reset()
+    assert next(it).data[0].shape == (4, 3, 8, 8)
+
+
+def test_image_record_iter_sharding(tmp_path):
+    rec = tmp_path / "d.rec"
+    _make_rec(rec, 8)
+    seen = []
+    for part in range(2):
+        it = mxio.ImageRecordIter(path_imgrec=str(rec),
+                                  data_shape=(3, 8, 8), batch_size=4,
+                                  resize=8, part_index=part, num_parts=2)
+        for b in it:
+            seen.extend(b.label[0].asnumpy()[:4 - b.pad].tolist())
+    # the two shards together cover all 8 records exactly once
+    assert sorted(seen) == [float(i) for i in range(8)]
+
+
+def test_image_record_iter_mean_std(tmp_path):
+    rec = tmp_path / "d.rec"
+    _make_rec(rec, 4)
+    it = mxio.ImageRecordIter(path_imgrec=str(rec), data_shape=(3, 8, 8),
+                              batch_size=4, resize=8,
+                              mean_r=123.0, mean_g=117.0, mean_b=104.0,
+                              std_r=58.0, std_g=57.0, std_b=57.0)
+    b = next(it)
+    # normalized data should be roughly centered
+    assert abs(float(b.data[0].asnumpy().mean())) < 2.0
+
+
+def test_image_det_record_iter(tmp_path):
+    rec = tmp_path / "det.rec"
+    _make_rec(rec, 6, det=True)
+    it = mxio.ImageDetRecordIter(path_imgrec=str(rec), data_shape=(3, 8, 8),
+                                 batch_size=3, resize=8)
+    b = next(it)
+    lab = b.label[0].asnumpy()
+    assert lab.ndim == 3 and lab.shape[0] == 3 and lab.shape[2] == 5
+    # record i has 1 + i%3 objects; padding rows are -1
+    assert (lab[0, 0] != -1).all()
+    assert (lab[0, 1:] == -1).all()
+    assert (lab[1, :2, 0] == [0.0, 1.0]).all()
+
+
+def test_libsvm_iter(tmp_path):
+    f = tmp_path / "d.libsvm"
+    f.write_text("1 0:1.5 3:2.0\n"
+                 "0 1:0.5\n"
+                 "1 2:1.0 3:0.25\n")
+    it = mxio.LibSVMIter(data_libsvm=str(f), data_shape=(4,), batch_size=2)
+    b1 = next(it)
+    dense = b1.data[0].asnumpy() if hasattr(b1.data[0], "asnumpy") else None
+    np.testing.assert_allclose(dense, [[1.5, 0, 0, 2.0], [0, 0.5, 0, 0]])
+    np.testing.assert_allclose(b1.label[0].asnumpy(), [1.0, 0.0])
+    b2 = next(it)
+    assert b2.pad == 1
+    np.testing.assert_allclose(b2.data[0].asnumpy()[0], [0, 0, 1.0, 0.25])
+    with pytest.raises(StopIteration):
+        next(it)
+    it.reset()
+    assert next(it).pad == 0
+
+
+def test_libsvm_iter_index_out_of_range(tmp_path):
+    f = tmp_path / "bad.libsvm"
+    f.write_text("1 7:1.0\n")
+    with pytest.raises(MXNetError, match="data_shape"):
+        mxio.LibSVMIter(data_libsvm=str(f), data_shape=(4,), batch_size=1)
+
+
+def test_image_record_iter_std_only(tmp_path):
+    rec = tmp_path / "d.rec"
+    _make_rec(rec, 4)
+    it = mxio.ImageRecordIter(path_imgrec=str(rec), data_shape=(3, 8, 8),
+                              batch_size=4, resize=8,
+                              std_r=58.0, std_g=57.0, std_b=57.0)
+    b = next(it)
+    # pixels in [0,255] divided by ~57 -> values < 5
+    assert float(b.data[0].asnumpy().max()) < 6.0
+    it.close()
+
+
+def test_image_record_iter_shuffle_seed(tmp_path):
+    rec = tmp_path / "d.rec"
+    _make_rec(rec, 16)
+
+    def order(seed):
+        it = mxio.ImageRecordIter(path_imgrec=str(rec),
+                                  data_shape=(3, 8, 8), batch_size=16,
+                                  resize=8, shuffle=True, seed=seed)
+        lab = next(it).label[0].asnumpy().tolist()
+        it.close()
+        return lab
+
+    assert order(1) == order(1)
+    assert order(1) != order(2)
+
+
+def test_libsvm_iter_multilabel(tmp_path):
+    d = tmp_path / "d.libsvm"
+    d.write_text("0 0:1.0\n0 1:1.0\n")
+    l = tmp_path / "l.libsvm"
+    l.write_text("1 0 1\n0 1 0\n")
+    it = mxio.LibSVMIter(data_libsvm=str(d), data_shape=(2,), batch_size=2,
+                         label_libsvm=str(l), label_shape=(3,))
+    b = next(it)
+    np.testing.assert_allclose(b.label[0].asnumpy(),
+                               [[1, 0, 1], [0, 1, 0]])
