@@ -3,7 +3,9 @@
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 Baseline: 298.51 img/s — MXNet ResNet-50 training, batch 32 fp32, 1x V100
 (BASELINE.md / docs/faq/perf.md:227-237). The whole train step (fwd+bwd+SGD
-momentum update, bf16 compute) is one fused XLA program with donated buffers.
+momentum update) is one fused XLA program with donated buffers; compute
+dtype comes from MXTPU_BENCH_DTYPE (default float32 — bf16 is pathologically
+slow through the axon relay) and is recorded in the output JSON.
 """
 import json
 import os
@@ -91,7 +93,9 @@ def run(batch=128, warmup=1, iters=None, dtype=None):
     step_est = (time.time() - t0) / max(warmup, 1)
     if iters is None:
         # enough steps for a stable number, capped at ~180s of measurement
-        iters = max(3, min(10, int(180.0 / max(step_est, 1e-3))))
+        # (floor 2 keeps multi-minute steps from blowing the time budget)
+        iters = max(2 if step_est > 120 else 3,
+                    min(10, int(180.0 / max(step_est, 1e-3))))
     log(f"~{step_est:.2f}s/step -> {iters} timed iters")
 
     t0 = time.perf_counter()
@@ -128,6 +132,8 @@ def main():
                 "value": round(value, 2),
                 "unit": "img/s",
                 "vs_baseline": round(value / BASELINE_IMGS_PER_SEC, 3),
+                "dtype": os.environ.get("MXTPU_BENCH_DTYPE", "float32"),
+                "batch": batch,
             }))
             return
         except Exception as e:  # OOM or backend issue: try smaller batch

@@ -1,0 +1,93 @@
+"""Model-zoo throughput benchmark
+(ref: benchmark/python/gluon/benchmark_gluon.py — per-model inference and
+training img/s across the vision zoo)."""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import numpy as np
+
+
+def score(model_name, batch_size, image_shape, n_iter, train):
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.gluon.model_zoo.vision import get_model
+    from mxnet_tpu.parallel import SPMDTrainer
+    from mxnet_tpu.ndarray.ndarray import from_jax
+
+    mx.random.seed(0)
+    net = get_model(model_name)
+    net.initialize(mx.init.Xavier())
+    rs = np.random.RandomState(0)
+    data = jnp.asarray(rs.randn(batch_size, *image_shape)
+                       .astype(np.float32))
+
+    if train:
+        label = jnp.asarray(rs.randint(0, 1000, batch_size)
+                            .astype(np.float32))
+        tr = SPMDTrainer(net, gloss.SoftmaxCrossEntropyLoss(), mesh=None,
+                         optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.01})
+        float(tr.step(data, label))  # compile
+        t0 = time.time()
+        for _ in range(n_iter - 1):
+            tr.step(data, label)
+        float(tr.step(data, label))
+    else:
+        with autograd.pause():
+            net._imperative_call(from_jax(data[:1]))  # resolve shapes
+        params = [p for _, p in sorted(net.collect_params().items())]
+        pa = tuple(p._data._data for p in params)
+
+        def fwd(pa, x):
+            orig = []
+            for p, a in zip(params, pa):
+                orig.append(p._data._data)
+                p._data._data = a
+            try:
+                with autograd.pause():
+                    return net._imperative_call(from_jax(x))._data
+            finally:
+                for p, o in zip(params, orig):
+                    p._data._data = o
+
+        jf = jax.jit(fwd)
+        float(jf(pa, data).sum())  # compile
+        t0 = time.time()
+        for _ in range(n_iter - 1):
+            out = jf(pa, data)
+        float(jf(pa, data).sum())
+    dt = time.time() - t0
+    return batch_size * n_iter / dt
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--models", default="resnet18_v1,mobilenet_v2_1_0")
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--image-shape", default="3,224,224")
+    ap.add_argument("--num-iters", type=int, default=10)
+    ap.add_argument("--mode", choices=["inference", "training", "both"],
+                    default="both")
+    args = ap.parse_args()
+    shape = tuple(int(x) for x in args.image_shape.split(","))
+    for name in args.models.split(","):
+        if args.mode in ("inference", "both"):
+            ips = score(name, args.batch_size, shape, args.num_iters, False)
+            print(f"{name} inference: {ips:.1f} img/s "
+                  f"(batch {args.batch_size})", flush=True)
+        if args.mode in ("training", "both"):
+            ips = score(name, args.batch_size, shape, args.num_iters, True)
+            print(f"{name} training: {ips:.1f} img/s "
+                  f"(batch {args.batch_size})", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,128 @@
+"""BASELINE config #2: ImageNet-class training
+(ref: example/image-classification/train_imagenet.py).
+
+Data comes from RecordIO shards through ImageRecordIter (the reference's
+path), or --benchmark 1 uses synthetic batches (the reference's
+train_imagenet --benchmark flag) so throughput is measurable without the
+dataset. Training runs the fused SPMD path: the whole
+fwd+bwd+SGD-momentum step is one XLA program over the device mesh, with
+ImageRecordIter sharding by part_index/num_parts for multi-host.
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+
+def get_net(network, classes=1000):
+    from mxnet_tpu.gluon.model_zoo import vision as models
+    factory = {
+        "resnet-18": models.resnet18_v1, "resnet-34": models.resnet34_v1,
+        "resnet-50": models.resnet50_v1, "resnet-101": models.resnet101_v1,
+        "resnet-152": models.resnet152_v1, "vgg-16": models.vgg16,
+        "mobilenet-v2": models.mobilenet_v2_1_0,
+        "inception-v3": models.inception_v3,
+    }
+    if network not in factory:
+        raise SystemExit(f"unknown network {network}; have "
+                         f"{sorted(factory)}")
+    return factory[network](classes=classes)
+
+
+def synthetic_batches(batch_size, image_shape, num_batches):
+    rs = np.random.RandomState(0)
+    data = rs.randn(batch_size, *image_shape).astype(np.float32)
+    label = rs.randint(0, 1000, batch_size).astype(np.float32)
+    for _ in range(num_batches):
+        yield data, label
+
+
+def rec_batches(args):
+    from mxnet_tpu.io import ImageRecordIter
+    c, h, w = args.image_shape
+    it = ImageRecordIter(
+        path_imgrec=args.data_train, data_shape=(c, h, w),
+        batch_size=args.batch_size, shuffle=True,
+        rand_crop=True, rand_mirror=True, resize=max(h, w) + 32,
+        mean_r=123.68, mean_g=116.779, mean_b=103.939,
+        std_r=58.393, std_g=57.12, std_b=57.375,
+        preprocess_threads=args.data_nthreads,
+        part_index=args.part_index, num_parts=args.num_parts)
+    for batch in it:
+        yield batch.data[0].asnumpy(), batch.label[0].asnumpy()
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--network", default="resnet-50")
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--num-epochs", type=int, default=1)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--mom", type=float, default=0.9)
+    ap.add_argument("--wd", type=float, default=1e-4)
+    ap.add_argument("--data-train", default=None,
+                    help="RecordIO file (im2rec output)")
+    ap.add_argument("--data-nthreads", type=int, default=4)
+    ap.add_argument("--image-shape", default="3,224,224")
+    ap.add_argument("--benchmark", type=int, default=0,
+                    help="1: synthetic data, report img/s only")
+    ap.add_argument("--num-batches", type=int, default=20,
+                    help="batches per epoch in benchmark mode")
+    ap.add_argument("--dtype", default="float32",
+                    choices=["float32", "bfloat16"])
+    ap.add_argument("--kv-store", default="device",
+                    help="device|dist_sync (dist uses MXTPU_* env)")
+    ap.add_argument("--part-index", type=int, default=0)
+    ap.add_argument("--num-parts", type=int, default=1)
+    ap.add_argument("--disp-batches", type=int, default=10)
+    ap.add_argument("--model-prefix", default=None)
+    args = ap.parse_args()
+    args.image_shape = tuple(int(x) for x in args.image_shape.split(","))
+
+    if args.kv_store.startswith("dist"):
+        from mxnet_tpu.kvstore_server import init_distributed
+        init_distributed()
+
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.parallel import SPMDTrainer, auto_mesh
+
+    mx.random.seed(0)
+    net = get_net(args.network)
+    net.initialize(mx.init.Xavier())
+    mesh = auto_mesh()
+    trainer = SPMDTrainer(
+        net, gloss.SoftmaxCrossEntropyLoss(), mesh=mesh, optimizer="sgd",
+        optimizer_params={"learning_rate": args.lr, "momentum": args.mom,
+                          "wd": args.wd},
+        dtype=jnp.bfloat16 if args.dtype == "bfloat16" else None)
+
+    for epoch in range(args.num_epochs):
+        batches = synthetic_batches(args.batch_size, args.image_shape,
+                                    args.num_batches) \
+            if args.benchmark or not args.data_train else rec_batches(args)
+        t0 = time.time()
+        n_img = 0
+        for i, (data, label) in enumerate(batches):
+            loss = trainer.step(jnp.asarray(data), jnp.asarray(label))
+            n_img += len(data)
+            if (i + 1) % args.disp_batches == 0:
+                dt = time.time() - t0
+                print(f"epoch {epoch} batch {i + 1}: "
+                      f"loss={float(loss):.3f} {n_img / dt:.1f} img/s",
+                      flush=True)
+        dt = time.time() - t0
+        print(f"epoch {epoch}: {n_img} images in {dt:.1f}s "
+              f"({n_img / dt:.1f} img/s)", flush=True)
+        if args.model_prefix:
+            net.export(args.model_prefix, epoch=epoch)
+
+
+if __name__ == "__main__":
+    main()

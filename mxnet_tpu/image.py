@@ -279,6 +279,23 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
     return auglist
 
 
+def decode_and_augment(rec, auglist):
+    """Shared per-record pipeline: unpack -> augment -> CHW float32.
+
+    Used by image.ImageIter and io.ImageRecordIter so the decode path
+    exists exactly once. Returns (chw_array, label_array)."""
+    from .recordio import unpack_img
+    from .ndarray import ndarray as _nd2
+    header, img = unpack_img(rec)
+    x = _nd2.array(img.astype(_np.float32))
+    for aug in auglist:
+        x = aug(x)
+    arr = x.asnumpy()
+    if arr.ndim == 3 and arr.shape[2] in (1, 3):
+        arr = arr.transpose(2, 0, 1)
+    return arr, _np.asarray(header.label, _np.float32)
+
+
 class ImageIter(DataIter):
     """Image iterator over a .rec (npy-payload) or image list
     (ref: image.py ImageIter; the C++ fast path is ImageRecordIter via
@@ -313,7 +330,6 @@ class ImageIter(DataIter):
         self._pipe.reset()
 
     def next(self):
-        from .recordio import unpack_img
         c, h, w = self.data_shape
         batch = _np.zeros((self.batch_size, c, h, w), _np.float32)
         labels = _np.zeros((self.batch_size,), _np.float32)
@@ -324,16 +340,10 @@ class ImageIter(DataIter):
                 if i == 0:
                     raise StopIteration
                 break  # partial final batch: pad with wrap
-            header, img = unpack_img(rec)
-            x = _nd.array(img.astype(_np.float32))
-            for aug in self.auglist:
-                x = aug(x)
-            arr = x.asnumpy()
-            if arr.ndim == 3 and arr.shape[2] in (1, 3):
-                arr = arr.transpose(2, 0, 1)
+            arr, label = decode_and_augment(rec, self.auglist)
             batch[i] = arr
-            labels[i] = float(header.label) if _np.isscalar(header.label) \
-                or getattr(header.label, "size", 1) == 1 else header.label[0]
+            labels[i] = float(label) if label.size == 1 \
+                else float(label.reshape(-1)[0])
             i += 1
         return DataBatch([_nd.array(batch)], [_nd.array(labels)],
                          pad=self.batch_size - i)

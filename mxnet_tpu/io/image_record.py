@@ -57,12 +57,19 @@ class ImageRecordIter(DataIter):
             aug["std"] = _np.asarray(std, _np.float32)
         aug.pop("mean_a", None)
         aug.pop("std_a", None)
-        # accepted-but-inert reference knobs (perf/IO tuning)
-        for k in ("shuffle_chunk_size", "shuffle_chunk_seed", "verbose",
-                  "num_decode_threads", "prefetch_buffer", "dtype",
-                  "max_random_scale", "min_random_scale"):
-            aug.pop(k, None)
-        self.auglist = CreateAugmenter(self.data_shape, **aug)
+        # forward only kwargs CreateAugmenter implements; the reference
+        # accepts many more tuning/augmentation knobs — drop them with a
+        # notice rather than crash existing training scripts
+        import inspect
+        import logging
+        known = set(inspect.signature(CreateAugmenter).parameters)
+        dropped = sorted(k for k in aug if k not in known)
+        if dropped:
+            logging.getLogger("mxnet_tpu").warning(
+                "ImageRecordIter: ignoring unimplemented augmentation "
+                "kwargs %s", dropped)
+        self.auglist = CreateAugmenter(
+            self.data_shape, **{k: v for k, v in aug.items() if k in known})
         from .record_io import RecordPipeline
         self._pipe = RecordPipeline(path_imgrec,
                                     num_threads=int(preprocess_threads),
@@ -102,16 +109,9 @@ class ImageRecordIter(DataIter):
         self._pipe.reset()
 
     def _decode_one(self, rec):
-        from ..recordio import unpack_img
-        header, img = unpack_img(rec)
-        x = _nd.array(img.astype(_np.float32))
-        for aug in self.auglist:
-            x = aug(x)
-        arr = x.asnumpy()
-        if arr.ndim == 3 and arr.shape[2] in (1, 3):
-            arr = arr.transpose(2, 0, 1)
-        label = _np.atleast_1d(_np.asarray(header.label, _np.float32))
-        return arr, label
+        from ..image import decode_and_augment
+        arr, label = decode_and_augment(rec, self.auglist)
+        return arr, _np.atleast_1d(label)
 
     def next(self):
         recs = []
@@ -143,13 +143,39 @@ class ImageDetRecordIter(ImageRecordIter):
     label is [header_width, obj_width, <extra header>, obj0..., obj1...];
     emitted labels are (batch, max_objs, obj_width) padded with -1."""
 
+    # geometric augmenters would move pixels without moving the boxes;
+    # only box-preserving ones are allowed until CreateDetAugmenter-style
+    # joint transforms exist
+    # ('resize' is fine: the det pipeline force-resizes the whole image to
+    # data_shape, which preserves normalized box coords)
+    _GEOMETRIC_KWARGS = ("rand_crop", "rand_mirror", "rand_resize",
+                         "max_rotate_angle", "max_aspect_ratio",
+                         "max_shear_ratio", "rand_pad")
+
     def __init__(self, path_imgrec, data_shape, batch_size,
                  label_pad_width=0, label_pad_value=-1.0, **kwargs):
         kwargs.setdefault("label_name", "label")
+        bad = [k for k in self._GEOMETRIC_KWARGS if kwargs.get(k)]
+        check(not bad,
+              f"ImageDetRecordIter: geometric augmenters {bad} would "
+              "desync images from their boxes; only color/normalize "
+              "augmentation is supported (boxes are not transformed)")
         super().__init__(path_imgrec, data_shape, batch_size,
                          label_width=1, **kwargs)
+        # exact resize to data_shape keeps normalized box coords valid
+        # (CreateAugmenter's center-crop default would not)
+        from ..image import ForceResizeAug, CastAug
+        self.auglist = [ForceResizeAug((self.data_shape[2],
+                                        self.data_shape[1])), CastAug()] + \
+            [a for a in self.auglist
+             if type(a).__name__ in ("ColorNormalizeAug", "ColorJitterAug",
+                                     "BrightnessJitterAug",
+                                     "ContrastJitterAug",
+                                     "SaturationJitterAug", "LightingAug")]
         self._label_pad_width = int(label_pad_width)
         self._label_pad_value = float(label_pad_value)
+        # monotone: label shape only grows, so recompiles are bounded
+        self._max_objs = max(self._label_pad_width, 1)
 
     @property
     def provide_label(self):
@@ -189,9 +215,10 @@ class ImageDetRecordIter(ImageRecordIter):
         check(len(widths) == 1,
               f"inconsistent detection obj_width across records: {widths}")
         obj_width = widths.pop()
-        max_objs = max(self._label_pad_width,
-                       max((l.shape[0] for l in det_labels), default=1), 1)
-        out = _np.full((self.batch_size, max_objs, obj_width),
+        self._max_objs = max(self._max_objs,
+                             max((l.shape[0] for l in det_labels),
+                                 default=1))
+        out = _np.full((self.batch_size, self._max_objs, obj_width),
                        self._label_pad_value, _np.float32)
         for i, l in enumerate(det_labels):
             if l.size:
@@ -219,12 +246,12 @@ class LibSVMIter(DataIter):
         self._dim = int(data_shape)
         self._data_name = data_name
         self._label_name = label_name
-        rows, labels = self._parse(data_libsvm)
+        values, indices, indptr, labels = self._parse(data_libsvm)
         if label_libsvm is not None:
             labels = self._parse_label_file(label_libsvm)
-            check(len(labels) == len(rows),
+            check(len(labels) == len(indptr) - 1,
                   f"label_libsvm has {len(labels)} rows, data has "
-                  f"{len(rows)}")
+                  f"{len(indptr) - 1}")
         self._label_width = 1
         if label_shape is not None:
             self._label_width = int(label_shape[0] if
@@ -232,8 +259,21 @@ class LibSVMIter(DataIter):
                                     else label_shape)
         check(int(num_parts) >= 1 and 0 <= int(part_index) < int(num_parts),
               "bad part_index/num_parts")
-        self._rows = rows[int(part_index)::int(num_parts)]
-        self._labels = labels[int(part_index)::int(num_parts)]
+        # keep only this part's rows (compact flat-CSR storage)
+        keep = list(range(int(part_index), len(indptr) - 1,
+                          int(num_parts)))
+        vs, ins, ptr = [], [], [0]
+        for r in keep:
+            lo, hi = indptr[r], indptr[r + 1]
+            vs.append(values[lo:hi])
+            ins.append(indices[lo:hi])
+            ptr.append(ptr[-1] + (hi - lo))
+        self._values = _np.concatenate(vs) if vs else \
+            _np.zeros((0,), _np.float32)
+        self._indices = _np.concatenate(ins) if ins else \
+            _np.zeros((0,), _np.int64)
+        self._indptr = _np.asarray(ptr, _np.int64)
+        self._labels = [labels[r] for r in keep]
         self._cursor = 0
 
     @staticmethod
@@ -248,7 +288,9 @@ class LibSVMIter(DataIter):
         return labels
 
     def _parse(self, path):
-        rows, labels = [], []
+        """Stream the file into flat CSR arrays (compact: one numpy
+        value/index per nonzero, not per-row Python objects)."""
+        values, indices, indptr, labels = [], [], [0], []
         with open(path) as f:
             for line in f:
                 parts = line.split()
@@ -264,8 +306,12 @@ class LibSVMIter(DataIter):
                             f"libsvm feature index {idx} >= data_shape "
                             f"{self._dim}")
                     feats.append((idx, float(val_s)))
-                rows.append(feats)
-        return rows, labels
+                feats.sort()
+                indices.extend(i for i, _ in feats)
+                values.extend(v for _, v in feats)
+                indptr.append(len(indices))
+        return (_np.asarray(values, _np.float32),
+                _np.asarray(indices, _np.int64), indptr, labels)
 
     @property
     def provide_data(self):
@@ -280,28 +326,26 @@ class LibSVMIter(DataIter):
     def reset(self):
         self._cursor = 0
 
+    def __len__(self):
+        return len(self._indptr) - 1
+
     def next(self):
-        if self._cursor >= len(self._rows):
+        n_rows = len(self._indptr) - 1
+        if self._cursor >= n_rows:
             raise StopIteration
-        take = self._rows[self._cursor:self._cursor + self.batch_size]
-        labs = self._labels[self._cursor:self._cursor + self.batch_size]
-        self._cursor += len(take)
-        pad = self.batch_size - len(take)
-        indptr = [0]
-        indices: List[int] = []
-        values: List[float] = []
-        for feats in take:
-            for idx, val in sorted(feats):
-                indices.append(idx)
-                values.append(val)
-            indptr.append(len(indices))
-        for _ in range(pad):
-            indptr.append(len(indices))
+        lo_row = self._cursor
+        hi_row = min(lo_row + self.batch_size, n_rows)
+        labs = self._labels[lo_row:hi_row]
+        self._cursor = hi_row
+        pad = self.batch_size - (hi_row - lo_row)
+        lo, hi = self._indptr[lo_row], self._indptr[hi_row]
+        indptr = self._indptr[lo_row:hi_row + 1] - lo
+        if pad:
+            indptr = _np.concatenate(
+                [indptr, _np.full((pad,), indptr[-1], _np.int64)])
         from ..ndarray import sparse as _sp
         data = _sp.csr_matrix(
-            (_np.asarray(values, _np.float32),
-             _np.asarray(indices, _np.int64),
-             _np.asarray(indptr, _np.int64)),
+            (self._values[lo:hi], self._indices[lo:hi], indptr),
             shape=(self.batch_size, self._dim))
         labels = _np.zeros((self.batch_size, self._label_width),
                            _np.float32)
