@@ -62,8 +62,13 @@ class ImageRecordIter(DataIter):
         # notice rather than crash existing training scripts
         import inspect
         import logging
+        # the reference's IO/perf tuning knobs: intentionally inert here
+        _INERT = {"shuffle_chunk_size", "shuffle_chunk_seed", "verbose",
+                  "num_decode_threads", "prefetch_buffer", "dtype",
+                  "max_random_scale", "min_random_scale"}
         known = set(inspect.signature(CreateAugmenter).parameters)
-        dropped = sorted(k for k in aug if k not in known)
+        dropped = sorted(k for k in aug
+                         if k not in known and k not in _INERT)
         if dropped:
             logging.getLogger("mxnet_tpu").warning(
                 "ImageRecordIter: ignoring unimplemented augmentation "
@@ -155,6 +160,7 @@ class ImageDetRecordIter(ImageRecordIter):
     def __init__(self, path_imgrec, data_shape, batch_size,
                  label_pad_width=0, label_pad_value=-1.0, **kwargs):
         kwargs.setdefault("label_name", "label")
+        kwargs.pop("label_width", None)  # det labels are variable-width
         bad = [k for k in self._GEOMETRIC_KWARGS if kwargs.get(k)]
         check(not bad,
               f"ImageDetRecordIter: geometric augmenters {bad} would "
@@ -164,14 +170,12 @@ class ImageDetRecordIter(ImageRecordIter):
                          label_width=1, **kwargs)
         # exact resize to data_shape keeps normalized box coords valid
         # (CreateAugmenter's center-crop default would not)
-        from ..image import ForceResizeAug, CastAug
+        from ..image import ForceResizeAug
         self.auglist = [ForceResizeAug((self.data_shape[2],
-                                        self.data_shape[1])), CastAug()] + \
+                                        self.data_shape[1]))] + \
             [a for a in self.auglist
-             if type(a).__name__ in ("ColorNormalizeAug", "ColorJitterAug",
-                                     "BrightnessJitterAug",
-                                     "ContrastJitterAug",
-                                     "SaturationJitterAug", "LightingAug")]
+             if type(a).__name__ in ("ColorJitterAug", "LightingAug",
+                                     "ColorNormalizeAug")]
         self._label_pad_width = int(label_pad_width)
         self._label_pad_value = float(label_pad_value)
         # monotone: label shape only grows, so recompiles are bounded
@@ -259,21 +263,27 @@ class LibSVMIter(DataIter):
                                     else label_shape)
         check(int(num_parts) >= 1 and 0 <= int(part_index) < int(num_parts),
               "bad part_index/num_parts")
-        # keep only this part's rows (compact flat-CSR storage)
-        keep = list(range(int(part_index), len(indptr) - 1,
-                          int(num_parts)))
-        vs, ins, ptr = [], [], [0]
-        for r in keep:
-            lo, hi = indptr[r], indptr[r + 1]
-            vs.append(values[lo:hi])
-            ins.append(indices[lo:hi])
-            ptr.append(ptr[-1] + (hi - lo))
-        self._values = _np.concatenate(vs) if vs else \
-            _np.zeros((0,), _np.float32)
-        self._indices = _np.concatenate(ins) if ins else \
-            _np.zeros((0,), _np.int64)
-        self._indptr = _np.asarray(ptr, _np.int64)
-        self._labels = [labels[r] for r in keep]
+        if int(num_parts) == 1:
+            self._values = values
+            self._indices = indices
+            self._indptr = _np.asarray(indptr, _np.int64)
+            self._labels = labels
+        else:
+            # keep only this part's rows (compact flat-CSR storage)
+            keep = list(range(int(part_index), len(indptr) - 1,
+                              int(num_parts)))
+            vs, ins, ptr = [], [], [0]
+            for r in keep:
+                lo, hi = indptr[r], indptr[r + 1]
+                vs.append(values[lo:hi])
+                ins.append(indices[lo:hi])
+                ptr.append(ptr[-1] + (hi - lo))
+            self._values = _np.concatenate(vs) if vs else \
+                _np.zeros((0,), _np.float32)
+            self._indices = _np.concatenate(ins) if ins else \
+                _np.zeros((0,), _np.int64)
+            self._indptr = _np.asarray(ptr, _np.int64)
+            self._labels = [labels[r] for r in keep]
         self._cursor = 0
 
     @staticmethod

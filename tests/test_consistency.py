@@ -1,0 +1,54 @@
+"""Cross-dtype consistency sweep (ref: test_utils.py:1224
+check_consistency — the same computation cross-checked over ctx/dtype
+combos; the GPU suite re-runs the CPU suite this way by construction)."""
+import numpy as np
+import pytest
+
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import check_consistency
+
+
+def _r(shape, seed=0, scale=1.0):
+    return (np.random.RandomState(seed).randn(*shape) * scale) \
+        .astype(np.float32)
+
+
+CASES = [
+    ("conv", lambda x, w: nd.Convolution(x, w, kernel=(3, 3), num_filter=8,
+                                         pad=(1, 1), no_bias=True),
+     [_r((2, 4, 8, 8)), _r((8, 4, 3, 3), 1, 0.3)]),
+    ("fc", lambda x, w, b: nd.FullyConnected(x, w, b, num_hidden=8),
+     [_r((4, 6)), _r((8, 6), 1, 0.3), _r((8,), 2, 0.1)]),
+    ("pool", lambda x: nd.Pooling(x, kernel=(2, 2), stride=(2, 2),
+                                  pool_type="avg"),
+     [_r((2, 3, 8, 8))]),
+    ("softmax", lambda x: nd.softmax(x, axis=-1), [_r((4, 10))]),
+    ("layernorm", lambda x, g, b: nd.LayerNorm(x, g, b)[0],
+     [_r((4, 8)), np.ones(8, np.float32), np.zeros(8, np.float32)]),
+    ("dot", lambda a, b: nd.dot(a, b), [_r((4, 6)), _r((6, 3), 1)]),
+    ("sort", lambda x: nd.sort(x, axis=-1), [_r((4, 10))]),
+    ("norm", lambda x: nd.norm(x), [_r((4, 10))]),
+    ("take", lambda x: nd.take(x, nd.array(np.array([0., 2., 1.]))),
+     [_r((4, 5))]),
+]
+
+
+@pytest.mark.parametrize("name,fn,inputs", CASES,
+                         ids=[c[0] for c in CASES])
+def test_f32_f64_consistency(name, fn, inputs):
+    check_consistency(fn, inputs, dtypes=(np.float32, np.float64),
+                      rtol=1e-3, atol=1e-4)
+
+
+def test_bf16_f32_consistency_looser():
+    """bf16 runs of the same net must track f32 within bf16 precision."""
+    import jax.numpy as jnp
+    x = _r((2, 4, 8, 8), 3)
+    w = _r((8, 4, 3, 3), 4, 0.3)
+    f32 = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                         num_filter=8, pad=(1, 1), no_bias=True).asnumpy()
+    bf = nd.Convolution(nd.array(x).astype(jnp.bfloat16),
+                        nd.array(w).astype(jnp.bfloat16), kernel=(3, 3),
+                        num_filter=8, pad=(1, 1),
+                        no_bias=True).astype(np.float32).asnumpy()
+    np.testing.assert_allclose(f32, bf, rtol=5e-2, atol=5e-2)

@@ -150,3 +150,13 @@ def test_libsvm_iter_multilabel(tmp_path):
     b = next(it)
     np.testing.assert_allclose(b.label[0].asnumpy(),
                                [[1, 0, 1], [0, 1, 0]])
+
+
+def test_image_det_record_iter_label_width_kwarg(tmp_path):
+    # the parent-class kwarg must not collide (regression: TypeError)
+    rec = tmp_path / "det.rec"
+    _make_rec(rec, 3, det=True)
+    it = mxio.ImageDetRecordIter(path_imgrec=str(rec),
+                                 data_shape=(3, 8, 8), batch_size=3,
+                                 label_width=5)
+    assert next(it).label[0].asnumpy().shape[2] == 5

@@ -1,0 +1,41 @@
+#!/usr/bin/env python
+"""Parse training logs into (epoch, train-acc, val-acc, speed) tables
+(ref: tools/parse_log.py)."""
+import argparse
+import re
+import sys
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("logfile")
+    ap.add_argument("--format", choices=["markdown", "csv"],
+                    default="markdown")
+    args = ap.parse_args()
+    with open(args.logfile) as f:
+        text = f.read()
+    train = dict(re.findall(
+        r"Epoch\[(\d+)\].*?Train-accuracy=([\d.]+)", text))
+    val = dict(re.findall(
+        r"Epoch\[(\d+)\].*?Validation-accuracy=([\d.]+)", text))
+    speed = {}
+    for ep, sp in re.findall(r"Epoch\[(\d+)\].*?Speed: ([\d.]+)", text):
+        speed.setdefault(ep, []).append(float(sp))
+    epochs = sorted(set(train) | set(val) | set(speed), key=int)
+    if not epochs:
+        print("no epoch records found", file=sys.stderr)
+        return 1
+    sep = "," if args.format == "csv" else " | "
+    print(sep.join(["epoch", "train-acc", "val-acc", "speed(img/s)"]))
+    if args.format == "markdown":
+        print(" | ".join(["---"] * 4))
+    for ep in epochs:
+        sp = speed.get(ep)
+        print(sep.join([
+            ep, train.get(ep, "-"), val.get(ep, "-"),
+            f"{sum(sp) / len(sp):.1f}" if sp else "-"]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
