@@ -1,0 +1,28 @@
+// C++ frontend example (ref: cpp-package/example/inference/): load an
+// exported model and classify one input.
+#include <mxnet_tpu_cpp/predictor.hpp>
+
+#include <algorithm>
+#include <iostream>
+
+int main(int argc, char **argv) {
+  if (argc < 3) {
+    std::cerr << "usage: " << argv[0]
+              << " model-symbol.json model-0000.params\n";
+    return 2;
+  }
+  mxtpu::Predictor pred(argv[1], argv[2], {{"data", {2, 8}}});
+  std::vector<float> input(16);
+  for (size_t i = 0; i < input.size(); ++i) input[i] = 0.1f * i;
+  pred.SetInput("data", input);
+  pred.Forward();
+  auto shape = pred.GetOutputShape(0);
+  auto out = pred.GetOutput(0);
+  std::cout << "output shape:";
+  for (auto d : shape) std::cout << ' ' << d;
+  std::cout << "\nargmax: "
+            << (std::max_element(out.begin(), out.begin() + shape.back())
+                - out.begin())
+            << "\nfirst: " << out[0] << "\nCPP_OK\n";
+  return 0;
+}

@@ -1,0 +1,145 @@
+// Header-only C++ inference frontend (ref: cpp-package/include/mxnet-cpp/
+// — the reference generates a full C++ API from the op registry; the TPU
+// build's C surface is the predict API, so the C++ frontend is an RAII
+// wrapper over it: load an exported model (symbol-JSON + params), feed
+// float32 batches, read outputs).
+//
+// Usage:
+//   #include <mxnet_tpu_cpp/predictor.hpp>
+//   mxtpu::Predictor pred("m-symbol.json", "m-0000.params",
+//                         {{"data", {1, 3, 224, 224}}});
+//   pred.SetInput("data", buf);         // buf: float vector
+//   pred.Forward();
+//   std::vector<float> out = pred.GetOutput(0);
+//
+// Link against src/libmxtpu_predict.so (see examples/c_predict/README.md).
+#ifndef MXNET_TPU_CPP_PREDICTOR_HPP_
+#define MXNET_TPU_CPP_PREDICTOR_HPP_
+
+#include <cstdint>
+#include <fstream>
+#include <numeric>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+extern "C" {
+typedef unsigned int mx_uint;
+typedef float mx_float;
+typedef void *PredictorHandle;
+
+const char *MXGetLastError();
+int MXPredCreate(const char *symbol_json, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char **input_keys,
+                 const mx_uint *input_shape_indptr,
+                 const mx_uint *input_shape_data, PredictorHandle *out);
+int MXPredSetInput(PredictorHandle handle, const char *key,
+                   const mx_float *data, mx_uint size);
+int MXPredForward(PredictorHandle handle);
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                         mx_uint **shape_data, mx_uint *shape_ndim);
+int MXPredGetOutput(PredictorHandle handle, mx_uint index, mx_float *data,
+                    mx_uint size);
+int MXPredFree(PredictorHandle handle);
+}
+
+namespace mxtpu {
+
+class Predictor {
+ public:
+  using Shape = std::vector<mx_uint>;
+
+  Predictor(const std::string &symbol_json_path,
+            const std::string &params_path,
+            const std::vector<std::pair<std::string, Shape>> &inputs,
+            int dev_type = 1, int dev_id = 0) {
+    std::string sym = ReadFile(symbol_json_path);
+    std::string params = ReadFile(params_path);
+    std::vector<const char *> keys;
+    std::vector<mx_uint> indptr{0};
+    std::vector<mx_uint> shape_data;
+    for (const auto &kv : inputs) {
+      keys.push_back(kv.first.c_str());
+      for (mx_uint d : kv.second) shape_data.push_back(d);
+      indptr.push_back(static_cast<mx_uint>(shape_data.size()));
+    }
+    if (MXPredCreate(sym.c_str(), params.data(),
+                     static_cast<int>(params.size()), dev_type, dev_id,
+                     static_cast<mx_uint>(keys.size()), keys.data(),
+                     indptr.data(), shape_data.data(), &handle_) != 0) {
+      throw std::runtime_error(std::string("MXPredCreate failed: ") +
+                               MXGetLastError());
+    }
+  }
+
+  Predictor(const Predictor &) = delete;
+  Predictor &operator=(const Predictor &) = delete;
+  Predictor(Predictor &&other) noexcept : handle_(other.handle_) {
+    other.handle_ = nullptr;
+  }
+  Predictor &operator=(Predictor &&other) noexcept {
+    if (this != &other) {
+      if (handle_ != nullptr) MXPredFree(handle_);
+      handle_ = other.handle_;
+      other.handle_ = nullptr;
+    }
+    return *this;
+  }
+
+  ~Predictor() {
+    if (handle_ != nullptr) MXPredFree(handle_);
+  }
+
+  void SetInput(const std::string &key, const std::vector<mx_float> &data) {
+    Check(MXPredSetInput(handle_, key.c_str(), data.data(),
+                         static_cast<mx_uint>(data.size())),
+          "MXPredSetInput");
+  }
+
+  void Forward() { Check(MXPredForward(handle_), "MXPredForward"); }
+
+  Shape GetOutputShape(mx_uint index = 0) const {
+    mx_uint *shape = nullptr;
+    mx_uint ndim = 0;
+    Check(MXPredGetOutputShape(handle_, index, &shape, &ndim),
+          "MXPredGetOutputShape");
+    return Shape(shape, shape + ndim);
+  }
+
+  std::vector<mx_float> GetOutput(mx_uint index = 0) const {
+    Shape shape = GetOutputShape(index);
+    mx_uint total = std::accumulate(shape.begin(), shape.end(), 1u,
+                                    [](mx_uint a, mx_uint b) {
+                                      return a * b;
+                                    });
+    std::vector<mx_float> out(total);
+    Check(MXPredGetOutput(handle_, index, out.data(), total),
+          "MXPredGetOutput");
+    return out;
+  }
+
+ private:
+  static std::string ReadFile(const std::string &path) {
+    std::ifstream f(path, std::ios::binary);
+    if (!f) throw std::runtime_error("cannot open " + path);
+    std::ostringstream ss;
+    ss << f.rdbuf();
+    return ss.str();
+  }
+
+  static void Check(int rc, const char *what) {
+    if (rc != 0) {
+      throw std::runtime_error(std::string(what) + " failed: " +
+                               MXGetLastError());
+    }
+  }
+
+  PredictorHandle handle_ = nullptr;
+};
+
+}  // namespace mxtpu
+
+#endif  // MXNET_TPU_CPP_PREDICTOR_HPP_

@@ -62,11 +62,12 @@ class ImageRecordIter(DataIter):
         # notice rather than crash existing training scripts
         import inspect
         import logging
-        # the reference's IO/perf tuning knobs: intentionally inert here
+        # genuinely inert IO/perf tuning knobs (no data effect)
         _INERT = {"shuffle_chunk_size", "shuffle_chunk_seed", "verbose",
-                  "num_decode_threads", "prefetch_buffer", "dtype",
-                  "max_random_scale", "min_random_scale"}
+                  "num_decode_threads", "prefetch_buffer"}
         known = set(inspect.signature(CreateAugmenter).parameters)
+        # dtype/max_random_scale/... DO change the produced data: keep
+        # warning about those
         dropped = sorted(k for k in aug
                          if k not in known and k not in _INERT)
         if dropped:
