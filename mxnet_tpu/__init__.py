@@ -58,6 +58,7 @@ from . import attribute  # noqa: F401
 from .attribute import AttrScope  # noqa: F401
 from . import monitor  # noqa: F401
 from . import rtc  # noqa: F401
+from . import subgraph  # noqa: F401
 from .monitor import Monitor  # noqa: F401
 from . import visualization  # noqa: F401
 from . import visualization as viz  # noqa: F401

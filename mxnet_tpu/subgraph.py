@@ -1,0 +1,298 @@
+"""Subgraph partitioning framework.
+
+Reference: src/operator/subgraph/ — SubgraphProperty/SubgraphSelector
+(subgraph_property.h:93,162), MXNET_REGISTER_SUBGRAPH_PROPERTY (:208), and
+the partitioner (partition_graph.cc:316-430) that MKLDNN/TensorRT use to
+claim fusable regions.
+
+TPU redesign (SURVEY §2.1): "subgraph -> MKLDNN/TensorRT" generalizes to
+"subgraph -> one compiled XLA region". The default property fuses maximal
+connected regions into single graph nodes whose execution is one jitted
+program; custom properties express pattern fusions (conv+bn+relu, int8
+blocks) by overriding the selector. The partitioner is greedy-connected
+like the reference's: seed at a selected node, grow across edges the
+selector accepts, replace each region with one `_subgraph` op node
+carrying its sub-Symbol.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from .base import MXNetError, check
+
+__all__ = ["SubgraphSelector", "SubgraphProperty",
+           "register_subgraph_property", "get_subgraph_property",
+           "partition_graph", "list_subgraph_properties"]
+
+
+class SubgraphSelector:
+    """Decides which nodes join a subgraph
+    (ref: subgraph_property.h:93 SubgraphSelector)."""
+
+    def select(self, node) -> bool:
+        """May this node seed a new subgraph?"""
+        return False
+
+    def select_input(self, node, input_node) -> bool:
+        """Grow from `node` to its producer `input_node`?"""
+        return self.select(input_node)
+
+    def select_output(self, node, output_node) -> bool:
+        """Grow from `node` to its consumer `output_node`?"""
+        return self.select(output_node)
+
+
+class SubgraphProperty:
+    """A fusion strategy (ref: subgraph_property.h:162).
+
+    Subclasses override create_selector() and, optionally,
+    create_subgraph_node() to control how a claimed region executes.
+    """
+
+    def create_selector(self) -> SubgraphSelector:
+        raise NotImplementedError
+
+    def create_subgraph_node(self, sub_sym, input_names: List[str],
+                             index: int):
+        """Return the replacement node spec for a claimed region. The
+        default wraps the region in a `_subgraph` op that jit-executes
+        the sub-Symbol as one XLA program."""
+        attrs = {"__subgraph__": sub_sym,
+                 "__subgraph_inputs__": tuple(input_names)}
+        return ("_subgraph", attrs)
+
+
+_PROPERTIES: Dict[str, Callable[[], SubgraphProperty]] = {}
+
+
+def register_subgraph_property(name: str):
+    """(ref: MXNET_REGISTER_SUBGRAPH_PROPERTY)"""
+    def deco(cls):
+        _PROPERTIES[name] = cls
+        return cls
+    return deco
+
+
+def get_subgraph_property(name: str, **kwargs) -> SubgraphProperty:
+    if name not in _PROPERTIES:
+        raise MXNetError(
+            f"no subgraph property {name!r}; registered: "
+            f"{sorted(_PROPERTIES)}")
+    return _PROPERTIES[name](**kwargs)
+
+
+def list_subgraph_properties() -> List[str]:
+    return sorted(_PROPERTIES)
+
+
+# ---------------------------------------------------------------------------
+# the _subgraph op: executes a captured sub-Symbol as one jitted program
+# ---------------------------------------------------------------------------
+
+def _register_subgraph_op():
+    from .ops.registry import register
+
+    # NOTE: the symbolic executor special-cases _subgraph nodes and inlines
+    # them with the surrounding _walk's is_train/aux context (so fused
+    # BatchNorm/Dropout keep training semantics); this fn is the
+    # inference-mode fallback for any other invocation path.
+    @register("_subgraph", num_outputs=lambda n_in, params:
+              len(params["__subgraph__"]._outputs))
+    def _subgraph(*inputs, __subgraph__=None, __subgraph_inputs__=()):
+        from .symbol.executor import _walk
+        arg_map = dict(zip(__subgraph_inputs__, inputs))
+        outs = _walk(__subgraph__, arg_map, {}, False)
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+try:
+    _register_subgraph_op()
+except MXNetError:
+    pass  # already registered (module reload)
+
+
+# ---------------------------------------------------------------------------
+# partitioner (ref: partition_graph.cc:316-430)
+# ---------------------------------------------------------------------------
+
+def partition_graph(symbol, prop: SubgraphProperty):
+    """Replace every maximal selected region with one _subgraph node.
+
+    Returns a new Symbol; the input symbol is not modified.
+    """
+    from .symbol.symbol import Symbol, _Node, new_node_name
+    from .symbol import var as sym_var
+
+    sym = symbol.__copy__()
+    order = sym._topo()
+    consumers: Dict[int, List] = {}
+    for node in order:
+        for inp, _ in node.inputs:
+            consumers.setdefault(id(inp), []).append(node)
+
+    selector = prop.create_selector()
+    assigned: Dict[int, int] = {}     # node id -> region index
+    regions: List[List] = []
+
+    for node in order:
+        if node.is_variable or id(node) in assigned:
+            continue
+        if not selector.select(node):
+            continue
+        region = [node]
+        assigned[id(node)] = len(regions)
+        frontier = [node]
+        while frontier:
+            cur = frontier.pop()
+            for inp, _ in cur.inputs:
+                if inp.is_variable or id(inp) in assigned:
+                    continue
+                if selector.select_input(cur, inp):
+                    assigned[id(inp)] = len(regions)
+                    region.append(inp)
+                    frontier.append(inp)
+            for out in consumers.get(id(cur), []):
+                if id(out) in assigned:
+                    continue
+                if selector.select_output(cur, out):
+                    assigned[id(out)] = len(regions)
+                    region.append(out)
+                    frontier.append(out)
+        regions.append(region)
+
+    if not regions:
+        return sym
+
+    # fusing a region must not create a cycle: no path may leave the
+    # region and re-enter it. The reference splits offending regions
+    # (partition_graph.cc CheckCycle); here we shrink greedily — drop the
+    # topologically-last node until acyclic — which keeps most of the
+    # region fused instead of discarding it wholesale.
+    def is_cyclic(ids):
+        reach: Set[int] = set()
+        for node in order:
+            if id(node) in ids:
+                continue
+            if any(id(i) in ids or id(i) in reach for i, _ in node.inputs):
+                reach.add(id(node))
+        return any(id(i) in reach for n in order if id(n) in ids
+                   for i, _ in n.inputs)
+
+    safe_regions = []
+    for region in regions:
+        region = [n for n in order if id(n) in {id(r) for r in region}]
+        while len(region) > 1 and is_cyclic({id(n) for n in region}):
+            region.pop()  # drop topologically-last member
+        if region and not is_cyclic({id(n) for n in region}):
+            safe_regions.append(region)
+    regions = [r for r in safe_regions if r]
+    if not regions:
+        return sym
+
+    replaced: Dict[int, Tuple] = {}   # old node id -> (new node, out slot map)
+    for ridx, region in enumerate(regions):
+        ids = {id(n) for n in region}
+        region_sorted = [n for n in order if id(n) in ids]
+        # region inputs: edges from outside (vars included)
+        input_entries: List[Tuple] = []
+        input_names: List[str] = []
+        seen_inputs = {}
+        for n in region_sorted:
+            for inp, slot in n.inputs:
+                if id(inp) in ids:
+                    continue
+                key = (id(inp), slot)
+                if key not in seen_inputs:
+                    seen_inputs[key] = len(input_entries)
+                    input_entries.append((inp, slot))
+                    input_names.append(f"_sub{ridx}_in{len(input_names)}")
+        # region outputs: entries consumed outside (or graph heads)
+        head_ids = {(id(n), i) for n, i in sym._outputs}
+        out_entries: List[Tuple] = []
+        for n in region_sorted:
+            for i in range(n.num_outputs()):
+                used_outside = any(
+                    id(c) not in ids and any(id(ci) == id(n) and k == i
+                                             for ci, k in c.inputs)
+                    for c in consumers.get(id(n), [])) or \
+                    (id(n), i) in head_ids
+                if used_outside:
+                    out_entries.append((n, i))
+        if not out_entries:
+            continue
+        # build the sub-symbol over proxy variables
+        proxy_map = {}
+        for (inp, slot), name in zip(input_entries, input_names):
+            proxy_map[(id(inp), slot)] = sym_var(name)._outputs[0][0]
+        sub_nodes = {}
+        for n in region_sorted:
+            new_inputs = []
+            for inp, slot in n.inputs:
+                if id(inp) in ids:
+                    new_inputs.append((sub_nodes[id(inp)], slot))
+                else:
+                    new_inputs.append((proxy_map[(id(inp), slot)], 0))
+            c = _Node(n.op, n.name, dict(n.attrs), new_inputs)
+            c.extra = dict(n.extra)
+            sub_nodes[id(n)] = c
+        from .symbol.symbol import Symbol as _Sym
+        sub_sym = _Sym([(sub_nodes[id(n)], i) for n, i in out_entries])
+        op_name, attrs = prop.create_subgraph_node(sub_sym, input_names,
+                                                   ridx)
+        from .ops import registry as _reg
+        # an input edge may be another (earlier) region's output: route it
+        # to that region's fused node
+        fused_inputs = [replaced.get((id(inp), slot), (inp, slot))
+                        for inp, slot in input_entries]
+        fused = _Node(_reg.get_op(op_name),
+                      new_node_name(f"subgraph{ridx}_"), attrs,
+                      fused_inputs)
+        for j, (n, i) in enumerate(out_entries):
+            replaced[(id(n), i)] = (fused, j)
+
+    # rewrite edges in the outer graph
+    def rewrite_entry(entry):
+        node, slot = entry
+        return replaced.get((id(node), slot), entry)
+
+    for node in order:
+        if any((id(i), s) in replaced for i, s in node.inputs):
+            node.inputs = [rewrite_entry(e) for e in node.inputs]
+    sym._outputs = [rewrite_entry(e) for e in sym._outputs]
+    return sym
+
+
+# ---------------------------------------------------------------------------
+# built-in properties
+# ---------------------------------------------------------------------------
+
+@register_subgraph_property("XLA")
+class XLAFuseProperty(SubgraphProperty):
+    """Fuse every dense compute node into maximal XLA regions — the
+    TPU-native generalization of the MKLDNN fusion property (SURVEY §2.1:
+    'replace subgraph -> MKLDNN with subgraph -> XLA HLO module')."""
+
+    class _Sel(SubgraphSelector):
+        def select(self, node):
+            return node.op is not None and node.op.name != "_subgraph" \
+                and not getattr(node.op, "rng", False)
+
+    def create_selector(self):
+        return self._Sel()
+
+
+class NamedOpProperty(SubgraphProperty):
+    """Fuse chains of the given op names (conv+bn+relu style patterns)."""
+
+    def __init__(self, op_names):
+        self._names = set(op_names)
+
+    class _Sel(SubgraphSelector):
+        def __init__(self, names):
+            self._names = names
+
+        def select(self, node):
+            return node.op is not None and node.op.name in self._names
+
+    def create_selector(self):
+        return self._Sel(self._names)

@@ -75,6 +75,27 @@ def _walk(symbol, arg_map: Dict[str, Any], aux_map: Dict[str, Any],
             else:
                 check(name in arg_map, f"missing argument {name}")
                 cache[(id(node), 0)] = arg_map[name]
+        elif node.op.name == "_subgraph":
+            # inline a fused region with THIS walk's training/aux context
+            # (the op-registry fallback runs inference-mode only)
+            ins = [cache[(id(i), k)] for i, k in node.inputs]
+            sub = node.attrs["__subgraph__"]
+            in_names = tuple(node.attrs["__subgraph_inputs__"])
+            inner_args = dict(zip(in_names, ins))
+            inner_collect = {} if collect_aux is not None else None
+            outs = _walk(sub, inner_args, {}, is_train,
+                         collect_aux=inner_collect)
+            for i, o in enumerate(outs):
+                cache[(id(node), i)] = o
+            if inner_collect:
+                # translate proxy-input names back to the outer graph's
+                # aux variables feeding this fused node
+                for pname, val in inner_collect.items():
+                    if pname in in_names:
+                        outer = node.inputs[in_names.index(pname)][0]
+                        collect_aux[outer.name] = val
+                    else:
+                        collect_aux[pname] = val
         else:
             ins = [cache[(id(i), k)] for i, k in node.inputs]
             params = _reg.normalize_params(node.attrs)

@@ -262,8 +262,22 @@ class Symbol:
             node.inputs = [(mapping.get(i.name, i) if i.is_variable else i, k)
                            for i, k in node.inputs]
 
+    def optimize_for(self, backend: str, **kwargs) -> "Symbol":
+        """Partition the graph with a registered subgraph property
+        (ref: Symbol.optimize_for + MXNET_SUBGRAPH_BACKEND activation of
+        src/operator/subgraph/)."""
+        from ..subgraph import get_subgraph_property, partition_graph
+        return partition_graph(self,
+                               get_subgraph_property(backend, **kwargs))
+
     # -- serialization ---------------------------------------------------
     def tojson(self) -> str:
+        for n in self._topo():
+            if n.op is not None and n.op.name == "_subgraph":
+                raise MXNetError(
+                    "cannot serialize a partitioned graph: _subgraph "
+                    "nodes are runtime artifacts; save the original "
+                    "symbol and re-run optimize_for after loading")
         nodes = []
         index: Dict[int, int] = {}
         order = self._topo()

@@ -1,0 +1,151 @@
+"""Subgraph partitioning framework
+(ref: tests/python/unittest/test_subgraph_op.py — partition + numerical
+equivalence of the fused graph)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as S
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.subgraph import (NamedOpProperty, get_subgraph_property,
+                                list_subgraph_properties, partition_graph)
+from mxnet_tpu.symbol.executor import eval_symbol
+from mxnet_tpu.symbol.symbol import create
+
+
+def _mlp_sym():
+    x = S.var("data")
+    fc1 = create("FullyConnected", [x, S.var("w1"), S.var("b1")],
+                 {"num_hidden": 8}, name="fc1")
+    act = create("Activation", [fc1], {"act_type": "relu"}, name="relu1")
+    fc2 = create("FullyConnected", [act, S.var("w2"), S.var("b2")],
+                 {"num_hidden": 4}, name="fc2")
+    return fc2
+
+
+def _params(seed=0):
+    rs = np.random.RandomState(seed)
+    return {
+        "w1": mx.nd.array(rs.randn(8, 6).astype(np.float32) * 0.3),
+        "b1": mx.nd.array(np.zeros(8, np.float32)),
+        "w2": mx.nd.array(rs.randn(4, 8).astype(np.float32) * 0.3),
+        "b2": mx.nd.array(np.zeros(4, np.float32)),
+    }
+
+
+def _run(sym, x, params):
+    out = eval_symbol(sym, ["data"], [x], params)
+    return (out[0] if isinstance(out, list) else out).asnumpy()
+
+
+def test_xla_property_fuses_whole_graph():
+    sym = _mlp_sym()
+    fused = sym.optimize_for("XLA")
+    ops = [n.op.name for n in fused._topo() if n.op is not None]
+    assert ops == ["_subgraph"]
+    x = mx.nd.array(np.random.RandomState(1).randn(2, 6)
+                    .astype(np.float32))
+    p = _params()
+    np.testing.assert_allclose(_run(fused, x, p), _run(_mlp_sym(), x, p),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_named_property_fuses_selected_chain():
+    sym = _mlp_sym()
+    fused = partition_graph(sym, NamedOpProperty(["FullyConnected",
+                                                  "Activation"]))
+    # everything is in the name set -> one region again, but via the
+    # pattern property
+    ops = [n.op.name for n in fused._topo() if n.op is not None]
+    assert ops == ["_subgraph"]
+
+
+def test_partial_fusion_keeps_unselected_ops():
+    x = S.var("data")
+    fc = create("FullyConnected", [x, S.var("w1"), S.var("b1")],
+                {"num_hidden": 8}, name="fc1")
+    act = create("Activation", [fc], {"act_type": "relu"}, name="relu1")
+    sm = create("softmax", [act], {"axis": -1}, name="sm")
+    fused = partition_graph(sm, NamedOpProperty(["FullyConnected",
+                                                 "Activation"]))
+    ops = [n.op.name for n in fused._topo() if n.op is not None]
+    assert ops == ["_subgraph", "softmax"]
+    xs = mx.nd.array(np.random.RandomState(2).randn(3, 6)
+                     .astype(np.float32))
+    p = {"w1": _params()["w1"], "b1": _params()["b1"]}
+    ref = eval_symbol(sm, ["data"], [xs], p)
+    got = eval_symbol(fused, ["data"], [xs], p)
+    ref = (ref[0] if isinstance(ref, list) else ref).asnumpy()
+    got = (got[0] if isinstance(got, list) else got).asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_multi_output_region_edges():
+    """A region output consumed by TWO outside nodes maps to one fused
+    output slot."""
+    x = S.var("data")
+    fc = create("FullyConnected", [x, S.var("w1"), S.var("b1")],
+                {"num_hidden": 8}, name="fc1")
+    a = create("exp", [fc], {}, name="e")
+    b = create("log", [create("abs", [fc], {}, name="ab")], {}, name="l")
+    from mxnet_tpu.symbol.symbol import Group
+    g = Group([a, b])
+    fused = partition_graph(g, NamedOpProperty(["FullyConnected"]))
+    ops = sorted(n.op.name for n in fused._topo() if n.op is not None)
+    assert ops == ["_subgraph", "abs", "exp", "log"]
+    xs = mx.nd.array(np.random.RandomState(3).randn(2, 6)
+                     .astype(np.float32))
+    p = {"w1": _params()["w1"], "b1": _params()["b1"]}
+    ref = eval_symbol(g, ["data"], [xs], p)
+    got = eval_symbol(fused, ["data"], [xs], p)
+    for r, o in zip(ref, got):
+        np.testing.assert_allclose(o.asnumpy(), r.asnumpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_property_registry():
+    assert "XLA" in list_subgraph_properties()
+    assert get_subgraph_property("XLA") is not None
+    with pytest.raises(MXNetError, match="registered"):
+        get_subgraph_property("nope")
+
+
+def test_fused_batchnorm_trains_and_updates_aux():
+    """Training through a fused region must use batch stats and update
+    the outer moving stats (regression: fused BN ran inference-mode)."""
+    from mxnet_tpu.symbol.executor import _walk
+    x = S.var("data")
+    bn = create("BatchNorm", [x, S.var("g"), S.var("b"), S.var("mm"),
+                              S.var("mv")], {"fix_gamma": False},
+                name="bn0")
+    out = create("relu", [bn[0]], {}, name="r0")
+    fused = partition_graph(out, NamedOpProperty(["BatchNorm", "relu"]))
+    ops = [n.op.name for n in fused._topo() if n.op is not None]
+    assert ops == ["_subgraph"]
+    import jax.numpy as jnp
+    rs = np.random.RandomState(0)
+    xv = jnp.asarray(rs.randn(8, 4).astype(np.float32) * 3 + 1)
+    arg = {"data": xv,
+           "g": jnp.ones(4), "b": jnp.zeros(4)}
+    aux = {"mm": jnp.zeros(4), "mv": jnp.ones(4)}
+    collect = {}
+    outs = _walk(fused, dict(arg), dict(aux), True, collect_aux=collect)
+    # train mode: output is batch-normalized (mean ~0) even though
+    # moving_mean is 0 and moving_var 1
+    got = np.asarray(outs[0])
+    assert abs(np.asarray(outs[0]).mean()) < 1.5
+    # moving stats were collected against the OUTER aux names
+    assert set(collect) == {"mm", "mv"}
+    assert abs(float(np.asarray(collect["mm"]).mean()) - 0.1 *
+               float(np.asarray(xv).mean(axis=0).mean())) < 0.5
+
+
+def test_partitioned_graph_serialization_raises():
+    fused = _mlp_sym().optimize_for("XLA")
+    with pytest.raises(MXNetError, match="partitioned"):
+        fused.tojson()
+
+
+def test_optimize_for_rejects_unknown_kwargs():
+    with pytest.raises(TypeError):
+        _mlp_sym().optimize_for("XLA", dedup_subgraph=True)
