@@ -1,0 +1,97 @@
+"""Distributed Gluon training with the dist_sync kvstore
+(ref: example/distributed_training/cifar10_dist.py — Gluon net +
+`dist_sync` kvstore; each worker trains on its shard and gradients are
+summed across workers every step).
+
+Launch (N local processes; ssh/manual for real clusters):
+
+    python tools/launch.py -n 2 --launcher local \
+        python examples/distributed_training/cifar10_dist.py --epochs 1
+
+Data: CIFAR-10 RecordIO via --data-train (im2rec output, sharded with
+part_index/num_parts = rank/world); falls back to a synthetic set in the
+zero-egress environment.
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+
+def synthetic_batches(batch, n_batches, rank):
+    rs = np.random.RandomState(100 + rank)
+    for _ in range(n_batches):
+        yield (rs.randn(batch, 3, 32, 32).astype(np.float32),
+               rs.randint(0, 10, batch).astype(np.float32))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--batch-size", type=int, default=64,
+                    help="per-worker batch size")
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--data-train", default=None)
+    ap.add_argument("--num-batches", type=int, default=8,
+                    help="synthetic batches per epoch")
+    args = ap.parse_args()
+
+    from mxnet_tpu.kvstore_server import init_distributed
+    init_distributed()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+    from mxnet_tpu.gluon import nn, loss as gloss
+
+    kv = mx.kv.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+    print(f"worker {rank}/{nw} up", flush=True)
+
+    mx.random.seed(42 + rank)
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(16, 3, padding=1, activation="relu"),
+            nn.MaxPool2D(2, 2),
+            nn.Conv2D(32, 3, padding=1, activation="relu"),
+            nn.MaxPool2D(2, 2),
+            nn.Flatten(), nn.Dense(64, activation="relu"), nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    lossfn = gloss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr}, kvstore=kv)
+
+    for epoch in range(args.epochs):
+        if args.data_train:
+            from mxnet_tpu.io import ImageRecordIter
+            it = ImageRecordIter(path_imgrec=args.data_train,
+                                 data_shape=(3, 32, 32),
+                                 batch_size=args.batch_size, shuffle=True,
+                                 rand_mirror=True, part_index=rank,
+                                 num_parts=nw)
+            batches = ((b.data[0].asnumpy(), b.label[0].asnumpy())
+                       for b in it)
+        else:
+            batches = synthetic_batches(args.batch_size, args.num_batches,
+                                        rank)
+        t0 = time.time()
+        total, n = 0.0, 0
+        for data, label in batches:
+            x, y = nd.array(data), nd.array(label)
+            with autograd.record():
+                loss = lossfn(net(x), y)
+            loss.backward()
+            trainer.step(args.batch_size)
+            total += float(loss.mean().asscalar())
+            n += 1
+        kv.barrier()
+        print(f"worker {rank}: epoch {epoch} loss {total / max(n, 1):.4f} "
+              f"({time.time() - t0:.1f}s, {n} batches)", flush=True)
+    print(f"worker {rank}: DONE", flush=True)
+
+
+if __name__ == "__main__":
+    main()

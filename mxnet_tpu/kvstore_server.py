@@ -24,6 +24,15 @@ def init_distributed() -> bool:
     if coord is None or nproc <= 1:
         return False
     import jax
+    # a JAX_PLATFORMS request must win over any sitecustomize-forced
+    # platform, or every worker initializes the single-chip backend and
+    # sees world size 1
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        try:
+            jax.config.update("jax_platforms", want)
+        except Exception:
+            pass
     jax.distributed.initialize(coordinator_address=coord,
                                num_processes=nproc, process_id=rank)
     return True

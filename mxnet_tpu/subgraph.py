@@ -189,10 +189,11 @@ def partition_graph(symbol, prop: SubgraphProperty):
     if not regions:
         return sym
 
-    replaced: Dict[int, Tuple] = {}   # old node id -> (new node, out slot map)
+    replaced: Dict[Tuple, Tuple] = {}  # (node id, slot) -> (fused, slot)
+    fused_nodes: List = []
     for ridx, region in enumerate(regions):
         ids = {id(n) for n in region}
-        region_sorted = [n for n in order if id(n) in ids]
+        region_sorted = region  # already topologically ordered
         # region inputs: edges from outside (vars included)
         input_entries: List[Tuple] = []
         input_names: List[str] = []
@@ -235,8 +236,7 @@ def partition_graph(symbol, prop: SubgraphProperty):
             c = _Node(n.op, n.name, dict(n.attrs), new_inputs)
             c.extra = dict(n.extra)
             sub_nodes[id(n)] = c
-        from .symbol.symbol import Symbol as _Sym
-        sub_sym = _Sym([(sub_nodes[id(n)], i) for n, i in out_entries])
+        sub_sym = Symbol([(sub_nodes[id(n)], i) for n, i in out_entries])
         op_name, attrs = prop.create_subgraph_node(sub_sym, input_names,
                                                    ridx)
         from .ops import registry as _reg
@@ -247,15 +247,18 @@ def partition_graph(symbol, prop: SubgraphProperty):
         fused = _Node(_reg.get_op(op_name),
                       new_node_name(f"subgraph{ridx}_"), attrs,
                       fused_inputs)
+        fused_nodes.append(fused)
         for j, (n, i) in enumerate(out_entries):
             replaced[(id(n), i)] = (fused, j)
 
-    # rewrite edges in the outer graph
+    # rewrite edges in the outer graph; fused nodes built before a
+    # later-seeded region existed get a second pass so region->region
+    # edges resolve regardless of seeding order
     def rewrite_entry(entry):
         node, slot = entry
         return replaced.get((id(node), slot), entry)
 
-    for node in order:
+    for node in list(order) + fused_nodes:
         if any((id(i), s) in replaced for i, s in node.inputs):
             node.inputs = [rewrite_entry(e) for e in node.inputs]
     sym._outputs = [rewrite_entry(e) for e in sym._outputs]

@@ -149,3 +149,46 @@ def test_partitioned_graph_serialization_raises():
 def test_optimize_for_rejects_unknown_kwargs():
     with pytest.raises(TypeError):
         _mlp_sym().optimize_for("XLA", dedup_subgraph=True)
+
+
+def test_fused_graph_shape_inference_and_bind():
+    """optimize_for + simple_bind must infer unshaped weights through the
+    fused region (regression: PARAM_SHAPE_HINTS couldn't see inside)."""
+    fused = _mlp_sym().optimize_for("XLA")
+    args, outs, aux = fused.infer_shape(data=(2, 6))
+    assert outs == [(2, 4)]
+    assert (8, 6) in args and (4, 8) in args
+    ex = fused.simple_bind(data=(2, 6))
+    x = np.random.RandomState(5).randn(2, 6).astype(np.float32)
+    ex.arg_dict["data"][:] = mx.nd.array(x)
+    out = ex.forward()[0].asnumpy()
+    assert out.shape == (2, 4)
+
+
+def test_region_to_region_edges_resolve_any_seed_order():
+    """Output-grown regions must route edges between fused nodes."""
+    from mxnet_tpu.subgraph import SubgraphProperty, SubgraphSelector
+
+    class DownstreamOnly(SubgraphProperty):
+        class _Sel(SubgraphSelector):
+            def select(self, node):
+                return node.op is not None
+            def select_input(self, node, input_node):
+                return False
+        def create_selector(self):
+            return self._Sel()
+
+    x = S.var("data")
+    s_ = create("exp", [x], {}, name="s")
+    t = create("abs", [x], {}, name="t")
+    m = create("elemwise_add", [s_, t], {}, name="m")
+    fused = partition_graph(m, DownstreamOnly())
+    ops = [n.op.name for n in fused._topo() if n.op is not None]
+    # no raw exp/abs/add nodes survive outside fused regions
+    assert set(ops) == {"_subgraph"}, ops
+    xs = mx.nd.array(np.random.RandomState(6).randn(2, 3)
+                     .astype(np.float32))
+    got = eval_symbol(fused, ["data"], [xs], {})
+    got = (got[0] if isinstance(got, list) else got).asnumpy()
+    ref = np.exp(xs.asnumpy()) + np.abs(xs.asnumpy())
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
