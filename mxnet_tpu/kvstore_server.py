@@ -31,8 +31,12 @@ def init_distributed() -> bool:
     if want:
         try:
             jax.config.update("jax_platforms", want)
-        except Exception:
-            pass
+        except Exception as e:
+            import warnings
+            warnings.warn(
+                f"could not select JAX_PLATFORMS={want!r} ({e}); "
+                "distributed init may land on the wrong backend and "
+                "report world size 1")
     jax.distributed.initialize(coordinator_address=coord,
                                num_processes=nproc, process_id=rank)
     return True

@@ -243,3 +243,10 @@ def _scatter_plus_scalar(x, scalar: float = 1.0, reverse: bool = False):
 @register("_scatter_minus_scalar")
 def _scatter_minus_scalar(x, scalar: float = 1.0, reverse: bool = False):
     return x - scalar
+
+
+@register("hard_sigmoid")
+def _hard_sigmoid(data, alpha=0.2, beta=0.5):
+    """max(0, min(1, alpha*x + beta)) (ref: mshadow_op.h hard_sigmoid)."""
+    jnp = _jnp()
+    return jnp.clip(float(alpha) * data + float(beta), 0.0, 1.0)

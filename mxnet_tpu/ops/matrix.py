@@ -343,3 +343,12 @@ def _khatri_rao(*mats):
     for m in mats[1:]:
         out = (out[:, None, :] * m[None, :, :]).reshape((-1, m.shape[1]))
     return out
+
+
+@register("batch_take")
+def _batch_take(a, indices):
+    """out[i] = a[i, indices[i]] (ref: tensor/indexing_op.cc batch_take)."""
+    jnp = _jnp()
+    idx = indices.reshape(-1).astype(jnp.int32)
+    rows = jnp.arange(a.shape[0])
+    return a[rows, idx]

@@ -448,6 +448,103 @@ def _linear_regression_output(data, label, grad_scale=1.0):
     return f(data, label)
 
 
+@register("SVMOutput")
+def _svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
+                use_linear=False):
+    """Identity forward with hinge-loss backward
+    (ref: src/operator/svm_output.cc L1_SVM/L2_SVM kernels)."""
+    import jax
+    jnp = _jnp()
+    margin = float(margin)
+    reg = float(regularization_coefficient)
+
+    @jax.custom_vjp
+    def f(d, l):
+        return d
+
+    def fwd(d, l):
+        return d, (d, l)
+
+    def bwd(res, g):
+        d, l = res
+        n_class = d.shape[1]
+        onehot = jax.nn.one_hot(l.astype(jnp.int32), n_class,
+                                dtype=d.dtype)
+        if use_linear:  # L1-SVM
+            pos = -(margin > d).astype(d.dtype) * reg
+            neg = (margin > -d).astype(d.dtype) * reg
+        else:  # L2-SVM
+            pos = jnp.where(margin > d, 2.0 * (margin - d), 0.0) * -reg
+            neg = jnp.where(margin > -d, -2.0 * (margin + d), 0.0) * -reg
+        return (jnp.where(onehot > 0, pos, neg).astype(d.dtype), None)
+
+    f.defvjp(fwd, bwd)
+    return f(data, label)
+
+
+@register("MakeLoss")
+def _make_loss(data, grad_scale=1.0, valid_thresh=0.0,
+               normalization="null"):
+    """Identity forward; backward is the constant grad_scale, optionally
+    normalized by batch size or the count of entries above valid_thresh
+    (ref: src/operator/make_loss-inl.h)."""
+    import jax
+    jnp = _jnp()
+    gs = float(grad_scale)
+
+    @jax.custom_vjp
+    def f(d):
+        return d
+
+    def fwd(d):
+        return d, d
+
+    def bwd(d, g):
+        if normalization == "batch":
+            scale = gs / d.shape[0]
+            return (jnp.full(d.shape, scale, d.dtype),)
+        if normalization == "valid":
+            n_valid = jnp.maximum(
+                jnp.sum((d > valid_thresh).astype(jnp.float32)), 1.0)
+            return ((jnp.full(d.shape, gs, jnp.float32) / n_valid)
+                    .astype(d.dtype),)
+        return (jnp.full(d.shape, gs, d.dtype),)
+
+    f.defvjp(fwd, bwd)
+    return f(data)
+
+
+@register("IdentityAttachKLSparseReg",
+          aliases=("identity_attach_KL_sparse_reg",))
+def _identity_attach_kl_sparse_reg(data, sparseness_target=0.1,
+                                   penalty=0.001, momentum=0.9):
+    """Identity forward; backward adds the KL-sparsity penalty gradient
+    penalty * (-rho/rho_hat + (1-rho)/(1-rho_hat)) per hidden unit, with
+    rho_hat the batch mean activation (ref:
+    src/operator/identity_attach_KL_sparse_reg-inl.h; the reference's
+    momentum-smoothed moving average is simplified to the batch average —
+    pair only with sigmoid activations)."""
+    import jax
+    jnp = _jnp()
+    rho = float(sparseness_target)
+    pen = float(penalty)
+
+    @jax.custom_vjp
+    def f(d):
+        return d
+
+    def fwd(d):
+        return d, d
+
+    def bwd(d, g):
+        avg = jnp.clip(jnp.mean(d, axis=0, keepdims=True), 1e-6, 1 - 1e-6)
+        kl_grad = pen * (-(rho / avg) + (1.0 - rho) / (1.0 - avg))
+        return ((g + kl_grad).astype(d.dtype),)
+
+    f.defvjp(fwd, bwd)
+    return f(data)
+
+
 @register("MAERegressionOutput")
 def _mae_regression_output(data, label, grad_scale=1.0):
     import jax

@@ -182,8 +182,8 @@ class Symbol:
         # variable dtype defaults
         dtypes = {n.name: n.extra.get("dtype", _np.float32)
                   for n in self._variables()}
-        shapes, _, aux_shapes, out_shapes, _ = _infer(self, known, dtypes,
-                                                      partial)
+        shapes, _, aux_shapes, _, out_shapes, _ = _infer(
+            self, known, dtypes, partial)
         arg_shapes = [shapes.get(n) for n in arg_names]
         aux = [aux_shapes.get(n) for n in self.list_auxiliary_states()]
         return arg_shapes, out_shapes, aux
@@ -203,8 +203,8 @@ class Symbol:
         dtypes = {n.name: known_t.get(n.name, n.extra.get("dtype", _np.float32))
                   for n in self._variables()}
         try:
-            _, types, aux_t, out_t, _ = _infer(self, known_s, dtypes,
-                                               True)
+            _, types, _, aux_t, _, out_t = _infer(self, known_s, dtypes,
+                                                  True)
         except Exception:
             return [None] * len(arg_names), None, []
         return ([types.get(n) for n in arg_names], out_t,
@@ -496,26 +496,29 @@ def _infer(symbol: Symbol, known_shapes, dtypes, partial):
         elif node.op.name == "_subgraph":
             # recurse into the fused region so hints inside it can
             # backfill outer parameter shapes (partition_graph proxies)
-            import jax
-            import jax.numpy as jnp
             sub = node.attrs["__subgraph__"]
             in_names = tuple(node.attrs["__subgraph_inputs__"])
             in_avals = [cache.get((id(i), k)) for i, k in node.inputs]
-            sub_known = {n: tuple(a.shape)
-                         for n, a in zip(in_names, in_avals)
-                         if a is not None}
-            sub_dtypes = {n: a.dtype for n, a in zip(in_names, in_avals)
-                          if a is not None}
-            s_shapes, _, _, _, _ = _infer(sub, sub_known, sub_dtypes, True)
-            for idx, pname in enumerate(in_names):
-                if in_avals[idx] is None and pname in s_shapes:
-                    inp, k = node.inputs[idx]
-                    if inp.is_variable and                             cache.get((id(inp), 0)) is None:
-                        aval = var_aval(
-                            inp, assigned_shape=tuple(s_shapes[pname]))
-                        if aval is not None:
-                            record_var(inp, aval)
-            in_avals = [cache.get((id(i), k)) for i, k in node.inputs]
+            if any(a is None for a in in_avals):
+                sub_known = {n: tuple(a.shape)
+                             for n, a in zip(in_names, in_avals)
+                             if a is not None}
+                sub_dtypes = {n: a.dtype
+                              for n, a in zip(in_names, in_avals)
+                              if a is not None}
+                s_shapes, _, _, _, _, _ = _infer(sub, sub_known,
+                                                 sub_dtypes, True)
+                for idx, pname in enumerate(in_names):
+                    if in_avals[idx] is None and pname in s_shapes:
+                        inp, k = node.inputs[idx]
+                        if inp.is_variable \
+                                and cache.get((id(inp), 0)) is None:
+                            aval = var_aval(
+                                inp,
+                                assigned_shape=tuple(s_shapes[pname]))
+                            if aval is not None:
+                                record_var(inp, aval)
+                in_avals = [cache.get((id(i), k)) for i, k in node.inputs]
             if any(a is None for a in in_avals):
                 if partial:
                     continue
@@ -528,7 +531,7 @@ def _infer(symbol: Symbol, known_shapes, dtypes, partial):
             sub_known = {n: tuple(a.shape)
                          for n, a in zip(in_names, in_avals)}
             sub_dtypes = {n: a.dtype for n, a in zip(in_names, in_avals)}
-            _, _, _, s_out_shapes, s_out_types = _infer(
+            _, _, _, _, s_out_shapes, s_out_types = _infer(
                 sub, sub_known, sub_dtypes, partial)
             for i, (shp, dt) in enumerate(zip(s_out_shapes, s_out_types)):
                 if shp is not None:
@@ -579,7 +582,7 @@ def _infer(symbol: Symbol, known_shapes, dtypes, partial):
         a = cache.get((id(node), i))
         out_shapes.append(tuple(a.shape) if a is not None else None)
         out_types.append(a.dtype if a is not None else None)
-    return shapes, types, aux_shapes, out_shapes, out_types
+    return shapes, types, aux_shapes, aux_types, out_shapes, out_types
 
 
 # ---------------------------------------------------------------------------

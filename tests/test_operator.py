@@ -4,7 +4,7 @@ import numpy as np
 import pytest
 
 import mxnet_tpu as mx
-from mxnet_tpu import nd
+from mxnet_tpu import autograd, nd
 from mxnet_tpu.test_utils import (assert_almost_equal,
                                   check_numeric_gradient, check_consistency)
 
@@ -215,3 +215,76 @@ def test_kvstore_compressed_push():
     out = nd.zeros((4,))
     kv.pull("w", out=out)
     assert out.asnumpy().tolist() == [0.5, -0.5, 0.0, 0.0]
+
+
+def test_hard_sigmoid():
+    x = np.array([[-5.0, 0.0, 1.0, 5.0]], np.float32)
+    out = nd.hard_sigmoid(nd.array(x)).asnumpy()
+    assert_almost_equal(out, np.clip(0.2 * x + 0.5, 0, 1))
+    from mxnet_tpu.test_utils import check_numeric_gradient
+    check_numeric_gradient("hard_sigmoid",
+                           [np.array([[0.3, -0.8, 1.1]], np.float32)])
+
+
+def test_batch_take():
+    a = np.arange(12, dtype=np.float32).reshape(4, 3)
+    idx = np.array([0, 2, 1, 0], np.float32)
+    out = nd.batch_take(nd.array(a), nd.array(idx)).asnumpy()
+    assert_almost_equal(out, a[np.arange(4), idx.astype(int)])
+
+
+def test_svm_output_gradients():
+    # ref: svm_output.cc L1_SVM/L2_SVM kernels
+    d = np.array([[0.5, -0.2], [0.1, 0.8]], np.float32)
+    l = np.array([0.0, 1.0], np.float32)
+
+    def grads(use_linear):
+        x = nd.array(d)
+        x.attach_grad()
+        with autograd.record():
+            nd.SVMOutput(x, nd.array(l), use_linear=use_linear) \
+                .sum().backward()
+        return x.grad.asnumpy()
+
+    l2 = grads(False)
+    assert_almost_equal(l2, np.array([[-1.0, 1.6], [2.2, -0.4]],
+                                     np.float32), rtol=1e-5)
+    l1 = grads(True)
+    assert_almost_equal(l1, np.array([[-1.0, 1.0], [1.0, -1.0]],
+                                     np.float32), rtol=1e-5)
+    # forward is identity
+    assert_almost_equal(nd.SVMOutput(nd.array(d), nd.array(l)).asnumpy(), d)
+
+
+def test_make_loss_gradient_normalization():
+    d = np.array([[0.5, 0.0], [2.0, 0.1]], np.float32)
+
+    def grad(norm, **kw):
+        x = nd.array(d)
+        x.attach_grad()
+        with autograd.record():
+            nd.MakeLoss(x, grad_scale=3.0, normalization=norm,
+                        **kw).sum().backward()
+        return x.grad.asnumpy()
+
+    assert_almost_equal(grad("null"), np.full(d.shape, 3.0, np.float32))
+    assert_almost_equal(grad("batch"), np.full(d.shape, 1.5, np.float32))
+    # valid: 3 entries above 0.05 -> scale 3/3 = 1
+    assert_almost_equal(grad("valid", valid_thresh=0.05),
+                        np.full(d.shape, 1.0, np.float32))
+
+
+def test_identity_attach_kl_sparse_reg():
+    rs = np.random.RandomState(0)
+    d = rs.uniform(0.2, 0.8, (6, 4)).astype(np.float32)
+    x = nd.array(d)
+    x.attach_grad()
+    with autograd.record():
+        out = nd.IdentityAttachKLSparseReg(x, sparseness_target=0.1,
+                                           penalty=0.01)
+        out.sum().backward()
+    assert_almost_equal(out.asnumpy(), d)  # identity forward
+    avg = d.mean(axis=0, keepdims=True)
+    expected = 1.0 + 0.01 * (-(0.1 / avg) + 0.9 / (1 - avg))
+    assert_almost_equal(x.grad.asnumpy(),
+                        np.broadcast_to(expected, d.shape), rtol=1e-4)

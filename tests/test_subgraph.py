@@ -192,3 +192,18 @@ def test_region_to_region_edges_resolve_any_seed_order():
     got = (got[0] if isinstance(got, list) else got).asnumpy()
     ref = np.exp(xs.asnumpy()) + np.abs(xs.asnumpy())
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_infer_type_returns_dtypes():
+    """infer_type's second element is output DTYPES, not shapes
+    (regression: 5-tuple unpack kept out_shapes in the dtype slot)."""
+    x = S.var("data", shape=(2, 6))
+    fc = create("FullyConnected",
+                [x, S.var("w1", shape=(8, 6)), S.var("b1", shape=(8,))],
+                {"num_hidden": 8}, name="fc1")
+    arg_t, out_t, aux_t = fc.infer_type(data=np.float32)
+    assert out_t and not isinstance(out_t[0], tuple)
+    assert np.dtype(out_t[0]) == np.float32
+    fused = fc.optimize_for("XLA")
+    _, out_t2, _ = fused.infer_type(data=np.float32)
+    assert out_t2 and np.dtype(out_t2[0]) == np.float32
