@@ -57,6 +57,11 @@ def enable_compile_cache(cache_dir=None):
     __graft_entry__.py; MXTPU_COMPILE_CACHE overrides the location."""
     try:
         import jax
+        if jax.default_backend() == "cpu":
+            # CPU compiles are fast, and reloading CPU AOT entries across
+            # differing host-feature detection risks SIGILL — cache only
+            # the slow tunnel/TPU compiles
+            return False
         if cache_dir is None:
             cache_dir = os.environ.get(
                 "MXTPU_COMPILE_CACHE",
