@@ -276,6 +276,17 @@ def grad(heads, variables, head_grads=None, retain_graph=None,
                             "not supported yet on the eager tape")
     if retain_graph is None:
         retain_graph = create_graph
+    from .ndarray.ndarray import NDArray
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if isinstance(variables, NDArray):
+        variables = [variables]
+    if head_grads is not None and isinstance(head_grads, NDArray):
+        head_grads = [head_grads]
+    if head_grads is not None:
+        check(len(head_grads) == len(heads),
+              f"len(head_grads) ({len(head_grads)}) must equal "
+              f"len(heads) ({len(heads)})")
     return _backward_impl(heads, head_grads, retain_graph, train_mode,
                           variables=variables)
 

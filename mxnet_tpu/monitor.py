@@ -46,6 +46,9 @@ class Monitor:
     def _stat_helper(self, name, value) -> None:
         if not self.activated or not self.re_prog.match(str(name)):
             return
+        from .ndarray.ndarray import NDArray, from_jax
+        if not isinstance(value, NDArray):
+            value = from_jax(value)
         self.queue.append((self.step, str(name), self.stat_func(value)))
 
     def tic(self) -> None:
@@ -57,6 +60,8 @@ class Monitor:
         if not self.activated:
             self.step += 1
             return []
+        import logging
+        from .ndarray.ndarray import from_jax
         # pull internal outputs from each installed executor
         for exe in self._exes:
             try:
@@ -68,9 +73,14 @@ class Monitor:
                 outs = _walk(internals, arg_map, aux_map, False)
                 for name, val in zip(names, outs):
                     if self.re_prog.match(name):
+                        # stat_func receives an NDArray (the reference
+                        # contract: monitor.py stat funcs call .asnumpy())
                         self.queue.append((self.step, name,
-                                           self.stat_func(_np.asarray(val))))
-            except Exception:
+                                           self.stat_func(from_jax(val))))
+            except Exception as e:
+                logging.getLogger("mxnet_tpu").warning(
+                    "Monitor: could not evaluate internals of executor "
+                    "%r: %s", exe, e)
                 continue
         self.activated = False
         res = []

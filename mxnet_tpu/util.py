@@ -57,11 +57,20 @@ def enable_compile_cache(cache_dir=None):
     __graft_entry__.py; MXTPU_COMPILE_CACHE overrides the location."""
     try:
         import jax
-        if jax.default_backend() == "cpu":
+        # decide from config/env (NOT jax.default_backend(), which would
+        # eagerly initialize the backend and lock the platform before
+        # callers like __graft_entry__._honor_platform_env can set it)
+        plat = None
+        try:
+            plat = jax.config.jax_platforms
+        except Exception:
+            pass
+        plat = plat or os.environ.get("JAX_PLATFORMS") or ""
+        if plat.split(",")[0].strip() == "cpu":
             # CPU compiles are fast, and reloading CPU AOT entries across
             # differing host-feature detection risks SIGILL — cache only
             # the slow tunnel/TPU compiles
-            return False
+            return "skipped-cpu"  # truthy: intentional skip, not a failure
         if cache_dir is None:
             cache_dir = os.environ.get(
                 "MXTPU_COMPILE_CACHE",
