@@ -1,0 +1,66 @@
+"""INT8 quantization flow (ref: example/quantization/imagenet_gen_qsym.py:
+train/load an fp32 model, calibrate on sample batches, emit a quantized
+symbol + params, compare accuracy against fp32)."""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--calib-batches", type=int, default=4)
+    args = ap.parse_args()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, sym
+    from mxnet_tpu.contrib.quantization import quantize_model
+    from mxnet_tpu.symbol.executor import eval_symbol
+
+    rs = np.random.RandomState(0)
+
+    # an fp32 MLP with random ("pretrained") weights
+    data = sym.var("data")
+    fc1 = sym.FullyConnected(data, sym.var("fc1_weight"),
+                             sym.var("fc1_bias"), num_hidden=args.hidden,
+                             name="fc1")
+    act = sym.Activation(fc1, act_type="relu")
+    fc2 = sym.FullyConnected(act, sym.var("fc2_weight"),
+                             sym.var("fc2_bias"), num_hidden=10, name="fc2")
+    net = sym.softmax(fc2, axis=-1)
+
+    arg_params = {
+        "fc1_weight": nd.array(rs.randn(args.hidden, 20)
+                               .astype(np.float32) * 0.2),
+        "fc1_bias": nd.array(np.zeros(args.hidden, np.float32)),
+        "fc2_weight": nd.array(rs.randn(10, args.hidden)
+                               .astype(np.float32) * 0.2),
+        "fc2_bias": nd.array(np.zeros(10, np.float32)),
+    }
+
+    qsym, qargs, qaux = quantize_model(net, arg_params, {},
+                                       calib_mode="naive")
+
+    x = nd.array(rs.randn(args.batch_size, 20).astype(np.float32))
+    fp32_out = eval_symbol(net, ["data"], [x], arg_params)
+    int8_out = eval_symbol(qsym, ["data"], [x], qargs)
+    fp32_out = (fp32_out[0] if isinstance(fp32_out, list)
+                else fp32_out).asnumpy()
+    int8_out = (int8_out[0] if isinstance(int8_out, list)
+                else int8_out).asnumpy()
+    agree = (fp32_out.argmax(1) == int8_out.argmax(1)).mean()
+    err = np.abs(fp32_out - int8_out).max()
+    print(f"top-1 agreement fp32 vs int8: {agree:.2%}  "
+          f"max abs err: {err:.4f}")
+    assert agree > 0.9, "int8 model diverged from fp32"
+    print("quantization flow done")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,89 @@
+"""Bucketed LSTM language model via BucketingModule
+(ref: example/rnn/bucketing/lstm_bucketing.py: variable-length sentences
+bucketed by length, one shared-parameter executor per bucket).
+
+Synthetic corpus by default (zero-egress); pass --corpus for a text file
+(one sentence per line, whitespace-tokenized).
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+
+def synthetic_sentences(n=200, vocab=50, seed=0):
+    rs = np.random.RandomState(seed)
+    return [list(rs.randint(1, vocab, rs.randint(3, 30)))
+            for _ in range(n)], vocab
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--num-hidden", type=int, default=32)
+    ap.add_argument("--num-embed", type=int, default=32)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--buckets", default="10,20,30")
+    ap.add_argument("--corpus", default=None)
+    args = ap.parse_args()
+    buckets = [int(b) for b in args.buckets.split(",")]
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import sym
+    from mxnet_tpu.rnn import BucketSentenceIter
+    from mxnet_tpu.module import BucketingModule
+
+    if args.corpus:
+        with open(args.corpus) as f:
+            tokens = [l.split() for l in f if l.strip()]
+        vocab_map = {w: i + 1 for i, w in
+                     enumerate(sorted({w for l in tokens for w in l}))}
+        sentences = [[vocab_map[w] for w in l] for l in tokens]
+        vocab = len(vocab_map) + 1
+    else:
+        sentences, vocab = synthetic_sentences()
+
+    train = BucketSentenceIter(sentences, args.batch_size, buckets=buckets)
+
+    def sym_gen(seq_len):
+        data = sym.var("data")
+        label = sym.var("softmax_label")
+        embed = sym.Embedding(data, sym.var("embed_weight"),
+                              input_dim=vocab, output_dim=args.num_embed,
+                              name="embed")
+        from mxnet_tpu.ops.rnn_op import rnn_param_size
+        psize = rnn_param_size(num_layers=1, input_size=args.num_embed,
+                               state_size=args.num_hidden,
+                               bidirectional=False, mode="lstm")
+        out = sym.RNN(sym.transpose(embed, axes=(1, 0, 2)),
+                      sym.var("rnn_params", shape=(psize,)),
+                      sym.var("rnn_state", shape=(1, args.batch_size,
+                                                  args.num_hidden)),
+                      sym.var("rnn_state_cell",
+                              shape=(1, args.batch_size, args.num_hidden)),
+                      state_size=args.num_hidden, num_layers=1,
+                      mode="lstm", name="lstm")
+        out = sym.reshape(sym.transpose(out, axes=(1, 0, 2)),
+                          shape=(-1, args.num_hidden))
+        pred = sym.FullyConnected(out, sym.var("fc_weight"),
+                                  sym.var("fc_bias"), num_hidden=vocab,
+                                  name="pred")
+        label_flat = sym.reshape(label, shape=(-1,))
+        return (sym.SoftmaxOutput(pred, label_flat, name="softmax"),
+                ("data",), ("softmax_label",))
+
+    mod = BucketingModule(sym_gen,
+                          default_bucket_key=train.default_bucket_key)
+    mod.fit(train, num_epoch=args.epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05},
+            eval_metric="Perplexity",
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 5))
+    print("bucketing training done")
+
+
+if __name__ == "__main__":
+    main()

@@ -18,6 +18,7 @@ __all__ = ["seed", "next_key", "uniform", "normal", "randint", "gamma",
 
 _lock = threading.Lock()
 _key = None
+_counter = 0
 _seed_value = 0
 
 # While tracing a CachedOp/jitted graph, random ops must derive their keys
@@ -35,10 +36,11 @@ def _jr():
 
 def seed(seed_state: int, ctx=None) -> None:
     """Reset the root key (ref: python/mxnet/random.py seed)."""
-    global _key, _seed_value
+    global _key, _seed_value, _counter
     with _lock:
         _seed_value = int(seed_state)
         _key = _jr().PRNGKey(_seed_value)
+        _counter = 0
 
 
 def push_trace_key(key) -> None:
@@ -52,18 +54,29 @@ def pop_trace_key() -> None:
 
 
 def next_key():
-    """Split off a fresh subkey for one sampling op."""
+    """Derive a fresh subkey for one sampling op.
+
+    The root key is NEVER mutated with the result of a jax op: splitting
+    under an active jit trace would store a tracer into module state
+    (UnexpectedTracerError on the next eager call). Instead subkeys are
+    fold_in(root, counter) — the counter is plain python state, safe to
+    advance during tracing.
+    """
     stack = getattr(_trace_stack, "stack", None)
     if stack:
         entry = stack[-1]
         entry[1] += 1
         return _jr().fold_in(entry[0], entry[1])
-    global _key
+    global _key, _counter
     with _lock:
         if _key is None:
-            _key = _jr().PRNGKey(0)
-        _key, sub = _jr().split(_key)
-        return sub
+            import jax
+            # force eager creation even if the first next_key() happens
+            # inside a jit trace — a staged PRNGKey would be a tracer
+            with jax.ensure_compile_time_eval():
+                _key = _jr().PRNGKey(0)
+        _counter += 1
+        return _jr().fold_in(_key, _counter)
 
 
 def _nd():

@@ -66,6 +66,10 @@ def enable_compile_cache(cache_dir=None):
         except Exception:
             pass
         plat = plat or os.environ.get("JAX_PLATFORMS") or ""
+        if not plat:
+            # no explicit platform request to preserve — asking the
+            # backend directly is safe and covers implicit-CPU hosts
+            plat = jax.default_backend()
         if plat.split(",")[0].strip() == "cpu":
             # CPU compiles are fast, and reloading CPU AOT entries across
             # differing host-feature detection risks SIGILL — cache only

@@ -170,3 +170,20 @@ def test_concurrent_autograd():
     for t in threads:
         t.join()
     assert not errors, errors
+
+
+def test_rng_inside_jit_does_not_poison_global_key():
+    """Regression: next_key() used to split-update the global key; doing
+    so under a jit trace stored a tracer into module state and the next
+    eager sampling call raised UnexpectedTracerError."""
+    import jax
+    from mxnet_tpu import random as mxrandom
+
+    @jax.jit
+    def g(x):
+        # no trace key pushed: exercises the global-key branch in-trace
+        return x * 0 + mxrandom.next_key()[0]
+
+    g(nd.zeros((2,))._data)
+    out = mx.random.uniform(shape=(4,))   # must not raise
+    assert np.isfinite(out.asnumpy()).all()
