@@ -1,0 +1,93 @@
+"""DCGAN (ref: example/gan/dcgan.py — Conv2DTranspose generator vs conv
+discriminator, alternating SGD updates). Synthetic 32x32 data by default
+(zero-egress); the training loop, losses, and update pattern match the
+reference."""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+
+def build_nets(ngf=16, ndf=16, nc=3, nz=16):
+    from mxnet_tpu.gluon import nn
+
+    netG = nn.HybridSequential()
+    netG.add(
+        nn.Conv2DTranspose(ngf * 4, 4, 1, 0, use_bias=False),   # 1 -> 4
+        nn.BatchNorm(), nn.Activation("relu"),
+        nn.Conv2DTranspose(ngf * 2, 4, 2, 1, use_bias=False),   # 4 -> 8
+        nn.BatchNorm(), nn.Activation("relu"),
+        nn.Conv2DTranspose(ngf, 4, 2, 1, use_bias=False),       # 8 -> 16
+        nn.BatchNorm(), nn.Activation("relu"),
+        nn.Conv2DTranspose(nc, 4, 2, 1, use_bias=False),        # 16 -> 32
+        nn.Activation("tanh"))
+
+    netD = nn.HybridSequential()
+    netD.add(
+        nn.Conv2D(ndf, 4, 2, 1, use_bias=False),
+        nn.LeakyReLU(0.2),
+        nn.Conv2D(ndf * 2, 4, 2, 1, use_bias=False),
+        nn.BatchNorm(), nn.LeakyReLU(0.2),
+        nn.Conv2D(ndf * 4, 4, 2, 1, use_bias=False),
+        nn.BatchNorm(), nn.LeakyReLU(0.2),
+        nn.Conv2D(1, 4, 1, 0, use_bias=False))
+    return netG, netD
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--nz", type=int, default=16)
+    ap.add_argument("--iters", type=int, default=6)
+    ap.add_argument("--lr", type=float, default=0.0002)
+    args = ap.parse_args()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+    from mxnet_tpu.gluon import loss as gloss
+
+    mx.random.seed(0)
+    netG, netD = build_nets(nz=args.nz)
+    netG.initialize(mx.init.Normal(0.02))
+    netD.initialize(mx.init.Normal(0.02))
+    loss_fn = gloss.SigmoidBinaryCrossEntropyLoss()
+    trainerG = gluon.Trainer(netG.collect_params(), "adam",
+                             {"learning_rate": args.lr, "beta1": 0.5})
+    trainerD = gluon.Trainer(netD.collect_params(), "adam",
+                             {"learning_rate": args.lr, "beta1": 0.5})
+
+    rs = np.random.RandomState(0)
+    real_label = nd.ones((args.batch_size,))
+    fake_label = nd.zeros((args.batch_size,))
+    for it in range(args.iters):
+        real = nd.array(rs.randn(args.batch_size, 3, 32, 32)
+                        .astype(np.float32).clip(-1, 1))
+        noise = nd.array(rs.randn(args.batch_size, args.nz, 1, 1)
+                         .astype(np.float32))
+        # D step: maximize log D(x) + log(1 - D(G(z)))
+        with autograd.record():
+            out_real = netD(real).reshape((-1,))
+            fake = netG(noise)
+            out_fake = netD(fake.detach()).reshape((-1,))
+            errD = loss_fn(out_real, real_label) + \
+                loss_fn(out_fake, fake_label)
+        errD.backward()
+        trainerD.step(args.batch_size)
+        # G step: maximize log D(G(z))
+        with autograd.record():
+            out = netD(netG(noise)).reshape((-1,))
+            errG = loss_fn(out, real_label)
+        errG.backward()
+        trainerG.step(args.batch_size)
+        print(f"iter {it}: errD={float(errD.mean().asscalar()):.4f} "
+              f"errG={float(errG.mean().asscalar()):.4f}", flush=True)
+    print("dcgan training loop done")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,6 +1,6 @@
-"""INT8 quantization flow (ref: example/quantization/imagenet_gen_qsym.py:
-train/load an fp32 model, calibrate on sample batches, emit a quantized
-symbol + params, compare accuracy against fp32)."""
+"""INT8 quantization flow (ref: example/quantization/imagenet_gen_qsym.py):
+load an fp32 model, quantize weights to int8 (dynamic/naive mode), emit
+the quantized symbol + params, and compare outputs against fp32."""
 import argparse
 import os
 import sys
@@ -15,7 +15,6 @@ def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--hidden", type=int, default=64)
     ap.add_argument("--batch-size", type=int, default=32)
-    ap.add_argument("--calib-batches", type=int, default=4)
     args = ap.parse_args()
 
     import mxnet_tpu as mx

@@ -73,14 +73,15 @@ def main():
                                   sym.var("fc_bias"), num_hidden=vocab,
                                   name="pred")
         label_flat = sym.reshape(label, shape=(-1,))
-        return (sym.SoftmaxOutput(pred, label_flat, name="softmax"),
+        return (sym.SoftmaxOutput(pred, label_flat, use_ignore=True,
+                                 ignore_label=-1, name="softmax"),
                 ("data",), ("softmax_label",))
 
     mod = BucketingModule(sym_gen,
                           default_bucket_key=train.default_bucket_key)
     mod.fit(train, num_epoch=args.epochs, optimizer="sgd",
             optimizer_params={"learning_rate": 0.05},
-            eval_metric="Perplexity",
+            eval_metric=mx.metric.Perplexity(ignore_label=-1),
             batch_end_callback=mx.callback.Speedometer(args.batch_size, 5))
     print("bucketing training done")
 

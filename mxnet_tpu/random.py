@@ -76,7 +76,9 @@ def next_key():
             with jax.ensure_compile_time_eval():
                 _key = _jr().PRNGKey(0)
         _counter += 1
-        return _jr().fold_in(_key, _counter)
+        # distinguished fold so the eager stream cannot collide with a
+        # trace-key stream even when a caller pushes the root key itself
+        return _jr().fold_in(_jr().fold_in(_key, 0xEA6E4), _counter)
 
 
 def _nd():

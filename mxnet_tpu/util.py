@@ -57,9 +57,11 @@ def enable_compile_cache(cache_dir=None):
     __graft_entry__.py; MXTPU_COMPILE_CACHE overrides the location."""
     try:
         import jax
-        # decide from config/env (NOT jax.default_backend(), which would
-        # eagerly initialize the backend and lock the platform before
-        # callers like __graft_entry__._honor_platform_env can set it)
+        # ordering contract: call AFTER any jax.config platform override
+        # (like __graft_entry__._honor_platform_env). Explicit requests
+        # are read from config/env without touching the backend; only
+        # when NOTHING was requested do we ask default_backend(), which
+        # initializes (and thereby pins) the default platform
         plat = None
         try:
             plat = jax.config.jax_platforms
