@@ -27,16 +27,8 @@ def init_distributed() -> bool:
     # a JAX_PLATFORMS request must win over any sitecustomize-forced
     # platform, or every worker initializes the single-chip backend and
     # sees world size 1
-    want = os.environ.get("JAX_PLATFORMS")
-    if want:
-        try:
-            jax.config.update("jax_platforms", want)
-        except Exception as e:
-            import warnings
-            warnings.warn(
-                f"could not select JAX_PLATFORMS={want!r} ({e}); "
-                "distributed init may land on the wrong backend and "
-                "report world size 1")
+    from .util import honor_platform_env
+    honor_platform_env()
     jax.distributed.initialize(coordinator_address=coord,
                                num_processes=nproc, process_id=rank)
     return True

@@ -87,3 +87,19 @@ def enable_compile_cache(cache_dir=None):
         return True
     except Exception:
         return False
+
+
+def honor_platform_env():
+    """Re-apply a JAX_PLATFORMS request over any sitecustomize-forced
+    platform. Must run before the first backend initialization; a no-op
+    afterwards. Shared by __graft_entry__, tools/bandwidth.py, and
+    kvstore_server.init_distributed."""
+    want = os.environ.get("JAX_PLATFORMS")
+    if not want:
+        return
+    try:
+        import jax
+        jax.config.update("jax_platforms", want)
+    except Exception as e:
+        import warnings
+        warnings.warn(f"could not select JAX_PLATFORMS={want!r} ({e})")

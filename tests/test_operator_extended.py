@@ -1,0 +1,193 @@
+"""Extended operator coverage: samplers, ordering, sequence ops, spatial
+sampling, indexing edge cases (ref: test_operator.py families with thin
+coverage in the base sweep)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+# ---------------------------------------------------------------------------
+# random samplers: moment sanity (ref: test_random.py)
+# ---------------------------------------------------------------------------
+
+def test_uniform_moments():
+    mx.random.seed(7)
+    x = mx.random.uniform(2.0, 6.0, shape=(20000,)).asnumpy()
+    assert 3.8 < x.mean() < 4.2
+    assert x.min() >= 2.0 and x.max() <= 6.0
+
+
+def test_normal_moments():
+    mx.random.seed(7)
+    x = mx.random.normal(1.0, 2.0, shape=(20000,)).asnumpy()
+    assert abs(x.mean() - 1.0) < 0.1
+    assert abs(x.std() - 2.0) < 0.1
+
+
+def test_poisson_gamma_exponential_moments():
+    mx.random.seed(3)
+    p = mx.random.poisson(4.0, shape=(20000,)).asnumpy()
+    assert abs(p.mean() - 4.0) < 0.15
+    g = mx.random.gamma(3.0, 2.0, shape=(20000,)).asnumpy()
+    assert abs(g.mean() - 6.0) < 0.3   # mean = alpha*beta
+    e = mx.random.exponential(0.5, shape=(20000,)).asnumpy()
+    assert abs(e.mean() - 0.5) < 0.05
+
+
+def test_seed_reproducibility():
+    mx.random.seed(42)
+    a = mx.random.uniform(shape=(100,)).asnumpy()
+    mx.random.seed(42)
+    b = mx.random.uniform(shape=(100,)).asnumpy()
+    np.testing.assert_array_equal(a, b)
+    c = mx.random.uniform(shape=(100,)).asnumpy()
+    assert not np.array_equal(b, c)
+
+
+def test_multinomial_distribution():
+    mx.random.seed(0)
+    probs = nd.array(np.array([[0.7, 0.2, 0.1]], np.float32))
+    draws = np.concatenate([
+        nd.sample_multinomial(probs, shape=(500,)).asnumpy().reshape(-1)
+        for _ in range(4)])
+    frac0 = (draws == 0).mean()
+    assert 0.6 < frac0 < 0.8
+
+
+def test_shuffle_is_permutation():
+    mx.random.seed(1)
+    x = nd.array(np.arange(32, dtype=np.float32))
+    y = nd.shuffle(x).asnumpy()
+    assert sorted(y.tolist()) == list(range(32))
+
+
+# ---------------------------------------------------------------------------
+# ordering ops (ref: test_operator.py test_order)
+# ---------------------------------------------------------------------------
+
+def test_topk_values_and_indices():
+    x = np.array([[3.0, 1.0, 4.0, 1.5], [2.0, 7.0, 5.0, 0.0]], np.float32)
+    v = nd.topk(nd.array(x), k=2, ret_typ="value", axis=-1).asnumpy()
+    assert_almost_equal(v, np.array([[4.0, 3.0], [7.0, 5.0]], np.float32))
+    i = nd.topk(nd.array(x), k=2, ret_typ="indices", axis=-1).asnumpy()
+    assert i.tolist() == [[2, 0], [1, 2]]
+
+
+def test_sort_argsort_descending():
+    x = np.array([3.0, 1.0, 2.0], np.float32)
+    assert nd.sort(nd.array(x), is_ascend=False).asnumpy().tolist() == \
+        [3.0, 2.0, 1.0]
+    assert nd.argsort(nd.array(x), is_ascend=False).asnumpy().tolist() == \
+        [0, 2, 1]
+
+
+def test_argmax_argmin_axes():
+    x = np.array([[3.0, 9.0, 4.0], [8.0, 1.0, 5.0]], np.float32)
+    assert nd.argmax(nd.array(x), axis=0).asnumpy().tolist() == [1, 0, 1]
+    assert nd.argmin(nd.array(x), axis=1).asnumpy().tolist() == [0, 1]
+
+
+# ---------------------------------------------------------------------------
+# sequence ops (ref: test_operator.py test_sequence_*)
+# ---------------------------------------------------------------------------
+
+def test_sequence_mask_last_reverse():
+    # (T, B, D) = (4, 2, 1)
+    x = np.arange(8, dtype=np.float32).reshape(4, 2, 1)
+    lengths = nd.array(np.array([2.0, 3.0], np.float32))
+    masked = nd.SequenceMask(nd.array(x), lengths,
+                             use_sequence_length=True, value=-1.0).asnumpy()
+    assert masked[2, 0, 0] == -1.0 and masked[3, 1, 0] == -1.0
+    assert masked[1, 0, 0] == x[1, 0, 0] and masked[2, 1, 0] == x[2, 1, 0]
+    last = nd.SequenceLast(nd.array(x), lengths,
+                           use_sequence_length=True).asnumpy()
+    assert last[0, 0] == x[1, 0, 0] and last[1, 0] == x[2, 1, 0]
+    rev = nd.SequenceReverse(nd.array(x), lengths,
+                             use_sequence_length=True).asnumpy()
+    assert rev[0, 0, 0] == x[1, 0, 0]  # batch 0 reversed within length 2
+    assert rev[0, 1, 0] == x[2, 1, 0]  # batch 1 reversed within length 3
+    assert rev[3, 0, 0] == x[3, 0, 0]  # beyond length: untouched
+
+
+# ---------------------------------------------------------------------------
+# spatial sampling (ref: test_operator.py test_bilinear_sampler /
+# test_spatial_transformer against manual grids)
+# ---------------------------------------------------------------------------
+
+def test_bilinear_sampler_identity_grid():
+    rs = np.random.RandomState(0)
+    x = rs.randn(1, 2, 5, 5).astype(np.float32)
+    ys, xs = np.meshgrid(np.linspace(-1, 1, 5), np.linspace(-1, 1, 5),
+                         indexing="ij")
+    grid = np.stack([xs, ys])[None].astype(np.float32)  # (1, 2, 5, 5)
+    out = nd.BilinearSampler(nd.array(x), nd.array(grid)).asnumpy()
+    assert_almost_equal(out, x, rtol=1e-4, atol=1e-5)
+
+
+def test_grid_generator_affine_identity():
+    theta = nd.array(np.array([[1, 0, 0, 0, 1, 0]], np.float32))
+    grid = nd.GridGenerator(theta, transform_type="affine",
+                            target_shape=(4, 4)).asnumpy()
+    ys, xs = np.meshgrid(np.linspace(-1, 1, 4), np.linspace(-1, 1, 4),
+                         indexing="ij")
+    assert_almost_equal(grid[0, 0], xs.astype(np.float32), rtol=1e-5,
+                        atol=1e-5)
+    assert_almost_equal(grid[0, 1], ys.astype(np.float32), rtol=1e-5,
+                        atol=1e-5)
+
+
+def test_upsampling_nearest():
+    x = np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2)
+    out = nd.UpSampling(nd.array(x), scale=2,
+                        sample_type="nearest").asnumpy()
+    assert out.shape == (1, 1, 4, 4)
+    assert out[0, 0, 0, 0] == 0 and out[0, 0, 0, 1] == 0
+    assert out[0, 0, 3, 3] == 3
+
+
+# ---------------------------------------------------------------------------
+# indexing edge cases
+# ---------------------------------------------------------------------------
+
+def test_one_hot_and_pick():
+    idx = nd.array(np.array([0.0, 2.0], np.float32))
+    oh = nd.one_hot(idx, depth=3, on_value=5.0, off_value=-1.0).asnumpy()
+    assert_almost_equal(oh, np.array([[5, -1, -1], [-1, -1, 5]],
+                                     np.float32))
+    x = np.array([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]], np.float32)
+    picked = nd.pick(nd.array(x), idx, axis=1).asnumpy()
+    assert picked.tolist() == [1.0, 6.0]
+
+
+def test_gather_nd_scatter_nd_roundtrip():
+    data = np.arange(12, dtype=np.float32).reshape(3, 4)
+    indices = nd.array(np.array([[0, 2], [1, 3]], np.float32))
+    g = nd.gather_nd(nd.array(data), indices).asnumpy()
+    assert g.tolist() == [data[0, 1], data[2, 3]]
+    s = nd.scatter_nd(nd.array(np.array([10.0, 20.0], np.float32)),
+                      indices, shape=(3, 4)).asnumpy()
+    assert s[0, 1] == 10.0 and s[2, 3] == 20.0 and s.sum() == 30.0
+
+
+def test_take_clip_and_wrap_modes():
+    x = np.arange(6, dtype=np.float32).reshape(3, 2)
+    idx = nd.array(np.array([-1.0, 4.0], np.float32))
+    clip = nd.take(nd.array(x), idx, mode="clip").asnumpy()
+    assert clip[0].tolist() == [0.0, 1.0] and clip[1].tolist() == [4.0, 5.0]
+    wrap = nd.take(nd.array(x), idx, mode="wrap").asnumpy()
+    assert wrap[0].tolist() == [4.0, 5.0] and wrap[1].tolist() == [2.0, 3.0]
+
+
+def test_where_broadcast_and_grad():
+    cond = nd.array(np.array([1.0, 0.0, 1.0], np.float32))
+    a = nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+    b = nd.array(np.array([10.0, 20.0, 30.0], np.float32))
+    a.attach_grad()
+    with autograd.record():
+        out = nd.where(cond, a, b)
+        out.sum().backward()
+    assert out.asnumpy().tolist() == [1.0, 20.0, 3.0]
+    assert a.grad.asnumpy().tolist() == [1.0, 0.0, 1.0]

@@ -26,14 +26,8 @@ def main():
     args = ap.parse_args()
 
     import jax
-    # honor a JAX_PLATFORMS request over any sitecustomize-forced platform
-    # (same contract as __graft_entry__._honor_platform_env)
-    want = os.environ.get("JAX_PLATFORMS")
-    if want:
-        try:
-            jax.config.update("jax_platforms", want)
-        except Exception:
-            pass
+    from mxnet_tpu.util import honor_platform_env
+    honor_platform_env()
     from mxnet_tpu.parallel import make_mesh, measure_allreduce_bandwidth
 
     n = args.num_devices or len(jax.devices())
