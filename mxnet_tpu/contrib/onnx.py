@@ -389,6 +389,12 @@ def _mk_node(op_type, inputs, outputs, name, **attrs):
 
 def _emit_conv(node, em):
     a = node.attrs
+    layout = str(a.get("layout") or "")
+    if layout.endswith("C"):
+        raise MXNetError(
+            f"mx2onnx: {node.name} uses channel-last layout {layout}; "
+            "ONNX convolution is channel-first — build the exported net "
+            "in NCHW")
     ins = _in_names(node)
     attrs = {"kernel_shape": _ints(a["kernel"]),
              "group": int(a.get("num_group", 1))}
@@ -473,6 +479,12 @@ def _emit_act(node, em):
 
 def _emit_pool(node, em):
     a = node.attrs
+    layout = str(a.get("layout") or "")
+    if layout.endswith("C"):
+        raise MXNetError(
+            f"mx2onnx: {node.name} uses channel-last layout {layout}; "
+            "ONNX pooling is channel-first — build the exported net "
+            "in NCHW")
     ptype = str(a.get("pool_type", "max"))
     if ptype not in ("max", "avg"):
         raise MXNetError(f"Pooling type {ptype} has no ONNX mapping")

@@ -27,15 +27,29 @@ class _Conv(HybridBlock):
         self._in_channels = in_channels
         nd = len(kernel_size)
         self._op_name = op_name
+        self._layout = layout
+        self._channel_axis = layout.index("C") if layout else 1
         self._kwargs = {
             "kernel": kernel_size, "stride": _tup(strides, nd),
             "dilate": _tup(dilation, nd), "pad": _tup(padding, nd),
             "num_filter": channels, "num_group": groups,
-            "no_bias": not use_bias}
+            "no_bias": not use_bias, "layout": layout}
         if adj is not None:
             self._kwargs["adj"] = _tup(adj, nd)
+        channel_last = bool(layout) and layout.endswith("C")
+        if channel_last and op_name != "Convolution":
+            from ...base import MXNetError
+            raise MXNetError(
+                f"{op_name} supports channel-first layouts only; got "
+                f"{layout!r}")
         if op_name == "Convolution":
-            wshape = (channels, in_channels // groups) + tuple(kernel_size)
+            if channel_last:
+                # MXNet NHWC weight convention: (O, *k, I/groups)
+                wshape = (channels,) + tuple(kernel_size) + \
+                    (in_channels // groups,)
+            else:
+                wshape = (channels, in_channels // groups) + \
+                    tuple(kernel_size)
         else:  # Deconvolution: weight is (in, out/g, *k)
             wshape = (in_channels, channels // groups) + tuple(kernel_size)
         self.weight = self.params.get("weight", shape=wshape,
@@ -49,10 +63,14 @@ class _Conv(HybridBlock):
         self._activation = activation
 
     def infer_shape_from_inputs(self, x):
-        c = x.shape[1]
+        c = x.shape[self._channel_axis]
         w = self.weight
+        g = self._kwargs["num_group"]
         if self._op_name == "Convolution":
-            shape = (w.shape[0], c // self._kwargs["num_group"]) + w.shape[2:]
+            if self._layout and self._layout.endswith("C"):
+                shape = (w.shape[0],) + w.shape[1:-1] + (c // g,)
+            else:
+                shape = (w.shape[0], c // g) + w.shape[2:]
         else:
             shape = (c,) + w.shape[1:]
         w.shape_hint(shape)
@@ -140,7 +158,8 @@ class Conv3DTranspose(_Conv):
 
 class _Pooling(HybridBlock):
     def __init__(self, pool_size, strides, padding, ceil_mode, global_pool,
-                 pool_type, count_include_pad=None, prefix=None, params=None):
+                 pool_type, layout=None, count_include_pad=None,
+                 prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
         if strides is None:
             strides = pool_size
@@ -150,6 +169,8 @@ class _Pooling(HybridBlock):
             "pad": _tup(padding, nd), "pool_type": pool_type,
             "global_pool": global_pool,
             "pooling_convention": "full" if ceil_mode else "valid"}
+        if layout is not None:
+            self._kwargs["layout"] = layout
         if count_include_pad is not None:
             self._kwargs["count_include_pad"] = count_include_pad
 
@@ -164,35 +185,37 @@ class MaxPool1D(_Pooling):
     def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
                  ceil_mode=False, **kw):
         super().__init__(_tup(pool_size, 1), strides, padding, ceil_mode,
-                         False, "max", **kw)
+                         False, "max", layout=layout, **kw)
 
 
 class MaxPool2D(_Pooling):
     def __init__(self, pool_size=(2, 2), strides=None, padding=0,
                  layout="NCHW", ceil_mode=False, **kw):
         super().__init__(_tup(pool_size, 2), strides, padding, ceil_mode,
-                         False, "max", **kw)
+                         False, "max", layout=layout, **kw)
 
 
 class MaxPool3D(_Pooling):
     def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
                  layout="NCDHW", ceil_mode=False, **kw):
         super().__init__(_tup(pool_size, 3), strides, padding, ceil_mode,
-                         False, "max", **kw)
+                         False, "max", layout=layout, **kw)
 
 
 class AvgPool1D(_Pooling):
     def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
                  ceil_mode=False, count_include_pad=True, **kw):
         super().__init__(_tup(pool_size, 1), strides, padding, ceil_mode,
-                         False, "avg", count_include_pad, **kw)
+                         False, "avg", layout=layout,
+                         count_include_pad=count_include_pad, **kw)
 
 
 class AvgPool2D(_Pooling):
     def __init__(self, pool_size=(2, 2), strides=None, padding=0,
                  layout="NCHW", ceil_mode=False, count_include_pad=True, **kw):
         super().__init__(_tup(pool_size, 2), strides, padding, ceil_mode,
-                         False, "avg", count_include_pad, **kw)
+                         False, "avg", layout=layout,
+                         count_include_pad=count_include_pad, **kw)
 
 
 class AvgPool3D(_Pooling):
@@ -200,37 +223,44 @@ class AvgPool3D(_Pooling):
                  layout="NCDHW", ceil_mode=False, count_include_pad=True,
                  **kw):
         super().__init__(_tup(pool_size, 3), strides, padding, ceil_mode,
-                         False, "avg", count_include_pad, **kw)
+                         False, "avg", layout=layout,
+                         count_include_pad=count_include_pad, **kw)
 
 
 class GlobalMaxPool1D(_Pooling):
     def __init__(self, layout="NCW", **kw):
-        super().__init__((1,), None, 0, False, True, "max", **kw)
+        super().__init__((1,), None, 0, False, True, "max", layout=layout,
+                         **kw)
 
 
 class GlobalMaxPool2D(_Pooling):
     def __init__(self, layout="NCHW", **kw):
-        super().__init__((1, 1), None, 0, False, True, "max", **kw)
+        super().__init__((1, 1), None, 0, False, True, "max", layout=layout,
+                         **kw)
 
 
 class GlobalMaxPool3D(_Pooling):
     def __init__(self, layout="NCDHW", **kw):
-        super().__init__((1, 1, 1), None, 0, False, True, "max", **kw)
+        super().__init__((1, 1, 1), None, 0, False, True, "max",
+                         layout=layout, **kw)
 
 
 class GlobalAvgPool1D(_Pooling):
     def __init__(self, layout="NCW", **kw):
-        super().__init__((1,), None, 0, False, True, "avg", **kw)
+        super().__init__((1,), None, 0, False, True, "avg", layout=layout,
+                         **kw)
 
 
 class GlobalAvgPool2D(_Pooling):
     def __init__(self, layout="NCHW", **kw):
-        super().__init__((1, 1), None, 0, False, True, "avg", **kw)
+        super().__init__((1, 1), None, 0, False, True, "avg", layout=layout,
+                         **kw)
 
 
 class GlobalAvgPool3D(_Pooling):
     def __init__(self, layout="NCDHW", **kw):
-        super().__init__((1, 1, 1), None, 0, False, True, "avg", **kw)
+        super().__init__((1, 1, 1), None, 0, False, True, "avg",
+                         layout=layout, **kw)
 
 
 class ReflectionPad2D(HybridBlock):

@@ -74,9 +74,23 @@ def _fully_connected(data, weight, *maybe_bias, num_hidden=1, no_bias=False,
 # deconvolution.cc; im2col replaced by XLA's native conv lowering)
 # ---------------------------------------------------------------------------
 
-_CONV_DN = {1: ("NCW", "OIW", "NCW"),
-            2: ("NCHW", "OIHW", "NCHW"),
-            3: ("NCDHW", "OIDHW", "NCDHW")}
+# data layout -> (lhs, rhs, out) dimension-number specs. Channel-last
+# ("TPU-native": C rides the 128-lane minor dim) uses MXNet's NHWC weight
+# convention (num_filter, *spatial, C/num_group) = O...I.
+_CONV_DN = {"NCW": ("NCW", "OIW", "NCW"),
+            "NWC": ("NWC", "OWI", "NWC"),
+            "NCHW": ("NCHW", "OIHW", "NCHW"),
+            "NHWC": ("NHWC", "OHWI", "NHWC"),
+            "NCDHW": ("NCDHW", "OIDHW", "NCDHW"),
+            "NDHWC": ("NDHWC", "ODHWI", "NDHWC")}
+_DEFAULT_LAYOUT = {1: "NCW", 2: "NCHW", 3: "NCDHW"}
+
+
+def _conv_layout(layout, nd):
+    layout = layout or _DEFAULT_LAYOUT[nd]
+    if layout not in _CONV_DN or len(layout) != nd + 2:
+        raise MXNetError(f"unsupported {nd}-d conv layout {layout!r}")
+    return layout
 
 
 @register("Convolution", aliases=("conv2d",))
@@ -88,7 +102,9 @@ def _convolution(data, weight, *maybe_bias, kernel=(), stride=(), dilate=(),
     stride = _tuplify(stride, nd)
     dilate = _tuplify(dilate, nd)
     pad = _tuplify(pad if pad else 0, nd)
-    dn = lax.conv_dimension_numbers(data.shape, weight.shape, _CONV_DN[nd])
+    layout = _conv_layout(layout, nd)
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape,
+                                    _CONV_DN[layout])
     out = lax.conv_general_dilated(
         data, weight,
         window_strides=stride,
@@ -99,7 +115,9 @@ def _convolution(data, weight, *maybe_bias, kernel=(), stride=(), dilate=(),
     )
     if not no_bias and maybe_bias:
         bias = maybe_bias[0]
-        out = out + bias.reshape((1, -1) + (1,) * nd)
+        bshape = [1] * (nd + 2)
+        bshape[layout.index("C")] = -1
+        out = out + bias.reshape(tuple(bshape))
     return out
 
 
@@ -111,11 +129,16 @@ def _deconvolution(data, weight, *maybe_bias, kernel=(), stride=(), dilate=(),
     lax = _lax()
     nd = len(kernel)
     stride = _tuplify(stride, nd)
+    dilate = _tuplify(dilate if dilate else 1, nd)
     pad = _tuplify(pad if pad else 0, nd)
     adj = _tuplify(adj if adj else 0, nd)
     # transposed conv = gradient of conv wrt input: lhs-dilate by stride.
     pads = [(kernel[i] - 1 - pad[i], kernel[i] - 1 - pad[i] + adj[i])
             for i in range(nd)]
+    if layout is not None and layout not in ("NCW", "NCHW", "NCDHW"):
+        raise MXNetError(
+            "Deconvolution supports channel-first layouts only (transpose "
+            "channel-last data around the op)")
     # weight layout is (C_in, num_filter, *k); with transpose_kernel=True
     # lax treats the "OIHW" spec relative to the FORWARD conv, giving the
     # exact gradient-of-conv semantics the reference implements
@@ -124,7 +147,8 @@ def _deconvolution(data, weight, *maybe_bias, kernel=(), stride=(), dilate=(),
     if num_group != 1:
         raise MXNetError("grouped Deconvolution not yet supported")
     out = lax.conv_transpose(data, weight, strides=stride, padding=pads,
-                             dimension_numbers=dn, transpose_kernel=True)
+                             rhs_dilation=dilate, dimension_numbers=dn,
+                             transpose_kernel=True)
     if not no_bias and maybe_bias:
         out = out + maybe_bias[0].reshape((1, -1) + (1,) * nd)
     return out
@@ -140,8 +164,11 @@ def _pooling(data, kernel=(), pool_type="max", global_pool=False,
              p_value=2, count_include_pad=True, layout=None):
     jnp, lax = _jnp(), _lax()
     nd = data.ndim - 2
+    layout = _conv_layout(layout, nd)
+    # spatial axis positions for the layout (channel-first: 2..; NHWC: 1..)
+    spatial = [layout.index(c) for c in layout if c not in ("N", "C")]
     if global_pool:
-        axes = tuple(range(2, data.ndim))
+        axes = tuple(spatial)
         if pool_type == "max":
             return jnp.max(data, axis=axes, keepdims=True)
         if pool_type in ("avg", "sum"):
@@ -162,14 +189,19 @@ def _pooling(data, kernel=(), pool_type="max", global_pool=False,
     extra = [0] * nd
     if pooling_convention == "full":
         for i in range(nd):
-            in_i = data.shape[2 + i]
+            in_i = data.shape[spatial[i]]
             out_i = -(-(in_i + 2 * pad[i] - kernel[i]) // stride[i]) + 1  # ceil
             need = (out_i - 1) * stride[i] + kernel[i] - in_i - 2 * pad[i]
             extra[i] = max(0, need)
 
-    window = (1, 1) + kernel
-    strides = (1, 1) + stride
-    pads = ((0, 0), (0, 0)) + tuple((pad[i], pad[i] + extra[i]) for i in range(nd))
+    window = [1] * (nd + 2)
+    strides = [1] * (nd + 2)
+    pads = [(0, 0)] * (nd + 2)
+    for i, ax in enumerate(spatial):
+        window[ax] = kernel[i]
+        strides[ax] = stride[i]
+        pads[ax] = (pad[i], pad[i] + extra[i])
+    window, strides, pads = tuple(window), tuple(strides), tuple(pads)
 
     if pool_type == "max":
         init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else \

@@ -361,8 +361,13 @@ def _conv_hint(in_shapes, params):
     kernel = tuple(params.get("kernel", ()))
     nf = int(params.get("num_filter", 1))
     g = int(params.get("num_group", 1))
+    layout = params.get("layout") or ""
+    channel_last = layout.endswith("C")
+    c = data[-1] if channel_last else data[1]
     if len(in_shapes) > 1 and in_shapes[1] is None:
-        out[1] = (nf, data[1] // g) + kernel
+        # channel-last follows the NHWC weight convention (O, *k, I/g)
+        out[1] = (nf,) + kernel + (c // g,) if channel_last \
+            else (nf, c // g) + kernel
     if len(in_shapes) > 2 and in_shapes[2] is None:
         out[2] = (nf,)
     return out

@@ -1,0 +1,171 @@
+"""Channel-last (NHWC/NWC) layout support for conv/pooling — the
+TPU-native layout (C on the 128-lane minor dim); numerics must match the
+channel-first path bit-for-bit (ref: test_operator.py layout tests)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_conv_nhwc_matches_nchw():
+    rs = np.random.RandomState(0)
+    x = rs.randn(2, 4, 6, 6).astype(np.float32)
+    w = rs.randn(8, 4, 3, 3).astype(np.float32)
+    b = rs.randn(8).astype(np.float32)
+    ref = nd.Convolution(nd.array(x), nd.array(w), nd.array(b),
+                         kernel=(3, 3), num_filter=8, pad=(1, 1),
+                         stride=(2, 2)).asnumpy()
+    out = nd.Convolution(nd.array(np.transpose(x, (0, 2, 3, 1))),
+                         nd.array(np.transpose(w, (0, 2, 3, 1))),
+                         nd.array(b), kernel=(3, 3), num_filter=8,
+                         pad=(1, 1), stride=(2, 2),
+                         layout="NHWC").asnumpy()
+    assert_almost_equal(np.transpose(out, (0, 3, 1, 2)), ref)
+
+
+def test_conv_nwc_1d():
+    rs = np.random.RandomState(1)
+    x = rs.randn(2, 4, 10).astype(np.float32)
+    w = rs.randn(6, 4, 3).astype(np.float32)
+    ref = nd.Convolution(nd.array(x), nd.array(w), kernel=(3,),
+                         num_filter=6, no_bias=True).asnumpy()
+    out = nd.Convolution(nd.array(np.transpose(x, (0, 2, 1))),
+                         nd.array(np.transpose(w, (0, 2, 1))),
+                         kernel=(3,), num_filter=6, no_bias=True,
+                         layout="NWC").asnumpy()
+    assert_almost_equal(np.transpose(out, (0, 2, 1)), ref)
+
+
+def test_conv_nhwc_grouped():
+    rs = np.random.RandomState(2)
+    x = rs.randn(1, 4, 5, 5).astype(np.float32)
+    w = rs.randn(4, 2, 3, 3).astype(np.float32)
+    ref = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                         num_filter=4, num_group=2, pad=(1, 1),
+                         no_bias=True).asnumpy()
+    out = nd.Convolution(nd.array(np.transpose(x, (0, 2, 3, 1))),
+                         nd.array(np.transpose(w, (0, 2, 3, 1))),
+                         kernel=(3, 3), num_filter=4, num_group=2,
+                         pad=(1, 1), no_bias=True, layout="NHWC").asnumpy()
+    assert_almost_equal(np.transpose(out, (0, 3, 1, 2)), ref)
+
+
+@pytest.mark.parametrize("pool_type", ["max", "avg"])
+def test_pooling_nhwc(pool_type):
+    rs = np.random.RandomState(3)
+    x = rs.randn(2, 3, 8, 8).astype(np.float32)
+    ref = nd.Pooling(nd.array(x), kernel=(3, 3), stride=(2, 2),
+                     pad=(1, 1), pool_type=pool_type).asnumpy()
+    out = nd.Pooling(nd.array(np.transpose(x, (0, 2, 3, 1))),
+                     kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                     pool_type=pool_type, layout="NHWC").asnumpy()
+    assert_almost_equal(np.transpose(out, (0, 3, 1, 2)), ref)
+
+
+def test_pooling_nhwc_global_and_ceil():
+    rs = np.random.RandomState(4)
+    x = rs.randn(2, 3, 7, 7).astype(np.float32)
+    xh = np.transpose(x, (0, 2, 3, 1))
+    gp = nd.Pooling(nd.array(xh), kernel=(1, 1), global_pool=True,
+                    pool_type="avg", layout="NHWC").asnumpy()
+    assert gp.shape == (2, 1, 1, 3)
+    assert_almost_equal(gp.reshape(2, 3), x.mean(axis=(2, 3)), rtol=1e-5)
+    ceil = nd.Pooling(nd.array(xh), kernel=(2, 2), stride=(2, 2),
+                      pooling_convention="full", pool_type="max",
+                      layout="NHWC").asnumpy()
+    assert ceil.shape == (2, 4, 4, 3)
+
+
+def test_nhwc_gradients():
+    from mxnet_tpu.test_utils import check_numeric_gradient
+    rs = np.random.RandomState(5)
+    check_numeric_gradient(
+        lambda x, w: nd.Convolution(x, w, kernel=(3, 3), num_filter=3,
+                                    pad=(1, 1), no_bias=True,
+                                    layout="NHWC"),
+        [rs.randn(1, 5, 5, 2).astype(np.float32) * 0.5,
+         rs.randn(3, 3, 3, 2).astype(np.float32) * 0.3],
+        rtol=2e-2, atol=1e-3)
+
+
+def test_gluon_nhwc_net_trains():
+    rs = np.random.RandomState(6)
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1, layout="NHWC", activation="relu"),
+            nn.BatchNorm(axis=-1),
+            nn.MaxPool2D(2, 2, layout="NHWC"),
+            nn.GlobalAvgPool2D(layout="NHWC"),
+            nn.Flatten(), nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    x = nd.array(rs.randn(2, 8, 8, 3).astype(np.float32))
+    from mxnet_tpu import gluon
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.01})
+    losses = []
+    for _ in range(4):
+        with autograd.record():
+            loss = (net(x) ** 2).mean()
+        loss.backward()
+        tr.step(2)
+        losses.append(float(loss.asscalar()))
+    assert losses[-1] < losses[0]
+
+
+def test_deconv_channel_last_raises():
+    with pytest.raises(MXNetError, match="channel-first"):
+        nd.Deconvolution(nd.zeros((1, 4, 4, 2)), nd.zeros((2, 3, 3, 4)),
+                         kernel=(3, 3), num_filter=4, layout="NHWC")
+
+
+def test_bad_layout_raises():
+    with pytest.raises(MXNetError, match="layout"):
+        nd.Convolution(nd.zeros((1, 2, 4, 4)), nd.zeros((3, 2, 3, 3)),
+                       kernel=(3, 3), num_filter=3, no_bias=True,
+                       layout="CHWN")
+
+
+def test_symbolic_nhwc_weight_inference():
+    """PARAM_SHAPE_HINTS honors layout: NHWC conv weight is (O, *k, I/g)."""
+    from mxnet_tpu import symbol as S
+    from mxnet_tpu.symbol.symbol import create
+    sym = create("Convolution", [S.var("data"), S.var("w")],
+                 {"kernel": (3, 3), "num_filter": 8, "pad": (1, 1),
+                  "no_bias": True, "layout": "NHWC"})
+    args, outs, _ = sym.infer_shape(data=(2, 6, 6, 4))
+    assert (8, 3, 3, 4) in args
+    assert outs == [(2, 6, 6, 8)]
+
+
+def test_deconv_dilation_applied():
+    x = np.zeros((1, 1, 5, 5), np.float32)
+    x[0, 0, 2, 2] = 1.0
+    w = np.ones((1, 1, 2, 2), np.float32)
+    out = nd.Deconvolution(nd.array(x), nd.array(w), kernel=(2, 2),
+                           num_filter=1, dilate=(2, 2),
+                           no_bias=True).asnumpy()
+    # dilated 2x2 kernel spreads the impulse to a 3-spaced pattern
+    nz = np.argwhere(out[0, 0] > 0)
+    ys = sorted(set(nz[:, 0].tolist()))
+    assert ys[1] - ys[0] == 2, out[0, 0]
+
+
+def test_conv_transpose_channel_last_rejected_at_init():
+    with pytest.raises(MXNetError, match="channel-first"):
+        nn.Conv2DTranspose(8, 3, layout="NHWC")
+
+
+def test_onnx_export_rejects_channel_last(tmp_path):
+    from mxnet_tpu.contrib import onnx as mxonnx
+    from mxnet_tpu import symbol as S
+    from mxnet_tpu.symbol.symbol import create
+    sym = create("Convolution", [S.var("data"), S.var("w")],
+                 {"kernel": (3, 3), "num_filter": 4, "no_bias": True,
+                  "layout": "NHWC"})
+    with pytest.raises(MXNetError, match="channel-last"):
+        mxonnx.export_model(
+            sym, {"w": mx.nd.zeros((4, 3, 3, 2))}, [(1, 6, 6, 2)],
+            onnx_file_path=str(tmp_path / "x.onnx"))
