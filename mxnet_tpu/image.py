@@ -23,7 +23,9 @@ __all__ = ["imresize", "imdecode", "fixed_crop", "center_crop",
            "CastAug", "ColorNormalizeAug", "RandomCropAug", "CenterCropAug",
            "ResizeAug", "ForceResizeAug", "BrightnessJitterAug",
            "ContrastJitterAug", "SaturationJitterAug", "LightingAug",
-           "ColorJitterAug", "CreateAugmenter", "ImageIter"]
+           "ColorJitterAug", "CreateAugmenter", "ImageIter",
+           "DetAugmenter", "DetBorrowAug", "DetHorizontalFlipAug",
+           "DetRandomCropAug", "DetForceResizeAug", "CreateDetAugmenter"]
 
 
 def imdecode(buf, flag=1, to_rgb=True, out=None):
@@ -279,6 +281,14 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
     return auglist
 
 
+def to_chw(x) -> _np.ndarray:
+    """HWC NDArray/array -> CHW float numpy (no-op for non-3-channel)."""
+    arr = x.asnumpy() if hasattr(x, "asnumpy") else _np.asarray(x)
+    if arr.ndim == 3 and arr.shape[2] in (1, 3):
+        arr = arr.transpose(2, 0, 1)
+    return arr
+
+
 def decode_and_augment(rec, auglist):
     """Shared per-record pipeline: unpack -> augment -> CHW float32.
 
@@ -290,10 +300,7 @@ def decode_and_augment(rec, auglist):
     x = _nd2.array(img.astype(_np.float32))
     for aug in auglist:
         x = aug(x)
-    arr = x.asnumpy()
-    if arr.ndim == 3 and arr.shape[2] in (1, 3):
-        arr = arr.transpose(2, 0, 1)
-    return arr, _np.asarray(header.label, _np.float32)
+    return to_chw(x), _np.asarray(header.label, _np.float32)
 
 
 class ImageIter(DataIter):
@@ -347,3 +354,140 @@ class ImageIter(DataIter):
             i += 1
         return DataBatch([_nd.array(batch)], [_nd.array(labels)],
                          pad=self.batch_size - i)
+
+
+# ---------------------------------------------------------------------------
+# Detection augmenters: image + boxes transformed JOINTLY
+# (ref: python/mxnet/image/detection.py DetBorrowAug/DetHorizontalFlipAug/
+#  DetRandomCropAug/CreateDetAugmenter). Labels are (N, 5+) rows
+# [id, xmin, ymin, xmax, ymax, ...] with coords normalized to [0, 1].
+# ---------------------------------------------------------------------------
+
+class DetAugmenter:
+    """Base: __call__(src, label) -> (src, label)."""
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Wrap a plain (image-only) augmenter — must be box-preserving
+    (color/cast/normalize/exact-resize) (ref: detection.py DetBorrowAug)."""
+
+    def __init__(self, augmenter):
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Flip image and boxes together with probability p
+    (ref: detection.py DetHorizontalFlipAug)."""
+
+    def __init__(self, p=0.5):
+        self.p = p
+
+    def __call__(self, src, label):
+        if _np.random.random() < self.p:
+            src = src.flip(axis=1)
+            label = label.copy()
+            xmin = label[:, 1].copy()
+            label[:, 1] = 1.0 - label[:, 3]
+            label[:, 3] = 1.0 - xmin
+        return src, label
+
+
+class DetRandomCropAug(DetAugmenter):
+    """Random crop keeping boxes (clipped to the crop, dropped when the
+    remaining overlap falls under min_object_covered)
+    (ref: detection.py DetRandomCropAug, simplified: aspect/area sampled
+    within bounds, constraint = per-object coverage)."""
+
+    def __init__(self, min_object_covered=0.3, min_crop_size=0.5,
+                 max_attempts=10):
+        self.min_object_covered = min_object_covered
+        self.min_crop_size = min_crop_size
+        self.max_attempts = max_attempts
+
+    def __call__(self, src, label):
+        h, w = src.shape[0], src.shape[1]
+        for _ in range(self.max_attempts):
+            s = _np.random.uniform(self.min_crop_size, 1.0)
+            # snap the window to whole pixels FIRST so boxes renormalize
+            # against exactly the pixels that were kept
+            wi = max(int(round(s * w)), 1)
+            hi = max(int(round(s * h)), 1)
+            xi = _np.random.randint(0, w - wi + 1)
+            yi = _np.random.randint(0, h - hi + 1)
+            x0, y0 = xi / w, yi / h
+            cw, ch = wi / w, hi / h
+            new = self._crop_boxes(label, x0, y0, cw, ch)
+            if new.shape[0] > 0:
+                src = fixed_crop(src, xi, yi, wi, hi)
+                return src, new
+        return src, label
+
+    def _crop_boxes(self, label, x0, y0, cw, ch):
+        out = []
+        for row in _np.asarray(label, _np.float32):
+            bx0, by0, bx1, by1 = row[1:5]
+            ix0, iy0 = max(bx0, x0), max(by0, y0)
+            ix1, iy1 = min(bx1, x0 + cw), min(by1, y0 + ch)
+            iw, ih = max(ix1 - ix0, 0.0), max(iy1 - iy0, 0.0)
+            area = (bx1 - bx0) * (by1 - by0)
+            if area <= 0 or iw * ih / area < self.min_object_covered:
+                continue
+            new = row.copy()
+            new[1] = (ix0 - x0) / cw
+            new[2] = (iy0 - y0) / ch
+            new[3] = (ix1 - x0) / cw
+            new[4] = (iy1 - y0) / ch
+            out.append(new)
+        return _np.asarray(out, _np.float32).reshape(-1, label.shape[1])
+
+
+class DetForceResizeAug(DetAugmenter):
+    """Exact resize to (w, h): normalized boxes are unchanged."""
+
+    def __init__(self, size, interp=2):
+        self.aug = ForceResizeAug(size, interp)
+
+    def __call__(self, src, label):
+        return self.aug(src), label
+
+
+def CreateDetAugmenter(data_shape, rand_crop=0, rand_mirror=False,
+                       mean=None, std=None, brightness=0, contrast=0,
+                       saturation=0, pca_noise=0,
+                       min_object_covered=0.3, min_crop_size=0.5,
+                       inter_method=2):
+    """Detection augmentation pipeline (ref: detection.py
+    CreateDetAugmenter): geometric stages transform boxes jointly; color
+    stages are borrowed from the classification augmenters."""
+    auglist: List[DetAugmenter] = []
+    if rand_crop:
+        auglist.append(DetRandomCropAug(min_object_covered=min_object_covered,
+                                        min_crop_size=min_crop_size))
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    # after geometry: exact resize to the network input (box-preserving)
+    auglist.append(DetForceResizeAug((data_shape[2], data_shape[1]),
+                                     inter_method))
+    auglist.append(DetBorrowAug(CastAug()))
+    if brightness or contrast or saturation:
+        auglist.append(DetBorrowAug(ColorJitterAug(brightness, contrast,
+                                                   saturation)))
+    if pca_noise > 0:
+        eigval = [55.46, 4.794, 1.148]
+        eigvec = [[-0.5675, 0.7192, 0.4009],
+                  [-0.5808, -0.0045, -0.8140],
+                  [-0.5836, -0.6948, 0.4203]]
+        auglist.append(DetBorrowAug(LightingAug(pca_noise, eigval, eigvec)))
+    if mean is True:
+        mean = _np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = _np.array([58.395, 57.12, 57.375])
+    if mean is not None and len(_np.atleast_1d(mean)) > 0:
+        auglist.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
+    return auglist

@@ -147,40 +147,47 @@ class ImageRecordIter(DataIter):
 class ImageDetRecordIter(ImageRecordIter):
     """Detection batches (ref: iter_image_det_recordio.cc): each record's
     label is [header_width, obj_width, <extra header>, obj0..., obj1...];
-    emitted labels are (batch, max_objs, obj_width) padded with -1."""
+    emitted labels are (batch, max_objs, obj_width) padded with -1.
 
-    # geometric augmenters would move pixels without moving the boxes;
-    # only box-preserving ones are allowed until CreateDetAugmenter-style
-    # joint transforms exist
-    # ('resize' is fine: the det pipeline force-resizes the whole image to
-    # data_shape, which preserves normalized box coords)
-    _GEOMETRIC_KWARGS = ("rand_crop", "rand_mirror", "rand_resize",
-                         "max_rotate_angle", "max_aspect_ratio",
-                         "max_shear_ratio", "rand_pad")
+    Geometric augmentation (rand_crop/rand_mirror) transforms images and
+    boxes JOINTLY via image.CreateDetAugmenter."""
 
     def __init__(self, path_imgrec, data_shape, batch_size,
                  label_pad_width=0, label_pad_value=-1.0, **kwargs):
         kwargs.setdefault("label_name", "label")
         kwargs.pop("label_width", None)  # det labels are variable-width
-        bad = [k for k in self._GEOMETRIC_KWARGS if kwargs.get(k)]
-        check(not bad,
-              f"ImageDetRecordIter: geometric augmenters {bad} would "
-              "desync images from their boxes; only color/normalize "
-              "augmentation is supported (boxes are not transformed)")
+        from ..image import CreateDetAugmenter
+        import inspect
+        det_known = set(inspect.signature(CreateDetAugmenter).parameters)
+        det_kwargs = {}
+        for k in list(kwargs):
+            if k in det_known and k != "data_shape":
+                det_kwargs[k] = kwargs.pop(k)
+        # per-channel mean/std translate like the parent iterator
+        mean = [kwargs.pop(k, 0.0) for k in ("mean_r", "mean_g", "mean_b")]
+        std = [kwargs.pop(k, 1.0) for k in ("std_r", "std_g", "std_b")]
+        if any(m != 0.0 for m in mean) or any(v != 1.0 for v in std):
+            det_kwargs["mean"] = _np.asarray(mean, _np.float32)
+            det_kwargs["std"] = _np.asarray(std, _np.float32)
         super().__init__(path_imgrec, data_shape, batch_size,
                          label_width=1, **kwargs)
-        # exact resize to data_shape keeps normalized box coords valid
-        # (CreateAugmenter's center-crop default would not)
-        from ..image import ForceResizeAug
-        self.auglist = [ForceResizeAug((self.data_shape[2],
-                                        self.data_shape[1]))] + \
-            [a for a in self.auglist
-             if type(a).__name__ in ("ColorJitterAug", "LightingAug",
-                                     "ColorNormalizeAug")]
+        self.det_auglist = CreateDetAugmenter(self.data_shape,
+                                              **det_kwargs)
         self._label_pad_width = int(label_pad_width)
         self._label_pad_value = float(label_pad_value)
         # monotone: label shape only grows, so recompiles are bounded
         self._max_objs = max(self._label_pad_width, 1)
+
+    def _decode_one_det(self, rec):
+        from ..recordio import unpack_img
+        header, img = unpack_img(rec)
+        boxes, obj_width = self._parse_det_label(
+            _np.asarray(header.label, _np.float32))
+        src = _nd.array(img.astype(_np.float32))
+        for aug in self.det_auglist:
+            src, boxes = aug(src, boxes)
+        from ..image import to_chw
+        return to_chw(src), boxes, obj_width
 
     @property
     def provide_label(self):
@@ -211,10 +218,9 @@ class ImageDetRecordIter(ImageRecordIter):
         batch = _np.zeros((self.batch_size, c, h, w), _np.float32)
         det_labels: List[_np.ndarray] = []
         widths = set()
-        for i, (arr, label) in enumerate(self._pool.map(self._decode_one,
-                                                        recs)):
+        for i, (arr, parsed, ow) in enumerate(
+                self._pool.map(self._decode_one_det, recs)):
             batch[i] = arr
-            parsed, ow = self._parse_det_label(label)
             det_labels.append(parsed)
             widths.add(ow)
         check(len(widths) == 1,

@@ -132,8 +132,10 @@ def _deconvolution(data, weight, *maybe_bias, kernel=(), stride=(), dilate=(),
     dilate = _tuplify(dilate if dilate else 1, nd)
     pad = _tuplify(pad if pad else 0, nd)
     adj = _tuplify(adj if adj else 0, nd)
-    # transposed conv = gradient of conv wrt input: lhs-dilate by stride.
-    pads = [(kernel[i] - 1 - pad[i], kernel[i] - 1 - pad[i] + adj[i])
+    # transposed conv = gradient of conv wrt input: lhs-dilate by stride;
+    # the effective kernel extent is dilate*(k-1)+1
+    pads = [(dilate[i] * (kernel[i] - 1) - pad[i],
+             dilate[i] * (kernel[i] - 1) - pad[i] + adj[i])
             for i in range(nd)]
     if layout is not None and layout not in ("NCW", "NCHW", "NCDHW"):
         raise MXNetError(

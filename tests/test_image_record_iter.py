@@ -160,3 +160,42 @@ def test_image_det_record_iter_label_width_kwarg(tmp_path):
                                  data_shape=(3, 8, 8), batch_size=3,
                                  label_width=5)
     assert next(it).label[0].asnumpy().shape[2] == 5
+
+
+def test_det_augmenters_transform_boxes():
+    """CreateDetAugmenter: flip and crop move boxes with the pixels."""
+    from mxnet_tpu.image import (DetHorizontalFlipAug, DetRandomCropAug,
+                                 CreateDetAugmenter)
+    from mxnet_tpu import nd as mxnd
+    img = mxnd.array(np.zeros((10, 10, 3), np.float32))
+    label = np.array([[0, 0.1, 0.2, 0.4, 0.6]], np.float32)
+    flip = DetHorizontalFlipAug(p=1.1)  # always
+    _, flipped = flip(img, label)
+    np.testing.assert_allclose(flipped[0, 1:5], [0.6, 0.2, 0.9, 0.6],
+                               rtol=1e-6)
+    np.random.seed(0)
+    crop = DetRandomCropAug(min_object_covered=0.1, min_crop_size=0.6)
+    src2, boxes2 = crop(img, label)
+    assert boxes2.shape[1] == 5
+    assert (boxes2[:, 1:5] >= -1e-6).all() and \
+        (boxes2[:, 1:5] <= 1 + 1e-6).all()
+    augs = CreateDetAugmenter((3, 8, 8), rand_mirror=True, rand_crop=1)
+    s, l = img, label
+    for a in augs:
+        s, l = a(s, l)
+    assert s.shape[:2] == (8, 8)
+
+
+def test_image_det_record_iter_with_geometric_augs(tmp_path):
+    rec = tmp_path / "det.rec"
+    _make_rec(rec, 6, det=True)
+    it = mxio.ImageDetRecordIter(path_imgrec=str(rec),
+                                 data_shape=(3, 8, 8), batch_size=3,
+                                 rand_mirror=True, rand_crop=1,
+                                 min_object_covered=0.1)
+    b = next(it)
+    lab = b.label[0].asnumpy()
+    assert b.data[0].shape == (3, 3, 8, 8)
+    valid = lab[lab[:, :, 0] >= 0]
+    assert (valid[:, 1:5] >= -1e-6).all() and \
+        (valid[:, 1:5] <= 1 + 1e-6).all()

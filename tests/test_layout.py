@@ -147,7 +147,8 @@ def test_deconv_dilation_applied():
     out = nd.Deconvolution(nd.array(x), nd.array(w), kernel=(2, 2),
                            num_filter=1, dilate=(2, 2),
                            no_bias=True).asnumpy()
-    # dilated 2x2 kernel spreads the impulse to a 3-spaced pattern
+    # reference shape: stride*(in-1) + dilate*(k-1) + 1 - 2*pad = 7
+    assert out.shape == (1, 1, 7, 7), out.shape
     nz = np.argwhere(out[0, 0] > 0)
     ys = sorted(set(nz[:, 0].tolist()))
     assert ys[1] - ys[0] == 2, out[0, 0]
