@@ -263,22 +263,30 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
         auglist.append(CenterCropAug(crop_size, inter_method))
     if rand_mirror:
         auglist.append(HorizontalFlipAug(0.5))
-    auglist.append(CastAug())
+    auglist.extend(_color_stages(brightness, contrast, saturation,
+                                 pca_noise, mean, std))
+    return auglist
+
+
+def _color_stages(brightness, contrast, saturation, pca_noise, mean, std):
+    """Cast + color jitter + PCA lighting + normalization — shared by
+    CreateAugmenter and (via DetBorrowAug) CreateDetAugmenter."""
+    stages: List[Augmenter] = [CastAug()]
     if brightness or contrast or saturation:
-        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+        stages.append(ColorJitterAug(brightness, contrast, saturation))
     if pca_noise > 0:
         eigval = [55.46, 4.794, 1.148]
         eigvec = [[-0.5675, 0.7192, 0.4009],
                   [-0.5808, -0.0045, -0.8140],
                   [-0.5836, -0.6948, 0.4203]]
-        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+        stages.append(LightingAug(pca_noise, eigval, eigvec))
     if mean is True:
         mean = _np.array([123.68, 116.28, 103.53])
     if std is True:
         std = _np.array([58.395, 57.12, 57.375])
     if mean is not None and len(_np.atleast_1d(mean)) > 0:
-        auglist.append(ColorNormalizeAug(mean, std))
-    return auglist
+        stages.append(ColorNormalizeAug(mean, std))
+    return stages
 
 
 def to_chw(x) -> _np.ndarray:
@@ -381,6 +389,13 @@ class DetBorrowAug(DetAugmenter):
         return self.augmenter(src), label
 
 
+def _check_det_label(label, who):
+    check(label.ndim == 2 and label.shape[1] >= 5,
+          f"{who} needs detection labels with obj_width >= 5 "
+          f"[id, xmin, ymin, xmax, ymax, ...]; got shape "
+          f"{tuple(label.shape)}")
+
+
 class DetHorizontalFlipAug(DetAugmenter):
     """Flip image and boxes together with probability p
     (ref: detection.py DetHorizontalFlipAug)."""
@@ -389,6 +404,7 @@ class DetHorizontalFlipAug(DetAugmenter):
         self.p = p
 
     def __call__(self, src, label):
+        _check_det_label(label, "DetHorizontalFlipAug")
         if _np.random.random() < self.p:
             src = src.flip(axis=1)
             label = label.copy()
@@ -411,6 +427,7 @@ class DetRandomCropAug(DetAugmenter):
         self.max_attempts = max_attempts
 
     def __call__(self, src, label):
+        _check_det_label(label, "DetRandomCropAug")
         h, w = src.shape[0], src.shape[1]
         for _ in range(self.max_attempts):
             s = _np.random.uniform(self.min_crop_size, 1.0)
@@ -474,20 +491,7 @@ def CreateDetAugmenter(data_shape, rand_crop=0, rand_mirror=False,
     # after geometry: exact resize to the network input (box-preserving)
     auglist.append(DetForceResizeAug((data_shape[2], data_shape[1]),
                                      inter_method))
-    auglist.append(DetBorrowAug(CastAug()))
-    if brightness or contrast or saturation:
-        auglist.append(DetBorrowAug(ColorJitterAug(brightness, contrast,
-                                                   saturation)))
-    if pca_noise > 0:
-        eigval = [55.46, 4.794, 1.148]
-        eigvec = [[-0.5675, 0.7192, 0.4009],
-                  [-0.5808, -0.0045, -0.8140],
-                  [-0.5836, -0.6948, 0.4203]]
-        auglist.append(DetBorrowAug(LightingAug(pca_noise, eigval, eigvec)))
-    if mean is True:
-        mean = _np.array([123.68, 116.28, 103.53])
-    if std is True:
-        std = _np.array([58.395, 57.12, 57.375])
-    if mean is not None and len(_np.atleast_1d(mean)) > 0:
-        auglist.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
+    auglist.extend(DetBorrowAug(a) for a in
+                   _color_stages(brightness, contrast, saturation,
+                                 pca_noise, mean, std))
     return auglist

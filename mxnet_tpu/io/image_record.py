@@ -169,8 +169,21 @@ class ImageDetRecordIter(ImageRecordIter):
         if any(m != 0.0 for m in mean) or any(v != 1.0 for v in std):
             det_kwargs["mean"] = _np.asarray(mean, _np.float32)
             det_kwargs["std"] = _np.asarray(std, _np.float32)
+        # unimplemented geometric det augmenters must not no-op silently
+        _unimpl = [k for k in ("rand_resize", "max_rotate_angle",
+                               "max_aspect_ratio", "max_shear_ratio",
+                               "rand_pad") if kwargs.pop(k, None)]
+        if _unimpl:
+            import logging
+            logging.getLogger("mxnet_tpu").warning(
+                "ImageDetRecordIter: geometric augmenters %s are not "
+                "implemented for detection and are IGNORED", _unimpl)
         super().__init__(path_imgrec, data_shape, batch_size,
                          label_width=1, **kwargs)
+        # the det path uses det_auglist exclusively; drop the parent's
+        # classification pipeline (its center-crop would desync boxes if
+        # ever reached)
+        self.auglist = []
         self.det_auglist = CreateDetAugmenter(self.data_shape,
                                               **det_kwargs)
         self._label_pad_width = int(label_pad_width)

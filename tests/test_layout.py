@@ -170,3 +170,21 @@ def test_onnx_export_rejects_channel_last(tmp_path):
         mxonnx.export_model(
             sym, {"w": mx.nd.zeros((4, 3, 3, 2))}, [(1, 6, 6, 2)],
             onnx_file_path=str(tmp_path / "x.onnx"))
+
+
+def test_resnet_nhwc_variant():
+    """get_resnet(layout='NHWC'): the flagship model runs channel-last
+    end-to-end (conv/BN/pool all layout-aware) and trains."""
+    from mxnet_tpu.gluon.model_zoo.vision import resnet18_v1
+    from mxnet_tpu import gluon
+    rs = np.random.RandomState(0)
+    net = resnet18_v1(layout="NHWC", classes=10)
+    net.initialize(mx.init.Xavier())
+    x = nd.array(rs.randn(2, 32, 32, 3).astype(np.float32))
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.01})
+    with autograd.record():
+        loss = (net(x) ** 2).mean()
+    loss.backward()
+    tr.step(2)
+    assert np.isfinite(float(loss.asscalar()))
