@@ -253,6 +253,12 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                     contrast=0, saturation=0, hue=0, pca_noise=0,
                     rand_gray=0, inter_method=2):
     """(ref: image.py CreateAugmenter)"""
+    unimpl = [n for n, v in (("rand_resize", rand_resize), ("hue", hue),
+                             ("rand_gray", rand_gray)) if v]
+    if unimpl:
+        import logging
+        logging.getLogger("mxnet_tpu").warning(
+            "CreateAugmenter: %s not implemented and IGNORED", unimpl)
     auglist: List[Augmenter] = []
     if resize > 0:
         auglist.append(ResizeAug(resize, inter_method))

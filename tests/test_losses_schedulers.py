@@ -1,0 +1,209 @@
+"""Loss functions, LR schedulers, initializers vs analytic references
+(ref: tests/python/unittest/test_loss.py + test_optimizer lr tests)."""
+import math
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon import loss as gloss
+from mxnet_tpu import lr_scheduler as lrs
+from mxnet_tpu.test_utils import assert_almost_equal
+
+RS = np.random.RandomState(0)
+
+
+def _np32(*shape, scale=1.0, seed=None):
+    rs = np.random.RandomState(seed) if seed is not None else RS
+    return (rs.randn(*shape) * scale).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# losses vs numpy formulas
+# ---------------------------------------------------------------------------
+
+def test_l1_l2_loss():
+    p, t = _np32(4, 3, seed=1), _np32(4, 3, seed=2)
+    out = gloss.L1Loss()(nd.array(p), nd.array(t)).asnumpy()
+    assert_almost_equal(out, np.abs(p - t).mean(axis=1), rtol=1e-5)
+    out2 = gloss.L2Loss()(nd.array(p), nd.array(t)).asnumpy()
+    assert_almost_equal(out2, ((p - t) ** 2).mean(axis=1) / 2, rtol=1e-5)
+
+
+def test_softmax_ce_loss():
+    p = _np32(4, 5, seed=3)
+    labels = np.array([0, 2, 4, 1], np.float32)
+    out = gloss.SoftmaxCrossEntropyLoss()(nd.array(p),
+                                          nd.array(labels)).asnumpy()
+    e = np.exp(p - p.max(axis=1, keepdims=True))
+    sm = e / e.sum(axis=1, keepdims=True)
+    ref = -np.log(sm[np.arange(4), labels.astype(int)])
+    assert_almost_equal(out, ref, rtol=1e-5)
+
+
+def test_sigmoid_bce_loss():
+    p = _np32(4, 3, seed=4)
+    t = (RS.rand(4, 3) > 0.5).astype(np.float32)
+    out = gloss.SigmoidBinaryCrossEntropyLoss()(
+        nd.array(p), nd.array(t)).asnumpy()
+    sig = 1 / (1 + np.exp(-p))
+    ref = -(t * np.log(sig + 1e-12) +
+            (1 - t) * np.log(1 - sig + 1e-12)).mean(axis=1)
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_kl_div_loss():
+    logits = _np32(3, 4, seed=5)
+    e = np.exp(logits - logits.max(axis=1, keepdims=True))
+    pred_log = np.log(e / e.sum(axis=1, keepdims=True))
+    target = np.full((3, 4), 0.25, np.float32)
+    out = gloss.KLDivLoss(from_logits=True)(
+        nd.array(pred_log), nd.array(target)).asnumpy()
+    ref = (target * (np.log(target) - pred_log)).mean(axis=1)
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_huber_loss():
+    p = np.array([[0.2, 3.0]], np.float32)
+    t = np.array([[0.0, 0.0]], np.float32)
+    out = gloss.HuberLoss(rho=1.0)(nd.array(p), nd.array(t)).asnumpy()
+    ref = np.array([(0.5 * 0.2 ** 2 + (3.0 - 0.5)) / 2], np.float32)
+    assert_almost_equal(out, ref, rtol=1e-5)
+
+
+def test_hinge_losses():
+    p = np.array([[0.5, -2.0]], np.float32)
+    t = np.array([[1.0, -1.0]], np.float32)  # margins: 0.5, -2*-1=2
+    out = gloss.HingeLoss()(nd.array(p), nd.array(t)).asnumpy()
+    assert_almost_equal(out, np.array([(0.5 + 0.0) / 2], np.float32),
+                        rtol=1e-5)
+    sq = gloss.SquaredHingeLoss()(nd.array(p), nd.array(t)).asnumpy()
+    assert_almost_equal(sq, np.array([(0.25 + 0.0) / 2], np.float32),
+                        rtol=1e-5)
+
+
+def test_triplet_loss():
+    a = _np32(2, 4, seed=6)
+    pos = a + 0.01
+    neg = a + 5.0
+    out = gloss.TripletLoss(margin=1.0)(
+        nd.array(a), nd.array(pos), nd.array(neg)).asnumpy()
+    # pos is close and neg far: loss clamps to 0
+    assert (out <= 1e-2).all()
+
+
+def test_loss_weight_and_sample_weight():
+    p, t = _np32(3, 2, seed=7), _np32(3, 2, seed=8)
+    base = gloss.L2Loss()(nd.array(p), nd.array(t)).asnumpy()
+    scaled = gloss.L2Loss(weight=3.0)(nd.array(p), nd.array(t)).asnumpy()
+    assert_almost_equal(scaled, base * 3.0, rtol=1e-5)
+    sw = np.array([[1.0], [0.0], [2.0]], np.float32)
+    weighted = gloss.L2Loss()(nd.array(p), nd.array(t),
+                              nd.array(sw)).asnumpy()
+    assert_almost_equal(weighted, base * sw[:, 0], rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# LR schedulers (ref: lr_scheduler.py Factor/MultiFactor/Poly/Cosine)
+# ---------------------------------------------------------------------------
+
+def test_factor_scheduler():
+    # reference semantics: decay when num_update strictly exceeds the
+    # boundary (mx.lr_scheduler.FactorScheduler)
+    s = lrs.FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert s(0) == pytest.approx(1.0)
+    assert s(10) == pytest.approx(1.0)
+    assert s(11) == pytest.approx(0.5)
+    assert s(25) == pytest.approx(0.25)
+
+
+def test_multifactor_scheduler():
+    s = lrs.MultiFactorScheduler(step=[5, 15], factor=0.1, base_lr=1.0)
+    assert s(4) == pytest.approx(1.0)
+    assert s(6) == pytest.approx(0.1)
+    assert s(20) == pytest.approx(0.01)
+
+
+def test_poly_scheduler():
+    s = lrs.PolyScheduler(max_update=100, base_lr=2.0, pwr=2,
+                          final_lr=0.0)
+    assert s(0) == pytest.approx(2.0)
+    assert s(50) == pytest.approx(2.0 * 0.25)
+    assert s(100) == pytest.approx(0.0, abs=1e-9)
+
+
+def test_cosine_scheduler_with_warmup():
+    s = lrs.CosineScheduler(max_update=100, base_lr=1.0, final_lr=0.0,
+                            warmup_steps=10, warmup_begin_lr=0.0)
+    assert s(0) == pytest.approx(0.0, abs=1e-9)
+    assert s(10) == pytest.approx(1.0, rel=0.2)
+    mid = s(55)
+    ref = 0.5 * (1 + math.cos(math.pi * 45 / 90))
+    assert mid == pytest.approx(ref, rel=0.05)
+    assert s(100) == pytest.approx(0.0, abs=1e-6)
+
+
+def test_scheduler_drives_trainer_lr():
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+    net = nn.Dense(2)
+    net.initialize()
+    with mx.autograd.pause():
+        net(nd.zeros((1, 3)))
+    sched = lrs.FactorScheduler(step=1, factor=0.5, base_lr=0.1)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1, "lr_scheduler": sched})
+    x = nd.array(_np32(2, 3, seed=9))
+    lrs_seen = []
+    for _ in range(3):
+        with mx.autograd.record():
+            loss = (net(x) ** 2).mean()
+        loss.backward()
+        tr.step(2)
+        lrs_seen.append(tr.learning_rate)
+    assert lrs_seen[0] > lrs_seen[-1]
+
+
+# ---------------------------------------------------------------------------
+# initializers (ref: test_init.py)
+# ---------------------------------------------------------------------------
+
+def test_xavier_magnitude():
+    from mxnet_tpu.initializer import Xavier, InitDesc
+    arr = nd.zeros((256, 128))
+    Xavier(factor_type="avg", magnitude=3)(InitDesc("w"), arr)
+    v = arr.asnumpy()
+    bound = float(np.sqrt(3 * 2.0 / (256 + 128)))
+    assert abs(v).max() <= bound + 1e-6
+    assert v.std() > bound / 4
+
+
+def test_orthogonal_initializer():
+    from mxnet_tpu.initializer import Orthogonal, InitDesc
+    arr = nd.zeros((64, 32))
+    Orthogonal()(InitDesc("w"), arr)
+    v = arr.asnumpy()
+    gram = v.T @ v
+    assert_almost_equal(gram, np.eye(32, dtype=np.float32) * gram[0, 0],
+                        rtol=1e-3, atol=1e-3)
+
+
+def test_constant_zero_one():
+    from mxnet_tpu.initializer import Zero, One, Constant, InitDesc
+    a = nd.zeros((3, 3))
+    One()(InitDesc("w"), a)
+    assert (a.asnumpy() == 1).all()
+    Constant(2.5)(InitDesc("w"), a)
+    assert (a.asnumpy() == 2.5).all()
+
+
+def test_mixed_initializer():
+    from mxnet_tpu.initializer import Mixed, InitDesc
+    init = Mixed([".*bias", ".*"], [mx.init.Zero(), mx.init.One()])
+    b = nd.array(_np32(4, seed=10))
+    w = nd.array(_np32(4, seed=11))
+    init(InitDesc("fc1_bias"), b)
+    init(InitDesc("fc1_weight"), w)
+    assert (b.asnumpy() == 0).all()
+    assert (w.asnumpy() == 1).all()
