@@ -172,13 +172,15 @@ def test_onnx_export_rejects_channel_last(tmp_path):
             onnx_file_path=str(tmp_path / "x.onnx"))
 
 
-def test_resnet_nhwc_variant():
-    """get_resnet(layout='NHWC'): the flagship model runs channel-last
-    end-to-end (conv/BN/pool all layout-aware) and trains."""
-    from mxnet_tpu.gluon.model_zoo.vision import resnet18_v1
+@pytest.mark.parametrize("ctor_name", ["resnet18_v1", "resnet50_v1",
+                                       "resnet18_v2"])
+def test_resnet_nhwc_variant(ctor_name):
+    """get_resnet(layout='NHWC'): basic + bottleneck + v2 pre-activation
+    paths all run channel-last end-to-end and train."""
+    from mxnet_tpu.gluon.model_zoo import vision
     from mxnet_tpu import gluon
     rs = np.random.RandomState(0)
-    net = resnet18_v1(layout="NHWC", classes=10)
+    net = getattr(vision, ctor_name)(layout="NHWC", classes=10)
     net.initialize(mx.init.Xavier())
     x = nd.array(rs.randn(2, 32, 32, 3).astype(np.float32))
     tr = gluon.Trainer(net.collect_params(), "sgd",
