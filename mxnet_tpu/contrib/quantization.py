@@ -16,7 +16,8 @@ import numpy as _np
 
 from ..base import MXNetError, check
 
-__all__ = ["quantize_model", "calib_graph", "CalibrationCollector"]
+__all__ = ["quantize_model", "calib_graph", "CalibrationCollector",
+           "HistogramCollector", "get_optimal_threshold"]
 
 _QUANTIZABLE = {"FullyConnected"}
 
@@ -38,20 +39,118 @@ class CalibrationCollector:
             self.min_max[name] = (mn, mx)
 
 
-def calib_graph(symbol, arg_map, aux_map, calib_batches) -> Dict[str, Tuple]:
-    """Naive min/max calibration over batches (ref: collect statistics)."""
+class HistogramCollector:
+    """Per-tensor symmetric histograms for KL calibration
+    (ref: _LayerHistogramCollector). The bin range is pinned to the first
+    batch's absmax; later outliers accumulate into the edge bins."""
+
+    def __init__(self, num_bins: int = 8001):
+        self.num_bins = num_bins
+        self.hists: Dict[str, Tuple[_np.ndarray, float]] = {}
+
+    def collect(self, name: str, arr) -> None:
+        a = _np.asarray(arr, _np.float64).reshape(-1)
+        if name not in self.hists:
+            th = max(float(_np.abs(a).max()), 1e-8)
+            # adapt bin count to the sample size: the KL search degrades
+            # on near-empty histograms (a few samples across 8001 bins)
+            # floor 1025: at 257 bins there is exactly ONE KL candidate
+            # (the full range) and entropy mode degrades to absmax; 1025
+            # gives a 4x search range while the bulk-mass guard handles
+            # sparsity
+            bins = int(min(self.num_bins, max(1025, a.size // 4)))
+            bins |= 1  # keep a center bin
+            hist, _ = _np.histogram(_np.clip(a, -th, th),
+                                    bins=bins, range=(-th, th))
+            self.hists[name] = (hist.astype(_np.float64), th)
+        else:
+            hist, th = self.hists[name]
+            new, _ = _np.histogram(_np.clip(a, -th, th),
+                                   bins=hist.size, range=(-th, th))
+            self.hists[name] = (hist + new, th)
+
+
+def get_optimal_threshold(hist, threshold, num_quantized_bins=255):
+    """KL-divergence threshold search (ref: quantization.py
+    _get_optimal_threshold, the TensorRT calibration algorithm): pick the
+    symmetric clip threshold whose 255-level quantized distribution is
+    closest (min KL) to the original."""
+    hist = _np.asarray(hist, _np.float64)
+    num_bins = hist.size
+    zero = num_bins // 2
+    best_div = _np.inf
+    best_th = threshold
+    step = threshold / zero
+    total = hist.sum()
+    for i in range(num_quantized_bins // 2 + 1, zero + 1):
+        inside = hist[zero - i:zero + i + 1].sum()
+        # degenerate guard: a candidate that clips most of the mass can
+        # still score KL~0 on sparse histograms (q ~= p when the edge
+        # spikes dominate); real calibration clips OUTLIERS, not the bulk
+        if total > 0 and inside / total < 0.9:
+            continue
+        p = hist[zero - i:zero + i + 1].copy()
+        p[0] += hist[:zero - i].sum()
+        p[-1] += hist[zero + i + 1:].sum()
+        if p.sum() == 0:
+            continue
+        # quantize p into num_quantized_bins levels
+        idx = (_np.arange(p.size) * num_quantized_bins // p.size)
+        counts = _np.bincount(idx, weights=p, minlength=num_quantized_bins)
+        nonzero = _np.bincount(idx, weights=(p > 0).astype(_np.float64),
+                               minlength=num_quantized_bins)
+        with _np.errstate(divide="ignore", invalid="ignore"):
+            expanded = _np.where(nonzero[idx] > 0,
+                                 counts[idx] / nonzero[idx], 0.0)
+        q = _np.where(p > 0, expanded, 0.0)
+        # smooth (ref: _smooth_distribution) so KL stays finite
+        eps = 1e-4
+        for d in (p, q):
+            zeros = d == 0
+            nz = ~zeros
+            n_nz = int(nz.sum())
+            if n_nz == 0:
+                continue
+            d[zeros] = eps
+            d[nz] -= eps * zeros.sum() / n_nz
+        ps = p / p.sum()
+        qs = q / q.sum()
+        div = float(_np.sum(ps * _np.log(_np.maximum(ps, 1e-12) /
+                                         _np.maximum(qs, 1e-12))))
+        if div < best_div:
+            best_div = div
+            best_th = (i + 0.5) * step
+    return best_th
+
+
+def calib_graph(symbol, arg_map, aux_map, calib_batches,
+                mode: str = "naive", include=None) -> Dict[str, Tuple]:
+    """Collect per-layer calibration thresholds over batches
+    (ref: collect statistics; mode 'naive' = min/max,
+    'entropy' = KL-optimal symmetric thresholds). `include` restricts
+    collection to the named internal outputs (the reference's
+    include_layer) — entropy's KL search is expensive per tensor."""
     from ..symbol.executor import _walk
-    collector = CalibrationCollector()
+    collector = CalibrationCollector() if mode == "naive" \
+        else HistogramCollector()
     internals = symbol.get_internals()
     names = internals.list_outputs()
+    include = set(include) if include is not None else None
     for batch in calib_batches:
         feed = {k: (v._data if hasattr(v, "_data") else v)
                 for k, v in {**arg_map, **batch}.items()}
         outs = _walk(internals, feed,
                      {k: v._data for k, v in aux_map.items()}, False)
         for name, val in zip(names, outs):
-            collector.collect(name, val)
-    return collector.min_max
+            if include is None or name in include:
+                collector.collect(name, val)
+    if mode == "naive":
+        return collector.min_max
+    out = {}
+    for name, (hist, th) in collector.hists.items():
+        opt = get_optimal_threshold(hist, th)
+        out[name] = (-opt, opt)
+    return out
 
 
 def quantize_model(sym, arg_params, aux_params, data_names=("data",),
@@ -71,6 +170,35 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
 
     excluded = set(excluded_sym_names)
     qarg_params = dict(arg_params)
+
+    # calibrated activation thresholds: entropy (KL) or naive min/max,
+    # baked into the inserted quantize nodes as static ranges so inference
+    # needs no per-batch min/max reductions (ref: calib_mode semantics)
+    calib_thresholds: Dict[str, Tuple[float, float]] = {}
+    if calib_data is not None and calib_mode in ("naive", "entropy"):
+        batches = list(calib_data)
+        if num_calib_examples is not None:
+            # reference semantics: example COUNT, not batch count
+            kept, seen = [], 0
+            for b in batches:
+                kept.append(b)
+                first = next(iter(b.values()))
+                seen += int(getattr(first, "shape", (1,))[0])
+                if seen >= int(num_calib_examples):
+                    break
+            batches = kept
+        # only the data inputs of quantizable nodes consume thresholds
+        needed = set()
+        for node in sym._topo():
+            if node.is_variable or node.op.name not in _QUANTIZABLE or \
+                    node.name in excluded:
+                continue
+            inp, slot = node.inputs[0]
+            needed.add(f"{inp.name}_output" if inp.num_outputs() == 1
+                       else f"{inp.name}_output{slot}")
+        calib_thresholds = calib_graph(sym, arg_params, aux_params or {},
+                                       batches, mode=calib_mode,
+                                       include=needed)
 
     weight_meta: Dict[str, Tuple[float, float]] = {}
     for node in sym._topo():
@@ -99,8 +227,16 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
                 node.inputs[1][0].name in weight_meta:
             wname = node.inputs[1][0].name
             w_min, w_max = weight_meta[wname]
+            in_node = node.inputs[0][0]
+            in_key = f"{in_node.name}_output" if in_node.num_outputs() == 1 \
+                else f"{in_node.name}_output{node.inputs[0][1]}"
+            q_attrs = {}
+            if in_key in calib_thresholds:
+                lo, hi = calib_thresholds[in_key]
+                q_attrs = {"min_calib_range": float(lo),
+                           "max_calib_range": float(hi)}
             qd = _Node(_reg.get_op("_contrib_quantize_v2"),
-                       node.name + "_quantize", {}, [new_inputs[0]])
+                       node.name + "_quantize", q_attrs, [new_inputs[0]])
             wq_var = _Node(None, wname + "_quantized", {}, [])
             attrs = dict(node.attrs)
             inputs = [(qd, 0), (qd, 1), (qd, 2), (wq_var, 0)]

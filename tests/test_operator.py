@@ -288,3 +288,62 @@ def test_identity_attach_kl_sparse_reg():
     expected = 1.0 + 0.01 * (-(0.1 / avg) + 0.9 / (1 - avg))
     assert_almost_equal(x.grad.asnumpy(),
                         np.broadcast_to(expected, d.shape), rtol=1e-4)
+
+
+def test_entropy_calibration_threshold_clips_outliers():
+    from mxnet_tpu.contrib.quantization import (HistogramCollector,
+                                                get_optimal_threshold)
+    rs = np.random.RandomState(0)
+    data = rs.randn(100000).astype(np.float32)
+    data[:10] *= 100.0  # extreme outliers
+    c = HistogramCollector()
+    c.collect("x", data)
+    hist, th = c.hists["x"]
+    opt = get_optimal_threshold(hist, th)
+    # KL threshold must clip far inside the outlier range but keep the
+    # bulk of the gaussian
+    assert 2.0 < opt < th * 0.5, (opt, th)
+
+
+def test_quantize_model_entropy_mode():
+    from mxnet_tpu import symbol as S
+    from mxnet_tpu.symbol.symbol import create
+    from mxnet_tpu.symbol.executor import eval_symbol
+    from mxnet_tpu.contrib.quantization import quantize_model
+    rs = np.random.RandomState(1)
+    data = S.var("data")
+    fc = create("FullyConnected", [data, S.var("w"), S.var("b")],
+                {"num_hidden": 8}, name="fc1")
+    out_sym = create("relu", [fc], {}, name="r")
+    args = {"w": nd.array(rs.randn(8, 6).astype(np.float32) * 0.3),
+            "b": nd.array(np.zeros(8, np.float32))}
+    calib = [{"data": nd.array(rs.randn(16, 6).astype(np.float32))}
+             for _ in range(3)]
+    qsym, qargs, _ = quantize_model(out_sym, args, {},
+                                    calib_mode="entropy",
+                                    calib_data=calib)
+    # calibrated ranges are baked into the quantize node
+    qnodes = [n for n in qsym._topo()
+              if n.op is not None and n.op.name == "_contrib_quantize_v2"]
+    assert qnodes and "min_calib_range" in qnodes[0].attrs
+    x = nd.array(rs.randn(4, 6).astype(np.float32))
+    ref = eval_symbol(out_sym, ["data"], [x], args)
+    got = eval_symbol(qsym, ["data"], [x], qargs)
+    ref = (ref[0] if isinstance(ref, list) else ref).asnumpy()
+    got = (got[0] if isinstance(got, list) else got).asnumpy()
+    assert_almost_equal(got, ref, rtol=0.2, atol=0.1)
+
+
+def test_entropy_calibration_small_tensor_still_clips():
+    """Regression: bin floor below num_quantized_bins+2 emptied the KL
+    candidate loop and entropy mode returned raw absmax."""
+    from mxnet_tpu.contrib.quantization import (HistogramCollector,
+                                                get_optimal_threshold)
+    rs = np.random.RandomState(2)
+    data = rs.randn(96).astype(np.float32)
+    data[0] = 50.0  # extreme outlier
+    c = HistogramCollector()
+    c.collect("x", data)
+    hist, th = c.hists["x"]
+    opt = get_optimal_threshold(hist, th)
+    assert opt < 25.0, opt  # must clip, not return absmax 50
