@@ -352,3 +352,138 @@ def _spatial_transformer(data, loc, target_shape=(0, 0),
     grid = _grid_generator(loc, transform_type="affine",
                            target_shape=target_shape)
     return _bilinear_sampler(data, grid)
+
+
+# ---------------------------------------------------------------------------
+# SSD training/inference ops
+# (ref: src/operator/contrib/multibox_target.cc, multibox_detection.cc)
+# ---------------------------------------------------------------------------
+
+def _corner_to_center(boxes):
+    w = boxes[..., 2] - boxes[..., 0]
+    h = boxes[..., 3] - boxes[..., 1]
+    x = (boxes[..., 0] + boxes[..., 2]) * 0.5
+    y = (boxes[..., 1] + boxes[..., 3]) * 0.5
+    return x, y, w, h
+
+
+@register("_contrib_MultiBoxTarget", aliases=("MultiBoxTarget",),
+          num_outputs=3, differentiable=False)
+def _multibox_target(anchors, labels, cls_preds, overlap_threshold=0.5,
+                     ignore_label=-1.0, negative_mining_ratio=-1.0,
+                     negative_mining_thresh=0.5, minimum_negative_samples=0,
+                     variances=(0.1, 0.1, 0.2, 0.2)):
+    """SSD target assignment (ref: multibox_target.cc): per batch, match
+    anchors to ground-truth boxes (IoU >= threshold, plus each gt force-
+    matches its best anchor), encode matched-box regression targets with
+    the variances, and build classification targets (gt class + 1;
+    0 = background; ignore_label for negatives dropped by hard negative
+    mining on cls_preds max-confidence).
+
+    anchors (1, N, 4) corner; labels (B, M, 5) [cls, xmin, ymin, xmax,
+    ymax], padded rows cls < 0; cls_preds (B, num_classes+1, N).
+    Returns box_target (B, N*4), box_mask (B, N*4), cls_target (B, N).
+    """
+    import jax
+    jnp = _jnp()
+    v = tuple(float(x) for x in variances)
+    A = anchors.reshape(-1, 4)
+    N = A.shape[0]
+    ax, ay, aw, ah = _corner_to_center(A)
+
+    def one(lab, cp):
+        valid = lab[:, 0] >= 0                       # (M,)
+        gt = lab[:, 1:5]                             # (M, 4)
+        ious = _box_iou_corner(A, gt)                # (N, M)
+        ious = jnp.where(valid[None, :], ious, -1.0)
+        best_gt = jnp.argmax(ious, axis=1)           # (N,)
+        best_iou = jnp.max(ious, axis=1)
+        matched = best_iou >= overlap_threshold
+        # force-match: each valid gt claims its best anchor. Padded
+        # rows must not scatter at all (their argmax is a meaningless 0
+        # and duplicate-index .set ordering is undefined): route them to
+        # index N and drop.
+        best_anchor = jnp.where(valid, jnp.argmax(ious, axis=0), N)  # (M,)
+        forced = jnp.zeros((N,), bool).at[best_anchor] \
+            .set(True, mode="drop")
+        forced_gt = jnp.zeros((N,), jnp.int32).at[best_anchor] \
+            .set(jnp.arange(gt.shape[0], dtype=jnp.int32), mode="drop")
+        matched = matched | forced
+        assigned = jnp.where(forced, forced_gt, best_gt)
+
+        g = gt[assigned]                             # (N, 4)
+        gx, gy, gw, gh = _corner_to_center(g)
+        eps = 1e-8
+        t0 = (gx - ax) / jnp.maximum(aw, eps) / v[0]
+        t1 = (gy - ay) / jnp.maximum(ah, eps) / v[1]
+        t2 = jnp.log(jnp.maximum(gw, eps) / jnp.maximum(aw, eps)) / v[2]
+        t3 = jnp.log(jnp.maximum(gh, eps) / jnp.maximum(ah, eps)) / v[3]
+        box_t = jnp.stack([t0, t1, t2, t3], axis=1) * matched[:, None]
+        box_m = jnp.broadcast_to(matched[:, None].astype(A.dtype),
+                                 (N, 4))
+        cls_t = jnp.where(matched, lab[assigned, 0] + 1.0, 0.0)
+        if negative_mining_ratio > 0:
+            # hard negatives: highest background-excluded confidence among
+            # anchors whose best IoU stays under negative_mining_thresh
+            # (near-positives in [thresh, overlap) are ignored, not
+            # trained as background — ref: multibox_target.cc)
+            eligible = (~matched) & (best_iou < negative_mining_thresh)
+            neg_conf = jnp.max(cp[1:, :], axis=0)    # (N,)
+            n_pos = jnp.sum(matched)
+            n_neg = jnp.maximum(
+                (negative_mining_ratio * n_pos).astype(jnp.int32),
+                int(minimum_negative_samples))
+            conf = jnp.where(eligible, neg_conf, -jnp.inf)
+            order = jnp.argsort(-conf)
+            rank = jnp.zeros((N,), jnp.int32).at[order].set(
+                jnp.arange(N, dtype=jnp.int32))
+            keep_neg = eligible & (rank < n_neg)
+            cls_t = jnp.where(matched | keep_neg, cls_t,
+                              float(ignore_label))
+        return box_t.reshape(-1), box_m.reshape(-1), cls_t
+
+    box_t, box_m, cls_t = jax.vmap(one)(labels, cls_preds)
+    return box_t, box_m, cls_t
+
+
+@register("_contrib_MultiBoxDetection", aliases=("MultiBoxDetection",),
+          differentiable=False)
+def _multibox_detection(cls_prob, loc_pred, anchors, clip=True,
+                        threshold=0.01, background_id=0,
+                        nms_threshold=0.5, force_suppress=False,
+                        variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """SSD decoding + per-class NMS (ref: multibox_detection.cc):
+    cls_prob (B, C+1, N), loc_pred (B, N*4), anchors (1, N, 4) ->
+    (B, N, 6) rows [class_id, score, xmin, ymin, xmax, ymax], suppressed
+    rows -1."""
+    import jax
+    jnp = _jnp()
+    v = tuple(float(x) for x in variances)
+    A = anchors.reshape(-1, 4)
+    N = A.shape[0]
+    ax, ay, aw, ah = _corner_to_center(A)
+
+    def one(cp, lp):
+        loc = lp.reshape(N, 4)
+        cx = loc[:, 0] * v[0] * aw + ax
+        cy = loc[:, 1] * v[1] * ah + ay
+        w = jnp.exp(loc[:, 2] * v[2]) * aw * 0.5
+        h = jnp.exp(loc[:, 3] * v[3]) * ah * 0.5
+        boxes = jnp.stack([cx - w, cy - h, cx + w, cy + h], axis=1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        # best non-background class per anchor
+        fg = jnp.concatenate(
+            [cp[:background_id], cp[background_id + 1:]], axis=0)
+        cls_id = jnp.argmax(fg, axis=0).astype(boxes.dtype)
+        score = jnp.max(fg, axis=0)
+        keep = score > threshold
+        rows = jnp.concatenate(
+            [jnp.where(keep, cls_id, -1.0)[:, None],
+             jnp.where(keep, score, -1.0)[:, None], boxes], axis=1)
+        return rows
+
+    rows = jax.vmap(one)(cls_prob, loc_pred)
+    return _box_nms(rows, overlap_thresh=nms_threshold, valid_thresh=0.0,
+                    topk=nms_topk, coord_start=2, score_index=1,
+                    id_index=0, force_suppress=force_suppress)

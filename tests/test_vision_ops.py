@@ -231,3 +231,113 @@ def test_count_sketch_requires_out_dim():
     with pytest.raises(MXNetError, match="out_dim"):
         nd.contrib.count_sketch(nd.zeros((2, 4)), nd.zeros((1, 4)),
                                 nd.ones((1, 4)))
+
+
+# ---------------------------------------------------------------------------
+# SSD MultiBox ops (ref: test_operator.py test_multibox_target /
+# multibox_detection hand-computed cases)
+# ---------------------------------------------------------------------------
+
+def test_multibox_target_matching_and_encoding():
+    anchors = np.array([[[0.1, 0.1, 0.5, 0.5],
+                         [0.5, 0.5, 0.9, 0.9],
+                         [0.0, 0.0, 0.2, 0.2]]], np.float32)
+    # one gt overlapping anchor 0 strongly; padded second row
+    labels = np.array([[[1.0, 0.1, 0.1, 0.5, 0.5],
+                        [-1.0, 0, 0, 0, 0]]], np.float32)
+    cls_preds = np.zeros((1, 3, 3), np.float32)
+    bt, bm, ct = nd.contrib.MultiBoxTarget(
+        nd.array(anchors), nd.array(labels), nd.array(cls_preds))
+    ct = ct.asnumpy()
+    bm = bm.asnumpy().reshape(1, 3, 4)
+    bt = bt.asnumpy().reshape(1, 3, 4)
+    # anchor 0 matches class 1 -> target 2; others background
+    assert ct.tolist() == [[2.0, 0.0, 0.0]]
+    assert bm[0, 0].tolist() == [1, 1, 1, 1]
+    assert bm[0, 1].tolist() == [0, 0, 0, 0]
+    # perfect overlap: encoded regression target is 0
+    assert np.abs(bt[0, 0]).max() < 1e-5
+
+
+def test_multibox_target_force_match_and_mining():
+    anchors = np.array([[[0.0, 0.0, 0.3, 0.3],
+                         [0.4, 0.4, 0.9, 0.9]]], np.float32)
+    # gt overlaps anchor 1 weakly (IoU < 0.5) -> force match still assigns
+    labels = np.array([[[0.0, 0.5, 0.5, 1.0, 1.0]]], np.float32)
+    cls_preds = np.zeros((1, 2, 2), np.float32)
+    cls_preds[0, 1, 0] = 5.0  # anchor 0 is a confident (hard) negative
+    bt, bm, ct = nd.contrib.MultiBoxTarget(
+        nd.array(anchors), nd.array(labels), nd.array(cls_preds),
+        negative_mining_ratio=1.0, minimum_negative_samples=1)
+    ct = ct.asnumpy()
+    assert ct[0, 1] == 1.0          # forced positive (class 0 -> 1)
+    assert ct[0, 0] == 0.0          # kept hard negative stays background
+
+
+def test_multibox_detection_decode_and_nms():
+    anchors = np.array([[[0.1, 0.1, 0.5, 0.5],
+                         [0.12, 0.1, 0.52, 0.5],
+                         [0.6, 0.6, 0.9, 0.9]]], np.float32)
+    # zero offsets: boxes == anchors
+    loc = np.zeros((1, 12), np.float32)
+    cls_prob = np.zeros((1, 2, 3), np.float32)
+    cls_prob[0, 1] = [0.9, 0.8, 0.7]   # one foreground class
+    cls_prob[0, 0] = [0.1, 0.2, 0.3]
+    out = nd.contrib.MultiBoxDetection(
+        nd.array(cls_prob), nd.array(loc), nd.array(anchors),
+        nms_threshold=0.5).asnumpy()
+    assert out.shape == (1, 3, 6)
+    kept = out[0][out[0, :, 0] >= 0]
+    # anchors 0/1 overlap heavily: NMS keeps the higher-scoring one + the
+    # distant anchor 2
+    assert kept.shape[0] == 2
+    scores = sorted(kept[:, 1].tolist(), reverse=True)
+    assert scores[0] == pytest.approx(0.9, rel=1e-5)
+    assert scores[1] == pytest.approx(0.7, rel=1e-5)
+
+
+def test_multibox_detection_offset_decoding():
+    anchors = np.array([[[0.2, 0.2, 0.6, 0.6]]], np.float32)  # c=(.4,.4) wh=.4
+    loc = np.array([[1.0, 0.0, 0.0, 0.0]], np.float32)  # dx=1 -> cx += .1*.4
+    cls_prob = np.array([[[0.1], [0.9]]], np.float32)
+    out = nd.contrib.MultiBoxDetection(
+        nd.array(cls_prob), nd.array(loc), nd.array(anchors)).asnumpy()
+    box = out[0, 0, 2:]
+    assert box[0] == pytest.approx(0.24, abs=1e-5)
+    assert box[2] == pytest.approx(0.64, abs=1e-5)
+
+
+def test_multibox_target_padded_rows_do_not_corrupt_matching():
+    """Regression: padded gt rows used to scatter into anchor 0 and could
+    clobber a valid gt's force-match."""
+    anchors = np.array([[[0.0, 0.0, 0.3, 0.3],
+                         [0.5, 0.5, 0.9, 0.9]]], np.float32)
+    # the valid gt's best anchor is anchor 0, but with IoU < 0.5 -> only
+    # the force-match makes it positive; pad rows follow
+    labels = np.array([[[2.0, 0.0, 0.0, 0.2, 0.45],
+                        [-1.0, 0, 0, 0, 0],
+                        [-1.0, 0, 0, 0, 0]]], np.float32)
+    cls_preds = np.zeros((1, 4, 2), np.float32)
+    bt, bm, ct = nd.contrib.MultiBoxTarget(
+        nd.array(anchors), nd.array(labels), nd.array(cls_preds))
+    ct = ct.asnumpy()
+    assert ct[0, 0] == 3.0  # class 2 -> target 3, force-matched
+    assert ct[0, 1] == 0.0
+
+
+def test_multibox_target_near_positive_negatives_ignored():
+    """Unmatched anchors with IoU >= negative_mining_thresh are ignored,
+    not trained as background (ref: multibox_target.cc)."""
+    anchors = np.array([[[0.0, 0.0, 0.4, 0.4],      # IoU ~0.33 near-pos
+                         [0.18, 0.0, 0.58, 0.4],    # IoU ~0.9 match
+                         [0.6, 0.6, 0.9, 0.9]]], np.float32)
+    labels = np.array([[[0.0, 0.2, 0.0, 0.6, 0.4]]], np.float32)
+    cls_preds = np.zeros((1, 2, 3), np.float32)
+    cls_preds[0, 1, 0] = 9.0  # confident near-positive
+    bt, bm, ct = nd.contrib.MultiBoxTarget(
+        nd.array(anchors), nd.array(labels), nd.array(cls_preds),
+        negative_mining_ratio=3.0, negative_mining_thresh=0.3)
+    ct = ct.asnumpy()[0]
+    assert ct[1] == 1.0          # matched positive
+    assert ct[0] == -1.0         # near-positive ignored, not background
+    assert ct[2] in (0.0, -1.0)  # distant anchor: negative or ignored
