@@ -1,0 +1,103 @@
+"""Tiny SSD (ref: example/ssd/): single-scale anchor head over a small
+conv backbone, trained with MultiBoxTarget targets and decoded with
+MultiBoxDetection. Synthetic colored-square dataset (zero-egress)."""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+
+def synthetic_batch(rs, batch, size=32):
+    """Images each containing one bright square; label its box."""
+    data = rs.rand(batch, 3, size, size).astype(np.float32) * 0.1
+    labels = np.zeros((batch, 1, 5), np.float32)
+    for i in range(batch):
+        w = rs.randint(8, 16)
+        x0 = rs.randint(0, size - w)
+        y0 = rs.randint(0, size - w)
+        data[i, :, y0:y0 + w, x0:x0 + w] = 1.0
+        labels[i, 0] = [0, x0 / size, y0 / size, (x0 + w) / size,
+                        (y0 + w) / size]
+    return data, labels
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--iters", type=int, default=8)
+    ap.add_argument("--lr", type=float, default=0.05)
+    args = ap.parse_args()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+    from mxnet_tpu.gluon import nn, loss as gloss
+
+    mx.random.seed(0)
+    num_classes = 1  # square vs background
+    sizes, ratios = (0.3, 0.45), (1.0, 2.0)
+    num_anchors = len(sizes) + len(ratios) - 1
+
+    backbone = nn.HybridSequential()
+    backbone.add(nn.Conv2D(16, 3, 2, 1, activation="relu"),
+                 nn.Conv2D(32, 3, 2, 1, activation="relu"))  # 32 -> 8
+    cls_head = nn.Conv2D(num_anchors * (num_classes + 1), 3, padding=1)
+    box_head = nn.Conv2D(num_anchors * 4, 3, padding=1)
+    for blk in (backbone, cls_head, box_head):
+        blk.initialize(mx.init.Xavier())
+
+    cls_loss = gloss.SoftmaxCrossEntropyLoss()
+    l1_loss = gloss.L1Loss()
+    params = {}
+    for blk in (backbone, cls_head, box_head):
+        params.update(blk.collect_params())
+    trainer = gluon.Trainer(params, "sgd", {"learning_rate": args.lr})
+
+    rs = np.random.RandomState(0)
+    losses = []
+    for it in range(args.iters):
+        data_np, labels_np = synthetic_batch(rs, args.batch_size)
+        x = nd.array(data_np)
+        labels = nd.array(labels_np)
+        with autograd.record():
+            feat = backbone(x)
+            anchors = nd.contrib.MultiBoxPrior(feat, sizes=sizes,
+                                               ratios=ratios)
+            B = args.batch_size
+            N = anchors.shape[1] if anchors.ndim == 3 else \
+                anchors.size // 4
+            cp = cls_head(feat).reshape((B, num_anchors *
+                                         (num_classes + 1), -1))
+            cp = cp.reshape((B, num_classes + 1, -1))
+            bp = box_head(feat).reshape((B, -1))
+            with autograd.pause():
+                bt, bm, ct = nd.contrib.MultiBoxTarget(
+                    anchors.reshape((1, -1, 4)), labels, cp)
+            l_cls = cls_loss(nd.transpose(cp, axes=(0, 2, 1)), ct)
+            l_box = l1_loss(bp * bm, bt)
+            loss = (l_cls.mean() + l_box.mean())
+        loss.backward()
+        trainer.step(B)
+        losses.append(float(loss.asscalar()))
+        print(f"iter {it}: loss={losses[-1]:.4f}", flush=True)
+
+    assert losses[-1] < losses[0], losses
+    # inference: decode + NMS
+    feat = backbone(x)
+    anchors = nd.contrib.MultiBoxPrior(feat, sizes=sizes, ratios=ratios)
+    cp = cls_head(feat).reshape((args.batch_size, num_classes + 1, -1))
+    cp = nd.softmax(cp, axis=1)
+    bp = box_head(feat).reshape((args.batch_size, -1))
+    det = nd.contrib.MultiBoxDetection(cp, bp,
+                                       anchors.reshape((1, -1, 4)))
+    print("detections:", det.shape, "kept:",
+          int((det.asnumpy()[:, :, 0] >= 0).sum()), flush=True)
+    print("ssd training loop done")
+
+
+if __name__ == "__main__":
+    main()

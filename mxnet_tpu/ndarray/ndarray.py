@@ -364,7 +364,12 @@ class NDArray:
     def broadcast_like(self, other) -> "NDArray":
         return imperative_invoke("broadcast_like", (self, other), {})
 
-    def transpose(self, *axes) -> "NDArray":
+    def transpose(self, *axes, **kwargs) -> "NDArray":
+        # the reference accepts both positional dims and axes= keyword
+        if "axes" in kwargs:
+            check(not axes, "pass axes positionally OR as axes=, not both")
+            axes = tuple(kwargs.pop("axes"))
+        check(not kwargs, f"unexpected kwargs {sorted(kwargs)}")
         if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
             axes = tuple(axes[0])
         return imperative_invoke("transpose", (self,),

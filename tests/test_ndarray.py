@@ -243,3 +243,10 @@ def test_ndarray_iteration_terminates():
         a[-4]
     rows = list(nd.array(np.arange(6, dtype=np.float32).reshape(3, 2)))
     assert len(rows) == 3 and rows[1].shape == (2,)
+
+
+def test_transpose_axes_keyword():
+    a = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3, 1))
+    assert a.transpose(axes=(0, 2, 1)).shape == (2, 1, 3)
+    assert a.transpose(2, 0, 1).shape == (1, 2, 3)
+    assert a.transpose((1, 0, 2)).shape == (3, 2, 1)
