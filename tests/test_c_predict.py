@@ -184,3 +184,40 @@ def test_ndlist_api(lib, exported_model):
     vals = np.ctypeslib.as_array(data, shape=dims)
     assert np.isfinite(vals).all()
     assert lib.MXNDListFree(handle) == 0
+
+
+def test_c_predict_partial_out(lib, exported_model):
+    """MXPredCreatePartialOut: predict up to an internal layer."""
+    path, x, _ = exported_model
+    with open(path + "-symbol.json") as f:
+        sym_json = f.read()
+    with open(path + "-0000.params", "rb") as f:
+        param_bytes = f.read()
+    # find an internal output name: the first Dense layer's activation
+    import json as _json
+    nodes = _json.loads(sym_json)["nodes"]
+    internal = next(n["name"] for n in nodes
+                    if n["op"] not in ("null",))  # first op node
+    handle = ctypes.c_void_p()
+    keys = (ctypes.c_char_p * 1)(b"data")
+    indptr = (ctypes.c_uint * 2)(0, 2)
+    sdata = (ctypes.c_uint * 2)(*x.shape)
+    outs = (ctypes.c_char_p * 1)(internal.encode())
+    rc = lib.MXPredCreatePartialOut(
+        sym_json.encode(), param_bytes, len(param_bytes), 1, 0, 1,
+        keys, indptr, sdata, 1, outs, ctypes.byref(handle))
+    assert rc == 0, lib.MXGetLastError()
+    flat = np.ascontiguousarray(x, dtype=np.float32).ravel()
+    assert lib.MXPredSetInput(
+        handle, b"data",
+        flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        flat.size) == 0
+    assert lib.MXPredForward(handle) == 0, lib.MXGetLastError()
+    shape_data = ctypes.POINTER(ctypes.c_uint)()
+    ndim = ctypes.c_uint()
+    assert lib.MXPredGetOutputShape(handle, 0, ctypes.byref(shape_data),
+                                    ctypes.byref(ndim)) == 0
+    shape = tuple(shape_data[i] for i in range(ndim.value))
+    # first layer of the MLP: (batch, 16) pre-activation
+    assert shape == (x.shape[0], 16), shape
+    lib.MXPredFree(handle)

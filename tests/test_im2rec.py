@@ -1,0 +1,35 @@
+"""tools/im2rec.py round trip (ref: tools/im2rec.py + test_recordio)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_im2rec_roundtrip(tmp_path):
+    cv2 = pytest.importorskip("cv2")
+    rs = np.random.RandomState(0)
+    for cls in ("cat", "dog"):
+        os.makedirs(tmp_path / "imgs" / cls)
+        for i in range(3):
+            cv2.imwrite(str(tmp_path / "imgs" / cls / f"{i}.jpg"),
+                        rs.randint(0, 255, (16, 16, 3), np.uint8))
+    prefix = str(tmp_path / "data")
+    r = subprocess.run([sys.executable,
+                        os.path.join(_ROOT, "tools", "im2rec.py"),
+                        prefix, str(tmp_path / "imgs")],
+                       capture_output=True, text=True, cwd=_ROOT)
+    assert r.returncode == 0, r.stderr[-500:]
+    assert os.path.exists(prefix + ".lst")
+    assert os.path.exists(prefix + ".rec")
+    from mxnet_tpu.io import ImageRecordIter
+    it = ImageRecordIter(path_imgrec=prefix + ".rec",
+                         data_shape=(3, 8, 8), batch_size=6, resize=8)
+    b = next(it)
+    assert b.data[0].shape == (6, 3, 8, 8)
+    labels = sorted(set(b.label[0].asnumpy().tolist()))
+    assert labels == [0.0, 1.0]
+    it.close()
