@@ -44,6 +44,13 @@ class SequentialModule(BaseModule):
             labels = label_shapes if i == (self._label_module_idx
                                            if self._label_module_idx is not None
                                            else len(self._modules) - 1) else None
+            if i > 0 and self._metas[i].get(self.META_AUTO_WIRING, False):
+                # rename the previous module's outputs onto this module's
+                # data names positionally (ref: SequentialModule
+                # auto_wiring)
+                names = mod.data_names
+                cur_shapes = [(names[j], s)
+                              for j, (_, s) in enumerate(cur_shapes)]
             mod.bind(cur_shapes, labels, for_training,
                      inputs_need_grad or i > 0, force_rebind, None, grad_req)
             cur_shapes = [(n, s) for n, s in mod.output_shapes]

@@ -1,0 +1,98 @@
+"""runtime.Features, SequentialModule, pallas flash attention numerics
+(surfaces with no direct coverage)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_runtime_features():
+    feats = mx.runtime.Features()
+    assert len(feats) > 0
+    assert feats.is_enabled("TPU") or feats.is_enabled("CPU") or True
+    # feature flags the reference exposes must at least be queryable
+    for name in ("CUDA", "CUDNN", "MKLDNN"):
+        assert isinstance(feats.is_enabled(name), bool)
+
+
+def test_flash_attention_matches_reference_softmax():
+    import jax.numpy as jnp
+    rs = np.random.RandomState(0)
+    B, T, H, D = 2, 16, 2, 8
+    q = rs.randn(B, T, H, D).astype(np.float32) * 0.5
+    k = rs.randn(B, T, H, D).astype(np.float32) * 0.5
+    v = rs.randn(B, T, H, D).astype(np.float32) * 0.5
+
+    out = nd.contrib.flash_attention(nd.array(q), nd.array(k),
+                                     nd.array(v)).asnumpy()
+
+    def ref_attn(q, k, v, causal=False):
+        scale = 1.0 / np.sqrt(D)
+        o = np.zeros_like(q)
+        for b in range(B):
+            for h in range(H):
+                logits = q[b, :, h] @ k[b, :, h].T * scale
+                if causal:
+                    mask = np.tril(np.ones((T, T), bool))
+                    logits = np.where(mask, logits, -1e30)
+                p = np.exp(logits - logits.max(axis=-1, keepdims=True))
+                p /= p.sum(axis=-1, keepdims=True)
+                o[b, :, h] = p @ v[b, :, h]
+        return o
+
+    assert_almost_equal(out, ref_attn(q, k, v), rtol=1e-4, atol=1e-4)
+    out_c = nd.contrib.flash_attention(nd.array(q), nd.array(k),
+                                       nd.array(v), causal=True).asnumpy()
+    assert_almost_equal(out_c, ref_attn(q, k, v, causal=True),
+                        rtol=1e-4, atol=1e-4)
+
+
+def test_flash_attention_gradients():
+    from mxnet_tpu import autograd
+    rs = np.random.RandomState(1)
+    q = nd.array(rs.randn(1, 8, 1, 4).astype(np.float32))
+    k = nd.array(rs.randn(1, 8, 1, 4).astype(np.float32))
+    v = nd.array(rs.randn(1, 8, 1, 4).astype(np.float32))
+    for x in (q, k, v):
+        x.attach_grad()
+    with autograd.record():
+        o = nd.contrib.flash_attention(q, k, v)
+        (o * o).sum().backward()
+    for x in (q, k, v):
+        g = x.grad.asnumpy()
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_sequential_module():
+    from mxnet_tpu.module import Module, SequentialModule
+    from mxnet_tpu.io import NDArrayIter
+    rs = np.random.RandomState(0)
+    x = rs.randn(32, 6).astype(np.float32)
+    w = rs.randn(6).astype(np.float32)
+    y = (x @ w > 0).astype(np.float32)
+
+    data = sym.var("data")
+    net1 = sym.FullyConnected(data, sym.var("fc1_weight"),
+                              sym.var("fc1_bias"), num_hidden=8,
+                              name="fc1")
+    net1 = sym.Activation(net1, act_type="relu")
+    net2_in = sym.var("data")
+    net2 = sym.FullyConnected(net2_in, sym.var("fc2_weight"),
+                              sym.var("fc2_bias"), num_hidden=2,
+                              name="fc2")
+    net2 = sym.SoftmaxOutput(net2, sym.var("softmax_label"),
+                             name="softmax")
+
+    seq = SequentialModule()
+    seq.add(Module(net1, label_names=[]))
+    seq.add(Module(net2), take_labels=True, auto_wiring=True)
+
+    train = NDArrayIter(x, y, batch_size=8, shuffle=False,
+                        label_name="softmax_label")
+    seq.fit(train, num_epoch=4, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1})
+    score = dict(seq.score(train, "acc"))
+    acc = score.get("accuracy", score.get("acc", 0))
+    assert acc > 0.6, score
