@@ -47,8 +47,13 @@ class SequentialModule(BaseModule):
             if i > 0 and self._metas[i].get(self.META_AUTO_WIRING, False):
                 # rename the previous module's outputs onto this module's
                 # data names positionally (ref: SequentialModule
-                # auto_wiring)
+                # auto_wiring, which asserts the arities match)
                 names = mod.data_names
+                from ..base import check
+                check(len(names) == len(cur_shapes),
+                      f"auto_wiring: module {i} declares {len(names)} "
+                      f"data inputs but the previous module produces "
+                      f"{len(cur_shapes)} outputs")
                 cur_shapes = [(names[j], s)
                               for j, (_, s) in enumerate(cur_shapes)]
             mod.bind(cur_shapes, labels, for_training,
