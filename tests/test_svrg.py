@@ -1,0 +1,63 @@
+"""SVRG optimization module (ref: python/mxnet/contrib/svrg_optimization/
++ tests/python/unittest/test_contrib_svrg_module.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+from mxnet_tpu.contrib.svrg_optimization import SVRGModule
+from mxnet_tpu.io import NDArrayIter
+
+
+def _linreg_problem(seed=0, n=64, d=4):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, d).astype(np.float32)
+    w_true = rs.randn(d).astype(np.float32)
+    y = (x @ w_true).astype(np.float32)
+    return x, y
+
+
+def _linreg_sym():
+    data = sym.var("data")
+    pred = sym.FullyConnected(data, sym.var("fc_weight"),
+                              sym.var("fc_bias"), num_hidden=1, name="fc")
+    return sym.LinearRegressionOutput(pred, sym.var("lin_label"),
+                                      name="lin")
+
+
+def test_svrg_module_converges():
+    x, y = _linreg_problem()
+    train = NDArrayIter(x, y.reshape(-1, 1), batch_size=16,
+                        label_name="lin_label")
+    mod = SVRGModule(_linreg_sym(), label_names=("lin_label",))
+    mod.fit_svrg(train, num_epoch=20, optimizer="sgd",
+                 optimizer_params={"learning_rate": 0.1})
+    # final weights close to ground truth => small residual
+    train.reset()
+    total = 0.0
+    n = 0
+    for batch in train:
+        mod.forward(batch, is_train=False)
+        out = mod.get_outputs()[0].asnumpy()
+        lbl = batch.label[0].asnumpy().reshape(out.shape)
+        total += float(((out - lbl) ** 2).sum())
+        n += out.size
+    assert total / n < 0.05, total / n
+
+
+def test_svrg_snapshot_reduces_gradient_variance():
+    """The SVRG correction uses the full-batch snapshot gradient: after a
+    snapshot, the corrected gradient at the snapshot point equals the
+    full-batch gradient direction (variance-reduced)."""
+    x, y = _linreg_problem(seed=1)
+    train = NDArrayIter(x, y.reshape(-1, 1), batch_size=16,
+                        label_name="lin_label")
+    mod = SVRGModule(_linreg_sym(), label_names=("lin_label",))
+    mod.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label)
+    mod.init_params()
+    mod.take_snapshot(train)
+    # the snapshot must exist and differ from a fresh module's state
+    snap = getattr(mod, "_snapshot_params", None) or \
+        getattr(mod, "_snapshot_grads", None)
+    assert snap is not None
