@@ -36,22 +36,52 @@ def _is_bf16(arr) -> bool:
     return data.dtype == jnp.bfloat16
 
 
+_CSR_MARK = "__csr__:"
+_RSP_MARK = "__rsp__:"
+
+
+def _sparse_payload(prefix: str, v, payload: dict) -> None:
+    from . import sparse as _sp
+    import jax.numpy as jnp
+    bf16 = v._data.dtype == jnp.bfloat16
+    mark = _CSR_MARK if isinstance(v, _sp.CSRNDArray) else _RSP_MARK
+    data_key = f"{prefix}{mark}~data" + ("~bf16" if bf16 else "")
+    data = _np.asarray(v._data.astype(jnp.float32)) if bf16 \
+        else _np.asarray(v._data)
+    payload[data_key] = data
+    payload[f"{prefix}{mark}~indices"] = _np.asarray(v._indices)
+    if isinstance(v, _sp.CSRNDArray):
+        payload[f"{prefix}{mark}~indptr"] = _np.asarray(v._indptr)
+    payload[f"{prefix}{mark}~shape"] = _np.asarray(v.shape, _np.int64)
+
+
+def _entry(i, k, v, payload):
+    from . import sparse as _sp
+    if _CSR_MARK in k or _RSP_MARK in k:
+        raise MXNetError(
+            f"array name {k!r} contains a reserved storage marker")
+    if isinstance(v, (_sp.CSRNDArray, _sp.RowSparseNDArray)):
+        _sparse_payload(f"{i}:{k}", v, payload)
+    elif isinstance(v, _nd.NDArray):
+        name = f"{i}:{_BF16_PREFIX if _is_bf16(v) else ''}{k}"
+        payload[name] = _to_numpy(v)
+    else:
+        raise MXNetError("save expects NDArray values")
+
+
 def save(fname: str, data) -> None:
-    """Save a list or dict of NDArrays (ref: mx.nd.save)."""
+    """Save a list or dict of (possibly sparse) NDArrays
+    (ref: mx.nd.save — the reference serializes row_sparse/csr storage
+    too)."""
     if isinstance(data, _nd.NDArray):
         data = [data]
     payload = {}
     if isinstance(data, dict):
         for i, (k, v) in enumerate(data.items()):
-            if not isinstance(v, _nd.NDArray):
-                raise MXNetError("save expects NDArray values")
-            name = f"{i}:{_BF16_PREFIX if _is_bf16(v) else ''}{k}"
-            payload[name] = _to_numpy(v)
+            _entry(i, k, v, payload)
     elif isinstance(data, (list, tuple)):
         for i, v in enumerate(data):
-            if not isinstance(v, _nd.NDArray):
-                raise MXNetError("save expects NDArray values")
-            payload[f"{i}:{_BF16_PREFIX if _is_bf16(v) else ''}"] = _to_numpy(v)
+            _entry(i, "", v, payload)
     else:
         raise MXNetError("save expects NDArray, list or dict")
     with open(fname, "wb") as f:
@@ -61,17 +91,43 @@ def save(fname: str, data) -> None:
 def load(fname: str) -> Union[List, Dict]:
     """Load arrays saved by :func:`save` (ref: mx.nd.load)."""
     import jax.numpy as jnp
+    from . import sparse as _sp
     with _np.load(fname, allow_pickle=False) as z:
         entries = []
+        sparse_parts: Dict[tuple, Dict[str, _np.ndarray]] = {}
         for key in z.files:
             idx_s, _, name = key.partition(":")
-            arr = z[key]
-            if name.startswith(_BF16_PREFIX):
-                name = name[len(_BF16_PREFIX):]
-                nd = _nd.array(arr).astype(jnp.bfloat16)
+            for mark, kind in ((_CSR_MARK, "csr"), (_RSP_MARK, "rsp")):
+                if mark in name:
+                    base, _, part = name.partition(mark)
+                    part = part.lstrip("~")
+                    sparse_parts.setdefault((int(idx_s), base, kind),
+                                            {})[part] = z[key]
+                    break
             else:
-                nd = _nd.array(arr, dtype=arr.dtype)
-            entries.append((int(idx_s), name, nd))
+                arr = z[key]
+                if name.startswith(_BF16_PREFIX):
+                    name = name[len(_BF16_PREFIX):]
+                    nd = _nd.array(arr).astype(jnp.bfloat16)
+                else:
+                    nd = _nd.array(arr, dtype=arr.dtype)
+                entries.append((int(idx_s), name, nd))
+        for (idx, base, kind), parts in sparse_parts.items():
+            shape = tuple(int(x) for x in parts["shape"])
+            data = parts.get("data")
+            bf16 = data is None
+            if bf16:
+                data = parts["data~bf16"]
+            if kind == "csr":
+                nd = _sp.csr_matrix((data, parts["indices"],
+                                     parts["indptr"]), shape=shape)
+            else:
+                nd = _sp.row_sparse_array((data, parts["indices"]),
+                                          shape=shape)
+            if bf16:
+                nd = nd.astype(jnp.bfloat16) if hasattr(nd, "astype") \
+                    else nd
+            entries.append((idx, base, nd))
     entries.sort(key=lambda e: e[0])
     if any(name for _, name, _ in entries):
         return {name: nd for _, name, nd in entries}

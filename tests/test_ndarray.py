@@ -250,3 +250,52 @@ def test_transpose_axes_keyword():
     assert a.transpose(axes=(0, 2, 1)).shape == (2, 1, 3)
     assert a.transpose(2, 0, 1).shape == (1, 2, 3)
     assert a.transpose((1, 0, 2)).shape == (3, 2, 1)
+
+
+def test_sparse_save_load_roundtrip(tmp_path):
+    """(ref: mx.nd.save serializes row_sparse/csr storage types)"""
+    from mxnet_tpu.ndarray import utils as nd_utils, sparse as sp
+    dense_csr = np.array([[1.0, 0, 2], [0, 0, 3]], np.float32)
+    dense_rsp = np.array([[0, 0], [1, 2], [0, 0], [4, 5]], np.float32)
+    csr = sp.csr_matrix(dense_csr)
+    rsp = sp.row_sparse_array(dense_rsp)
+    f = str(tmp_path / "mixed.params")
+    nd_utils.save(f, {"csr": csr, "rsp": rsp, "dense": nd.ones((2, 2))})
+    loaded = nd_utils.load(f)
+    assert type(loaded["csr"]).__name__ == "CSRNDArray"
+    assert type(loaded["rsp"]).__name__ == "RowSparseNDArray"
+    def dense(x):
+        if hasattr(x, "todense"):
+            x = x.todense()
+        return x.asnumpy()
+    np.testing.assert_allclose(dense(loaded["csr"]), dense_csr)
+    np.testing.assert_allclose(dense(loaded["rsp"]), dense_rsp)
+    np.testing.assert_allclose(loaded["dense"].asnumpy(), np.ones((2, 2)))
+    # list form too
+    f2 = str(tmp_path / "list.params")
+    nd_utils.save(f2, [csr, nd.zeros((2,))])
+    out = nd_utils.load(f2)
+    assert isinstance(out, list) and len(out) == 2
+    np.testing.assert_allclose(dense(out[0]), dense_csr)
+
+
+def test_sparse_save_reserved_marker_rejected(tmp_path):
+    from mxnet_tpu.ndarray import utils as nd_utils
+    import pytest
+    from mxnet_tpu.base import MXNetError
+    with pytest.raises(MXNetError, match="reserved"):
+        nd_utils.save(str(tmp_path / "bad.params"),
+                      {"w__csr__:x": nd.ones((2, 2))})
+
+
+def test_sparse_save_load_bf16(tmp_path):
+    import jax.numpy as jnp
+    from mxnet_tpu.ndarray import utils as nd_utils, sparse as sp
+    csr = sp.csr_matrix(np.array([[1.0, 0, 2], [0, 0, 3]], np.float32))
+    csr._data = csr._data.astype(jnp.bfloat16)
+    f = str(tmp_path / "b.params")
+    nd_utils.save(f, {"w": csr})
+    out = nd_utils.load(f)["w"]
+    np.testing.assert_allclose(
+        np.asarray(out.todense().asnumpy(), np.float32),
+        [[1, 0, 2], [0, 0, 3]])

@@ -45,10 +45,9 @@ def test_svrg_module_converges():
     assert total / n < 0.05, total / n
 
 
-def test_svrg_snapshot_reduces_gradient_variance():
-    """The SVRG correction uses the full-batch snapshot gradient: after a
-    snapshot, the corrected gradient at the snapshot point equals the
-    full-batch gradient direction (variance-reduced)."""
+def test_svrg_take_snapshot_stores_params():
+    """take_snapshot captures the current parameters for the full-batch
+    gradient correction term."""
     x, y = _linreg_problem(seed=1)
     train = NDArrayIter(x, y.reshape(-1, 1), batch_size=16,
                         label_name="lin_label")
@@ -57,7 +56,8 @@ def test_svrg_snapshot_reduces_gradient_variance():
              label_shapes=train.provide_label)
     mod.init_params()
     mod.take_snapshot(train)
-    # the snapshot must exist and differ from a fresh module's state
-    snap = getattr(mod, "_snapshot_params", None) or \
-        getattr(mod, "_snapshot_grads", None)
-    assert snap is not None
+    assert mod._snapshot_params is not None
+    arg, _ = mod.get_params()
+    for k, v in mod._snapshot_params.items():
+        np.testing.assert_allclose(np.asarray(v.asnumpy()),
+                                   np.asarray(arg[k].asnumpy()))
