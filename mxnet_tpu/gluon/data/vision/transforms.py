@@ -1,20 +1,27 @@
 """Vision transforms (ref: python/mxnet/gluon/data/vision/transforms.py).
 
-Implemented over nd ops (numpy-free where possible) so transforms can also
-run inside compiled pipelines.
+Like the reference, these Blocks are thin wrappers over the ``mx.nd.image``
+operators (src/operator/image/) so the exact same kernels serve both the
+transform pipeline and direct op calls; random transforms draw from the
+global ``mx.random`` key stream.
 """
 from __future__ import annotations
 
 import numpy as np
 
-from ....base import MXNetError, check
 from ...block import Block, HybridBlock
 from ...nn import Sequential, HybridSequential
 
 __all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize",
            "CenterCrop", "RandomResizedCrop", "RandomFlipLeftRight",
            "RandomFlipTopBottom", "RandomBrightness", "RandomContrast",
-           "RandomSaturation", "RandomLighting", "RandomColorJitter"]
+           "RandomSaturation", "RandomHue", "RandomLighting",
+           "RandomColorJitter"]
+
+
+def _image():
+    from .... import ndarray as nd
+    return nd.image
 
 
 class Compose(Sequential):
@@ -39,46 +46,32 @@ class ToTensor(HybridBlock):
     """HWC uint8 [0,255] -> CHW float32 [0,1] (ref: ToTensor)."""
 
     def hybrid_forward(self, F, x):
-        x = F.cast(x, dtype="float32") / 255.0
-        if x.ndim == 3:
-            return F.transpose(x, axes=(2, 0, 1))
-        return F.transpose(x, axes=(0, 3, 1, 2))
+        return F._internal._image_to_tensor(x)
 
 
 class Normalize(HybridBlock):
     def __init__(self, mean=0.0, std=1.0):
         super().__init__()
-        self._mean = mean
-        self._std = std
+        self._mean = mean if isinstance(mean, (tuple, list)) else (mean,)
+        self._std = std if isinstance(std, (tuple, list)) else (std,)
 
     def hybrid_forward(self, F, x):
-        import numpy as _np
-        mean = _np.asarray(self._mean, _np.float32).reshape(-1, 1, 1)
-        std = _np.asarray(self._std, _np.float32).reshape(-1, 1, 1)
-        from ....ndarray import array
-        return (x - array(mean)) / array(std)
+        return F._internal._image_normalize(x, mean=tuple(self._mean),
+                                            std=tuple(self._std))
 
 
 class Resize(Block):
-    """Bilinear resize (ref: Resize; image_io/resize)."""
+    """Resize via the _image_resize op (ref: Resize)."""
 
     def __init__(self, size, keep_ratio=False, interpolation=1):
         super().__init__()
-        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._size = size
+        self._keep = keep_ratio
+        self._interp = interpolation
 
     def forward(self, x):
-        import jax
-        from ....ndarray import from_jax
-        data = x._data
-        h, w = self._size[1], self._size[0]
-        if data.ndim == 3:
-            out = jax.image.resize(data.astype("float32"),
-                                   (h, w, data.shape[2]), "bilinear")
-        else:
-            out = jax.image.resize(data.astype("float32"),
-                                   (data.shape[0], h, w, data.shape[3]),
-                                   "bilinear")
-        return from_jax(out.astype(data.dtype))
+        return _image().resize(x, size=self._size, keep_ratio=self._keep,
+                               interp=self._interp)
 
 
 class CenterCrop(Block):
@@ -101,11 +94,12 @@ class RandomResizedCrop(Block):
         self._size = (size, size) if isinstance(size, int) else tuple(size)
         self._scale = scale
         self._ratio = ratio
-        self._resize = Resize(self._size)
+        self._interp = interpolation
 
     def forward(self, x):
         H, W = x.shape[-3], x.shape[-2]
         area = H * W
+        crop = x
         for _ in range(10):
             target = np.random.uniform(*self._scale) * area
             ratio = np.random.uniform(*self._ratio)
@@ -115,90 +109,74 @@ class RandomResizedCrop(Block):
                 x0 = np.random.randint(0, W - w + 1)
                 y0 = np.random.randint(0, H - h + 1)
                 crop = x[..., y0:y0 + h, x0:x0 + w, :]
-                return self._resize(crop)
-        return self._resize(x)
+                break
+        return _image().resize(crop, size=(self._size[0], self._size[1]),
+                               interp=self._interp)
 
 
-class _RandomFlip(Block):
-    _axis = -2
-
-    def __init__(self, p=0.5):
-        super().__init__()
-        self._p = p
-
+class RandomFlipLeftRight(Block):
     def forward(self, x):
-        if np.random.rand() < self._p:
-            return x.flip(axis=x.ndim + self._axis)
-        return x
+        return _image().random_flip_left_right(x)
 
 
-class RandomFlipLeftRight(_RandomFlip):
-    _axis = -2
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        return _image().random_flip_top_bottom(x)
 
 
-class RandomFlipTopBottom(_RandomFlip):
-    _axis = -3
+class _RandomEnhance(Block):
+    """factor m -> uniform alpha in [max(0, 1-m), 1+m] like the reference."""
 
-
-class _ColorJitterBase(Block):
     def __init__(self, magnitude):
         super().__init__()
-        self._m = magnitude
-
-    def _alpha(self):
-        return 1.0 + np.random.uniform(-self._m, self._m)
+        self._lo = max(0.0, 1.0 - magnitude)
+        self._hi = 1.0 + magnitude
 
 
-class RandomBrightness(_ColorJitterBase):
+class RandomBrightness(_RandomEnhance):
     def forward(self, x):
-        return (x.astype("float32") * self._alpha()).clip(0, 255) \
-            .astype(x.dtype)
+        return _image().random_brightness(x, min_factor=self._lo,
+                                          max_factor=self._hi)
 
 
-class RandomContrast(_ColorJitterBase):
+class RandomContrast(_RandomEnhance):
     def forward(self, x):
-        alpha = self._alpha()
-        xf = x.astype("float32")
-        gray = xf.mean()
-        return (xf * alpha + gray * (1 - alpha)).clip(0, 255).astype(x.dtype)
+        return _image().random_contrast(x, min_factor=self._lo,
+                                        max_factor=self._hi)
 
 
-class RandomSaturation(_ColorJitterBase):
+class RandomSaturation(_RandomEnhance):
     def forward(self, x):
-        alpha = self._alpha()
-        xf = x.astype("float32")
-        gray = xf.mean(axis=-1, keepdims=True)
-        return (xf * alpha + gray * (1 - alpha)).clip(0, 255).astype(x.dtype)
+        return _image().random_saturation(x, min_factor=self._lo,
+                                          max_factor=self._hi)
 
 
-class RandomLighting(_ColorJitterBase):
-    """AlexNet-style PCA noise (ref: RandomLighting)."""
-
-    _eigval = np.array([55.46, 4.794, 1.148], np.float32)
-    _eigvec = np.array([[-0.5675, 0.7192, 0.4009],
-                        [-0.5808, -0.0045, -0.8140],
-                        [-0.5836, -0.6948, 0.4203]], np.float32)
+class RandomHue(Block):
+    def __init__(self, hue):
+        super().__init__()
+        self._hue = hue
 
     def forward(self, x):
-        alpha = np.random.normal(0, self._m, 3).astype(np.float32)
-        rgb = (self._eigvec @ (alpha * self._eigval)).astype(np.float32)
-        from ....ndarray import array
-        return (x.astype("float32") + array(rgb)).clip(0, 255).astype(x.dtype)
+        return _image().random_hue(x, min_factor=-self._hue,
+                                   max_factor=self._hue)
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA noise (ref: RandomLighting(alpha))."""
+
+    def __init__(self, alpha):
+        super().__init__()
+        self._std = alpha
+
+    def forward(self, x):
+        return _image().random_lighting(x, alpha_std=self._std)
 
 
 class RandomColorJitter(Block):
     def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
         super().__init__()
-        self._ts = []
-        if brightness:
-            self._ts.append(RandomBrightness(brightness))
-        if contrast:
-            self._ts.append(RandomContrast(contrast))
-        if saturation:
-            self._ts.append(RandomSaturation(saturation))
+        self._args = dict(brightness=brightness, contrast=contrast,
+                          saturation=saturation, hue=hue)
 
     def forward(self, x):
-        order = np.random.permutation(len(self._ts))
-        for i in order:
-            x = self._ts[i](x)
-        return x
+        return _image().random_color_jitter(x, **self._args)

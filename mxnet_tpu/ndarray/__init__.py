@@ -17,7 +17,7 @@ from . import register as _register
 
 # build sub-namespace modules (mx.nd.random etc.)
 _this = sys.modules[__name__]
-_subnames = ["random", "linalg", "contrib", "_internal", "op"]
+_subnames = ["random", "linalg", "contrib", "image", "_internal", "op"]
 _submodules = {}
 for _n in _subnames:
     _m = types.ModuleType(__name__ + "." + _n)
@@ -30,8 +30,40 @@ _register.populate(_this, _submodules)
 from . import sparse  # noqa: E402,F401
 _submodules["sparse"] = sparse
 
+# storage-type ops live at the frontend level (sparse arrays are Python
+# containers, not registry values) — same surface as the reference:
+# mx.nd.cast_storage / mx.nd.sparse_retain / mx.nd.contrib.getnnz
+cast_storage = sparse.cast_storage
+sparse_retain = sparse.sparse_retain
+_submodules["contrib"].getnnz = sparse.getnnz
+sparse.retain = sparse.sparse_retain  # mx.nd.sparse.retain alias
+
 # creation/builtin helpers that shadow any op with the same name
 from .ndarray import (zeros, ones, full, empty, arange, linspace, eye,  # noqa
                       array, concatenate, stack, moveaxis)
 
 NDArray = NDArray
+
+
+def split_v2(ary, indices_or_sections, axis=0, squeeze_axis=False):
+    """numpy-style split (ref: python/mxnet/ndarray/ndarray.py:3949
+    split_v2 — int -> equal sections, tuple -> interior boundaries; the
+    internal op receives boundaries with a prepended 0)."""
+    from ..base import MXNetError
+    if isinstance(indices_or_sections, int):
+        if ary.shape[axis] % indices_or_sections:
+            raise MXNetError("array split does not result in an equal "
+                             "division")
+        return ndarray.imperative_invoke(
+            "_split_v2", (ary,),
+            {"sections": indices_or_sections, "axis": axis,
+             "squeeze_axis": squeeze_axis})
+    if isinstance(indices_or_sections, (tuple, list)):
+        return ndarray.imperative_invoke(
+            "_split_v2", (ary,),
+            {"indices": (0,) + tuple(indices_or_sections), "axis": axis,
+             "squeeze_axis": squeeze_axis})
+    raise MXNetError("indices_or_sections must be int or tuple of ints")
+
+
+from . import ndarray  # noqa: E402  (module self-reference for split_v2)

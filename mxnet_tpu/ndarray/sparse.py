@@ -174,6 +174,28 @@ class CSRNDArray(BaseSparseNDArray):
         return self.todense()[idx]
 
 
+def _dense_to_row_sparse(np_d: _np.ndarray, ctx=None) -> "RowSparseNDArray":
+    """Shared dense -> row_sparse conversion (vectorized)."""
+    jnp = _jnp()
+    nz_rows = _np.where(_np.any(np_d != 0, axis=tuple(range(1, np_d.ndim))))[0]
+    return RowSparseNDArray(jnp.asarray(np_d[nz_rows]),
+                            jnp.asarray(nz_rows, _np.int32),
+                            np_d.shape, ctx)
+
+
+def _dense_to_csr(dense: _np.ndarray, ctx=None) -> "CSRNDArray":
+    """Shared dense -> csr conversion (vectorized; ref:
+    src/operator/tensor/cast_storage-inl.h CastStorageDnsCsrImpl)."""
+    jnp = _jnp()
+    check(dense.ndim == 2, "csr requires 2-D input")
+    rows, cols = _np.nonzero(dense)
+    indptr = _np.concatenate(
+        ([0], _np.cumsum(_np.bincount(rows, minlength=dense.shape[0]))))
+    return CSRNDArray(jnp.asarray(dense[rows, cols]),
+                      _np.asarray(cols, _np.int32),
+                      _np.asarray(indptr, _np.int32), dense.shape, ctx)
+
+
 def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
     """(ref: mx.nd.sparse.row_sparse_array)"""
     jnp = _jnp()
@@ -183,37 +205,18 @@ def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
         indices = jnp.asarray(_np.asarray(indices), _np.int32)
         check(shape is not None, "shape required")
         return RowSparseNDArray(data, indices, shape, ctx)
-    # from dense
-    dense = _nd.array(arg1, dtype=dtype)
-    np_d = dense.asnumpy()
-    nz_rows = _np.where(_np.any(np_d != 0, axis=tuple(range(1, np_d.ndim))))[0]
-    return RowSparseNDArray(jnp.asarray(np_d[nz_rows]),
-                            jnp.asarray(nz_rows, _np.int32),
-                            np_d.shape, ctx)
+    return _dense_to_row_sparse(_nd.array(arg1, dtype=dtype).asnumpy(), ctx)
 
 
 def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
     """(ref: mx.nd.sparse.csr_matrix)"""
-    jnp = _jnp()
     if isinstance(arg1, tuple) and len(arg1) == 3:
         data, indices, indptr = arg1
         check(shape is not None, "shape required")
         return CSRNDArray(_nd.array(data, dtype=dtype)._data,
                           _np.asarray(indices), _np.asarray(indptr),
                           shape, ctx)
-    dense = _np.asarray(arg1, dtype=dtype or _np.float32)
-    check(dense.ndim == 2, "csr requires 2D")
-    indptr = [0]
-    indices = []
-    data = []
-    for r in range(dense.shape[0]):
-        cols = _np.nonzero(dense[r])[0]
-        indices.extend(cols.tolist())
-        data.extend(dense[r, cols].tolist())
-        indptr.append(len(indices))
-    return CSRNDArray(jnp.asarray(_np.asarray(data, dense.dtype)),
-                      _np.asarray(indices, _np.int32),
-                      _np.asarray(indptr, _np.int32), dense.shape, ctx)
+    return _dense_to_csr(_np.asarray(arg1, dtype=dtype or _np.float32), ctx)
 
 
 def zeros(stype, shape, ctx=None, dtype=None):
@@ -232,3 +235,47 @@ def array(source, ctx=None, dtype=None):
     if isinstance(source, (RowSparseNDArray, CSRNDArray)):
         return source
     return _nd.array(source, ctx=ctx, dtype=dtype)
+
+
+def cast_storage(data, stype="default"):
+    """Convert between dense / row_sparse / csr storage
+    (ref: src/operator/tensor/cast_storage.cc)."""
+    if isinstance(data, (RowSparseNDArray, CSRNDArray)):
+        if stype == "default":
+            return data.todense()
+        if stype == data.stype:
+            return data
+        data = data.todense()  # sparse->sparse goes through dense
+    if stype == "default":
+        return data
+    arr = _np.asarray(data.asnumpy())
+    ctx = getattr(data, "context", None)
+    if stype == "row_sparse":
+        return _dense_to_row_sparse(arr, ctx)
+    if stype == "csr":
+        return _dense_to_csr(arr, ctx)
+    raise MXNetError(f"unknown stype {stype!r}")
+
+
+def sparse_retain(data, indices):
+    """Retain listed rows of a row_sparse array
+    (ref: src/operator/tensor/sparse_retain.cc)."""
+    check(isinstance(data, RowSparseNDArray),
+          "sparse_retain requires a row_sparse input")
+    return data.retain(indices)
+
+
+def getnnz(data, axis=None):
+    """Number of stored values of a csr matrix
+    (ref: src/operator/contrib/nnz.cc _contrib_getnnz)."""
+    check(isinstance(data, CSRNDArray), "getnnz requires a csr input")
+    if axis is None:
+        return _nd.array(_np.asarray(int(data.data.shape[0]), _np.int64))
+    if axis == 1:  # per-row (ref: nnz.cc CsrNNZRowKernel)
+        indptr = data.indptr.asnumpy()
+        return _nd.array((indptr[1:] - indptr[:-1]).astype(_np.int64))
+    check(axis == 0, "getnnz: axis must be None, 0 or 1")
+    # per-column — unsupported in the reference (nnz.cc:124), provided here
+    counts = _np.bincount(data.indices.asnumpy().astype(_np.int64),
+                          minlength=data.shape[1])
+    return _nd.array(counts.astype(_np.int64))

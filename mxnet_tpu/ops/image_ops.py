@@ -1,0 +1,305 @@
+"""``mx.nd.image`` operators.
+
+Reference: src/operator/image/image_random.cc (to_tensor, normalize, flips,
+random brightness/contrast/saturation/hue, color jitter, lighting) and
+src/operator/image/resize.cc (_image_resize). The reference draws its
+per-call randomness from the engine's PRNG resource
+(include/mxnet/resource.h kRandom); here random_* ops are pure functions of
+an explicit key split from the global ``mx.random`` stream (rng=True),
+reproducible under jit by construction.
+
+All ops accept HWC images or NHWC batches (the reference's 1.5-dev image
+ops are HWC-only; batch support matches later upstream and costs nothing
+under vmap-free broadcasting).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .registry import register
+
+# ITU-R BT.601 luma coefficients (ref: image_random-inl.h AdjustSaturationImpl)
+_GRAY_COEF = (0.299, 0.587, 0.114)
+# AlexNet PCA lighting basis (ref: image_random-inl.h AdjustLightingImpl /
+# python RandomLighting defaults)
+_EIGVAL = _np.array([55.46, 4.794, 1.148], _np.float32)
+_EIGVEC = _np.array([[-0.5675, 0.7192, 0.4009],
+                     [-0.5808, -0.0045, -0.8140],
+                     [-0.5836, -0.6948, 0.4203]], _np.float32)
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _jr():
+    import jax.random as jr
+    return jr
+
+
+def _saturate(x, dtype):
+    """saturate_cast<DType>: clamp to the integer range for int dtypes."""
+    jnp = _jnp()
+    dt = _np.dtype(dtype)
+    if dt.kind in "ui":
+        info = _np.iinfo(dt)
+        x = jnp.clip(jnp.rint(x), info.min, info.max)
+    return x.astype(dt)
+
+
+@register("_image_to_tensor", differentiable=False)
+def _image_to_tensor(data, **_):
+    """HWC [0,255] -> CHW float32 [0,1] (ref: image_random.cc ToTensor)."""
+    jnp = _jnp()
+    x = data.astype(jnp.float32) / 255.0
+    if x.ndim == 3:
+        return jnp.transpose(x, (2, 0, 1))
+    return jnp.transpose(x, (0, 3, 1, 2))
+
+
+@register("_image_normalize")
+def _image_normalize(data, mean=(0.0,), std=(1.0,), **_):
+    """(x - mean) / std per channel on CHW/NCHW float input
+    (ref: image_random.cc Normalize)."""
+    jnp = _jnp()
+    mean = _np.asarray(mean, _np.float32).reshape(-1, 1, 1)
+    std = _np.asarray(std, _np.float32).reshape(-1, 1, 1)
+    return (data - jnp.asarray(mean)) / jnp.asarray(std)
+
+
+@register("_image_resize", differentiable=False)
+def _image_resize(data, size=(), keep_ratio=False, interp=1, **_):
+    """HWC/NHWC resize (ref: resize.cc). size = (), int, or (w, h)."""
+    import jax
+    jnp = _jnp()
+    method = {0: "nearest", 1: "bilinear", 2: "bicubic",
+              3: "bicubic", 4: "bicubic"}.get(int(interp), "bilinear")
+    batched = data.ndim == 4
+    H, W = (data.shape[1], data.shape[2]) if batched else \
+        (data.shape[0], data.shape[1])
+    if isinstance(size, (int, _np.integer)):
+        size = (size,)
+    size = tuple(int(s) for s in size)
+    if len(size) == 0:
+        new_h, new_w = H, W
+    elif len(size) == 1:
+        if keep_ratio:  # resize short edge to `size`
+            if H < W:
+                new_h, new_w = size[0], max(1, round(W * size[0] / H))
+            else:
+                new_h, new_w = max(1, round(H * size[0] / W)), size[0]
+        else:
+            new_h = new_w = size[0]
+    else:
+        new_w, new_h = size[0], size[1]
+    shape = ((data.shape[0], new_h, new_w, data.shape[3]) if batched
+             else (new_h, new_w, data.shape[2]))
+    out = jax.image.resize(data.astype(jnp.float32), shape, method)
+    return _saturate(out, data.dtype)
+
+
+def _flip(data, axis_from_last):
+    # HWC: W is axis -2, H is axis -3; works for NHWC too.
+    return _jnp().flip(data, axis=data.ndim + axis_from_last)
+
+
+@register("_image_flip_left_right",
+          differentiable=False)
+def _image_flip_left_right(data, **_):
+    return _flip(data, -2)
+
+
+@register("_image_flip_top_bottom",
+          differentiable=False)
+def _image_flip_top_bottom(data, **_):
+    return _flip(data, -3)
+
+
+def _random_flip(data, key, axis_from_last):
+    jnp = _jnp()
+    coin = _jr().bernoulli(key, 0.5)
+    return jnp.where(coin, _flip(data, axis_from_last), data)
+
+
+@register("_image_random_flip_left_right", rng=True,
+          differentiable=False)
+def _image_random_flip_left_right(data, _key, **_):
+    return _random_flip(data, _key, -2)
+
+
+@register("_image_random_flip_top_bottom", rng=True,
+          differentiable=False)
+def _image_random_flip_top_bottom(data, _key, **_):
+    return _random_flip(data, _key, -3)
+
+
+def _adjust_brightness(x, alpha, dtype):
+    return _saturate(x * alpha, dtype)
+
+
+def _adjust_contrast(x, alpha, dtype):
+    jnp = _jnp()
+    # per-image gray mean: reduce H, W (and C) but keep the batch axis so
+    # NHWC batches don't mix statistics across images
+    spatial = tuple(range(x.ndim - 3, x.ndim - 1))
+    if x.shape[-1] == 3:
+        coef = jnp.asarray(_GRAY_COEF, jnp.float32)
+        gray = jnp.tensordot(x, coef, axes=([-1], [0]))
+        gray_mean = jnp.mean(gray, axis=spatial, keepdims=True)[..., None]
+    else:
+        gray_mean = jnp.mean(x, axis=spatial + (x.ndim - 1,), keepdims=True)
+    return _saturate(x * alpha + (1.0 - alpha) * gray_mean, dtype)
+
+
+def _adjust_saturation(x, alpha, dtype):
+    jnp = _jnp()
+    if x.shape[-1] != 3:
+        return _saturate(x, dtype)
+    coef = jnp.asarray(_GRAY_COEF, jnp.float32)
+    gray = jnp.tensordot(x, coef, axes=([-1], [0]))[..., None]
+    return _saturate(x * alpha + (1.0 - alpha) * gray, dtype)
+
+
+def _rgb_to_hls(rgb):
+    """Vectorised RGB2HLSConvert (ref: image_random-inl.h:783-822)."""
+    jnp = _jnp()
+    x = rgb / 255.0
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    vmax = jnp.maximum(jnp.maximum(r, g), b)
+    vmin = jnp.minimum(jnp.minimum(r, g), b)
+    diff = vmax - vmin
+    l = (vmax + vmin) * 0.5
+    eps = _np.finfo(_np.float32).eps
+    safe = diff > eps
+    denom = jnp.where(l < 0.5, vmax + vmin, 2.0 - vmax - vmin)
+    s = jnp.where(safe, diff / jnp.maximum(denom, eps), 0.0)
+    d = 60.0 / jnp.maximum(diff, eps)
+    h = jnp.where(vmax == r, (g - b) * d,
+                  jnp.where(vmax == g, (b - r) * d + 120.0,
+                            (r - g) * d + 240.0))
+    h = jnp.where(h < 0, h + 360.0, h)
+    h = jnp.where(safe, h, 0.0)
+    return h, l, s
+
+
+def _hls_to_rgb(h, l, s):
+    """Vectorised HLS2RGBConvert (ref: image_random-inl.h:824-879)."""
+    jnp = _jnp()
+    p2 = jnp.where(l <= 0.5, l * (1 + s), l + s - l * s)
+    p1 = 2 * l - p2
+    hh = jnp.mod(h / 60.0, 6.0)
+    sector = jnp.floor(hh).astype(_np.int32)
+    frac = hh - sector
+    t_up = p1 + (p2 - p1) * frac          # rising edge
+    t_down = p1 + (p2 - p1) * (1 - frac)  # falling edge
+    # per-sector (r, g, b) from {p1, p2, t_up, t_down}
+    def sel(table):
+        jnp_ = _jnp()
+        out = table[0]
+        for i in range(1, 6):
+            out = jnp_.where(sector == i, table[i], out)
+        return out
+    r = sel([p2, t_down, p1, p1, t_up, p2])
+    g = sel([t_up, p2, p2, t_down, p1, p1])
+    b = sel([p1, p1, t_up, p2, p2, t_down])
+    gray = jnp.broadcast_to(l, r.shape)
+    mask = s != 0
+    r = jnp.where(mask, r, gray)
+    g = jnp.where(mask, g, gray)
+    b = jnp.where(mask, b, gray)
+    return jnp.stack([r * 255.0, g * 255.0, b * 255.0], axis=-1)
+
+
+def _adjust_hue(x, alpha, dtype):
+    jnp = _jnp()
+    if x.shape[-1] != 3:
+        return _saturate(x, dtype)
+    h, l, s = _rgb_to_hls(x.astype(jnp.float32))
+    out = _hls_to_rgb(h + alpha * 360.0, l, s)
+    return _saturate(out, dtype)
+
+
+def _uniform_factor(key, min_factor, max_factor):
+    return _jr().uniform(key, (), _np.float32, float(min_factor),
+                         float(max_factor))
+
+
+@register("_image_random_brightness",
+          rng=True, differentiable=False)
+def _image_random_brightness(data, _key, min_factor=0.0, max_factor=0.0, **_):
+    jnp = _jnp()
+    alpha = _uniform_factor(_key, min_factor, max_factor)
+    return _adjust_brightness(data.astype(jnp.float32), alpha, data.dtype)
+
+
+@register("_image_random_contrast",
+          rng=True, differentiable=False)
+def _image_random_contrast(data, _key, min_factor=0.0, max_factor=0.0, **_):
+    jnp = _jnp()
+    alpha = _uniform_factor(_key, min_factor, max_factor)
+    return _adjust_contrast(data.astype(jnp.float32), alpha, data.dtype)
+
+
+@register("_image_random_saturation",
+          rng=True, differentiable=False)
+def _image_random_saturation(data, _key, min_factor=0.0, max_factor=0.0, **_):
+    jnp = _jnp()
+    alpha = _uniform_factor(_key, min_factor, max_factor)
+    return _adjust_saturation(data.astype(jnp.float32), alpha, data.dtype)
+
+
+@register("_image_random_hue", rng=True,
+          differentiable=False)
+def _image_random_hue(data, _key, min_factor=0.0, max_factor=0.0, **_):
+    alpha = _uniform_factor(_key, min_factor, max_factor)
+    return _adjust_hue(data, alpha, data.dtype)
+
+
+@register("_image_random_color_jitter",
+          rng=True, differentiable=False)
+def _image_random_color_jitter(data, _key, brightness=0.0, contrast=0.0,
+                               saturation=0.0, hue=0.0, **_):
+    """Apply the four jitters in a random order
+    (ref: image_random.cc RandomColorJitter)."""
+    jr, jnp = _jr(), _jnp()
+    keys = jr.split(_key, 5)
+    x = data.astype(jnp.float32)
+    dtype = data.dtype
+    # Random order via random priorities is data-dependent; the reference
+    # shuffles op order on the host. Use a fixed traced order but randomly
+    # sampled factors — statistically equivalent jitter strength.
+    if brightness > 0:
+        a = _uniform_factor(keys[0], max(0.0, 1 - brightness), 1 + brightness)
+        x = _adjust_brightness(x, a, jnp.float32)
+    if contrast > 0:
+        a = _uniform_factor(keys[1], max(0.0, 1 - contrast), 1 + contrast)
+        x = _adjust_contrast(x, a, jnp.float32)
+    if saturation > 0:
+        a = _uniform_factor(keys[2], max(0.0, 1 - saturation), 1 + saturation)
+        x = _adjust_saturation(x, a, jnp.float32)
+    if hue > 0:
+        a = _uniform_factor(keys[3], -hue, hue)
+        x = _adjust_hue(x, a, jnp.float32)
+    return _saturate(x, dtype)
+
+
+@register("_image_adjust_lighting",
+          differentiable=False)
+def _image_adjust_lighting(data, alpha=(0.0, 0.0, 0.0), **_):
+    """PCA lighting with fixed alphas (ref: image_random.cc AdjustLighting)."""
+    jnp = _jnp()
+    alpha = _np.asarray(alpha, _np.float32)
+    rgb = _EIGVEC @ (alpha * _EIGVAL)
+    return _saturate(data.astype(jnp.float32) + jnp.asarray(rgb), data.dtype)
+
+
+@register("_image_random_lighting", rng=True,
+          differentiable=False)
+def _image_random_lighting(data, _key, alpha_std=0.05, **_):
+    """PCA lighting with alpha ~ N(0, alpha_std)
+    (ref: image_random.cc RandomLighting)."""
+    jnp = _jnp()
+    alpha = _jr().normal(_key, (3,), _np.float32) * float(alpha_std)
+    rgb = jnp.asarray(_EIGVEC) @ (alpha * jnp.asarray(_EIGVAL))
+    return _saturate(data.astype(jnp.float32) + rgb, data.dtype)

@@ -1,0 +1,149 @@
+"""Remaining reference ops: add_n, split_v2, legacy Crop, internal
+assignment/identity helpers.
+
+References: src/operator/tensor/elemwise_sum.cc (add_n/ElementWiseSum),
+src/operator/tensor/matrix_op.cc (_split_v2), src/operator/crop.cc (Crop),
+src/operator/tensor/indexing_op.cc (_scatter_set_nd),
+src/operator/tensor/matrix_op.cc (_slice_assign/_slice_assign_scalar),
+src/operator/tensor/init_op.cc (_zeros_without_dtype),
+src/operator/tensor/elemwise_unary_op_basic.cc
+(_identity_with_attr_like_rhs), src/operator/nn/concat.cc
+(_rnn_param_concat).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+@register("add_n", aliases=("ElementWiseSum", "_sum"), variadic=True)
+def _add_n(*xs, num_args=None):
+    """Elementwise sum of n inputs (ref: elemwise_sum.cc add_n)."""
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+def _split_v2_outputs(n_inputs, params):
+    sections = int(params.get("sections", 0))
+    if sections > 0:
+        return sections
+    # indices follow the C++/frontend convention: they INCLUDE the leading
+    # 0 boundary (python/mxnet/ndarray/ndarray.py:3989 prepends 0), so
+    # num_outputs == len(indices)
+    return max(1, len(tuple(params.get("indices", ()))))
+
+
+@register("_split_v2", num_outputs=_split_v2_outputs)
+def _split_v2(x, indices=(), axis=1, squeeze_axis=False, sections=0):
+    """Split by equal sections or explicit boundary indices. `indices`
+    includes the leading 0 start boundary, matching the reference's
+    serialized attrs (ref: matrix_op.cc _split_v2, SplitParam
+    matrix_op-inl.h:2532; GetSplitIndices builds [0, ...]).
+    """
+    jnp = _jnp()
+    axis = int(axis)
+    if int(sections) > 0:
+        parts = jnp.split(x, int(sections), axis=axis)
+    else:
+        interior = [int(i) for i in indices][1:]  # drop the 0 start boundary
+        parts = jnp.split(x, interior, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts) if len(parts) != 1 else parts[0]
+
+
+@register("Crop", variadic=True)
+def _crop(*inputs, num_args=1, offset=(0, 0), h_w=(0, 0),
+          center_crop=False):
+    """Legacy NCHW crop (ref: src/operator/crop.cc). With two inputs the
+    second is `crop_like` providing the target H/W."""
+    from ..base import check
+    data = inputs[0]
+    if int(num_args) >= 2 and len(inputs) >= 2:
+        th, tw = inputs[1].shape[2], inputs[1].shape[3]
+    else:
+        th, tw = int(h_w[0]), int(h_w[1])
+    H, W = data.shape[2], data.shape[3]
+    check(th <= H and tw <= W,
+          f"Crop: target ({th}, {tw}) larger than input ({H}, {W})")
+    if center_crop:
+        y0, x0 = (H - th) // 2, (W - tw) // 2
+    else:
+        y0, x0 = int(offset[0]), int(offset[1])
+        check(y0 + th <= H and x0 + tw <= W,
+              f"Crop: offset ({y0}, {x0}) + target ({th}, {tw}) exceeds "
+              f"input ({H}, {W})")
+    return data[:, :, y0:y0 + th, x0:x0 + tw]
+
+
+@register("_slice_assign", aliases=("slice_assign",))
+def _slice_assign(lhs, rhs, begin=(), end=(), step=()):
+    """Assign rhs into lhs[begin:end:step] (ref: matrix_op.cc
+    _slice_assign)."""
+    sl = _make_slices(lhs.shape, begin, end, step)
+    return lhs.at[sl].set(rhs)
+
+
+@register("_slice_assign_scalar", aliases=("slice_assign_scalar",))
+def _slice_assign_scalar(lhs, scalar=0.0, begin=(), end=(), step=()):
+    sl = _make_slices(lhs.shape, begin, end, step)
+    return lhs.at[sl].set(scalar)
+
+
+def _make_slices(shape, begin, end, step):
+    begin, end = tuple(begin), tuple(end)
+    step = tuple(step) if step else (1,) * len(begin)
+    out = []
+    for i in range(len(shape)):
+        if i < len(begin):
+            b = begin[i]
+            e = end[i] if i < len(end) else None
+            s = step[i] if i < len(step) and step[i] not in (None, 0) else 1
+            b = None if b is None else int(b)
+            e = None if e is None else int(e)
+            out.append(slice(b, e, int(s)))
+        else:
+            out.append(slice(None))
+    return tuple(out)
+
+
+@register("_zeros_without_dtype", creation=True, differentiable=False)
+def _zeros_without_dtype(shape=(), ctx=None, dtype=None, **_):
+    """zeros whose dtype defaults to float32 when unspecified
+    (ref: init_op.cc _zeros_without_dtype, used for grad init)."""
+    jnp = _jnp()
+    dt = _np.dtype("float32") if dtype in (None, "None", -1) else dtype
+    return jnp.zeros(tuple(shape), dt)
+
+
+@register("_identity_with_attr_like_rhs")
+def _identity_with_attr_like_rhs(lhs, rhs):
+    """Identity on lhs; rhs only contributes shape/stype attrs in the
+    reference's graph passes (ref: elemwise_unary_op_basic.cc)."""
+    return lhs
+
+
+@register("_rnn_param_concat", variadic=True)
+def _rnn_param_concat(*xs, dim=0, num_args=None):
+    """Concat for packing RNN parameters; flattens each input first
+    (ref: src/operator/nn/concat.cc _rnn_param_concat — shape inference
+    differs from Concat but runtime is 1-D concat)."""
+    jnp = _jnp()
+    return jnp.concatenate([x.reshape(-1) for x in xs], axis=0)
+
+
+# SparseEmbedding: the reference's dense-forward / row_sparse-grad embedding
+# (src/operator/tensor/indexing_op.cc _contrib_SparseEmbedding). Gradients
+# here flow through JAX's gather VJP (scatter-add), so the dense Embedding
+# op is semantically equivalent; row_sparse gradient packing happens in the
+# optimizer/kvstore layer.
+from .registry import alias as _alias  # noqa: E402
+_alias("_contrib_SparseEmbedding", "Embedding")

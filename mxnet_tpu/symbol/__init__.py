@@ -7,7 +7,8 @@ from .. import ops as _ops_pkg  # noqa: F401  (ensure registration)
 from . import register as _register
 
 _this = sys.modules[__name__]
-_subnames = ["random", "linalg", "contrib", "_internal", "op", "sparse"]
+_subnames = ["random", "linalg", "contrib", "image", "_internal", "op",
+             "sparse"]
 _submodules = {}
 for _n in _subnames:
     _m = types.ModuleType(__name__ + "." + _n)
@@ -23,3 +24,16 @@ from .executor import Executor  # noqa: F401,E402
 # mark BatchNorm aux inputs for symbolic graphs
 from ..ops import registry as _reg
 _reg.get_op("BatchNorm").aux_inputs = (3, 4)
+
+
+def split_v2(data, indices_or_sections, axis=0, squeeze_axis=False):
+    """Symbolic split_v2 (ref: python/mxnet/symbol/symbol.py split_v2)."""
+    from ..base import MXNetError
+    if isinstance(indices_or_sections, int):
+        return _internal._split_v2(data, sections=indices_or_sections,
+                                   axis=axis, squeeze_axis=squeeze_axis)
+    if isinstance(indices_or_sections, (tuple, list)):
+        return _internal._split_v2(
+            data, indices=(0,) + tuple(indices_or_sections), axis=axis,
+            squeeze_axis=squeeze_axis)
+    raise MXNetError("indices_or_sections must be int or tuple of ints")

@@ -41,6 +41,8 @@ def populate(target_module, submodules: Dict[str, Any]) -> None:
             setattr(submodules["contrib"], name[len("_contrib_"):], fn)
         elif name.startswith("_linalg_"):
             setattr(submodules["linalg"], name[len("_linalg_"):], fn)
+        elif name.startswith("_image_"):
+            setattr(submodules["image"], name[len("_image_"):], fn)
         if name.startswith("_"):
             setattr(submodules["_internal"], name, fn)
             if name.startswith("_random_"):

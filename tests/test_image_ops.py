@@ -1,0 +1,132 @@
+"""mx.nd.image operator tests (ref: tests/python/unittest/test_gluon_data_vision.py
+and src/operator/image/image_random.cc semantics)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _img(h=8, w=6, c=3, dtype=np.uint8, seed=0):
+    rng = np.random.RandomState(seed)
+    return mx.nd.array(rng.randint(0, 255, (h, w, c)).astype(dtype))
+
+
+def test_to_tensor():
+    x = _img()
+    y = mx.nd.image.to_tensor(x)
+    assert y.shape == (3, 8, 6)
+    assert y.dtype == np.float32
+    np.testing.assert_allclose(
+        y.asnumpy(), x.asnumpy().transpose(2, 0, 1) / 255.0, rtol=1e-6)
+    # batched
+    xb = mx.nd.array(np.stack([x.asnumpy()] * 2))
+    yb = mx.nd.image.to_tensor(xb)
+    assert yb.shape == (2, 3, 8, 6)
+
+
+def test_normalize():
+    x = mx.nd.image.to_tensor(_img())
+    y = mx.nd.image.normalize(x, mean=(0.5, 0.4, 0.3), std=(0.2, 0.2, 0.1))
+    ref = (x.asnumpy() - np.array([0.5, 0.4, 0.3]).reshape(3, 1, 1)) \
+        / np.array([0.2, 0.2, 0.1]).reshape(3, 1, 1)
+    np.testing.assert_allclose(y.asnumpy(), ref, rtol=1e-5)
+
+
+def test_resize():
+    x = _img(10, 8)
+    y = mx.nd.image.resize(x, size=(4, 5))  # (w, h)
+    assert y.shape == (5, 4, 3)
+    assert y.dtype == np.uint8
+    # int size, keep_ratio resizes short edge
+    y2 = mx.nd.image.resize(x, size=4, keep_ratio=True)
+    assert y2.shape == (5, 4, 3)
+    # batch
+    yb = mx.nd.image.resize(mx.nd.array(np.stack([x.asnumpy()] * 2)), size=6)
+    assert yb.shape == (2, 6, 6, 3)
+
+
+def test_flips():
+    x = _img()
+    np.testing.assert_array_equal(
+        mx.nd.image.flip_left_right(x).asnumpy(), x.asnumpy()[:, ::-1, :])
+    np.testing.assert_array_equal(
+        mx.nd.image.flip_top_bottom(x).asnumpy(), x.asnumpy()[::-1, :, :])
+    # random flip returns either identity or flipped
+    mx.random.seed(7)
+    y = mx.nd.image.random_flip_left_right(x).asnumpy()
+    assert (y == x.asnumpy()).all() or (y == x.asnumpy()[:, ::-1, :]).all()
+
+
+def test_random_brightness_bounds():
+    x = _img()
+    mx.random.seed(0)
+    y = mx.nd.image.random_brightness(x, min_factor=0.5, max_factor=1.5)
+    assert y.dtype == np.uint8
+    xf = x.asnumpy().astype(np.float32)
+    lo = np.clip(np.rint(xf * 0.5), 0, 255)
+    hi = np.clip(np.rint(xf * 1.5), 0, 255)
+    yf = y.asnumpy().astype(np.float32)
+    assert (yf >= lo - 1).all() and (yf <= hi + 1).all()
+
+
+def test_random_contrast_identity():
+    x = _img()
+    mx.random.seed(0)
+    y = mx.nd.image.random_contrast(x, min_factor=1.0, max_factor=1.0)
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy(), atol=1)
+
+
+def test_random_saturation_identity_and_gray():
+    x = _img()
+    mx.random.seed(0)
+    y = mx.nd.image.random_saturation(x, min_factor=1.0, max_factor=1.0)
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy(), atol=1)
+    # alpha=0 -> luminance gray image, channels equal
+    g = mx.nd.image.random_saturation(x, min_factor=0.0, max_factor=0.0)
+    gn = g.asnumpy()
+    assert np.abs(gn[..., 0].astype(int) - gn[..., 1].astype(int)).max() <= 1
+
+
+def test_random_hue_identity():
+    x = _img()
+    mx.random.seed(0)
+    y = mx.nd.image.random_hue(x, min_factor=0.0, max_factor=0.0)
+    np.testing.assert_allclose(y.asnumpy().astype(int),
+                               x.asnumpy().astype(int), atol=2)
+
+
+def test_hue_rotation_full_circle():
+    x = _img()
+    mx.random.seed(0)
+    y = mx.nd.image.random_hue(x, min_factor=1.0, max_factor=1.0)
+    np.testing.assert_allclose(y.asnumpy().astype(int),
+                               x.asnumpy().astype(int), atol=2)
+
+
+def test_color_jitter_runs():
+    x = _img()
+    mx.random.seed(0)
+    y = mx.nd.image.random_color_jitter(x, brightness=0.3, contrast=0.3,
+                                        saturation=0.3, hue=0.1)
+    assert y.shape == x.shape and y.dtype == np.uint8
+
+
+def test_adjust_lighting():
+    x = _img()
+    y0 = mx.nd.image.adjust_lighting(x, alpha=(0.0, 0.0, 0.0))
+    np.testing.assert_array_equal(y0.asnumpy(), x.asnumpy())
+    y = mx.nd.image.adjust_lighting(x, alpha=(0.1, 0.1, 0.1))
+    assert not (y.asnumpy() == x.asnumpy()).all()
+    mx.random.seed(0)
+    yr = mx.nd.image.random_lighting(x, alpha_std=0.5)
+    assert yr.shape == x.shape
+
+
+def test_symbol_image_namespace():
+    data = mx.sym.var("data")
+    s = mx.sym.image.to_tensor(data)
+    x = _img()
+    ex = s.bind(mx.cpu(), {"data": x})
+    out = ex.forward()[0]
+    np.testing.assert_allclose(
+        out.asnumpy(), x.asnumpy().transpose(2, 0, 1) / 255.0, rtol=1e-6)

@@ -1,0 +1,130 @@
+"""add_n / split_v2 / Crop / slice_assign / storage-cast op tests
+(ref: tests/python/unittest/test_operator.py, test_sparse_ndarray.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def test_add_n():
+    xs = [mx.nd.array(np.full((2, 3), i, np.float32)) for i in range(4)]
+    out = mx.nd.add_n(*xs)
+    np.testing.assert_allclose(out.asnumpy(), np.full((2, 3), 6.0))
+    out2 = mx.nd.ElementWiseSum(*xs)
+    np.testing.assert_allclose(out2.asnumpy(), out.asnumpy())
+
+
+def test_add_n_grad():
+    a = mx.nd.array(np.ones((2, 2), np.float32))
+    b = mx.nd.array(np.ones((2, 2), np.float32))
+    a.attach_grad()
+    b.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.add_n(a, b, a)
+    y.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), 2 * np.ones((2, 2)))
+    np.testing.assert_allclose(b.grad.asnumpy(), np.ones((2, 2)))
+
+
+def test_split_v2():
+    x = mx.nd.array(np.arange(24.0).reshape(2, 12))
+    parts = mx.nd.split_v2(x, 3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == (2, 4)
+    np.testing.assert_allclose(parts[2].asnumpy(), x.asnumpy()[:, 8:])
+    parts = mx.nd.split_v2(x, (2, 5), axis=1)
+    assert [p.shape[1] for p in parts] == [2, 3, 7]
+    # squeeze_axis
+    parts = mx.nd.split_v2(x, 2, axis=0, squeeze_axis=True)
+    assert parts[0].shape == (12,)
+    # unequal sections must raise (ref frontend ValueError analog)
+    import pytest
+    from mxnet_tpu.base import MXNetError
+    with pytest.raises(MXNetError):
+        mx.nd.split_v2(x, 5, axis=1)
+    # internal op accepts serialized attrs with the leading 0 boundary
+    parts = mx.nd._internal._split_v2(x, indices=(0, 2, 5), axis=1)
+    assert [p.shape[1] for p in parts] == [2, 3, 7]
+    # symbolic wrapper
+    s = mx.sym.split_v2(mx.sym.var("d"), (4, 8), axis=1)
+    outs = s.bind(mx.cpu(), {"d": x}).forward()
+    assert [o.shape[1] for o in outs] == [4, 4, 4]
+
+
+def test_crop_legacy():
+    x = mx.nd.array(np.arange(2 * 3 * 6 * 6.0).reshape(2, 3, 6, 6))
+    y = mx.nd.Crop(x, num_args=1, h_w=(4, 4), offset=(1, 1))
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy()[:, :, 1:5, 1:5])
+    like = mx.nd.zeros((2, 3, 2, 2))
+    y2 = mx.nd.Crop(x, like, num_args=2, center_crop=True)
+    np.testing.assert_allclose(y2.asnumpy(), x.asnumpy()[:, :, 2:4, 2:4])
+    # oversized target / out-of-bounds offset must raise
+    import pytest
+    from mxnet_tpu.base import MXNetError
+    with pytest.raises(MXNetError):
+        mx.nd.Crop(x, num_args=1, h_w=(8, 8), center_crop=True)
+    with pytest.raises(MXNetError):
+        mx.nd.Crop(x, num_args=1, h_w=(4, 4), offset=(4, 4))
+
+
+def test_slice_assign_ops():
+    x = mx.nd.array(np.zeros((3, 4), np.float32))
+    y = mx.nd._internal._slice_assign(
+        x, mx.nd.array(np.ones((2, 2), np.float32)), begin=(0, 1), end=(2, 3))
+    expect = np.zeros((3, 4))
+    expect[0:2, 1:3] = 1
+    np.testing.assert_allclose(y.asnumpy(), expect)
+    z = mx.nd._internal._slice_assign_scalar(x, scalar=5.0, begin=(1,),
+                                             end=(2,))
+    assert z.asnumpy()[1].sum() == 20
+
+
+def test_zeros_without_dtype_and_identity():
+    z = mx.nd._internal._zeros_without_dtype(shape=(2, 3))
+    assert z.dtype == np.float32 and z.shape == (2, 3)
+    a = mx.nd.array(np.ones((2, 2)))
+    b = mx.nd.array(np.zeros((2, 2)))
+    out = mx.nd._internal._identity_with_attr_like_rhs(a, b)
+    np.testing.assert_allclose(out.asnumpy(), a.asnumpy())
+
+
+def test_rnn_param_concat():
+    a = mx.nd.array(np.ones((2, 3), np.float32))
+    b = mx.nd.array(np.zeros((4,), np.float32))
+    out = mx.nd._internal._rnn_param_concat(a, b, dim=0)
+    assert out.shape == (10,)
+
+
+def test_cast_storage_roundtrip():
+    x = np.array([[0, 1, 0], [0, 0, 0], [2, 0, 3]], np.float32)
+    nd = mx.nd.array(x)
+    csr = mx.nd.cast_storage(nd, "csr")
+    assert csr.stype == "csr"
+    np.testing.assert_allclose(csr.todense().asnumpy(), x)
+    rs = mx.nd.cast_storage(nd, "row_sparse")
+    assert rs.stype == "row_sparse"
+    np.testing.assert_array_equal(rs.indices.asnumpy(), [0, 2])
+    np.testing.assert_allclose(rs.todense().asnumpy(), x)
+    back = mx.nd.cast_storage(csr, "default")
+    np.testing.assert_allclose(back.asnumpy(), x)
+    # csr -> row_sparse through dense
+    rs2 = mx.nd.cast_storage(csr, "row_sparse")
+    np.testing.assert_allclose(rs2.todense().asnumpy(), x)
+
+
+def test_sparse_retain_and_getnnz():
+    x = np.array([[1, 1], [2, 2], [3, 3], [0, 0]], np.float32)
+    rs = mx.nd.cast_storage(mx.nd.array(x), "row_sparse")
+    kept = mx.nd.sparse_retain(rs, mx.nd.array(np.array([0, 2], np.float32)))
+    np.testing.assert_array_equal(kept.indices.asnumpy(), [0, 2])
+    csr = mx.nd.cast_storage(mx.nd.array(x), "csr")
+    assert int(mx.nd.contrib.getnnz(csr).asnumpy()) == 6
+    per_row = mx.nd.contrib.getnnz(csr, axis=1)
+    np.testing.assert_array_equal(per_row.asnumpy(), [2, 2, 2, 0])
+    per_col = mx.nd.contrib.getnnz(csr, axis=0)
+    np.testing.assert_array_equal(per_col.asnumpy(), [3, 3])
+
+
+def test_sparse_embedding_alias():
+    w = mx.nd.array(np.random.RandomState(0).rand(5, 3).astype(np.float32))
+    idx = mx.nd.array(np.array([0, 4], np.float32))
+    out = mx.nd.contrib.SparseEmbedding(idx, w, input_dim=5, output_dim=3)
+    np.testing.assert_allclose(out.asnumpy(), w.asnumpy()[[0, 4]])
