@@ -38,6 +38,14 @@ sparse_retain = sparse.sparse_retain
 _submodules["contrib"].getnnz = sparse.getnnz
 sparse.retain = sparse.sparse_retain  # mx.nd.sparse.retain alias
 
+# DGL graph ops are likewise host-side csr algorithms (ref:
+# src/operator/contrib/dgl_graph.cc, CPU-only FComputeEx)
+from . import graph_ops as _graph_ops  # noqa: E402
+for _gname in ("edge_id", "dgl_adjacency", "dgl_subgraph",
+               "dgl_csr_neighbor_uniform_sample",
+               "dgl_csr_neighbor_non_uniform_sample", "dgl_graph_compact"):
+    setattr(_submodules["contrib"], _gname, getattr(_graph_ops, _gname))
+
 # creation/builtin helpers that shadow any op with the same name
 from .ndarray import (zeros, ones, full, empty, arange, linspace, eye,  # noqa
                       array, concatenate, stack, moveaxis)

@@ -147,3 +147,20 @@ def _rnn_param_concat(*xs, dim=0, num_args=None):
 # optimizer/kvstore layer.
 from .registry import alias as _alias  # noqa: E402
 _alias("_contrib_SparseEmbedding", "Embedding")
+
+
+@register("_CrossDeviceCopy")
+def _cross_device_copy(data):
+    """Cross-device copy marker inserted between ctx_group placements
+    (ref: src/operator/cross_device_copy.cc). Under XLA one compiled
+    program spans the mesh, so the transfer is a sharding boundary the
+    compiler materializes; imperatively it is identity."""
+    return data
+
+
+# Legacy v1 duplicates kept for checkpoint/JSON backcompat (ref:
+# src/operator/batch_norm_v1.cc, convolution_v1.cc, pooling_v1.cc — the
+# reference retains the pre-NNVM implementations under *_v1 names).
+_alias("BatchNorm_v1", "BatchNorm")
+_alias("Convolution_v1", "Convolution")
+_alias("Pooling_v1", "Pooling")

@@ -176,7 +176,11 @@ def _adamw_update(weight, grad, mean, var, rescale_grad_t, lr=0.01, beta1=0.9,
     m = beta1 * mean + (1 - beta1) * g
     v = beta2 * var + (1 - beta2) * jnp.square(g)
     w = weight - eta * (lr * m / (jnp.sqrt(v) + epsilon) + wd * weight)
-    return w, m, v
+    # skip the update when the dynamic-loss-scale factor overflowed
+    # (ref: adamw.cc skip-on-nonfinite rescale_grad)
+    ok = jnp.isfinite(rescale_grad_t).all()
+    return (jnp.where(ok, w, weight), jnp.where(ok, m, mean),
+            jnp.where(ok, v, var))
 
 
 def _multi_sgd_nout(n_inputs, params):
@@ -216,3 +220,106 @@ def _multi_sgd_mom_update(*tensors, lrs=(), wds=(), momentum=0.0,
         outs.append(nw)
         moms.append(nm)
     return tuple(outs) + tuple(moms)
+
+
+def _multi_mp_sgd_nout(n_inputs, params):
+    return 2 * int(params.get("num_weights", n_inputs // 3))
+
+
+@register("multi_mp_sgd_update", num_outputs=_multi_mp_sgd_nout,
+          variadic=True)
+def _multi_mp_sgd_update(*tensors, lrs=(), wds=(), rescale_grad=1.0,
+                         clip_gradient=-1.0, num_weights=1):
+    """Multi-tensor multi-precision SGD (ref: optimizer_op.cc
+    multi_mp_sgd_update): (weight, grad, weight32) triplets."""
+    ws, w32s = [], []
+    for i in range(num_weights):
+        w, g, w32 = tensors[3 * i], tensors[3 * i + 1], tensors[3 * i + 2]
+        nw, nw32 = _mp_sgd_update(w, g, w32, lr=lrs[i], wd=wds[i],
+                                  rescale_grad=rescale_grad,
+                                  clip_gradient=clip_gradient)
+        ws.append(nw)
+        w32s.append(nw32)
+    return tuple(ws) + tuple(w32s)
+
+
+def _multi_mp_sgd_mom_nout(n_inputs, params):
+    return 3 * int(params.get("num_weights", n_inputs // 4))
+
+
+@register("multi_mp_sgd_mom_update", num_outputs=_multi_mp_sgd_mom_nout,
+          variadic=True)
+def _multi_mp_sgd_mom_update(*tensors, lrs=(), wds=(), momentum=0.0,
+                             rescale_grad=1.0, clip_gradient=-1.0,
+                             num_weights=1):
+    """Multi-tensor multi-precision SGD w/ momentum (ref: optimizer_op.cc
+    multi_mp_sgd_mom_update): (weight, grad, mom, weight32) quadruplets."""
+    ws, moms, w32s = [], [], []
+    for i in range(num_weights):
+        w, g, m, w32 = tensors[4 * i:4 * i + 4]
+        nw, nm, nw32 = _mp_sgd_mom_update(
+            w, g, m, w32, lr=lrs[i], momentum=momentum, wd=wds[i],
+            rescale_grad=rescale_grad, clip_gradient=clip_gradient)
+        ws.append(nw)
+        moms.append(nm)
+        w32s.append(nw32)
+    return tuple(ws) + tuple(moms) + tuple(w32s)
+
+
+@register("_contrib_group_adagrad_update",
+          aliases=("group_adagrad_update",), num_outputs=2)
+def _group_adagrad_update(weight, grad, history, lr=0.01, rescale_grad=1.0,
+                          clip_gradient=-1.0, epsilon=1e-5):
+    """Group AdaGrad: one shared accumulator per row
+    (ref: src/operator/contrib/optimizer_op-inl.h GroupAdagradDnsRspKernel
+    — history[row] += mean(g[row]^2); w -= lr*g/sqrt(history+eps))."""
+    jnp = _jnp()
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    row_axes = tuple(range(1, g.ndim))
+    new_hist = history + jnp.mean(jnp.square(g), axis=row_axes)
+    denom = jnp.sqrt(new_hist + epsilon).reshape((-1,) + (1,) * len(row_axes))
+    return weight - lr * g / denom, new_hist
+
+
+@register("_sparse_adagrad_update", aliases=("sparse_adagrad_update",),
+          num_outputs=2)
+def _sparse_adagrad_update(weight, grad, history, lr=0.01, rescale_grad=1.0,
+                           clip_gradient=-1.0, epsilon=1e-7, wd=0.0):
+    """AdaGrad update (ref: optimizer_op-inl.h AdagradDnsRspDnsKernel:1994
+    — history += g^2; w -= lr*g/sqrt(history+eps)). The reference kernel is
+    row_sparse-gradient-only; rows with zero gradient are untouched here
+    too since their g^2 contribution is zero."""
+    jnp = _jnp()
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    if wd:
+        g = g + wd * weight
+    new_hist = history + jnp.square(g)
+    return weight - lr * g / jnp.sqrt(new_hist + epsilon), new_hist
+
+
+@register("_mp_adamw_update", aliases=("mp_adamw_update",), num_outputs=4)
+def _mp_adamw_update(weight, grad, mean, var, weight32, rescale_grad_t,
+                     lr=0.01, beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
+                     eta=1.0, clip_gradient=-1.0):
+    """Multi-precision AdamW (ref: src/operator/contrib/adamw.cc
+    _mp_adamw_update): fp32 master weights, decoupled weight decay,
+    tensor-valued rescale_grad for dynamic loss scaling."""
+    jnp = _jnp()
+    g = grad.astype(weight32.dtype) * rescale_grad_t
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * jnp.square(g)
+    w32 = weight32 - eta * (lr * m / (jnp.sqrt(v) + epsilon)
+                            + wd * weight32)
+    # dynamic loss scaling: a non-finite rescale_grad means the scaled
+    # loss overflowed — skip the whole update so training recovers
+    # (ref: adamw.cc MPUpdateInferShape/adamw skip-on-nonfinite)
+    ok = jnp.isfinite(rescale_grad_t).all()
+    return (jnp.where(ok, w32.astype(weight.dtype), weight),
+            jnp.where(ok, m, mean), jnp.where(ok, v, var),
+            jnp.where(ok, w32, weight32))

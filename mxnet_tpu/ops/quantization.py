@@ -163,3 +163,51 @@ def _quantized_pooling(data, data_min, data_max, kernel=(), pool_type="max",
           num_outputs=3, differentiable=False)
 def _quantized_flatten(data, data_min, data_max):
     return data.reshape(data.shape[0], -1), data_min, data_max
+
+
+@register("_contrib_quantize", aliases=("quantize",), num_outputs=3,
+          differentiable=False)
+def _quantize_v1(data, min_range, max_range, out_type="uint8"):
+    """v1 quantize with explicit (min_range, max_range) tensor inputs
+    (ref: src/operator/quantization/quantize-inl.h quantize_unsigned /
+    quantize_zero_centered)."""
+    jnp = _jnp()
+    mn = min_range.reshape(())
+    mx = max_range.reshape(())
+    if out_type == "uint8":
+        scale = 255.0 / jnp.maximum(mx - mn, 1e-8)
+        q = jnp.floor((data - mn) * scale + 0.5).astype(jnp.uint8)
+        return q, mn, mx
+    real = jnp.maximum(jnp.maximum(jnp.abs(mn), jnp.abs(mx)), 1e-8)
+    scale = 127.0 / real
+    q = (jnp.sign(data) *
+         jnp.minimum(jnp.abs(data) * scale + 0.5, 127.0)).astype(jnp.int8)
+    return q, -real, real
+
+
+@register("_contrib_quantized_concat", aliases=("quantized_concat",),
+          num_outputs=3, variadic=True, differentiable=False)
+def _quantized_concat(*args, dim=1, num_args=1):
+    """Concat int8 inputs, rescaling each to the merged calibration range
+    (ref: src/operator/quantization/quantized_concat.cc — inputs are
+    num_args data followed by num_args mins and num_args maxs)."""
+    jnp = _jnp()
+    n = int(num_args)
+    datas = args[:n]
+    mins = [m.reshape(()) for m in args[n:2 * n]]
+    maxs = [m.reshape(()) for m in args[2 * n:3 * n]]
+    out_min = mins[0]
+    out_max = maxs[0]
+    for m in mins[1:]:
+        out_min = jnp.minimum(out_min, m)
+    for m in maxs[1:]:
+        out_max = jnp.maximum(out_max, m)
+    out_abs = jnp.maximum(jnp.abs(out_min), jnp.abs(out_max))
+    out_scale = 127.0 / jnp.maximum(out_abs, 1e-8)
+    rescaled = []
+    for q, mn, mx in zip(datas, mins, maxs):
+        in_abs = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+        f = q.astype(jnp.float32) * (in_abs / 127.0)
+        rescaled.append(jnp.clip(jnp.round(f * out_scale), -127, 127)
+                        .astype(jnp.int8))
+    return jnp.concatenate(rescaled, axis=int(dim)), -out_abs, out_abs

@@ -1,0 +1,415 @@
+"""Region-proposal / region-CNN operators.
+
+Reference: src/operator/contrib/proposal.cc (+ proposal-inl.h anchor
+generation), multi_proposal.cc, psroi_pooling.cc,
+deformable_psroi_pooling.cu (the reference's CPU path is unimplemented —
+deformable_psroi_pooling.cc:54 "NOT_IMPLEMENTED"), and
+bounding_box-inl.h:643 (bipartite matching).
+
+TPU-native design notes:
+- Everything is static-shape: NMS is a masked `lax.scan` over the sorted
+  candidate list (no dynamic compaction), and the post-NMS output is
+  filled by scatter-by-rank with the reference's cyclic padding
+  (proposal.cc:404-419 fills slot i from keep[i % out_size]).
+- PSROIPooling uses a summed-area table (2-D cumsum) so each bin's
+  average is 4 gathers instead of a dynamic-extent loop — the classic
+  TPU-friendly formulation of rectangle sums.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _generate_anchors(stride, ratios, scales):
+    """(ref: proposal-inl.h:184-223 GenerateAnchors/_Transform) ->
+    (A, 4) numpy array, A = len(ratios) * len(scales)."""
+    base_w = base_h = float(stride)
+    x_ctr = 0.5 * (base_w - 1.0)
+    y_ctr = 0.5 * (base_h - 1.0)
+    size = base_w * base_h
+    out = []
+    for ratio in ratios:
+        size_ratios = _np.floor(size / ratio)
+        for scale in scales:
+            new_w = _np.floor(_np.sqrt(size_ratios) + 0.5) * scale
+            new_h = _np.floor(new_w / scale * ratio + 0.5) * scale
+            out.append([x_ctr - 0.5 * (new_w - 1.0),
+                        y_ctr - 0.5 * (new_h - 1.0),
+                        x_ctr + 0.5 * (new_w - 1.0),
+                        y_ctr + 0.5 * (new_h - 1.0)])
+    return _np.asarray(out, _np.float32)
+
+
+def _proposal_single(scores_fg, deltas, im_info, anchors, feature_stride,
+                     pre_nms_top_n, post_nms_top_n, threshold, min_size,
+                     iou_loss):
+    """One image. scores_fg: (A, H, W) foreground scores; deltas:
+    (4A, H, W); im_info: (3,) [height, width, scale]. Returns
+    (rois (post, 4), roi_scores (post,))."""
+    import jax
+    jnp = _jnp()
+    A = anchors.shape[0]
+    H, W = scores_fg.shape[1], scores_fg.shape[2]
+    K = H * W * A
+
+    # shifted anchors in (h, w, a) order (ref: proposal.cc:347-359)
+    shift_x = jnp.arange(W, dtype=jnp.float32) * feature_stride
+    shift_y = jnp.arange(H, dtype=jnp.float32) * feature_stride
+    shifts = jnp.stack(
+        jnp.broadcast_arrays(shift_x[None, :, None], shift_y[:, None, None]),
+        axis=-1)  # (H, W, 1, 2) -> [x, y]
+    boxes = jnp.asarray(anchors)[None, None, :, :] + jnp.concatenate(
+        [shifts, shifts], axis=-1)  # (H, W, A, 4)
+    boxes = boxes.reshape(K, 4)
+    scores = jnp.transpose(scores_fg, (1, 2, 0)).reshape(K)
+    # deltas (4A, H, W) -> (H, W, A, 4)
+    d = jnp.transpose(deltas.reshape(A, 4, H, W), (2, 3, 0, 1)).reshape(K, 4)
+
+    im_h, im_w, im_scale = im_info[0], im_info[1], im_info[2]
+    if iou_loss:
+        # (ref: proposal.cc IoUTransformInv) corner offsets
+        pred = boxes + d
+    else:
+        # (ref: proposal.cc:49-88 BBoxTransformInv)
+        w = boxes[:, 2] - boxes[:, 0] + 1.0
+        h = boxes[:, 3] - boxes[:, 1] + 1.0
+        cx = boxes[:, 0] + 0.5 * (w - 1.0)
+        cy = boxes[:, 1] + 0.5 * (h - 1.0)
+        pcx = d[:, 0] * w + cx
+        pcy = d[:, 1] * h + cy
+        pw = jnp.exp(d[:, 2]) * w
+        ph = jnp.exp(d[:, 3]) * h
+        pred = jnp.stack([pcx - 0.5 * (pw - 1.0), pcy - 0.5 * (ph - 1.0),
+                          pcx + 0.5 * (pw - 1.0), pcy + 0.5 * (ph - 1.0)],
+                         axis=1)
+    lo = jnp.zeros((), jnp.float32)
+    pred = jnp.stack([jnp.clip(pred[:, 0], lo, im_w - 1.0),
+                      jnp.clip(pred[:, 1], lo, im_h - 1.0),
+                      jnp.clip(pred[:, 2], lo, im_w - 1.0),
+                      jnp.clip(pred[:, 3], lo, im_h - 1.0)], axis=1)
+
+    # mask anchors beyond the real (unpadded) feature extent
+    # (ref: proposal.cc:362-365,83-85)
+    real_h = jnp.floor(im_h / feature_stride)
+    real_w = jnp.floor(im_w / feature_stride)
+    hh = jnp.repeat(jnp.arange(H), W * A)
+    ww = jnp.tile(jnp.repeat(jnp.arange(W), A), H)
+    pad_mask = (hh >= real_h) | (ww >= real_w)
+    scores = jnp.where(pad_mask, -1.0, scores)
+
+    # FilterBox (ref: proposal.cc:145-157)
+    msz = min_size * im_scale
+    iw = pred[:, 2] - pred[:, 0] + 1.0
+    ih = pred[:, 3] - pred[:, 1] + 1.0
+    small = (iw < msz) | (ih < msz)
+    pred = jnp.where(small[:, None],
+                     pred + jnp.array([-0.5, -0.5, 0.5, 0.5]) * msz, pred)
+    scores = jnp.where(small, -1.0, scores)
+
+    # pre-NMS topk by score
+    pre_n = min(pre_nms_top_n, K) if pre_nms_top_n > 0 else K
+    order = jnp.argsort(-scores)[:pre_n]
+    sboxes = pred[order]
+    sscores = scores[order]
+
+    # greedy NMS over the sorted list (masked scan; ref NonMaximumSuppression
+    # proposal.cc:212-268 with +1 area convention)
+    x1, y1, x2, y2 = sboxes[:, 0], sboxes[:, 1], sboxes[:, 2], sboxes[:, 3]
+    area = (x2 - x1 + 1.0) * (y2 - y1 + 1.0)
+    xx1 = jnp.maximum(x1[:, None], x1[None, :])
+    yy1 = jnp.maximum(y1[:, None], y1[None, :])
+    xx2 = jnp.minimum(x2[:, None], x2[None, :])
+    yy2 = jnp.minimum(y2[:, None], y2[None, :])
+    inter = jnp.maximum(xx2 - xx1 + 1.0, 0.0) * \
+        jnp.maximum(yy2 - yy1 + 1.0, 0.0)
+    iou = inter / (area[:, None] + area[None, :] - inter)
+
+    def body(keep, i):
+        sup = (iou[i] > threshold) & (jnp.arange(pre_n) > i) & keep[i]
+        return jnp.where(sup, False, keep), None
+
+    keep0 = jnp.ones((pre_n,), bool)
+    keep, _ = jax.lax.scan(body, keep0, jnp.arange(pre_n))
+
+    # take first post_n kept, cyclically padding when fewer
+    # (ref: proposal.cc:404-419)
+    rank = jnp.cumsum(keep.astype(jnp.int32)) - 1
+    out_size = jnp.maximum(keep.sum(), 1)
+    slots = jnp.zeros((post_nms_top_n,), jnp.int32)
+    slots = slots.at[jnp.where(keep, rank, post_nms_top_n)].set(
+        jnp.arange(pre_n, dtype=jnp.int32), mode="drop")
+    pick = slots[jnp.mod(jnp.arange(post_nms_top_n), out_size)]
+    return sboxes[pick], sscores[pick]
+
+
+def _proposal_nout(n_inputs, params):
+    return 2 if params.get("output_score", False) else 1
+
+
+def _proposal_params(params):
+    return dict(
+        feature_stride=int(params.get("feature_stride", 16)),
+        scales=tuple(params.get("scales", (4, 8, 16, 32))),
+        ratios=tuple(params.get("ratios", (0.5, 1, 2))),
+        pre=int(params.get("rpn_pre_nms_top_n", 6000)),
+        post=int(params.get("rpn_post_nms_top_n", 300)),
+        threshold=float(params.get("threshold", 0.7)),
+        min_size=float(params.get("rpn_min_size", 16)),
+        iou_loss=bool(params.get("iou_loss", False)))
+
+
+@register("_contrib_Proposal", aliases=("Proposal",),
+          num_outputs=_proposal_nout, differentiable=False)
+def _proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+              rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+              scales=(4, 8, 16, 32), ratios=(0.5, 1, 2), feature_stride=16,
+              output_score=False, iou_loss=False):
+    """RPN proposal generation, batch 1 (ref: proposal.cc _contrib_Proposal).
+    cls_prob (1, 2A, H, W), bbox_pred (1, 4A, H, W), im_info (1, 3) ->
+    rois (post, 5) [batch0, x1, y1, x2, y2] (+ scores (post, 1))."""
+    jnp = _jnp()
+    anchors = _generate_anchors(feature_stride, ratios, scales)
+    A = anchors.shape[0]
+    boxes, scores = _proposal_single(
+        cls_prob[0, A:], bbox_pred[0], im_info[0], anchors, feature_stride,
+        int(rpn_pre_nms_top_n), int(rpn_post_nms_top_n), threshold,
+        float(rpn_min_size), iou_loss)
+    rois = jnp.concatenate(
+        [jnp.zeros((boxes.shape[0], 1), boxes.dtype), boxes], axis=1)
+    if output_score:
+        return rois, scores[:, None]
+    return rois
+
+
+@register("_contrib_MultiProposal", aliases=("MultiProposal",),
+          num_outputs=_proposal_nout, differentiable=False)
+def _multi_proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+                    rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+                    scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
+                    feature_stride=16, output_score=False, iou_loss=False):
+    """Batched Proposal (ref: multi_proposal.cc): rois (N*post, 5) with
+    per-image batch indices."""
+    import jax
+    jnp = _jnp()
+    anchors = _generate_anchors(feature_stride, ratios, scales)
+    A = anchors.shape[0]
+    N = cls_prob.shape[0]
+    post = int(rpn_post_nms_top_n)
+
+    def one(sc, dl, info):
+        return _proposal_single(sc, dl, info, anchors, feature_stride,
+                                int(rpn_pre_nms_top_n), post, threshold,
+                                float(rpn_min_size), iou_loss)
+
+    boxes, scores = jax.vmap(one)(cls_prob[:, A:], bbox_pred, im_info)
+    bidx = jnp.repeat(jnp.arange(N, dtype=boxes.dtype), post)[:, None]
+    rois = jnp.concatenate([bidx, boxes.reshape(N * post, 4)], axis=1)
+    if output_score:
+        return rois, scores.reshape(N * post, 1)
+    return rois
+
+
+def _integral_image(data):
+    """(N, C, H, W) -> (N, C, H+1, W+1) summed-area table."""
+    jnp = _jnp()
+    s = jnp.cumsum(jnp.cumsum(data, axis=-1), axis=-2)
+    return jnp.pad(s, ((0, 0), (0, 0), (1, 0), (1, 0)))
+
+
+@register("_contrib_PSROIPooling", aliases=("PSROIPooling",),
+          differentiable=False)
+def _psroi_pooling(data, rois, spatial_scale=1.0, output_dim=1,
+                   pooled_size=1, group_size=0):
+    """Position-sensitive ROI average pooling (ref: psroi_pooling.cc
+    PSROIPoolForwardCPU). data (N, output_dim*group^2, H, W),
+    rois (R, 5) -> (R, output_dim, pooled, pooled)."""
+    import jax
+    jnp = _jnp()
+    pooled = int(pooled_size)
+    group = int(group_size) if int(group_size) > 0 else pooled
+    D = int(output_dim)
+    H, W = data.shape[2], data.shape[3]
+    sat = _integral_image(data)  # (N, C, H+1, W+1)
+
+    # static channel index per (ctop, ph, pw) (ref: psroi_pooling.cc:94-98)
+    phs = _np.arange(pooled)
+    gh = _np.clip((phs * group) // pooled, 0, group - 1)
+    c_idx = (_np.arange(D)[:, None, None] * group + gh[None, :, None]) \
+        * group + gh[None, None, :]  # (D, pooled, pooled)
+    c_idx = jnp.asarray(c_idx)
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1]) * spatial_scale
+        y1 = jnp.round(roi[2]) * spatial_scale
+        x2 = (jnp.round(roi[3]) + 1.0) * spatial_scale
+        y2 = (jnp.round(roi[4]) + 1.0) * spatial_scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_h = rh / pooled
+        bin_w = rw / pooled
+        ph = jnp.arange(pooled, dtype=jnp.float32)
+        hstart = jnp.clip(jnp.floor(ph * bin_h + y1), 0, H).astype(jnp.int32)
+        hend = jnp.clip(jnp.ceil((ph + 1.0) * bin_h + y1), 0, H) \
+            .astype(jnp.int32)
+        wstart = jnp.clip(jnp.floor(ph * bin_w + x1), 0, W).astype(jnp.int32)
+        wend = jnp.clip(jnp.ceil((ph + 1.0) * bin_w + x1), 0, W) \
+            .astype(jnp.int32)
+        s = sat[b]  # (C, H+1, W+1)
+        c = c_idx  # (D, p, p)
+        hs = hstart[None, :, None]
+        he = hend[None, :, None]
+        ws = wstart[None, None, :]
+        we = wend[None, None, :]
+        rect = s[c, he, we] - s[c, hs, we] - s[c, he, ws] + s[c, hs, ws]
+        bin_area = (hend[:, None] - hstart[:, None]) * (wend - wstart)[None]
+        empty = bin_area <= 0
+        return jnp.where(empty[None], 0.0,
+                         rect / jnp.maximum(bin_area, 1)[None])
+
+    return jax.vmap(one_roi)(rois)
+
+
+@register("_contrib_DeformablePSROIPooling",
+          aliases=("DeformablePSROIPooling",), num_outputs=2,
+          differentiable=False)
+def _deformable_psroi_pooling(data, rois, *maybe_trans, spatial_scale=1.0,
+                              output_dim=1, group_size=1, pooled_size=1,
+                              part_size=0, sample_per_part=1, trans_std=0.0,
+                              no_trans=False):
+    """Deformable position-sensitive ROI pooling (ref:
+    deformable_psroi_pooling.cu DeformablePSROIPoolForwardKernel; the
+    reference's CPU forward is unimplemented). Returns (out, top_count)."""
+    import jax
+    jnp = _jnp()
+    pooled = int(pooled_size)
+    group = int(group_size)
+    D = int(output_dim)
+    spp = int(sample_per_part)
+    part = int(part_size) if int(part_size) > 0 else pooled
+    H, W = data.shape[2], data.shape[3]
+    trans = maybe_trans[0] if (maybe_trans and not no_trans) else None
+    if trans is not None:
+        num_classes = trans.shape[1] // 2
+    else:
+        num_classes = 1
+    ch_each = D // num_classes
+
+    phs = _np.arange(pooled)
+    gh = _np.clip((phs * group) // pooled, 0, group - 1)
+    c_idx = (_np.arange(D)[:, None, None] * group + gh[None, :, None]) \
+        * group + gh[None, None, :]
+    c_idx = jnp.asarray(c_idx)  # (D, p, p)
+    part_h = jnp.asarray((phs * part) // pooled)  # (p,)
+    class_id = _np.arange(D) // ch_each  # (D,)
+
+    def bilinear(img, y, x):
+        # img (H, W); y, x scalars already clipped to [0, H-1]/[0, W-1]
+        y0 = jnp.floor(y).astype(jnp.int32)
+        x0 = jnp.floor(x).astype(jnp.int32)
+        y1 = jnp.minimum(y0 + 1, H - 1)
+        x1 = jnp.minimum(x0 + 1, W - 1)
+        ly, lx = y - y0, x - x0
+        return (img[y0, x0] * (1 - ly) * (1 - lx)
+                + img[y0, x1] * (1 - ly) * lx
+                + img[y1, x0] * ly * (1 - lx)
+                + img[y1, x1] * ly * lx)
+
+    def one_roi(roi, tr):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1]) * spatial_scale - 0.5
+        y1 = jnp.round(roi[2]) * spatial_scale - 0.5
+        x2 = (jnp.round(roi[3]) + 1.0) * spatial_scale - 0.5
+        y2 = (jnp.round(roi[4]) + 1.0) * spatial_scale - 0.5
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_h, bin_w = rh / pooled, rw / pooled
+        sub_h, sub_w = bin_h / spp, bin_w / spp
+
+        if tr is None:
+            tx = jnp.zeros((D, pooled, pooled))
+            ty = jnp.zeros((D, pooled, pooled))
+        else:
+            # tr (2*num_classes, part, part)
+            tr2 = tr.reshape(num_classes, 2, part, part)
+            cls = jnp.asarray(class_id)
+            tx = tr2[cls][:, 0][:, part_h][:, :, part_h] * trans_std
+            ty = tr2[cls][:, 1][:, part_h][:, :, part_h] * trans_std
+
+        ph = jnp.arange(pooled, dtype=jnp.float32)
+        wst = ph[None, None, :] * bin_w + x1 + tx * rw  # (D, p, p)
+        hst = ph[None, :, None] * bin_h + y1 + ty * rh
+
+        img_all = data[b]  # (C, H, W)
+
+        def sample(ih, iw):
+            y = hst + ih * sub_h
+            x = wst + iw * sub_w
+            valid = (x >= -0.5) & (x <= W - 0.5) & (y >= -0.5) & (y <= H - 0.5)
+            yc = jnp.clip(y, 0.0, H - 1.0)
+            xc = jnp.clip(x, 0.0, W - 1.0)
+            val = jax.vmap(
+                jax.vmap(jax.vmap(bilinear)))(img_all[c_idx], yc, xc)
+            return jnp.where(valid, val, 0.0), valid
+
+        total = jnp.zeros((D, pooled, pooled))
+        count = jnp.zeros((D, pooled, pooled))
+        for ih in range(spp):
+            for iw in range(spp):
+                v, ok = sample(float(ih), float(iw))
+                total = total + v
+                count = count + ok
+        out = jnp.where(count > 0, total / jnp.maximum(count, 1), 0.0)
+        return out, count
+
+    if trans is None:
+        out, cnt = jax.vmap(lambda r: one_roi(r, None))(rois)
+    else:
+        out, cnt = jax.vmap(one_roi)(rois, trans)
+    return out, cnt
+
+
+@register("_contrib_bipartite_matching", aliases=("bipartite_matching",),
+          num_outputs=2, differentiable=False)
+def _bipartite_matching(score, threshold=0.0, is_ascend=False, topk=-1):
+    """Greedy bipartite matching (ref: bounding_box-inl.h:682
+    bipartite_matching kernel). score (..., R, C) -> (row_match (..., R),
+    col_match (..., C)); unmatched = -1. The reference's topk records one
+    extra match past the limit (count > topk after assignment); here topk
+    is exact."""
+    import jax
+    jnp = _jnp()
+    shape = score.shape
+    R, C = shape[-2], shape[-1]
+    flat = score.reshape((-1, R, C))
+
+    def per_batch(s):
+        order = jnp.argsort(jnp.where(is_ascend, s, -s).reshape(-1))
+        svals = s.reshape(-1)[order]
+        rows = order // C
+        cols = order % C
+
+        def body(carry, j):
+            rmark, cmark, cnt = carry
+            r, c, v = rows[j], cols[j], svals[j]
+            good = jnp.where(is_ascend, v < threshold, v > threshold)
+            free = (rmark[r] == -1) & (cmark[c] == -1)
+            can = good & free & ((topk <= 0) | (cnt < topk))
+            rmark = rmark.at[r].set(jnp.where(can, c, rmark[r]))
+            cmark = cmark.at[c].set(jnp.where(can, r, cmark[c]))
+            return (rmark, cmark, cnt + can.astype(jnp.int32)), None
+
+        init = (-jnp.ones((R,), s.dtype), -jnp.ones((C,), s.dtype),
+                jnp.zeros((), jnp.int32))
+        (rmark, cmark, _), _ = jax.lax.scan(body, init, jnp.arange(R * C))
+        return rmark, cmark
+
+    rm, cm = jax.vmap(per_batch)(flat)
+    return rm.reshape(shape[:-1]), cm.reshape(shape[:-2] + (C,))

@@ -1,3 +1,5 @@
 """Optimizers (ref: python/mxnet/optimizer/__init__.py)."""
 from .optimizer import *  # noqa: F401,F403
 from .optimizer import Optimizer, create, register, get_updater, Updater  # noqa: F401
+from . import contrib  # noqa: F401
+from .contrib import GroupAdaGrad  # noqa: F401

@@ -1,0 +1,44 @@
+"""Contrib optimizers (ref: python/mxnet/optimizer/contrib.py).
+
+GroupAdaGrad keeps ONE accumulator value per output row (useful for
+embedding tables where whole rows get sparse updates), backed by the fused
+``_contrib_group_adagrad_update`` op.
+"""
+from __future__ import annotations
+
+from .. import ndarray as _nd
+from .optimizer import Optimizer, register
+from ..ndarray.ndarray import imperative_invoke as _invoke
+
+__all__ = ["GroupAdaGrad"]
+
+
+def _clip(v):
+    return -1.0 if v is None else v
+
+
+@register
+class GroupAdaGrad(Optimizer):
+    """Adagrad with per-row grouped statistics
+    (ref: python/mxnet/optimizer/contrib.py GroupAdaGrad;
+    src/operator/contrib/optimizer_op.cc _contrib_group_adagrad_update)."""
+
+    def __init__(self, eps=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return _nd.zeros((weight.shape[0],), ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        assert self._get_wd(index) == 0.0, \
+            "GroupAdaGrad does not support weight decay"
+        new_w, new_h = _invoke(
+            "_contrib_group_adagrad_update", (weight, grad, state),
+            dict(lr=lr, rescale_grad=self.rescale_grad,
+                 clip_gradient=_clip(self.clip_gradient),
+                 epsilon=self.float_stable_eps))
+        weight._rebind(new_w._data)
+        state._rebind(new_h._data)

@@ -1,0 +1,183 @@
+"""DGL graph op + quantize v1 tests (ref: tests/python/unittest/test_dgl_graph.py,
+test_operator.py quantization tests)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _full_graph():
+    # the 5-vertex complete graph from dgl_graph.cc:775-780 docs
+    data = np.arange(1, 21, dtype=np.int64)
+    indices = np.array([1, 2, 3, 4, 0, 2, 3, 4, 0, 1, 3, 4,
+                        0, 1, 2, 4, 0, 1, 2, 3], np.int64)
+    indptr = np.array([0, 4, 8, 12, 16, 20], np.int64)
+    return nd.sparse.csr_matrix((data, indices, indptr), shape=(5, 5))
+
+
+def test_edge_id():
+    g = _full_graph()
+    out = nd.contrib.edge_id(g, nd.array(np.array([0, 1, 0])),
+                             nd.array(np.array([1, 0, 0])))
+    # edge 0->1 has id 1, edge 1->0 has id 5, self-loop absent -> -1
+    np.testing.assert_array_equal(out.asnumpy(), [1, 5, -1])
+
+
+def test_dgl_adjacency():
+    g = _full_graph()
+    adj = nd.contrib.dgl_adjacency(g)
+    assert adj.stype == "csr"
+    assert adj.dtype == np.float32
+    np.testing.assert_array_equal(adj.data.asnumpy(), np.ones(20))
+    np.testing.assert_array_equal(adj.indices.asnumpy(),
+                                  g.indices.asnumpy())
+
+
+def test_dgl_subgraph():
+    x = np.array([[1, 0, 0, 2],
+                  [3, 0, 4, 0],
+                  [0, 5, 0, 0],
+                  [0, 6, 7, 0]], np.float32)
+    g = nd.sparse.csr_matrix(x)
+    sub, mapping = nd.contrib.dgl_subgraph(
+        g, nd.array(np.array([0, 1, 2])), return_mapping=True)
+    # example from dgl_graph.cc:1139-1152
+    np.testing.assert_array_equal(sub.todense().asnumpy(),
+                                  [[1, 0, 0], [2, 0, 3], [0, 4, 0]])
+    np.testing.assert_array_equal(mapping.todense().asnumpy(),
+                                  [[1, 0, 0], [3, 0, 4], [0, 5, 0]])
+
+
+def test_neighbor_uniform_sample():
+    np.random.seed(0)
+    g = _full_graph()
+    seed = nd.array(np.array([0, 1, 2, 3, 4], np.int64))
+    verts, sub, layer = nd.contrib.dgl_csr_neighbor_uniform_sample(
+        g, seed, num_args=2, num_hops=1, num_neighbor=2, max_num_vertices=5)
+    v = verts.asnumpy()
+    assert v.shape == (6,)
+    assert v[-1] == 5  # all five vertices sampled (all are seeds)
+    assert sorted(v[:5]) == [0, 1, 2, 3, 4]
+    assert sub.shape == (5, 5)
+    dense = sub.todense().asnumpy()
+    # every row sampled exactly 2 edges, values are parent edge ids
+    assert (dense > 0).sum(axis=1).tolist() == [2] * 5
+    parent = _full_graph().todense().asnumpy()
+    nz = dense > 0
+    np.testing.assert_array_equal(dense[nz], parent[nz])
+    np.testing.assert_array_equal(layer.asnumpy(), np.zeros(5))
+
+
+def test_neighbor_uniform_sample_hops():
+    np.random.seed(1)
+    g = _full_graph()
+    seed = nd.array(np.array([0], np.int64))
+    verts, sub, layer = nd.contrib.dgl_csr_neighbor_uniform_sample(
+        g, seed, num_args=2, num_hops=1, num_neighbor=2, max_num_vertices=4)
+    v = verts.asnumpy()
+    assert v[-1] == 3  # seed + 2 sampled neighbors
+    lay = layer.asnumpy()
+    assert lay[0] == 0 and (lay[1:3] == 1).all() and lay[3] == -1
+
+
+def test_neighbor_non_uniform_sample():
+    np.random.seed(2)
+    g = _full_graph()
+    # probability concentrated on vertices 1 and 2
+    prob = nd.array(np.array([0.0, 0.5, 0.5, 0.0, 0.0], np.float32))
+    seed = nd.array(np.array([0], np.int64))
+    verts, sub, layer = nd.contrib.dgl_csr_neighbor_non_uniform_sample(
+        g, prob, seed, num_args=3, num_hops=1, num_neighbor=2,
+        max_num_vertices=5)
+    v = verts.asnumpy()
+    assert v[-1] == 3
+    assert set(v[1:3].tolist()) == {1, 2}  # zero-prob vertices never drawn
+
+
+def test_graph_compact():
+    np.random.seed(3)
+    g = _full_graph()
+    seed = nd.array(np.array([0, 1, 2, 3, 4], np.int64))
+    verts, sub, _ = nd.contrib.dgl_csr_neighbor_uniform_sample(
+        g, seed, num_args=2, num_hops=1, num_neighbor=2, max_num_vertices=6)
+    size = int(verts.asnumpy()[-1])
+    compact = nd.contrib.dgl_graph_compact(sub, verts, graph_sizes=(size,),
+                                           return_mapping=False)
+    assert compact.shape == (size, size)
+    # same number of edges survive (all vertices kept)
+    assert compact.data.shape[0] == sub.data.shape[0]
+
+
+def test_quantize_v1_uint8_and_int8():
+    x = np.array([[0.0, 0.5], [1.0, 0.25]], np.float32)
+    q, mn, mx = nd.contrib.quantize(nd.array(x), nd.array(np.array([0.0])),
+                                    nd.array(np.array([1.0])),
+                                    out_type="uint8")
+    assert q.dtype == np.uint8
+    # reference: static_cast<uint8>((x - min) * scale + 0.5)
+    np.testing.assert_array_equal(q.asnumpy(), [[0, 128], [255, 64]])
+    assert float(mn.asnumpy()) == 0.0 and float(mx.asnumpy()) == 1.0
+    x2 = np.array([-1.0, 0.0, 1.0], np.float32)
+    q2, mn2, mx2 = nd.contrib.quantize(nd.array(x2),
+                                       nd.array(np.array([-1.0])),
+                                       nd.array(np.array([1.0])),
+                                       out_type="int8")
+    assert q2.dtype == np.int8
+    np.testing.assert_array_equal(q2.asnumpy(), [-127, 0, 127])
+    assert float(mn2.asnumpy()) == -1.0 and float(mx2.asnumpy()) == 1.0
+
+
+def test_quantized_concat():
+    a = np.array([[100, -100]], np.int8)   # range ±1 -> values ±0.787
+    b = np.array([[50, -50]], np.int8)     # range ±2 -> values ±0.787
+    out, omin, omax = nd.contrib.quantized_concat(
+        nd.array(a), nd.array(b),
+        nd.array(np.array([-1.0])), nd.array(np.array([-2.0])),
+        nd.array(np.array([1.0])), nd.array(np.array([2.0])),
+        dim=1, num_args=2)
+    assert out.dtype == np.int8
+    assert float(omax.asnumpy()) == 2.0
+    o = out.asnumpy()[0]
+    # a rescaled from range 1 to range 2 (halved), b unchanged
+    np.testing.assert_array_equal(o, [50, -50, 50, -50])
+
+
+def test_non_uniform_sample_fewer_nonzero_than_k():
+    np.random.seed(5)
+    g = _full_graph()
+    # only one neighbor of vertex 0 has nonzero probability but k=3
+    prob = nd.array(np.array([0.0, 1.0, 0.0, 0.0, 0.0], np.float32))
+    verts, sub, layer = nd.contrib.dgl_csr_neighbor_non_uniform_sample(
+        g, prob, nd.array(np.array([0], np.int64)), num_args=3, num_hops=1,
+        num_neighbor=3, max_num_vertices=5)
+    v = verts.asnumpy()
+    assert v[-1] == 2 and v[1] == 1  # seed + single viable neighbor
+
+
+def test_graph_compact_mapping_ids():
+    np.random.seed(6)
+    g = _full_graph()
+    seed = nd.array(np.array([0, 1, 2, 3, 4], np.int64))
+    verts, sub, _ = nd.contrib.dgl_csr_neighbor_uniform_sample(
+        g, seed, num_args=2, num_hops=1, num_neighbor=2, max_num_vertices=6)
+    size = int(verts.asnumpy()[-1])
+    compact, mapping = nd.contrib.dgl_graph_compact(
+        sub, verts, graph_sizes=(size,), return_mapping=True)
+    # graph carries fresh 1..E ids row-major; mapping carries parent ids
+    e = compact.data.shape[0]
+    np.testing.assert_array_equal(compact.data.asnumpy(),
+                                  np.arange(1, e + 1))
+    parent_vals = set(_full_graph().data.asnumpy().tolist())
+    assert set(mapping.data.asnumpy().astype(int).tolist()) <= parent_vals
+
+
+def test_quantize_v1_degenerate_range():
+    q, mn, mx = nd.contrib.quantize(
+        nd.array(np.zeros((2, 2), np.float32)),
+        nd.array(np.array([0.0])), nd.array(np.array([0.0])),
+        out_type="uint8")
+    assert np.isfinite(q.asnumpy().astype(np.float64)).all()
+    q2, _, _ = nd.contrib.quantize(
+        nd.array(np.zeros(3, np.float32)), nd.array(np.array([0.0])),
+        nd.array(np.array([0.0])), out_type="int8")
+    np.testing.assert_array_equal(q2.asnumpy(), np.zeros(3))

@@ -141,3 +141,124 @@ def test_optimizers_reduce_quadratic_loss():
             tr.step(4)
             losses.append(float(loss.asscalar()))
         assert losses[-1] < losses[0], (name, losses)
+
+
+def test_group_adagrad_op_and_optimizer():
+    # oracle: history[row] += mean(g[row]^2); w -= lr*g/sqrt(hist+eps)
+    rs = np.random.RandomState(3)
+    w = rs.randn(4, 3).astype(np.float32)
+    g = rs.randn(4, 3).astype(np.float32)
+    lr, eps = 0.05, 1e-5
+    hist = np.zeros(4, np.float32)
+    ref_hist = hist + (g ** 2).mean(axis=1)
+    ref_w = w - lr * g / np.sqrt(ref_hist + eps)[:, None]
+    nw, nh = nd.contrib.group_adagrad_update(
+        nd.array(w), nd.array(g), nd.array(hist), lr=lr, epsilon=eps)
+    assert_almost_equal(nw.asnumpy(), ref_w, rtol=1e-5)
+    assert_almost_equal(nh.asnumpy(), ref_hist, rtol=1e-5)
+    # two optimizer steps track the oracle
+    opt = mx.optimizer.create("groupadagrad", learning_rate=lr, eps=eps,
+                              wd=0.0)
+    weight = nd.array(w)
+    state = opt.create_state(0, weight)
+    rw, rh = w.copy(), np.zeros(4, np.float32)
+    for i in range(2):
+        gi = rs.randn(4, 3).astype(np.float32)
+        opt.update(0, weight, nd.array(gi), state)
+        rh += (gi ** 2).mean(axis=1)
+        rw -= lr * gi / np.sqrt(rh + eps)[:, None]
+    assert_almost_equal(weight.asnumpy(), rw, rtol=1e-5)
+
+
+def test_sparse_adagrad_update_op():
+    rs = np.random.RandomState(4)
+    w = rs.randn(5, 2).astype(np.float32)
+    g = rs.randn(5, 2).astype(np.float32)
+    h = np.abs(rs.randn(5, 2)).astype(np.float32)
+    lr, eps = 0.1, 1e-7
+    ref_h = h + g ** 2
+    ref_w = w - lr * g / np.sqrt(ref_h + eps)
+    nw, nh = nd.sparse_adagrad_update(nd.array(w), nd.array(g), nd.array(h),
+                                      lr=lr, epsilon=eps)
+    assert_almost_equal(nw.asnumpy(), ref_w, rtol=1e-5)
+    assert_almost_equal(nh.asnumpy(), ref_h, rtol=1e-5)
+
+
+def test_multi_mp_sgd_updates():
+    rs = np.random.RandomState(5)
+    ws = [rs.randn(3).astype(np.float16) for _ in range(2)]
+    gs = [rs.randn(3).astype(np.float16) for _ in range(2)]
+    w32s = [w.astype(np.float32) for w in ws]
+    lrs, wds = (0.1, 0.2), (0.0, 0.01)
+    tensors = []
+    for w, g, w32 in zip(ws, gs, w32s):
+        tensors += [nd.array(w), nd.array(g), nd.array(w32)]
+    outs = nd.multi_mp_sgd_update(*tensors, lrs=lrs, wds=wds, num_weights=2)
+    assert len(outs) == 4
+    for i in range(2):
+        ref32 = w32s[i] - lrs[i] * (gs[i].astype(np.float32)
+                                    + wds[i] * w32s[i])
+        assert outs[i].dtype == np.float16
+        assert_almost_equal(outs[2 + i].asnumpy(), ref32, rtol=1e-5)
+        assert_almost_equal(outs[i].asnumpy(), ref32.astype(np.float16),
+                            rtol=1e-2)
+    # momentum variant shapes/count
+    tensors = []
+    moms = [np.zeros(3, np.float32) for _ in range(2)]
+    for w, g, m, w32 in zip(ws, gs, moms, w32s):
+        tensors += [nd.array(w), nd.array(g), nd.array(m), nd.array(w32)]
+    outs = nd.multi_mp_sgd_mom_update(*tensors, lrs=lrs, wds=wds,
+                                      momentum=0.9, num_weights=2)
+    assert len(outs) == 6
+
+
+def test_mp_adamw_update_op():
+    rs = np.random.RandomState(6)
+    w = rs.randn(4).astype(np.float16)
+    w32 = w.astype(np.float32)
+    g = rs.randn(4).astype(np.float16)
+    m = np.zeros(4, np.float32)
+    v = np.zeros(4, np.float32)
+    lr, b1, b2, eps, wd, eta = 0.01, 0.9, 0.999, 1e-8, 0.1, 1.0
+    gf = g.astype(np.float32) * 1.0
+    rm = b1 * m + (1 - b1) * gf
+    rv = b2 * v + (1 - b2) * gf ** 2
+    rw32 = w32 - eta * (lr * rm / (np.sqrt(rv) + eps) + wd * w32)
+    outs = nd.mp_adamw_update(
+        nd.array(w), nd.array(g), nd.array(m), nd.array(v), nd.array(w32),
+        nd.array(np.array(1.0, np.float32)), lr=lr, beta1=b1, beta2=b2,
+        epsilon=eps, wd=wd, eta=eta)
+    nw, nm, nv, nw32 = outs
+    assert nw.dtype == np.float16
+    assert_almost_equal(nw32.asnumpy(), rw32, rtol=1e-5)
+    assert_almost_equal(nm.asnumpy(), rm, rtol=1e-5)
+    assert_almost_equal(nv.asnumpy(), rv, rtol=1e-5)
+
+
+def test_adamw_skips_update_on_overflowed_scale():
+    w = np.ones(3, np.float32)
+    m = np.zeros(3, np.float32)
+    v = np.zeros(3, np.float32)
+    g = np.ones(3, np.float32)
+    nw, nm, nv = nd.adamw_update(
+        nd.array(w), nd.array(g), nd.array(m), nd.array(v),
+        nd.array(np.array(np.inf, np.float32)), lr=0.1)
+    np.testing.assert_array_equal(nw.asnumpy(), w)
+    np.testing.assert_array_equal(nm.asnumpy(), m)
+    np.testing.assert_array_equal(nv.asnumpy(), v)
+    outs = nd.mp_adamw_update(
+        nd.array(w.astype(np.float16)), nd.array(g.astype(np.float16)),
+        nd.array(m), nd.array(v), nd.array(w),
+        nd.array(np.array(np.nan, np.float32)), lr=0.1)
+    np.testing.assert_array_equal(outs[3].asnumpy(), w)
+
+
+def test_sparse_adagrad_wd_applied():
+    w = np.ones(4, np.float32)
+    g = np.zeros(4, np.float32)
+    h = np.zeros(4, np.float32)
+    nw, nh = nd.sparse_adagrad_update(nd.array(w), nd.array(g), nd.array(h),
+                                      lr=0.1, wd=0.5, epsilon=1e-7)
+    # effective grad = wd*w = 0.5 -> hist 0.25, w -= 0.1*0.5/sqrt(0.25)
+    np.testing.assert_allclose(nh.asnumpy(), 0.25 * np.ones(4), rtol=1e-6)
+    np.testing.assert_allclose(nw.asnumpy(), w - 0.1, rtol=1e-5)

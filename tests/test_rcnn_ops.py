@@ -1,0 +1,188 @@
+"""Proposal / PSROIPooling / bipartite-matching op tests
+(ref: tests/python/unittest/test_operator.py test_psroipooling et al.,
+tests/python/gpu/test_operator_gpu.py test_proposal)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _rpn_inputs(N=1, A=4, H=4, W=4, seed=0):
+    rs = np.random.RandomState(seed)
+    cls = rs.rand(N, 2 * A, H, W).astype(np.float32)
+    bbox = (rs.randn(N, 4 * A, H, W) * 0.1).astype(np.float32)
+    info = np.tile(np.array([[64.0, 64.0, 1.0]], np.float32), (N, 1))
+    return cls, bbox, info
+
+
+def test_proposal_shapes_and_validity():
+    cls, bbox, info = _rpn_inputs()
+    rois = mx.nd.contrib.Proposal(
+        mx.nd.array(cls), mx.nd.array(bbox), mx.nd.array(info),
+        rpn_pre_nms_top_n=30, rpn_post_nms_top_n=8, threshold=0.7,
+        rpn_min_size=4, scales=(2, 4), ratios=(0.5, 1.0), feature_stride=16)
+    r = rois.asnumpy()
+    assert r.shape == (8, 5)
+    assert (r[:, 0] == 0).all()  # batch index
+    # boxes clipped to the image
+    assert (r[:, 1:3] >= 0).all() and (r[:, 3] <= 63).all() \
+        and (r[:, 4] <= 63).all()
+    assert (r[:, 3] >= r[:, 1]).all() and (r[:, 4] >= r[:, 2]).all()
+
+
+def test_proposal_output_score_and_nms():
+    cls, bbox, info = _rpn_inputs(seed=1)
+    rois, scores = mx.nd.contrib.Proposal(
+        mx.nd.array(cls), mx.nd.array(bbox), mx.nd.array(info),
+        rpn_pre_nms_top_n=48, rpn_post_nms_top_n=6, threshold=0.5,
+        rpn_min_size=2, scales=(2, 4), ratios=(0.5, 1.0),
+        feature_stride=16, output_score=True)
+    s = scores.asnumpy().reshape(-1)
+    # scores sorted descending (kept in score order)
+    assert (np.diff(s) <= 1e-6).all()
+    # surviving boxes pairwise IoU below threshold
+    r = rois.asnumpy()[:, 1:]
+    uniq = np.unique(r, axis=0)
+    for i in range(len(uniq)):
+        for j in range(i + 1, len(uniq)):
+            a, b = uniq[i], uniq[j]
+            ax1, ay1, ax2, ay2 = a
+            bx1, by1, bx2, by2 = b
+            iw = min(ax2, bx2) - max(ax1, bx1) + 1
+            ih = min(ay2, by2) - max(ay1, by1) + 1
+            if iw > 0 and ih > 0:
+                inter = iw * ih
+                ua = (ax2 - ax1 + 1) * (ay2 - ay1 + 1) + \
+                    (bx2 - bx1 + 1) * (by2 - by1 + 1) - inter
+                assert inter / ua <= 0.5 + 1e-5
+
+
+def test_multi_proposal_batch_indices():
+    cls, bbox, info = _rpn_inputs(N=2, seed=2)
+    rois = mx.nd.contrib.MultiProposal(
+        mx.nd.array(cls), mx.nd.array(bbox), mx.nd.array(info),
+        rpn_pre_nms_top_n=30, rpn_post_nms_top_n=5, threshold=0.7,
+        rpn_min_size=4, scales=(2, 4), ratios=(0.5, 1.0), feature_stride=16)
+    r = rois.asnumpy()
+    assert r.shape == (10, 5)
+    np.testing.assert_array_equal(r[:5, 0], 0)
+    np.testing.assert_array_equal(r[5:, 0], 1)
+
+
+def _psroi_ref(data, rois, spatial_scale, output_dim, pooled, group):
+    """Direct numpy port of psroi_pooling.cc PSROIPoolForwardCPU."""
+    R = rois.shape[0]
+    _, C, H, W = data.shape
+    out = np.zeros((R, output_dim, pooled, pooled), np.float32)
+    for n in range(R):
+        b = int(rois[n, 0])
+        x1 = round(rois[n, 1]) * spatial_scale
+        y1 = round(rois[n, 2]) * spatial_scale
+        x2 = (round(rois[n, 3]) + 1.0) * spatial_scale
+        y2 = (round(rois[n, 4]) + 1.0) * spatial_scale
+        rw = max(x2 - x1, 0.1)
+        rh = max(y2 - y1, 0.1)
+        bh, bw = rh / pooled, rw / pooled
+        for ct in range(output_dim):
+            for ph in range(pooled):
+                for pw in range(pooled):
+                    hs = min(max(int(np.floor(ph * bh + y1)), 0), H)
+                    he = min(max(int(np.ceil((ph + 1) * bh + y1)), 0), H)
+                    ws = min(max(int(np.floor(pw * bw + x1)), 0), W)
+                    we = min(max(int(np.ceil((pw + 1) * bw + x1)), 0), W)
+                    gw = min(max(pw * group // pooled, 0), group - 1)
+                    gh = min(max(ph * group // pooled, 0), group - 1)
+                    c = (ct * group + gh) * group + gw
+                    if he <= hs or we <= ws:
+                        continue
+                    patch = data[b, c, hs:he, ws:we]
+                    out[n, ct, ph, pw] = patch.sum() / patch.size
+    return out
+
+
+def test_psroi_pooling_vs_reference_impl():
+    rs = np.random.RandomState(3)
+    pooled, group, D = 3, 3, 2
+    data = rs.rand(2, D * group * group, 12, 12).astype(np.float32)
+    rois = np.array([[0, 1, 1, 8, 8],
+                     [1, 0, 2, 11, 11],
+                     [0, 4, 4, 6, 7]], np.float32)
+    out = mx.nd.contrib.PSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois), spatial_scale=1.0,
+        output_dim=D, pooled_size=pooled, group_size=group)
+    ref = _psroi_ref(data, rois, 1.0, D, pooled, group)
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_psroi_pooling_spatial_scale():
+    rs = np.random.RandomState(4)
+    data = rs.rand(1, 4, 8, 8).astype(np.float32)
+    rois = np.array([[0, 2, 2, 13, 13]], np.float32)
+    out = mx.nd.contrib.PSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois), spatial_scale=0.5,
+        output_dim=1, pooled_size=2, group_size=2)
+    ref = _psroi_ref(data, rois, 0.5, 1, 2, 2)
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_deformable_psroi_no_trans_matches_sampled_pool():
+    rs = np.random.RandomState(5)
+    pooled, group, D = 2, 2, 2
+    data = rs.rand(1, D * group * group, 10, 10).astype(np.float32)
+    rois = np.array([[0, 1, 1, 8, 8]], np.float32)
+    out, cnt = mx.nd.contrib.DeformablePSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois), spatial_scale=1.0,
+        output_dim=D, group_size=group, pooled_size=pooled,
+        sample_per_part=2, no_trans=True)
+    assert out.shape == (1, D, pooled, pooled)
+    assert cnt.shape == (1, D, pooled, pooled)
+    assert (cnt.asnumpy() == 4).all()  # all samples in-bounds
+    assert np.isfinite(out.asnumpy()).all()
+    assert out.asnumpy().max() <= 1.0 and out.asnumpy().min() >= 0.0
+
+
+def test_deformable_psroi_trans_shifts_window():
+    # constant-gradient image: shifting the window changes the mean
+    H = W = 12
+    img = np.tile(np.arange(W, dtype=np.float32), (H, 1))
+    data = img[None, None].repeat(1, axis=0)
+    rois = np.array([[0, 2, 2, 9, 9]], np.float32)
+    trans0 = np.zeros((1, 2, 1, 1), np.float32)
+    trans1 = np.zeros((1, 2, 1, 1), np.float32)
+    trans1[0, 0] = 1.0  # x shift
+    base, _ = mx.nd.contrib.DeformablePSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois), mx.nd.array(trans0),
+        spatial_scale=1.0, output_dim=1, group_size=1, pooled_size=1,
+        part_size=1, sample_per_part=4, trans_std=0.1)
+    shifted, _ = mx.nd.contrib.DeformablePSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois), mx.nd.array(trans1),
+        spatial_scale=1.0, output_dim=1, group_size=1, pooled_size=1,
+        part_size=1, sample_per_part=4, trans_std=0.1)
+    assert shifted.asnumpy()[0, 0, 0, 0] > base.asnumpy()[0, 0, 0, 0]
+
+
+def test_bipartite_matching():
+    score = np.array([[0.9, 0.1],
+                      [0.8, 0.7]], np.float32)
+    rm, cm = mx.nd.contrib.bipartite_matching(mx.nd.array(score),
+                                              threshold=0.05)
+    # greedy: (0,0)=0.9 first, then (1,1)=0.7
+    np.testing.assert_array_equal(rm.asnumpy(), [0, 1])
+    np.testing.assert_array_equal(cm.asnumpy(), [0, 1])
+    # threshold cuts low scores
+    rm2, cm2 = mx.nd.contrib.bipartite_matching(mx.nd.array(score),
+                                                threshold=0.75)
+    np.testing.assert_array_equal(rm2.asnumpy(), [0, -1])
+    np.testing.assert_array_equal(cm2.asnumpy(), [0, -1])
+    # ascending mode picks smallest first
+    rm3, _ = mx.nd.contrib.bipartite_matching(mx.nd.array(score),
+                                              threshold=0.95, is_ascend=True)
+    np.testing.assert_array_equal(rm3.asnumpy(), [1, 0])
+    # topk limits matches
+    rm4, _ = mx.nd.contrib.bipartite_matching(mx.nd.array(score),
+                                              threshold=0.05, topk=1)
+    np.testing.assert_array_equal(rm4.asnumpy(), [0, -1])
+    # batch dim
+    rmb, cmb = mx.nd.contrib.bipartite_matching(
+        mx.nd.array(np.stack([score, score.T])), threshold=0.05)
+    assert rmb.shape == (2, 2) and cmb.shape == (2, 2)
