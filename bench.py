@@ -3,9 +3,10 @@
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 Baseline: 298.51 img/s — MXNet ResNet-50 training, batch 32 fp32, 1x V100
 (BASELINE.md / docs/faq/perf.md:227-237). The whole train step (fwd+bwd+SGD
-momentum update) is one fused XLA program with donated buffers; compute
-dtype comes from MXTPU_BENCH_DTYPE (default float32 — bf16 is pathologically
-slow through the axon relay) and is recorded in the output JSON.
+momentum update) is one fused XLA program; SPMDTrainer pins parameters to
+the accelerator backend up front (CPU-committed args would silently run
+the jit on host). Compute dtype from MXTPU_BENCH_DTYPE (default bfloat16 —
+the MXU-native dtype; measured 1065 img/s at batch 256 vs 576 f32).
 """
 import json
 import os
@@ -58,10 +59,11 @@ def run(batch=128, warmup=1, iters=None, dtype=None):
     from mxnet_tpu.parallel import SPMDTrainer
     from mxnet_tpu import nd
 
-    # dtype: measured on the axon relay, bf16 matmuls run ~15x SLOWER than
-    # f32 (software-handled bf16); default to f32 there, bf16 on real TPU.
+    # bf16 default: the MXU-native dtype (the earlier "bf16 slow on the
+    # relay" measurement was an artifact of CPU-committed parameters
+    # pulling the jit onto the host backend — fixed in SPMDTrainer).
     if dtype is None:
-        dtype = os.environ.get("MXTPU_BENCH_DTYPE", "float32")
+        dtype = os.environ.get("MXTPU_BENCH_DTYPE", "bfloat16")
 
     mx.random.seed(0)
     net = resnet50_v1()
@@ -121,11 +123,11 @@ def main():
     if not _init_backend():
         os._exit(0)
     _enable_compile_cache()
-    # batch 64 default: throughput here is memory-bandwidth-bound (img/s
-    # roughly batch-independent) and the smaller step keeps total bench
-    # wall-clock inside the driver's budget
+    # batch 512 first: the ~100ms per-execution relay overhead amortizes
+    # with batch size (measured 1406 img/s @512, 1065 @256, 690 @128,
+    # bf16); smaller fallbacks cover tighter-memory chips
     batches = [int(b) for b in
-               os.environ.get("MXTPU_BENCH_BATCHES", "64,32").split(",")]
+               os.environ.get("MXTPU_BENCH_BATCHES", "512,256,128").split(",")]
     last_err = None
     for batch in batches:
         try:
@@ -135,7 +137,7 @@ def main():
                 "value": round(value, 2),
                 "unit": "img/s",
                 "vs_baseline": round(value / BASELINE_IMGS_PER_SEC, 3),
-                "dtype": os.environ.get("MXTPU_BENCH_DTYPE", "float32"),
+                "dtype": os.environ.get("MXTPU_BENCH_DTYPE", "bfloat16"),
                 "batch": batch,
             }))
             return

@@ -1,0 +1,117 @@
+"""Tiny Faster R-CNN (ref: example/rcnn/): RPN head producing proposals
+through the `Proposal` op, ROIPooling over the backbone features, and a
+small ROI classification head. Synthetic bright-square dataset
+(zero-egress). Demonstrates the full two-stage detection pipeline the
+reference's rcnn example runs (train_end2end.py) on the new
+Proposal/ROIPooling ops.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+
+def synthetic_batch(rs, batch, size=64):
+    """Images with one bright square; label = class (1) + corner box."""
+    data = rs.rand(batch, 3, size, size).astype(np.float32) * 0.1
+    boxes = np.zeros((batch, 4), np.float32)
+    for i in range(batch):
+        w = rs.randint(16, 32)
+        x0 = rs.randint(0, size - w)
+        y0 = rs.randint(0, size - w)
+        data[i, :, y0:y0 + w, x0:x0 + w] = 1.0
+        boxes[i] = [x0, y0, x0 + w - 1, y0 + w - 1]
+    return data, boxes
+
+
+def iou_targets(rois, gt_box):
+    """Label each roi 1 if IoU with the single gt box > 0.3 else 0."""
+    x1 = np.maximum(rois[:, 1], gt_box[0])
+    y1 = np.maximum(rois[:, 2], gt_box[1])
+    x2 = np.minimum(rois[:, 3], gt_box[2])
+    y2 = np.minimum(rois[:, 4], gt_box[3])
+    inter = np.maximum(x2 - x1 + 1, 0) * np.maximum(y2 - y1 + 1, 0)
+    a1 = (rois[:, 3] - rois[:, 1] + 1) * (rois[:, 4] - rois[:, 2] + 1)
+    a2 = (gt_box[2] - gt_box[0] + 1) * (gt_box[3] - gt_box[1] + 1)
+    return (inter / (a1 + a2 - inter) > 0.3).astype(np.float32)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--batch-size", type=int, default=4)
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--num-rois", type=int, default=16)
+    args = ap.parse_args()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+    from mxnet_tpu.gluon import nn, loss as gloss
+
+    mx.random.seed(0)
+    rs = np.random.RandomState(0)
+    stride = 16
+    scales, ratios = (1.5, 2.0), (1.0,)
+    A = len(scales) * len(ratios)
+
+    backbone = nn.HybridSequential()
+    backbone.add(nn.Conv2D(16, 3, strides=2, padding=1, activation="relu"),
+                 nn.Conv2D(32, 3, strides=2, padding=1, activation="relu"),
+                 nn.Conv2D(32, 3, strides=2, padding=1, activation="relu"),
+                 nn.Conv2D(32, 3, strides=2, padding=1, activation="relu"))
+    rpn_cls = nn.Conv2D(2 * A, 1)
+    rpn_bbox = nn.Conv2D(4 * A, 1)
+    roi_head = nn.HybridSequential()
+    roi_head.add(nn.Dense(64, activation="relu"), nn.Dense(2))
+    for blk in (backbone, rpn_cls, rpn_bbox, roi_head):
+        blk.initialize(mx.init.Xavier())
+
+    params = {}
+    for blk in (backbone, rpn_cls, rpn_bbox, roi_head):
+        params.update(blk.collect_params())
+    trainer = gluon.Trainer(params, "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9})
+    ce = gloss.SoftmaxCrossEntropyLoss()
+
+    for it in range(args.iters):
+        data_np, gt = synthetic_batch(rs, args.batch_size)
+        data = nd.array(data_np)
+        im_info = nd.array(np.tile([[64.0, 64.0, 1.0]],
+                                   (args.batch_size, 1)).astype(np.float32))
+        with autograd.record():
+            feat = backbone(data)
+            cls_score = rpn_cls(feat)
+            # softmax over the (bg, fg) anchor pair for the Proposal op
+            cls_prob = nd.softmax(
+                cls_score.reshape((args.batch_size, 2, -1)), axis=1) \
+                .reshape(cls_score.shape)
+            bbox_pred = rpn_bbox(feat)
+            with autograd.pause():
+                rois = nd.contrib.MultiProposal(
+                    cls_prob, bbox_pred, im_info,
+                    rpn_pre_nms_top_n=64, rpn_post_nms_top_n=args.num_rois,
+                    threshold=0.7, rpn_min_size=8, scales=scales,
+                    ratios=ratios, feature_stride=stride)
+                roi_np = rois.asnumpy()
+                labels = np.concatenate(
+                    [iou_targets(roi_np[i * args.num_rois:
+                                        (i + 1) * args.num_rois], gt[i])
+                     for i in range(args.batch_size)])
+            pooled = nd.ROIPooling(feat, rois, pooled_size=(3, 3),
+                                   spatial_scale=1.0 / stride)
+            logits = roi_head(pooled.reshape((pooled.shape[0], -1)))
+            loss = ce(logits, nd.array(labels)).mean()
+        loss.backward()
+        trainer.step(args.batch_size)
+        acc = (logits.asnumpy().argmax(axis=1) == labels).mean()
+        print(f"iter {it}: roi-cls loss {float(loss.asnumpy()):.4f} "
+              f"acc {acc:.3f}")
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

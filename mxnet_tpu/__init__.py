@@ -44,6 +44,7 @@ from . import engine  # noqa: F401
 from . import profiler  # noqa: F401
 from . import runtime  # noqa: F401
 from . import recordio  # noqa: F401
+from . import fault  # noqa: F401
 from . import test_utils  # noqa: F401
 from . import contrib  # noqa: F401
 from . import parallel  # noqa: F401

@@ -1,0 +1,231 @@
+"""Failure detection + restart-from-checkpoint (SURVEY §5.3).
+
+Reference analog: ps-lite heartbeats surfaced as
+``ps::Postoffice::GetDeadNodes(timeout)`` through the dist kvstore
+(src/kvstore/kvstore_dist.h:121-126) and the ``is_recovery`` rejoin branch
+(kvstore_dist.h:52,138,206). ICI collectives cannot tolerate membership
+change mid-program, so the TPU-native story (SURVEY §5.3 design note) is:
+
+1. **Liveness**: every worker process beats a per-rank heartbeat file under
+   a shared directory (works across the processes tools/launch.py forks);
+   ``dead_nodes(timeout)`` lists ranks whose beat is stale — the
+   GetDeadNodes equivalent for the coordinator/driver to act on.
+2. **Recovery**: restart the whole job from the latest complete checkpoint.
+   ``CheckpointManager`` writes atomic, versioned checkpoints (params +
+   optimizer/trainer state + step counter) and ``restore_latest`` resumes;
+   ``is_recovery()`` mirrors ps-lite's rejoin flag via MXNET_IS_RECOVERY.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .base import MXNetError, check
+from . import ndarray as nd
+
+__all__ = ["Heartbeat", "dead_nodes", "is_recovery", "CheckpointManager"]
+
+
+def _hb_path(dir_path: str, rank: int) -> str:
+    return os.path.join(dir_path, f"heartbeat-{rank}")
+
+
+class Heartbeat:
+    """Per-rank liveness beacon: touches ``heartbeat-<rank>`` every
+    ``interval`` seconds on a daemon thread. Use as a context manager
+    around the training loop."""
+
+    def __init__(self, dir_path: str, rank: Optional[int] = None,
+                 interval: float = 5.0):
+        self._dir = dir_path
+        if rank is None:
+            rank = int(os.environ.get("DMLC_RANK", "0"))
+        self._rank = int(rank)
+        self._interval = float(interval)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        os.makedirs(dir_path, exist_ok=True)
+
+    @property
+    def rank(self) -> int:
+        return self._rank
+
+    def beat(self) -> None:
+        path = _hb_path(self._dir, self._rank)
+        with open(path, "w") as f:
+            f.write(str(time.time()))
+
+    def start(self) -> "Heartbeat":
+        self.beat()
+        if self._thread is None:
+            def loop():
+                while not self._stop.wait(self._interval):
+                    try:
+                        self.beat()
+                    except OSError:
+                        pass
+            self._thread = threading.Thread(target=loop, daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self._interval + 1)
+            self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def dead_nodes(dir_path: str, timeout: float = 60.0) -> List[int]:
+    """Ranks whose heartbeat is older than ``timeout`` seconds — the
+    ``GetDeadNodes`` analog (ref: kvstore_dist.h:121-126). A rank that
+    never wrote a heartbeat is not listed (it may not have started)."""
+    out = []
+    now = time.time()
+    if not os.path.isdir(dir_path):
+        return out
+    for name in sorted(os.listdir(dir_path)):
+        if not name.startswith("heartbeat-"):
+            continue
+        try:
+            rank = int(name.split("-", 1)[1])
+            with open(os.path.join(dir_path, name)) as f:
+                last = float(f.read().strip() or 0)
+        except (ValueError, OSError):
+            continue
+        if now - last > timeout:
+            out.append(rank)
+    return out
+
+
+def is_recovery() -> bool:
+    """Rejoin-after-failure flag (ref: ps::Postoffice::is_recovery, set on
+    relaunched nodes; here via the MXNET_IS_RECOVERY env the relauncher
+    sets)."""
+    return os.environ.get("MXNET_IS_RECOVERY", "0") not in ("0", "", "false")
+
+
+class CheckpointManager:
+    """Atomic, versioned training checkpoints for restart-based recovery.
+
+    Layout: ``<dir>/ckpt-<step>/params`` (nd.save format, same container
+    the reference's save_checkpoint uses — src/c_api/c_api.cc:313
+    MXNDArraySave), ``trainer`` (optimizer states when given), and a
+    ``DONE`` marker written last so partially-written checkpoints are
+    never restored. ``max_keep`` old checkpoints are pruned.
+    """
+
+    def __init__(self, dir_path: str, max_keep: int = 3):
+        check(max_keep >= 1, "max_keep must be >= 1")
+        self._dir = dir_path
+        self._max_keep = max_keep
+        os.makedirs(dir_path, exist_ok=True)
+
+    def _ckpt_dir(self, step: int) -> str:
+        return os.path.join(self._dir, f"ckpt-{step}")
+
+    def steps(self) -> List[int]:
+        """Completed checkpoint steps, ascending."""
+        out = []
+        for name in os.listdir(self._dir):
+            if name.startswith("ckpt-"):
+                try:
+                    step = int(name.split("-", 1)[1])
+                except ValueError:
+                    continue
+                if os.path.exists(os.path.join(self._dir, name, "DONE")):
+                    out.append(step)
+        return sorted(out)
+
+    def save(self, step: int, params: Optional[Dict[str, "nd.NDArray"]] = None,
+             trainer=None, extra: Optional[dict] = None, net=None) -> str:
+        """Write checkpoint ``step``. Pass ``net`` (a gluon Block) to save
+        its parameters under structural names that survive re-instantiation
+        (same naming as Block.save_parameters), or ``params`` as an explicit
+        name->NDArray map; ``trainer`` may be a gluon Trainer (optimizer
+        states included)."""
+        check(params is not None or net is not None,
+              "save() needs params or net")
+        if net is not None:
+            params = {k: p.data()
+                      for k, p in net._collect_params_with_prefix().items()}
+        path = self._ckpt_dir(step)
+        tmp = path + ".tmp"
+        if os.path.isdir(tmp):
+            import shutil
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        nd.save(os.path.join(tmp, "params"), dict(params))
+        if trainer is not None:
+            trainer.save_states(os.path.join(tmp, "trainer"))
+        meta = {"step": int(step), "time": time.time()}
+        if extra:
+            meta.update(extra)
+        with open(os.path.join(tmp, "meta.json"), "w") as f:
+            json.dump(meta, f)
+        with open(os.path.join(tmp, "DONE"), "w") as f:
+            f.write("ok")
+        if os.path.isdir(path):
+            import shutil
+            shutil.rmtree(path)
+        os.replace(tmp, path)
+        self._prune()
+        return path
+
+    def _prune(self) -> None:
+        steps = self.steps()
+        for step in steps[:-self._max_keep]:
+            import shutil
+            shutil.rmtree(self._ckpt_dir(step), ignore_errors=True)
+
+    def latest(self) -> Optional[int]:
+        steps = self.steps()
+        return steps[-1] if steps else None
+
+    def restore(self, step: int, net=None, trainer=None
+                ) -> Tuple[int, Dict[str, "nd.NDArray"], dict]:
+        """Load checkpoint ``step``; when ``net``/``trainer`` are given,
+        their parameters/optimizer states are set in place."""
+        path = self._ckpt_dir(step)
+        check(os.path.exists(os.path.join(path, "DONE")),
+              f"checkpoint {step} is missing or incomplete")
+        params = nd.load(os.path.join(path, "params"))
+        with open(os.path.join(path, "meta.json")) as f:
+            meta = json.load(f)
+        if net is not None:
+            # structural names first (instance-independent, the save(net=)
+            # format), falling back to collect_params naming; unmatched
+            # keys are an error, not a silent skip
+            structural = net._collect_params_with_prefix()
+            flat = net.collect_params()
+            for k, v in params.items():
+                if k in structural:
+                    structural[k].set_data(v)
+                elif k in flat:
+                    flat[k].set_data(v)
+                else:
+                    raise MXNetError(
+                        f"checkpoint parameter {k!r} not found in net "
+                        f"(known: {sorted(structural)[:5]}...)")
+        tr_path = os.path.join(path, "trainer")
+        if trainer is not None and os.path.exists(tr_path):
+            trainer.load_states(tr_path)
+        return int(meta["step"]), params, meta
+
+    def restore_latest(self, net=None, trainer=None
+                       ) -> Optional[Tuple[int, Dict, dict]]:
+        """Resume point for restart-based recovery: returns None on a
+        fresh start, else (step, params, meta) of the newest complete
+        checkpoint (optionally loading net/trainer in place)."""
+        step = self.latest()
+        if step is None:
+            return None
+        return self.restore(step, net=net, trainer=trainer)

@@ -30,17 +30,18 @@ def _csr_np(csr: CSRNDArray):
 
 def edge_id(data, u, v):
     """out[i] = data[u[i], v[i]], or -1 when the edge is absent
-    (ref: dgl_graph.cc:1315 _contrib_edge_id)."""
+    (ref: dgl_graph.cc:1315 _contrib_edge_id). Keeps the csr value dtype
+    (edge ids are int64 — a float32 result would corrupt ids > 2^24)."""
     vals, indices, indptr, _ = _csr_np(data)
     uu = u.asnumpy().astype(_np.int64).reshape(-1)
     vv = v.asnumpy().astype(_np.int64).reshape(-1)
-    out = _np.full(uu.shape, -1.0, _np.float32)
+    out = _np.full(uu.shape, -1, vals.dtype)
     for i, (r, c) in enumerate(zip(uu, vv)):
         row = indices[indptr[r]:indptr[r + 1]]
         hit = _np.where(row == c)[0]
         if hit.size:
             out[i] = vals[indptr[r] + hit[0]]
-    return _nd.array(out)
+    return _nd.array(out, dtype=vals.dtype)
 
 
 def dgl_adjacency(data):
@@ -74,12 +75,14 @@ def dgl_subgraph(graph, *vertex_arrays, return_mapping=False, **_):
             sub_indices.extend(c for c, _v in cols)
             sub_parent.extend(_v for _c, _v in cols)
             sub_indptr.append(len(sub_indices))
-        new_ids = _np.arange(1, len(sub_indices) + 1, dtype=_np.float32)
+        new_ids = _np.arange(1, len(sub_indices) + 1, dtype=vals.dtype)
         ii = _np.asarray(sub_indices, _np.int64)
         pp = _np.asarray(sub_indptr, _np.int64)
-        outs.append(csr_matrix((new_ids, ii, pp), shape=(n, n)))
+        outs.append(csr_matrix((new_ids, ii, pp), shape=(n, n),
+                               dtype=vals.dtype))
         mappings.append(csr_matrix(
-            (_np.asarray(sub_parent, _np.float32), ii, pp), shape=(n, n)))
+            (_np.asarray(sub_parent, vals.dtype), ii, pp), shape=(n, n),
+            dtype=vals.dtype))
     result = outs + mappings if return_mapping else outs
     return result[0] if len(result) == 1 else tuple(result)
 
@@ -120,8 +123,7 @@ def _neighbor_sample(graph, seed_arrays, num_hops, num_neighbor,
                     pick = _np.random.choice(deg, size=k, replace=False,
                                              p=p / s)
                 pick.sort()
-                chosen = [(int(row_cols[i]), float(row_vals[i]))
-                          for i in pick]
+                chosen = [(int(row_cols[i]), row_vals[i]) for i in pick]
                 sampled_edges.setdefault(v, []).extend(chosen)
                 for c, _e in chosen:
                     if c not in layer and len(order) < max_num_vertices:
@@ -137,27 +139,40 @@ def _neighbor_sample(graph, seed_arrays, num_hops, num_neighbor,
         layers = _np.full(max_num_vertices, -1, _np.int64)
         for i, v in enumerate(order):
             layers[i] = layer[v]
-        # csr in original id space, (max_num_vertices, max_num_vertices)
+        # sub csr, shape (max_num_vertices, parent_n): row i holds the
+        # sampled out-edges of the i-th vertex in `order`, columns are
+        # ORIGINAL vertex ids, values original edge ids (ref: dgl_graph.cc
+        # CSRNeighborUniformSampleShape:272-281 — out_csr_shape =
+        # [max_num_vertices, in_shape[1]])
         m = max_num_vertices
+        parent_n = shape[1]
+        vset = set(order)
         sub_indptr = [0]
         sub_indices: List[int] = []
-        sub_vals: List[float] = []
-        vset = set(order)
-        for r in range(m):
-            if r in sampled_edges and r in vset:
-                row = sorted((c, e) for c, e in sampled_edges[r]
-                             if c in vset and c < m)
-                sub_indices.extend(c for c, _e in row)
-                sub_vals.extend(e for _c, e in row)
+        sub_vals: List = []
+        for v in order:
+            row = sorted((c, e) for c, e in sampled_edges.get(v, ())
+                         if c in vset)
+            sub_indices.extend(c for c, _e in row)
+            sub_vals.extend(e for _c, e in row)
             sub_indptr.append(len(sub_indices))
-        sub = csr_matrix((_np.asarray(sub_vals, _np.float32),
+        sub_indptr.extend([len(sub_indices)] * (m - len(order)))
+        sub = csr_matrix((_np.asarray(sub_vals, vals.dtype),
                           _np.asarray(sub_indices, _np.int64),
-                          _np.asarray(sub_indptr, _np.int64)), shape=(m, m))
-        results.append((_nd.array(verts), sub, _nd.array(layers)))
-    vs = [r[0] for r in results]
-    gs = [r[1] for r in results]
-    ls = [r[2] for r in results]
-    out = vs + gs + ls
+                          _np.asarray(sub_indptr, _np.int64)),
+                         shape=(m, parent_n), dtype=vals.dtype)
+        if prob is not None:
+            # non-uniform adds a sub_probability output (ref:
+            # CSRNeighborNonUniformSampleShape:340-347)
+            sub_prob = _np.zeros(m, _np.float32)
+            sub_prob[:len(order)] = prob[order]
+            results.append((_nd.array(verts), sub, _nd.array(sub_prob),
+                            _nd.array(layers)))
+        else:
+            results.append((_nd.array(verts), sub, _nd.array(layers)))
+    out = []
+    for i in range(len(results[0])):
+        out.extend(r[i] for r in results)
     return tuple(out)
 
 
@@ -198,27 +213,32 @@ def dgl_graph_compact(*graph_data, graph_sizes=(), return_mapping=False,
         size = graph_sizes[i]
         vids = varr.asnumpy().astype(_np.int64).reshape(-1)[:size]
         vals, indices, indptr, _shape = _csr_np(g)
+        # sampler csr rows are SAMPLE POSITIONS (row j = j-th vertex in
+        # the vertex list) with original-id columns (ref: dgl_graph.cc
+        # CompactSubgraph:1443-1484 copies row pointers 0..size and
+        # remaps columns via the id map)
         pos = {int(v): j for j, v in enumerate(vids)}
         sub_indptr = [0]
         sub_indices: List[int] = []
-        sub_vals: List[float] = []
-        for v in vids:
-            row_cols = indices[indptr[v]:indptr[v + 1]]
-            row_vals = vals[indptr[v]:indptr[v + 1]]
-            row = sorted((pos[int(c)], float(e))
+        sub_vals: List = []
+        for r in range(size):
+            row_cols = indices[indptr[r]:indptr[r + 1]]
+            row_vals = vals[indptr[r]:indptr[r + 1]]
+            row = sorted((pos[int(c)], e)
                          for c, e in zip(row_cols, row_vals) if int(c) in pos)
             sub_indices.extend(c for c, _e in row)
             sub_vals.extend(e for _c, e in row)
             sub_indptr.append(len(sub_indices))
         ii = _np.asarray(sub_indices, _np.int64)
         pp = _np.asarray(sub_indptr, _np.int64)
-        outs.append(csr_matrix((_np.asarray(sub_vals, _np.float32), ii, pp),
-                               shape=(size, size)))
+        outs.append(csr_matrix((_np.asarray(sub_vals, vals.dtype), ii, pp),
+                               shape=(size, size), dtype=vals.dtype))
         if return_mapping:
             # like dgl_subgraph: first output gets fresh 1-based edge ids,
             # mapping carries the parent edge ids
-            new_ids = _np.arange(1, len(sub_vals) + 1, dtype=_np.float32)
+            new_ids = _np.arange(1, len(sub_vals) + 1, dtype=vals.dtype)
             maps.append(outs[-1])
-            outs[-1] = csr_matrix((new_ids, ii, pp), shape=(size, size))
+            outs[-1] = csr_matrix((new_ids, ii, pp), shape=(size, size),
+                                  dtype=vals.dtype)
     result = outs + maps if return_mapping else outs
     return result[0] if len(result) == 1 else tuple(result)

@@ -1,0 +1,35 @@
+"""Smoke-run the detection examples end to end (ref: the reference CI runs
+example trees via ci/docker/runtime_functions.sh tutorialtest)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script, args):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, script)] + args,
+        capture_output=True, text=True, timeout=600, env=env)
+
+
+@pytest.mark.slow
+def test_rcnn_example_learns():
+    r = _run("examples/rcnn/train_rcnn.py",
+             ["--iters", "6", "--batch-size", "4"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [l for l in r.stdout.splitlines() if l.startswith("iter")]
+    assert len(lines) == 6
+    first = float(lines[0].split("loss")[1].split()[0])
+    last = float(lines[-1].split("loss")[1].split()[0])
+    assert last < first, (first, last)
+
+
+@pytest.mark.slow
+def test_ssd_example_runs():
+    r = _run("examples/ssd/train_ssd.py", ["--iters", "3"])
+    assert r.returncode == 0, r.stderr[-2000:]

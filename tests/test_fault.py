@@ -1,0 +1,109 @@
+"""Failure-detection + checkpoint/restart tests (SURVEY §5.3 analog of
+tests around ps-lite GetDeadNodes / model_backwards_compatibility_check)."""
+import os
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import fault, gluon, nd, autograd
+
+
+def test_heartbeat_and_dead_nodes(tmp_path):
+    d = str(tmp_path)
+    hb0 = fault.Heartbeat(d, rank=0, interval=0.2)
+    hb1 = fault.Heartbeat(d, rank=1, interval=0.2)
+    with hb0, hb1:
+        time.sleep(0.5)
+        assert fault.dead_nodes(d, timeout=5.0) == []
+    # stop rank 1's beats and backdate its file -> reported dead
+    os.utime(os.path.join(d, "heartbeat-1"),
+             (time.time() - 100, time.time() - 100))
+    # utime doesn't change the content; rewrite with an old stamp instead
+    with open(os.path.join(d, "heartbeat-1"), "w") as f:
+        f.write(str(time.time() - 100))
+    assert fault.dead_nodes(d, timeout=30.0) == [1]
+    assert fault.dead_nodes(d, timeout=1000.0) == []
+
+
+def test_is_recovery_env(monkeypatch):
+    monkeypatch.delenv("MXNET_IS_RECOVERY", raising=False)
+    assert not fault.is_recovery()
+    monkeypatch.setenv("MXNET_IS_RECOVERY", "1")
+    assert fault.is_recovery()
+
+
+def _make_net():
+    net = gluon.nn.Dense(2, use_bias=False)
+    net.initialize(mx.init.Constant(1.0))
+    with autograd.pause():
+        net(nd.ones((1, 3)))
+    return net
+
+
+def _step(net, trainer, x, y):
+    with autograd.record():
+        loss = ((net(x) - y) ** 2).mean()
+    loss.backward()
+    trainer.step(x.shape[0])
+    return float(loss.asnumpy())
+
+
+def test_checkpoint_resume_exact(tmp_path):
+    """A killed-and-restarted run resumes bit-identically from the
+    checkpoint (momentum state included)."""
+    rs = np.random.RandomState(0)
+    x = nd.array(rs.randn(8, 3).astype(np.float32))
+    y = nd.array(rs.randn(8, 2).astype(np.float32))
+
+    # run A: 4 steps straight through
+    net_a = _make_net()
+    tr_a = gluon.Trainer(net_a.collect_params(), "sgd",
+                         {"learning_rate": 0.1, "momentum": 0.9})
+    for _ in range(4):
+        _step(net_a, tr_a, x, y)
+
+    # run B: 2 steps, checkpoint, "crash", restore into fresh objects,
+    # 2 more steps
+    cm = fault.CheckpointManager(str(tmp_path), max_keep=2)
+    net_b = _make_net()
+    tr_b = gluon.Trainer(net_b.collect_params(), "sgd",
+                         {"learning_rate": 0.1, "momentum": 0.9})
+    for _ in range(2):
+        _step(net_b, tr_b, x, y)
+    cm.save(2, net=net_b, trainer=tr_b)
+
+    net_c = _make_net()
+    tr_c = gluon.Trainer(net_c.collect_params(), "sgd",
+                         {"learning_rate": 0.1, "momentum": 0.9})
+    resumed = cm.restore_latest(net=net_c, trainer=tr_c)
+    assert resumed is not None and resumed[0] == 2
+    for _ in range(2):
+        _step(net_c, tr_c, x, y)
+
+    for (ka, pa), (kc, pc) in zip(sorted(net_a.collect_params().items()),
+                                  sorted(net_c.collect_params().items())):
+        np.testing.assert_allclose(pa.data().asnumpy(),
+                                   pc.data().asnumpy(), rtol=1e-6)
+
+
+def test_checkpoint_prune_and_incomplete(tmp_path):
+    cm = fault.CheckpointManager(str(tmp_path), max_keep=2)
+    net = _make_net()
+    params = {k: p.data() for k, p in net.collect_params().items()}
+    for s in (1, 2, 3):
+        cm.save(s, params)
+    assert cm.steps() == [2, 3]  # pruned to max_keep
+    # partially-written checkpoint (no DONE) is invisible
+    broken = os.path.join(str(tmp_path), "ckpt-9")
+    os.makedirs(broken)
+    assert cm.latest() == 3
+    import pytest
+    from mxnet_tpu.base import MXNetError
+    with pytest.raises(MXNetError):
+        cm.restore(9)
+
+
+def test_fresh_start_returns_none(tmp_path):
+    cm = fault.CheckpointManager(str(tmp_path))
+    assert cm.restore_latest() is None

@@ -86,12 +86,16 @@ def test_neighbor_non_uniform_sample():
     # probability concentrated on vertices 1 and 2
     prob = nd.array(np.array([0.0, 0.5, 0.5, 0.0, 0.0], np.float32))
     seed = nd.array(np.array([0], np.int64))
-    verts, sub, layer = nd.contrib.dgl_csr_neighbor_non_uniform_sample(
-        g, prob, seed, num_args=3, num_hops=1, num_neighbor=2,
-        max_num_vertices=5)
+    verts, sub, sprob, layer = \
+        nd.contrib.dgl_csr_neighbor_non_uniform_sample(
+            g, prob, seed, num_args=3, num_hops=1, num_neighbor=2,
+            max_num_vertices=5)
     v = verts.asnumpy()
     assert v[-1] == 3
     assert set(v[1:3].tolist()) == {1, 2}  # zero-prob vertices never drawn
+    # probability output follows the sampled vertex order (seed first)
+    np.testing.assert_allclose(sprob.asnumpy()[:3],
+                               prob.asnumpy()[v[:3]], rtol=1e-6)
 
 
 def test_graph_compact():
@@ -147,11 +151,49 @@ def test_non_uniform_sample_fewer_nonzero_than_k():
     g = _full_graph()
     # only one neighbor of vertex 0 has nonzero probability but k=3
     prob = nd.array(np.array([0.0, 1.0, 0.0, 0.0, 0.0], np.float32))
-    verts, sub, layer = nd.contrib.dgl_csr_neighbor_non_uniform_sample(
-        g, prob, nd.array(np.array([0], np.int64)), num_args=3, num_hops=1,
-        num_neighbor=3, max_num_vertices=5)
+    verts, sub, sprob, layer = \
+        nd.contrib.dgl_csr_neighbor_non_uniform_sample(
+            g, prob, nd.array(np.array([0], np.int64)), num_args=3,
+            num_hops=1, num_neighbor=3, max_num_vertices=5)
     v = verts.asnumpy()
     assert v[-1] == 2 and v[1] == 1  # seed + single viable neighbor
+
+
+def test_neighbor_sample_large_graph_small_cap():
+    """Parent graph larger than max_num_vertices: rows are sample
+    positions, columns original ids (ref out_csr shape [max, parent_n])."""
+    np.random.seed(7)
+    n = 50
+    dense = np.zeros((n, n), np.int64)
+    rs = np.random.RandomState(1)
+    eid = 1
+    for r in range(n):
+        for c in rs.choice(n, 4, replace=False):
+            if c != r:
+                dense[r, c] = eid
+                eid += 1
+    g = nd.sparse.cast_storage(nd.array(dense.astype(np.float32)), "csr")
+    # rebuild with int64 ids to preserve exactness
+    rows, cols = np.nonzero(dense)
+    indptr = np.concatenate(([0], np.cumsum(np.bincount(rows, minlength=n))))
+    g = nd.sparse.csr_matrix((dense[rows, cols], cols.astype(np.int64),
+                              indptr.astype(np.int64)), shape=(n, n))
+    seed = nd.array(np.array([40], np.int64))
+    verts, sub, layer = nd.contrib.dgl_csr_neighbor_uniform_sample(
+        g, seed, num_args=2, num_hops=2, num_neighbor=2, max_num_vertices=6)
+    cnt = int(verts.asnumpy()[-1])
+    assert 1 <= cnt <= 6
+    assert sub.shape == (6, n)
+    # row 0 = the seed's sampled edges, values are parent edge ids
+    d = sub.todense().asnumpy()
+    nz = np.nonzero(d[0])[0]
+    assert len(nz) <= 2
+    for c in nz:
+        assert d[0, c] == dense[40, c]
+    # compaction relabels into (cnt, cnt) without error
+    compact = nd.contrib.dgl_graph_compact(sub, verts, graph_sizes=(cnt,),
+                                           return_mapping=False)
+    assert compact.shape == (cnt, cnt)
 
 
 def test_graph_compact_mapping_ids():
