@@ -59,6 +59,7 @@ class Heartbeat:
             f.write(str(time.time()))
 
     def start(self) -> "Heartbeat":
+        self._stop.clear()  # allow restart after stop()
         self.beat()
         if self._thread is None:
             def loop():
@@ -103,7 +104,7 @@ def dead_nodes(dir_path: str, timeout: float = 60.0) -> List[int]:
             continue
         if now - last > timeout:
             out.append(rank)
-    return out
+    return sorted(out)
 
 
 def is_recovery() -> bool:

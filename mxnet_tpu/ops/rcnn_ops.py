@@ -153,18 +153,6 @@ def _proposal_nout(n_inputs, params):
     return 2 if params.get("output_score", False) else 1
 
 
-def _proposal_params(params):
-    return dict(
-        feature_stride=int(params.get("feature_stride", 16)),
-        scales=tuple(params.get("scales", (4, 8, 16, 32))),
-        ratios=tuple(params.get("ratios", (0.5, 1, 2))),
-        pre=int(params.get("rpn_pre_nms_top_n", 6000)),
-        post=int(params.get("rpn_post_nms_top_n", 300)),
-        threshold=float(params.get("threshold", 0.7)),
-        min_size=float(params.get("rpn_min_size", 16)),
-        iou_loss=bool(params.get("iou_loss", False)))
-
-
 @register("_contrib_Proposal", aliases=("Proposal",),
           num_outputs=_proposal_nout, differentiable=False)
 def _proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
@@ -223,8 +211,7 @@ def _integral_image(data):
     return jnp.pad(s, ((0, 0), (0, 0), (1, 0), (1, 0)))
 
 
-@register("_contrib_PSROIPooling", aliases=("PSROIPooling",),
-          differentiable=False)
+@register("_contrib_PSROIPooling", aliases=("PSROIPooling",))
 def _psroi_pooling(data, rois, spatial_scale=1.0, output_dim=1,
                    pooled_size=1, group_size=0):
     """Position-sensitive ROI average pooling (ref: psroi_pooling.cc
@@ -278,8 +265,7 @@ def _psroi_pooling(data, rois, spatial_scale=1.0, output_dim=1,
 
 
 @register("_contrib_DeformablePSROIPooling",
-          aliases=("DeformablePSROIPooling",), num_outputs=2,
-          differentiable=False)
+          aliases=("DeformablePSROIPooling",), num_outputs=2)
 def _deformable_psroi_pooling(data, rois, *maybe_trans, spatial_scale=1.0,
                               output_dim=1, group_size=1, pooled_size=1,
                               part_size=0, sample_per_part=1, trans_std=0.0,

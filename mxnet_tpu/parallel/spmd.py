@@ -217,6 +217,7 @@ class SPMDTrainer:
     def step(self, data, label):
         """Run one training step; returns the (device) scalar loss."""
         import jax
+        import jax.numpy as jnp
         from .. import random as _random
         from ..ndarray.ndarray import NDArray
 
@@ -224,6 +225,16 @@ class SPMDTrainer:
         label = label._data if isinstance(label, NDArray) else label
         if self._param_objs is None:
             self._collect(sample_data=data)
+        if self.mesh is None:
+            # NDArray inputs arrive committed to the default *context*
+            # device (CPU); with parameters pinned to the accelerator
+            # (_consolidate_params) mixed commitments would error — move
+            # batch inputs to the same device
+            dev = jax.devices()[0]
+            if dev not in data.devices():
+                data = jax.device_put(data, dev)
+            if dev not in label.devices():
+                label = jax.device_put(label, dev)
         if self.mesh is not None:
             from .sharding import shard_batch
             data = shard_batch(data, self.mesh)

@@ -107,3 +107,21 @@ def test_checkpoint_prune_and_incomplete(tmp_path):
 def test_fresh_start_returns_none(tmp_path):
     cm = fault.CheckpointManager(str(tmp_path))
     assert cm.restore_latest() is None
+
+
+def test_heartbeat_restart_and_numeric_order(tmp_path):
+    d = str(tmp_path)
+    hb = fault.Heartbeat(d, rank=0, interval=0.2)
+    hb.start()
+    hb.stop()
+    hb.start()  # restart must resume beating
+    time.sleep(0.6)
+    hb.stop()
+    with open(os.path.join(d, "heartbeat-0")) as f:
+        last = float(f.read())
+    assert time.time() - last < 5.0, "no beats after restart"
+    # numeric rank ordering with >= 10 ranks
+    for r in (0, 2, 10, 11, 1):
+        with open(os.path.join(d, f"heartbeat-{r}"), "w") as f:
+            f.write(str(time.time() - 100))
+    assert fault.dead_nodes(d, timeout=30.0) == [0, 1, 2, 10, 11]

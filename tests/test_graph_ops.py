@@ -172,8 +172,7 @@ def test_neighbor_sample_large_graph_small_cap():
             if c != r:
                 dense[r, c] = eid
                 eid += 1
-    g = nd.sparse.cast_storage(nd.array(dense.astype(np.float32)), "csr")
-    # rebuild with int64 ids to preserve exactness
+    # build with int64 ids to preserve exactness
     rows, cols = np.nonzero(dense)
     indptr = np.concatenate(([0], np.cumsum(np.bincount(rows, minlength=n))))
     g = nd.sparse.csr_matrix((dense[rows, cols], cols.astype(np.int64),

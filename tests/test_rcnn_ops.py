@@ -186,3 +186,43 @@ def test_bipartite_matching():
     rmb, cmb = mx.nd.contrib.bipartite_matching(
         mx.nd.array(np.stack([score, score.T])), threshold=0.05)
     assert rmb.shape == (2, 2) and cmb.shape == (2, 2)
+
+
+def test_psroi_pooling_gradient():
+    """Backward through PSROIPooling distributes each bin's grad as
+    1/bin_area over the bin (ref: psroi_pooling.cc PSROIPoolBackwardAcc)."""
+    import mxnet_tpu.autograd as autograd
+    rs = np.random.RandomState(9)
+    data = mx.nd.array(rs.rand(1, 4, 8, 8).astype(np.float32))
+    rois = mx.nd.array(np.array([[0, 0, 0, 7, 7]], np.float32))
+    data.attach_grad()
+    with autograd.record():
+        out = mx.nd.contrib.PSROIPooling(data, rois, spatial_scale=1.0,
+                                         output_dim=1, pooled_size=2,
+                                         group_size=2)
+        s = out.sum()
+    s.backward()
+    g = data.grad.asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+    # grad sums to number of bins (each bin's mean contributes grad 1)
+    np.testing.assert_allclose(g.sum(), 4.0, rtol=1e-4)
+
+
+def test_deformable_psroi_gradient_flows_to_trans():
+    import mxnet_tpu.autograd as autograd
+    H = W = 12
+    img = np.tile(np.arange(W, dtype=np.float32), (H, 1))
+    data = mx.nd.array(img[None, None])
+    rois = mx.nd.array(np.array([[0, 2, 2, 9, 9]], np.float32))
+    trans = mx.nd.array(np.zeros((1, 2, 1, 1), np.float32))
+    trans.attach_grad()
+    with autograd.record():
+        out, _cnt = mx.nd.contrib.DeformablePSROIPooling(
+            data, rois, trans, spatial_scale=1.0, output_dim=1,
+            group_size=1, pooled_size=1, part_size=1, sample_per_part=4,
+            trans_std=0.1)
+        s = out.sum()
+    s.backward()
+    g = trans.grad.asnumpy()
+    # x-shift on a horizontal gradient image must have positive dL/dtx
+    assert g[0, 0, 0, 0] > 0
