@@ -54,9 +54,14 @@ class Heartbeat:
         return self._rank
 
     def beat(self) -> None:
+        # Atomic write (tmp + rename): a plain open("w") truncates first,
+        # so a concurrent dead_nodes() read could see an empty file, parse
+        # the stamp as 0 and report a live rank dead.
         path = _hb_path(self._dir, self._rank)
-        with open(path, "w") as f:
+        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        with open(tmp, "w") as f:
             f.write(str(time.time()))
+        os.replace(tmp, path)
 
     def start(self) -> "Heartbeat":
         self._stop.clear()  # allow restart after stop()
@@ -85,24 +90,38 @@ class Heartbeat:
         self.stop()
 
 
-def dead_nodes(dir_path: str, timeout: float = 60.0) -> List[int]:
-    """Ranks whose heartbeat is older than ``timeout`` seconds — the
-    ``GetDeadNodes`` analog (ref: kvstore_dist.h:121-126). A rank that
-    never wrote a heartbeat is not listed (it may not have started)."""
+def dead_nodes(dir_path: str, timeout: float = 60.0,
+               margin: float = 1.0) -> List[int]:
+    """Ranks whose heartbeat is older than ``timeout + margin`` seconds —
+    the ``GetDeadNodes`` analog (ref: kvstore_dist.h:121-126). A rank that
+    never wrote a heartbeat is not listed (it may not have started).
+
+    Stamps are wall-clock (the only clock comparable across hosts sharing
+    the heartbeat directory); ``margin`` absorbs NTP adjustments and
+    scheduler jitter so a loaded-but-live rank is not declared dead at the
+    boundary. The file mtime serves as a fallback stamp if the content is
+    unreadable."""
     out = []
     now = time.time()
     if not os.path.isdir(dir_path):
         return out
     for name in sorted(os.listdir(dir_path)):
-        if not name.startswith("heartbeat-"):
+        if not name.startswith("heartbeat-") or ".tmp." in name:
             continue
+        path = os.path.join(dir_path, name)
         try:
             rank = int(name.split("-", 1)[1])
-            with open(os.path.join(dir_path, name)) as f:
-                last = float(f.read().strip() or 0)
-        except (ValueError, OSError):
+        except ValueError:
             continue
-        if now - last > timeout:
+        try:
+            with open(path) as f:
+                last = float(f.read().strip())
+        except (ValueError, OSError):
+            try:
+                last = os.path.getmtime(path)
+            except OSError:
+                continue
+        if now - last > timeout + margin:
             out.append(rank)
     return sorted(out)
 

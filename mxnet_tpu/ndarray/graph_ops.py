@@ -55,7 +55,14 @@ def dgl_adjacency(data):
 def dgl_subgraph(graph, *vertex_arrays, return_mapping=False, **_):
     """Induced subgraph per vertex set; new edge ids are 1-based in
     row-major order, mapping output carries parent edge ids
-    (ref: dgl_graph.cc:1130 _contrib_dgl_subgraph)."""
+    (ref: dgl_graph.cc:1130 _contrib_dgl_subgraph).
+
+    Deviation note: the reference is internally inconsistent here — its
+    docstring example shows 1-based new edge ids while the executed kernel
+    GetSubgraph writes 0-based ids (dgl_graph.cc:1099-1100
+    ``sub_eids[i] = i``). We follow the documented 1-based convention; code
+    indexing edge-feature arrays by these ids must subtract 1 to match the
+    reference kernel's actual output."""
     vals, indices, indptr, _ = _csr_np(graph)
     outs: List = []
     mappings: List = []
@@ -94,6 +101,8 @@ def _neighbor_sample(graph, seed_arrays, num_hops, num_neighbor,
     check(max_num_vertices >= 1, "max_num_vertices must be positive")
     prob = None if probability is None else \
         probability.asnumpy().reshape(-1).astype(_np.float64)
+    from .. import random as _mxrandom
+    rng = _mxrandom.np_rng()  # mx.random.seed() governs sampling
     results = []
     for seeds_arr in seed_arrays:
         seeds = seeds_arr.asnumpy().astype(_np.int64).reshape(-1)
@@ -111,7 +120,7 @@ def _neighbor_sample(graph, seed_arrays, num_hops, num_neighbor,
                     continue
                 k = min(num_neighbor, deg)
                 if prob is None:
-                    pick = _np.random.choice(deg, size=k, replace=False)
+                    pick = rng.choice(deg, size=k, replace=False)
                 else:
                     p = prob[row_cols]
                     s = p.sum()
@@ -120,8 +129,7 @@ def _neighbor_sample(graph, seed_arrays, num_hops, num_neighbor,
                     # without replacement: can draw at most the number of
                     # nonzero-probability neighbors
                     k = min(k, int((p > 0).sum()))
-                    pick = _np.random.choice(deg, size=k, replace=False,
-                                             p=p / s)
+                    pick = rng.choice(deg, size=k, replace=False, p=p / s)
                 pick.sort()
                 chosen = [(int(row_cols[i]), row_vals[i]) for i in pick]
                 sampled_edges.setdefault(v, []).extend(chosen)

@@ -176,9 +176,9 @@ def _adamw_update(weight, grad, mean, var, rescale_grad_t, lr=0.01, beta1=0.9,
     m = beta1 * mean + (1 - beta1) * g
     v = beta2 * var + (1 - beta2) * jnp.square(g)
     w = weight - eta * (lr * m / (jnp.sqrt(v) + epsilon) + wd * weight)
-    # skip the update when the dynamic-loss-scale factor overflowed
-    # (ref: adamw.cc skip-on-nonfinite rescale_grad)
-    ok = jnp.isfinite(rescale_grad_t).all()
+    # skip the update when the dynamic-loss-scale factor overflowed or is 0
+    # (ref: adamw.cc:44 skips on !isfinite(scalef) || scalef == 0)
+    ok = jnp.isfinite(rescale_grad_t).all() & (rescale_grad_t != 0).all()
     return (jnp.where(ok, w, weight), jnp.where(ok, m, mean),
             jnp.where(ok, v, var))
 
@@ -316,10 +316,10 @@ def _mp_adamw_update(weight, grad, mean, var, weight32, rescale_grad_t,
     v = beta2 * var + (1 - beta2) * jnp.square(g)
     w32 = weight32 - eta * (lr * m / (jnp.sqrt(v) + epsilon)
                             + wd * weight32)
-    # dynamic loss scaling: a non-finite rescale_grad means the scaled
-    # loss overflowed — skip the whole update so training recovers
-    # (ref: adamw.cc MPUpdateInferShape/adamw skip-on-nonfinite)
-    ok = jnp.isfinite(rescale_grad_t).all()
+    # dynamic loss scaling: a non-finite or zero rescale_grad means the
+    # scaled loss overflowed — skip the whole update so training recovers
+    # (ref: adamw.cc:44 skips on !isfinite(scalef) || scalef == 0)
+    ok = jnp.isfinite(rescale_grad_t).all() & (rescale_grad_t != 0).all()
     return (jnp.where(ok, w32.astype(weight.dtype), weight),
             jnp.where(ok, m, mean), jnp.where(ok, v, var),
             jnp.where(ok, w32, weight32))

@@ -229,11 +229,12 @@ class SPMDTrainer:
             # NDArray inputs arrive committed to the default *context*
             # device (CPU); with parameters pinned to the accelerator
             # (_consolidate_params) mixed commitments would error — move
-            # batch inputs to the same device
+            # batch inputs to the same device. Raw numpy arrays have no
+            # commitment yet and are accepted as-is (jit coerces them).
             dev = jax.devices()[0]
-            if dev not in data.devices():
+            if isinstance(data, jax.Array) and dev not in data.devices():
                 data = jax.device_put(data, dev)
-            if dev not in label.devices():
+            if isinstance(label, jax.Array) and dev not in label.devices():
                 label = jax.device_put(label, dev)
         if self.mesh is not None:
             from .sharding import shard_batch

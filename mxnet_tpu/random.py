@@ -81,6 +81,23 @@ def next_key():
         return _jr().fold_in(_jr().fold_in(_key, 0xEA6E4), _counter)
 
 
+def np_rng() -> "_numpy.random.Generator":
+    """Numpy Generator seeded from the mx.random key stream.
+
+    Host-side samplers (e.g. the DGL neighbor samplers, which are numpy
+    graph algorithms) draw from this instead of the global numpy RNG so
+    that `mx.random.seed()` makes them reproducible like every
+    device-side random op."""
+    import numpy as _numpy
+    k = next_key()
+    try:
+        raw = _jr().key_data(k)  # typed keys (jax >= 0.4.16)
+    except Exception:
+        raw = k  # raw uint32 key arrays
+    seed_words = _numpy.asarray(raw).astype(_numpy.uint32).reshape(-1)
+    return _numpy.random.default_rng(_numpy.random.SeedSequence(seed_words))
+
+
 def _nd():
     from .ndarray import register as ndreg
     return ndreg.registry_namespace()

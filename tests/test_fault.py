@@ -26,6 +26,30 @@ def test_heartbeat_and_dead_nodes(tmp_path):
     assert fault.dead_nodes(d, timeout=1000.0) == []
 
 
+def test_heartbeat_read_write_race(tmp_path):
+    """dead_nodes must never see a half-written stamp: hammer beat() and
+    dead_nodes() concurrently — with non-atomic writes the reader catches
+    a truncated file, parses the stamp as 0 and reports the rank dead."""
+    d = str(tmp_path)
+    hb = fault.Heartbeat(d, rank=0, interval=10.0)
+    hb.beat()
+    stop = [False]
+    import threading
+
+    def writer():
+        while not stop[0]:
+            hb.beat()
+
+    t = threading.Thread(target=writer)
+    t.start()
+    try:
+        for _ in range(2000):
+            assert fault.dead_nodes(d, timeout=30.0) == []
+    finally:
+        stop[0] = True
+        t.join()
+
+
 def test_is_recovery_env(monkeypatch):
     monkeypatch.delenv("MXNET_IS_RECOVERY", raising=False)
     assert not fault.is_recovery()

@@ -251,6 +251,20 @@ def test_adamw_skips_update_on_overflowed_scale():
         nd.array(m), nd.array(v), nd.array(w),
         nd.array(np.array(np.nan, np.float32)), lr=0.1)
     np.testing.assert_array_equal(outs[3].asnumpy(), w)
+    # scale == 0 is the "overflow, skip step" sentinel from dynamic loss
+    # scalers and must also leave all state untouched (ref: adamw.cc:44)
+    nw, nm, nv = nd.adamw_update(
+        nd.array(w), nd.array(g), nd.array(m), nd.array(v),
+        nd.array(np.array(0.0, np.float32)), lr=0.1)
+    np.testing.assert_array_equal(nw.asnumpy(), w)
+    np.testing.assert_array_equal(nm.asnumpy(), m)
+    np.testing.assert_array_equal(nv.asnumpy(), v)
+    outs = nd.mp_adamw_update(
+        nd.array(w.astype(np.float16)), nd.array(g.astype(np.float16)),
+        nd.array(m), nd.array(v), nd.array(w),
+        nd.array(np.array(0.0, np.float32)), lr=0.1)
+    np.testing.assert_array_equal(outs[3].asnumpy(), w)
+    np.testing.assert_array_equal(outs[1].asnumpy(), m)
 
 
 def test_sparse_adagrad_wd_applied():
