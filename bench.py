@@ -2,11 +2,17 @@
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 Baseline: 298.51 img/s — MXNet ResNet-50 training, batch 32 fp32, 1x V100
-(BASELINE.md / docs/faq/perf.md:227-237). The whole train step (fwd+bwd+SGD
-momentum update) is one fused XLA program; SPMDTrainer pins parameters to
-the accelerator backend up front (CPU-committed args would silently run
-the jit on host). Compute dtype from MXTPU_BENCH_DTYPE (default bfloat16 —
-the MXU-native dtype; measured 1065 img/s at batch 256 vs 576 f32).
+(BASELINE.md / docs/faq/perf.md:227-237).
+
+TPU mapping decisions (the parts that matter for MFU):
+- NHWC layout (MXTPU_BENCH_LAYOUT): channels-last is the native TPU conv
+  layout — NCHW forces transposes around every convolution.
+- bf16 compute (MXTPU_BENCH_DTYPE): the MXU-native dtype; f32 master
+  weights (mixed precision) in SPMDTrainer.
+- Fused multi-step dispatch: SPMDTrainer.run_steps scans K training steps
+  inside ONE jitted program, so the ~100 ms per-execution relay/host
+  overhead is paid once per K steps and XLA overlaps the weight update of
+  step i with the forward of step i+1.
 """
 import json
 import os
@@ -49,24 +55,22 @@ def _init_backend(timeout_s=900):
     return False
 
 
-def run(batch=128, warmup=1, iters=None, dtype=None):
+def run(batch=256, k_steps=8, dtype=None, layout=None):
     import numpy as np
     import jax
     import jax.numpy as jnp
     import mxnet_tpu as mx
-    from mxnet_tpu.gluon import nn, loss as gloss
+    from mxnet_tpu.gluon import loss as gloss
     from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
     from mxnet_tpu.parallel import SPMDTrainer
-    from mxnet_tpu import nd
 
-    # bf16 default: the MXU-native dtype (the earlier "bf16 slow on the
-    # relay" measurement was an artifact of CPU-committed parameters
-    # pulling the jit onto the host backend — fixed in SPMDTrainer).
     if dtype is None:
         dtype = os.environ.get("MXTPU_BENCH_DTYPE", "bfloat16")
+    if layout is None:
+        layout = os.environ.get("MXTPU_BENCH_LAYOUT", "NHWC")
 
     mx.random.seed(0)
-    net = resnet50_v1()
+    net = resnet50_v1(layout=layout)
     net.initialize(mx.init.Xavier())
 
     trainer = SPMDTrainer(net, gloss.SoftmaxCrossEntropyLoss(),
@@ -76,38 +80,44 @@ def run(batch=128, warmup=1, iters=None, dtype=None):
                           dtype=jnp.bfloat16 if dtype == "bfloat16" else None)
 
     rs = np.random.RandomState(0)
-    data = jnp.asarray(rs.randn(batch, 3, 224, 224).astype(np.float32))
-    label = jnp.asarray(rs.randint(0, 1000, batch).astype(np.float32))
+    shape = ((k_steps, batch, 224, 224, 3) if layout == "NHWC"
+             else (k_steps, batch, 3, 224, 224))
+    # f32 input: it is resident on device once (the step casts to the
+    # compute dtype inside the program, fused into the first conv)
+    data = jnp.asarray(rs.rand(*shape).astype(np.float32))
+    label = jnp.asarray(
+        rs.randint(0, 1000, (k_steps, batch)).astype(np.float32))
 
-    def sync(loss):
+    def sync(x):
         # on the tunneled backend block_until_ready can return before the
-        # device finishes; fetching the scalar is the only true sync
-        return float(loss)
+        # device finishes; fetching a scalar is the only true sync
+        return float(np.asarray(x)[-1] if getattr(x, "ndim", 0) else x)
 
-    log(f"compiling train step (batch={batch}, {dtype}) ...")
+    log(f"compiling fused {k_steps}-step train program "
+        f"(batch={batch}, {dtype}, {layout}) ...")
     t0 = time.time()
-    loss_val = sync(trainer.step(data, label))
-    log(f"first step (compile) took {time.time() - t0:.1f}s, "
+    loss_val = sync(trainer.run_steps(data, label))
+    log(f"first dispatch (compile) took {time.time() - t0:.1f}s, "
         f"loss={loss_val:.3f}")
     t0 = time.time()
-    for _ in range(warmup):
-        sync(trainer.step(data, label))
-    step_est = (time.time() - t0) / max(warmup, 1)
-    if iters is None:
-        # enough steps for a stable number, capped at ~180s of measurement
-        # (floor 2 keeps multi-minute steps from blowing the time budget)
-        iters = max(2 if step_est > 120 else 3,
-                    min(10, int(180.0 / max(step_est, 1e-3))))
-    log(f"~{step_est:.2f}s/step -> {iters} timed iters")
+    sync(trainer.run_steps(data, label))
+    est = (time.time() - t0) / k_steps
+    # enough dispatches for a stable number within ~120s of measurement
+    reps = max(1, min(5, int(120.0 / max(est * k_steps, 1e-3))))
+    log(f"~{est * 1000:.1f} ms/step -> {reps} timed dispatches "
+        f"of {k_steps} steps")
 
     t0 = time.perf_counter()
-    for _ in range(iters - 1):
-        trainer.step(data, label)
-    sync(trainer.step(data, label))
+    for _ in range(reps - 1):
+        trainer.run_steps(data, label)
+    sync(trainer.run_steps(data, label))
     dt = time.perf_counter() - t0
-    imgs_per_sec = batch * iters / dt
-    log(f"{imgs_per_sec:.1f} img/s over {iters} steps "
-        f"({dt / iters * 1000:.1f} ms/step)")
+    imgs_per_sec = batch * k_steps * reps / dt
+    ms_step = dt / (k_steps * reps) * 1000
+    # MFU accounting: ResNet-50 train ~= 3x fwd FLOPs ~= 12.3 GFLOP/img
+    tflops = imgs_per_sec * 12.3e9 / 1e12
+    log(f"{imgs_per_sec:.1f} img/s ({ms_step:.1f} ms/step, "
+        f"~{tflops:.1f} TFLOP/s sustained)")
     return imgs_per_sec
 
 
@@ -123,27 +133,30 @@ def main():
     if not _init_backend():
         os._exit(0)
     _enable_compile_cache()
-    # batch 512 first: the ~100ms per-execution relay overhead amortizes
-    # with batch size (measured 1406 img/s @512, 1065 @256, 690 @128,
-    # bf16); smaller fallbacks cover tighter-memory chips
-    batches = [int(b) for b in
-               os.environ.get("MXTPU_BENCH_BATCHES", "512,256,128").split(",")]
+    # batch x k_steps configs, largest first; smaller fallbacks cover
+    # tighter-memory chips. k_steps amortizes dispatch overhead; batch
+    # amortizes per-step fixed cost.
+    configs = os.environ.get("MXTPU_BENCH_CONFIGS",
+                             "256x8,128x8,256x4,128x2")
     last_err = None
-    for batch in batches:
+    for cfg in configs.split(","):
+        batch, k = (int(v) for v in cfg.split("x"))
         try:
-            value = run(batch=batch)
+            value = run(batch=batch, k_steps=k)
             print(json.dumps({
                 "metric": "resnet50_train_imgs_per_sec",
                 "value": round(value, 2),
                 "unit": "img/s",
                 "vs_baseline": round(value / BASELINE_IMGS_PER_SEC, 3),
                 "dtype": os.environ.get("MXTPU_BENCH_DTYPE", "bfloat16"),
+                "layout": os.environ.get("MXTPU_BENCH_LAYOUT", "NHWC"),
                 "batch": batch,
+                "fused_steps": k,
             }))
             return
-        except Exception as e:  # OOM or backend issue: try smaller batch
+        except Exception as e:  # OOM or backend issue: try smaller config
             last_err = e
-            log(f"batch {batch} failed: {e}")
+            log(f"config {cfg} failed: {e}")
     print(json.dumps({
         "metric": "resnet50_train_imgs_per_sec",
         "value": 0.0,

@@ -62,13 +62,18 @@ class _CachedOpGrad:
         import jax
         entry = self.entry
         if entry.vjp_jitted is None:
+            from .util import apply_mirror
             fn = self.op._make_pure_fn(self.training, entry)
+            mirror = self.op.mirror
 
             def run(params, key, ins, cots):
                 def outputs_only(params_, *ins_):
                     outs, _state = fn(params_, key, *ins_)
                     return outs
 
+                # mirror/remat: store only the inputs across fwd->bwd and
+                # recompute activations inside the backward program
+                outputs_only = apply_mirror(outputs_only, mirror)
                 _, vjp = jax.vjp(outputs_only, params, *ins)
                 return vjp(tuple(cots))
 
@@ -89,10 +94,14 @@ class CachedOp:
 
     def __init__(self, block, static_alloc: bool = False,
                  static_shape: bool = False, inline_limit: int = 2,
-                 flags: Sequence = ()):
+                 flags: Sequence = (), mirror: Optional[bool] = None):
         # static_alloc/static_shape are implied by XLA compilation; kept for
-        # API compat (ref: CachedOpConfig, cached_op.h:32-53).
+        # API compat (ref: CachedOpConfig, cached_op.h:32-53). ``mirror``
+        # (default: the MXNET_BACKWARD_DO_MIRROR env flag) rematerializes
+        # activations in backward instead of storing them (ref: the
+        # mirror_fun path of src/nnvm/gradient.cc:271).
         self.block = block
+        self.mirror = mirror
         self._cache: Dict[Tuple, _CacheEntry] = {}
         self._param_objs: Optional[List] = None
 

@@ -196,11 +196,15 @@ class HybridBlock(Block):
         super().__init__(prefix=prefix, params=params)
         self._active = False
         self._cached_op = None
+        self._cached_op_kwargs = {}
 
     def hybridize(self, active: bool = True, static_alloc: bool = False,
-                  static_shape: bool = False, **kwargs) -> None:
+                  static_shape: bool = False, mirror=None, **kwargs) -> None:
         self._active = active
         self._cached_op = None
+        # mirror: rematerialize activations in backward (None = follow the
+        # MXNET_BACKWARD_DO_MIRROR env flag)
+        self._cached_op_kwargs = {"mirror": mirror}
         super().hybridize(active, static_alloc=static_alloc,
                           static_shape=static_shape, **kwargs)
 
@@ -272,7 +276,8 @@ class HybridBlock(Block):
                     self._collect_deferred_check()
                 except DeferredInitializationError:
                     self._imperative_call(*args)
-                self._cached_op = CachedOp(self)
+                self._cached_op = CachedOp(
+                    self, **getattr(self, "_cached_op_kwargs", {}))
             return self._cached_op(*args)
         return self._imperative_call(*args)
 

@@ -25,12 +25,16 @@ __all__ = ["SPMDTrainer"]
 class SPMDTrainer:
     def __init__(self, block, loss_fn, mesh=None, optimizer: str = "sgd",
                  optimizer_params: Optional[dict] = None,
-                 plan=None, dtype=None):
+                 plan=None, dtype=None, remat: Optional[bool] = None):
         import jax
         self.block = block
         self.loss_fn = loss_fn
         self.mesh = mesh
         self.plan = plan
+        # remat=True (or MXNET_BACKWARD_DO_MIRROR) recomputes activations
+        # in backward instead of storing them — the memory-for-compute
+        # lever for big models / long sequences
+        self.remat = remat
         opt_params = dict(optimizer_params or {})
         self.lr = float(opt_params.get("learning_rate", 0.01))
         self.momentum = float(opt_params.get("momentum", 0.0))
@@ -49,6 +53,7 @@ class SPMDTrainer:
         self._step_fns: Dict[Tuple, Any] = {}
         self._opt_state = None
         self._t = 0
+        self._base_key = None
 
     def _collect(self, sample_data=None):
         """Resolve deferred-init params (probe forward) then place on mesh."""
@@ -56,8 +61,14 @@ class SPMDTrainer:
         if any(p._data is None for _, p in items) and sample_data is not None:
             from ..ndarray.ndarray import from_jax
             from .. import autograd
+            import jax.numpy as jnp
+            # the probe runs eagerly against float32 parameters — cast a
+            # low-precision sample up so conv dtype checks don't trip
+            probe = sample_data
+            if hasattr(probe, "dtype") and probe.dtype != jnp.float32:
+                probe = probe.astype(jnp.float32)
             with autograd.pause():
-                self.block._imperative_call(from_jax(sample_data))
+                self.block._imperative_call(from_jax(probe))
             items = sorted(self.block.collect_params().items())
         self._param_objs = [p for _, p in items]
         self._trainable = [p for p in self._param_objs if p.grad_req != "null"]
@@ -122,7 +133,10 @@ class SPMDTrainer:
                          tuple(jnp.zeros_like(a) for a in xs)))
         return zeros2(*train_arrays)
 
-    def _make_step(self, treedef_key):
+    def _build_step_fn(self):
+        """The raw (un-jitted) single-step function
+        (train, aux, opt, key, t, data, label) ->
+        (loss, new_train, new_aux, new_opt)."""
         import jax
         import jax.numpy as jnp
         from ..ndarray.ndarray import NDArray, from_jax
@@ -138,6 +152,11 @@ class SPMDTrainer:
         compute_dtype = self._compute_dtype
 
         def step(train_arrays, aux_arrays, opt_state, key, t, data, label):
+            # per-step stream derived on-device from the trainer's base key:
+            # fold_in(base, t) makes step() and run_steps() draw IDENTICAL
+            # dropout masks for the same step index t
+            step_key = jax.random.fold_in(key, t)
+
             def loss_of(params):
                 originals = []
                 for p, a in zip(trainable, params):
@@ -152,7 +171,7 @@ class SPMDTrainer:
                 for p, a in zip(aux, aux_arrays):
                     aux_orig.append(p._data._data)
                     p._data._data = a
-                _random.push_trace_key(key)
+                _random.push_trace_key(step_key)
                 prev_r = autograd.set_recording(False)
                 prev_t = autograd.set_training(True)
                 try:
@@ -175,8 +194,10 @@ class SPMDTrainer:
                     for p, o in zip(aux, aux_orig):
                         p._data._data = o
 
+            from ..util import apply_mirror
             (loss, new_aux), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(tuple(train_arrays))
+                apply_mirror(loss_of, self.remat),
+                has_aux=True)(tuple(train_arrays))
 
             new_params = []
             if optimizer == "sgd":
@@ -210,12 +231,46 @@ class SPMDTrainer:
 
             return loss, tuple(new_params), new_aux, new_opt
 
-        donate = (0, 1, 2)
-        return jax.jit(step, donate_argnums=donate)
+        return step
+
+    def _make_step(self, treedef_key):
+        import jax
+        return jax.jit(self._build_step_fn(), donate_argnums=(0, 1, 2))
+
+    def _make_multi_step(self, treedef_key):
+        """K steps fused into ONE XLA program via lax.scan.
+
+        One dispatch per K steps amortizes the per-execution host/relay
+        overhead (~100 ms on a tunneled TPU — 27% of a batch-512 ResNet-50
+        step) to noise, and lets XLA pipeline the weight-update of step i
+        with the forward of step i+1. Each microstep folds the trainer's
+        base key with its step index — the same stream step() uses, so the
+        trajectories (dropout masks included) are identical."""
+        import jax
+        from jax import lax
+        step = self._build_step_fn()
+
+        def multi(train_arrays, aux_arrays, opt_state, key, t0, datas,
+                  labels):
+            def body(carry, xs):
+                train, aux, opt, t = carry
+                d, l = xs
+                loss, ntrain, naux, nopt = step(train, aux, opt, key, t,
+                                                d, l)
+                return (ntrain, naux, nopt, t + 1), loss
+
+            (train, aux, opt, _), losses = lax.scan(
+                body, (train_arrays, aux_arrays, opt_state, t0),
+                (datas, labels))
+            return losses, train, aux, opt
+
+        return jax.jit(multi, donate_argnums=(0, 1, 2))
 
     # ------------------------------------------------------------------
-    def step(self, data, label):
-        """Run one training step; returns the (device) scalar loss."""
+    def _prepare(self, data, label, batch_dim=0):
+        """Shared step preamble: unwrap NDArrays, resolve deferred params,
+        align device commitments, shard the batch, gather param/opt arrays
+        and the base RNG key. Returns (data, label, train, aux, key)."""
         import jax
         import jax.numpy as jnp
         from .. import random as _random
@@ -224,7 +279,7 @@ class SPMDTrainer:
         data = data._data if isinstance(data, NDArray) else data
         label = label._data if isinstance(label, NDArray) else label
         if self._param_objs is None:
-            self._collect(sample_data=data)
+            self._collect(sample_data=data if batch_dim == 0 else data[0])
         if self.mesh is None:
             # NDArray inputs arrive committed to the default *context*
             # device (CPU); with parameters pinned to the accelerator
@@ -236,37 +291,75 @@ class SPMDTrainer:
                 data = jax.device_put(data, dev)
             if isinstance(label, jax.Array) and dev not in label.devices():
                 label = jax.device_put(label, dev)
-        if self.mesh is not None:
+        else:
             from .sharding import shard_batch
-            data = shard_batch(data, self.mesh)
-            label = shard_batch(label, self.mesh)
+            data = shard_batch(data, self.mesh, batch_dim=batch_dim)
+            label = shard_batch(label, self.mesh, batch_dim=batch_dim)
 
         train_arrays = tuple(p._data._data for p in self._trainable)
         aux_arrays = tuple(p._data._data for p in self._aux)
         if self._opt_state is None:
             self._opt_state = self._init_opt_state(train_arrays)
-        self._t += 1
+        if self._base_key is None:
+            # one base key per trainer; every step folds it with its step
+            # index t on device. Fetched to host because the eager RNG
+            # stream lives on the default *context* (CPU) — a
+            # CPU-committed argument would drag the whole jit onto the
+            # host backend (see _consolidate_params).
+            key = _random.next_key()
+            if isinstance(key, jax.Array):
+                import numpy as _np
+                key = jnp.asarray(_np.asarray(key))
+            self._base_key = key
+        return data, label, train_arrays, aux_arrays, self._base_key
 
-        sig = (tuple((a.shape, str(a.dtype)) for a in (data, label)),)
-        fn = self._step_fns.get(sig)
-        if fn is None:
-            fn = self._step_fns[sig] = self._make_step(sig)
-        import jax
-        import jax.numpy as jnp
-        # the eager RNG stream lives on the default *context* (CPU); a
-        # CPU-committed argument would drag the whole jit onto the host
-        # backend (see _consolidate_params) — fetch to host so it enters
-        # uncommitted
-        key = _random.next_key()
-        if isinstance(key, jax.Array):
-            import numpy as _np
-            key = jnp.asarray(_np.asarray(key))
-        loss, new_params, new_aux, new_opt = fn(
-            train_arrays, aux_arrays, self._opt_state, key,
-            jnp.asarray(self._t, jnp.int32), data, label)
+    def _finish(self, new_params, new_aux, new_opt):
         for p, a in zip(self._trainable, new_params):
             p._data._rebind(a)
         for p, a in zip(self._aux, new_aux):
             p._data._rebind(a)
         self._opt_state = new_opt
+
+    def step(self, data, label):
+        """Run one training step; returns the (device) scalar loss."""
+        import jax.numpy as jnp
+        data, label, train_arrays, aux_arrays, key = self._prepare(
+            data, label)
+        self._t += 1
+        sig = (tuple((a.shape, str(a.dtype)) for a in (data, label)),)
+        fn = self._step_fns.get(sig)
+        if fn is None:
+            fn = self._step_fns[sig] = self._make_step(sig)
+        loss, new_params, new_aux, new_opt = fn(
+            train_arrays, aux_arrays, self._opt_state, key,
+            jnp.asarray(self._t, jnp.int32), data, label)
+        self._finish(new_params, new_aux, new_opt)
         return loss
+
+    def run_steps(self, data, label):
+        """Run ``K = data.shape[0]`` training steps in ONE fused XLA
+        dispatch (lax.scan over microbatches).
+
+        ``data``/``label`` carry a leading steps axis: ``(K, batch, ...)``.
+        Returns the ``(K,)`` per-step loss array (still on device — only
+        fetch it when you need the values). Produces the same trajectory
+        as K calls to :meth:`step` (per-step RNG keys are fold_in(base, t)
+        in both paths, so even dropout masks match). Use it when
+        per-dispatch host overhead matters (tunneled or remote TPUs) or to
+        let XLA overlap the optimizer update of step i with the forward
+        of step i+1."""
+        import jax.numpy as jnp
+        data, label, train_arrays, aux_arrays, key = self._prepare(
+            data, label, batch_dim=1)
+        k_steps = data.shape[0]
+        sig = ("multi", tuple((a.shape, str(a.dtype))
+                              for a in (data, label)))
+        fn = self._step_fns.get(sig)
+        if fn is None:
+            fn = self._step_fns[sig] = self._make_multi_step(sig)
+        t0 = jnp.asarray(self._t + 1, jnp.int32)
+        losses, new_params, new_aux, new_opt = fn(
+            train_arrays, aux_arrays, self._opt_state, key, t0, data, label)
+        self._t += int(k_steps)
+        self._finish(new_params, new_aux, new_opt)
+        return losses

@@ -257,27 +257,36 @@ class Executor:
         grad_names = tuple(self._grad_names)
 
         def fwd_bwd(arg_arrays, aux_arrays, key, out_grads):
+            import jax.numpy as jnp
             arg_map = dict(zip(arg_names, arg_arrays))
             aux_map = dict(zip(aux_names, aux_arrays))
             diff_args = tuple(arg_map[n] for n in grad_names)
 
-            collect: Dict[str, Any] = {}
-            aux_out: Dict[str, Any] = {}
-
             def f(diff):
+                # aux updates travel in the return value (not a python
+                # side-channel) so the whole function can be wrapped in
+                # jax.checkpoint without leaking tracers
+                collect: Dict[str, Any] = {}
                 _random.push_trace_key(key)
                 try:
                     m = dict(arg_map)
                     m.update(zip(grad_names, diff))
                     outs = _walk(symbol, m, aux_map, True,
                                  collect_aux=collect)
-                    return tuple(outs)
+                    new_aux = tuple(collect.get(n, aux_map[n])
+                                    for n in aux_names)
+                    return tuple(outs), new_aux
                 finally:
                     _random.pop_trace_key()
 
-            outs, vjp = jax.vjp(f, diff_args)
-            grads = vjp(tuple(out_grads))[0]
-            new_aux = tuple(collect.get(n, aux_map[n]) for n in aux_names)
+            # MXNET_BACKWARD_DO_MIRROR: rematerialize activations in the
+            # backward half of the fused program instead of storing them
+            # (ref: src/nnvm/gradient.cc:271 mirror_fun)
+            from ..util import apply_mirror
+            f = apply_mirror(f)
+            (outs, new_aux), vjp = jax.vjp(f, diff_args)
+            aux_cots = tuple(jnp.zeros_like(a) for a in new_aux)
+            grads = vjp((tuple(out_grads), aux_cots))[0]
             return outs, grads, new_aux
 
         return jax.jit(fwd_bwd)

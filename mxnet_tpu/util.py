@@ -43,6 +43,44 @@ def wrap_ctx_to_device_func(func):
     return func
 
 
+def mirror_enabled(explicit=None) -> bool:
+    """Whether backward rematerialization is on: an explicit argument wins,
+    else the MXNET_BACKWARD_DO_MIRROR env flag (ref: the mirror_fun path of
+    src/nnvm/gradient.cc:271 — the reference's only memory-for-compute
+    lever; on TPU this maps to jax.checkpoint)."""
+    if explicit is not None:
+        return bool(explicit)
+    from .base import env
+    return bool(env.get("MXNET_BACKWARD_DO_MIRROR"))
+
+
+def apply_mirror(fn, explicit=None):
+    """Wrap a traceable function in jax.checkpoint when mirroring is on.
+
+    The backward pass then stores only the function's inputs (plus
+    whatever the MXNET_BACKWARD_MIRROR_POLICY keeps) and recomputes
+    intermediate activations — XLA fuses the recompute into the backward
+    program. Policies:
+      full (default) - save nothing, recompute everything (max savings)
+      dots           - save matmul/einsum results, recompute elementwise
+                       (closest to the reference's mirror of cheap ops)
+    """
+    if not mirror_enabled(explicit):
+        return fn
+    import jax
+    from .base import env
+    policy_name = env.get("MXNET_BACKWARD_MIRROR_POLICY") or "full"
+    policy = None
+    if policy_name == "dots":
+        policy = jax.checkpoint_policies.checkpoint_dots
+    elif policy_name not in ("full", ""):
+        from .base import MXNetError
+        raise MXNetError(
+            f"unknown MXNET_BACKWARD_MIRROR_POLICY {policy_name!r} "
+            "(expected 'full' or 'dots')")
+    return jax.checkpoint(fn, policy=policy)
+
+
 def getenv(name):
     return os.environ.get(name)
 

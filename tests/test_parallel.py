@@ -93,6 +93,104 @@ def test_spmd_trainer_data_parallel():
     assert losses[-1] < losses[0] * 0.2, f"{losses[0]} -> {losses[-1]}"
 
 
+def test_spmd_run_steps_matches_sequential():
+    """The fused K-step scan driver (one XLA dispatch) must be bit-for-bit
+    the same training trajectory as K individual step() calls."""
+    from mxnet_tpu.gluon import nn, loss as gloss
+    from mxnet_tpu import autograd
+
+    def make():
+        mx.random.seed(0)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+        net.initialize(mx.init.Xavier())
+        with autograd.pause():
+            net(nd.ones((2, 8)))
+        return net
+
+    rs = np.random.RandomState(1)
+    K, B = 4, 8
+    datas = rs.randn(K, B, 8).astype(np.float32)
+    labels = rs.randint(0, 4, (K, B)).astype(np.float32)
+    loss = gloss.SoftmaxCrossEntropyLoss()
+    opt = {"learning_rate": 0.1, "momentum": 0.9}
+
+    net_a = make()
+    tr_a = par.SPMDTrainer(net_a, loss, optimizer="sgd",
+                           optimizer_params=opt)
+    la = [float(np.asarray(tr_a.step(datas[i], labels[i])))
+          for i in range(K)]
+    net_b = make()
+    tr_b = par.SPMDTrainer(net_b, loss, optimizer="sgd",
+                           optimizer_params=opt)
+    lb = np.asarray(tr_b.run_steps(datas, labels))
+    np.testing.assert_allclose(la, lb, rtol=1e-5)
+    for (_, pa), (_, pb) in zip(sorted(net_a.collect_params().items()),
+                                sorted(net_b.collect_params().items())):
+        np.testing.assert_allclose(pa.data().asnumpy(),
+                                   pb.data().asnumpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_spmd_run_steps_matches_sequential_with_dropout():
+    """Stochastic layers too: both paths fold the trainer's base key with
+    the step index, so dropout masks — hence trajectories — match."""
+    from mxnet_tpu.gluon import nn, loss as gloss
+    from mxnet_tpu import autograd
+
+    def make():
+        mx.random.seed(3)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="relu"), nn.Dropout(0.5),
+                nn.Dense(4))
+        net.initialize(mx.init.Xavier())
+        with autograd.pause():
+            net(nd.ones((2, 8)))
+        return net
+
+    rs = np.random.RandomState(4)
+    K, B = 3, 8
+    datas = rs.randn(K, B, 8).astype(np.float32)
+    labels = rs.randint(0, 4, (K, B)).astype(np.float32)
+    loss = gloss.SoftmaxCrossEntropyLoss()
+
+    net_a = make()
+    mx.random.seed(11)
+    tr_a = par.SPMDTrainer(net_a, loss, optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1})
+    la = [float(np.asarray(tr_a.step(datas[i], labels[i])))
+          for i in range(K)]
+    net_b = make()
+    mx.random.seed(11)
+    tr_b = par.SPMDTrainer(net_b, loss, optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1})
+    lb = np.asarray(tr_b.run_steps(datas, labels))
+    np.testing.assert_allclose(la, lb, rtol=1e-5)
+
+
+def test_spmd_run_steps_on_mesh():
+    """run_steps shards the batch axis (axis 1) over dp and trains."""
+    from mxnet_tpu.gluon import nn, loss as gloss
+    mesh = _mesh(dp=8)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    trainer = par.SPMDTrainer(net, gloss.SoftmaxCrossEntropyLoss(),
+                              mesh=mesh, optimizer="sgd",
+                              optimizer_params={"learning_rate": 0.5,
+                                                "momentum": 0.9})
+    rs = np.random.RandomState(0)
+    centers = rs.randn(10, 16).astype(np.float32) * 2
+    K, B = 6, 64
+    labels = rs.randint(0, 10, (K, B))
+    data = centers[labels] + 0.1 * rs.randn(K, B, 16).astype(np.float32)
+    losses = np.asarray(trainer.run_steps(
+        nd.array(data), nd.array(labels.astype(np.float32))))
+    losses2 = np.asarray(trainer.run_steps(
+        nd.array(data), nd.array(labels.astype(np.float32))))
+    assert losses2[-1] < losses[0], f"{losses[0]} -> {losses2[-1]}"
+
+
 def test_transformer_sharded_train_step():
     import jax
     import jax.numpy as jnp
