@@ -177,11 +177,46 @@ def _shuffle(data, _key, **_):
     return _jr().permutation(_key, data, axis=0)
 
 
-@register("sample_unique_zipfian", creation=True, rng=True,
+@register("sample_unique_zipfian", creation=True, rng=True, num_outputs=2,
           differentiable=False)
 def _sample_unique_zipfian(_key, range_max=1, shape=(1,), **_):
-    # log-uniform (Zipfian) candidate sampler (ref: unique_sample_op.cc)
-    jr, jnp = _jr(), _jnp()
-    u = jr.uniform(_key, tuple(shape))
-    out = jnp.exp(u * _np.log(range_max)).astype(_np.int64) - 1
-    return jnp.clip(out, 0, range_max - 1)
+    """Unique log-uniform (Zipfian) candidate sampler.
+
+    Returns (samples, num_tries) like the reference
+    (src/operator/random/unique_sample_op.cc SampleUniqueZipfian):
+    rejection-samples until the last axis holds distinct classes, counting
+    trials. The rejection loop is data-dependent, so it runs host-side via
+    pure_callback — same placement as the reference's CPU-only kernel."""
+    import jax
+    jnp = _jnp()
+    from ..base import check
+    shape = tuple(int(s) for s in shape)
+    range_max = int(range_max)
+    batch, n = shape[:-1], shape[-1]
+    check(n <= range_max,
+          f"cannot draw {n} unique samples from range_max={range_max}")
+
+    def host(key_data):
+        seed = _np.asarray(key_data).astype(_np.uint32).reshape(-1)
+        rng = _np.random.default_rng(_np.random.SeedSequence(seed.tolist()))
+        out = _np.empty(shape, _np.int32)
+        tries = _np.empty(batch, _np.int32)
+        log_rm = _np.log(range_max + 1)
+        for idx in _np.ndindex(*batch):
+            seen, vals, t = set(), [], 0
+            while len(vals) < n:
+                v = int(_np.exp(rng.random() * log_rm)) - 1
+                v = min(max(v, 0), range_max - 1)
+                t += 1
+                if v not in seen:
+                    seen.add(v)
+                    vals.append(v)
+            out[idx] = vals
+            tries[idx] = t
+        return out, tries
+
+    key_data = jax.random.key_data(_key) \
+        if hasattr(jax.random, "key_data") else _key
+    return jax.pure_callback(
+        host, (jax.ShapeDtypeStruct(shape, jnp.int32),
+               jax.ShapeDtypeStruct(batch, jnp.int32)), key_data)

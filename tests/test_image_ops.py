@@ -51,10 +51,12 @@ def test_flips():
         mx.nd.image.flip_left_right(x).asnumpy(), x.asnumpy()[:, ::-1, :])
     np.testing.assert_array_equal(
         mx.nd.image.flip_top_bottom(x).asnumpy(), x.asnumpy()[::-1, :, :])
-    # random flip returns either identity or flipped
+    # random flips return either identity or flipped
     mx.random.seed(7)
     y = mx.nd.image.random_flip_left_right(x).asnumpy()
     assert (y == x.asnumpy()).all() or (y == x.asnumpy()[:, ::-1, :]).all()
+    y = mx.nd.image.random_flip_top_bottom(x).asnumpy()
+    assert (y == x.asnumpy()).all() or (y == x.asnumpy()[::-1, :, :]).all()
 
 
 def test_random_brightness_bounds():

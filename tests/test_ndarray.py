@@ -77,6 +77,8 @@ def test_indexing_get():
 
 
 def test_indexing_set():
+    """__setitem__ paths: scalar fill (_index_assign_scalar) and array
+    assignment (_index_assign) — the registry ops behind nd setitem."""
     a = nd.zeros((3, 3))
     a[1] = 5
     assert a.asnumpy()[1].tolist() == [5, 5, 5]

@@ -1,0 +1,113 @@
+"""Operator test-coverage gate + report.
+
+Computes, for every canonical op in the registry:
+  - "sweep":     has a case in tests/op_cases.py (fwd cross-check + numeric
+                 gradient via test_op_sweep.py)
+  - "dedicated": listed in COVERED_ELSEWHERE and the named test file really
+                 mentions it (claim verified by grep)
+  - "untested":  neither
+
+Writes OP_COVERAGE.json at the repo root and enforces the >=80% bar
+(VERDICT r1 item 2). Aliases resolve to their canonical op.
+"""
+import json
+import os
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops import registry as reg
+
+from op_cases import CASES, COVERED_ELSEWHERE
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _canonical_ops():
+    """name -> OpDef, one entry per distinct OpDef (first name wins)."""
+    seen = {}
+    for n in reg.list_ops():
+        d = reg.get_op(n)
+        if id(d) not in seen:
+            seen[id(d)] = n
+    return sorted(seen.values())
+
+
+def test_case_table_names_are_registered():
+    for name in list(CASES) + list(COVERED_ELSEWHERE):
+        reg.get_op(name)  # raises MXNetError on a stale table entry
+
+
+def _stems(op):
+    """Tokens that count as 'this op is exercised here': the op name, its
+    aliases, and family stems (prefix/suffix-stripped, camel->snake)."""
+    import re
+    names = [n for n in reg.list_ops() if reg.get_op(n) is reg.get_op(op)]
+    out = set()
+    for n in names:
+        out.add(n)
+        s = n
+        for pre in ("_contrib_", "_image_", "_random_", "_sample_",
+                    "_linalg_", "_"):
+            if s.startswith(pre):
+                s = s[len(pre):]
+        for suf in ("_update", "_v2"):
+            if s.endswith(suf):
+                s = s[: -len(suf)]
+        out.add(s)
+        out.add(re.sub(r"(?<!^)(?=[A-Z])", "_", s).lower())  # RoiAlign->roi_align
+        out.add(s.lower())
+    return {t for t in out if len(t) >= 3}
+
+
+def test_covered_elsewhere_claims_are_true():
+    missing = []
+    for op, path in sorted(COVERED_ELSEWHERE.items()):
+        full = os.path.join(ROOT, path)
+        if not os.path.exists(full):
+            missing.append(f"{op}: {path} does not exist")
+            continue
+        with open(full) as f:
+            src = f.read().lower()
+        if not any(t.lower() in src for t in _stems(op)):
+            missing.append(f"{op}: not mentioned in {path}")
+    assert not missing, "\n".join(missing)
+
+
+def test_coverage_report_and_bar():
+    ops = _canonical_ops()
+    sweep_names = set()
+    for n in CASES:
+        d = reg.get_op(n)
+        sweep_names.update(a for a in reg.list_ops()
+                           if reg.get_op(a) is d)
+    elsewhere_names = set()
+    for n in COVERED_ELSEWHERE:
+        d = reg.get_op(n)
+        elsewhere_names.update(a for a in reg.list_ops()
+                               if reg.get_op(a) is d)
+
+    rows = {}
+    for n in ops:
+        if n in sweep_names:
+            rows[n] = "sweep"
+        elif n in elsewhere_names:
+            rows[n] = "dedicated"
+        else:
+            rows[n] = "untested"
+    tested = sum(1 for v in rows.values() if v != "untested")
+    pct = 100.0 * tested / len(rows)
+    report = {
+        "canonical_ops": len(rows),
+        "registry_names": len(reg.list_ops()),
+        "tested": tested,
+        "coverage_pct": round(pct, 1),
+        "sweep": sum(1 for v in rows.values() if v == "sweep"),
+        "dedicated": sum(1 for v in rows.values() if v == "dedicated"),
+        "untested": sorted(n for n, v in rows.items() if v == "untested"),
+    }
+    with open(os.path.join(ROOT, "OP_COVERAGE.json"), "w") as f:
+        json.dump(report, f, indent=1)
+    assert pct >= 80.0, (
+        f"operator test coverage {pct:.1f}% < 80% — untested: "
+        f"{report['untested']}")

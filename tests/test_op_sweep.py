@@ -1,0 +1,147 @@
+"""Registry-driven operator correctness sweep.
+
+For every entry of tests/op_cases.py:CASES:
+  - forward: run the op eagerly and cross-check against the numpy ref
+  - gradient: autograd vs central finite differences (differentiable ops)
+  - dtype sweep: f16/bf16/f64 runs stay close to the f32 result
+  - edge shapes: size-0 and single-element inputs execute and keep shape
+    semantics (elementwise-classed cases)
+
+Model: tests/python/unittest/test_operator.py (the reference gates every
+operator on check_numeric_gradient + numpy forward parity,
+python/mxnet/test_utils.py:801).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ops import registry as reg
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+from op_cases import CASES, Case
+
+
+def _flat_cases():
+    out = []
+    for name, cases in sorted(CASES.items()):
+        for i, c in enumerate(cases):
+            out.append(pytest.param(name, c, id=f"{name}-{i}"))
+    return out
+
+
+ALL_CASES = _flat_cases()
+
+
+def _run(name, case):
+    nds = tuple(nd.array(a) for a in case.inputs)
+    out = nd.imperative_invoke(name, nds, dict(case.params))
+    return out
+
+
+def _first(out, idx=0):
+    if isinstance(out, (tuple, list)):
+        return out[idx]
+    return out
+
+
+@pytest.mark.parametrize("name,case", ALL_CASES)
+def test_forward(name, case):
+    opdef = reg.get_op(name)  # raises if the table lists an unknown op
+    out = _first(_run(name, case), case.out_index)
+    got = out.asnumpy()
+    assert np.isfinite(got.astype(np.float64)).all() or \
+        not np.issubdtype(got.dtype, np.floating) or "nan" in name.lower()
+    if case.ref is not None:
+        want = case.ref(*case.inputs, **case.params)
+        assert_almost_equal(got, np.asarray(want), rtol=case.rtol,
+                            atol=case.atol, names=(name, "numpy"))
+
+
+def _gradable(name, case):
+    if case.grad is False:
+        return False
+    opdef = reg.get_op(name)
+    if not opdef.differentiable:
+        return False
+    return all(np.issubdtype(a.dtype, np.floating) for a in case.inputs) \
+        and len(case.inputs) > 0
+
+
+GRAD_CASES = [p for p in ALL_CASES if _gradable(*p.values)]
+
+
+@pytest.mark.parametrize("name,case",
+                         [pytest.param(*p.values, id=p.id)
+                          for p in GRAD_CASES])
+def test_gradient(name, case):
+    if case.grad_only is None:
+        check_numeric_gradient(name, list(case.inputs), dict(case.params),
+                               rtol=case.grad_rtol, atol=case.grad_atol)
+        return
+    # differentiate only the data inputs; index-like inputs (lengths,
+    # positions) are closed over, not perturbed
+    fixed = {i: nd.array(a) for i, a in enumerate(case.inputs)
+             if i not in case.grad_only}
+    order = list(case.grad_only)
+
+    def fn(*diff_nds):
+        full = []
+        it = iter(diff_nds)
+        for i in range(len(case.inputs)):
+            full.append(fixed[i] if i in fixed else next(it))
+        return nd.imperative_invoke(name, tuple(full), dict(case.params))
+
+    check_numeric_gradient(fn, [case.inputs[i] for i in order],
+                           rtol=case.grad_rtol, atol=case.grad_atol)
+
+
+DTYPE_CASES = [p for p in ALL_CASES if p.values[1].dtype_sweep]
+
+
+@pytest.mark.parametrize("name,case",
+                         [pytest.param(*p.values, id=p.id)
+                          for p in DTYPE_CASES])
+def test_dtype_sweep(name, case):
+    """The op must run in half/bfloat16/double and agree with f32 at the
+    appropriate precision (the reference's GPU-vs-CPU dtype matrix,
+    test_utils.py:1224 check_consistency)."""
+    import jax.numpy as jnp
+    base = _first(_run(name, case), case.out_index).asnumpy()
+    sweeps = [("float64", 1e-4, 1e-5), ("float16", 2e-2, 2e-2),
+              ("bfloat16", 8e-2, 8e-2)]
+    for dt, rtol, atol in sweeps:
+        ins = tuple(a.astype(dt) if np.issubdtype(a.dtype, np.floating)
+                    else a for a in case.inputs)
+        nds = tuple(nd.array(a, dtype=a.dtype) for a in ins)
+        out = _first(nd.imperative_invoke(name, nds, dict(case.params)),
+                     case.out_index)
+        got = np.asarray(out.asnumpy(), dtype=np.float64)
+        assert_almost_equal(got, base.astype(np.float64), rtol=rtol,
+                            atol=atol, names=(f"{name}[{dt}]", "f32"))
+
+
+EDGE_CASES = [p for p in ALL_CASES if p.values[1].edge]
+
+
+@pytest.mark.parametrize("name,case",
+                         [pytest.param(*p.values, id=p.id)
+                          for p in EDGE_CASES])
+def test_edge_shapes(name, case):
+    """Size-0 and 1-element inputs must execute with numpy-consistent
+    result shapes (the reference's zero-size/edge-shape sweeps).
+
+    Shapes keep the case's rank so axis-valued params stay valid."""
+    rank = max(a.ndim for a in case.inputs)
+    shapes = [(0,) + (2,) * (rank - 1), (1,) * rank,
+              (2,) * (rank - 1) + (0,)]
+    for shape in shapes:
+        ins = tuple(np.ones(shape, a.dtype) for a in case.inputs)
+        nds = tuple(nd.array(a) for a in ins)
+        out = _first(nd.imperative_invoke(name, nds, dict(case.params)),
+                     case.out_index)
+        got = out.asnumpy()
+        if case.ref is not None:
+            want = np.asarray(case.ref(*ins, **case.params))
+            assert got.shape == want.shape, \
+                f"{name}{shape}: {got.shape} != {want.shape}"

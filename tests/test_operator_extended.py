@@ -64,6 +64,71 @@ def test_shuffle_is_permutation():
     assert sorted(y.tolist()) == list(range(32))
 
 
+def test_negative_binomial_moments():
+    # _random_negative_binomial: mean = k(1-p)/p
+    mx.random.seed(5)
+    x = nd.op._random_negative_binomial(k=4, p=0.5,
+                                        shape=(20000,)).asnumpy()
+    assert abs(x.mean() - 4.0) < 0.3
+    # _random_generalized_negative_binomial: mean = mu
+    y = nd.op._random_generalized_negative_binomial(
+        mu=3.0, alpha=0.2, shape=(20000,)).asnumpy()
+    assert abs(y.mean() - 3.0) < 0.3
+    # var = mu + alpha*mu^2
+    assert abs(y.var() - (3.0 + 0.2 * 9.0)) < 0.6
+
+
+def test_randint_range_and_dtype():
+    mx.random.seed(6)
+    x = mx.random.randint(3, 11, shape=(5000,)).asnumpy()
+    assert x.dtype == np.int32
+    assert x.min() >= 3 and x.max() <= 10
+    # every value in range appears
+    assert set(np.unique(x)) == set(range(3, 11))
+
+
+def test_sample_ops_parameter_broadcast():
+    """_sample_* draw per-row distributions from parameter arrays
+    (ref: src/operator/random/multisample_op.cc)."""
+    mx.random.seed(8)
+    lo = nd.array(np.array([0.0, 10.0], np.float32))
+    hi = nd.array(np.array([1.0, 20.0], np.float32))
+    u = nd.op._sample_uniform(lo, hi, shape=(4000,)).asnumpy()
+    assert u.shape == (2, 4000)
+    assert u[0].max() <= 1.0 and u[1].min() >= 10.0
+    mu = nd.array(np.array([0.0, 5.0], np.float32))
+    sg = nd.array(np.array([1.0, 0.5], np.float32))
+    n = nd.op._sample_normal(mu, sg, shape=(4000,)).asnumpy()
+    assert abs(n[0].mean()) < 0.1 and abs(n[1].mean() - 5.0) < 0.1
+    al = nd.array(np.array([2.0, 5.0], np.float32))
+    be = nd.array(np.array([1.0, 2.0], np.float32))
+    g = nd.op._sample_gamma(al, be, shape=(4000,)).asnumpy()
+    assert abs(g[0].mean() - 2.0) < 0.25 and abs(g[1].mean() - 10.0) < 1.0
+    lam = nd.array(np.array([1.0, 4.0], np.float32))
+    e = nd.op._sample_exponential(lam, shape=(4000,)).asnumpy()
+    assert abs(e[0].mean() - 1.0) < 0.1 and abs(e[1].mean() - 0.25) < 0.05
+    p = nd.op._sample_poisson(lam, shape=(4000,)).asnumpy()
+    assert abs(p[0].mean() - 1.0) < 0.1 and abs(p[1].mean() - 4.0) < 0.2
+
+
+def test_sample_unique_zipfian():
+    mx.random.seed(9)
+    samples, num_tries = nd.op.sample_unique_zipfian(range_max=1000,
+                                                     shape=(1, 64))
+    vals = samples.asnumpy()
+    flat = vals.reshape(-1)
+    assert len(set(flat.tolist())) == flat.size, "samples must be unique"
+    assert flat.min() >= 0 and flat.max() < 1000
+    assert int(num_tries.asnumpy()[0]) >= 64
+    # log-uniform: small classes much more frequent — P(class < 31) ~ 0.5
+    big = nd.op.sample_unique_zipfian(range_max=100000, shape=(1, 500))[0]
+    # log-uniform puts ~half the raw mass below sqrt(range_max)=316, but
+    # uniqueness rejection thins the head — expect well above uniform
+    # (uniform would give 316/100000 ~ 0.3%)
+    frac_small = (big.asnumpy() < 316).mean()
+    assert frac_small > 0.15
+
+
 # ---------------------------------------------------------------------------
 # ordering ops (ref: test_operator.py test_order)
 # ---------------------------------------------------------------------------

@@ -276,3 +276,73 @@ def test_sparse_adagrad_wd_applied():
     # effective grad = wd*w = 0.5 -> hist 0.25, w -= 0.1*0.5/sqrt(0.25)
     np.testing.assert_allclose(nh.asnumpy(), 0.25 * np.ones(4), rtol=1e-6)
     np.testing.assert_allclose(nw.asnumpy(), w - 0.1, rtol=1e-5)
+
+
+def test_multi_sgd_update_matches_per_tensor():
+    """multi_sgd_update / multi_sgd_mom_update fuse a whole parameter
+    group (ref: optimizer_op.cc:654); results must equal per-tensor
+    sgd_update / sgd_mom_update."""
+    w1, g1 = _setup(10, (4,))
+    w2, g2 = _setup(11, (3, 2))
+    lrs, wds = (0.1, 0.2), (0.01, 0.0)
+    outs = nd.multi_sgd_update(nd.array(w1), nd.array(g1),
+                               nd.array(w2), nd.array(g2),
+                               lrs=lrs, wds=wds, num_weights=2)
+    for out, w, g, lr, wd in zip(outs, (w1, w2), (g1, g2), lrs, wds):
+        ref = nd.sgd_update(nd.array(w), nd.array(g), lr=lr, wd=wd)
+        assert_almost_equal(out.asnumpy(), ref.asnumpy(), rtol=1e-6)
+
+    m1 = np.zeros_like(w1)
+    m2 = np.zeros_like(w2)
+    outs = nd.multi_sgd_mom_update(
+        nd.array(w1), nd.array(g1), nd.array(m1),
+        nd.array(w2), nd.array(g2), nd.array(m2),
+        lrs=lrs, wds=wds, momentum=0.9, num_weights=2)
+    # output layout: all new weights first, then all new momenta
+    for i, (w, g, m, lr, wd) in enumerate(
+            zip((w1, w2), (g1, g2), (m1, m2), lrs, wds)):
+        rw, rm = nd.sgd_mom_update(nd.array(w), nd.array(g), nd.array(m),
+                                   lr=lr, wd=wd, momentum=0.9)
+        assert_almost_equal(outs[i].asnumpy(), rw.asnumpy(), rtol=1e-6)
+        assert_almost_equal(outs[2 + i].asnumpy(), rm.asnumpy(),
+                            rtol=1e-6)
+
+
+def test_multi_mp_sgd_update_master_weights():
+    """multi_mp_sgd(_mom)_update keep f32 master weights for f16 params."""
+    w1, g1 = _setup(12, (4,))
+    outs = nd.multi_mp_sgd_update(
+        nd.array(w1.astype(np.float16)), nd.array(g1.astype(np.float16)),
+        nd.array(w1), lrs=(0.1,), wds=(0.0,), num_weights=1)
+    out = outs[0] if isinstance(outs, (list, tuple)) else outs
+    ref = w1 - 0.1 * g1.astype(np.float16).astype(np.float32)
+    assert out.dtype == np.float16
+    assert_almost_equal(out.asnumpy().astype(np.float32), ref, rtol=1e-2,
+                        atol=1e-3)
+    m1 = np.zeros_like(w1)
+    outs = nd.multi_mp_sgd_mom_update(
+        nd.array(w1.astype(np.float16)), nd.array(g1.astype(np.float16)),
+        nd.array(m1), nd.array(w1),
+        lrs=(0.1,), wds=(0.0,), momentum=0.9, num_weights=1)
+    assert outs[0].dtype == np.float16
+
+
+def test_rmspropalex_centered_rule():
+    """rmspropalex_update: centered RMSProp (ref: optimizer_op-inl.h
+    RMSPropAlex) — n (second moment), g_avg (first moment), delta."""
+    w, g = _setup(13)
+    lr, g1c, g2c, eps = 0.05, 0.95, 0.9, 1e-8
+    n0 = np.zeros_like(w)
+    ga0 = np.zeros_like(w)
+    d0 = np.zeros_like(w)
+    nw, nn, nga, ndelta = nd.rmspropalex_update(
+        nd.array(w), nd.array(g), nd.array(n0), nd.array(ga0),
+        nd.array(d0), lr=lr, gamma1=g1c, gamma2=g2c, epsilon=eps)
+    rn = (1 - g1c) * g * g + g1c * n0
+    rga = (1 - g1c) * g + g1c * ga0
+    rdelta = g2c * d0 - lr * g / np.sqrt(rn - rga * rga + eps)
+    rw = w + rdelta
+    assert_almost_equal(nn.asnumpy(), rn, rtol=1e-5)
+    assert_almost_equal(nga.asnumpy(), rga, rtol=1e-5)
+    assert_almost_equal(ndelta.asnumpy(), rdelta, rtol=1e-4, atol=1e-6)
+    assert_almost_equal(nw.asnumpy(), rw, rtol=1e-4, atol=1e-6)

@@ -1,0 +1,139 @@
+"""Per-op int8 quantization tests (model: the reference's
+tests/python/quantization/test_quantization.py op-level checks).
+
+Covers: _contrib_quantize, _contrib_quantize_v2, _contrib_dequantize,
+_contrib_requantize, _contrib_quantized_conv,
+_contrib_quantized_fully_connected, _contrib_quantized_pooling,
+_contrib_quantized_concat, _contrib_quantized_flatten, _quantized_fc_static.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+RS = np.random.RandomState(11)
+
+
+def _q(name, inputs, params=None):
+    out = nd.imperative_invoke(name, tuple(nd.array(a) for a in inputs),
+                               dict(params or {}))
+    return out if isinstance(out, tuple) else (out,)
+
+
+def test_quantize_dequantize_roundtrip_int8():
+    x = RS.uniform(-3, 3, (4, 5)).astype(np.float32)
+    mn = np.array(-3.0, np.float32)
+    mx_ = np.array(3.0, np.float32)
+    q, qmin, qmax = _q("_contrib_quantize", (x, mn, mx_),
+                       {"out_type": "int8"})
+    assert q.dtype == np.int8
+    back, = _q("_contrib_dequantize",
+               (q.asnumpy(), qmin.asnumpy(), qmax.asnumpy()))
+    # int8 over [-3,3]: one step = 3/127 ~ 0.024
+    assert_almost_equal(back.asnumpy(), x, rtol=0.05, atol=0.05)
+
+
+def test_quantize_v2_calibrated_ranges():
+    x = RS.uniform(-1, 1, (3, 4)).astype(np.float32)
+    q, qmin, qmax = _q("_contrib_quantize_v2", (x,),
+                       {"min_calib_range": -1.0, "max_calib_range": 1.0,
+                        "out_type": "int8"})
+    assert q.dtype == np.int8
+    assert float(qmin.asnumpy()) == pytest.approx(-1.0)
+    assert float(qmax.asnumpy()) == pytest.approx(1.0)
+    back, = _q("_contrib_dequantize",
+               (q.asnumpy(), qmin.asnumpy(), qmax.asnumpy()))
+    assert_almost_equal(back.asnumpy(), x, rtol=0.05, atol=0.02)
+
+
+def test_requantize_int32_to_int8():
+    # int32 accumulators with a real range -> int8
+    acc = RS.randint(-20000, 20000, (3, 4)).astype(np.int32)
+    mn = np.array(-20000 / 2147483647.0 * 1000, np.float32)
+    mx_ = np.array(20000 / 2147483647.0 * 1000, np.float32)
+    q, qmin, qmax = _q("_contrib_requantize", (acc, mn, mx_))
+    assert q.dtype == np.int8
+    assert float(qmax.asnumpy()) > 0
+
+
+def _quant_sym(x, lo, hi):
+    scale = 127.0 / max(abs(lo), abs(hi))
+    return np.clip(np.round(x * scale), -127, 127).astype(np.int8)
+
+
+def test_quantized_fully_connected_matches_f32():
+    x = RS.uniform(-1, 1, (2, 6)).astype(np.float32)
+    w = RS.uniform(-1, 1, (3, 6)).astype(np.float32)
+    b = RS.uniform(-1, 1, (3,)).astype(np.float32)
+    qx, qw = _quant_sym(x, -1, 1), _quant_sym(w, -1, 1)
+    qb = _quant_sym(b, -1, 1)
+    one = np.array(1.0, np.float32)
+    out, omin, omax = _q(
+        "_contrib_quantized_fully_connected",
+        (qx, qw, qb, -one, one, -one, one),
+        {"num_hidden": 3, "b_min": -1.0, "b_max": 1.0})
+    # the op returns the dequantized f32 accumulator plus its range
+    want = x @ w.T + b
+    assert_almost_equal(out.asnumpy(), want, rtol=0.1, atol=0.1)
+    assert float(omax.asnumpy()) >= np.abs(out.asnumpy()).max() - 1e-5
+
+
+def test_quantized_conv_matches_f32():
+    x = RS.uniform(-1, 1, (1, 2, 5, 5)).astype(np.float32)
+    w = RS.uniform(-1, 1, (3, 2, 3, 3)).astype(np.float32)
+    qx, qw = _quant_sym(x, -1, 1), _quant_sym(w, -1, 1)
+    one = np.array(1.0, np.float32)
+    out, omin, omax = _q(
+        "_contrib_quantized_conv",
+        (qx, qw, np.zeros(3, np.int8), -one, one, -one, one),
+        {"kernel": (3, 3), "num_filter": 3, "no_bias": True})
+    want = nd.imperative_invoke(
+        "Convolution", (nd.array(x), nd.array(w)),
+        {"kernel": (3, 3), "num_filter": 3, "no_bias": True}).asnumpy()
+    assert_almost_equal(out.asnumpy(), want, rtol=0.15, atol=0.15)
+
+
+def test_quantized_pooling_preserves_range():
+    x = RS.uniform(-1, 1, (1, 2, 4, 4)).astype(np.float32)
+    qx = _quant_sym(x, -1, 1)
+    one = np.array(1.0, np.float32)
+    out, omin, omax = _q("_contrib_quantized_pooling",
+                         (qx, -one, one),
+                         {"kernel": (2, 2), "stride": (2, 2),
+                          "pool_type": "max"})
+    assert out.dtype == np.int8
+    assert float(omin.asnumpy()) == pytest.approx(-1.0)
+    # int8 max-pool == pool of the int8 values
+    want = qx.reshape(1, 2, 2, 2, 2, 2).max(axis=(3, 5))
+    np.testing.assert_array_equal(out.asnumpy(), want)
+
+
+def test_quantized_flatten_and_concat():
+    x = RS.uniform(-1, 1, (2, 2, 3)).astype(np.float32)
+    qx = _quant_sym(x, -1, 1)
+    one = np.array(1.0, np.float32)
+    out, omin, omax = _q("_contrib_quantized_flatten", (qx, -one, one))
+    np.testing.assert_array_equal(out.asnumpy(), qx.reshape(2, 6))
+    # inputs are num_args datas, then num_args mins, then num_args maxs
+    a = _quant_sym(RS.uniform(-1, 1, (2, 3)).astype(np.float32), -1, 1)
+    b = _quant_sym(RS.uniform(-1, 1, (2, 4)).astype(np.float32), -1, 1)
+    out, cmin, cmax = _q("_contrib_quantized_concat",
+                         (a, b, -one, -one, one, one),
+                         {"dim": 1, "num_args": 2})
+    assert out.shape == (2, 7)
+    np.testing.assert_array_equal(out.asnumpy(),
+                                  np.concatenate([a, b], axis=1))
+
+
+def test_quantized_fc_static_dequantized_output():
+    x = RS.uniform(-1, 1, (2, 6)).astype(np.float32)
+    w = RS.uniform(-1, 1, (3, 6)).astype(np.float32)
+    qx, qw = _quant_sym(x, -1, 1), _quant_sym(w, -1, 1)
+    one = np.array(1.0, np.float32)
+    out, = _q("_quantized_fc_static", (qx, -one, one, qw),
+              {"w_min": -1.0, "w_max": 1.0, "num_hidden": 3,
+               "no_bias": True})
+    assert out.dtype == np.float32
+    assert_almost_equal(out.asnumpy(), x @ w.T, rtol=0.1, atol=0.1)
