@@ -41,7 +41,14 @@ def main():
     ap.add_argument("--batch-size", type=int, default=256)
     ap.add_argument("--epochs", type=int, default=3)
     ap.add_argument("--lr", type=float, default=0.5)
+    ap.add_argument("--shard-table", action="store_true",
+                    help="shard the weight table across local devices "
+                    "(lowers MXNET_KVSTORE_BIGARRAY_BOUND so this table "
+                    "qualifies; ref: kvstore_dist_server.h:331)")
     args = ap.parse_args()
+
+    if args.shard_table:
+        os.environ["MXNET_KVSTORE_BIGARRAY_BOUND"] = "4096"
 
     rows, labels = synthetic_libsvm(num_features=args.num_features)
     kv = kv_mod.create(args.kvstore)
@@ -50,6 +57,10 @@ def main():
     # weight lives in the store; workers row_sparse_pull only touched rows
     weight = nd.zeros((args.num_features, 1))
     kv.init("weight", weight)
+    if args.shard_table:
+        shards = kv._store["weight"]._data.addressable_shards
+        print(f"weight table sharded over {len(shards)} devices "
+              f"({shards[0].data.shape[0]} rows each)")
     # server-side additive update (the kvstore_dist_server ApplyUpdates
     # analog): pushed values are deltas merged into the stored weight
     kv.set_updater(lambda key, delta, stored:

@@ -17,6 +17,43 @@ from .base import MXNetError, check
 
 __all__ = ["GradientCompression"]
 
+_WIRE_FNS: Dict[str, object] = {}
+
+
+def _pack_fn():
+    """Module-level jitted packer (stable identity -> jit caches per
+    shape instead of retracing every push)."""
+    fn = _WIRE_FNS.get("pack")
+    if fn is None:
+        import jax
+        import jax.numpy as jnp
+
+        def _pack(qf):
+            codes = jnp.where(qf < 0, jnp.uint8(2),
+                              qf.astype(jnp.uint8))  # {-1,0,1} -> {2,0,1}
+            c = codes.reshape(-1, 4)
+            return (c[:, 0] | (c[:, 1] << 2) | (c[:, 2] << 4)
+                    | (c[:, 3] << 6)).astype(jnp.uint8)
+
+        fn = _WIRE_FNS["pack"] = jax.jit(_pack)
+    return fn
+
+
+def _unpack_fn():
+    fn = _WIRE_FNS.get("unpack")
+    if fn is None:
+        import jax
+        import jax.numpy as jnp
+
+        def _unpack(p):
+            b = p[:, None] >> jnp.arange(0, 8, 2,
+                                         dtype=jnp.uint8)[None, :]
+            codes = (b & 3).astype(jnp.int8).reshape(-1)
+            return jnp.where(codes == 2, jnp.int8(-1), codes)
+
+        fn = _WIRE_FNS["unpack"] = jax.jit(_unpack)
+    return fn
+
 
 class GradientCompression:
     def __init__(self, type: str = "2bit", threshold: float = 0.5):
@@ -67,3 +104,33 @@ class GradientCompression:
     def roundtrip(self, key, grad):
         q = self.compress(key, grad)
         return self.decompress(q, grad.dtype)
+
+    # -- wire format ----------------------------------------------------
+    # 2-bit codes packed 4-per-byte: the payload that actually crosses
+    # the slow (DCN) hop is n/4 uint8 bytes vs 4n f32 bytes = 16x smaller
+    # (ref: gradient_compression.h:37-134 quantize_2bit wire layout).
+
+    def pack(self, q):
+        """int8 {-1,0,1} -> packed uint8 (4 codes/byte, zero-padded)."""
+        import jax.numpy as jnp
+        flat = q.reshape(-1)
+        pad = (-flat.size) % 4
+        if pad:
+            flat = jnp.concatenate(
+                [flat, jnp.zeros((pad,), flat.dtype)])
+        return _pack_fn()(flat)
+
+    def unpack(self, packed, nelem):
+        """packed uint8 -> int8 codes {-1,0,1} of length nelem."""
+        return _unpack_fn()(packed)[:int(nelem)]
+
+    def compress_packed(self, key, grad):
+        """Compress with error feedback and pack for the wire.
+        Returns (packed_uint8, nelem)."""
+        q = self.compress(key, grad)
+        return self.pack(q), q.size
+
+    def decode_packed(self, packed, nelem, shape, dtype):
+        """Wire payload -> dequantized gradient."""
+        q = self.unpack(packed, nelem)
+        return self.decompress(q, dtype).reshape(shape)

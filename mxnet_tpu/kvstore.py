@@ -28,6 +28,7 @@ in place, exactly like kvstore_dist_server.h:346 ApplyUpdates.
 """
 from __future__ import annotations
 
+import functools
 import pickle
 from typing import Any, Dict, List, Optional, Sequence, Union
 
@@ -85,8 +86,44 @@ class KVStoreBase:
             if k in self._store:
                 continue
             v = vals[0]
-            self._store[k] = v.copy() if isinstance(v, _nd.NDArray) \
-                else _nd.array(v)
+            v = v.copy() if isinstance(v, _nd.NDArray) else _nd.array(v)
+            self._store[k] = self._maybe_shard(v)
+
+    def _maybe_shard(self, v: _nd.NDArray) -> _nd.NDArray:
+        """Row-shard big tables across this process's local devices (ref:
+        the dist server's big-array sharding, kvstore_dist_server.h:331
+        DataHandleRowSparse; threshold MXNET_KVSTORE_BIGARRAY_BOUND).
+
+        The stored value becomes ONE jax.Array with a per-device shard of
+        rows — row_sparse_pull then compiles to a cross-shard gather and
+        the updater keeps the result sharded. Local devices only: the
+        host-local array cannot be device_put onto other processes'
+        devices; cross-process reduction stays in _reduce_global."""
+        from .base import env
+        bound = int(env.get("MXNET_KVSTORE_BIGARRAY_BOUND"))
+        n = len(_local_shard_mesh().devices.ravel()) \
+            if _local_shard_mesh() is not None else 1
+        if (v.size < bound or n <= 1 or not v.shape
+                or v.shape[0] % n != 0):
+            return v
+        from .parallel.sharded_embedding import shard_table
+        arr = shard_table(v._data, _local_shard_mesh(), axis="shard")
+        return _nd.NDArray(arr, ctx=v._ctx)
+
+    def _match_store_sharding(self, merged: _nd.NDArray,
+                              stored: _nd.NDArray) -> _nd.NDArray:
+        """Align a pushed value's placement with a sharded stored table so
+        the updater's arithmetic has consistent shardings."""
+        import jax
+        s = getattr(stored._data, "sharding", None)
+        if s is None or getattr(merged._data, "sharding", None) == s:
+            return merged
+        from jax.sharding import NamedSharding
+        if isinstance(s, NamedSharding) and \
+                merged.shape == stored.shape:
+            return _nd.NDArray(jax.device_put(merged._data, s),
+                               ctx=merged._ctx)
+        return merged
 
     def _merge(self, vals: List[_nd.NDArray]) -> _nd.NDArray:
         """Sum a list of per-device values with one fused program
@@ -102,17 +139,26 @@ class KVStoreBase:
         for k, vals in _group(key, value):
             check(k in self._store, f"kvstore key {k} not initialized")
             merged = self._merge(vals)
-            if self._compressor is not None:
-                # compress->decompress round trip with error feedback
-                # (ref: push-path quantization, gradient_compression.cc)
+            if self._compressor is not None and not self._wire_compresses():
+                # no wire hop here (local store): compress->decompress
+                # round trip with error feedback reproduces the numeric
+                # effect (ref: push-path quantization,
+                # gradient_compression.cc)
                 merged = _nd.NDArray(
                     self._compressor.roundtrip(k, merged._data),
                     ctx=merged._ctx)
-            merged = self._reduce_global(merged)
+            merged = self._reduce_global(merged, key=k)
+            merged = self._match_store_sharding(merged, self._store[k])
             if self._updater is not None:
                 self._updater(_key_int(k), merged, self._store[k])
             else:
                 self._store[k]._rebind(merged._data)
+
+    def _wire_compresses(self) -> bool:
+        """True when _reduce_global itself moves the compressed payload
+        (dist stores); the local roundtrip is skipped to avoid quantizing
+        twice."""
+        return False
 
     def pull(self, key, out=None, priority: int = 0,
              ignore_sparse: bool = True) -> None:
@@ -140,9 +186,22 @@ class KVStoreBase:
             row_ids = [row_ids] * len(out)
         src = self._store[key if not isinstance(key, (list, tuple)) else key[0]]
         from .ndarray import sparse as _sp
+        sharding = getattr(src._data, "sharding", None)
+        from jax.sharding import NamedSharding
+        sharded = isinstance(sharding, NamedSharding) and \
+            sharding.spec and sharding.spec[0] is not None
         for o, rid in zip(out, row_ids):
-            rows = _nd.imperative_invoke("take", (src, rid),
-                                         {"axis": 0, "mode": "clip"})
+            if sharded:
+                # sharded table: the compiled psum-of-masked-gather
+                # (cached per mesh/shape in sharded_embedding) assembles
+                # the requested rows without moving the table
+                from .parallel.sharded_embedding import sharded_lookup
+                rows = _nd.NDArray(
+                    sharded_lookup(src._data, rid._data, sharding.mesh,
+                                   axis=sharding.spec[0]), ctx=src._ctx)
+            else:
+                rows = _nd.imperative_invoke("take", (src, rid),
+                                             {"axis": 0, "mode": "clip"})
             if isinstance(o, _sp.RowSparseNDArray):
                 o._update(rows._data, rid._data)
             else:
@@ -186,7 +245,8 @@ class KVStoreBase:
             self._updater.set_states(f.read())
 
     # -- distributed hooks ---------------------------------------------
-    def _reduce_global(self, merged: _nd.NDArray) -> _nd.NDArray:
+    def _reduce_global(self, merged: _nd.NDArray,
+                       key=None) -> _nd.NDArray:
         return merged
 
     def barrier(self) -> None:
@@ -258,9 +318,31 @@ class KVStoreDistTPU(KVStoreBase):
     def num_workers(self):
         return self._nproc
 
-    def _reduce_global(self, merged: _nd.NDArray) -> _nd.NDArray:
+    def _wire_compresses(self) -> bool:
+        return self._mesh is not None and self._compressor is not None
+
+    def _reduce_global(self, merged: _nd.NDArray,
+                       key=None) -> _nd.NDArray:
         if self._mesh is None:
             return merged
+        if self._compressor is not None:
+            # REAL wire compression (ref: gradient_compression.h:37-134):
+            # quantize 2-bit with local error feedback, move ONLY the
+            # packed payload (n/4 uint8 bytes vs 4n f32 = 16x less over
+            # DCN), then decode + sum every worker's contribution.
+            from .parallel.collectives import cross_process_allgather
+            import numpy as _np
+            packed, nelem = self._compressor.compress_packed(
+                key, merged._data)
+            gathered = cross_process_allgather(
+                _np.asarray(packed), self._mesh, axis="hosts")
+            self._last_wire_bytes = gathered.nbytes // len(gathered)
+            total = None
+            for row in gathered:
+                dec = self._compressor.decode_packed(
+                    row, nelem, merged.shape, merged._data.dtype)
+                total = dec if total is None else total + dec
+            return _nd.NDArray(total, ctx=merged._ctx)
         from .parallel.collectives import cross_process_allreduce
         out = cross_process_allreduce(merged.asnumpy(), self._mesh,
                                       axis="hosts")
@@ -269,6 +351,19 @@ class KVStoreDistTPU(KVStoreBase):
     def barrier(self) -> None:
         from .parallel.collectives import barrier as _barrier
         _barrier(self._mesh)
+
+
+@functools.lru_cache(maxsize=None)
+def _local_shard_mesh():
+    """1-D mesh over this process's addressable devices, for big-table
+    row sharding. None when there is only one local device."""
+    import jax
+    import numpy as _np
+    from jax.sharding import Mesh
+    devs = jax.local_devices()
+    if len(devs) <= 1:
+        return None
+    return Mesh(_np.asarray(devs), ("shard",))
 
 
 KVStore = KVStoreBase  # surface alias (ref: python/mxnet/kvstore.py KVStore)

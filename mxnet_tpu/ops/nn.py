@@ -268,13 +268,14 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
         mean = moving_mean.astype(jnp.float32)
         var = moving_var.astype(jnp.float32)
     inv = _lax().rsqrt(var + eps)
-    # fold the normalization into one per-channel affine and apply it in
-    # the data dtype: out = x * scale + bias
-    scale = inv * g
-    bias = beta.astype(jnp.float32) - mean * scale
-    out = data * scale.astype(data.dtype).reshape(bshape) \
-        + bias.astype(data.dtype).reshape(bshape)
-    return out, mean, var
+    # normalize in f32 (the converts fuse into the surrounding elementwise
+    # kernel, so HBM traffic stays at read-bf16/write-bf16; subtracting
+    # the mean BEFORE scaling keeps precision for large-mean data, unlike
+    # a folded x*scale+bias affine)
+    out = (data.astype(jnp.float32) - mean.reshape(bshape)) \
+        * (inv * g).reshape(bshape) \
+        + beta.astype(jnp.float32).reshape(bshape)
+    return out.astype(data.dtype), mean, var
 
 
 @register("LayerNorm", aliases=("layer_norm",), num_outputs=3)

@@ -111,6 +111,41 @@ def cross_process_allreduce(local, mesh, axis: str = "hosts",
     return np.asarray(out)
 
 
+def cross_process_allgather(local, mesh, axis: str = "hosts"):
+    """AllGather of per-PROCESS local values over a one-device-per-process
+    mesh: every worker receives the (nproc, ...) stack. This is the wire
+    hop for compressed-gradient push — the payload that crosses DCN is
+    whatever dtype/size `local` has (e.g. packed 2-bit codes)."""
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    nproc = mesh.devices.size
+    check(nproc == jax.process_count(),
+          f"cross_process_allgather needs a one-device-per-process mesh; "
+          f"got {nproc} devices for {jax.process_count()} processes")
+    local = np.asarray(local)[None]
+    gshape = (nproc,) + local.shape[1:]
+    garr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P(axis)), local, gshape)
+    out = _cross_process_gather_fn(mesh, axis, local.ndim - 1)(garr)
+    return np.asarray(out)
+
+
+@functools.lru_cache(maxsize=None)
+def _cross_process_gather_fn(mesh, axis, ndim):
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    def f(v):
+        return jax.lax.all_gather(v[0], axis)
+
+    return jax.jit(shard_map(f, mesh=mesh, in_specs=(P(axis),),
+                             out_specs=P(*([None] * (ndim + 1))),
+                             check_vma=False))
+
+
 def device_allreduce(arrays, mesh, axis: str = "dp", op: str = "sum"):
     """Fused allreduce of a list of arrays (one compiled program for the
     whole gradient bucket, like the reference's grouped NCCL launches,

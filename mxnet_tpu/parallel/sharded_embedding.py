@@ -1,0 +1,165 @@
+"""Mesh-sharded embedding tables (sparse/large-embedding parallelism).
+
+Reference analog: the dist kvstore's server-side row_sparse path —
+`DataHandleRowSparse` (src/kvstore/kvstore_dist_server.h:331) shards big
+tables across server processes and workers pull only active rows
+(example/sparse/linear_classification/train.py:32-34).
+
+TPU-native redesign: the table is ONE jax.Array row-sharded over a mesh
+axis (NamedSharding P(axis)); there are no server processes. Lookups and
+sparse updates run inside the compiled program:
+
+- `lookup` uses a shard_map psum-of-masked-gather: each device gathers the
+  requested rows it owns locally and contributes zeros elsewhere; one psum
+  over the shard axis assembles the result. Only `ids` (replicated ints)
+  and the (batch, dim) result cross the interconnect — never the table.
+- gradients: jax differentiates the shard_map, so the backward is the
+  mirrored masked scatter-add, again local per shard + no table motion.
+- `sgd_update_sparse` applies a row-sparse SGD step fully shard-locally.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+from ..base import MXNetError, check
+
+__all__ = ["ShardedEmbedding", "shard_table", "sharded_lookup",
+           "sharded_scatter_add"]
+
+
+def shard_table(table, mesh, axis: str = "mp"):
+    """Place a (rows, dim) table on the mesh, rows sharded over `axis`."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    check(axis in mesh.axis_names, f"mesh has no axis {axis!r}")
+    return jax.device_put(table, NamedSharding(mesh, P(axis)))
+
+
+def _axis_size(mesh, axis):
+    return dict(zip(mesh.axis_names, mesh.devices.shape))[axis]
+
+
+@functools.lru_cache(maxsize=None)
+def _lookup_fn(mesh, axis, rows_per_shard):
+    """Cached, jitted psum-of-masked-gather (jit identity is stable per
+    (mesh, axis, rows/shard) so XLA compiles once per shape; shard_map
+    must run under jit on multi-host meshes — see collectives.py)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def body(local_table, ids):
+        # local_table: (rows/n, dim) block of this shard; ids replicated
+        shard = jax.lax.axis_index(axis)
+        base = shard * rows_per_shard
+        local = ids - base
+        mine = (local >= 0) & (local < rows_per_shard)
+        safe = jnp.clip(local, 0, rows_per_shard - 1)
+        got = jnp.take(local_table, safe, axis=0)
+        contrib = jnp.where(mine[..., None], got, 0)
+        return jax.lax.psum(contrib, axis)
+
+    return jax.jit(shard_map(body, mesh=mesh,
+                             in_specs=(P(axis, None), P()),
+                             out_specs=P(), check_vma=False))
+
+
+def _replicate_ids(ids, mesh):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    ids = jnp.asarray(ids, jnp.int32)
+    if not isinstance(ids, jax.core.Tracer):
+        ids = jax.device_put(ids, NamedSharding(mesh, P()))
+    return ids
+
+
+def sharded_lookup(table, ids, mesh, axis: str = "mp"):
+    """Gather rows `ids` from a row-sharded table; result replicated.
+
+    Differentiable: the vjp is the mirrored shard-local scatter-add (the
+    row-sparse gradient never leaves its shard)."""
+    n = _axis_size(mesh, axis)
+    check(table.shape[0] % n == 0,
+          f"table rows {table.shape[0]} must divide the {axis} axis ({n})")
+    return _lookup_fn(mesh, axis, table.shape[0] // n)(
+        table, _replicate_ids(ids, mesh))
+
+
+@functools.lru_cache(maxsize=None)
+def _scatter_add_fn(mesh, axis, rows_per_shard):
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def body(local_table, ids, rows):
+        shard = jax.lax.axis_index(axis)
+        base = shard * rows_per_shard
+        local = ids - base
+        mine = (local >= 0) & (local < rows_per_shard)
+        safe = jnp.where(mine, local, rows_per_shard)  # out-of-range drop
+        padded = jnp.concatenate(
+            [local_table, jnp.zeros((1,) + local_table.shape[1:],
+                                    local_table.dtype)])
+        updated = padded.at[safe].add(rows.astype(local_table.dtype))
+        return updated[:rows_per_shard]
+
+    return jax.jit(shard_map(body, mesh=mesh,
+                             in_specs=(P(axis, None), P(), P()),
+                             out_specs=P(axis, None), check_vma=False))
+
+
+def sharded_scatter_add(table, ids, rows, mesh, axis: str = "mp"):
+    """table[ids] += rows, each shard updating only the rows it owns;
+    returns the updated (still sharded) table."""
+    n = _axis_size(mesh, axis)
+    return _scatter_add_fn(mesh, axis, table.shape[0] // n)(
+        table, _replicate_ids(ids, mesh), rows)
+
+
+class ShardedEmbedding:
+    """An embedding table living row-sharded across the mesh.
+
+    The TPU-native replacement for a kvstore-served big embedding: the
+    table never moves; lookups/updates are compiled collectives.
+
+    >>> emb = ShardedEmbedding(100000, 64, mesh, axis="mp")
+    >>> vecs = emb(ids)                       # (batch, 64), differentiable
+    >>> emb.sgd_update_sparse(ids, grads, lr) # row-sparse SGD step
+    """
+
+    def __init__(self, input_dim: int, output_dim: int, mesh,
+                 axis: str = "mp", dtype=None, init_scale: float = 0.01,
+                 seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        n = _axis_size(mesh, axis)
+        check(input_dim % n == 0,
+              f"input_dim {input_dim} must be divisible by the {axis} "
+              f"axis size {n} (pad the vocabulary)")
+        self.mesh, self.axis = mesh, axis
+        self.input_dim, self.output_dim = input_dim, output_dim
+        dtype = dtype or jnp.float32
+        w = jax.random.normal(jax.random.PRNGKey(seed),
+                              (input_dim, output_dim), dtype) * init_scale
+        self.weight = shard_table(w, mesh, axis)
+
+    def __call__(self, ids):
+        return sharded_lookup(self.weight, ids, self.mesh, self.axis)
+
+    def lookup(self, ids):
+        return self(ids)
+
+    def sgd_update_sparse(self, ids, grad_rows, lr: float) -> None:
+        """weight[ids] -= lr * grad_rows, shard-locally."""
+        self.weight = sharded_scatter_add(self.weight, ids,
+                                          -lr * grad_rows, self.mesh,
+                                          self.axis)
+
+    @property
+    def shards(self):
+        """Per-device addressable shards (proof the table is sharded)."""
+        return self.weight.addressable_shards

@@ -18,8 +18,8 @@ from .ndarray import ndarray as _nd
 __all__ = ["default_context", "set_default_context", "assert_almost_equal",
            "almost_equal", "rand_ndarray", "rand_shape_2d", "rand_shape_3d",
            "rand_shape_nd", "check_numeric_gradient", "check_consistency",
-           "numeric_grad", "simple_forward", "same", "random_arrays",
-           "assert_exception", "retry"]
+           "check_backend_consistency", "numeric_grad", "simple_forward",
+           "same", "random_arrays", "assert_exception", "retry"]
 
 _default_ctx: List[Context] = []
 
@@ -176,6 +176,76 @@ def check_consistency(fn, inputs: Sequence[np.ndarray],
         results.append(out.asnumpy().astype(np.float64))
     for r in results[1:]:
         assert_almost_equal(results[0], r, rtol=rtol, atol=atol)
+
+
+def check_backend_consistency(op_name_or_fn, inputs: Sequence[np.ndarray],
+                              params: Optional[dict] = None, rtol=1e-5,
+                              atol=1e-6, grad=False) -> None:
+    """Cross-execution-mode parity — the TPU analog of the reference's
+    'GPU suite = CPU suite re-run' trick (test_utils.py:1224,
+    tests/python/gpu/test_operator_gpu.py):
+
+      1. normal path (per-op jit through the registry cache),
+      2. jit disabled (jax.disable_jit: op-by-op eager lowering — catches
+         XLA fusion/compilation bugs),
+      3. the CPU backend, when the default backend is an accelerator
+         (catches TPU lowering bugs against the reference CPU lowering).
+
+    Outputs (and gradients with ``grad=True``) must agree across modes.
+    """
+    import jax
+    from . import autograd
+    params = params or {}
+
+    def run():
+        nds = [_nd.array(a) for a in inputs]
+        if grad:
+            for x in nds:
+                x.attach_grad()
+        rec = autograd.record() if grad else None
+        if rec:
+            rec.__enter__()
+        try:
+            if callable(op_name_or_fn):
+                out = op_name_or_fn(*nds)
+            else:
+                out = _nd.imperative_invoke(op_name_or_fn, tuple(nds),
+                                            dict(params))
+            first = out[0] if isinstance(out, (list, tuple)) else out
+            if grad:
+                first.sum().backward()
+        finally:
+            if rec:
+                rec.__exit__(None, None, None)
+        outs = [o.asnumpy() for o in
+                (out if isinstance(out, (list, tuple)) else (out,))]
+        grads = [x.grad.asnumpy() for x in nds] if grad else []
+        return outs, grads
+
+    base_outs, base_grads = run()
+
+    with jax.disable_jit():
+        nj_outs, nj_grads = run()
+    for i, (a, b) in enumerate(zip(base_outs, nj_outs)):
+        assert_almost_equal(a, b, rtol=rtol, atol=atol,
+                            names=(f"jit_out[{i}]", f"nojit_out[{i}]"))
+    for i, (a, b) in enumerate(zip(base_grads, nj_grads)):
+        assert_almost_equal(a, b, rtol=rtol, atol=atol,
+                            names=(f"jit_grad[{i}]", f"nojit_grad[{i}]"))
+
+    if jax.default_backend() != "cpu":
+        cpu_dev = jax.devices("cpu")[0]
+        with jax.default_device(cpu_dev):
+            c_outs, c_grads = run()
+        # accelerator-vs-cpu tolerance is looser (different matmul units)
+        for i, (a, b) in enumerate(zip(base_outs, c_outs)):
+            assert_almost_equal(a, b, rtol=max(rtol, 1e-3),
+                                atol=max(atol, 1e-4),
+                                names=(f"dev_out[{i}]", f"cpu_out[{i}]"))
+        for i, (a, b) in enumerate(zip(base_grads, c_grads)):
+            assert_almost_equal(a, b, rtol=max(rtol, 1e-3),
+                                atol=max(atol, 1e-4),
+                                names=(f"dev_grad[{i}]", f"cpu_grad[{i}]"))
 
 
 def simple_forward(sym, ctx=None, is_train=False, **inputs):

@@ -46,6 +46,32 @@ def main():
         expected = sum(float(r) for r in range(nw))
         assert np.allclose(out.asnumpy(), expected), (rank, k)
 
+    # round 3: 2-bit wire compression — the collective payload must be
+    # the packed codes (n/4 bytes), and the result the sum of each
+    # worker's dequantized gradient (threshold 0.5 -> +-0.5 steps)
+    kv2 = mx.kv.create("dist_sync")
+    kv2.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    shape = (4, 8)
+    n = int(np.prod(shape))
+    kv2.init("c0", mx.nd.zeros(shape))
+    kv2.set_updater(lambda k, merged, stored: stored._rebind(merged._data))
+    vals = np.linspace(-1.2, 1.2, n).reshape(shape).astype(np.float32)
+    kv2.push("c0", mx.nd.array(vals))
+    out = mx.nd.zeros(shape)
+    kv2.pull("c0", out=out)
+    q = np.where(vals >= 0.5, 0.5, np.where(vals <= -0.5, -0.5, 0.0))
+    expected = q * nw  # same grad on every worker
+    assert np.allclose(out.asnumpy(), expected), (rank, "compressed push")
+    assert kv2._last_wire_bytes == (n + 3) // 4, kv2._last_wire_bytes
+    # error feedback: the residual carries the quantization error
+    kv2.push("c0", mx.nd.array(vals))
+    out2 = mx.nd.zeros(shape)
+    kv2.pull("c0", out=out2)
+    res = vals - q
+    g2 = vals + res
+    q2 = np.where(g2 >= 0.5, 0.5, np.where(g2 <= -0.5, -0.5, 0.0))
+    assert np.allclose(out2.asnumpy(), q2 * nw), (rank, "error feedback")
+
     kv.barrier()
     print(f"worker {rank}/{nw}: dist kvstore checks passed", flush=True)
 

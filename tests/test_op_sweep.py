@@ -17,7 +17,9 @@ import pytest
 import mxnet_tpu as mx
 from mxnet_tpu import nd
 from mxnet_tpu.ops import registry as reg
-from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+from mxnet_tpu.test_utils import (assert_almost_equal,
+                                  check_backend_consistency,
+                                  check_numeric_gradient)
 
 from op_cases import CASES, Case
 
@@ -119,6 +121,17 @@ def test_dtype_sweep(name, case):
         got = np.asarray(out.asnumpy(), dtype=np.float64)
         assert_almost_equal(got, base.astype(np.float64), rtol=rtol,
                             atol=atol, names=(f"{name}[{dt}]", "f32"))
+
+
+@pytest.mark.parametrize("name,case", ALL_CASES)
+def test_mode_consistency(name, case):
+    """The whole sweep re-run under a second execution mode — jit vs
+    disable_jit (op-by-op lowering), plus cpu-vs-accelerator when the
+    default backend is not cpu. The reference's 'GPU suite = CPU suite
+    re-imported' trick (tests/python/gpu/test_operator_gpu.py)."""
+    check_backend_consistency(name, list(case.inputs), dict(case.params),
+                              grad=_gradable(name, case) and
+                              case.grad_only is None)
 
 
 EDGE_CASES = [p for p in ALL_CASES if p.values[1].edge]
