@@ -23,9 +23,25 @@ import os
 from .base import env
 
 __all__ = ["set_bulk_size", "bulk", "wait_for_all", "engine_type",
-           "set_engine_type"]
+           "set_engine_type", "NativeEngine", "shared_engine"]
 
 _bulk_size = 15
+_shared_engine = None
+
+
+def shared_engine(num_workers: int = None):
+    """Process-wide NativeEngine for host-side pipelines (IO prefetch,
+    async checkpoint writes). Returns None when the native library is
+    unavailable — callers fall back to synchronous execution."""
+    global _shared_engine
+    if _shared_engine is None:
+        try:
+            workers = num_workers or int(
+                env.get("MXNET_CPU_WORKER_NTHREADS") or 1) * 4
+            _shared_engine = NativeEngine(num_workers=max(2, workers))
+        except Exception:
+            _shared_engine = False
+    return _shared_engine or None
 
 
 def set_bulk_size(size: int) -> int:
@@ -109,13 +125,26 @@ class NativeEngine:
     def new_var(self):
         return self._lib.mxtpu_engine_new_var(self._h)
 
-    def push(self, fn, read_vars=(), write_vars=()) -> None:
+    def push(self, fn, read_vars=(), write_vars=(), name="engine_task"):
         """Schedule ``fn()`` after its dependencies
-        (ref: Engine::PushAsync, engine.h:115)."""
+        (ref: Engine::PushAsync, engine.h:115).
+
+        Returns the ctypes trampoline keeping the task callable alive;
+        callers managing many short-lived tasks may hold it themselves and
+        drop it once the task is known complete (e.g. after
+        wait_for_var on a var the task wrote) instead of letting it
+        accumulate until wait_all."""
         import ctypes
 
         def tramp(_):
-            fn()
+            from . import profiler as _prof
+            if _prof.is_active():
+                import time as _time
+                t0 = _time.perf_counter()
+                fn()
+                _prof.record_span(name, "engine", t0, _time.perf_counter())
+            else:
+                fn()
 
         cb = self._cb_type(tramp)
         self._keepalive.append(cb)
@@ -124,6 +153,15 @@ class NativeEngine:
         self._lib.mxtpu_engine_push(
             self._h, ctypes.cast(cb, ctypes.c_void_p), None,
             reads, len(read_vars), writes, len(write_vars))
+        return cb
+
+    def release(self, cbs) -> None:
+        """Drop trampoline refs for tasks known to be complete."""
+        for cb in cbs:
+            try:
+                self._keepalive.remove(cb)
+            except ValueError:
+                pass
 
     def wait_for_var(self, var, version: int = 0) -> None:
         self._lib.mxtpu_engine_wait_var(self._h, var, version)

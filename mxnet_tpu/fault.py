@@ -143,17 +143,54 @@ class CheckpointManager:
     never restored. ``max_keep`` old checkpoints are pruned.
     """
 
-    def __init__(self, dir_path: str, max_keep: int = 3):
+    def __init__(self, dir_path: str, max_keep: int = 3,
+                 async_write: bool = False):
         check(max_keep >= 1, "max_keep must be >= 1")
         self._dir = dir_path
         self._max_keep = max_keep
         os.makedirs(dir_path, exist_ok=True)
+        # async_write: file IO runs as NativeEngine tasks serialized by a
+        # write-var (the iter_prefetcher.h-style overlap, applied to
+        # checkpoints) — save() snapshots values to host then returns;
+        # readers (steps/restore/wait) fence on the var first
+        self._engine = None
+        self._ckpt_var = None
+        self._cbs: List = []  # (write-var version when done, trampoline)
+        self._n_scheduled = 0
+        if async_write:
+            from .engine import shared_engine
+            self._engine = shared_engine()
+            if self._engine is not None:
+                self._ckpt_var = self._engine.new_var()
+
+    def wait(self) -> None:
+        """Block until all scheduled checkpoint writes hit disk."""
+        if self._engine is not None:
+            self._engine.wait_for_var(self._ckpt_var)
+            self._engine.release([cb for _, cb in self._cbs])
+            self._cbs.clear()
+
+    def _reap_done(self) -> None:
+        """Release trampolines (and their captured parameter snapshots)
+        for writes that already completed — keeps a save-only training
+        loop from pinning one host copy of the model per checkpoint."""
+        if self._engine is None or not self._cbs:
+            return
+        done = self._engine.var_version(self._ckpt_var)
+        finished = [(v, cb) for v, cb in self._cbs if v <= done]
+        if finished:
+            self._engine.release([cb for _, cb in finished])
+            self._cbs = [(v, cb) for v, cb in self._cbs if v > done]
 
     def _ckpt_dir(self, step: int) -> str:
         return os.path.join(self._dir, f"ckpt-{step}")
 
     def steps(self) -> List[int]:
-        """Completed checkpoint steps, ascending."""
+        """Completed checkpoint steps, ascending (fences async writes)."""
+        self.wait()
+        return self._steps_nowait()
+
+    def _steps_nowait(self) -> List[int]:
         out = []
         for name in os.listdir(self._dir):
             if name.startswith("ckpt-"):
@@ -178,6 +215,36 @@ class CheckpointManager:
             params = {k: p.data()
                       for k, p in net._collect_params_with_prefix().items()}
         path = self._ckpt_dir(step)
+        if self._engine is None:
+            self._write(step, dict(params), trainer, extra)
+            return path
+        # async: snapshot device values to HOST now (consistency point),
+        # then let the engine do the file IO; the write-var serializes
+        # checkpoints in submission order
+        host_params = {k: nd.array(v.asnumpy()) for k, v in params.items()}
+        trainer_states = None
+        if trainer is not None:
+            try:
+                trainer_states = trainer._updaters[0].get_states(
+                    dump_optimizer=False)
+            except Exception:
+                # no in-memory snapshot API: synchronous write instead
+                self._write(step, host_params, trainer, extra)
+                return path
+
+        def task():
+            self._write(step, host_params, None, extra,
+                        trainer_states=trainer_states)
+
+        self._reap_done()
+        self._n_scheduled += 1
+        self._cbs.append((self._n_scheduled, self._engine.push(
+            task, write_vars=[self._ckpt_var], name=f"ckpt-{step}")))
+        return path
+
+    def _write(self, step, params, trainer, extra,
+               trainer_states=None) -> None:
+        path = self._ckpt_dir(step)
         tmp = path + ".tmp"
         if os.path.isdir(tmp):
             import shutil
@@ -186,6 +253,9 @@ class CheckpointManager:
         nd.save(os.path.join(tmp, "params"), dict(params))
         if trainer is not None:
             trainer.save_states(os.path.join(tmp, "trainer"))
+        elif trainer_states is not None:
+            with open(os.path.join(tmp, "trainer"), "wb") as f:
+                f.write(trainer_states)
         meta = {"step": int(step), "time": time.time()}
         if extra:
             meta.update(extra)
@@ -198,10 +268,11 @@ class CheckpointManager:
             shutil.rmtree(path)
         os.replace(tmp, path)
         self._prune()
-        return path
 
     def _prune(self) -> None:
-        steps = self.steps()
+        # _steps_nowait: _prune runs INSIDE the engine write task when
+        # async — fencing there would deadlock on the task's own var
+        steps = self._steps_nowait()
         for step in steps[:-self._max_keep]:
             import shutil
             shutil.rmtree(self._ckpt_dir(step), ignore_errors=True)
@@ -214,6 +285,7 @@ class CheckpointManager:
                 ) -> Tuple[int, Dict[str, "nd.NDArray"], dict]:
         """Load checkpoint ``step``; when ``net``/``trainer`` are given,
         their parameters/optimizer states are set in place."""
+        self.wait()  # fence pending async writes
         path = self._ckpt_dir(step)
         check(os.path.exists(os.path.join(path, "DONE")),
               f"checkpoint {step} is missing or incomplete")

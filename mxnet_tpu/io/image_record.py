@@ -85,8 +85,30 @@ class ImageRecordIter(DataIter):
         self._pool = _futures.ThreadPoolExecutor(
             max_workers=int(preprocess_threads))
         self._round_batch = round_batch
+        # NativeEngine-driven prefetch (ref: iter_prefetcher.h:47 +
+        # iter_image_recordio_2.cc:766): decode fan-out and batch assembly
+        # are engine tasks ordered by per-slot vars, and the NEXT batch
+        # decodes while the trainer consumes the current one. Falls back
+        # to the synchronous pool path when the native lib is absent.
+        from ..engine import shared_engine
+        self._engine = shared_engine()
+        self._pending = None
+        if self._engine is not None:
+            self._slot_vars = [self._engine.new_var()
+                               for _ in range(int(preprocess_threads))]
+            self._batch_var = self._engine.new_var()
+
+    def _drop_pending(self):
+        """Wait out and release an unconsumed prefetched batch (its
+        trampolines hold the decoded arrays — leaking them in the shared
+        engine would pin one batch per reset for the process lifetime)."""
+        if self._engine is not None and self._pending is not None:
+            self._engine.wait_for_var(self._batch_var)
+            self._engine.release(self._pending[2])
+            self._pending = None
 
     def close(self):
+        self._drop_pending()
         if self._pool is not None:
             self._pool.shutdown(wait=False)
             self._pool = None
@@ -112,6 +134,7 @@ class ImageRecordIter(DataIter):
         return [DataDesc(self._label_name, shape)]
 
     def reset(self):
+        self._drop_pending()
         self._pipe.reset()
 
     def _decode_one(self, rec):
@@ -119,20 +142,21 @@ class ImageRecordIter(DataIter):
         arr, label = decode_and_augment(rec, self.auglist)
         return arr, _np.atleast_1d(label)
 
-    def next(self):
+    def _read_records(self):
         recs = []
         while len(recs) < self.batch_size:
             rec = self._pipe.next()
             if rec is None:
                 break
             recs.append(rec)
-        if not recs:
-            raise StopIteration
+        return recs
+
+    def _assemble(self, recs, decoded):
         c, h, w = self.data_shape
         batch = _np.zeros((self.batch_size, c, h, w), _np.float32)
-        labels = _np.zeros((self.batch_size, self.label_width), _np.float32)
-        for i, (arr, label) in enumerate(self._pool.map(self._decode_one,
-                                                        recs)):
+        labels = _np.zeros((self.batch_size, self.label_width),
+                           _np.float32)
+        for i, (arr, label) in enumerate(decoded):
             batch[i] = arr
             labels[i, :] = label[:self.label_width]
         pad = self.batch_size - len(recs)
@@ -142,6 +166,59 @@ class ImageRecordIter(DataIter):
                 labels[i] = labels[i % len(recs)]
         lab = labels[:, 0] if self.label_width == 1 else labels
         return DataBatch([_nd.array(batch)], [_nd.array(lab)], pad=pad)
+
+    # -- NativeEngine prefetch path ------------------------------------
+    def _schedule_batch(self):
+        """Fan decode tasks out to the engine and chain an assembly task;
+        the produced DataBatch is picked up by the following next()."""
+        recs = self._read_records()
+        if not recs:
+            self._pending = ("eof", None, [])
+            return
+        decoded = [None] * len(recs)
+        state = {"recs": recs, "decoded": decoded}
+        cbs = []
+        nslots = len(self._slot_vars)
+
+        def make_task(i, rec):
+            def task():
+                decoded[i] = self._decode_one(rec)
+            return task
+
+        for i, rec in enumerate(recs):
+            cbs.append(self._engine.push(
+                make_task(i, rec),
+                write_vars=[self._slot_vars[i % nslots]],
+                name="decode"))
+
+        def finalize():
+            state["batch"] = self._assemble(recs, decoded)
+
+        cbs.append(self._engine.push(
+            finalize, read_vars=list(self._slot_vars),
+            write_vars=[self._batch_var], name="batch_assemble"))
+        self._pending = ("batch", state, cbs)
+
+    def next(self):
+        if self._engine is None:
+            recs = self._read_records()
+            if not recs:
+                raise StopIteration
+            return self._assemble(recs,
+                                  self._pool.map(self._decode_one, recs))
+        if self._pending is None:
+            self._schedule_batch()
+        kind, state, cbs = self._pending
+        if kind == "eof":
+            self._pending = None
+            raise StopIteration
+        self._engine.wait_for_var(self._batch_var)
+        self._engine.release(cbs)
+        batch = state["batch"]
+        # prefetch: the next batch decodes while the caller trains on
+        # this one
+        self._schedule_batch()
+        return batch
 
 
 class ImageDetRecordIter(ImageRecordIter):

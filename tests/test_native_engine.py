@@ -119,3 +119,119 @@ def test_wait_for_var_version():
     eng.wait_for_var(v, version=10)
     assert eng.var_version(v) == 10
     eng.close()
+
+
+def test_image_record_iter_uses_engine_and_overlaps(tmp_path):
+    """The iterator decodes batch k+1 while the consumer works on batch k
+    (ref: iter_prefetcher.h:47). Proof: with a consumer that sleeps
+    per batch, total wall time ~= consumer time, not consumer + decode."""
+    import time
+    import numpy as np
+    from mxnet_tpu import io as mxio, recordio
+
+    rec = tmp_path / "d.rec"
+    rs = np.random.RandomState(0)
+    writer = recordio.MXRecordIO(str(rec), "w")
+    for i in range(24):
+        img = rs.randint(0, 255, (64, 64, 3), np.uint8)
+        writer.write(recordio.pack_img(
+            recordio.IRHeader(0, float(i % 10), i, 0), img, quality=95))
+    writer.close()
+
+    it = mxio.ImageRecordIter(path_imgrec=str(rec), data_shape=(3, 32, 32),
+                              batch_size=8, resize=32,
+                              preprocess_threads=4)
+    assert it._engine is not None, "native engine must drive the iterator"
+    consume = 0.05
+    t0 = time.perf_counter()
+    n = 0
+    for b in it:
+        time.sleep(consume)  # the training step
+        n += 1
+    wall = time.perf_counter() - t0
+    assert n == 3
+    # serial would be n*(consume + decode); proof of prefetch is that the
+    # engine had the next batch ready: generous bound at 3x consume + 1
+    # decode's worth of slack
+    it2 = mxio.ImageRecordIter(path_imgrec=str(rec),
+                               data_shape=(3, 32, 32), batch_size=8,
+                               resize=32, preprocess_threads=4)
+    t1 = time.perf_counter()
+    for _ in it2:
+        pass
+    decode_total = time.perf_counter() - t1
+    assert wall < n * consume + decode_total / n + 0.25, \
+        (wall, decode_total)
+
+
+def test_async_checkpoint_write(tmp_path):
+    """CheckpointManager(async_write=True): save() returns before the
+    files exist; wait()/steps() fence; contents match a sync write; the
+    snapshot is taken at save() time (later mutations don't leak in)."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import fault, nd
+
+    cm = fault.CheckpointManager(str(tmp_path), max_keep=2,
+                                 async_write=True)
+    assert cm._engine is not None
+    w = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    params = {"w": w}
+    cm.save(1, params)
+    # mutate AFTER scheduling: the checkpoint must hold the old value
+    w += 100.0
+    cm.save(2, params)
+    assert cm.steps() == [1, 2]  # steps() waits for the writes
+    step, loaded, meta = cm.restore(1)
+    np.testing.assert_array_equal(
+        loaded["w"].asnumpy(),
+        np.arange(6, dtype=np.float32).reshape(2, 3))
+    step2, loaded2, _ = cm.restore(2)
+    np.testing.assert_array_equal(
+        loaded2["w"].asnumpy(),
+        np.arange(6, dtype=np.float32).reshape(2, 3) + 100.0)
+
+
+def test_async_checkpoint_resume_with_trainer(tmp_path):
+    """Async checkpoints restore bit-exactly including optimizer state."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, fault, gluon, nd
+
+    def make():
+        net = gluon.nn.Dense(2, use_bias=False)
+        net.initialize(mx.init.Constant(1.0))
+        with autograd.pause():
+            net(nd.ones((1, 3)))
+        return net
+
+    def step(net, tr, x, y):
+        with autograd.record():
+            loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        tr.step(x.shape[0])
+
+    rs = np.random.RandomState(0)
+    x = nd.array(rs.randn(8, 3).astype(np.float32))
+    y = nd.array(rs.randn(8, 2).astype(np.float32))
+    net_a = make()
+    tr_a = gluon.Trainer(net_a.collect_params(), "sgd",
+                         {"learning_rate": 0.1, "momentum": 0.9})
+    for _ in range(2):
+        step(net_a, tr_a, x, y)
+    cm = fault.CheckpointManager(str(tmp_path), async_write=True)
+    cm.save(2, net=net_a, trainer=tr_a)
+    for _ in range(2):
+        step(net_a, tr_a, x, y)  # keep training while the write lands
+
+    net_b = make()
+    tr_b = gluon.Trainer(net_b.collect_params(), "sgd",
+                         {"learning_rate": 0.1, "momentum": 0.9})
+    resumed = cm.restore_latest(net=net_b, trainer=tr_b)
+    assert resumed is not None and resumed[0] == 2
+    for _ in range(2):
+        step(net_b, tr_b, x, y)
+    for (_, pa), (_, pb) in zip(sorted(net_a.collect_params().items()),
+                                sorted(net_b.collect_params().items())):
+        np.testing.assert_allclose(pa.data().asnumpy(),
+                                   pb.data().asnumpy(), rtol=1e-6)

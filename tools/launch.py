@@ -2,33 +2,188 @@
 """Distributed job launcher (ref: tools/launch.py + dmlc_tracker).
 
 The reference forks scheduler + servers + workers wired with DMLC_* env
-vars over ssh/mpi/yarn. The TPU-native cluster model has no parameter
-servers: every host runs the SAME SPMD program and rendezvouses through the
-JAX coordination service. This launcher starts N local worker processes (or
-emits the per-host commands for ssh) with the env each jax.distributed
-worker needs:
+vars over local/ssh/mpi/sge/yarn (ref tools/launch.py:100-107). The
+TPU-native cluster model has no parameter servers: every host runs the
+SAME SPMD program and rendezvouses through the JAX coordination service.
+This launcher starts N workers (locally, or one per remote host over ssh)
+with the env each jax.distributed worker needs:
 
   MXTPU_COORDINATOR  host:port of process 0  (DMLC_PS_ROOT_URI analog)
   MXTPU_NUM_WORKERS  world size              (DMLC_NUM_WORKER analog)
   MXTPU_WORKER_ID    rank                    (DMLC_RANK analog)
 
-Worker code calls mxnet_tpu.tools_init_distributed() (or
-jax.distributed.initialize directly) which reads these.
+plus DMLC_* aliases for scripts ported from the reference. Worker code
+calls mxnet_tpu.tools_init_distributed() (or jax.distributed.initialize
+directly) which reads these.
+
+ssh launcher
+------------
+  launch.py -n 4 --launcher ssh -H hostfile --coordinator host0:12357 \
+      python train.py ...
+
+`hostfile` holds one host per line, optionally `host slots=K` to place K
+workers on that host (ranks assigned block-wise in file order, like
+dmlc_tracker/ssh.py). `--env KEY` forwards the local value of KEY to every
+worker; PYTHONPATH and MXNET_*/MXTPU_*/JAX_* vars forward by default.
+On any worker failing, the rest are terminated.
 """
 import argparse
 import os
+import shlex
+import signal
 import subprocess
 import sys
 
 
+def _worker_env(args, rank):
+    env = {
+        "MXTPU_COORDINATOR": args.coordinator,
+        "MXTPU_NUM_WORKERS": str(args.num_workers),
+        "MXTPU_WORKER_ID": str(rank),
+        # reference-compatible aliases (DMLC_* consumers: fault.Heartbeat
+        # rank default, ported worker scripts)
+        "DMLC_PS_ROOT_URI": args.coordinator.rsplit(":", 1)[0],
+        "DMLC_NUM_WORKER": str(args.num_workers),
+        "DMLC_RANK": str(rank),
+        "DMLC_ROLE": "worker",
+    }
+    return env
+
+
+def _forward_env(args):
+    """Env vars propagated to remote workers."""
+    out = {}
+    prefixes = ("MXNET_", "MXTPU_", "JAX_", "XLA_")
+    for k, v in os.environ.items():
+        if k.startswith(prefixes) or k == "PYTHONPATH":
+            out[k] = v
+    for k in args.env or ():
+        if k in os.environ:
+            out[k] = os.environ[k]
+    return out
+
+
+def _parse_hostfile(path):
+    """[(host, slots)] — lines `host` or `host slots=K`, # comments."""
+    hosts = []
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            host = parts[0]
+            slots = 1
+            for p in parts[1:]:
+                if p.startswith("slots="):
+                    slots = int(p.split("=", 1)[1])
+            hosts.append((host, slots))
+    return hosts
+
+
+def _assign_ranks(hosts, n):
+    """Block-wise rank placement honoring slots (dmlc_tracker/ssh.py)."""
+    if sum(s for _, s in hosts) <= 0:
+        raise SystemExit("hostfile has no usable slots")
+    placement = []  # rank -> host
+    i = 0
+    while len(placement) < n:
+        host, slots = hosts[i % len(hosts)]
+        for _ in range(slots):
+            if len(placement) >= n:
+                break
+            placement.append(host)
+        i += 1
+    return placement
+
+
+def launch_ssh(args, cmd):
+    hosts = _parse_hostfile(args.hostfile) if args.hostfile \
+        else [("localhost", args.num_workers)]
+    placement = _assign_ranks(hosts, args.num_workers)
+    fwd = _forward_env(args)
+    procs = []
+    for rank in range(args.num_workers):
+        env = dict(fwd)
+        env.update(_worker_env(args, rank))
+        exports = " ".join(f"export {k}={shlex.quote(v)};"
+                           for k, v in sorted(env.items()))
+        quoted_cmd = " ".join(shlex.quote(c) for c in cmd)
+        remote = (f"cd {shlex.quote(args.remote_workdir or os.getcwd())} "
+                  f"&& {exports} exec {quoted_cmd}")
+        ssh_base = shlex.split(args.ssh_cmd)
+        if args.ssh_port and args.ssh_cmd == "ssh":
+            ssh_base += ["-p", str(args.ssh_port)]
+        full = ssh_base + [placement[rank], remote]
+        procs.append((rank, subprocess.Popen(full)))
+        print(f"launched rank {rank} on {placement[rank]}",
+              file=sys.stderr, flush=True)
+    return _wait_group(procs)
+
+
+def launch_local(args, cmd):
+    procs = []
+    for rank in range(args.num_workers):
+        env = dict(os.environ)
+        env.update(_worker_env(args, rank))
+        procs.append((rank, subprocess.Popen(cmd, env=env)))
+    return _wait_group(procs)
+
+
+def _wait_group(procs):
+    """Wait for all workers; kill the group as soon as one fails (the
+    dmlc_tracker fail-fast behavior) so a crashed rank doesn't leave the
+    rest hanging in a collective."""
+    failed = None
+    alive = dict(procs)
+    try:
+        while alive:
+            for rank in list(alive):
+                rc = alive[rank].poll()
+                if rc is None:
+                    continue
+                del alive[rank]
+                if rc != 0 and failed is None:
+                    failed = (rank, rc)
+                    for other in alive.values():
+                        try:
+                            other.terminate()
+                        except OSError:
+                            pass
+            if alive:
+                import time
+                time.sleep(0.05)
+    except KeyboardInterrupt:
+        for p in alive.values():
+            p.send_signal(signal.SIGINT)
+        raise
+    if failed:
+        print(f"worker {failed[0]} exited with {failed[1]}",
+              file=sys.stderr)
+        return failed[1]
+    return 0
+
+
 def main():
-    ap = argparse.ArgumentParser(description=__doc__)
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
     ap.add_argument("-n", "--num-workers", type=int, required=True)
     ap.add_argument("--launcher", choices=["local", "ssh", "manual"],
                     default="local")
-    ap.add_argument("--coordinator", default="127.0.0.1:12357")
+    ap.add_argument("--coordinator", default="127.0.0.1:12357",
+                    help="host:port of rank 0's coordination service")
     ap.add_argument("-H", "--hostfile", default=None,
-                    help="one host per line (ssh launcher)")
+                    help="one host per line, optional 'slots=K' "
+                         "(ssh launcher)")
+    ap.add_argument("--env", action="append", default=[],
+                    help="extra env var NAMES to forward to workers")
+    ap.add_argument("--remote-workdir", default=None,
+                    help="working directory on remote hosts "
+                         "(default: current directory)")
+    ap.add_argument("--ssh-port", type=int, default=None)
+    ap.add_argument("--ssh-cmd", default="ssh",
+                    help="ssh executable (tests substitute a local stub)")
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args()
     if not args.command:
@@ -37,37 +192,17 @@ def main():
 
     if args.launcher == "manual":
         for rank in range(args.num_workers):
-            env = (f"MXTPU_COORDINATOR={args.coordinator} "
-                   f"MXTPU_NUM_WORKERS={args.num_workers} "
-                   f"MXTPU_WORKER_ID={rank}")
+            env = " ".join(f"{k}={v}" for k, v in
+                           sorted(_worker_env(args, rank).items()))
             print(f"[host {rank}] {env} {' '.join(cmd)}")
         return
 
     if args.launcher == "ssh":
-        hosts = [h.strip() for h in open(args.hostfile)] \
-            if args.hostfile else ["localhost"] * args.num_workers
-        procs = []
-        for rank in range(args.num_workers):
-            env = (f"MXTPU_COORDINATOR={args.coordinator} "
-                   f"MXTPU_NUM_WORKERS={args.num_workers} "
-                   f"MXTPU_WORKER_ID={rank}")
-            procs.append(subprocess.Popen(
-                ["ssh", hosts[rank % len(hosts)],
-                 f"cd {os.getcwd()} && {env} {' '.join(cmd)}"]))
-        rc = max(p.wait() for p in procs)
-        sys.exit(rc)
+        sys.exit(launch_ssh(args, cmd))
 
     # local: fork N processes on this machine (the reference's local
     # tracker pattern used by tests/nightly/dist_sync_kvstore.py)
-    procs = []
-    for rank in range(args.num_workers):
-        env = dict(os.environ)
-        env.update({"MXTPU_COORDINATOR": args.coordinator,
-                    "MXTPU_NUM_WORKERS": str(args.num_workers),
-                    "MXTPU_WORKER_ID": str(rank)})
-        procs.append(subprocess.Popen(cmd, env=env))
-    rc = max(p.wait() for p in procs)
-    sys.exit(rc)
+    sys.exit(launch_local(args, cmd))
 
 
 if __name__ == "__main__":
