@@ -65,3 +65,16 @@ from . import visualization  # noqa: F401
 from . import visualization as viz  # noqa: F401
 
 # `import mxnet_tpu as mx; mx.nd...` is the canonical spelling.
+
+
+def _apply_global_env_flags():
+    """Honor process-wide MXNET_* knobs at import (the dmlc::GetEnv-at-
+    startup analog)."""
+    from .base import env
+    prec = env.get("MXNET_TPU_MATMUL_PRECISION")
+    if prec and prec != "default":
+        import jax
+        jax.config.update("jax_default_matmul_precision", prec)
+
+
+_apply_global_env_flags()

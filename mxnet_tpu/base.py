@@ -107,6 +107,11 @@ env.declare("MXNET_DEFAULT_DTYPE", str, "float32",
             "Default dtype for created arrays.")
 env.declare("MXNET_TPU_MATMUL_PRECISION", str, "default",
             "jax matmul precision: default|high|highest.")
+env.declare("MXNET_SAFE_ACCUMULATION", bool, False,
+            "Accumulate f16/bf16 reductions (sum/mean/prod/norm) in f32.")
+env.declare("MXNET_IS_RECOVERY", bool, False,
+            "Set by the relauncher on restarted nodes; read by "
+            "mx.fault.is_recovery().")
 
 
 class classproperty:  # noqa: N801 - decorator style

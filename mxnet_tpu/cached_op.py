@@ -170,6 +170,16 @@ class CachedOp:
         if any(isinstance(a, jax.core.Tracer) for a in in_arrays):
             return self.block._imperative_call(*args)
 
+        # bulk-exec knobs: when disabled, run op-by-op imperatively
+        # instead of one fused program (ref: MXNET_EXEC_BULK_EXEC_TRAIN /
+        # _INFERENCE gating engine bulking, graph_executor.cc)
+        from .base import env
+        if autograd.is_training():
+            if not env.get("MXNET_EXEC_BULK_EXEC_TRAIN"):
+                return self.block._imperative_call(*args)
+        elif not env.get("MXNET_EXEC_BULK_EXEC_INFERENCE"):
+            return self.block._imperative_call(*args)
+
         params = self._params()
         for p in params:
             if p._data is None:

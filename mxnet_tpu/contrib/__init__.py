@@ -6,15 +6,16 @@ from . import text  # noqa: F401
 from . import svrg_optimization  # noqa: F401
 from . import onnx  # noqa: F401
 
-# surface on mx.nd.contrib / mx.sym.contrib like the reference
+# surface on mx.nd.contrib like the reference; mx.sym.contrib carries the
+# SYMBOLIC control-flow builders (symbol/control_flow.py), installed by
+# mxnet_tpu/symbol/__init__.py
 def _install():
     import sys
-    for modname in ("mxnet_tpu.ndarray.contrib", "mxnet_tpu.symbol.contrib"):
-        m = sys.modules.get(modname)
-        if m is not None:
-            m.foreach = foreach
-            m.while_loop = while_loop
-            m.cond = cond
+    m = sys.modules.get("mxnet_tpu.ndarray.contrib")
+    if m is not None:
+        m.foreach = foreach
+        m.while_loop = while_loop
+        m.cond = cond
 
 
 _install()

@@ -186,10 +186,17 @@ class Module(BaseModule):
         if isinstance(kvstore, str) and kvstore not in (None, "local",
                                                         "device"):
             from .. import kvstore as kv_mod
+            from ..base import env
             try:
                 self._kvstore = kv_mod.create(kvstore)
-                self._kvstore.set_optimizer(optimizer)
-                self._update_on_kvstore = True
+                # MXNET_UPDATE_ON_KVSTORE=0 keeps the optimizer on the
+                # worker (kvstore only aggregates gradients) — the
+                # reference's update_on_kvstore switch
+                # (python/mxnet/model.py _update_params[_on_kvstore])
+                self._update_on_kvstore = bool(
+                    env.get("MXNET_UPDATE_ON_KVSTORE"))
+                if self._update_on_kvstore:
+                    self._kvstore.set_optimizer(optimizer)
                 for i, name in enumerate(self._param_names):
                     self._kvstore.init(i, self._exec.arg_dict[name])
             except Exception:
@@ -236,6 +243,12 @@ class Module(BaseModule):
             g = self._exec.grad_dict.get(name)
             if g is None:
                 continue
+            if self._kvstore is not None:
+                # MXNET_UPDATE_ON_KVSTORE=0: the store only AGGREGATES
+                # gradients; the optimizer runs here on the worker
+                # (ref: model.py _update_params)
+                self._kvstore.push(i, g)
+                self._kvstore.pull(i, g)
             self._updater(i, g, self._exec.arg_dict[name])
 
     def get_outputs(self, merge_multi_context=True):

@@ -74,8 +74,15 @@ def make_nd_function(name: str, opdef):
         return result
 
     generic.__name__ = name
-    generic.__doc__ = opdef.doc
     generic.__module__ = "mxnet_tpu.ndarray.op"
+    # real signature + numpydoc docstring from registry metadata, the
+    # MXSymbolGetAtomicSymbolInfo codegen analog (ref:
+    # python/mxnet/ndarray/register.py) — help(nd.Convolution) shows
+    # typed params
+    from ..ops.opdoc import signature_and_doc
+    sig, doc = signature_and_doc(name, opdef, creation=opdef.creation)
+    generic.__signature__ = sig
+    generic.__doc__ = doc
     return generic
 
 

@@ -164,3 +164,30 @@ def _cross_device_copy(data):
 _alias("BatchNorm_v1", "BatchNorm")
 _alias("Convolution_v1", "Convolution")
 _alias("Pooling_v1", "Pooling")
+
+
+@register("cast_storage")
+def _cast_storage_op(data, stype="default"):
+    """Graph-level cast_storage (ref: src/operator/tensor/cast_storage.cc).
+
+    Inside a compiled graph every tensor is dense (XLA has no sparse
+    runtime representation), so all stype targets are identity at
+    execution time; the op exists so sym.* graphs that change storage
+    type bind/compose exactly like the reference. Container-level
+    conversion (returning RowSparse/CSR NDArrays) lives in
+    mx.nd.cast_storage (ndarray/sparse.py), which shadows this op on the
+    imperative frontend."""
+    return data
+
+
+@register("sparse_retain")
+def _sparse_retain_op(data, indices):
+    """Graph-level sparse_retain (ref: src/operator/tensor/
+    sparse_retain.cc): keep the listed rows, zero the rest. Dense
+    semantics of the reference kernel; the container-level variant is
+    mx.nd.sparse_retain."""
+    jnp = _jnp()
+    rows = jnp.zeros((data.shape[0],), jnp.bool_)
+    rows = rows.at[indices.astype(jnp.int32)].set(True)
+    shape = (data.shape[0],) + (1,) * (data.ndim - 1)
+    return data * rows.reshape(shape).astype(data.dtype)

@@ -1,0 +1,113 @@
+"""Signature + docstring generation for frontend op functions.
+
+The reference generates full Python signatures and numpydoc docstrings
+from each op's C++ parameter struct (MXSymbolGetAtomicSymbolInfo +
+dmlc/parameter.h __DOC__, consumed by python/mxnet/ndarray/register.py).
+Here the registry op IS a Python function, so its signature carries the
+same metadata: array inputs are the leading positional params, op params
+are the keyword params with defaults. This module turns that into a
+``inspect.Signature`` (so ``help(nd.Convolution)`` shows typed params and
+IDEs autocomplete) and a numpydoc-style docstring.
+"""
+from __future__ import annotations
+
+import inspect
+from typing import Any, Dict, Tuple
+
+__all__ = ["signature_and_doc"]
+
+_HIDDEN = {"_key", "_training"}  # injected by the frontend wrapper
+
+
+def _type_name(default: Any) -> str:
+    if isinstance(default, bool):
+        return "boolean"
+    if isinstance(default, int):
+        return "int"
+    if isinstance(default, float):
+        return "float"
+    if isinstance(default, str):
+        return "string"
+    if isinstance(default, (tuple, list)):
+        return "Shape(tuple)"
+    return "any"
+
+
+def _split_params(opdef) -> Tuple[list, list, bool]:
+    """(array_inputs, [(param, default)], variadic) from the impl fn."""
+    try:
+        sig = inspect.signature(opdef.fn)
+    except (TypeError, ValueError):
+        return [], [], True
+    inputs, params = [], []
+    variadic = False
+    for p in sig.parameters.values():
+        if p.name in _HIDDEN:
+            continue
+        if p.kind == p.VAR_POSITIONAL:
+            variadic = True
+        elif p.kind == p.VAR_KEYWORD:
+            continue
+        elif p.default is p.empty:
+            inputs.append(p.name)
+        else:
+            params.append((p.name, p.default))
+    return inputs, params, variadic
+
+
+def signature_and_doc(name: str, opdef, creation: bool = False,
+                      symbol: bool = False):
+    """Returns (inspect.Signature, docstring) for the frontend wrapper."""
+    inputs, params, variadic = _split_params(opdef)
+    kind_arr = "Symbol" if symbol else "NDArray"
+
+    sig_params = []
+    P = inspect.Parameter
+    for n in inputs:
+        sig_params.append(P(n, P.POSITIONAL_OR_KEYWORD))
+    if variadic:
+        var_name = "args" if "args" not in inputs else "more_args"
+        sig_params.append(P(var_name, P.VAR_POSITIONAL))
+    for n, d in params:
+        sig_params.append(P(n, P.KEYWORD_ONLY, default=d))
+    used = {p.name for p in sig_params}
+    if creation and "ctx" not in used:
+        sig_params.append(P("ctx", P.KEYWORD_ONLY, default=None))
+    if not symbol and "out" not in used:
+        sig_params.append(P("out", P.KEYWORD_ONLY, default=None))
+    if "name" not in used:
+        sig_params.append(P("name", P.KEYWORD_ONLY, default=None))
+    signature = inspect.Signature(sig_params)
+
+    lines = []
+    body = (opdef.doc or "").strip()
+    if body:
+        lines.append(body)
+        lines.append("")
+    lines.append("Parameters")
+    lines.append("----------")
+    for n in inputs:
+        lines.append(f"{n} : {kind_arr}")
+        lines.append(f"    Input {kind_arr.lower()}.")
+    if variadic:
+        lines.append(f"*args : {kind_arr}(s)")
+        lines.append("    Variadic input arrays.")
+    for n, d in params:
+        lines.append(f"{n} : {_type_name(d)}, optional, default={d!r}")
+    if creation:
+        lines.append("ctx : Context, optional")
+        lines.append("    Device context of the output.")
+    if not symbol:
+        lines.append("out : NDArray, optional")
+        lines.append("    Output buffer (written in place).")
+    lines.append("name : string, optional")
+    lines.append("    Name hint (symbolic graphs).")
+    lines.append("")
+    lines.append("Returns")
+    lines.append("-------")
+    n_out = opdef.num_outputs
+    if callable(n_out) or (isinstance(n_out, int) and n_out > 1):
+        lines.append(f"tuple of {kind_arr}")
+    else:
+        lines.append(f"out : {kind_arr}")
+    return signature, "\n".join(lines)

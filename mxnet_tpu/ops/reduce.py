@@ -28,9 +28,26 @@ def _axes(x, axis, exclude: bool):
     return ax
 
 
-def _reduce(jfn):
-    def impl(x, axis=None, keepdims: bool = False, exclude: bool = False, **_):
-        return jfn(x, axis=_axes(x, axis, exclude), keepdims=keepdims)
+def _safe_acc(x):
+    """MXNET_SAFE_ACCUMULATION: accumulate low-precision reductions in
+    f32 (ref: broadcast_reduce-inl.h AType promotion behind the same
+    flag). Returns (maybe-upcast x, dtype to cast the result back to)."""
+    from ..base import env
+    jnp = _jnp()
+    if env.get("MXNET_SAFE_ACCUMULATION") and \
+            x.dtype in (jnp.float16, jnp.bfloat16):
+        return x.astype(jnp.float32), x.dtype
+    return x, None
+
+
+def _reduce(jfn, accumulating: bool = True):
+    def impl(x, axis=None, keepdims: bool = False, exclude: bool = False,
+             **_):
+        back = None
+        if accumulating:
+            x, back = _safe_acc(x)
+        out = jfn(x, axis=_axes(x, axis, exclude), keepdims=keepdims)
+        return out if back is None else out.astype(back)
     return impl
 
 
@@ -39,13 +56,16 @@ register("mean")(_reduce(lambda x, **k: _jnp().mean(x, **k)))
 register("prod")(_reduce(lambda x, **k: _jnp().prod(x, **k)))
 register("nansum")(_reduce(lambda x, **k: _jnp().nansum(x, **k)))
 register("nanprod")(_reduce(lambda x, **k: _jnp().nanprod(x, **k)))
-register("max", aliases=("max_axis",))(_reduce(lambda x, **k: _jnp().max(x, **k)))
-register("min", aliases=("min_axis",))(_reduce(lambda x, **k: _jnp().min(x, **k)))
+register("max", aliases=("max_axis",))(
+    _reduce(lambda x, **k: _jnp().max(x, **k), accumulating=False))
+register("min", aliases=("min_axis",))(
+    _reduce(lambda x, **k: _jnp().min(x, **k), accumulating=False))
 
 
 @register("norm")
 def _norm(x, ord: int = 2, axis=None, keepdims: bool = False, **_):
     jnp = _jnp()
+    x, back = _safe_acc(x)
     if axis is None:
         ax = tuple(range(x.ndim))
     elif isinstance(axis, int):
@@ -53,8 +73,24 @@ def _norm(x, ord: int = 2, axis=None, keepdims: bool = False, **_):
     else:
         ax = tuple(axis)
     if ord == 1:
-        return jnp.sum(jnp.abs(x), axis=ax, keepdims=keepdims)
-    return jnp.sqrt(jnp.sum(jnp.square(x), axis=ax, keepdims=keepdims))
+        out = jnp.sum(jnp.abs(x), axis=ax, keepdims=keepdims)
+    else:
+        out = jnp.sqrt(jnp.sum(jnp.square(x), axis=ax, keepdims=keepdims))
+    return out if back is None else out.astype(back)
+
+
+@register("_square_sum", aliases=("square_sum",))
+def _square_sum(data, axis=None, keepdims: bool = False, exclude=False,
+                **_):
+    """sum(data**2) — the reference's fused row-sparse kernel
+    (src/operator/tensor/square_sum-inl.h); on TPU the dense fusion is
+    XLA's, this registers the graph-level op so sym.* graphs and the
+    partitioner can use it."""
+    jnp = _jnp()
+    x, back = _safe_acc(data)
+    out = jnp.sum(jnp.square(x), axis=_axes(x, axis, bool(exclude)),
+                  keepdims=keepdims)
+    return out if back is None else out.astype(back)
 
 
 @register("argmax", differentiable=False)

@@ -19,7 +19,9 @@ __all__ = ["seed", "next_key", "uniform", "normal", "randint", "gamma",
 _lock = threading.Lock()
 _key = None
 _counter = 0
-_seed_value = 0
+# None until the user calls seed() explicitly (MXNET_ENFORCE_DETERMINISM
+# uses this to detect unseeded host-side sampling)
+_seed_value = None
 
 # While tracing a CachedOp/jitted graph, random ops must derive their keys
 # from a *traced* key input (otherwise the trace would bake one fixed mask
@@ -87,8 +89,17 @@ def np_rng() -> "_numpy.random.Generator":
     Host-side samplers (e.g. the DGL neighbor samplers, which are numpy
     graph algorithms) draw from this instead of the global numpy RNG so
     that `mx.random.seed()` makes them reproducible like every
-    device-side random op."""
+    device-side random op.
+
+    Under MXNET_ENFORCE_DETERMINISM, using a host-side sampler without an
+    explicit mx.random.seed() is an error (the run would not be
+    reproducible across restarts)."""
     import numpy as _numpy
+    from .base import MXNetError, env
+    if env.get("MXNET_ENFORCE_DETERMINISM") and _seed_value is None:
+        raise MXNetError(
+            "MXNET_ENFORCE_DETERMINISM is set but mx.random.seed() was "
+            "never called — host-side sampling would be irreproducible")
     k = next_key()
     try:
         raw = _jr().key_data(k)  # typed keys (jax >= 0.4.16)

@@ -21,6 +21,13 @@ _register.populate(_this, _submodules)
 from .symbol import var, Variable, Group, load, load_json  # noqa: F401,E402
 from .executor import Executor  # noqa: F401,E402
 
+# symbolic control flow (ref: control_flow.cc) exposed as
+# sym.contrib.foreach / while_loop / cond, matching the reference surface
+from . import control_flow as _cf  # noqa: E402
+contrib.foreach = _cf.foreach
+contrib.while_loop = _cf.while_loop
+contrib.cond = _cf.cond
+
 # mark BatchNorm aux inputs for symbolic graphs
 from ..ops import registry as _reg
 _reg.get_op("BatchNorm").aux_inputs = (3, 4)

@@ -1,0 +1,204 @@
+"""Symbol-level control flow: foreach / while_loop / cond as graph nodes.
+
+Reference: src/operator/control_flow.cc (_foreach:1255, _while_loop:1316,
+_cond) — the reference embeds subgraphs as node attributes and executes
+them with a loop-aware executor. Here the Python body is traced once with
+proxy Variables into a sub-Symbol stored on the node; the graph executor
+(symbol/executor.py _walk) lowers the node to lax.scan / lax.while_loop /
+lax.cond, so symbolic control flow compiles into the same fused XLA
+program as the rest of the graph — usable from sym.* graphs, Module, and
+the subgraph partitioner (unlike the NDArray-level contrib.control_flow
+wrappers, which only work imperatively).
+
+Free variables (weights used inside the body) are discovered from the
+traced subgraph and become ordinary inputs of the node, so bind() treats
+them like any other argument.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Sequence, Tuple
+
+from ..base import MXNetError, check
+from ..ops import registry as _reg
+from ..ops.registry import register
+from . import symbol as _sym
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+# Registry stubs: these ops only execute through the graph executor's
+# dedicated lowering (executor._walk), which intercepts them BEFORE the
+# generic op path. num_outputs comes from the builder-recorded attr.
+def _cf_nout(n_inputs, params):
+    return int(params.get("__num_outputs__", 1))
+
+
+def _cf_stub(name):
+    def fn(*a, **k):
+        raise MXNetError(
+            f"{name} is a symbolic control-flow op; execute it through a "
+            "bound executor (sym.bind / Module), not imperatively")
+    return fn
+
+
+register("_foreach", num_outputs=_cf_nout, variadic=True)(
+    _cf_stub("_foreach"))
+register("_while_loop", num_outputs=_cf_nout, variadic=True)(
+    _cf_stub("_while_loop"))
+register("_cond", num_outputs=_cf_nout, variadic=True)(_cf_stub("_cond"))
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _trace_body(fn, proxy_names: Sequence[str]):
+    """Run fn on fresh proxy Variables; returns (outs, proxies)."""
+    proxies = [_sym.var(n) for n in proxy_names]
+    result = fn(proxies)
+    return result, proxies
+
+
+def _free_vars(sub: _sym.Symbol, bound_names: set) -> List[str]:
+    return [n for n in sub.list_arguments() + sub.list_auxiliary_states()
+            if n not in bound_names]
+
+
+def _free_var_syms(free: Sequence[str], subs: Sequence[_sym.Symbol]):
+    """Outer-graph Variables for the body's free names, keeping the aux
+    marking (BatchNorm moving stats inside the body must surface as aux
+    states of the enclosing graph)."""
+    aux = set()
+    for s in subs:
+        aux.update(s.list_auxiliary_states())
+    out = []
+    for n in free:
+        v = _sym.var(n)
+        if n in aux:
+            v._outputs[0][0].extra["aux"] = True
+        out.append(v)
+    return out
+
+
+def _make_node(op_name: str, sub_syms, attrs, input_syms, name):
+    node = _sym._Node(_reg.get_op(op_name), name, attrs,
+                      [s._outputs[0] for s in input_syms])
+    n_out = node.op.n_out(len(node.inputs), attrs)
+    return _sym.Symbol([(node, i) for i in range(n_out)])
+
+
+def foreach(body: Callable, data, init_states, name: str = None):
+    """Scan ``body`` over the leading axis of ``data`` — the symbolic
+    analog of mx.nd.contrib.foreach (ref: control_flow.cc:1255 _foreach).
+
+    body(data_slice, states) -> (outs, new_states), all Symbols. Returns
+    (stacked_outs, final_states).
+    """
+    name = name or _sym.new_node_name("foreach")
+    datas = _as_list(data)
+    states = _as_list(init_states)
+    single_data = not isinstance(data, (list, tuple))
+    single_state = not isinstance(init_states, (list, tuple))
+    check(datas and all(isinstance(d, _sym.Symbol) for d in datas),
+          "foreach: data must be Symbol(s)")
+
+    slice_names = [f"{name}_in{i}" for i in range(len(datas))]
+    state_names = [f"{name}_state{i}" for i in range(len(states))]
+    slice_vars = [_sym.var(n) for n in slice_names]
+    state_vars = [_sym.var(n) for n in state_names]
+    out, new_states = body(
+        slice_vars[0] if single_data else slice_vars,
+        state_vars[0] if single_state else state_vars)
+    outs = _as_list(out)
+    nstates = _as_list(new_states)
+    check(len(nstates) == len(states),
+          "foreach: body must return as many states as it was given")
+    sub = _sym.Group([*outs, *nstates])
+
+    free = _free_vars(sub, set(slice_names + state_names))
+    attrs = {
+        "__subgraph__": sub,
+        "__cf_slice_names__": tuple(slice_names),
+        "__cf_state_names__": tuple(state_names),
+        "__cf_free_names__": tuple(free),
+        "__cf_n_out__": len(outs),
+        "__num_outputs__": len(outs) + len(states),
+    }
+    inputs = datas + states + _free_var_syms(free, [sub])
+    res = _make_node("_foreach", sub, attrs, inputs, name)
+    stacked = [res[i] for i in range(len(outs))]
+    finals = [res[len(outs) + i] for i in range(len(states))]
+    return (stacked[0] if single_data and len(stacked) == 1 else
+            (stacked[0] if len(stacked) == 1 else stacked)), \
+        (finals[0] if single_state else finals)
+
+
+def while_loop(cond_fn: Callable, func: Callable, loop_vars,
+               max_iterations: int, name: str = None):
+    """Bounded symbolic while loop (ref: control_flow.cc:1316
+    _while_loop). func(*loop_vars) -> (step_outputs, new_loop_vars);
+    step outputs land in a max_iterations buffer. Returns
+    (buffered_outputs, final_loop_vars)."""
+    name = name or _sym.new_node_name("while_loop")
+    check(max_iterations and max_iterations > 0,
+          "while_loop requires max_iterations")
+    single_var = not isinstance(loop_vars, (list, tuple))
+    lvars = _as_list(loop_vars)
+    var_names = [f"{name}_var{i}" for i in range(len(lvars))]
+    var_syms = [_sym.var(n) for n in var_names]
+    pred = cond_fn(*var_syms)
+    outs, new_vars = func(*var_syms)
+    outs = _as_list(outs)
+    nvars = _as_list(new_vars)
+    check(len(nvars) == len(lvars),
+          "while_loop: func must return as many loop vars as it was given")
+    sub = _sym.Group([pred, *outs, *nvars])
+    free = _free_vars(sub, set(var_names))
+    attrs = {
+        "__subgraph__": sub,
+        "__cf_state_names__": tuple(var_names),
+        "__cf_free_names__": tuple(free),
+        "__cf_n_out__": len(outs),
+        "__cf_max_iter__": int(max_iterations),
+        "__num_outputs__": len(outs) + len(lvars),
+    }
+    inputs = lvars + _free_var_syms(free, [sub])
+    res = _make_node("_while_loop", sub, attrs, inputs, name)
+    buffered = [res[i] for i in range(len(outs))]
+    finals = [res[len(outs) + i] for i in range(len(lvars))]
+    return (buffered[0] if len(buffered) == 1 else buffered), \
+        (finals[0] if single_var else finals)
+
+
+def cond(pred, then_func: Callable, else_func: Callable, inputs=None,
+         name: str = None):
+    """Symbolic if/else (ref: control_flow.cc _cond). Both branches are
+    traced on the same inputs and must produce matching output shapes."""
+    name = name or _sym.new_node_name("cond")
+    check(isinstance(pred, _sym.Symbol), "cond: pred must be a Symbol")
+    ins = _as_list(inputs)
+    in_names = [f"{name}_in{i}" for i in range(len(ins))]
+    in_syms = [_sym.var(n) for n in in_names]
+    then_out = _as_list(then_func(*in_syms) if ins else then_func())
+    else_out = _as_list(else_func(*in_syms) if ins else else_func())
+    check(len(then_out) == len(else_out),
+          "cond: branches must produce the same number of outputs")
+    # separate subgraphs per branch so the executor's lax.cond only
+    # computes the branch it takes
+    sub_then = _sym.Group(then_out)
+    sub_else = _sym.Group(else_out)
+    bound = set(in_names)
+    free = sorted(set(_free_vars(sub_then, bound))
+                  | set(_free_vars(sub_else, bound)))
+    attrs = {
+        "__subgraph__": sub_then,
+        "__cf_else__": sub_else,
+        "__cf_in_names__": tuple(in_names),
+        "__cf_free_names__": tuple(free),
+        "__cf_n_out__": len(then_out),
+        "__num_outputs__": len(then_out),
+    }
+    node_inputs = [pred] + ins + _free_var_syms(free, [sub_then, sub_else])
+    return _make_node("_cond", sub_then, attrs, node_inputs, name)

@@ -47,6 +47,112 @@ def _bn_aux_update(in_arrays, out_arrays, params):
 
 AUX_UPDATERS: Dict[str, Callable] = {"BatchNorm": _bn_aux_update}
 
+
+def _lower_control_flow(node, ins, is_train):
+    """Lower a symbolic control-flow node (symbol/control_flow.py) to
+    lax.scan / lax.while_loop / lax.cond — the executor-side half of the
+    reference's control_flow.cc loop operators."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    sub = node.attrs["__subgraph__"]
+    free_names = node.attrs["__cf_free_names__"]
+    n_out = node.attrs["__cf_n_out__"]
+    # free variables marked aux (e.g. BatchNorm moving stats inside the
+    # body) must route through _walk's aux_map, not arg_map
+    aux_names = set(sub.list_auxiliary_states())
+    if "__cf_else__" in node.attrs:
+        aux_names |= set(node.attrs["__cf_else__"].list_auxiliary_states())
+
+    def _split_maps(frees):
+        args = {k: v for k, v in frees.items() if k not in aux_names}
+        auxs = {k: v for k, v in frees.items() if k in aux_names}
+        return args, auxs
+
+    if node.op.name == "_foreach":
+        slice_names = node.attrs["__cf_slice_names__"]
+        state_names = node.attrs["__cf_state_names__"]
+        n_d, n_s = len(slice_names), len(state_names)
+        datas = ins[:n_d]
+        states = tuple(ins[n_d:n_d + n_s])
+        frees, faux = _split_maps(dict(zip(free_names,
+                                           ins[n_d + n_s:])))
+
+        def step(carry, slices):
+            m = dict(frees)
+            m.update(zip(slice_names, slices))
+            m.update(zip(state_names, carry))
+            res = _walk(sub, m, dict(faux), is_train)
+            return tuple(res[n_out:]), tuple(res[:n_out])
+
+        final, stacked = lax.scan(step, states, tuple(datas))
+        return list(stacked) + list(final)
+
+    if node.op.name == "_while_loop":
+        state_names = node.attrs["__cf_state_names__"]
+        max_iter = node.attrs["__cf_max_iter__"]
+        n_s = len(state_names)
+        states = tuple(ins[:n_s])
+        frees, faux = _split_maps(dict(zip(free_names, ins[n_s:])))
+
+        def run_sub(vars_):
+            m = dict(frees)
+            m.update(zip(state_names, vars_))
+            return _walk(sub, m, dict(faux), is_train)
+
+        # probe output shapes for the buffers
+        probe = jax.eval_shape(lambda v: run_sub(v), states)
+        bufs = tuple(jnp.zeros((max_iter,) + tuple(p.shape), p.dtype)
+                     for p in probe[1:1 + n_out])
+
+        def body(carry):
+            i, vars_, bufs_, alive = carry
+            res = run_sub(vars_)
+            pred = res[0].reshape(()).astype(bool)
+            outs = res[1:1 + n_out]
+            new_vars = tuple(res[1 + n_out:])
+            # write step outputs only while the predicate held
+            bufs_ = tuple(
+                lax.cond(pred,
+                         lambda b, o: lax.dynamic_update_index_in_dim(
+                             b, o.astype(b.dtype), i, 0),
+                         lambda b, o: b, b, o)
+                for b, o in zip(bufs_, outs))
+            vars_ = tuple(
+                jax.tree_util.tree_map(
+                    lambda nv, ov: jnp.where(pred, nv, ov), nv, ov)
+                for nv, ov in zip(new_vars, vars_))
+            return i + jnp.where(pred, 1, 0), vars_, bufs_, pred
+
+        def cond_f(carry):
+            i, vars_, _, alive = carry
+            return alive & (i < max_iter)
+
+        i0 = jnp.asarray(0, jnp.int32)
+        _, final_vars, bufs, _ = lax.while_loop(
+            cond_f, body, (i0, states, bufs, jnp.asarray(True)))
+        return list(bufs) + list(final_vars)
+
+    # _cond: separate then/else subgraphs, so the untaken branch is not
+    # computed (lax.cond executes exactly one branch on TPU)
+    in_names = node.attrs["__cf_in_names__"]
+    n_i = len(in_names)
+    pred = ins[0].reshape(()).astype(bool)
+    branch_ins = ins[1:1 + n_i]
+    frees, faux = _split_maps(dict(zip(free_names, ins[1 + n_i:])))
+
+    def run_branch(branch_sub):
+        def f(args):
+            m = dict(frees)
+            m.update(zip(in_names, args))
+            res = _walk(branch_sub, m, dict(faux), is_train)
+            return tuple(res[:n_out])
+        return f
+
+    return list(lax.cond(pred, run_branch(sub),
+                         run_branch(node.attrs["__cf_else__"]),
+                         tuple(branch_ins)))
+
 _TRAINING_PARAM_CACHE: Dict[int, bool] = {}
 
 
@@ -75,6 +181,11 @@ def _walk(symbol, arg_map: Dict[str, Any], aux_map: Dict[str, Any],
             else:
                 check(name in arg_map, f"missing argument {name}")
                 cache[(id(node), 0)] = arg_map[name]
+        elif node.op.name in ("_foreach", "_while_loop", "_cond"):
+            ins = [cache[(id(i), k)] for i, k in node.inputs]
+            outs = _lower_control_flow(node, ins, is_train)
+            for i, o in enumerate(outs):
+                cache[(id(node), i)] = o
         elif node.op.name == "_subgraph":
             # inline a fused region with THIS walk's training/aux context
             # (the op-registry fallback runs inference-mode only)

@@ -28,8 +28,12 @@ def make_sym_function(name: str, opdef):
         return _sym.create(name, inputs, params, name=node_name)
 
     generic.__name__ = name
-    generic.__doc__ = opdef.doc
     generic.__module__ = "mxnet_tpu.symbol.op"
+    from ..ops.opdoc import signature_and_doc
+    sig, doc = signature_and_doc(name, opdef, creation=opdef.creation,
+                                 symbol=True)
+    generic.__signature__ = sig
+    generic.__doc__ = doc
     return generic
 
 

@@ -936,6 +936,10 @@ COVERED_ELSEWHERE = {
     **{op: "tests/test_pallas_ops.py" for op in [
         "_contrib_flash_attention", "_contrib_interleaved_matmul_selfatt_qk",
         "_contrib_interleaved_matmul_selfatt_valatt"]},
+    # symbolic control flow + graph-level sparse ops
+    **{op: "tests/test_symbol_control_flow.py" for op in [
+        "_foreach", "_while_loop", "_cond", "cast_storage",
+        "sparse_retain", "_square_sum"]},
     # misc dedicated files
     "CTCLoss": "tests/test_ctc.py",
     "Custom": "tests/test_custom_op.py",

@@ -1,0 +1,168 @@
+"""Symbol-level control flow (ref: src/operator/control_flow.cc —
+_foreach:1255, _while_loop:1316, _cond) + graph-level sparse ops
+(cast_storage/sparse_retain/_square_sum in sym.* graphs).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+
+
+def _run(out_sym, args, grads=None, out_grads=None, is_train=False):
+    arg_nds = {k: nd.array(v) for k, v in args.items()}
+    grad_nds = {k: nd.zeros(v.shape) for k, v in args.items()} \
+        if grads else None
+    ex = out_sym.bind(mx.cpu(), args=arg_nds, args_grad=grad_nds)
+    outs = ex.forward(is_train=is_train or bool(grads))
+    if grads:
+        ex.backward(out_grads=out_grads)
+        return [o.asnumpy() for o in outs], \
+            {k: g.asnumpy() for k, g in ex.grad_dict.items()}
+    return [o.asnumpy() for o in outs]
+
+
+def test_sym_foreach_cumsum():
+    data = sym.Variable("data")
+    init = sym.Variable("init")
+
+    def body(x, s):
+        new_s = sym.elemwise_add(x, s)
+        return new_s, new_s
+
+    outs, final = sym.contrib.foreach(body, data, init)
+    g = sym.Group([outs, final])
+    rs = np.random.RandomState(0)
+    d = rs.randn(5, 3).astype(np.float32)
+    s0 = np.zeros(3, np.float32)
+    res = _run(g, {"data": d, "init": s0})
+    np.testing.assert_allclose(res[0], np.cumsum(d, axis=0), rtol=1e-5)
+    np.testing.assert_allclose(res[1], d.sum(0), rtol=1e-5)
+
+
+def test_sym_foreach_with_free_weight():
+    """Weights used inside the body become ordinary graph arguments."""
+    data = sym.Variable("data")
+    init = sym.Variable("init")
+    w = sym.Variable("w")
+
+    def body(x, s):
+        h = sym.broadcast_mul(x, w)
+        new_s = sym.elemwise_add(h, s)
+        return new_s, new_s
+
+    outs, final = sym.contrib.foreach(body, data, init)
+    assert "w" in sym.Group([outs]).list_arguments()
+    rs = np.random.RandomState(1)
+    d = rs.randn(4, 3).astype(np.float32)
+    wv = rs.randn(3).astype(np.float32)
+    res = _run(sym.Group([final]), {"data": d, "init": np.zeros(3, np.float32),
+                                    "w": wv})
+    np.testing.assert_allclose(res[0], (d * wv).sum(0), rtol=1e-5)
+
+
+def test_sym_foreach_gradient():
+    data = sym.Variable("data")
+    init = sym.Variable("init")
+
+    def body(x, s):
+        new_s = sym.elemwise_add(sym.square(x), s)
+        return new_s, new_s
+
+    _, final = sym.contrib.foreach(body, data, init)
+    rs = np.random.RandomState(2)
+    d = rs.randn(4, 3).astype(np.float32)
+    outs, grads = _run(final, {"data": d, "init": np.zeros(3, np.float32)},
+                       grads=True, out_grads=nd.ones((3,)))
+    # d(sum x^2)/dx = 2x
+    np.testing.assert_allclose(grads["data"], 2 * d, rtol=1e-5)
+
+
+def test_sym_while_loop_counts():
+    """Run until i >= 4: buffered outputs + final loop vars."""
+    i = sym.Variable("i")
+    acc = sym.Variable("acc")
+
+    def cond_fn(i, acc):
+        return sym._internal._lesser_scalar(i, scalar=4.0)
+
+    def func(i, acc):
+        new_i = sym._internal._plus_scalar(i, scalar=1.0)
+        new_acc = sym.elemwise_add(acc, new_i)
+        return new_i, [new_i, new_acc]
+
+    outs, finals = sym.contrib.while_loop(cond_fn, func, [i, acc],
+                                          max_iterations=8)
+    g = sym.Group([outs, *finals])
+    res = _run(g, {"i": np.zeros((1,), np.float32),
+                   "acc": np.zeros((1,), np.float32)})
+    # steps produce i = 1..4, then predicate fails
+    np.testing.assert_allclose(res[0][:4, 0], [1, 2, 3, 4])
+    np.testing.assert_allclose(res[1], [4.0])
+    np.testing.assert_allclose(res[2], [1 + 2 + 3 + 4.0])
+
+
+def test_sym_cond_branches():
+    pred = sym.Variable("p")
+    x = sym.Variable("x")
+    out = sym.contrib.cond(pred,
+                           lambda a: sym.square(a),
+                           lambda a: sym.negative(a), inputs=[x])
+    xv = np.array([2.0, -3.0], np.float32)
+    res_t = _run(out, {"p": np.array([1.0], np.float32), "x": xv})
+    res_f = _run(out, {"p": np.array([0.0], np.float32), "x": xv})
+    np.testing.assert_allclose(res_t[0], xv ** 2)
+    np.testing.assert_allclose(res_f[0], -xv)
+
+
+def test_sym_square_sum_and_sparse_ops_in_graph():
+    x = sym.Variable("x")
+    idx = sym.Variable("idx")
+    ss = sym.op._square_sum(x, axis=(1,))
+    cs = sym.op.cast_storage(x, stype="row_sparse")
+    sr = sym.op.sparse_retain(x, idx)
+    g = sym.Group([ss, cs, sr])
+    rs = np.random.RandomState(3)
+    xv = rs.randn(4, 3).astype(np.float32)
+    res = _run(g, {"x": xv, "idx": np.array([0, 2], np.float32)})
+    np.testing.assert_allclose(res[0], (xv ** 2).sum(1), rtol=1e-5)
+    np.testing.assert_allclose(res[1], xv, rtol=1e-6)  # dense in-graph
+    want = xv.copy()
+    want[[1, 3]] = 0
+    np.testing.assert_allclose(res[2], want, rtol=1e-6)
+
+
+def test_sym_foreach_with_aux_state_op():
+    """An op with auxiliary states (BatchNorm moving stats) inside the
+    body: aux free variables must route through the executor's aux_map."""
+    data = sym.Variable("data")          # (T, B, C)
+    init = sym.Variable("init")
+    gamma = sym.Variable("gamma")
+    beta = sym.Variable("beta")
+
+    def body(x, s):
+        h = sym.BatchNorm(x, gamma, beta, use_global_stats=True,
+                          fix_gamma=False, axis=1, name="bn")[0]
+        return sym.elemwise_add(h, s), s
+
+    outs, _ = sym.contrib.foreach(body, data, init)
+    aux = sym.Group([outs]).list_auxiliary_states()
+    assert any("moving_mean" in a for a in aux), aux
+    rs = np.random.RandomState(4)
+    T, B, C = 3, 2, 4
+    d = rs.randn(T, B, C).astype(np.float32)
+    arg_nds = {"data": nd.array(d), "init": nd.zeros((B, C)),
+               "gamma": nd.ones((C,)), "beta": nd.zeros((C,))}
+    aux_nds = {"bn_moving_mean": nd.zeros((C,)),
+               "bn_moving_var": nd.ones((C,))}
+    ex = sym.Group([outs]).bind(mx.cpu(), args=arg_nds,
+                                aux_states=aux_nds)
+    res = ex.forward(is_train=False)[0].asnumpy()
+    # global stats mean=0 var=1 -> BN is ~identity (eps only)
+    np.testing.assert_allclose(res, d / np.sqrt(1 + 1e-3), rtol=1e-4)
+
+
+def test_cf_op_imperative_invoke_raises():
+    from mxnet_tpu.base import MXNetError
+    with pytest.raises(MXNetError, match="control-flow"):
+        nd.imperative_invoke("_foreach", (nd.ones((2, 2)),), {})
