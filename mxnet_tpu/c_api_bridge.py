@@ -1,0 +1,270 @@
+"""Python half of the general C API (src/c_api.cc).
+
+Reference: include/mxnet/c_api.h (198 functions over NDArray lifecycle,
+operator invocation, symbol composition, executor, autograd, kvstore).
+The C library embeds CPython (same mechanism as c_predict_api.cc) and
+calls the functions here; handles crossing the C boundary are plain
+Python objects held as PyObject* by the caller.
+
+Buffers cross as (address, nbytes) pairs — numpy views over caller
+memory — so MXNDArraySyncCopyFromCPU/ToCPU match the reference contract.
+"""
+from __future__ import annotations
+
+import ctypes
+import json
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.base import MXNetError
+
+_DTYPE_CODES = {0: np.float32, 1: np.float64, 2: np.float16, 3: np.uint8,
+                4: np.int32, 5: np.int8, 6: np.int64}
+_DTYPE_RCODES = {np.dtype(v): k for k, v in _DTYPE_CODES.items()}
+
+
+def version() -> int:
+    return 10500  # reference MXNET_VERSION parity (1.5.0)
+
+
+# -- NDArray ---------------------------------------------------------------
+
+def ndarray_create(shape: Sequence[int], dtype_code: int, ctx_type: int,
+                   ctx_id: int):
+    dt = _DTYPE_CODES[int(dtype_code)]
+    return nd.zeros(tuple(int(s) for s in shape), dtype=dt)
+
+
+def ndarray_create_none():
+    return nd.array(np.zeros((0,), np.float32))
+
+def _np_view(addr: int, nbytes: int):
+    buf = (ctypes.c_char * nbytes).from_address(addr)
+    return np.frombuffer(buf, dtype=np.uint8)
+
+
+def ndarray_sync_copy_from_cpu(arr, addr: int, size: int) -> None:
+    view = _np_view(addr, size * arr.dtype.itemsize)
+    data = view.view(arr.dtype)[:size].reshape(arr.shape)
+    arr._rebind(nd.array(data.copy(), dtype=arr.dtype)._data)
+
+
+def ndarray_sync_copy_to_cpu(arr, addr: int, size: int) -> None:
+    out = np.ascontiguousarray(arr.asnumpy())
+    view = _np_view(addr, size * out.dtype.itemsize)
+    view.view(out.dtype)[:size] = out.reshape(-1)[:size]
+
+
+def ndarray_shape(arr) -> List[int]:
+    return [int(s) for s in arr.shape]
+
+
+def ndarray_dtype(arr) -> int:
+    return _DTYPE_RCODES[np.dtype(arr.dtype)]
+
+
+def ndarray_slice(arr, begin: int, end: int):
+    return arr[int(begin):int(end)]
+
+
+def ndarray_at(arr, idx: int):
+    return arr[int(idx)]
+
+
+def ndarray_reshape(arr, shape: Sequence[int]):
+    return arr.reshape(tuple(int(s) for s in shape))
+
+
+def ndarray_save(fname: str, arrays, names) -> None:
+    if names:
+        nd.save(fname, dict(zip(list(names), list(arrays))))
+    else:
+        nd.save(fname, list(arrays))
+
+
+def ndarray_load(fname: str):
+    loaded = nd.load(fname)
+    if isinstance(loaded, dict):
+        names = list(loaded.keys())
+        arrays = [loaded[k] for k in names]
+    else:
+        names, arrays = [], list(loaded)
+    return names, arrays
+
+
+def ndarray_wait_all() -> None:
+    nd.waitall()
+
+
+def ndarray_wait(arr) -> None:
+    arr.wait_to_read()
+
+
+# -- operator invocation ---------------------------------------------------
+
+def list_all_op_names() -> List[str]:
+    from mxnet_tpu.ops import registry as reg
+    return reg.list_ops()
+
+
+def imperative_invoke(op_name: str, inputs, param_keys, param_vals):
+    params: Dict[str, Any] = {}
+    for k, v in zip(list(param_keys), list(param_vals)):
+        params[str(k)] = _parse_param(str(v))
+    out = nd.imperative_invoke(op_name, tuple(inputs), params)
+    return list(out) if isinstance(out, (list, tuple)) else [out]
+
+
+def _parse_param(v: str):
+    try:
+        return json.loads(v)
+    except (ValueError, TypeError):
+        pass
+    if v.startswith("(") and v.endswith(")"):
+        inner = v[1:-1].strip()
+        if not inner:
+            return ()
+        return tuple(_parse_param(x.strip()) for x in inner.split(","))
+    lv = v.lower()
+    if lv in ("true", "false"):
+        return lv == "true"
+    return v
+
+
+# -- symbol ----------------------------------------------------------------
+
+def symbol_create_variable(name: str):
+    return sym.var(name)
+
+
+def symbol_create_atomic(op_name: str, param_keys, param_vals,
+                         input_syms, input_names, name: str):
+    params = {str(k): _parse_param(str(v))
+              for k, v in zip(list(param_keys), list(param_vals))}
+    from mxnet_tpu.symbol.symbol import create
+    return create(op_name, list(input_syms), params, name=name or None)
+
+
+def symbol_from_json(js: str):
+    return sym.load_json(js)
+
+
+def symbol_to_json(s) -> str:
+    return s.tojson()
+
+
+def symbol_list_arguments(s) -> List[str]:
+    return s.list_arguments()
+
+
+def symbol_list_outputs(s) -> List[str]:
+    return s.list_outputs()
+
+
+def symbol_list_aux(s) -> List[str]:
+    return s.list_auxiliary_states()
+
+
+def symbol_infer_shape(s, names, shapes):
+    known = {str(n): tuple(int(x) for x in shp)
+             for n, shp in zip(list(names), list(shapes))}
+    arg_shapes, out_shapes, aux_shapes = s.infer_shape(**known)
+    return arg_shapes, out_shapes, aux_shapes
+
+
+def symbol_get_atomic_symbol_info(op_name: str):
+    """(name, description, signature_str) — the codegen metadata."""
+    from mxnet_tpu.ops import registry as reg
+    from mxnet_tpu.ops.opdoc import signature_and_doc
+    opdef = reg.get_op(op_name)
+    sig, doc = signature_and_doc(op_name, opdef, creation=opdef.creation)
+    return op_name, doc, str(sig)
+
+
+# -- executor --------------------------------------------------------------
+
+def executor_bind(s, args, arg_names, grads, grad_names, aux, aux_names):
+    arg_map = dict(zip(list(arg_names), list(args)))
+    grad_map = dict(zip(list(grad_names), list(grads))) if grads else None
+    aux_map = dict(zip(list(aux_names), list(aux))) if aux else None
+    return s.bind(mx.cpu(), args=arg_map, args_grad=grad_map,
+                  aux_states=aux_map)
+
+
+def executor_forward(ex, is_train: int) -> None:
+    ex.forward(is_train=bool(is_train))
+
+
+def executor_backward(ex, out_grads) -> None:
+    ex.backward(out_grads=list(out_grads) if out_grads else None)
+
+
+def executor_outputs(ex):
+    return list(ex.outputs)
+
+
+# -- autograd --------------------------------------------------------------
+
+def autograd_set_recording(flag: int) -> int:
+    from mxnet_tpu import autograd
+    return int(autograd.set_recording(bool(flag)))
+
+
+def autograd_set_training(flag: int) -> int:
+    from mxnet_tpu import autograd
+    return int(autograd.set_training(bool(flag)))
+
+
+def autograd_mark_variables(arrays) -> None:
+    for a in arrays:
+        a.attach_grad()
+
+
+def autograd_backward(outputs) -> None:
+    from mxnet_tpu import autograd
+    autograd.backward(list(outputs))
+
+
+def autograd_get_grad(arr):
+    g = arr.grad
+    if g is None:
+        raise MXNetError("no gradient attached")
+    return g
+
+
+# -- kvstore ---------------------------------------------------------------
+
+def kvstore_create(typ: str):
+    from mxnet_tpu import kvstore as kv_mod
+    return kv_mod.create(typ or "local")
+
+
+def kvstore_init(kv, keys, values) -> None:
+    for k, v in zip(list(keys), list(values)):
+        kv.init(str(k), v)
+
+
+def kvstore_push(kv, keys, values) -> None:
+    for k, v in zip(list(keys), list(values)):
+        kv.push(str(k), v)
+
+
+def kvstore_pull(kv, keys, outs) -> None:
+    for k, o in zip(list(keys), list(outs)):
+        kv.pull(str(k), out=o)
+
+
+def kvstore_rank(kv) -> int:
+    return int(kv.rank)
+
+
+def kvstore_size(kv) -> int:
+    return int(kv.num_workers)
+
+
+def random_seed(seed: int) -> None:
+    mx.random.seed(int(seed))

@@ -166,8 +166,20 @@ class KVStoreBase:
         for k, outs in _group(key, out):
             check(k in self._store, f"kvstore key {k} not initialized")
             src = self._store[k]
+            data = src._data
+            from jax.sharding import NamedSharding
+            if isinstance(getattr(data, "sharding", None), NamedSharding) \
+                    and getattr(data.sharding, "spec", None) and \
+                    data.sharding.spec[0] is not None:
+                # the table lives sharded in the store; a FULL pull hands
+                # the worker a plain single-device array (the reference's
+                # worker-side copy semantics) — use row_sparse_pull to
+                # touch only active rows without the gather
+                import jax
+                data = jax.device_put(data, jax.devices()[0])
             for o in outs:
-                o._rebind(src.as_in_context(o.context)._data)
+                o._rebind(_nd.NDArray(data, ctx=src._ctx)
+                          .as_in_context(o.context)._data)
 
     def pushpull(self, key, value, out=None, priority: int = 0) -> None:
         self.push(key, value, priority)

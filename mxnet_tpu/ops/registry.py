@@ -89,7 +89,10 @@ class OpDef:
         cached = self._jit_cache.get(params_key)
         if cached is None:
             import jax
-            kwargs = dict(params_key)
+            # strip the trace-time flag suffix (booleans) — only real
+            # (name, value) param pairs become kwargs
+            kwargs = dict(kv for kv in params_key
+                          if isinstance(kv, tuple) and len(kv) == 2)
             fn = self.fn
 
             def call(*arrays):
@@ -149,6 +152,13 @@ def normalize_params(params: Dict[str, Any]) -> Dict[str, Any]:
     return {k: coerce_param(v) for k, v in params.items() if v is not None}
 
 
+def _trace_time_flags() -> Tuple:
+    """Env flags read INSIDE op impls at trace time (they change the
+    compiled program, so they must be part of the jit-cache key —
+    otherwise toggling the flag after first compile is a silent no-op)."""
+    return (bool(env.get("MXNET_SAFE_ACCUMULATION")),)
+
+
 def invoke_jax(opdef: OpDef, arrays: Sequence, params: Dict[str, Any]):
     """Execute an op on raw jax arrays through the jit cache.
 
@@ -157,7 +167,7 @@ def invoke_jax(opdef: OpDef, arrays: Sequence, params: Dict[str, Any]):
     with the engine push replaced by XLA async dispatch.
     """
     params = normalize_params(params)
-    key = hashable_params(params)
+    key = hashable_params(params) + _trace_time_flags()
     from .. import profiler as _prof
     profiling = _prof.is_active()
     t0 = __import__("time").perf_counter() if profiling else 0.0

@@ -54,13 +54,6 @@ def _as_list(x):
     return list(x) if isinstance(x, (list, tuple)) else [x]
 
 
-def _trace_body(fn, proxy_names: Sequence[str]):
-    """Run fn on fresh proxy Variables; returns (outs, proxies)."""
-    proxies = [_sym.var(n) for n in proxy_names]
-    result = fn(proxies)
-    return result, proxies
-
-
 def _free_vars(sub: _sym.Symbol, bound_names: set) -> List[str]:
     return [n for n in sub.list_arguments() + sub.list_auxiliary_states()
             if n not in bound_names]
@@ -82,7 +75,7 @@ def _free_var_syms(free: Sequence[str], subs: Sequence[_sym.Symbol]):
     return out
 
 
-def _make_node(op_name: str, sub_syms, attrs, input_syms, name):
+def _make_node(op_name: str, attrs, input_syms, name):
     node = _sym._Node(_reg.get_op(op_name), name, attrs,
                       [s._outputs[0] for s in input_syms])
     n_out = node.op.n_out(len(node.inputs), attrs)
@@ -111,6 +104,7 @@ def foreach(body: Callable, data, init_states, name: str = None):
     out, new_states = body(
         slice_vars[0] if single_data else slice_vars,
         state_vars[0] if single_state else state_vars)
+    single_out = not isinstance(out, (list, tuple))
     outs = _as_list(out)
     nstates = _as_list(new_states)
     check(len(nstates) == len(states),
@@ -127,11 +121,11 @@ def foreach(body: Callable, data, init_states, name: str = None):
         "__num_outputs__": len(outs) + len(states),
     }
     inputs = datas + states + _free_var_syms(free, [sub])
-    res = _make_node("_foreach", sub, attrs, inputs, name)
+    res = _make_node("_foreach", attrs, inputs, name)
     stacked = [res[i] for i in range(len(outs))]
     finals = [res[len(outs) + i] for i in range(len(states))]
-    return (stacked[0] if single_data and len(stacked) == 1 else
-            (stacked[0] if len(stacked) == 1 else stacked)), \
+    # mirror the body's output structure (reference contrib.foreach)
+    return (stacked[0] if single_out else stacked), \
         (finals[0] if single_state else finals)
 
 
@@ -165,7 +159,7 @@ def while_loop(cond_fn: Callable, func: Callable, loop_vars,
         "__num_outputs__": len(outs) + len(lvars),
     }
     inputs = lvars + _free_var_syms(free, [sub])
-    res = _make_node("_while_loop", sub, attrs, inputs, name)
+    res = _make_node("_while_loop", attrs, inputs, name)
     buffered = [res[i] for i in range(len(outs))]
     finals = [res[len(outs) + i] for i in range(len(lvars))]
     return (buffered[0] if len(buffered) == 1 else buffered), \
@@ -201,4 +195,4 @@ def cond(pred, then_func: Callable, else_func: Callable, inputs=None,
         "__num_outputs__": len(then_out),
     }
     node_inputs = [pred] + ins + _free_var_syms(free, [sub_then, sub_else])
-    return _make_node("_cond", sub_then, attrs, node_inputs, name)
+    return _make_node("_cond", attrs, node_inputs, name)

@@ -48,10 +48,14 @@ def _bn_aux_update(in_arrays, out_arrays, params):
 AUX_UPDATERS: Dict[str, Callable] = {"BatchNorm": _bn_aux_update}
 
 
-def _lower_control_flow(node, ins, is_train):
+def _lower_control_flow(node, ins, is_train, collect_aux=None):
     """Lower a symbolic control-flow node (symbol/control_flow.py) to
     lax.scan / lax.while_loop / lax.cond — the executor-side half of the
-    reference's control_flow.cc loop operators."""
+    reference's control_flow.cc loop operators.
+
+    Auxiliary states used inside the body (BatchNorm moving stats) are
+    carried through the loop and their FINAL values surface in
+    ``collect_aux`` so training forwards update them like any other op."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -63,11 +67,17 @@ def _lower_control_flow(node, ins, is_train):
     aux_names = set(sub.list_auxiliary_states())
     if "__cf_else__" in node.attrs:
         aux_names |= set(node.attrs["__cf_else__"].list_auxiliary_states())
+    aux_free = [n for n in free_names if n in aux_names]
 
     def _split_maps(frees):
         args = {k: v for k, v in frees.items() if k not in aux_names}
         auxs = {k: v for k, v in frees.items() if k in aux_names}
         return args, auxs
+
+    def _publish_aux(values):
+        if collect_aux is not None:
+            for n, v in zip(aux_free, values):
+                collect_aux[n] = v
 
     if node.op.name == "_foreach":
         slice_names = node.attrs["__cf_slice_names__"]
@@ -77,16 +87,23 @@ def _lower_control_flow(node, ins, is_train):
         states = tuple(ins[n_d:n_d + n_s])
         frees, faux = _split_maps(dict(zip(free_names,
                                            ins[n_d + n_s:])))
+        aux0 = tuple(faux[n] for n in aux_free)
 
         def step(carry, slices):
+            st, au = carry[:n_s], carry[n_s:]
             m = dict(frees)
             m.update(zip(slice_names, slices))
-            m.update(zip(state_names, carry))
-            res = _walk(sub, m, dict(faux), is_train)
-            return tuple(res[n_out:]), tuple(res[:n_out])
+            m.update(zip(state_names, st))
+            am = dict(zip(aux_free, au))
+            coll = {}
+            res = _walk(sub, m, am, is_train,
+                        collect_aux=coll if is_train else None)
+            new_au = tuple(coll.get(n, am[n]) for n in aux_free)
+            return tuple(res[n_out:]) + new_au, tuple(res[:n_out])
 
-        final, stacked = lax.scan(step, states, tuple(datas))
-        return list(stacked) + list(final)
+        final, stacked = lax.scan(step, states + aux0, tuple(datas))
+        _publish_aux(final[n_s:])
+        return list(stacked) + list(final[:n_s])
 
     if node.op.name == "_while_loop":
         state_names = node.attrs["__cf_state_names__"]
@@ -94,20 +111,26 @@ def _lower_control_flow(node, ins, is_train):
         n_s = len(state_names)
         states = tuple(ins[:n_s])
         frees, faux = _split_maps(dict(zip(free_names, ins[n_s:])))
+        aux0 = tuple(faux[n] for n in aux_free)
 
-        def run_sub(vars_):
+        def run_sub(vars_, au):
             m = dict(frees)
             m.update(zip(state_names, vars_))
-            return _walk(sub, m, dict(faux), is_train)
+            am = dict(zip(aux_free, au))
+            coll = {}
+            res = _walk(sub, m, am, is_train,
+                        collect_aux=coll if is_train else None)
+            new_au = tuple(coll.get(n, am[n]) for n in aux_free)
+            return res, new_au
 
         # probe output shapes for the buffers
-        probe = jax.eval_shape(lambda v: run_sub(v), states)
+        probe = jax.eval_shape(lambda v: run_sub(v, aux0)[0], states)
         bufs = tuple(jnp.zeros((max_iter,) + tuple(p.shape), p.dtype)
                      for p in probe[1:1 + n_out])
 
         def body(carry):
-            i, vars_, bufs_, alive = carry
-            res = run_sub(vars_)
+            i, vars_, bufs_, au, alive = carry
+            res, new_au = run_sub(vars_, au)
             pred = res[0].reshape(()).astype(bool)
             outs = res[1:1 + n_out]
             new_vars = tuple(res[1 + n_out:])
@@ -118,19 +141,20 @@ def _lower_control_flow(node, ins, is_train):
                              b, o.astype(b.dtype), i, 0),
                          lambda b, o: b, b, o)
                 for b, o in zip(bufs_, outs))
-            vars_ = tuple(
-                jax.tree_util.tree_map(
-                    lambda nv, ov: jnp.where(pred, nv, ov), nv, ov)
-                for nv, ov in zip(new_vars, vars_))
-            return i + jnp.where(pred, 1, 0), vars_, bufs_, pred
+            vars_ = tuple(jnp.where(pred, nv, ov)
+                          for nv, ov in zip(new_vars, vars_))
+            au = tuple(jnp.where(pred, na, oa)
+                       for na, oa in zip(new_au, au))
+            return i + jnp.where(pred, 1, 0), vars_, bufs_, au, pred
 
         def cond_f(carry):
-            i, vars_, _, alive = carry
+            i, vars_, _, _, alive = carry
             return alive & (i < max_iter)
 
         i0 = jnp.asarray(0, jnp.int32)
-        _, final_vars, bufs, _ = lax.while_loop(
-            cond_f, body, (i0, states, bufs, jnp.asarray(True)))
+        _, final_vars, bufs, final_aux, _ = lax.while_loop(
+            cond_f, body, (i0, states, bufs, aux0, jnp.asarray(True)))
+        _publish_aux(final_aux)
         return list(bufs) + list(final_vars)
 
     # _cond: separate then/else subgraphs, so the untaken branch is not
@@ -140,18 +164,25 @@ def _lower_control_flow(node, ins, is_train):
     pred = ins[0].reshape(()).astype(bool)
     branch_ins = ins[1:1 + n_i]
     frees, faux = _split_maps(dict(zip(free_names, ins[1 + n_i:])))
+    aux0 = tuple(faux[n] for n in aux_free)
 
     def run_branch(branch_sub):
         def f(args):
             m = dict(frees)
             m.update(zip(in_names, args))
-            res = _walk(branch_sub, m, dict(faux), is_train)
-            return tuple(res[:n_out])
+            am = dict(zip(aux_free, aux0))
+            coll = {}
+            res = _walk(branch_sub, m, am, is_train,
+                        collect_aux=coll if is_train else None)
+            new_au = tuple(coll.get(n, am[n]) for n in aux_free)
+            return tuple(res[:n_out]), new_au
         return f
 
-    return list(lax.cond(pred, run_branch(sub),
-                         run_branch(node.attrs["__cf_else__"]),
-                         tuple(branch_ins)))
+    outs, new_aux = lax.cond(pred, run_branch(sub),
+                             run_branch(node.attrs["__cf_else__"]),
+                             tuple(branch_ins))
+    _publish_aux(new_aux)
+    return list(outs)
 
 _TRAINING_PARAM_CACHE: Dict[int, bool] = {}
 
@@ -183,7 +214,8 @@ def _walk(symbol, arg_map: Dict[str, Any], aux_map: Dict[str, Any],
                 cache[(id(node), 0)] = arg_map[name]
         elif node.op.name in ("_foreach", "_while_loop", "_cond"):
             ins = [cache[(id(i), k)] for i, k in node.inputs]
-            outs = _lower_control_flow(node, ins, is_train)
+            outs = _lower_control_flow(node, ins, is_train,
+                                       collect_aux=collect_aux)
             for i, o in enumerate(outs):
                 cache[(id(node), i)] = o
         elif node.op.name == "_subgraph":

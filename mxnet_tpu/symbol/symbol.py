@@ -14,6 +14,10 @@ node-list format so checkpoints remain inspectable.
 from __future__ import annotations
 
 import json
+
+# attrs value prefix marking an embedded (recursively serialized)
+# subgraph Symbol — used by the control-flow nodes' save/load round-trip
+_SUBJSON_MARK = "__MXTPU_SUBGRAPH_JSON__:"
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as _np
@@ -279,15 +283,28 @@ class Symbol:
                     "cannot serialize a partitioned graph: _subgraph "
                     "nodes are runtime artifacts; save the original "
                     "symbol and re-run optimize_for after loading")
+
+        def ser_attr(v):
+            # control-flow nodes embed their body subgraphs: serialize
+            # them recursively so save/load round-trips (the reference
+            # stores subgraphs as node attributes likewise,
+            # control_flow.cc)
+            if isinstance(v, Symbol):
+                return _SUBJSON_MARK + v.tojson()
+            return str(v)
+
         nodes = []
         index: Dict[int, int] = {}
         order = self._topo()
         for node in order:
             index[id(node)] = len(nodes)
+            attrs = {k: ser_attr(v) for k, v in node.attrs.items()}
+            if node.is_variable and node.extra.get("aux", False):
+                attrs["__aux__"] = "1"
             nodes.append({
                 "op": "null" if node.is_variable else node.op.name,
                 "name": node.name,
-                "attrs": {k: str(v) for k, v in node.attrs.items()},
+                "attrs": attrs,
                 "inputs": [[index[id(i)], k, 0] for i, k in node.inputs],
             })
         arg_nodes = [index[id(n)] for n in order if n.is_variable]
@@ -668,10 +685,18 @@ def load_json(json_str: str) -> Symbol:
     data = json.loads(json_str)
     nodes: List[_Node] = []
     for spec in data["nodes"]:
-        attrs = {k: coerce_param(v)
-                 for k, v in (spec.get("attrs") or spec.get("param") or {}).items()}
+        raw = spec.get("attrs") or spec.get("param") or {}
+        attrs = {}
+        for k, v in raw.items():
+            if isinstance(v, str) and v.startswith(_SUBJSON_MARK):
+                attrs[k] = load_json(v[len(_SUBJSON_MARK):])
+            else:
+                attrs[k] = coerce_param(v)
         if spec["op"] == "null":
+            is_aux = attrs.pop("__aux__", None)
             node = _Node(None, spec["name"], {}, [])
+            if is_aux:
+                node.extra["aux"] = True
             if attrs:
                 node.extra["attr"] = attrs
         else:

@@ -1,0 +1,281 @@
+"""General C API (ref: include/mxnet/c_api.h — NDArray lifecycle,
+operator invocation, symbol compose, executor, autograd, kvstore).
+Driven through src/libmxtpu_capi.so via ctypes the way a language
+binding would."""
+import ctypes
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx  # ensures the interpreter owns jax/config first
+
+_LIB_PATH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "src", "libmxtpu_capi.so")
+
+u = ctypes.c_uint
+cp = ctypes.POINTER
+
+
+@pytest.fixture(scope="module")
+def lib():
+    if not os.path.exists(_LIB_PATH):
+        import subprocess
+        subprocess.run(["make", "-C", os.path.dirname(_LIB_PATH),
+                        "libmxtpu_capi.so"],
+                       check=False, capture_output=True, timeout=180)
+    if not os.path.exists(_LIB_PATH):
+        pytest.skip("libmxtpu_capi.so not built (make -C src)")
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    return lib
+
+
+def _check(lib, rc):
+    assert rc == 0, lib.MXGetLastError().decode()
+
+
+def _make_nd(lib, arr):
+    arr = np.ascontiguousarray(arr, np.float32)
+    shape = (u * arr.ndim)(*arr.shape)
+    h = ctypes.c_void_p()
+    _check(lib, lib.MXNDArrayCreateEx(shape, u(arr.ndim), 1, 0, 0, 0,
+                                      ctypes.byref(h)))
+    _check(lib, lib.MXNDArraySyncCopyFromCPU(
+        h, arr.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_size_t(arr.size)))
+    return h
+
+
+def _vp(h):
+    """Indexing a POINTER(c_void_p) yields a plain int; re-wrap so ctypes
+    passes a full 64-bit pointer (no argtypes declared)."""
+    return h if isinstance(h, ctypes.c_void_p) else ctypes.c_void_p(h)
+
+
+def _to_np(lib, h):
+    h = _vp(h)
+    ndim = u()
+    pdata = cp(u)()
+    _check(lib, lib.MXNDArrayGetShape(h, ctypes.byref(ndim),
+                                      ctypes.byref(pdata)))
+    shape = tuple(pdata[i] for i in range(ndim.value))
+    out = np.zeros(shape, np.float32)
+    _check(lib, lib.MXNDArraySyncCopyToCPU(
+        h, out.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_size_t(out.size)))
+    return out
+
+
+def test_version_and_op_listing(lib):
+    v = ctypes.c_int()
+    _check(lib, lib.MXGetVersion(ctypes.byref(v)))
+    assert v.value == 10500
+    n = u()
+    names = cp(ctypes.c_char_p)()
+    _check(lib, lib.MXListAllOpNames(ctypes.byref(n), ctypes.byref(names)))
+    all_names = {names[i].decode() for i in range(n.value)}
+    assert n.value >= 400
+    assert {"dot", "Convolution", "sgd_update"} <= all_names
+
+
+def test_ndarray_roundtrip_and_shape(lib):
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    h = _make_nd(lib, x)
+    np.testing.assert_array_equal(_to_np(lib, h), x)
+    dt = ctypes.c_int()
+    _check(lib, lib.MXNDArrayGetDType(h, ctypes.byref(dt)))
+    assert dt.value == 0  # float32
+    # slice + at + reshape
+    s = ctypes.c_void_p()
+    _check(lib, lib.MXNDArraySlice(h, u(1), u(3), ctypes.byref(s)))
+    np.testing.assert_array_equal(_to_np(lib, s), x[1:3])
+    a = ctypes.c_void_p()
+    _check(lib, lib.MXNDArrayAt(h, u(2), ctypes.byref(a)))
+    np.testing.assert_array_equal(_to_np(lib, a), x[2])
+    r = ctypes.c_void_p()
+    dims = (ctypes.c_int * 2)(4, 3)
+    _check(lib, lib.MXNDArrayReshape(h, 2, dims, ctypes.byref(r)))
+    np.testing.assert_array_equal(_to_np(lib, r), x.reshape(4, 3))
+    for hh in (s, a, r, h):
+        _check(lib, lib.MXNDArrayFree(hh))
+
+
+def test_imperative_invoke_dot(lib):
+    a = _make_nd(lib, np.random.RandomState(0).randn(3, 4))
+    b = _make_nd(lib, np.random.RandomState(1).randn(4, 5))
+    ins = (ctypes.c_void_p * 2)(a, b)
+    n_out = ctypes.c_int()
+    outs = cp(ctypes.c_void_p)()
+    _check(lib, lib.MXImperativeInvoke(
+        b"dot", 2, ins, ctypes.byref(n_out), ctypes.byref(outs), 0,
+        None, None))
+    assert n_out.value == 1
+    got = _to_np(lib, outs[0])
+    want = _to_np(lib, a) @ _to_np(lib, b)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_imperative_invoke_with_params(lib):
+    x = _make_nd(lib, np.random.RandomState(2).randn(2, 6))
+    ins = (ctypes.c_void_p * 1)(x)
+    n_out = ctypes.c_int()
+    outs = cp(ctypes.c_void_p)()
+    keys = (ctypes.c_char_p * 1)(b"shape")
+    vals = (ctypes.c_char_p * 1)(b"(3, 4)")
+    _check(lib, lib.MXImperativeInvoke(
+        b"Reshape", 1, ins, ctypes.byref(n_out), ctypes.byref(outs), 1,
+        keys, vals))
+    assert _to_np(lib, outs[0]).shape == (3, 4)
+
+
+def test_ndarray_save_load(lib, tmp_path):
+    f = str(tmp_path / "arrs.nd").encode()
+    a = _make_nd(lib, np.ones((2, 2), np.float32))
+    handles = (ctypes.c_void_p * 1)(a)
+    keys = (ctypes.c_char_p * 1)(b"w")
+    _check(lib, lib.MXNDArraySave(f, u(1), handles, keys))
+    n = u()
+    arrs = cp(ctypes.c_void_p)()
+    nn = u()
+    names = cp(ctypes.c_char_p)()
+    _check(lib, lib.MXNDArrayLoad(f, ctypes.byref(n), ctypes.byref(arrs),
+                                  ctypes.byref(nn), ctypes.byref(names)))
+    assert n.value == 1 and nn.value == 1
+    assert names[0] == b"w"
+    np.testing.assert_array_equal(_to_np(lib, arrs[0]), np.ones((2, 2)))
+
+
+def test_symbol_compose_infer_and_json(lib):
+    data = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolCreateVariable(b"data", ctypes.byref(data)))
+    w = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolCreateVariable(b"w", ctypes.byref(w)))
+    fc = ctypes.c_void_p()
+    keys = (ctypes.c_char_p * 2)(b"num_hidden", b"no_bias")
+    vals = (ctypes.c_char_p * 2)(b"4", b"true")
+    inputs = (ctypes.c_void_p * 2)(data, w)
+    _check(lib, lib.MXSymbolCreateAtomicSymbolEx(
+        b"FullyConnected", u(2), keys, vals, u(2), inputs, b"fc",
+        ctypes.byref(fc)))
+    n = u()
+    names = cp(ctypes.c_char_p)()
+    _check(lib, lib.MXSymbolListArguments(fc, ctypes.byref(n),
+                                          ctypes.byref(names)))
+    args = [names[i].decode() for i in range(n.value)]
+    assert args == ["data", "w"]
+    js = ctypes.c_char_p()
+    _check(lib, lib.MXSymbolSaveToJSON(fc, ctypes.byref(js)))
+    restored = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolCreateFromJSON(js.value,
+                                           ctypes.byref(restored)))
+    _check(lib, lib.MXSymbolListArguments(restored, ctypes.byref(n),
+                                          ctypes.byref(names)))
+    assert [names[i].decode() for i in range(n.value)] == ["data", "w"]
+
+
+def test_symbol_atomic_info(lib):
+    name = ctypes.c_char_p()
+    desc = ctypes.c_char_p()
+    sig = ctypes.c_char_p()
+    _check(lib, lib.MXSymbolGetAtomicSymbolInfo(
+        b"Convolution", ctypes.byref(name), ctypes.byref(desc),
+        ctypes.byref(sig)))
+    assert b"kernel" in sig.value
+    assert b"Parameters" in desc.value
+
+
+def test_executor_forward_backward(lib):
+    # y = FC(x, w); dy/dw via the C autograd-free executor path
+    data = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolCreateVariable(b"data", ctypes.byref(data)))
+    w = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolCreateVariable(b"w", ctypes.byref(w)))
+    fc = ctypes.c_void_p()
+    keys = (ctypes.c_char_p * 2)(b"num_hidden", b"no_bias")
+    vals = (ctypes.c_char_p * 2)(b"2", b"true")
+    inputs = (ctypes.c_void_p * 2)(data, w)
+    _check(lib, lib.MXSymbolCreateAtomicSymbolEx(
+        b"FullyConnected", u(2), keys, vals, u(2), inputs, b"fc",
+        ctypes.byref(fc)))
+    rs = np.random.RandomState(3)
+    xv = rs.randn(4, 3).astype(np.float32)
+    wv = rs.randn(2, 3).astype(np.float32)
+    xh, wh = _make_nd(lib, xv), _make_nd(lib, wv)
+    gw = _make_nd(lib, np.zeros((2, 3), np.float32))
+    arg_names = (ctypes.c_char_p * 2)(b"data", b"w")
+    arg_h = (ctypes.c_void_p * 2)(xh, wh)
+    grad_names = (ctypes.c_char_p * 1)(b"w")
+    grad_h = (ctypes.c_void_p * 1)(gw)
+    ex = ctypes.c_void_p()
+    _check(lib, lib.MXExecutorBind(fc, u(2), arg_names, arg_h, u(1),
+                                   grad_names, grad_h, u(0), None, None,
+                                   ctypes.byref(ex)))
+    _check(lib, lib.MXExecutorForward(ex, 1))
+    _check(lib, lib.MXExecutorBackward(ex, u(0), None))
+    n = u()
+    outs = cp(ctypes.c_void_p)()
+    _check(lib, lib.MXExecutorOutputs(ex, ctypes.byref(n),
+                                      ctypes.byref(outs)))
+    assert n.value == 1
+    np.testing.assert_allclose(_to_np(lib, outs[0]), xv @ wv.T,
+                               rtol=1e-5)
+    # d(sum out)/dw = ones(4,2).T @ x
+    np.testing.assert_allclose(_to_np(lib, gw),
+                               np.ones((4, 2)).T @ xv, rtol=1e-5)
+
+
+def test_autograd_through_c(lib):
+    x = _make_nd(lib, np.array([1.0, 2.0, 3.0], np.float32))
+    marks = (ctypes.c_void_p * 1)(x)
+    _check(lib, lib.MXAutogradMarkVariables(u(1), marks))
+    prev = ctypes.c_int()
+    _check(lib, lib.MXAutogradSetIsRecording(1, ctypes.byref(prev)))
+    ins = (ctypes.c_void_p * 1)(x)
+    n_out = ctypes.c_int()
+    outs = cp(ctypes.c_void_p)()
+    _check(lib, lib.MXImperativeInvoke(b"square", 1, ins,
+                                       ctypes.byref(n_out),
+                                       ctypes.byref(outs), 0, None, None))
+    y = _vp(outs[0])
+    _check(lib, lib.MXAutogradSetIsRecording(0, ctypes.byref(prev)))
+    heads = (ctypes.c_void_p * 1)(y)
+    _check(lib, lib.MXAutogradBackward(u(1), heads, None, 0))
+    g = ctypes.c_void_p()
+    _check(lib, lib.MXNDArrayGetGrad(x, ctypes.byref(g)))
+    np.testing.assert_allclose(_to_np(lib, g), [2.0, 4.0, 6.0],
+                               rtol=1e-6)
+
+
+def test_kvstore_through_c(lib):
+    kv = ctypes.c_void_p()
+    _check(lib, lib.MXKVStoreCreate(b"local", ctypes.byref(kv)))
+    rank = ctypes.c_int()
+    size = ctypes.c_int()
+    _check(lib, lib.MXKVStoreGetRank(kv, ctypes.byref(rank)))
+    _check(lib, lib.MXKVStoreGetGroupSize(kv, ctypes.byref(size)))
+    assert rank.value == 0 and size.value == 1
+    w = _make_nd(lib, np.zeros((3,), np.float32))
+    keys = (ctypes.c_char_p * 1)(b"k0")
+    vals = (ctypes.c_void_p * 1)(w)
+    _check(lib, lib.MXKVStoreInitEx(kv, u(1), keys, vals))
+    g = _make_nd(lib, np.array([1.0, 2.0, 3.0], np.float32))
+    gv = (ctypes.c_void_p * 1)(g)
+    _check(lib, lib.MXKVStorePushEx(kv, u(1), keys, gv, 0))
+    out = _make_nd(lib, np.zeros((3,), np.float32))
+    ov = (ctypes.c_void_p * 1)(out)
+    _check(lib, lib.MXKVStorePullEx(kv, u(1), keys, ov, 0))
+    np.testing.assert_allclose(_to_np(lib, out), [1.0, 2.0, 3.0])
+
+
+def test_error_contract(lib):
+    h = ctypes.c_void_p()
+    rc = lib.MXSymbolCreateVariable(None, ctypes.byref(h))
+    # creating an op that doesn't exist must fail with a message
+    bad = ctypes.c_void_p()
+    keys = (ctypes.c_char_p * 0)()
+    vals = (ctypes.c_char_p * 0)()
+    rc = lib.MXSymbolCreateAtomicSymbol(b"NoSuchOp", u(0), keys, vals,
+                                        ctypes.byref(bad))
+    assert rc != 0
+    assert b"NoSuchOp" in lib.MXGetLastError()

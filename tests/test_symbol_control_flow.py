@@ -162,6 +162,72 @@ def test_sym_foreach_with_aux_state_op():
     np.testing.assert_allclose(res, d / np.sqrt(1 + 1e-3), rtol=1e-4)
 
 
+def test_sym_foreach_updates_moving_stats_in_training():
+    """BatchNorm WITHOUT use_global_stats inside a foreach body: the
+    moving stats must be updated by forward(is_train=True) — the loop
+    carries them and the executor publishes the final values."""
+    data = sym.Variable("data")
+    init = sym.Variable("init")
+    gamma = sym.Variable("gamma")
+    beta = sym.Variable("beta")
+
+    def body(x, s):
+        h = sym.BatchNorm(x, gamma, beta, fix_gamma=False, axis=1,
+                          momentum=0.5, name="bn")[0]
+        return sym.elemwise_add(h, s), s
+
+    outs, _ = sym.contrib.foreach(body, data, init)
+    rs = np.random.RandomState(5)
+    T, B, C = 3, 8, 2
+    d = (rs.randn(T, B, C) * 2 + 5).astype(np.float32)
+    arg_nds = {"data": nd.array(d), "init": nd.zeros((B, C)),
+               "gamma": nd.ones((C,)), "beta": nd.zeros((C,))}
+    aux_nds = {"bn_moving_mean": nd.zeros((C,)),
+               "bn_moving_var": nd.ones((C,))}
+    g = sym.Group([outs])
+    ex = g.bind(mx.cpu(), args=arg_nds,
+                args_grad={k: nd.zeros(v.shape) for k, v in arg_nds.items()},
+                aux_states=aux_nds)
+    ex.forward(is_train=True)
+    ex.backward(out_grads=nd.ones((T, B, C)))
+    mm = ex.aux_dict["bn_moving_mean"].asnumpy()
+    mv = ex.aux_dict["bn_moving_var"].asnumpy()
+    assert not np.allclose(mm, 0.0), "moving_mean never updated"
+    assert not np.allclose(mv, 1.0), "moving_var never updated"
+    # T momentum-0.5 updates of per-step batch means
+    want_mm = np.zeros(C)
+    want_mv = np.ones(C)
+    for t in range(T):
+        bm = d[t].mean(0)
+        bv = d[t].var(0)
+        want_mm = want_mm * 0.5 + bm * 0.5
+        want_mv = want_mv * 0.5 + bv * 0.5
+    np.testing.assert_allclose(mm, want_mm, rtol=1e-4)
+    np.testing.assert_allclose(mv, want_mv, rtol=1e-4)
+
+
+def test_cf_symbol_save_load_roundtrip():
+    """tojson/load_json round-trips control-flow nodes (embedded
+    subgraphs + typed attrs + aux markers)."""
+    data = sym.Variable("data")
+    init = sym.Variable("init")
+
+    def body(x, s):
+        new_s = sym.elemwise_add(x, s)
+        return new_s, new_s
+
+    outs, final = sym.contrib.foreach(body, data, init)
+    g = sym.Group([outs, final])
+    js = g.tojson()
+    g2 = sym.load_json(js)
+    assert g2.list_arguments() == g.list_arguments()
+    rs = np.random.RandomState(6)
+    d = rs.randn(4, 3).astype(np.float32)
+    res = _run(g2, {"data": d, "init": np.zeros(3, np.float32)})
+    np.testing.assert_allclose(res[0], np.cumsum(d, axis=0), rtol=1e-5)
+    np.testing.assert_allclose(res[1], d.sum(0), rtol=1e-5)
+
+
 def test_cf_op_imperative_invoke_raises():
     from mxnet_tpu.base import MXNetError
     with pytest.raises(MXNetError, match="control-flow"):
