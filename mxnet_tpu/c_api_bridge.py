@@ -20,7 +20,7 @@ import numpy as np
 import mxnet_tpu as mx
 from mxnet_tpu import nd
 from mxnet_tpu import symbol as sym
-from mxnet_tpu.base import MXNetError
+from mxnet_tpu.base import MXNetError, check
 
 _DTYPE_CODES = {0: np.float32, 1: np.float64, 2: np.float16, 3: np.uint8,
                 4: np.int32, 5: np.int8, 6: np.int64}
@@ -111,34 +111,58 @@ def list_all_op_names() -> List[str]:
     return reg.list_ops()
 
 
-def imperative_invoke(op_name: str, inputs, param_keys, param_vals):
+def imperative_invoke(op_name: str, inputs, param_keys, param_vals,
+                      out_arrays=None):
     params: Dict[str, Any] = {}
     for k, v in zip(list(param_keys), list(param_vals)):
         params[str(k)] = _parse_param(str(v))
     out = nd.imperative_invoke(op_name, tuple(inputs), params)
-    return list(out) if isinstance(out, (list, tuple)) else [out]
+    outs = list(out) if isinstance(out, (list, tuple)) else [out]
+    if out_arrays:
+        # reference contract: caller-preallocated outputs are written in
+        # place (c_api.cc MXImperativeInvokeEx out-array path)
+        for dst, src in zip(list(out_arrays), outs):
+            dst._rebind(src._data)
+        return list(out_arrays)
+    return outs
 
 
 def _parse_param(v: str):
-    try:
-        return json.loads(v)
-    except (ValueError, TypeError):
-        pass
-    if v.startswith("(") and v.endswith(")"):
-        inner = v[1:-1].strip()
-        if not inner:
-            return ()
-        return tuple(_parse_param(x.strip()) for x in inner.split(","))
-    lv = v.lower()
+    """String-encoded op param -> python value (the reference's dmlc
+    parameter parsing). Delegates to base.coerce_param (ast.literal_eval:
+    tuples incl. nested/None, numbers) plus the C-style true/false
+    spellings."""
+    from mxnet_tpu.base import coerce_param
+    lv = v.strip().lower()
     if lv in ("true", "false"):
         return lv == "true"
-    return v
+    return coerce_param(v)
 
 
 # -- symbol ----------------------------------------------------------------
 
 def symbol_create_variable(name: str):
     return sym.var(name)
+
+
+def symbol_compose(s, name, input_syms) -> None:
+    """Attach inputs to an input-less atomic symbol in place (ref:
+    MXSymbolCompose — the CreateAtomicSymbol+Compose two-step every
+    language binding uses). Positional composition."""
+    node = s._outputs[0][0]
+    check(node.op is not None, "cannot compose a variable")
+    node.inputs = [a._outputs[0] for a in list(input_syms)]
+    if name:
+        node.name = str(name)
+    # aux-state auto-creation mirrors symbol.create
+    for aux_i in node.op.aux_inputs:
+        if aux_i >= len(node.inputs):
+            from mxnet_tpu.symbol.symbol import _Node
+            suffix = {3: "moving_mean", 4: "moving_var"}.get(
+                aux_i, f"aux{aux_i}")
+            aux_node = _Node(None, f"{node.name}_{suffix}", {}, [])
+            aux_node.extra["aux"] = True
+            node.inputs.append((aux_node, 0))
 
 
 def symbol_create_atomic(op_name: str, param_keys, param_vals,
@@ -173,7 +197,11 @@ def symbol_infer_shape(s, names, shapes):
     known = {str(n): tuple(int(x) for x in shp)
              for n, shp in zip(list(names), list(shapes))}
     arg_shapes, out_shapes, aux_shapes = s.infer_shape(**known)
-    return arg_shapes, out_shapes, aux_shapes
+
+    def as_lists(lst):
+        return [list(int(x) for x in shp) for shp in (lst or [])]
+
+    return as_lists(arg_shapes), as_lists(out_shapes), as_lists(aux_shapes)
 
 
 def symbol_get_atomic_symbol_info(op_name: str):
@@ -224,9 +252,12 @@ def autograd_mark_variables(arrays) -> None:
         a.attach_grad()
 
 
-def autograd_backward(outputs) -> None:
+def autograd_backward(outputs, head_grads=None,
+                      retain_graph: int = 0) -> None:
     from mxnet_tpu import autograd
-    autograd.backward(list(outputs))
+    heads = list(head_grads) if head_grads else None
+    autograd.backward(list(outputs), head_grads=heads,
+                      retain_graph=bool(retain_graph))
 
 
 def autograd_get_grad(arr):

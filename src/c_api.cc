@@ -358,15 +358,7 @@ MXTPU_API int MXNDArrayLoad(const char *fname, mx_uint *out_size,
   PyObject *names = PyTuple_GetItem(r, 0);
   PyObject *arrays = PyTuple_GetItem(r, 1);
   fill_strs(names, out_name_size, out_names);
-  Py_ssize_t n = PyList_Size(arrays);
-  g_handle_store.clear();
-  for (Py_ssize_t i = 0; i < n; ++i) {
-    PyObject *o = PyList_GetItem(arrays, i);
-    Py_INCREF(o);
-    g_handle_store.push_back(o);
-  }
-  *out_size = static_cast<mx_uint>(n);
-  *out_arr = g_handle_store.data();
+  fill_handles(arrays, out_size, out_arr);
   Py_DECREF(r);
   return 0;
 }
@@ -395,20 +387,22 @@ MXTPU_API int MXImperativeInvoke(const char *op_name, int num_inputs,
   PyObject *ins = handle_list(inputs, num_inputs);
   PyObject *keys = str_list(param_keys, num_params);
   PyObject *vals = str_list(param_vals, num_params);
-  PyObject *args = Py_BuildValue("(sNNN)", op_name, ins, keys, vals);
+  // reference contract: *num_outputs > 0 means the caller preallocated
+  // output arrays — results are written into them in place
+  bool prealloc = *num_outputs > 0 && *outputs != nullptr;
+  PyObject *outs = prealloc ? handle_list(*outputs, *num_outputs)
+                            : (Py_INCREF(Py_None), Py_None);
+  PyObject *args = Py_BuildValue("(sNNNN)", op_name, ins, keys, vals,
+                                 outs);
   PyObject *r = call("imperative_invoke", args);
   Py_DECREF(args);
   if (!r) { set_error(py_error()); return -1; }
-  Py_ssize_t n = PyList_Size(r);
-  g_handle_store.clear();
-  for (Py_ssize_t i = 0; i < n; ++i) {
-    PyObject *o = PyList_GetItem(r, i);
-    Py_INCREF(o);
-    g_handle_store.push_back(o);
+  if (!prealloc) {
+    mx_uint n = 0;
+    fill_handles(r, &n, outputs);
+    *num_outputs = static_cast<int>(n);
   }
   Py_DECREF(r);
-  *num_outputs = static_cast<int>(n);
-  *outputs = g_handle_store.data();
   return 0;
 }
 
@@ -454,19 +448,23 @@ MXTPU_API int MXSymbolCreateAtomicSymbol(const char *op_name,
   return 0;
 }
 
-// compose an atomic symbol with inputs in one call (the reference splits
-// CreateAtomicSymbol + Compose; both entry points are provided)
+// compose an atomic symbol with inputs: the CreateAtomicSymbol+Compose
+// two-step every reference language binding uses (positional args; the
+// keys argument names inputs in the reference and is accepted but
+// composition here is positional)
 MXTPU_API int MXSymbolCompose(SymbolHandle sym, const char *name,
                               mx_uint num_args, const char **keys,
                               SymbolHandle *args_h) {
   ensure_interpreter();
   ScopedGIL gil;
-  // the bridge rebuilds the node with inputs attached: emulate by
-  // retrieving the op name/params from the existing symbol is complex;
-  // instead the reference-compatible path is CreateAtomicSymbolEx below.
-  set_error("MXSymbolCompose: use MXSymbolCreateAtomicSymbolEx "
-            "(atomic creation with inputs)");
-  return -1;
+  PyObject *ins = handle_list(args_h, num_args);
+  PyObject *args = Py_BuildValue("(OsN)", static_cast<PyObject *>(sym),
+                                 name ? name : "", ins);
+  PyObject *r = call("symbol_compose", args);
+  Py_DECREF(args);
+  if (!r) { set_error(py_error()); return -1; }
+  Py_DECREF(r);
+  return 0;
 }
 
 MXTPU_API int MXSymbolCreateAtomicSymbolEx(const char *op_name,
@@ -543,6 +541,82 @@ MXTPU_API int MXSymbolListAuxiliaryStates(SymbolHandle sym,
                                           mx_uint *out_size,
                                           const char ***out) {
   return list_via("symbol_list_aux", sym, out_size, out);
+}
+
+namespace {
+// thread-local CSR-style shape storage for MXSymbolInferShape (the
+// reference's per-thread MXAPIThreadLocalEntry layout)
+struct ShapeSet {
+  std::vector<mx_uint> ndim;
+  std::vector<std::vector<mx_uint>> shapes;
+  std::vector<const mx_uint *> ptrs;
+};
+thread_local ShapeSet g_in_shapes, g_out_shapes, g_aux_shapes;
+
+void fill_shapeset(PyObject *list_of_shapes, ShapeSet *ss, mx_uint *size,
+                   const mx_uint **ndim_out,
+                   const mx_uint ***data_out) {
+  Py_ssize_t n = PyList_Size(list_of_shapes);
+  ss->ndim.clear();
+  ss->shapes.assign(n, {});
+  ss->ptrs.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *shp = PyList_GetItem(list_of_shapes, i);
+    Py_ssize_t d = PySequence_Size(shp);
+    ss->ndim.push_back(static_cast<mx_uint>(d));
+    for (Py_ssize_t j = 0; j < d; ++j) {
+      PyObject *v = PySequence_GetItem(shp, j);
+      ss->shapes[i].push_back((mx_uint)PyLong_AsUnsignedLong(v));
+      Py_DECREF(v);
+    }
+  }
+  for (auto &s : ss->shapes) ss->ptrs.push_back(s.data());
+  *size = static_cast<mx_uint>(n);
+  *ndim_out = ss->ndim.data();
+  *data_out = ss->ptrs.data();
+}
+}  // namespace
+
+MXTPU_API int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args,
+                                 const char **keys,
+                                 const mx_uint *arg_ind_ptr,
+                                 const mx_uint *arg_shape_data,
+                                 mx_uint *in_shape_size,
+                                 const mx_uint **in_shape_ndim,
+                                 const mx_uint ***in_shape_data,
+                                 mx_uint *out_shape_size,
+                                 const mx_uint **out_shape_ndim,
+                                 const mx_uint ***out_shape_data,
+                                 mx_uint *aux_shape_size,
+                                 const mx_uint **aux_shape_ndim,
+                                 const mx_uint ***aux_shape_data,
+                                 int *complete) {
+  ensure_interpreter();
+  ScopedGIL gil;
+  PyObject *names = str_list(keys, num_args);
+  PyObject *shapes = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    mx_uint lo = arg_ind_ptr[i], hi = arg_ind_ptr[i + 1];
+    PyObject *shp = PyList_New(hi - lo);
+    for (mx_uint j = lo; j < hi; ++j)
+      PyList_SetItem(shp, j - lo,
+                     PyLong_FromUnsignedLong(arg_shape_data[j]));
+    PyList_SetItem(shapes, i, shp);
+  }
+  PyObject *args = Py_BuildValue("(ONN)", static_cast<PyObject *>(sym),
+                                 names, shapes);
+  PyObject *r = call("symbol_infer_shape", args);
+  Py_DECREF(args);
+  if (!r) { set_error(py_error()); return -1; }
+  fill_shapeset(PyTuple_GetItem(r, 0), &g_in_shapes, in_shape_size,
+                in_shape_ndim, in_shape_data);
+  fill_shapeset(PyTuple_GetItem(r, 1), &g_out_shapes, out_shape_size,
+                out_shape_ndim, out_shape_data);
+  fill_shapeset(PyTuple_GetItem(r, 2), &g_aux_shapes, aux_shape_size,
+                aux_shape_ndim, aux_shape_data);
+  Py_DECREF(r);
+  if (complete) *complete = 1;
+  return 0;
 }
 
 MXTPU_API int MXSymbolGetAtomicSymbolInfo(const char *op_name,
@@ -685,7 +759,9 @@ MXTPU_API int MXAutogradBackward(mx_uint num, NDArrayHandle *outputs,
                                  int retain_graph) {
   ScopedGIL gil;
   PyObject *lst = handle_list(outputs, num);
-  PyObject *args = Py_BuildValue("(N)", lst);
+  PyObject *heads = head_grads ? handle_list(head_grads, num)
+                               : (Py_INCREF(Py_None), Py_None);
+  PyObject *args = Py_BuildValue("(NNi)", lst, heads, retain_graph);
   PyObject *r = call("autograd_backward", args);
   Py_DECREF(args);
   if (!r) { set_error(py_error()); return -1; }

@@ -279,3 +279,123 @@ def test_error_contract(lib):
                                         ctypes.byref(bad))
     assert rc != 0
     assert b"NoSuchOp" in lib.MXGetLastError()
+
+
+def test_symbol_compose_two_step(lib):
+    """The reference's canonical CreateAtomicSymbol + Compose path."""
+    fc = ctypes.c_void_p()
+    keys = (ctypes.c_char_p * 1)(b"num_hidden")
+    vals = (ctypes.c_char_p * 1)(b"3")
+    _check(lib, lib.MXSymbolCreateAtomicSymbol(b"FullyConnected", u(1),
+                                               keys, vals,
+                                               ctypes.byref(fc)))
+    data = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolCreateVariable(b"data", ctypes.byref(data)))
+    w = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolCreateVariable(b"w", ctypes.byref(w)))
+    b = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolCreateVariable(b"b", ctypes.byref(b)))
+    args = (ctypes.c_void_p * 3)(data, w, b)
+    _check(lib, lib.MXSymbolCompose(fc, b"fc1", u(3), None, args))
+    n = u()
+    names = cp(ctypes.c_char_p)()
+    _check(lib, lib.MXSymbolListArguments(fc, ctypes.byref(n),
+                                          ctypes.byref(names)))
+    assert [names[i].decode() for i in range(n.value)] == \
+        ["data", "w", "b"]
+
+
+def test_symbol_infer_shape(lib):
+    data = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolCreateVariable(b"data", ctypes.byref(data)))
+    w = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolCreateVariable(b"w", ctypes.byref(w)))
+    fc = ctypes.c_void_p()
+    keys = (ctypes.c_char_p * 2)(b"num_hidden", b"no_bias")
+    vals = (ctypes.c_char_p * 2)(b"7", b"true")
+    inputs = (ctypes.c_void_p * 2)(data, w)
+    _check(lib, lib.MXSymbolCreateAtomicSymbolEx(
+        b"FullyConnected", u(2), keys, vals, u(2), inputs, b"fc",
+        ctypes.byref(fc)))
+    arg_keys = (ctypes.c_char_p * 1)(b"data")
+    ind_ptr = (u * 2)(0, 2)
+    shape_data = (u * 2)(5, 3)
+    in_n, out_n, aux_n = u(), u(), u()
+    in_ndim = cp(u)()
+    out_ndim = cp(u)()
+    aux_ndim = cp(u)()
+    in_data = cp(cp(u))()
+    out_data = cp(cp(u))()
+    aux_data = cp(cp(u))()
+    complete = ctypes.c_int()
+    _check(lib, lib.MXSymbolInferShape(
+        fc, u(1), arg_keys, ind_ptr, shape_data,
+        ctypes.byref(in_n), ctypes.byref(in_ndim), ctypes.byref(in_data),
+        ctypes.byref(out_n), ctypes.byref(out_ndim),
+        ctypes.byref(out_data),
+        ctypes.byref(aux_n), ctypes.byref(aux_ndim),
+        ctypes.byref(aux_data), ctypes.byref(complete)))
+    assert complete.value == 1
+    assert out_n.value == 1 and out_ndim[0] == 2
+    assert [out_data[0][i] for i in range(2)] == [5, 7]
+    # the weight's inferred shape comes back in the arg shapes
+    args_got = {}
+    for i in range(in_n.value):
+        args_got[i] = [in_data[i][j] for j in range(in_ndim[i])]
+    assert [7, 3] in args_got.values()
+
+
+def test_autograd_head_grads_and_retain(lib):
+    x = _make_nd(lib, np.array([1.0, 2.0], np.float32))
+    marks = (ctypes.c_void_p * 1)(x)
+    _check(lib, lib.MXAutogradMarkVariables(u(1), marks))
+    prev = ctypes.c_int()
+    _check(lib, lib.MXAutogradSetIsRecording(1, ctypes.byref(prev)))
+    ins = (ctypes.c_void_p * 1)(x)
+    n_out = ctypes.c_int(0)
+    outs = cp(ctypes.c_void_p)()
+    _check(lib, lib.MXImperativeInvoke(b"square", 1, ins,
+                                       ctypes.byref(n_out),
+                                       ctypes.byref(outs), 0, None, None))
+    y = _vp(outs[0])
+    _check(lib, lib.MXAutogradSetIsRecording(0, ctypes.byref(prev)))
+    heads = (ctypes.c_void_p * 1)(y)
+    hg = _make_nd(lib, np.array([0.5, 0.5], np.float32))
+    hgs = (ctypes.c_void_p * 1)(hg)
+    _check(lib, lib.MXAutogradBackward(u(1), heads, hgs, 0))
+    g = ctypes.c_void_p()
+    _check(lib, lib.MXNDArrayGetGrad(x, ctypes.byref(g)))
+    # d(x^2) * 0.5 head grad = x
+    np.testing.assert_allclose(_to_np(lib, g), [1.0, 2.0], rtol=1e-6)
+
+
+def test_imperative_invoke_preallocated_output(lib):
+    x = _make_nd(lib, np.array([1.0, 4.0, 9.0], np.float32))
+    out = _make_nd(lib, np.zeros(3, np.float32))
+    ins = (ctypes.c_void_p * 1)(x)
+    outs_arr = (ctypes.c_void_p * 1)(out)
+    outs_ptr = ctypes.cast(outs_arr, cp(ctypes.c_void_p))
+    n_out = ctypes.c_int(1)
+    _check(lib, lib.MXImperativeInvoke(b"sqrt", 1, ins,
+                                       ctypes.byref(n_out),
+                                       ctypes.byref(outs_ptr), 0, None,
+                                       None))
+    # result written into the caller's array in place
+    np.testing.assert_allclose(_to_np(lib, out), [1.0, 2.0, 3.0],
+                               rtol=1e-6)
+
+
+def test_param_parsing_none_and_nested(lib):
+    # "(0, None)" must parse to (0, None) — slice-style params
+    x = _make_nd(lib, np.arange(12, dtype=np.float32).reshape(3, 4))
+    ins = (ctypes.c_void_p * 1)(x)
+    n_out = ctypes.c_int(0)
+    outs = cp(ctypes.c_void_p)()
+    keys = (ctypes.c_char_p * 2)(b"begin", b"end")
+    vals = (ctypes.c_char_p * 2)(b"(1, None)", b"(None, None)")
+    _check(lib, lib.MXImperativeInvoke(b"crop", 1, ins,
+                                       ctypes.byref(n_out),
+                                       ctypes.byref(outs), 2, keys, vals))
+    got = _to_np(lib, outs[0])
+    np.testing.assert_array_equal(
+        got, np.arange(12, dtype=np.float32).reshape(3, 4)[1:])

@@ -56,15 +56,27 @@ def test_safe_accumulation_is_in_jit_cache_key(monkeypatch):
         "flag toggle did not create a new cache entry (stale program!)"
     assert safe.dtype == np.float16  # result dtype preserved
     assert float(safe.asnumpy()) == float(plain.asnumpy()) == 32.0
-    # the safe-mode program really computes in f32
+    # the flag must change the lowered program where it matters: jnp
+    # reductions already accumulate f16 in f32 (jax's default upcast),
+    # but norm/_square_sum square BEFORE reducing — the flag moves the
+    # upcast ahead of the square (f16 squares overflow at |x| > 255).
+    # This fails if norm ever stops threading _safe_acc.
     import jax
     import jax.numpy as jnp
-    from mxnet_tpu.ops.reduce import _safe_acc
-    up, back = _safe_acc(jnp.ones((4,), jnp.float16))
-    assert up.dtype == jnp.float32 and back == jnp.float16
+    norm = reg.get_op("norm")
+    xp = jnp.ones((8,), jnp.float16)
+    on = str(jax.make_jaxpr(lambda a: norm.fn(a))(xp))
+    assert on.index("convert_element_type") < on.index("square"), on
     monkeypatch.delenv("MXNET_SAFE_ACCUMULATION")
-    up, back = _safe_acc(jnp.ones((4,), jnp.float16))
-    assert up.dtype == jnp.float16 and back is None
+    off = str(jax.make_jaxpr(lambda a: norm.fn(a))(xp))
+    assert off.index("square") < off.index("convert_element_type"), off
+    # end-to-end: f16 squares of 300 overflow to inf without the flag
+    big = np.full((16,), 300.0, np.float16)
+    plain_n = float(nd.op.norm(nd.array(big, dtype="float16")).asnumpy())
+    assert not np.isfinite(plain_n)
+    monkeypatch.setenv("MXNET_SAFE_ACCUMULATION", "1")
+    safe_n = float(nd.op.norm(nd.array(big, dtype="float16")).asnumpy())
+    assert np.isfinite(safe_n) and abs(safe_n - 1200.0) < 2.0
 
 
 def test_bulk_exec_flags_fall_back_to_imperative(monkeypatch):
