@@ -1,0 +1,439 @@
+// Header-only C++ frontend over the general C API (libmxtpu_capi.so).
+//
+// Reference: cpp-package/include/mxnet-cpp/ — the reference generates a
+// full C++ API (NDArray, Symbol, Executor, Optimizer, KVStore) from the
+// op registry. Here the same surface is an RAII wrapper over
+// src/c_api.cc: NDArray lifecycle + imperative ops by name, symbol
+// composition, executor fwd/bwd, autograd, kvstore.
+//
+// Usage:
+//   #include <mxnet_tpu_cpp/mxnet_tpu.hpp>
+//   using namespace mxtpu;
+//   NDArray a({2, 3});  a.CopyFrom({1,2,3,4,5,6});
+//   NDArray b = Op::Invoke1("relu", {a});
+//   Symbol x = Symbol::Variable("data"), w = Symbol::Variable("w");
+//   Symbol fc = Symbol::Create("FullyConnected", {x, w},
+//                              {{"num_hidden", "4"}, {"no_bias","true"}});
+//   Executor ex = fc.Bind({{"data", a4}, {"w", wArr}}, {{"w", gradW}});
+//   ex.Forward(true); ex.Backward();
+//
+// Link: -L<repo>/src -lmxtpu_capi (set MXTPU_HOME to the repo root when
+// running standalone so the embedded interpreter finds mxnet_tpu).
+#ifndef MXNET_TPU_CPP_MXNET_TPU_HPP_
+#define MXNET_TPU_CPP_MXNET_TPU_HPP_
+
+#include <cstddef>
+#include <map>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+extern "C" {
+typedef unsigned int mx_uint;
+typedef void *NDArrayHandle;
+typedef void *SymbolHandle;
+typedef void *ExecutorHandle;
+typedef void *KVStoreHandle;
+
+const char *MXGetLastError();
+int MXGetVersion(int *out);
+int MXRandomSeed(int seed);
+int MXNDArrayWaitAll();
+int MXNDArrayCreateEx(const mx_uint *shape, mx_uint ndim, int dev_type,
+                      int dev_id, int delay_alloc, int dtype,
+                      NDArrayHandle *out);
+int MXNDArrayFree(NDArrayHandle handle);
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                             size_t size);
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data, size_t size);
+int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_dim,
+                      const mx_uint **out_pdata);
+int MXNDArrayGetDType(NDArrayHandle handle, int *out);
+int MXNDArrayReshape(NDArrayHandle handle, int ndim, const int *dims,
+                     NDArrayHandle *out);
+int MXNDArraySave(const char *fname, mx_uint num_args,
+                  NDArrayHandle *args, const char **keys);
+int MXNDArrayLoad(const char *fname, mx_uint *out_size,
+                  NDArrayHandle **out_arr, mx_uint *out_name_size,
+                  const char ***out_names);
+int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle *out);
+int MXImperativeInvoke(const char *op_name, int num_inputs,
+                       NDArrayHandle *inputs, int *num_outputs,
+                       NDArrayHandle **outputs, int num_params,
+                       const char **param_keys, const char **param_vals);
+int MXListAllOpNames(mx_uint *out_size, const char ***out);
+int MXSymbolCreateVariable(const char *name, SymbolHandle *out);
+int MXSymbolFree(SymbolHandle handle);
+int MXSymbolCreateAtomicSymbolEx(const char *op_name, mx_uint num_param,
+                                 const char **keys, const char **vals,
+                                 mx_uint num_inputs, SymbolHandle *inputs,
+                                 const char *name, SymbolHandle *out);
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out);
+int MXSymbolSaveToJSON(SymbolHandle sym, const char **out);
+int MXSymbolListArguments(SymbolHandle sym, mx_uint *out_size,
+                          const char ***out);
+int MXSymbolListOutputs(SymbolHandle sym, mx_uint *out_size,
+                        const char ***out);
+int MXSymbolListAuxiliaryStates(SymbolHandle sym, mx_uint *out_size,
+                                const char ***out);
+int MXExecutorBind(SymbolHandle sym, mx_uint num_args,
+                   const char **arg_names, NDArrayHandle *args,
+                   mx_uint num_grads, const char **grad_names,
+                   NDArrayHandle *grads, mx_uint num_aux,
+                   const char **aux_names, NDArrayHandle *aux,
+                   ExecutorHandle *out);
+int MXExecutorFree(ExecutorHandle handle);
+int MXExecutorForward(ExecutorHandle handle, int is_train);
+int MXExecutorBackward(ExecutorHandle handle, mx_uint num_grads,
+                       NDArrayHandle *grads);
+int MXExecutorOutputs(ExecutorHandle handle, mx_uint *out_size,
+                      NDArrayHandle **out);
+int MXAutogradSetIsRecording(int is_recording, int *prev);
+int MXAutogradSetIsTraining(int is_training, int *prev);
+int MXAutogradMarkVariables(mx_uint num, NDArrayHandle *vars);
+int MXAutogradBackward(mx_uint num, NDArrayHandle *outputs,
+                       NDArrayHandle *head_grads, int retain_graph);
+int MXKVStoreCreate(const char *type, KVStoreHandle *out);
+int MXKVStoreFree(KVStoreHandle handle);
+int MXKVStoreInitEx(KVStoreHandle kv, mx_uint num, const char **keys,
+                    NDArrayHandle *vals);
+int MXKVStorePushEx(KVStoreHandle kv, mx_uint num, const char **keys,
+                    NDArrayHandle *vals, int priority);
+int MXKVStorePullEx(KVStoreHandle kv, mx_uint num, const char **keys,
+                    NDArrayHandle *outs, int priority);
+}
+
+namespace mxtpu {
+
+inline void Check(int rc) {
+  if (rc != 0) throw std::runtime_error(MXGetLastError());
+}
+
+inline int Version() {
+  int v = 0;
+  Check(MXGetVersion(&v));
+  return v;
+}
+
+inline void RandomSeed(int seed) { Check(MXRandomSeed(seed)); }
+inline void WaitAll() { Check(MXNDArrayWaitAll()); }
+
+// ---------------------------------------------------------------------------
+class NDArray {
+ public:
+  NDArray() = default;
+  explicit NDArray(const std::vector<mx_uint> &shape, int dtype = 0) {
+    Check(MXNDArrayCreateEx(shape.data(),
+                            static_cast<mx_uint>(shape.size()), 1, 0, 0,
+                            dtype, &h_));
+  }
+  explicit NDArray(NDArrayHandle h) : h_(h) {}
+  NDArray(const NDArray &) = delete;
+  NDArray &operator=(const NDArray &) = delete;
+  NDArray(NDArray &&o) noexcept : h_(o.h_) { o.h_ = nullptr; }
+  NDArray &operator=(NDArray &&o) noexcept {
+    if (this != &o) {
+      Free();
+      h_ = o.h_;
+      o.h_ = nullptr;
+    }
+    return *this;
+  }
+  ~NDArray() { Free(); }
+
+  NDArrayHandle handle() const { return h_; }
+
+  void CopyFrom(const std::vector<float> &data) {
+    Check(MXNDArraySyncCopyFromCPU(h_, data.data(), data.size()));
+  }
+  std::vector<float> CopyTo() const {
+    std::vector<float> out(Size());
+    Check(MXNDArraySyncCopyToCPU(h_, out.data(), out.size()));
+    return out;
+  }
+  std::vector<mx_uint> Shape() const {
+    mx_uint ndim = 0;
+    const mx_uint *data = nullptr;
+    Check(MXNDArrayGetShape(h_, &ndim, &data));
+    return std::vector<mx_uint>(data, data + ndim);
+  }
+  size_t Size() const {
+    size_t n = 1;
+    for (auto d : Shape()) n *= d;
+    return n;
+  }
+  NDArray Reshape(const std::vector<int> &dims) const {
+    NDArrayHandle out = nullptr;
+    Check(MXNDArrayReshape(h_, static_cast<int>(dims.size()), dims.data(),
+                           &out));
+    return NDArray(out);
+  }
+  NDArray Grad() const {
+    NDArrayHandle g = nullptr;
+    Check(MXNDArrayGetGrad(h_, &g));
+    return NDArray(g);
+  }
+  void AttachGrad() {
+    NDArrayHandle vars[1] = {h_};
+    Check(MXAutogradMarkVariables(1, vars));
+  }
+
+ private:
+  void Free() {
+    if (h_) MXNDArrayFree(h_);
+    h_ = nullptr;
+  }
+  NDArrayHandle h_ = nullptr;
+};
+
+// ---------------------------------------------------------------------------
+using KWArgs = std::map<std::string, std::string>;
+
+class Op {
+ public:
+  // invoke a registered op by name; returns all outputs
+  static std::vector<NDArray> Invoke(
+      const std::string &name, const std::vector<const NDArray *> &inputs,
+      const KWArgs &params = {}) {
+    std::vector<NDArrayHandle> ins;
+    for (auto *a : inputs) ins.push_back(a->handle());
+    std::vector<const char *> keys, vals;
+    for (auto &kv : params) {
+      keys.push_back(kv.first.c_str());
+      vals.push_back(kv.second.c_str());
+    }
+    int n_out = 0;
+    NDArrayHandle *outs = nullptr;
+    Check(MXImperativeInvoke(name.c_str(),
+                             static_cast<int>(ins.size()), ins.data(),
+                             &n_out, &outs,
+                             static_cast<int>(keys.size()), keys.data(),
+                             vals.data()));
+    std::vector<NDArray> result;
+    for (int i = 0; i < n_out; ++i) result.emplace_back(outs[i]);
+    return result;
+  }
+
+  static NDArray Invoke1(const std::string &name,
+                         const std::vector<const NDArray *> &inputs,
+                         const KWArgs &params = {}) {
+    auto outs = Invoke(name, inputs, params);
+    return std::move(outs.at(0));
+  }
+
+  static std::vector<std::string> ListAll() {
+    mx_uint n = 0;
+    const char **names = nullptr;
+    Check(MXListAllOpNames(&n, &names));
+    return std::vector<std::string>(names, names + n);
+  }
+};
+
+// ---------------------------------------------------------------------------
+class Executor;
+
+class Symbol {
+ public:
+  Symbol() = default;
+  explicit Symbol(SymbolHandle h) : h_(h) {}
+  Symbol(Symbol &&o) noexcept : h_(o.h_) { o.h_ = nullptr; }
+  Symbol &operator=(Symbol &&o) noexcept {
+    if (this != &o) {
+      Free();
+      h_ = o.h_;
+      o.h_ = nullptr;
+    }
+    return *this;
+  }
+  Symbol(const Symbol &) = delete;
+  Symbol &operator=(const Symbol &) = delete;
+  ~Symbol() { Free(); }
+
+  static Symbol Variable(const std::string &name) {
+    SymbolHandle h = nullptr;
+    Check(MXSymbolCreateVariable(name.c_str(), &h));
+    return Symbol(h);
+  }
+
+  static Symbol Create(const std::string &op,
+                       const std::vector<const Symbol *> &inputs,
+                       const KWArgs &params = {},
+                       const std::string &name = "") {
+    std::vector<const char *> keys, vals;
+    for (auto &kv : params) {
+      keys.push_back(kv.first.c_str());
+      vals.push_back(kv.second.c_str());
+    }
+    std::vector<SymbolHandle> ins;
+    for (auto *s : inputs) ins.push_back(s->h_);
+    SymbolHandle h = nullptr;
+    Check(MXSymbolCreateAtomicSymbolEx(
+        op.c_str(), static_cast<mx_uint>(keys.size()), keys.data(),
+        vals.data(), static_cast<mx_uint>(ins.size()), ins.data(),
+        name.c_str(), &h));
+    return Symbol(h);
+  }
+
+  static Symbol FromJSON(const std::string &json) {
+    SymbolHandle h = nullptr;
+    Check(MXSymbolCreateFromJSON(json.c_str(), &h));
+    return Symbol(h);
+  }
+
+  std::string ToJSON() const {
+    const char *out = nullptr;
+    Check(MXSymbolSaveToJSON(h_, &out));
+    return out;
+  }
+
+  std::vector<std::string> ListArguments() const {
+    return List(MXSymbolListArguments);
+  }
+  std::vector<std::string> ListOutputs() const {
+    return List(MXSymbolListOutputs);
+  }
+  std::vector<std::string> ListAuxiliaryStates() const {
+    return List(MXSymbolListAuxiliaryStates);
+  }
+
+  SymbolHandle handle() const { return h_; }
+
+  Executor Bind(const std::map<std::string, const NDArray *> &args,
+                const std::map<std::string, const NDArray *> &grads = {},
+                const std::map<std::string, const NDArray *> &aux = {})
+      const;
+
+ private:
+  using ListFn = int (*)(SymbolHandle, mx_uint *, const char ***);
+  std::vector<std::string> List(ListFn fn) const {
+    mx_uint n = 0;
+    const char **names = nullptr;
+    Check(fn(h_, &n, &names));
+    return std::vector<std::string>(names, names + n);
+  }
+  void Free() {
+    if (h_) MXSymbolFree(h_);
+    h_ = nullptr;
+  }
+  SymbolHandle h_ = nullptr;
+};
+
+// ---------------------------------------------------------------------------
+class Executor {
+ public:
+  explicit Executor(ExecutorHandle h) : h_(h) {}
+  Executor(Executor &&o) noexcept : h_(o.h_) { o.h_ = nullptr; }
+  Executor(const Executor &) = delete;
+  Executor &operator=(const Executor &) = delete;
+  ~Executor() {
+    if (h_) MXExecutorFree(h_);
+  }
+
+  void Forward(bool is_train) {
+    Check(MXExecutorForward(h_, is_train ? 1 : 0));
+  }
+  void Backward() { Check(MXExecutorBackward(h_, 0, nullptr)); }
+  std::vector<NDArray> Outputs() const {
+    mx_uint n = 0;
+    NDArrayHandle *outs = nullptr;
+    Check(MXExecutorOutputs(h_, &n, &outs));
+    std::vector<NDArray> result;
+    for (mx_uint i = 0; i < n; ++i) result.emplace_back(outs[i]);
+    return result;
+  }
+
+ private:
+  ExecutorHandle h_ = nullptr;
+};
+
+inline Executor Symbol::Bind(
+    const std::map<std::string, const NDArray *> &args,
+    const std::map<std::string, const NDArray *> &grads,
+    const std::map<std::string, const NDArray *> &aux) const {
+  std::vector<const char *> an, gn, xn;
+  std::vector<NDArrayHandle> ah, gh, xh;
+  for (auto &kv : args) {
+    an.push_back(kv.first.c_str());
+    ah.push_back(kv.second->handle());
+  }
+  for (auto &kv : grads) {
+    gn.push_back(kv.first.c_str());
+    gh.push_back(kv.second->handle());
+  }
+  for (auto &kv : aux) {
+    xn.push_back(kv.first.c_str());
+    xh.push_back(kv.second->handle());
+  }
+  ExecutorHandle h = nullptr;
+  Check(MXExecutorBind(h_, static_cast<mx_uint>(ah.size()), an.data(),
+                       ah.data(), static_cast<mx_uint>(gh.size()),
+                       gn.data(), gh.data(),
+                       static_cast<mx_uint>(xh.size()), xn.data(),
+                       xh.data(), &h));
+  return Executor(h);
+}
+
+// ---------------------------------------------------------------------------
+class KVStore {
+ public:
+  explicit KVStore(const std::string &type = "local") {
+    Check(MXKVStoreCreate(type.c_str(), &h_));
+  }
+  KVStore(const KVStore &) = delete;
+  KVStore &operator=(const KVStore &) = delete;
+  ~KVStore() {
+    if (h_) MXKVStoreFree(h_);
+  }
+
+  void Init(const std::string &key, const NDArray &v) {
+    const char *k = key.c_str();
+    NDArrayHandle h = v.handle();
+    Check(MXKVStoreInitEx(h_, 1, &k, &h));
+  }
+  void Push(const std::string &key, const NDArray &v, int priority = 0) {
+    const char *k = key.c_str();
+    NDArrayHandle h = v.handle();
+    Check(MXKVStorePushEx(h_, 1, &k, &h, priority));
+  }
+  void Pull(const std::string &key, NDArray *out, int priority = 0) {
+    const char *k = key.c_str();
+    NDArrayHandle h = out->handle();
+    Check(MXKVStorePullEx(h_, 1, &k, &h, priority));
+  }
+
+ private:
+  KVStoreHandle h_ = nullptr;
+};
+
+// autograd scope (ref: cpp-package autograd RAII helpers)
+class AutogradRecord {
+ public:
+  explicit AutogradRecord(bool train_mode = true)
+      : touched_train_(train_mode) {
+    Check(MXAutogradSetIsRecording(1, &prev_rec_));
+    if (train_mode) Check(MXAutogradSetIsTraining(1, &prev_train_));
+  }
+  ~AutogradRecord() {
+    int dummy = 0;
+    MXAutogradSetIsRecording(prev_rec_, &dummy);
+    // only restore training state if the constructor changed it
+    if (touched_train_) MXAutogradSetIsTraining(prev_train_, &dummy);
+  }
+
+ private:
+  bool touched_train_;
+  int prev_rec_ = 0;
+  int prev_train_ = 1;
+};
+
+inline void Backward(const std::vector<const NDArray *> &heads) {
+  std::vector<NDArrayHandle> hs;
+  for (auto *a : heads) hs.push_back(a->handle());
+  Check(MXAutogradBackward(static_cast<mx_uint>(hs.size()), hs.data(),
+                           nullptr, 0));
+}
+
+}  // namespace mxtpu
+
+#endif  // MXNET_TPU_CPP_MXNET_TPU_HPP_

@@ -121,9 +121,13 @@ def imperative_invoke(op_name: str, inputs, param_keys, param_vals,
     if out_arrays:
         # reference contract: caller-preallocated outputs are written in
         # place (c_api.cc MXImperativeInvokeEx out-array path)
-        for dst, src in zip(list(out_arrays), outs):
+        dsts = list(out_arrays)
+        check(len(dsts) == len(outs),
+              f"{op_name}: {len(dsts)} preallocated outputs for an op "
+              f"producing {len(outs)}")
+        for dst, src in zip(dsts, outs):
             dst._rebind(src._data)
-        return list(out_arrays)
+        return dsts
     return outs
 
 
@@ -148,21 +152,19 @@ def symbol_create_variable(name: str):
 def symbol_compose(s, name, input_syms) -> None:
     """Attach inputs to an input-less atomic symbol in place (ref:
     MXSymbolCompose — the CreateAtomicSymbol+Compose two-step every
-    language binding uses). Positional composition."""
+    language binding uses). Positional composition: rebuild the node via
+    symbol.create so aux auto-creation AND supplied-aux marking behave
+    exactly like the python frontend."""
     node = s._outputs[0][0]
     check(node.op is not None, "cannot compose a variable")
-    node.inputs = [a._outputs[0] for a in list(input_syms)]
-    if name:
-        node.name = str(name)
-    # aux-state auto-creation mirrors symbol.create
-    for aux_i in node.op.aux_inputs:
-        if aux_i >= len(node.inputs):
-            from mxnet_tpu.symbol.symbol import _Node
-            suffix = {3: "moving_mean", 4: "moving_var"}.get(
-                aux_i, f"aux{aux_i}")
-            aux_node = _Node(None, f"{node.name}_{suffix}", {}, [])
-            aux_node.extra["aux"] = True
-            node.inputs.append((aux_node, 0))
+    check(not node.inputs, "symbol already composed")
+    from mxnet_tpu.symbol.symbol import create
+    composed = create(node.op.name, list(input_syms), dict(node.attrs),
+                      name=str(name) if name else node.name)
+    cnode = composed._outputs[0][0]
+    node.inputs = cnode.inputs
+    node.name = cnode.name
+    node.attrs = cnode.attrs
 
 
 def symbol_create_atomic(op_name: str, param_keys, param_vals,
@@ -194,14 +196,26 @@ def symbol_list_aux(s) -> List[str]:
 
 
 def symbol_infer_shape(s, names, shapes):
+    """-> (arg_shapes, out_shapes, aux_shapes, complete). Falls back to
+    partial inference (unknown shapes become []) with complete=0, the
+    reference's (rc=0, *complete=0) contract."""
     known = {str(n): tuple(int(x) for x in shp)
              for n, shp in zip(list(names), list(shapes))}
-    arg_shapes, out_shapes, aux_shapes = s.infer_shape(**known)
 
     def as_lists(lst):
-        return [list(int(x) for x in shp) for shp in (lst or [])]
+        return [[] if shp is None else [int(x) for x in shp]
+                for shp in (lst or [])]
 
-    return as_lists(arg_shapes), as_lists(out_shapes), as_lists(aux_shapes)
+    try:
+        arg_shapes, out_shapes, aux_shapes = s.infer_shape(**known)
+        complete = all(shp is not None for shp in
+                       list(arg_shapes) + list(out_shapes) +
+                       list(aux_shapes))
+    except MXNetError:
+        arg_shapes, out_shapes, aux_shapes = s.infer_shape_partial(**known)
+        complete = False
+    return (as_lists(arg_shapes), as_lists(out_shapes),
+            as_lists(aux_shapes), 1 if complete else 0)
 
 
 def symbol_get_atomic_symbol_info(op_name: str):

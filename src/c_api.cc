@@ -457,6 +457,13 @@ MXTPU_API int MXSymbolCompose(SymbolHandle sym, const char *name,
                               SymbolHandle *args_h) {
   ensure_interpreter();
   ScopedGIL gil;
+  if (keys != nullptr) {
+    // silent positional wiring under keyword intent would transpose
+    // input roles — refuse loudly instead
+    set_error("MXSymbolCompose: keyword composition is not supported; "
+              "pass inputs positionally (keys must be NULL)");
+    return -1;
+  }
   PyObject *ins = handle_list(args_h, num_args);
   PyObject *args = Py_BuildValue("(OsN)", static_cast<PyObject *>(sym),
                                  name ? name : "", ins);
@@ -614,8 +621,9 @@ MXTPU_API int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args,
                 out_shape_ndim, out_shape_data);
   fill_shapeset(PyTuple_GetItem(r, 2), &g_aux_shapes, aux_shape_size,
                 aux_shape_ndim, aux_shape_data);
+  if (complete)
+    *complete = (int)PyLong_AsLong(PyTuple_GetItem(r, 3));
   Py_DECREF(r);
-  if (complete) *complete = 1;
   return 0;
 }
 

@@ -1,0 +1,44 @@
+"""cpp_package: the header-only C++ frontend over the general C API
+(ref: cpp-package/include/mxnet-cpp). Compiles and runs the training
+example like an external C++ consumer would."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def capi_lib():
+    lib = os.path.join(ROOT, "src", "libmxtpu_capi.so")
+    if not os.path.exists(lib):
+        subprocess.run(["make", "-C", os.path.join(ROOT, "src"),
+                        "libmxtpu_capi.so"],
+                       check=False, capture_output=True, timeout=180)
+    if not os.path.exists(lib):
+        pytest.skip("libmxtpu_capi.so not built")
+    return lib
+
+
+def test_cpp_frontend_trains_mlp(capi_lib, tmp_path):
+    binary = str(tmp_path / "train_mlp")
+    build = subprocess.run(
+        ["g++", "-O2", "-std=c++17",
+         "-I" + os.path.join(ROOT, "cpp_package", "include"),
+         os.path.join(ROOT, "cpp_package", "example", "train_mlp.cpp"),
+         "-L" + os.path.join(ROOT, "src"), "-lmxtpu_capi",
+         "-Wl,-rpath," + os.path.join(ROOT, "src"),
+         "-o", binary],
+        capture_output=True, text=True, timeout=180)
+    assert build.returncode == 0, build.stderr
+    env = dict(os.environ)
+    env["MXTPU_HOME"] = ROOT
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    run = subprocess.run([binary], capture_output=True, text=True,
+                         timeout=300, env=env)
+    out = run.stdout + run.stderr
+    assert run.returncode == 0, out[-2000:]
+    assert "OK" in run.stdout
+    assert "version 10500" in run.stdout

@@ -1,0 +1,58 @@
+"""tools/ scripts (ref: tools/parse_log.py, tools/bandwidth/measure.py)."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+LOG = """INFO Epoch[0] Batch [20] Speed: 1234.5 samples/sec
+INFO Epoch[0] Train-accuracy=0.61
+INFO Epoch[0] Time cost=12.3
+INFO Epoch[0] Validation-accuracy=0.58
+INFO Epoch[1] Batch [20] Speed: 1300.0 samples/sec
+INFO Epoch[1] Batch [40] Speed: 1310.0 samples/sec
+INFO Epoch[1] Train-cross-entropy=1.9
+INFO Epoch[1] Train-accuracy=0.72
+INFO Epoch[1] Validation-accuracy=0.69
+INFO Epoch[1] Time cost=11.9
+"""
+
+
+def _run_parse(tmp_path, *args):
+    log = tmp_path / "train.log"
+    log.write_text(LOG)
+    return subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "parse_log.py"),
+         str(log), *args], capture_output=True, text=True, timeout=60)
+
+
+def test_parse_log_markdown(tmp_path):
+    r = _run_parse(tmp_path)
+    assert r.returncode == 0, r.stderr
+    assert "train-accuracy" in r.stdout
+    assert "1305.0" in r.stdout  # averaged speedometer lines
+    assert "12.3" in r.stdout    # time cost
+
+
+def test_parse_log_json_and_metric(tmp_path):
+    r = _run_parse(tmp_path, "--format", "json")
+    rows = json.loads(r.stdout)
+    assert rows[1]["train"]["cross-entropy"] == "1.9"
+    assert rows[1]["speed"] == pytest.approx(1305.0)
+    r = _run_parse(tmp_path, "--metric", "cross-entropy")
+    assert "train-cross-entropy" in r.stdout
+
+
+def test_bandwidth_model_shapes():
+    """The gradient-shaped workload sweep runs on the test mesh and
+    reports per-tensor metadata (measure.py's real-model mode)."""
+    from tools.bandwidth import _model_grad_shapes, _measure_shapes
+    from mxnet_tpu.parallel import make_mesh
+    shapes = _model_grad_shapes("alexnet")
+    assert len(shapes) >= 10  # conv + fc params
+    mesh = make_mesh({"dp": 8})
+    bw, mb = _measure_shapes(mesh, "dp", shapes[:4], iters=2)
+    assert bw > 0 and mb > 0

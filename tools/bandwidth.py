@@ -2,23 +2,78 @@
 """Allreduce bandwidth benchmark (ref: tools/bandwidth/measure.py).
 
 Measures KVStore/collective bandwidth over the mesh with the reference's
-formula ``2(n-1)/n * size / t`` (measure.py:138). Run with JAX_PLATFORMS=cpu
-and --xla_force_host_platform_device_count for a virtual mesh, or on real
-chips for ICI numbers.
+formula ``2(n-1)/n * size / t`` (measure.py:138).
+
+Modes:
+- flat tensor sweep (``--size-mb``, possibly comma-separated)
+- model-gradient-shaped workload (``--model resnet50_v1|alexnet|...``):
+  allreduces one buffer per parameter with that model's REAL gradient
+  shapes in one fused program — the reference's measure.py drives the
+  kvstore with the model's actual param list likewise, which exposes
+  small-tensor overheads a single big buffer hides.
+
+Run with JAX_PLATFORMS=cpu and --xla_force_host_platform_device_count for
+a virtual mesh, or on real chips for ICI numbers.
 """
 import argparse
 import json
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".."))
 
 
+def _model_grad_shapes(name):
+    """Parameter shapes of a model-zoo network (gradient workload)."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, nd
+    from mxnet_tpu.gluon import model_zoo
+    net = model_zoo.vision.get_model(name)
+    net.initialize(mx.init.Xavier())
+    with autograd.pause():
+        net(nd.ones((1, 3, 224, 224)))
+    return [tuple(p.data().shape)
+            for _, p in sorted(net.collect_params().items())
+            if p.grad_req != "null"]
+
+
+def _measure_shapes(mesh, axis, shapes, iters):
+    """Fused (jitted) allreduce of one buffer per shape; returns
+    (GB/s/device, total_mb)."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.parallel.collectives import device_allreduce
+
+    arrays = [jnp.ones(s, jnp.float32) for s in shapes]
+    total_bytes = sum(a.nbytes for a in arrays)
+    n = dict(zip(mesh.axis_names, mesh.devices.shape))[axis]
+
+    # jit once: without it each iteration re-traces the shard_map per
+    # buffer and the timing measures host dispatch, not the wire
+    run = jax.jit(lambda *vs: device_allreduce(list(vs), mesh, axis=axis))
+
+    out = run(*arrays)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = run(*arrays)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    # ring-allreduce wire traffic: 2(n-1)/n * size (measure.py:138)
+    gb = 2 * (n - 1) / n * total_bytes / 1e9
+    return gb / dt, total_bytes / 1e6
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--size-mb", type=float, default=64.0,
-                    help="per-device tensor size")
+    ap.add_argument("--size-mb", default="64",
+                    help="flat tensor size(s), comma separated")
+    ap.add_argument("--model", default=None,
+                    help="use this model-zoo net's gradient shapes "
+                         "instead of a flat tensor")
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--axis", default="dp")
     ap.add_argument("--num-devices", type=int, default=0,
@@ -37,11 +92,24 @@ def main():
                           "note": "needs >=2 devices"}))
         return
     mesh = make_mesh({args.axis: n})
-    bw = measure_allreduce_bandwidth(mesh, size_mb=args.size_mb,
-                                     axis=args.axis, iters=args.iters)
-    print(json.dumps({"metric": "allreduce_bandwidth",
-                      "value": round(bw, 3), "unit": "GB/s/device",
-                      "devices": n, "size_mb": args.size_mb}))
+
+    if args.model:
+        shapes = _model_grad_shapes(args.model)
+        bw, mb = _measure_shapes(mesh, args.axis, shapes, args.iters)
+        print(json.dumps({"metric": "allreduce_bandwidth",
+                          "value": round(bw, 3), "unit": "GB/s/device",
+                          "devices": n, "model": args.model,
+                          "num_tensors": len(shapes),
+                          "total_mb": round(mb, 2)}))
+        return
+
+    for size_mb in (float(s) for s in str(args.size_mb).split(",")):
+        bw = measure_allreduce_bandwidth(mesh, size_mb=size_mb,
+                                         axis=args.axis,
+                                         iters=args.iters)
+        print(json.dumps({"metric": "allreduce_bandwidth",
+                          "value": round(bw, 3), "unit": "GB/s/device",
+                          "devices": n, "size_mb": size_mb}))
 
 
 if __name__ == "__main__":

@@ -6,34 +6,73 @@ import re
 import sys
 
 
-def main():
-    ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("logfile")
-    ap.add_argument("--format", choices=["markdown", "csv"],
-                    default="markdown")
-    args = ap.parse_args()
-    with open(args.logfile) as f:
-        text = f.read()
-    train = dict(re.findall(
-        r"Epoch\[(\d+)\].*?Train-accuracy=([\d.]+)", text))
-    val = dict(re.findall(
-        r"Epoch\[(\d+)\].*?Validation-accuracy=([\d.]+)", text))
+def parse(text):
+    """-> (epochs, train, val, speed, time) dicts keyed by epoch str.
+
+    Accepts the reference's logger format: any metric name after
+    Train-/Validation- (accuracy, cross-entropy, mse, ...), Speedometer
+    lines, and `Time cost=...` epoch summaries."""
+    train, val = {}, {}
+    for ep, metric, v in re.findall(
+            r"Epoch\[(\d+)\].*?Train-([\w-]+)=([\d.eE+-]+)", text):
+        train.setdefault(ep, {})[metric] = v
+    for ep, metric, v in re.findall(
+            r"Epoch\[(\d+)\].*?Validation-([\w-]+)=([\d.eE+-]+)", text):
+        val.setdefault(ep, {})[metric] = v
     speed = {}
     for ep, sp in re.findall(r"Epoch\[(\d+)\].*?Speed: ([\d.]+)", text):
         speed.setdefault(ep, []).append(float(sp))
-    epochs = sorted(set(train) | set(val) | set(speed), key=int)
+    times = dict(re.findall(r"Epoch\[(\d+)\].*?Time cost=([\d.]+)", text))
+    epochs = sorted(set(train) | set(val) | set(speed) | set(times),
+                    key=int)
+    return epochs, train, val, speed, times
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("logfile")
+    ap.add_argument("--format", choices=["markdown", "csv", "json"],
+                    default="markdown")
+    ap.add_argument("--metric", default=None,
+                    help="metric to tabulate (default: first seen, "
+                         "usually accuracy)")
+    args = ap.parse_args()
+    with open(args.logfile) as f:
+        text = f.read()
+    epochs, train, val, speed, times = parse(text)
     if not epochs:
         print("no epoch records found", file=sys.stderr)
         return 1
+    metric = args.metric
+    if metric is None:
+        for d in list(train.values()) + list(val.values()):
+            if d:
+                metric = next(iter(d))
+                break
+    if args.format == "json":
+        import json
+        rows = [{"epoch": int(ep),
+                 "train": train.get(ep, {}),
+                 "val": val.get(ep, {}),
+                 "speed": (sum(speed[ep]) / len(speed[ep])
+                           if ep in speed else None),
+                 "time_cost": float(times[ep]) if ep in times else None}
+                for ep in epochs]
+        print(json.dumps(rows, indent=1))
+        return 0
     sep = "," if args.format == "csv" else " | "
-    print(sep.join(["epoch", "train-acc", "val-acc", "speed(img/s)"]))
+    print(sep.join(["epoch", f"train-{metric}", f"val-{metric}",
+                    "speed(img/s)", "time(s)"]))
     if args.format == "markdown":
-        print(" | ".join(["---"] * 4))
+        print(" | ".join(["---"] * 5))
     for ep in epochs:
         sp = speed.get(ep)
         print(sep.join([
-            ep, train.get(ep, "-"), val.get(ep, "-"),
-            f"{sum(sp) / len(sp):.1f}" if sp else "-"]))
+            ep,
+            train.get(ep, {}).get(metric, "-"),
+            val.get(ep, {}).get(metric, "-"),
+            f"{sum(sp) / len(sp):.1f}" if sp else "-",
+            times.get(ep, "-")]))
     return 0
 
 
