@@ -82,14 +82,13 @@ int main() {
     if (step == 0) first = loss;
     last = loss;
     ex.Backward();  // LinearRegressionOutput: grad = pred - label
-    // SGD via the imperative op registry (sgd_update), like the
-    // reference cpp-package optimizer path
-    NDArray nw1 = Op::Invoke1("sgd_update", {&w1a, &g1},
-                              {{"lr", std::to_string(lr / B)}});
-    NDArray nw2 = Op::Invoke1("sgd_update", {&w2a, &g2},
-                              {{"lr", std::to_string(lr / B)}});
-    w1a.CopyFrom(nw1.CopyTo());
-    w2a.CopyFrom(nw2.CopyTo());
+    // SGD via the imperative op registry with preallocated outputs:
+    // the weight is rebound in place on device — zero host traffic
+    // (the reference cpp-package optimizer path)
+    Op::InvokeInto("sgd_update", {&w1a, &g1}, {&w1a},
+                   {{"lr", std::to_string(lr / B)}});
+    Op::InvokeInto("sgd_update", {&w2a, &g2}, {&w2a},
+                   {{"lr", std::to_string(lr / B)}});
   }
   std::printf("loss %f -> %f\n", first, last);
   if (!(last == last) || last >= first * 0.5f) {

@@ -10,11 +10,12 @@
 //   #include <mxnet_tpu_cpp/mxnet_tpu.hpp>
 //   using namespace mxtpu;
 //   NDArray a({2, 3});  a.CopyFrom({1,2,3,4,5,6});
-//   NDArray b = Op::Invoke1("relu", {a});
+//   NDArray b = Op::Invoke1("relu", {&a});
 //   Symbol x = Symbol::Variable("data"), w = Symbol::Variable("w");
-//   Symbol fc = Symbol::Create("FullyConnected", {x, w},
+//   Symbol fc = Symbol::Create("FullyConnected", {&x, &w},
 //                              {{"num_hidden", "4"}, {"no_bias","true"}});
-//   Executor ex = fc.Bind({{"data", a4}, {"w", wArr}}, {{"w", gradW}});
+//   Executor ex = fc.Bind({{"data", &a4}, {"w", &wArr}},
+//                         {{"w", &gradW}});
 //   ex.Forward(true); ex.Backward();
 //
 // Link: -L<repo>/src -lmxtpu_capi (set MXTPU_HOME to the repo root when
@@ -145,12 +146,19 @@ class NDArray {
   NDArrayHandle handle() const { return h_; }
 
   void CopyFrom(const std::vector<float> &data) {
+    RequireF32("CopyFrom");
     Check(MXNDArraySyncCopyFromCPU(h_, data.data(), data.size()));
   }
   std::vector<float> CopyTo() const {
+    RequireF32("CopyTo");
     std::vector<float> out(Size());
     Check(MXNDArraySyncCopyToCPU(h_, out.data(), out.size()));
     return out;
+  }
+  int DType() const {
+    int dt = 0;
+    Check(MXNDArrayGetDType(h_, &dt));
+    return dt;
   }
   std::vector<mx_uint> Shape() const {
     mx_uint ndim = 0;
@@ -180,6 +188,13 @@ class NDArray {
   }
 
  private:
+  void RequireF32(const char *what) const {
+    // the float-vector convenience surface is float32-only; wider dtypes
+    // through a float buffer would read/write out of bounds
+    if (DType() != 0)
+      throw std::runtime_error(std::string(what) +
+                               ": float32 arrays only (dtype code 0)");
+  }
   void Free() {
     if (h_) MXNDArrayFree(h_);
     h_ = nullptr;
@@ -220,6 +235,30 @@ class Op {
                          const KWArgs &params = {}) {
     auto outs = Invoke(name, inputs, params);
     return std::move(outs.at(0));
+  }
+
+  // in-place invoke: results land in caller-preallocated arrays (the
+  // reference's out= contract) — no new allocations, no host copies
+  static void InvokeInto(const std::string &name,
+                         const std::vector<const NDArray *> &inputs,
+                         const std::vector<NDArray *> &outputs,
+                         const KWArgs &params = {}) {
+    std::vector<NDArrayHandle> ins;
+    for (auto *a : inputs) ins.push_back(a->handle());
+    std::vector<NDArrayHandle> outs;
+    for (auto *a : outputs) outs.push_back(a->handle());
+    std::vector<const char *> keys, vals;
+    for (auto &kv : params) {
+      keys.push_back(kv.first.c_str());
+      vals.push_back(kv.second.c_str());
+    }
+    int n_out = static_cast<int>(outs.size());
+    NDArrayHandle *outp = outs.data();
+    Check(MXImperativeInvoke(name.c_str(),
+                             static_cast<int>(ins.size()), ins.data(),
+                             &n_out, &outp,
+                             static_cast<int>(keys.size()), keys.data(),
+                             vals.data()));
   }
 
   static std::vector<std::string> ListAll() {

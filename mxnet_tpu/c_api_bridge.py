@@ -157,7 +157,12 @@ def symbol_compose(s, name, input_syms) -> None:
     exactly like the python frontend."""
     node = s._outputs[0][0]
     check(node.op is not None, "cannot compose a variable")
-    check(not node.inputs, "symbol already composed")
+    # an uncomposed atomic symbol may already carry AUTO-CREATED aux
+    # inputs (symbol.create appends e.g. BatchNorm moving stats even with
+    # zero declared inputs) — only real (non-aux) inputs mean "composed"
+    real_inputs = [i for i, _ in node.inputs
+                   if not (i.is_variable and i.extra.get("aux", False))]
+    check(not real_inputs, "symbol already composed")
     from mxnet_tpu.symbol.symbol import create
     composed = create(node.op.name, list(input_syms), dict(node.attrs),
                       name=str(name) if name else node.name)

@@ -149,8 +149,12 @@ class BaseModule:
             nbatch = 0
             train_data.reset()
             for batch in train_data:
+                if monitor is not None:
+                    monitor.tic()
                 self.forward_backward(batch)
                 self.update()
+                if monitor is not None:
+                    monitor.toc_print()
                 self.update_metric(eval_metric, batch.label)
                 if batch_end_callback is not None:
                     _call_callbacks(batch_end_callback,

@@ -91,8 +91,9 @@ class BucketingModule(BaseModule):
         self._curr_module = self._buckets[bucket_key]
         self._curr_bucket_key = bucket_key
 
-    def init_params(self, **kwargs):
-        self._curr_module.init_params(**kwargs)
+    def init_params(self, initializer=None, **kwargs):
+        self._curr_module.init_params(initializer=initializer,
+                                      **kwargs)
         self.params_initialized = True
 
     def get_params(self):

@@ -262,24 +262,31 @@ def barrier(mesh=None) -> None:
     allreduce(jnp.zeros(()), mesh, axis=mesh.axis_names[0]).block_until_ready()
 
 
-def measure_allreduce_bandwidth(mesh, size_mb: float = 64.0, axis: str = "dp",
-                                iters: int = 10):
+def measure_allreduce_bandwidth(mesh, size_mb: float = 64.0,
+                                axis: str = "dp", iters: int = 10,
+                                shapes=None):
     """Allreduce bandwidth in GB/s/device with the reference's formula
-    ``2(n-1)/n * size / t`` (ref: tools/bandwidth/measure.py:138)."""
+    ``2(n-1)/n * size / t`` (ref: tools/bandwidth/measure.py:138).
+
+    ``shapes``: allreduce one buffer per shape in a single fused program
+    (the model-gradient-shaped workload of measure.py's real-model mode)
+    instead of one flat ``size_mb`` tensor."""
     import time
     import jax
     import jax.numpy as jnp
 
     n = dict(zip(mesh.axis_names, mesh.devices.shape))[axis]
-    nelem = int(size_mb * 1e6 / 4)
-    x = jnp.ones((nelem,), jnp.float32)
-    f = jax.jit(functools.partial(allreduce, mesh=mesh, axis=axis))
-    f(x).block_until_ready()  # compile
+    if shapes is None:
+        arrays = [jnp.ones((int(size_mb * 1e6 / 4),), jnp.float32)]
+    else:
+        arrays = [jnp.ones(s, jnp.float32) for s in shapes]
+    total_bytes = sum(a.nbytes for a in arrays)
+    f = jax.jit(lambda *vs: device_allreduce(list(vs), mesh, axis=axis))
+    jax.block_until_ready(f(*arrays))  # compile
     t0 = time.perf_counter()
     for _ in range(iters):
-        out = f(x)
-    out.block_until_ready()
+        out = f(*arrays)
+    jax.block_until_ready(out)
     dt = (time.perf_counter() - t0) / iters
-    size_bytes = nelem * 4
-    bw = 2 * (n - 1) / n * size_bytes / dt / 1e9
+    bw = 2 * (n - 1) / n * total_bytes / dt / 1e9
     return bw

@@ -449,9 +449,9 @@ MXTPU_API int MXSymbolCreateAtomicSymbol(const char *op_name,
 }
 
 // compose an atomic symbol with inputs: the CreateAtomicSymbol+Compose
-// two-step every reference language binding uses (positional args; the
-// keys argument names inputs in the reference and is accepted but
-// composition here is positional)
+// two-step every reference language binding uses. Positional args only —
+// keyword composition (keys != NULL) is rejected loudly rather than
+// silently wiring inputs into the wrong slots.
 MXTPU_API int MXSymbolCompose(SymbolHandle sym, const char *name,
                               mx_uint num_args, const char **keys,
                               SymbolHandle *args_h) {

@@ -187,3 +187,116 @@ def test_grouped_symbol():
     outs = ex.forward()
     assert float(outs[0].asscalar()) == 5.0
     assert float(outs[1].asscalar()) == 6.0
+
+
+def test_module_fit_with_monitor_and_callbacks():
+    """The fit harness edge paths of reference test_module.py: monitor
+    installed and fired, batch/epoch callbacks invoked with the right
+    payloads, arg_params used to warm-start."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import monitor as mon_mod
+    rs = np.random.RandomState(0)
+    X = rs.randn(64, 8).astype(np.float32)
+    Y = (X.sum(1) > 0).astype(np.float32)
+
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("fc_weight")
+    fc = mx.sym.FullyConnected(data, w, mx.sym.Variable("fc_bias"),
+                               num_hidden=2, name="fc")
+    out = mx.sym.Softmax(fc, mx.sym.Variable("softmax_label"),
+                         name="softmax")
+
+    train_iter = mx.io.NDArrayIter(X, Y, batch_size=16)
+    seen = {"batches": 0, "epochs": [], "monitor": 0}
+
+    def stat(x):
+        seen["monitor"] += 1
+        return x.abs().mean()
+
+    monitor = mx.Monitor(1, stat_func=stat, pattern=".*fc.*")
+
+    def batch_cb(param):
+        seen["batches"] += 1
+        assert hasattr(param, "epoch") and hasattr(param, "nbatch")
+
+    def epoch_cb(epoch, sym, arg_params, aux_params):
+        seen["epochs"].append(epoch)
+        assert "fc_weight" in arg_params
+
+    mod = mx.mod.Module(out, data_names=["data"],
+                        label_names=["softmax_label"])
+    # warm start from explicit arg_params (the resume path)
+    warm = {"fc_weight": mx.nd.zeros((2, 8)),
+            "fc_bias": mx.nd.zeros((2,))}
+    mod.fit(train_iter, num_epoch=2,
+            arg_params=warm, allow_missing=True,
+            initializer=mx.init.Xavier(),
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5},
+            batch_end_callback=batch_cb,
+            epoch_end_callback=epoch_cb,
+            monitor=monitor)
+    assert seen["batches"] == 8  # 4 batches x 2 epochs
+    assert seen["epochs"] == [0, 1]
+    assert seen["monitor"] > 0, "installed monitor never fired"
+    # metrics improve from the zero-init warm start
+    m = mx.metric.Accuracy()
+    mod.score(mx.io.NDArrayIter(X, Y, batch_size=16), m)
+    assert m.get()[1] > 0.6
+
+
+def test_executor_reshape_shares_parameters():
+    """MXExecutorReshape semantics: reshaping to a new batch size keeps
+    sharing the SAME parameter buffers (writes through one executor are
+    visible in the other)."""
+    import mxnet_tpu as mx
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("w")
+    fc = mx.sym.FullyConnected(data, w, num_hidden=3, no_bias=True,
+                               name="fc")
+    warr = nd.array(np.ones((3, 4), np.float32))
+    ex1 = fc.bind(mx.cpu(), args={"data": nd.zeros((2, 4)), "w": warr})
+    ex2 = ex1.reshape(data=(5, 4))
+    assert ex2.arg_dict["w"] is ex1.arg_dict["w"]
+    # mutate the shared weight; both executors see it
+    warr += 1.0
+    o1 = ex1.forward(is_train=False, data=nd.ones((2, 4)))[0].asnumpy()
+    o2 = ex2.forward(is_train=False, data=nd.ones((5, 4)))[0].asnumpy()
+    np.testing.assert_allclose(o1[0], o2[0], rtol=1e-6)
+    np.testing.assert_allclose(o1[0], np.full(3, 8.0), rtol=1e-6)
+
+
+def test_bucketing_module_shares_parameters_across_buckets():
+    """Switching buckets must reuse one parameter set (the shared_exec
+    path): training on one bucket changes predictions on the other."""
+    import mxnet_tpu as mx
+
+    def gen(bucket_key):
+        # time-axis bucketing: w is (2, 6) for EVERY bucket (applied per
+        # step, flatten=False), so buckets can share one buffer
+        data = mx.sym.Variable("data")
+        w = mx.sym.Variable("w")
+        fc = mx.sym.FullyConnected(data, w, num_hidden=2, no_bias=True,
+                                   flatten=False, name="fc")
+        return fc, ["data"], []
+
+    mod = mx.mod.BucketingModule(gen, default_bucket_key=8)
+    mod.bind(data_shapes=[("data", (4, 8, 6))], label_shapes=None,
+             for_training=True)
+    mod.init_params(mx.init.Constant(0.5))
+    from mxnet_tpu.io import DataBatch
+    mod.forward(DataBatch([nd.ones((4, 8, 6))], []), is_train=False)
+    out8 = mod.get_outputs()[0].asnumpy()
+    # switch to a shorter bucket: SAME param buffer, different shape
+    mod.switch_bucket(4, [("data", (4, 4, 6))], None)
+    assert mod._buckets[4]._exec.arg_dict["w"] is \
+        mod._buckets[8]._exec.arg_dict["w"]
+    mod.forward(DataBatch([nd.ones((4, 4, 6))], []), is_train=False)
+    out4 = mod.get_outputs()[0].asnumpy()
+    np.testing.assert_allclose(out8[0, 0], 0.5 * 6, rtol=1e-6)
+    np.testing.assert_allclose(out4[0, 0], 0.5 * 6, rtol=1e-6)
+    # a write through one bucket's buffer is visible in the other
+    mod._buckets[8]._exec.arg_dict["w"] += 0.5
+    mod.forward(DataBatch([nd.ones((4, 4, 6))], []), is_train=False)
+    np.testing.assert_allclose(mod.get_outputs()[0].asnumpy()[0, 0],
+                               1.0 * 6, rtol=1e-6)

@@ -41,30 +41,14 @@ def _model_grad_shapes(name):
 
 
 def _measure_shapes(mesh, axis, shapes, iters):
-    """Fused (jitted) allreduce of one buffer per shape; returns
+    """Gradient-shaped sweep via the library harness; returns
     (GB/s/device, total_mb)."""
-    import jax
-    import jax.numpy as jnp
-    from mxnet_tpu.parallel.collectives import device_allreduce
-
-    arrays = [jnp.ones(s, jnp.float32) for s in shapes]
-    total_bytes = sum(a.nbytes for a in arrays)
-    n = dict(zip(mesh.axis_names, mesh.devices.shape))[axis]
-
-    # jit once: without it each iteration re-traces the shard_map per
-    # buffer and the timing measures host dispatch, not the wire
-    run = jax.jit(lambda *vs: device_allreduce(list(vs), mesh, axis=axis))
-
-    out = run(*arrays)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = run(*arrays)
-    jax.block_until_ready(out)
-    dt = (time.perf_counter() - t0) / iters
-    # ring-allreduce wire traffic: 2(n-1)/n * size (measure.py:138)
-    gb = 2 * (n - 1) / n * total_bytes / 1e9
-    return gb / dt, total_bytes / 1e6
+    import numpy as np
+    from mxnet_tpu.parallel import measure_allreduce_bandwidth
+    bw = measure_allreduce_bandwidth(mesh, axis=axis, iters=iters,
+                                     shapes=shapes)
+    total_mb = sum(4 * int(np.prod(s)) for s in shapes) / 1e6
+    return bw, total_mb
 
 
 def main():

@@ -49,6 +49,8 @@ def main():
             if d:
                 metric = next(iter(d))
                 break
+        else:
+            metric = "accuracy"  # speed-only logs: sensible header
     if args.format == "json":
         import json
         rows = [{"epoch": int(ep),
