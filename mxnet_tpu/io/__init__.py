@@ -3,3 +3,4 @@ from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, CSVIter,  # noqa
                  MNISTIter, ResizeIter, PrefetchingIter)
 from .image_record import (ImageRecordIter, ImageDetRecordIter,  # noqa
                            LibSVMIter)
+from .staging import DeviceStagingIter  # noqa
