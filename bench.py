@@ -89,8 +89,12 @@ def run(batch=256, k_steps=8, dtype=None, layout=None):
         rs.randint(0, 1000, (k_steps, batch)).astype(np.float32))
 
     def sync(x):
-        # on the tunneled backend block_until_ready can return before the
-        # device finishes; fetching a scalar is the only true sync
+        # Root-caused (r2): block_until_ready DOES wait on the axon relay
+        # (measured ~120 ms for an 8k matmul, ~= compute + relay RTT); the
+        # earlier "returns early" suspicion was relay round-trip latency
+        # showing up in the subsequent fetch (~130 ms/op). A scalar fetch
+        # is used here because the timed quantity must include losses
+        # becoming host-visible, same as a real logging step.
         return float(np.asarray(x)[-1] if getattr(x, "ndim", 0) else x)
 
     log(f"compiling fused {k_steps}-step train program "
