@@ -43,6 +43,7 @@ from . import kvstore as kv  # noqa: F401
 from . import engine  # noqa: F401
 from . import profiler  # noqa: F401
 from . import runtime  # noqa: F401
+from . import storage  # noqa: F401
 from . import recordio  # noqa: F401
 from . import fault  # noqa: F401
 from . import test_utils  # noqa: F401

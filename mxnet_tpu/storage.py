@@ -1,0 +1,82 @@
+"""Storage layer surface (SURVEY §2.1 'Storage manager').
+
+Reference: src/storage/ — pooled storage managers
+(pooled_storage_manager.h:52), pinned-memory lanes, per-device
+round-robin pools, `MXStorageEmptyCache`, and the GPU memory info C API
+(`MXGetGPUMemoryInformation64`).
+
+TPU-native redesign: buffer allocation/pooling belongs to PJRT/XLA (the
+BFC allocator owns HBM; XLA buffer assignment plans program memory), so
+this layer exposes the OBSERVABILITY and CONTROL surface over it instead
+of reimplementing a pool:
+
+- :func:`memory_info` — free/total bytes per device (the
+  `mx.context.gpu_memory_info` analog, backed by PJRT memory stats)
+- :func:`memory_stats` — the allocator's raw counters (bytes in use,
+  peak, pool reserved — the pooled-storage-manager introspection)
+- :func:`empty_cache` — drop cached/donated buffers where the backend
+  supports it (`MXStorageEmptyCache` analog)
+- host->device staging lives in :class:`mxnet_tpu.io.DeviceStagingIter`
+  (the pinned-memory transfer lane analog)
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+from .base import check
+
+__all__ = ["memory_info", "memory_stats", "empty_cache"]
+
+
+def _device_of(ctx=None):
+    if ctx is None:
+        from .context import current_context
+        ctx = current_context()
+    return ctx.jax_device if hasattr(ctx, "jax_device") else ctx
+
+
+def memory_stats(ctx=None) -> Dict[str, int]:
+    """Raw allocator counters for the context's device.
+
+    Keys follow PJRT naming where available: ``bytes_in_use``,
+    ``peak_bytes_in_use``, ``bytes_limit``, ``bytes_reserved``, ...
+    Returns {} when the backend reports none (host CPU devices)."""
+    dev = _device_of(ctx)
+    stats = getattr(dev, "memory_stats", None)
+    if stats is None:
+        return {}
+    try:
+        return dict(stats() or {})
+    except Exception:
+        return {}
+
+
+def memory_info(ctx=None) -> Tuple[int, int]:
+    """(free_bytes, total_bytes) of the context's device — the
+    ``mx.context.gpu_memory_info`` / MXGetGPUMemoryInformation64 analog.
+
+    Raises MXNetError when the backend exposes no memory accounting
+    (matching the reference's error on CPU contexts)."""
+    s = memory_stats(ctx)
+    total = s.get("bytes_limit")
+    used = s.get("bytes_in_use")
+    check(total is not None and used is not None,
+          "device reports no memory accounting (host backend?)")
+    return int(total) - int(used), int(total)
+
+
+def empty_cache(ctx=None) -> None:
+    """Release cached device buffers where the backend supports it
+    (ref: MXStorageEmptyCache -> StorageManager::ReleaseAll). On PJRT
+    the allocator owns caching; this triggers a defragmentation hint when
+    available and is otherwise a documented no-op (XLA frees buffers at
+    their true last use — there is no framework-held pool to drop)."""
+    dev = _device_of(ctx)
+    for name in ("defragment", "clear_caches"):
+        fn = getattr(dev, name, None)
+        if fn is not None:
+            try:
+                fn()
+                return
+            except Exception:
+                continue  # try the next mechanism

@@ -96,3 +96,23 @@ def test_sequential_module():
     score = dict(seq.score(train, "acc"))
     acc = score.get("accuracy", score.get("acc", 0))
     assert acc > 0.6, score
+
+
+def test_storage_surface():
+    """mx.storage: allocator observability over PJRT (the storage-manager
+    introspection analog, pooled_storage_manager.h /
+    MXGetGPUMemoryInformation64)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.base import MXNetError
+    stats = mx.storage.memory_stats()
+    assert isinstance(stats, dict)  # may be {} on host backends
+    # branch on memory_info's OWN success condition
+    if stats.get("bytes_limit") is not None and \
+            stats.get("bytes_in_use") is not None:
+        free, total = mx.storage.memory_info()
+        assert 0 <= free <= total
+    else:
+        import pytest
+        with pytest.raises(MXNetError):
+            mx.storage.memory_info()
+    mx.storage.empty_cache()  # never raises
