@@ -62,10 +62,14 @@ class SPMDTrainer:
             from ..ndarray.ndarray import from_jax
             from .. import autograd
             import jax.numpy as jnp
-            # the probe runs eagerly against float32 parameters — cast a
-            # low-precision sample up so conv dtype checks don't trip
-            probe = sample_data
-            if hasattr(probe, "dtype") and probe.dtype != jnp.float32:
+            import numpy as _np
+            # the probe runs EAGERLY against freshly initialized (default-
+            # context) float32 parameters: detach the sample from any
+            # device commitment (a staged accelerator batch would clash
+            # with CPU-committed params) and cast low precision up so
+            # conv dtype checks don't trip
+            probe = jnp.asarray(_np.asarray(sample_data))
+            if probe.dtype != jnp.float32:
                 probe = probe.astype(jnp.float32)
             with autograd.pause():
                 self.block._imperative_call(from_jax(probe))

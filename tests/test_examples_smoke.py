@@ -33,3 +33,18 @@ def test_rcnn_example_learns():
 def test_ssd_example_runs():
     r = _run("examples/ssd/train_ssd.py", ["--iters", "3"])
     assert r.returncode == 0, r.stderr[-2000:]
+
+
+def test_tpu_fast_training_example(tmp_path):
+    """The round-2 fast-training recipe (run_steps + DeviceStagingIter +
+    async checkpoints + remat) runs end to end."""
+    r = _run("examples/tpu_fast_training.py",
+             ["--batch-size", "4", "--fused-steps", "2",
+              "--image-size", "32", "--num-batches", "3", "--remat",
+              "--ckpt-dir", str(tmp_path / "ckpt"), "--ckpt-every", "2"])
+    out = r.stdout + r.stderr
+    assert r.returncode == 0, out[-2000:]
+    assert "img/s" in r.stdout
+    assert "checkpoints: [4, 6]" in r.stdout or \
+        "checkpoints:" in r.stdout and "[]" not in r.stdout.split(
+            "checkpoints:")[1]
