@@ -364,7 +364,14 @@ case("slice_like",
           ref=lambda x, y: x[:2, :3]))
 case("take",
      Case((N((5, 3), seed=85), np.array([0, 2, 4], np.int32)),
-          ref=lambda x, i: x[i], dtype_sweep=True))
+          ref=lambda x, i: x[i], dtype_sweep=True),
+     # clip mode clamps out-of-range; wrap mode wraps negative/overflow
+     Case((N((4, 2), seed=217), np.array([-1, 5, 3], np.int32)),
+          {"mode": "clip"},
+          ref=lambda x, i, mode: x[np.clip(i, 0, 3)]),
+     Case((N((4, 2), seed=218), np.array([-1, 5, 3], np.int32)),
+          {"mode": "wrap"},
+          ref=lambda x, i, mode: x[i % 4]))
 case("batch_take",
      Case((N((3, 4), seed=86), np.array([0, 2, 1], np.int32)),
           ref=lambda a, i: a[np.arange(3), i]))
@@ -505,17 +512,41 @@ case("FullyConnected",
 case("Convolution",
      Case((N((2, 2, 5, 5), seed=127), N((3, 2, 3, 3), seed=128)),
           {"kernel": (3, 3), "num_filter": 3, "no_bias": True},
-          ref=lambda x, w, **kw: _conv2d_ref(x, w), grad_rtol=4e-2))
+          ref=lambda x, w, **kw: _conv2d_ref(x, w), grad_rtol=4e-2),
+     # stride 2 + padding 1
+     Case((N((1, 2, 6, 6), seed=219), N((4, 2, 3, 3), seed=220)),
+          {"kernel": (3, 3), "num_filter": 4, "no_bias": True,
+           "stride": (2, 2), "pad": (1, 1)},
+          ref=lambda x, w, **kw: _conv2d_ref(x, w, stride=2, pad=1),
+          grad_rtol=4e-2),
+     # grouped convolution (num_group=2)
+     Case((N((1, 4, 5, 5), seed=221), N((4, 2, 3, 3), seed=222)),
+          {"kernel": (3, 3), "num_filter": 4, "no_bias": True,
+           "num_group": 2},
+          ref=lambda x, w, **kw: np.concatenate(
+              [_conv2d_ref(x[:, :2], w[:2]),
+               _conv2d_ref(x[:, 2:], w[2:])], axis=1),
+          grad_rtol=4e-2),
+     # with bias
+     Case((N((1, 2, 4, 4), seed=223), N((3, 2, 3, 3), seed=224),
+           N((3,), seed=225)),
+          {"kernel": (3, 3), "num_filter": 3},
+          ref=lambda x, w, b, **kw:
+          _conv2d_ref(x, w) + b.reshape(1, -1, 1, 1), grad_rtol=4e-2))
 
 
 def _conv2d_ref(x, w, stride=1, pad=0):
+    if pad:
+        x = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
     n, c, h, wd = x.shape
     f, _, kh, kw = w.shape
-    oh, ow = h - kh + 1, wd - kw + 1
+    oh = (h - kh) // stride + 1
+    ow = (wd - kw) // stride + 1
     out = np.zeros((n, f, oh, ow), np.float32)
     for i in range(oh):
         for j in range(ow):
-            patch = x[:, :, i:i + kh, j:j + kw]
+            patch = x[:, :, i * stride:i * stride + kh,
+                      j * stride:j * stride + kw]
             out[:, :, i, j] = np.einsum("nchw,fchw->nf", patch, w)
     return out
 
@@ -523,14 +554,51 @@ def _conv2d_ref(x, w, stride=1, pad=0):
 case("Deconvolution",
      Case((N((1, 2, 3, 3), seed=129), N((2, 2, 2, 2), seed=130)),
           {"kernel": (2, 2), "num_filter": 2, "no_bias": True},
-          grad_rtol=4e-2))
+          ref=lambda x, w, **kw: _deconv2d_ref(x, w), grad_rtol=4e-2))
+
+
+def _deconv2d_ref(x, w):
+    # transposed convolution, stride 1: scatter each input pixel through
+    # the kernel (w layout: (in_ch, out_ch, kh, kw))
+    n, ci, h, wd = x.shape
+    _, co, kh, kw = w.shape
+    out = np.zeros((n, co, h + kh - 1, wd + kw - 1), np.float32)
+    for i in range(h):
+        for j in range(wd):
+            out[:, :, i:i + kh, j:j + kw] += np.einsum(
+                "nc,cfhw->nfhw", x[:, :, i, j], w)
+    return out
 case("Pooling",
      Case((N((2, 2, 4, 4), seed=131),),
           {"kernel": (2, 2), "stride": (2, 2), "pool_type": "max"},
           ref=lambda x, **kw: x.reshape(2, 2, 2, 2, 2, 2).max((3, 5))),
      Case((N((2, 2, 4, 4), seed=132),),
           {"kernel": (2, 2), "stride": (2, 2), "pool_type": "avg"},
-          ref=lambda x, **kw: x.reshape(2, 2, 2, 2, 2, 2).mean((3, 5))))
+          ref=lambda x, **kw: x.reshape(2, 2, 2, 2, 2, 2).mean((3, 5))),
+     # global pooling ignores kernel
+     Case((N((2, 3, 5, 5), seed=226),),
+          {"kernel": (2, 2), "pool_type": "avg", "global_pool": True},
+          ref=lambda x, **kw: x.mean((2, 3), keepdims=True)),
+     # 'full' convention rounds the output size UP (ref: pooling-inl.h
+     # pooling_convention=full)
+     Case((N((1, 1, 5, 5), seed=227),),
+          {"kernel": (2, 2), "stride": (2, 2), "pool_type": "max",
+           "pooling_convention": "full"},
+          ref=lambda x, **kw: _pool_full_ref(x)),
+     # sum pooling
+     Case((N((1, 2, 4, 4), seed=228),),
+          {"kernel": (2, 2), "stride": (2, 2), "pool_type": "sum"},
+          ref=lambda x, **kw: x.reshape(1, 2, 2, 2, 2, 2).sum((3, 5))))
+
+
+def _pool_full_ref(x):
+    # 5x5, kernel 2, stride 2, full: out 3x3 (last window partial)
+    out = np.full((1, 1, 3, 3), -np.inf, np.float32)
+    for i in range(3):
+        for j in range(3):
+            out[0, 0, i, j] = x[0, 0, 2 * i:2 * i + 2,
+                                2 * j:2 * j + 2].max()
+    return out
 case("softmax",
      Case((N((3, 5), seed=133),), {"axis": -1},
           ref=lambda x, axis: _softmax_ref(x), dtype_sweep=True))
@@ -577,7 +645,21 @@ case("L2Normalization",
           ref=lambda x: x / np.sqrt((x ** 2).sum(
               axis=tuple(range(1, x.ndim)), keepdims=True) + 1e-10)))
 case("LRN", Case((N((2, 6, 3, 3), seed=142),), {"nsize": 3},
+                 ref=lambda x, nsize: _lrn_ref(x, nsize),
                  grad_rtol=4e-2))
+
+
+def _lrn_ref(x, nsize, alpha=1e-4, beta=0.75, knorm=2.0):
+    # cross-channel local response norm; alpha is divided by nsize
+    # (ref: lrn-inl.h  tmp_norm = knorm + alpha/nsize * sum(sq))
+    n, c, h, w = x.shape
+    half = nsize // 2
+    sq = x ** 2
+    denom = np.zeros_like(x)
+    for ch in range(c):
+        lo, hi = max(0, ch - half), min(c, ch + half + 1)
+        denom[:, ch] = sq[:, lo:hi].sum(axis=1)
+    return x / (knorm + alpha / nsize * denom) ** beta
 case("Embedding",
      Case((np.array([0, 2, 1], np.int32), N((4, 5), seed=143)),
           {"input_dim": 4, "output_dim": 5},

@@ -45,6 +45,5 @@ def test_tpu_fast_training_example(tmp_path):
     out = r.stdout + r.stderr
     assert r.returncode == 0, out[-2000:]
     assert "img/s" in r.stdout
-    assert "checkpoints: [4, 6]" in r.stdout or \
-        "checkpoints:" in r.stdout and "[]" not in r.stdout.split(
-            "checkpoints:")[1]
+    # 3 outer batches of 2 fused steps, saving at i%2==1 -> exactly [4]
+    assert "checkpoints: [4]" in r.stdout, r.stdout[-500:]
