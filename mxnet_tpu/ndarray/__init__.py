@@ -10,7 +10,8 @@ import types
 from .ndarray import (NDArray, array, zeros, ones, full, empty, arange,
                       linspace, eye, concatenate, stack, moveaxis, from_jax,
                       waitall, imperative_invoke)
-from .utils import save, load
+from .utils import (save, load, from_dlpack,  # noqa: F401
+                    to_dlpack_for_read, to_dlpack_for_write)
 from ..ops import registry as _registry  # ensure op modules are imported
 from .. import ops as _ops_pkg  # noqa: F401  (triggers op registration)
 from . import register as _register

@@ -61,7 +61,7 @@ def _as_dtype(dtype):
 
 class NDArray:
     __slots__ = ("_data", "_ctx", "_version", "_grad", "_grad_req",
-                 "_tape_entry", "_stype", "__weakref__")
+                 "_tape_entry", "_stype", "_dlpack_staged", "__weakref__")
 
     __array_priority__ = 100.0
 
@@ -157,6 +157,42 @@ class NDArray:
         check(self.size == 1, "The current array is not a scalar")
         return self.asnumpy().reshape(())[()]
 
+    # -- DLPack interchange (ref: MXNDArrayToDLPack/FromDLPack,
+    # include/mxnet/c_api.h; python/mxnet/dlpack.py) ------------------
+    def _dlpack_source(self):
+        """The jax buffer to export: zero-copy on cpu/gpu; TPU buffers
+        are staged to host ONCE (DLPack has no TPU device type) and the
+        staged copy is reused across the __dlpack_device__/__dlpack__
+        consumer handshake."""
+        import jax
+        arr = self._data
+        platform = next(iter(arr.devices())).platform
+        if platform in ("cpu", "gpu", "cuda", "rocm"):
+            return arr
+        staged = getattr(self, "_dlpack_staged", None)
+        if staged is None or staged[0] is not arr:
+            staged = (arr, jax.device_put(arr, jax.devices("cpu")[0]))
+            self._dlpack_staged = staged
+        return staged[1]
+
+    def __dlpack__(self, *, stream=None):
+        return self._dlpack_source().__dlpack__(stream=stream)
+
+    def __dlpack_device__(self):
+        return self._dlpack_source().__dlpack_device__()
+
+    def to_dlpack_for_read(self):
+        """Export as a DLPack capsule (shared, read-only use)."""
+        self.wait_to_read()
+        return self._dlpack_source().__dlpack__()
+
+    def to_dlpack_for_write(self):
+        """Export as a DLPack capsule. Functional arrays on XLA are
+        immutable: consumers see a snapshot; in-place writes from the
+        consumer are NOT reflected back (documented deviation from the
+        reference's mutable buffers)."""
+        return self.to_dlpack_for_read()
+
     def item(self):
         return self.asscalar()
 
@@ -223,11 +259,6 @@ class NDArray:
 
     def as_nd_ndarray(self):
         return self
-
-    def to_dlpack_for_read(self):
-        return self._data.__dlpack__()
-
-    to_dlpack_for_write = to_dlpack_for_read
 
     # ------------------------------------------------------------------
     # autograd

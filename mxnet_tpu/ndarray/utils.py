@@ -132,3 +132,24 @@ def load(fname: str) -> Union[List, Dict]:
     if any(name for _, name, _ in entries):
         return {name: nd for _, name, nd in entries}
     return [nd for _, _, nd in entries]
+
+
+def from_dlpack(ext_tensor):
+    """NDArray from any DLPack-exporting tensor (torch, numpy, cupy, ...)
+    — zero-copy where devices allow (ref: MXNDArrayFromDLPackEx,
+    python/mxnet/dlpack.py)."""
+    import jax.numpy as jnp
+    from .ndarray import from_jax
+    return from_jax(jnp.from_dlpack(ext_tensor))
+
+
+def to_dlpack_for_read(arr):
+    """DLPack capsule of ``arr`` (ref: MXNDArrayToDLPackForRead)."""
+    return arr.to_dlpack_for_read()
+
+
+def to_dlpack_for_write(arr):
+    """DLPack capsule of ``arr``. XLA arrays are immutable: the consumer
+    sees a snapshot (ref: MXNDArrayToDLPackForWrite, with the documented
+    functional-semantics deviation)."""
+    return arr.to_dlpack_for_write()

@@ -235,3 +235,15 @@ def test_async_checkpoint_resume_with_trainer(tmp_path):
                                 sorted(net_b.collect_params().items())):
         np.testing.assert_allclose(pa.data().asnumpy(),
                                    pb.data().asnumpy(), rtol=1e-6)
+
+
+def test_cpp_native_unit_tests():
+    """The tests/cpp analog: build and run the assert-based C++ unit
+    tests over the engine + recordio C ABIs (make -C src test)."""
+    import os
+    import subprocess
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(["make", "-C", os.path.join(root, "src"), "test"],
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "ALL NATIVE TESTS PASSED" in r.stdout

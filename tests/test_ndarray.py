@@ -301,3 +301,24 @@ def test_sparse_save_load_bf16(tmp_path):
     np.testing.assert_allclose(
         np.asarray(out.todense().asnumpy(), np.float32),
         [[1, 0, 2], [0, 0, 3]])
+
+
+def test_dlpack_interchange_with_torch():
+    """DLPack round-trips with torch (ref: MXNDArrayToDLPack /
+    FromDLPack + python/mxnet/dlpack.py): zero-copy where the device
+    allows, snapshot semantics on functional XLA buffers."""
+    import numpy as np
+    torch = __import__("pytest").importorskip("torch")
+    a = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    # NDArray implements __dlpack__: torch consumes it directly
+    t = torch.from_dlpack(a)
+    np.testing.assert_array_equal(t.numpy(), a.asnumpy())
+    # capsule API
+    t2 = torch.utils.dlpack.from_dlpack(a.to_dlpack_for_read())
+    np.testing.assert_array_equal(t2.numpy(), a.asnumpy())
+    # torch -> NDArray
+    src = torch.arange(8, dtype=torch.float32).reshape(2, 4) + 1
+    b = nd.from_dlpack(src)
+    np.testing.assert_array_equal(b.asnumpy(), src.numpy())
+    # the imported array plays in ops
+    np.testing.assert_array_equal((b * 2).asnumpy(), src.numpy() * 2)

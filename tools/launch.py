@@ -121,6 +121,40 @@ def launch_ssh(args, cmd):
     return _wait_group(procs)
 
 
+def launch_mpi(args, cmd):
+    """Launch through mpirun/mpiexec (the dmlc_tracker mpi.py role).
+
+    The per-rank env is applied by a python shim on each rank (works for
+    any MPI flavor — no OpenMPI-only ``-x`` flags): the shim reads the
+    runtime's rank variable, overlays the SAME _worker_env contract the
+    local/ssh launchers use, plus the forwarded env, then execs the
+    worker. The coordinator address must be reachable from every host
+    (pass --coordinator host0:port)."""
+    import shutil
+    mpirun = shutil.which("mpirun") or shutil.which("mpiexec")
+    if mpirun is None:
+        print("mpirun/mpiexec not found on PATH", file=sys.stderr)
+        return 127
+    # full env (forwarded + rank-0 worker env template); the shim
+    # rewrites the rank-dependent keys per process
+    env = _forward_env(args)
+    env.update(_worker_env(args, 0))
+    shim = (
+        "import os,sys,subprocess;"
+        f"env={env!r};"
+        "r=os.environ.get('OMPI_COMM_WORLD_RANK') or "
+        "os.environ.get('PMI_RANK') or os.environ.get('PMIX_RANK') or "
+        "os.environ.get('SLURM_PROCID');"
+        "assert r is not None, "
+        "'cannot determine MPI rank (no OMPI/PMI/PMIX/SLURM rank var)';"
+        "env['MXTPU_WORKER_ID']=r; env['DMLC_RANK']=r;"
+        "os.environ.update(env);"
+        "sys.exit(subprocess.call(sys.argv[1:]))")
+    full = [mpirun, "-n", str(args.num_workers),
+            sys.executable, "-c", shim] + cmd
+    return subprocess.call(full)
+
+
 def launch_local(args, cmd):
     procs = []
     for rank in range(args.num_workers):
@@ -169,7 +203,8 @@ def main():
         description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
     ap.add_argument("-n", "--num-workers", type=int, required=True)
-    ap.add_argument("--launcher", choices=["local", "ssh", "manual"],
+    ap.add_argument("--launcher",
+                    choices=["local", "ssh", "mpi", "manual"],
                     default="local")
     ap.add_argument("--coordinator", default="127.0.0.1:12357",
                     help="host:port of rank 0's coordination service")
@@ -199,6 +234,9 @@ def main():
 
     if args.launcher == "ssh":
         sys.exit(launch_ssh(args, cmd))
+
+    if args.launcher == "mpi":
+        sys.exit(launch_mpi(args, cmd))
 
     # local: fork N processes on this machine (the reference's local
     # tracker pattern used by tests/nightly/dist_sync_kvstore.py)
