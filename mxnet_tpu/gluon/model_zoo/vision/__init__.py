@@ -5,8 +5,9 @@ from .vgg import (vgg11, vgg13, vgg16, vgg19, vgg11_bn, vgg13_bn,  # noqa
                   vgg16_bn, vgg19_bn, VGG)
 from .squeezenet import squeezenet1_0, squeezenet1_1, SqueezeNet  # noqa
 from .mobilenet import (mobilenet1_0, mobilenet0_75, mobilenet0_5,  # noqa
-                        mobilenet0_25, mobilenet_v2_1_0, MobileNet,
-                        MobileNetV2)
+                        mobilenet0_25, mobilenet_v2_1_0,
+                        mobilenet_v2_0_75, mobilenet_v2_0_5,
+                        mobilenet_v2_0_25, MobileNet, MobileNetV2)
 from .densenet import (densenet121, densenet161, densenet169,  # noqa
                        densenet201, DenseNet)
 from .inception import inception_v3, Inception3  # noqa
@@ -21,6 +22,9 @@ def _register_models():
     mod = sys.modules[__name__]
     # zoo names whose registry key differs from the function name
     aliases = {"mobilenetv2_1.0": "mobilenet_v2_1_0",
+               "mobilenetv2_0.75": "mobilenet_v2_0_75",
+               "mobilenetv2_0.5": "mobilenet_v2_0_5",
+               "mobilenetv2_0.25": "mobilenet_v2_0_25",
                "inceptionv3": "inception_v3"}
     for name in ["resnet18_v1", "resnet34_v1", "resnet50_v1", "resnet101_v1",
                  "resnet152_v1", "resnet18_v2", "resnet34_v2", "resnet50_v2",
@@ -28,7 +32,8 @@ def _register_models():
                  "vgg16", "vgg19", "vgg11_bn", "vgg13_bn", "vgg16_bn",
                  "vgg19_bn", "squeezenet1.0", "squeezenet1.1",
                  "mobilenet1.0", "mobilenet0.75", "mobilenet0.5",
-                 "mobilenet0.25", "mobilenetv2_1.0", "densenet121",
+                 "mobilenet0.25", "mobilenetv2_1.0", "mobilenetv2_0.75",
+                 "mobilenetv2_0.5", "mobilenetv2_0.25", "densenet121",
                  "densenet161", "densenet169", "densenet201",
                  "inceptionv3"]:
         attr = aliases.get(name, name.replace(".", "_"))

@@ -3,7 +3,8 @@ from ...block import HybridBlock
 from ... import nn
 
 __all__ = ["MobileNet", "MobileNetV2", "mobilenet1_0", "mobilenet0_75",
-           "mobilenet0_5", "mobilenet0_25", "mobilenet_v2_1_0"]
+           "mobilenet0_5", "mobilenet0_25", "mobilenet_v2_1_0",
+           "mobilenet_v2_0_75", "mobilenet_v2_0_5", "mobilenet_v2_0_25"]
 
 
 def _add_conv(out, channels, kernel=1, stride=1, pad=0, num_group=1,
@@ -93,3 +94,6 @@ def mobilenet0_75(**kw): return MobileNet(0.75, **kw)
 def mobilenet0_5(**kw): return MobileNet(0.5, **kw)
 def mobilenet0_25(**kw): return MobileNet(0.25, **kw)
 def mobilenet_v2_1_0(**kw): return MobileNetV2(1.0, **kw)
+def mobilenet_v2_0_75(**kw): return MobileNetV2(0.75, **kw)
+def mobilenet_v2_0_5(**kw): return MobileNetV2(0.5, **kw)
+def mobilenet_v2_0_25(**kw): return MobileNetV2(0.25, **kw)

@@ -17,3 +17,17 @@ def test_inception_v3_forward():
     net.initialize()
     x = mx.nd.array(np.random.rand(1, 3, 299, 299).astype(np.float32))
     assert net(x).shape == (1, 10)
+
+
+def test_mobilenet_v2_width_variants():
+    """All four MobileNetV2 width multipliers (reference zoo parity);
+    the multiplier must actually shrink the stem conv channels."""
+    for name, mult in [("mobilenetv2_1.0", 1.0), ("mobilenetv2_0.75", .75),
+                       ("mobilenetv2_0.5", 0.5), ("mobilenetv2_0.25", .25)]:
+        net = get_model(name, classes=10)
+        net.initialize()
+        x = mx.nd.array(np.random.rand(1, 3, 32, 32).astype(np.float32))
+        assert net(x).shape == (1, 10), name
+        stem = [p for n, p in sorted(net.collect_params().items())
+                if "weight" in n][0]
+        assert stem.data().shape[0] == int(32 * mult), (name, stem.shape)
