@@ -19,7 +19,8 @@ __version__ = "0.1.0"
 
 from .base import MXNetError  # noqa: F401
 from .context import (Context, cpu, gpu, tpu, cpu_pinned,  # noqa: F401
-                      current_context, num_gpus, num_tpus, device_list)
+                      current_context, num_gpus, num_tpus, device_list,
+                      gpu_memory_info)
 from . import ndarray  # noqa: F401
 from . import ndarray as nd  # noqa: F401
 from . import autograd  # noqa: F401

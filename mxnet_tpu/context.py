@@ -148,6 +148,15 @@ def current_context() -> Context:
     return Context._default_ctx.value
 
 
+def gpu_memory_info(device_id: int = 0):
+    """(free, total) bytes of accelerator ``device_id`` (ref:
+    python/mxnet/context.py:261 gpu_memory_info /
+    MXGetGPUMemoryInformation64). Backed by mx.storage.memory_info; on
+    TPU the 'gpu' context maps to the accelerator device."""
+    from .storage import memory_info
+    return memory_info(gpu(device_id))
+
+
 def num_gpus() -> int:
     """Number of accelerator chips visible (ref: mx.context.num_gpus)."""
     return len(_accelerator_devices())

@@ -116,3 +116,19 @@ def test_storage_surface():
         with pytest.raises(MXNetError):
             mx.storage.memory_info()
     mx.storage.empty_cache()  # never raises
+
+
+def test_gpu_memory_info_parity_surface():
+    """mx.context.gpu_memory_info maps to storage.memory_info (raises on
+    backends without accounting, like the reference on CPU builds)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.base import MXNetError
+    stats = mx.storage.memory_stats(mx.gpu(0))
+    if stats.get("bytes_limit") is not None and \
+            stats.get("bytes_in_use") is not None:
+        free, total = mx.gpu_memory_info(0)
+        assert 0 <= free <= total
+    else:
+        import pytest
+        with pytest.raises(MXNetError):
+            mx.gpu_memory_info(0)
