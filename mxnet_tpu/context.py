@@ -20,7 +20,7 @@ from typing import List, Optional
 from .base import MXNetError
 
 __all__ = ["Context", "cpu", "gpu", "tpu", "cpu_pinned", "current_context",
-           "num_gpus", "num_tpus", "device_list"]
+           "num_gpus", "num_tpus", "device_list", "gpu_memory_info"]
 
 
 def _jax():

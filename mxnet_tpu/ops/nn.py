@@ -377,31 +377,71 @@ def _leaky_relu(data, *maybe_gamma, act_type="leaky", slope=0.25,
 # Softmax family (ref: softmax.cc, softmax_output.cc, softmax_activation.cc)
 # ---------------------------------------------------------------------------
 
+def _length_mask(data, length, axis):
+    """Boolean mask selecting positions < length along ``axis`` (ref:
+    softmax-inl.h length path: the length tensor has data's shape with
+    the softmax axis removed)."""
+    jnp = _jnp()
+    ax = axis % data.ndim
+    shape = [1] * data.ndim
+    shape[ax] = data.shape[ax]
+    positions = jnp.arange(data.shape[ax]).reshape(shape)
+    return positions < jnp.expand_dims(length, ax).astype(jnp.int32)
+
+
 @register("softmax")
 def _softmax(data, *maybe_length, axis=-1, temperature=None, dtype=None,
              use_length=False):
     import jax
     jnp = _jnp()
     x = data if temperature in (None, 1.0) else data / temperature
-    out = jax.nn.softmax(x.astype(jnp.float32), axis=axis)
-    out = out.astype(_np.dtype(dtype)) if dtype is not None \
+    x = x.astype(jnp.float32)
+    if use_length:
+        if not maybe_length:
+            raise MXNetError("softmax: use_length=True requires the "
+                             "length input")
+        # masked softmax: exp(finfo.min - max) is exactly 0 in f32, so
+        # valid positions normalize over the valid slice alone and the
+        # where() zeroes masked positions (all-masked rows -> all zeros)
+        mask = _length_mask(data, maybe_length[0], axis)
+        neg = jnp.finfo(jnp.float32).min
+        p = jax.nn.softmax(jnp.where(mask, x, neg), axis=axis)
+        out = jnp.where(mask, p, 0.0)
+    else:
+        out = jax.nn.softmax(x, axis=axis)
+    return out.astype(_np.dtype(dtype)) if dtype is not None \
         else out.astype(data.dtype)
-    return out
 
 
 @register("log_softmax")
-def _log_softmax(data, axis=-1, temperature=None, dtype=None, use_length=False):
+def _log_softmax(data, *maybe_length, axis=-1, temperature=None,
+                 dtype=None, use_length=False):
     import jax
     jnp = _jnp()
     x = data if temperature in (None, 1.0) else data / temperature
-    return jax.nn.log_softmax(x.astype(jnp.float32),
-                              axis=axis).astype(data.dtype)
+    x = x.astype(jnp.float32)
+    if use_length:
+        if not maybe_length:
+            raise MXNetError("log_softmax: use_length=True requires the "
+                             "length input")
+        mask = _length_mask(data, maybe_length[0], axis)
+        neg = jnp.finfo(jnp.float32).min
+        out = jax.nn.log_softmax(jnp.where(mask, x, neg), axis=axis)
+        # masked positions output 0.0 like the reference kernel
+        # (softmax-inl.h SoftmaxWithLength) so mask*logp stays finite
+        out = jnp.where(mask, out, 0.0)
+    else:
+        out = jax.nn.log_softmax(x, axis=axis)
+    return out.astype(_np.dtype(dtype)) if dtype is not None \
+        else out.astype(data.dtype)
 
 
 @register("softmin")
-def _softmin(data, axis=-1, temperature=None, dtype=None, use_length=False):
-    import jax
-    return jax.nn.softmax(-data, axis=axis)
+def _softmin(data, *maybe_length, axis=-1, temperature=None, dtype=None,
+             use_length=False):
+    return _softmax(-data, *maybe_length, axis=axis,
+                    temperature=temperature, dtype=dtype,
+                    use_length=use_length)
 
 
 @register("SoftmaxActivation")

@@ -504,7 +504,11 @@ case("LeakyReLU",
           ref=lambda x, act_type, slope: np.where(x > 0, x, 0.1 * x)),
      Case((N(seed=123),), {"act_type": "elu", "slope": 1.0},
           ref=lambda x, act_type, slope: np.where(x > 0, x,
-                                                  np.expm1(x))))
+                                                  np.expm1(x))),
+     # prelu: learned per-channel (here scalar) negative slope input
+     Case((N((2, 4), seed=229), np.full((1,), 0.2, np.float32)),
+          {"act_type": "prelu"},
+          ref=lambda x, g, act_type: np.where(x > 0, x, 0.2 * x)))
 case("FullyConnected",
      Case((N((4, 6), seed=124), N((3, 6), seed=125), N((3,), seed=126)),
           {"num_hidden": 3},
@@ -601,13 +605,31 @@ def _pool_full_ref(x):
     return out
 case("softmax",
      Case((N((3, 5), seed=133),), {"axis": -1},
-          ref=lambda x, axis: _softmax_ref(x), dtype_sweep=True))
+          ref=lambda x, axis: _softmax_ref(x), dtype_sweep=True),
+     # masked softmax: positions >= length get exactly 0, a length-0 row
+     # is all zeros (ref: softmax-inl.h use_length path)
+     # float32 lengths so the numeric-gradient leg runs (grad_only skips
+     # perturbing the length input; _length_mask casts internally)
+     Case((N((3, 5), seed=230), np.array([3, 5, 0], np.float32)),
+          {"axis": -1, "use_length": True},
+          ref=lambda x, l, axis, use_length: _masked_softmax_ref(x, l),
+          grad_only=(0,)),
+     Case((N((3, 5), seed=231),), {"temperature": 2.0},
+          ref=lambda x, temperature: _softmax_ref(x / 2.0)))
 case("log_softmax",
      Case((N((3, 5), seed=134),), {"axis": -1},
           ref=lambda x, axis: np.log(_softmax_ref(x))))
 case("softmin",
      Case((N((3, 5), seed=135),), {"axis": -1},
           ref=lambda x, axis: _softmax_ref(-x)))
+
+
+def _masked_softmax_ref(x, lengths):
+    out = np.zeros_like(x)
+    for i, L in enumerate(lengths.astype(int)):
+        if L > 0:
+            out[i, :L] = _softmax_ref(x[i, :L].reshape(1, -1))
+    return out
 case("SoftmaxActivation",
      Case((N((3, 5), seed=136),), ref=lambda x: _softmax_ref(x)))
 
