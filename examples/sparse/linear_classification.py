@@ -1,6 +1,15 @@
 """BASELINE config #4: sparse linear classification with a distributed
 kvstore (ref: example/sparse/linear_classification/train.py — csr data,
 row_sparse weight, kvstore dist_sync push/pull + row_sparse_pull).
+
+The whole batch path is sparse end-to-end, like the reference:
+- each batch is a CSRNDArray (never densified),
+- only the batch's touched weight rows move: row_sparse_pull refreshes
+  them from the store (O(batch nnz) traffic),
+- the forward is the on-device csr×dense dot kernel (ops/sparse_ops.py),
+- the backward delivers a row_sparse gradient for only the touched rows,
+- the kvstore push ships those compact rows and the store applies a
+  lazy SGD step to them.
 """
 import argparse
 import os
@@ -19,19 +28,25 @@ from mxnet_tpu.ndarray import sparse
 
 
 def synthetic_libsvm(num_samples=4096, num_features=10000, nnz=32, seed=0):
-    """Sparse binary classification data (stand-in for kdda/avazu)."""
+    """Sparse binary classification data (stand-in for kdda/avazu),
+    already in csr coordinate form (vectorized, no per-row python work)."""
     rs = np.random.RandomState(seed)
     w_true = rs.randn(num_features).astype(np.float32) * \
         (rs.rand(num_features) < 0.05)
-    rows = []
-    labels = []
-    for _ in range(num_samples):
-        idx = rs.choice(num_features, nnz, replace=False)
-        val = rs.randn(nnz).astype(np.float32)
-        score = float(w_true[idx] @ val)
-        rows.append((idx, val))
-        labels.append(1.0 if score > 0 else 0.0)
-    return rows, np.array(labels, np.float32)
+    cols = np.stack([rs.choice(num_features, nnz, replace=False)
+                     for _ in range(num_samples)])       # (N, nnz)
+    vals = rs.randn(num_samples, nnz).astype(np.float32)
+    scores = (w_true[cols] * vals).sum(axis=1)
+    labels = (scores > 0).astype(np.float32)
+    return cols, vals, labels
+
+
+def batch_csr(cols, vals, num_features):
+    """Build one batch's CSRNDArray from its (rows, nnz) coordinate block."""
+    b, nnz = cols.shape
+    indptr = np.arange(b + 1, dtype=np.int32) * nnz
+    return sparse.CSRNDArray(vals.reshape(-1), cols.reshape(-1).astype(np.int32),
+                             indptr, (b, num_features))
 
 
 def main():
@@ -50,66 +65,51 @@ def main():
     if args.shard_table:
         os.environ["MXNET_KVSTORE_BIGARRAY_BOUND"] = "4096"
 
-    rows, labels = synthetic_libsvm(num_features=args.num_features)
+    cols, vals, labels = synthetic_libsvm(num_features=args.num_features)
     kv = kv_mod.create(args.kvstore)
     print(f"kvstore type={kv.type} rank={kv.rank}/{kv.num_workers}")
 
-    # weight lives in the store; workers row_sparse_pull only touched rows
+    # weight lives in the store; the updater is a lazy SGD on pushed rows
+    # (the kvstore_dist_server ApplyUpdates analog)
     weight = nd.zeros((args.num_features, 1))
     kv.init("weight", weight)
     if args.shard_table:
         shards = kv._store["weight"]._data.addressable_shards
         print(f"weight table sharded over {len(shards)} devices "
               f"({shards[0].data.shape[0]} rows each)")
-    # server-side additive update (the kvstore_dist_server ApplyUpdates
-    # analog): pushed values are deltas merged into the stored weight
-    kv.set_updater(lambda key, delta, stored:
-                   stored._rebind((stored + delta)._data))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=args.lr, lazy_update=True))
 
     n = len(labels)
-    steps = 0
+    batches = (n // args.batch_size) * args.batch_size
+    # local working copy of the table; per batch, only the touched rows are
+    # refreshed from the store via row_sparse_pull (O(batch nnz) traffic,
+    # like the reference's sparse weight pull)
+    w = nd.zeros((args.num_features, 1))
     for epoch in range(args.epochs):
         t0 = time.time()
         correct = 0
         for b0 in range(0, n - args.batch_size + 1, args.batch_size):
-            batch = rows[b0:b0 + args.batch_size]
-            y = labels[b0:b0 + args.batch_size]
-            # active rows of this batch
-            all_idx = np.unique(np.concatenate([idx for idx, _ in batch]))
-            rid = nd.array(all_idx, dtype="int64")
-            w_rows = nd.zeros((len(all_idx), 1))
-            kv.row_sparse_pull("weight", out=w_rows, row_ids=rid)
-            remap = {int(i): k for k, i in enumerate(all_idx)}
+            sl = slice(b0, b0 + args.batch_size)
+            X = batch_csr(cols[sl], vals[sl], args.num_features)
+            yn = nd.array(labels[sl])
 
-            # dense-per-batch computation over the active feature subspace
-            X = np.zeros((len(batch), len(all_idx)), np.float32)
-            for r, (idx, val) in enumerate(batch):
-                for i, v in zip(idx, val):
-                    X[r, remap[int(i)]] = v
-            Xn = nd.array(X)
-            yn = nd.array(y)
-            w_rows.attach_grad()
+            rid = nd.array(np.unique(cols[sl]), dtype="int64")
+            rows = sparse.zeros("row_sparse", (args.num_features, 1))
+            kv.row_sparse_pull("weight", out=rows, row_ids=rid)
+            w[rid] = rows.data
+            w.attach_grad(stype="row_sparse")
             with autograd.record():
-                logits = nd.op.dot(Xn, w_rows).reshape((-1,))
+                # on-device csr×dense dot — no densification anywhere
+                logits = sparse.dot(X, w).reshape((-1,))
                 loss = nd.op.relu(logits) - logits * yn + \
                     nd.op.Activation(-nd.op.abs(logits), act_type="softrelu")
                 loss = loss.mean()
             loss.backward()
-            # push row_sparse gradient for the touched rows only
-            grad_rows = w_rows.grad
-            scatter = sparse.RowSparseNDArray(
-                (grad_rows * args.lr * -1.0)._data, rid._data,
-                (args.num_features, 1))
-            # apply: pull full rows, add update, push back via updater
-            updated = w_rows - args.lr * grad_rows
-            dense_update = nd.zeros((args.num_features, 1))
-            dense_update[rid] = updated - w_rows
-            kv.push("weight", dense_update)
+            # w.grad is row_sparse: only this batch's features are present
+            kv.push("weight", w.grad)
             pred = (logits.asnumpy() > 0).astype(np.float32)
-            correct += int((pred == y).sum())
-            steps += 1
-        acc = correct / (steps and (n // args.batch_size) * args.batch_size)
-        print(f"epoch {epoch}: accuracy {correct / ((n // args.batch_size) * args.batch_size):.3f} "
+            correct += int((pred == labels[sl]).sum())
+        print(f"epoch {epoch}: accuracy {correct / batches:.3f} "
               f"({time.time() - t0:.1f}s)")
 
 

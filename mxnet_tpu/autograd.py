@@ -19,6 +19,8 @@ import threading
 import weakref
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+import numpy as _np
+
 from .base import MXNetError, check, hashable_params
 
 __all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
@@ -132,6 +134,60 @@ def predict_mode() -> _RecordingStateScope:
 # ---------------------------------------------------------------------------
 # tape structures
 # ---------------------------------------------------------------------------
+
+class _RspGrad:
+    """A row-sparse cotangent traveling down the tape: (data, indices) with
+    duplicate indices allowed; unique-row compaction happens once at grad
+    delivery. This is how Embedding(sparse_grad=True) and dot(csr, dense)
+    gradients avoid ever materializing a dense (vocab, dim) array
+    (ref: src/operator/tensor/indexing_op.cc SparseEmbeddingOpBackwardRspImpl)."""
+
+    __slots__ = ("data", "indices", "shape")
+
+    def __init__(self, data, indices, shape):
+        self.data = data          # (n, ...) jax array, n rows (dupes ok)
+        self.indices = indices    # (n,) int row ids
+        self.shape = tuple(shape)
+
+    def densify(self):
+        import jax.numpy as jnp
+        out = jnp.zeros(self.shape, self.data.dtype)
+        return out.at[jnp.asarray(self.indices)].add(self.data)
+
+    def compact(self):
+        """→ (data, unique_sorted_indices): duplicate rows segment-summed."""
+        import jax.numpy as jnp
+        import numpy as np
+        idx = np.asarray(self.indices)
+        uniq, inv = np.unique(idx, return_inverse=True)
+        data = jnp.zeros((len(uniq),) + self.shape[1:], self.data.dtype)
+        data = data.at[jnp.asarray(inv)].add(self.data)
+        return data, uniq.astype(np.int32)
+
+
+class _TapeIdentity:
+    """Backward hook that passes cotangents straight through — used to keep
+    the tape connected across container conversions (rsp.todense())."""
+
+    def _run_backward(self, cotangents):
+        return list(cotangents)
+
+
+def _grad_sum(a, b):
+    """Accumulate two cotangents, either of which may be row-sparse."""
+    a_rsp, b_rsp = isinstance(a, _RspGrad), isinstance(b, _RspGrad)
+    if a_rsp and b_rsp:
+        import jax.numpy as jnp
+        import numpy as np
+        return _RspGrad(jnp.concatenate([a.data, b.data]),
+                        np.concatenate([np.asarray(a.indices),
+                                        np.asarray(b.indices)]), a.shape)
+    if a_rsp:
+        return a.densify() + b
+    if b_rsp:
+        return a + b.densify()
+    return a + b
+
 
 class _VariableEntry:
     """Leaf marked by mark_variables/attach_grad (ref AGInfo for variables)."""
@@ -313,7 +369,7 @@ def _backward_impl(heads, head_grads, retain_graph, train_mode_flag,
         k = id(entry)
         entry_of[k] = entry
         if k in acc:
-            acc[k] = acc[k] + g
+            acc[k] = _grad_sum(acc[k], g)
         else:
             acc[k] = g
 
@@ -339,7 +395,8 @@ def _backward_impl(heads, head_grads, retain_graph, train_mode_flag,
                     break
             if found is not None:
                 has_any = True
-                cots.append(found)
+                cots.append(found.densify() if isinstance(found, _RspGrad)
+                            else found)
             else:
                 cots.append(jnp.zeros(shape, dtype))
         if not has_any:
@@ -349,6 +406,18 @@ def _backward_impl(heads, head_grads, retain_graph, train_mode_flag,
                              "retain_graph=True to backward() to reuse it")
         if node.custom is not None:
             in_grads = node.custom._run_backward(cots)
+        elif node.opdef.name == "Embedding" \
+                and dict(node.params_key).get("sparse_grad"):
+            # row_sparse weight gradient: ship (cot rows, ids) without the
+            # dense (vocab, dim) scatter (ref: indexing_op.cc
+            # SparseEmbeddingOpBackwardRspImpl)
+            data_in, weight_in = node.input_vals[0], node.input_vals[1]
+            cot = cots[0]
+            dim = weight_in.shape[-1]
+            in_grads = (None, _RspGrad(cot.reshape(-1, dim),
+                                       _np.asarray(data_in).reshape(-1)
+                                       .astype(_np.int64),
+                                       weight_in.shape))
         else:
             in_grads = _vjp_call(node, tuple(cots))
         for e, g in zip(node.input_entries, in_grads):
@@ -366,6 +435,12 @@ def _backward_impl(heads, head_grads, retain_graph, train_mode_flag,
             g = acc.get(id(e))
             if g is None:
                 g = jnp.zeros(v.shape, v._data.dtype)
+            elif isinstance(g, _RspGrad):
+                from .ndarray import sparse as _sp
+                data, uniq = g.compact()
+                results.append(_sp.RowSparseNDArray(data, uniq, g.shape,
+                                                    v._ctx))
+                continue
             results.append(NDArray(g, ctx=v._ctx))
     # accumulate into attached grad buffers
     for k, e in entry_of.items():
@@ -377,6 +452,22 @@ def _backward_impl(heads, head_grads, retain_graph, train_mode_flag,
             if gbuf is None or e.grad_req == "null":
                 continue
             g = acc[k]
+            from .ndarray.sparse import RowSparseNDArray
+            if isinstance(gbuf, RowSparseNDArray):
+                # row_sparse grad buffer (attach_grad(stype='row_sparse') /
+                # Parameter grad_stype): store only the touched rows
+                if not isinstance(g, _RspGrad):
+                    g = _RspGrad(g, _np.arange(g.shape[0], dtype=_np.int64),
+                                 g.shape)
+                if e.grad_req == "add" and gbuf._data.shape[0]:
+                    g = _grad_sum(_RspGrad(gbuf._data,
+                                           _np.asarray(gbuf._indices),
+                                           g.shape), g)
+                data, uniq = g.compact()
+                gbuf._update(data.astype(gbuf._data.dtype), uniq)
+                continue
+            if isinstance(g, _RspGrad):
+                g = g.densify()
             if e.grad_req == "add":
                 gbuf._rebind(gbuf._data + g)
             else:

@@ -112,6 +112,9 @@ env.declare("MXNET_SAFE_ACCUMULATION", bool, False,
 env.declare("MXNET_IS_RECOVERY", bool, False,
             "Set by the relauncher on restarted nodes; read by "
             "mx.fault.is_recovery().")
+env.declare("MXNET_STORAGE_FALLBACK_LOG_VERBOSE", bool, True,
+            "Warn when an op without a sparse kernel densifies its inputs "
+            "(storage fallback).")
 
 
 class classproperty:  # noqa: N801 - decorator style

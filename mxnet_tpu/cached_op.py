@@ -110,6 +110,16 @@ class CachedOp:
         if self._param_objs is None:
             self._param_objs = [p for _, p in
                                 sorted(self.block.collect_params().items())]
+            sparse = [p.name for p in self._param_objs
+                      if getattr(p, "grad_stype", "default") != "default"]
+            if sparse:
+                import warnings
+                warnings.warn(
+                    f"hybridize(): parameters {sparse} request row_sparse "
+                    "gradients, but the whole-graph XLA backward produces "
+                    "dense gradients (they are still delivered correctly "
+                    "to the row_sparse buffers). Run the block un-hybridized "
+                    "to keep gradients compact.", stacklevel=3)
         return self._param_objs
 
     def _make_pure_fn(self, training: bool, entry: _CacheEntry):

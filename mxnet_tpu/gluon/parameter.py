@@ -48,6 +48,7 @@ class Parameter:
         self.init = init
         self.allow_deferred_init = allow_deferred_init
         self.stype = stype
+        self.grad_stype = grad_stype
         self._data: Optional[_nd.NDArray] = None
         self._grad: Optional[_nd.NDArray] = None
         self._deferred_init = None  # (init, ctx)
@@ -102,8 +103,14 @@ class Parameter:
 
     def _attach(self) -> None:
         from .. import autograd
-        grad = _nd.zeros(self.shape, ctx=self._data.context,
-                         dtype=self._data._data.dtype)
+        if getattr(self, "grad_stype", "default") == "row_sparse":
+            from ..ndarray import sparse as _sp
+            grad = _sp.zeros("row_sparse", self.shape,
+                             ctx=self._data.context,
+                             dtype=self._data._data.dtype)
+        else:
+            grad = _nd.zeros(self.shape, ctx=self._data.context,
+                             dtype=self._data._data.dtype)
         self._grad = grad
         autograd.mark_variables([self._data], [grad], self._grad_req)
 
@@ -164,10 +171,17 @@ class Parameter:
                                else data._data)
 
     def zero_grad(self) -> None:
-        if self._grad is not None:
-            self._grad._rebind(_nd.zeros(self._grad.shape,
-                                         ctx=self._grad.context,
-                                         dtype=self._grad._data.dtype)._data)
+        if self._grad is None:
+            return
+        from ..ndarray import sparse as _sp
+        if isinstance(self._grad, _sp.RowSparseNDArray):
+            empty = _sp.zeros("row_sparse", self._grad.shape,
+                              dtype=self._grad._data.dtype)
+            self._grad._update(empty._data, empty._indices)
+            return
+        self._grad._rebind(_nd.zeros(self._grad.shape,
+                                     ctx=self._grad.context,
+                                     dtype=self._grad._data.dtype)._data)
 
     def reset_ctx(self, ctx) -> None:
         if self._data is not None:

@@ -92,10 +92,29 @@ class Trainer:
             self._init_kvstore()
         if self._kvstore is None:
             return
+        from ..ndarray import sparse as _sp
         for i, p in enumerate(self._params):
-            if p.grad_req != "null":
-                self._kvstore.push(i, p.grad())
-                self._kvstore.pull(i, p.grad())
+            if p.grad_req == "null":
+                continue
+            g = p.grad()
+            if isinstance(g, _sp.RowSparseNDArray):
+                # single-process grads are already complete (the tape saw
+                # every device's batch); a cross-worker reduce would need
+                # the dist store's sparse wire path — densify for it
+                # (ref: trainer.py requires update_on_kvstore for
+                # row_sparse params for the same reason)
+                if self._kvstore.num_workers > 1:
+                    # dense [grad | row-mask] reduce: the mask column makes
+                    # the rebuilt row set the union across workers, even
+                    # for rows whose reduced gradient is exactly zero
+                    packed = _sp.mask_pack(g)
+                    self._kvstore.push(i, packed)
+                    self._kvstore.pull(i, packed)
+                    reduced = _sp.mask_unpack(packed, g.shape)
+                    g._update(reduced._data, reduced._indices)
+                continue
+            self._kvstore.push(i, g)
+            self._kvstore.pull(i, g)
 
     def step(self, batch_size, ignore_stale_grad=False):
         """One optimization step: rescale by 1/batch_size, allreduce, update

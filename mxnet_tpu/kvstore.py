@@ -135,9 +135,54 @@ class KVStoreBase:
         total = jax.jit(lambda xs: sum(xs[1:], xs[0]))(arrays)
         return _nd.NDArray(total, ctx=vals[0]._ctx)
 
+    def _merge_rsp(self, vals):
+        """Sum row_sparse pushes: union of rows, duplicates segment-summed
+        (ref: CommCPU::ReduceRowSparse, src/kvstore/comm.h)."""
+        from .ndarray import sparse as _sp
+        import jax.numpy as jnp
+        import numpy as np
+        vals = [v if isinstance(v, _sp.RowSparseNDArray)
+                else _sp.cast_storage(v, "row_sparse") for v in vals]
+        if len(vals) == 1:
+            v = vals[0]
+            data, idx = v._data, np.asarray(v._indices)
+        else:
+            data = jnp.concatenate([v._data for v in vals])
+            idx = np.concatenate([np.asarray(v._indices) for v in vals])
+        return _sp.segment_sum_rows(data, idx, vals[0].shape, vals[0]._ctx)
+
+    def _reduce_global_rsp(self, merged, key=None):
+        """Cross-process reduce of a row_sparse push. Single process: the
+        local merge is already complete. Multi-worker: ride the dense
+        _reduce_global with a [grad | row-mask] packing so the reassembled
+        row set is the UNION across workers — rows whose reduced gradient
+        is exactly zero still get their lazy wd/momentum update
+        (ref: kvstore_dist_server.h DataHandleRowSparse aggregation)."""
+        if self.num_workers <= 1:
+            return merged
+        from .ndarray import sparse as _sp
+        packed = self._reduce_global(_sp.mask_pack(merged), key=key)
+        return _sp.mask_unpack(packed, merged.shape, merged._ctx)
+
     def push(self, key, value, priority: int = 0) -> None:
+        from .ndarray import sparse as _sp
         for k, vals in _group(key, value):
             check(k in self._store, f"kvstore key {k} not initialized")
+            if any(isinstance(v, _sp.BaseSparseNDArray) for v in vals):
+                # row_sparse push: no wire compression (the reference
+                # rejects compression for sparse grads too), updater gets
+                # the compact rows for a lazy update
+                merged = self._reduce_global_rsp(self._merge_rsp(vals),
+                                                 key=k)
+                store = self._store[k]
+                if self._updater is not None:
+                    self._updater(_key_int(k), merged, store)
+                else:
+                    import jax.numpy as jnp
+                    store._rebind(store._data.at[
+                        jnp.asarray(merged._indices)].set(
+                        merged._data.astype(store._data.dtype)))
+                continue
             merged = self._merge(vals)
             if self._compressor is not None and not self._wire_compresses():
                 # no wire hop here (local store): compress->decompress

@@ -265,9 +265,19 @@ class NDArray:
     # ------------------------------------------------------------------
     def attach_grad(self, grad_req: str = "write", stype=None) -> None:
         """Allocate gradient buffer and mark for autograd
-        (ref: Imperative::MarkVariables, src/imperative/imperative.cc:130)."""
+        (ref: Imperative::MarkVariables, src/imperative/imperative.cc:130).
+        ``stype='row_sparse'`` allocates a row-sparse gradient buffer so
+        sparse-grad ops (Embedding(sparse_grad=True), dot(csr, dense))
+        deliver compact (rows, ids) gradients for lazy optimizer updates."""
         from .. import autograd
-        grad = zeros(self.shape, ctx=self._ctx, dtype=self._data.dtype)
+        if stype in (None, "default"):
+            grad = zeros(self.shape, ctx=self._ctx, dtype=self._data.dtype)
+        else:
+            from . import sparse as _sp
+            check(stype == "row_sparse",
+                  f"attach_grad: unsupported grad stype {stype!r}")
+            grad = _sp.zeros("row_sparse", self.shape, ctx=self._ctx,
+                             dtype=self._data.dtype)
         self._grad = grad
         self._grad_req = grad_req
         autograd.mark_variables([self], [grad], grad_req)

@@ -44,6 +44,28 @@ def make_nd_function(name: str, opdef):
         out = kwargs.pop("out", None)
         kwargs.pop("name", None)
         ctx = kwargs.pop("ctx", None)
+        from . import sparse as _sp
+        if any(isinstance(a, _sp.BaseSparseNDArray) for a in args):
+            # storage-type dispatch axis (ref: FInferStorageType →
+            # FComputeEx | fallback, src/imperative/imperative.cc stype
+            # inference + src/common/exec_utils.h densify fallback)
+            stypes = tuple(getattr(a, "stype", "default") for a in args)
+            impl = _reg.stype_dispatch(name, stypes)
+            if impl is not None:
+                result = impl(*args, **kwargs)
+                if out is not None:
+                    if isinstance(result, _sp.RowSparseNDArray):
+                        if isinstance(out, _sp.RowSparseNDArray):
+                            out._update(result._data, result._indices)
+                        else:
+                            out._rebind(result.todense()._data)
+                    else:
+                        out._rebind(result._data)
+                    return out
+                return result
+            _reg.storage_fallback_warn(name, stypes)
+            args = tuple(a.todense() if isinstance(a, _sp.BaseSparseNDArray)
+                         else a for a in args)
         inputs = []
         for a in args:
             if isinstance(a, _nd.NDArray):

@@ -23,7 +23,8 @@ from ..context import Context, current_context
 from . import ndarray as _nd
 
 __all__ = ["BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
-           "row_sparse_array", "csr_matrix", "zeros", "array"]
+           "row_sparse_array", "csr_matrix", "zeros", "array", "dot", "add",
+           "cast_storage", "sparse_retain", "getnnz"]
 
 
 def _jnp():
@@ -93,7 +94,14 @@ class RowSparseNDArray(BaseSparseNDArray):
         jnp = _jnp()
         out = jnp.zeros(self._shape, self._data.dtype)
         out = out.at[self._indices].set(self._data)
-        return _nd.from_jax(out, ctx=self._ctx)
+        result = _nd.from_jax(out, ctx=self._ctx)
+        from .. import autograd
+        if autograd.is_recording() and \
+                getattr(self, "_tape_entry", None) is not None:
+            # keep the tape connected: d(dense)/d(rsp) is identity
+            autograd._record_custom(autograd._TapeIdentity(), [self],
+                                    [result])
+        return result
 
     tostype_map = {"default": "todense"}
 
@@ -125,17 +133,34 @@ class RowSparseNDArray(BaseSparseNDArray):
 
 
 class CSRNDArray(BaseSparseNDArray):
-    """(ref: python/mxnet/ndarray/sparse.py CSRNDArray)"""
+    """(ref: python/mxnet/ndarray/sparse.py CSRNDArray)
+
+    The coordinate arrays are kept host-side as well (``indices_np`` /
+    ``indptr_np``): sparse kernels need them concretely (row-id expansion,
+    unique-column sets) and re-fetching them from the device every batch
+    would add blocking syncs to the training hot path."""
 
     stype = "csr"
 
     def __init__(self, data, indices, indptr, shape, ctx=None):
         super().__init__(shape, ctx)
         jnp = _jnp()
-        conv = lambda a: a._data if isinstance(a, _nd.NDArray) else jnp.asarray(a)
-        self._data = conv(data)
-        self._indices = jnp.asarray(conv(indices), _np.int32)
-        self._indptr = jnp.asarray(conv(indptr), _np.int32)
+        conv = lambda a: a._data if isinstance(a, _nd.NDArray) else a
+        self._data = conv(data) if isinstance(data, _nd.NDArray) \
+            else jnp.asarray(data)
+        self._indices_np = _np.asarray(conv(indices), _np.int32)
+        self._indptr_np = _np.asarray(conv(indptr), _np.int32)
+        self._indices = jnp.asarray(self._indices_np)
+        self._indptr = jnp.asarray(self._indptr_np)
+        self._row_ids_np = None  # lazily expanded + cached
+
+    def _row_ids(self) -> _np.ndarray:
+        """nnz-length row-id expansion of indptr, cached on first use."""
+        if self._row_ids_np is None:
+            self._row_ids_np = _np.repeat(
+                _np.arange(len(self._indptr_np) - 1, dtype=_np.int32),
+                _np.diff(self._indptr_np))
+        return self._row_ids_np
 
     def _dtype(self):
         return self._data.dtype
@@ -263,6 +288,59 @@ def sparse_retain(data, indices):
     check(isinstance(data, RowSparseNDArray),
           "sparse_retain requires a row_sparse input")
     return data.retain(indices)
+
+
+def segment_sum_rows(data, indices, shape, ctx=None):
+    """Combine (data, row-indices-with-duplicates) into a compact
+    RowSparseNDArray: unique rows, duplicates summed. The single shared
+    row-merge used by grad compaction, kvstore reduce, and rsp+rsp add
+    (ref: the reduce half of CommCPU::ReduceRowSparse, src/kvstore/comm.h)."""
+    jnp = _jnp()
+    idx = _np.asarray(indices)
+    uniq, inv = _np.unique(idx, return_inverse=True)
+    out = jnp.zeros((len(uniq),) + tuple(shape[1:]), data.dtype)
+    out = out.at[jnp.asarray(inv)].add(data)
+    return RowSparseNDArray(out, uniq.astype(_np.int32), shape, ctx)
+
+
+def mask_pack(rsp) -> _nd.NDArray:
+    """Pack a row_sparse value into one dense array [flat grad | row mask]
+    for a dense cross-process allreduce. The mask column survives the
+    reduce, so rows whose reduced gradient is exactly zero are still part
+    of the reassembled row set (reference lazy-update semantics apply wd /
+    momentum to every pushed row, zero-valued or not)."""
+    jnp = _jnp()
+    dense = rsp.todense()._data
+    flat = dense.reshape(dense.shape[0], -1)
+    mask = jnp.zeros((flat.shape[0], 1), flat.dtype)
+    mask = mask.at[jnp.asarray(rsp._indices)].set(1.0)
+    return _nd.from_jax(jnp.concatenate([flat, mask], axis=1), ctx=rsp._ctx)
+
+
+def mask_unpack(packed: _nd.NDArray, shape, ctx=None) -> "RowSparseNDArray":
+    """Inverse of mask_pack after a reduce: rows = mask > 0 (the union of
+    every worker's row set)."""
+    jnp = _jnp()
+    arr = packed._data
+    rows = _np.where(_np.asarray(arr[:, -1]) > 0)[0].astype(_np.int32)
+    data = arr[jnp.asarray(rows), :-1].reshape((len(rows),) + tuple(shape[1:]))
+    return RowSparseNDArray(data, rows, shape, ctx)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse-aware dot (ref: mx.nd.sparse.dot -> src/operator/tensor/
+    dot-inl.h). csr×dense runs the on-device scatter-add kernel;
+    csrᵀ×dense returns row_sparse. Dense×dense falls through to nd.dot."""
+    from . import register as _register
+    fn = _register.registry_namespace()["dot"]
+    return fn(lhs, rhs, transpose_a=transpose_a, transpose_b=transpose_b)
+
+
+def add(lhs, rhs):
+    """row_sparse + row_sparse → row_sparse (union of rows)."""
+    from . import register as _register
+    fn = _register.registry_namespace()["elemwise_add"]
+    return fn(lhs, rhs)
 
 
 def getnnz(data, axis=None):

@@ -34,9 +34,16 @@ import numpy as _np
 from ..base import MXNetError, env, hashable_params, coerce_param
 
 __all__ = ["OpDef", "register", "get_op", "list_ops", "invoke_jax",
-           "eval_shape", "alias"]
+           "eval_shape", "alias", "register_sparse", "stype_dispatch",
+           "storage_fallback_warn"]
 
 _OPS: Dict[str, "OpDef"] = {}
+
+# storage-type dispatch table (the FComputeEx + FInferStorageType analog,
+# ref: include/mxnet/op_attr_types.h:122,282): (op name, input stypes) →
+# kernel over sparse/dense NDArray objects. "*" matches any stype tuple.
+_SPARSE_IMPLS: Dict[Tuple[str, Tuple[str, ...]], Callable] = {}
+_FALLBACK_WARNED: set = set()
 
 
 class OpDef:
@@ -131,6 +138,47 @@ def register(name: str, aliases: Sequence[str] = (), **kw) -> Callable:
 
 def alias(name: str, target: str) -> None:
     _OPS[name] = _OPS[target]
+
+
+def register_sparse(name: str, stypes: Sequence[str]) -> Callable:
+    """Register an FComputeEx kernel for ``name`` with the given input
+    storage-type signature, e.g. ``("csr", "default")``. The kernel receives
+    the frontend NDArray/sparse objects directly (it owns device dispatch
+    and tape recording) and returns NDArray or sparse NDArray outputs
+    (ref: op_attr_types.h:282 FComputeEx; DispatchMode::kFComputeEx)."""
+
+    def deco(fn: Callable) -> Callable:
+        _SPARSE_IMPLS[(name, tuple(stypes))] = fn
+        return fn
+
+    return deco
+
+
+def stype_dispatch(name: str, stypes: Sequence[str]) -> Optional[Callable]:
+    """FInferStorageType analog: pick the FComputeEx kernel for this input
+    stype combination, or None → dense fallback (DispatchMode::kFComputeFallback)."""
+    impl = _SPARSE_IMPLS.get((name, tuple(stypes)))
+    if impl is None:
+        impl = _SPARSE_IMPLS.get((name, ("*",)))
+    return impl
+
+
+def storage_fallback_warn(name: str, stypes: Sequence[str]) -> None:
+    """Log the sparse→dense fallback once per (op, stypes), like the
+    reference's LogStorageFallback (src/common/utils.h); silenced by
+    MXNET_STORAGE_FALLBACK_LOG_VERBOSE=0 (ref: docs/faq/env_var.md)."""
+    key = (name, tuple(stypes))
+    if key in _FALLBACK_WARNED:
+        return
+    _FALLBACK_WARNED.add(key)
+    if not env.get("MXNET_STORAGE_FALLBACK_LOG_VERBOSE"):
+        return
+    import warnings
+    warnings.warn(
+        f"operator {name} has no sparse kernel for input storage types "
+        f"{tuple(stypes)}: falling back to dense compute (inputs densified). "
+        "Set MXNET_STORAGE_FALLBACK_LOG_VERBOSE=0 to silence.",
+        stacklevel=3)
 
 
 def get_op(name: str) -> OpDef:

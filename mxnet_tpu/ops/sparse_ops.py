@@ -1,0 +1,317 @@
+"""Storage-type-aware operator kernels (the FComputeEx layer).
+
+Reference: include/mxnet/op_attr_types.h:122,282 — ops carry an
+``FInferStorageType`` attribute plus an ``FComputeEx`` kernel operating on
+NDArrays with non-default storage; src/operator/tensor/dot-inl.h implements
+csr×dense and csrᵀ×dense→row_sparse; src/operator/tensor/indexing_op.cc
+implements the row_sparse Embedding gradient.
+
+TPU-native design
+-----------------
+XLA has no sparse tensor type, so every sparse kernel here is a *static-shape
+gather/scatter program* over the compact (data, indices[, indptr]) arrays:
+
+- ``dot(csr, dense)``: one gather of the rhs rows named by ``indices``, a
+  broadcast multiply with ``data``, and a segment-sum scatter-add keyed by the
+  expanded row ids. All three map directly onto TPU-friendly XLA HLO
+  (Gather/Scatter with add-combiner); no densification of the lhs ever
+  happens, so FLOPs and HBM traffic scale with nnz, not rows×cols.
+- nnz is padded to power-of-two buckets so the jit cache sees a bounded set
+  of shapes across batches with varying sparsity (padding rows multiply by
+  zero data and scatter to row 0 — numerically inert).
+- ``dot(csr.T, dense)`` returns **row_sparse** (ref: dot-inl.h forward_stype
+  dispatch): the scatter target is the compact set of distinct columns, so
+  output memory scales with the number of touched rows.
+- The row_sparse Embedding/dot gradient is *never materialized dense*: the
+  tape carries a (data, indices) cotangent (`autograd._RspGrad`) with
+  duplicates allowed; unique-row compaction happens once at grad delivery.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import numpy as _np
+
+from ..base import MXNetError, check
+from .registry import register, register_sparse
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _jax():
+    import jax
+    return jax
+
+
+# ---------------------------------------------------------------------------
+# nnz bucketing: bound the number of compiled kernel variants
+# ---------------------------------------------------------------------------
+
+def _nnz_bucket(nnz: int) -> int:
+    """Round up to the next power of two (min 8) so batches with varying
+    sparsity reuse compiled programs instead of recompiling per nnz."""
+    b = 8
+    while b < nnz:
+        b <<= 1
+    return b
+
+
+def _padded_coords(csr) -> Tuple:
+    """(data, cols, row_ids) padded to an nnz bucket, as jax arrays.
+
+    Padding entries carry data=0 and scatter to row/col 0, contributing
+    nothing to any product or sum. Coordinates come from the csr's cached
+    host arrays — no device→host sync in the hot path.
+    """
+    jnp = _jnp()
+    data = csr._data
+    cols = csr._indices_np
+    row_ids = csr._row_ids()
+    nnz = int(data.shape[0])
+    pad = _nnz_bucket(nnz) - nnz
+    if pad:
+        data = jnp.concatenate([data, jnp.zeros((pad,), data.dtype)])
+        cols = _np.concatenate([cols, _np.zeros((pad,), _np.int32)])
+        row_ids = _np.concatenate([row_ids, _np.zeros((pad,), _np.int32)])
+    return data, jnp.asarray(cols), jnp.asarray(row_ids)
+
+
+# ---------------------------------------------------------------------------
+# compiled kernels (cached per shape by jax.jit)
+# ---------------------------------------------------------------------------
+
+def _csr_dot_kernel(n_rows: int):
+    """out[r, :] = Σ_nnz∈row(r) data · rhs[col]  — csr × dense."""
+    jax = _jax()
+
+    @partial(jax.jit, static_argnums=())
+    def kern(data, cols, row_ids, rhs):
+        jnp = _jnp()
+        contrib = data[:, None] * rhs[cols]
+        out = jnp.zeros((n_rows, rhs.shape[1]), contrib.dtype)
+        return out.at[row_ids].add(contrib)
+
+    return kern
+
+
+_CSR_DOT_CACHE = {}
+
+
+def _csr_dot(csr, rhs_2d):
+    """csr (M,K) × dense (K,N) → dense (M,N), fully on device."""
+    kern = _CSR_DOT_CACHE.get(csr.shape[0])
+    if kern is None:
+        kern = _CSR_DOT_CACHE[csr.shape[0]] = _csr_dot_kernel(csr.shape[0])
+    data, cols, row_ids = _padded_coords(csr)
+    return kern(data, cols, row_ids, rhs_2d)
+
+
+def _csr_t_dot_scatter(data, cols, row_ids, rhs, inv, n_uniq):
+    """Compact csrᵀ × dense: scatter contributions straight into the
+    unique-column slots (`inv` maps each nnz to its slot), so memory is
+    O(touched_rows × N) — never O(K × N)."""
+    jnp = _jnp()
+    contrib = data[:, None] * rhs[row_ids]
+    out = jnp.zeros((n_uniq, rhs.shape[1]), contrib.dtype)
+    return out.at[inv].add(contrib)
+
+
+_CSR_T_DOT_JIT = None
+
+
+def _csr_t_dot(csr, rhs_2d):
+    """csrᵀ (K,M) × dense (M,N) → row_sparse (K,N)."""
+    global _CSR_T_DOT_JIT
+    if _CSR_T_DOT_JIT is None:
+        _CSR_T_DOT_JIT = _jax().jit(_csr_t_dot_scatter, static_argnums=(5,))
+    data, cols, row_ids = _padded_coords(csr)
+    # unique touched columns from the cached host indices (real nnz only);
+    # padding entries carry zero data and are routed to slot 0,
+    # contributing nothing
+    nnz = int(csr._data.shape[0])
+    uniq, inv = _np.unique(csr._indices_np, return_inverse=True)
+    inv = _np.concatenate([inv, _np.zeros((int(cols.shape[0]) - nnz,),
+                                          inv.dtype)])
+    # bucket the slot count too, so varying touched-column counts across
+    # batches reuse one compiled scatter (trailing slots stay zero)
+    n_slots = _nnz_bucket(len(uniq))
+    out_rows = _CSR_T_DOT_JIT(data, cols, row_ids,
+                              rhs_2d, _jnp().asarray(inv, _np.int32),
+                              n_slots)[:len(uniq)]
+    return out_rows, uniq.astype(_np.int32)
+
+
+# ---------------------------------------------------------------------------
+# FComputeEx registrations (consumed by ndarray.register dispatch)
+# ---------------------------------------------------------------------------
+
+class _CsrDotBackward:
+    """Tape hook for dot(csr, dense): grad wrt the dense rhs is row-sparse
+    in the csr's column space (ref: dot-inl.h backward stype =
+    csrᵀ×grad→row_sparse). The cotangent is shipped as a duplicate-tolerant
+    (data, indices) pair over the REAL nnz (no padding — these are eager
+    ops, and padded column ids would leak a spurious row 0 into the lazy
+    optimizer update); compaction happens at delivery."""
+
+    def __init__(self, csr, rhs_was_1d):
+        self._csr = csr
+        self._rhs_was_1d = rhs_was_1d
+
+    def _run_backward(self, cotangents):
+        from .. import autograd
+        cot = cotangents[0]
+        data, cols = self._csr._data, self._csr._indices_np
+        row_ids = _jnp().asarray(self._csr._row_ids())
+        K = self._csr.shape[1]
+        if self._rhs_was_1d:
+            # y = csr @ w with w (K,): grad rows are scalars
+            contrib = data * cot[row_ids]
+            return [autograd._RspGrad(contrib, cols, (K,))]
+        contrib = data[:, None] * cot[row_ids]
+        return [autograd._RspGrad(contrib, cols,
+                                  (K,) + tuple(cot.shape[1:]))]
+
+
+class _CsrTDotBackward:
+    """Tape hook for dot(csr, dense, transpose_a=True): y = csrᵀ @ rhs, so
+    grad wrt rhs = csr @ cot — a dense (M, N) result via the forward
+    csr-dot kernel (ref: dot-inl.h backward of the transpose case)."""
+
+    def __init__(self, csr, rhs_was_1d):
+        self._csr = csr
+        self._rhs_was_1d = rhs_was_1d
+
+    def _run_backward(self, cotangents):
+        cot = cotangents[0]
+        if self._rhs_was_1d:
+            out = _csr_dot(self._csr, cot[:, None])[:, 0]
+        else:
+            out = _csr_dot(self._csr, cot)
+        return [out]
+
+
+@register_sparse("dot", ("csr", "default"))
+def _dot_csr_dense(lhs, rhs, transpose_a=False, transpose_b=False, **_ignored):
+    """dot with a csr lhs (ref: src/operator/tensor/dot-inl.h DotCsrDnsDns /
+    DotCsrDnsRspImpl)."""
+    from ..ndarray import ndarray as _nd
+    from ..ndarray import sparse as _sp
+    from .. import autograd
+    check(not transpose_b, "dot(csr, dense): transpose_b is not supported "
+                           "(matches reference dot-inl.h)")
+    rhs_data = rhs._data
+    squeeze = rhs_data.ndim == 1
+    if squeeze:
+        rhs_data = rhs_data[:, None]
+    recording = autograd.is_recording() and \
+        getattr(rhs, "_tape_entry", None) is not None
+    if transpose_a:
+        out_rows, uniq = _csr_t_dot(lhs, rhs_data)
+        if squeeze:
+            out_rows = out_rows[:, 0]
+            shape = (lhs.shape[1],)
+        else:
+            shape = (lhs.shape[1], rhs_data.shape[1])
+        result = _sp.RowSparseNDArray(out_rows, uniq, shape, lhs._ctx)
+        if recording:
+            autograd._record_custom(_CsrTDotBackward(lhs, squeeze), [rhs],
+                                    [result])
+        return result
+    out = _csr_dot(lhs, rhs_data)
+    if squeeze:
+        out = out[:, 0]
+    result = _nd.NDArray(out, ctx=rhs.context)
+    if recording:
+        autograd._record_custom(_CsrDotBackward(lhs, squeeze), [rhs],
+                                [result])
+    return result
+
+
+@register_sparse("elemwise_add", ("row_sparse", "row_sparse"))
+def _add_rsp_rsp(lhs, rhs, **_ignored):
+    """row_sparse + row_sparse → row_sparse (union of rows;
+    ref: elemwise_binary_op_basic.cc sparse dispatch)."""
+    from ..ndarray import sparse as _sp
+    jnp = _jnp()
+    idx = _np.concatenate([_np.asarray(lhs._indices),
+                           _np.asarray(rhs._indices)])
+    data = jnp.concatenate([lhs._data, rhs._data.astype(lhs._data.dtype)])
+    return _sp.segment_sum_rows(data, idx, lhs.shape, lhs._ctx)
+
+
+@register_sparse("cast_storage", ("*",))
+def _cast_storage_any(data, stype="default", **_ignored):
+    from ..ndarray import sparse as _sp
+    return _sp.cast_storage(data, stype)
+
+
+@register_sparse("sum", ("csr",))
+def _sum_csr(data, axis=None, keepdims=False, **_ignored):
+    """Σ over a csr without densifying (ref: square_sum/sum csr kernels)."""
+    from ..ndarray import ndarray as _nd
+    jnp = _jnp()
+    vals, cols, row_ids = _padded_coords(data)
+    if isinstance(axis, tuple):
+        norm = {a % 2 for a in axis}
+        axis = None if norm == {0, 1} else norm.pop()
+    if axis is None:
+        out = jnp.sum(vals)
+        return _nd.NDArray(out if not keepdims else out.reshape(1, 1))
+    if axis in (0, -2):
+        out = jnp.zeros((data.shape[1],), vals.dtype).at[cols].add(vals)
+        keep_shape = (1, data.shape[1])
+    else:
+        check(axis in (1, -1), "sum(csr): axis must be None, 0 or 1")
+        out = jnp.zeros((data.shape[0],), vals.dtype).at[row_ids].add(vals)
+        keep_shape = (data.shape[0], 1)
+    return _nd.NDArray(out.reshape(keep_shape) if keepdims else out)
+
+
+# ---------------------------------------------------------------------------
+# lazy (row-sliced) optimizer update kernels
+# (ref: src/operator/optimizer_op.cc row_sparse sgd/adam variants — the
+#  consumers of sparse_grad; only rows present in the gradient are touched)
+# ---------------------------------------------------------------------------
+
+def _row_grad(gdata, rows, rescale_grad, clip_gradient, wd):
+    jnp = _jnp()
+    g = gdata * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g + wd * rows
+
+
+@register("_sparse_sgd_update")
+def _sparse_sgd_update(weight, gdata, gidx, lr=0.01, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0):
+    rows = weight[gidx]
+    g = _row_grad(gdata, rows, rescale_grad, clip_gradient, wd)
+    return weight.at[gidx].set(rows - lr * g)
+
+
+@register("_sparse_sgd_mom_update", num_outputs=2)
+def _sparse_sgd_mom_update(weight, gdata, gidx, mom, lr=0.01, momentum=0.0,
+                           wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    rows = weight[gidx]
+    g = _row_grad(gdata, rows, rescale_grad, clip_gradient, wd)
+    new_mom_rows = momentum * mom[gidx] - lr * g
+    return (weight.at[gidx].set(rows + new_mom_rows),
+            mom.at[gidx].set(new_mom_rows))
+
+
+@register("_sparse_adam_update", num_outputs=3)
+def _sparse_adam_update(weight, gdata, gidx, mean, var, lr=0.01, beta1=0.9,
+                        beta2=0.999, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                        clip_gradient=-1.0):
+    jnp = _jnp()
+    rows = weight[gidx]
+    g = _row_grad(gdata, rows, rescale_grad, clip_gradient, wd)
+    m_rows = beta1 * mean[gidx] + (1 - beta1) * g
+    v_rows = beta2 * var[gidx] + (1 - beta2) * jnp.square(g)
+    w_rows = rows - lr * m_rows / (jnp.sqrt(v_rows) + epsilon)
+    return (weight.at[gidx].set(w_rows), mean.at[gidx].set(m_rows),
+            var.at[gidx].set(v_rows))

@@ -150,6 +150,32 @@ def _clip(cg):
     return -1.0 if cg is None else cg
 
 
+def _as_rsp(grad):
+    """Return the RowSparseNDArray if this grad is row-sparse, else None."""
+    from ..ndarray.sparse import RowSparseNDArray
+    return grad if isinstance(grad, RowSparseNDArray) else None
+
+
+def _rsp_parts(rsp):
+    """(grad rows, row ids) padded to a power-of-two row count so the
+    compiled lazy-update kernel is reused across batches with varying
+    numbers of touched rows. Padding repeats entry 0 verbatim: every
+    duplicate computes the identical row value, and the kernels write with
+    ``.at[].set`` (idempotent), so the padding is numerically inert."""
+    import jax.numpy as jnp
+    from ..ndarray import ndarray as _ndd
+    from ..ops.sparse_ops import _nnz_bucket
+    data, idx = rsp._data, jnp.asarray(rsp._indices)
+    n = int(data.shape[0])
+    if n:
+        b = _nnz_bucket(n)
+        if b != n:
+            data = jnp.concatenate([data, jnp.broadcast_to(
+                data[0], (b - n,) + data.shape[1:])])
+            idx = jnp.concatenate([idx, jnp.broadcast_to(idx[0], (b - n,))])
+    return (_ndd.from_jax(data), _ndd.from_jax(idx))
+
+
 @register
 class SGD(Optimizer):
     """SGD w/ momentum + multi-precision (ref: optimizer.py:511)."""
@@ -170,6 +196,26 @@ class SGD(Optimizer):
         lr, wd = self._get_lr(index), self._get_wd(index)
         kw = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
                   clip_gradient=_clip(self.clip_gradient))
+        rsp = _as_rsp(grad)
+        if rsp is not None:
+            # row-sliced lazy update: only rows present in the gradient are
+            # touched (ref: optimizer_op.cc SGDUpdateRspImpl; std_update when
+            # lazy_update=False densifies first)
+            if not self.lazy_update:
+                grad = rsp.todense()
+            else:
+                gdata, gidx = _rsp_parts(rsp)
+                if state is None:
+                    new_w = _invoke("_sparse_sgd_update",
+                                    (weight, gdata, gidx), kw)
+                    weight._rebind(new_w._data)
+                else:
+                    kw["momentum"] = self.momentum
+                    new_w, new_m = _invoke("_sparse_sgd_mom_update",
+                                           (weight, gdata, gidx, state), kw)
+                    weight._rebind(new_w._data)
+                    state._rebind(new_m._data)
+                return
         if state is None:
             new_w = _invoke("sgd_update", (weight, grad), kw)
             weight._rebind(new_w._data)
@@ -269,6 +315,7 @@ class Adam(Optimizer):
         self.beta1 = beta1
         self.beta2 = beta2
         self.epsilon = epsilon
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
         return (_nd.zeros(weight.shape, ctx=weight.context,
@@ -282,11 +329,26 @@ class Adam(Optimizer):
         t = self._index_update_count[index]
         lr_t = lr * math.sqrt(1 - self.beta2 ** t) / (1 - self.beta1 ** t)
         mean, var = state
-        new_w, new_m, new_v = _invoke(
-            "adam_update", (weight, grad, mean, var),
-            dict(lr=lr_t, beta1=self.beta1, beta2=self.beta2,
-                 epsilon=self.epsilon, wd=wd, rescale_grad=self.rescale_grad,
-                 clip_gradient=_clip(self.clip_gradient)))
+        kw = dict(lr=lr_t, beta1=self.beta1, beta2=self.beta2,
+                  epsilon=self.epsilon, wd=wd, rescale_grad=self.rescale_grad,
+                  clip_gradient=_clip(self.clip_gradient))
+        rsp = _as_rsp(grad)
+        if rsp is not None:
+            # lazy adam: mean/var/weight rows sliced to the gradient's rows
+            # (ref: optimizer_op.cc AdamUpdateRspImpl, lazy_update branch)
+            if not self.lazy_update:
+                grad = rsp.todense()
+            else:
+                gdata, gidx = _rsp_parts(rsp)
+                new_w, new_m, new_v = _invoke(
+                    "_sparse_adam_update",
+                    (weight, gdata, gidx, mean, var), kw)
+                weight._rebind(new_w._data)
+                mean._rebind(new_m._data)
+                var._rebind(new_v._data)
+                return
+        new_w, new_m, new_v = _invoke("adam_update",
+                                      (weight, grad, mean, var), kw)
         weight._rebind(new_w._data)
         mean._rebind(new_m._data)
         var._rebind(new_v._data)
