@@ -61,7 +61,10 @@ def _as_dtype(dtype):
 
 class NDArray:
     __slots__ = ("_data", "_ctx", "_version", "_grad", "_grad_req",
-                 "_tape_entry", "_stype", "_dlpack_staged", "__weakref__")
+                 "_tape_entry", "_stype", "_dlpack_staged", "__weakref__",
+                 # C API keep-alive anchors (MXNDArrayGetData host snapshot,
+                 # SaveRawBytes buffer, shared-mem segment)
+                 "_c_host_copy", "_c_raw_bytes", "_c_shm")
 
     __array_priority__ = 100.0
 

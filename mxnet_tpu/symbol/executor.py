@@ -462,7 +462,18 @@ class Executor:
         outs, new_aux = jitted(args, aux, key)
         self._outputs = [_nd.NDArray(o, ctx=self._ctx) for o in outs]
         self._pending = None
+        self._fire_monitor()
         return self._outputs
+
+    def _fire_monitor(self):
+        """Per-output monitor callback after a forward (ref:
+        MXExecutorSetMonitorCallback -> GraphExecutor monitor; per-op
+        granularity collapses to per-output under whole-graph fusion,
+        with internals available via Monitor.toc's pull path)."""
+        if self._monitor_callback is None or self._outputs is None:
+            return
+        for name, out in zip(self._out_names, self._outputs):
+            self._monitor_callback(name, out)
 
     @property
     def outputs(self) -> List[_nd.NDArray]:
@@ -474,6 +485,7 @@ class Executor:
             outs, new_aux = jitted(args, aux, key)
             self._write_aux(new_aux)
             self._outputs = [_nd.NDArray(o, ctx=self._ctx) for o in outs]
+            self._fire_monitor()
         if self._outputs is None:
             raise MXNetError("run forward() first")
         return self._outputs

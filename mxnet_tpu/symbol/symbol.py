@@ -657,8 +657,27 @@ def create(op_name: str, input_syms: Sequence[Symbol], params: Dict[str, Any],
         check(len(s._outputs) == 1,
               f"{op_name}: cannot use a grouped symbol as input")
         inputs.append(s._outputs[0])
-    # auto-create aux-state variables (e.g. BatchNorm moving stats) the way
-    # the reference's ListAuxiliaryStates does
+    # auto-create variables for MISSING op inputs, named {node}_{input}
+    # (ref: nnvm Symbol::Compose — `sym.FullyConnected(data, num_hidden=8)`
+    # yields fc_weight/fc_bias arguments exactly like the reference)
+    from ..ops.opdoc import _split_params
+    req_inputs, fn_params, variadic = _split_params(opdef)
+    aux_set = set(opdef.aux_inputs)
+    for idx in range(len(inputs), len(req_inputs)):
+        v = _Node(None, f"{name}_{req_inputs[idx]}", {}, [])
+        v.extra["auto"] = True  # placeholder: MXSymbolCompose may replace
+        if idx in aux_set:
+            v.extra["aux"] = True
+        inputs.append((v, 0))
+    # the bias slot of FC/Conv-style ops is variadic, gated on no_bias
+    if variadic and len(input_syms) <= len(req_inputs) and \
+            any(n == "no_bias" for n, _ in fn_params) and \
+            not coerce_param(params.get("no_bias", False)):
+        bias = _Node(None, f"{name}_bias", {}, [])
+        bias.extra["auto"] = True
+        inputs.append((bias, 0))
+    # auto-create any aux-state variables beyond the fn's positional list
+    # (ref: OperatorProperty::ListAuxiliaryStates)
     n_declared = len(inputs)
     for aux_i in opdef.aux_inputs:
         if aux_i >= n_declared:

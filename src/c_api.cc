@@ -120,7 +120,8 @@ PyObject *str_list(const char **data, mx_uint n) {
 PyObject *handle_list(void *const *handles, mx_uint n) {
   PyObject *lst = PyList_New(n);
   for (mx_uint i = 0; i < n; ++i) {
-    PyObject *o = static_cast<PyObject *>(handles[i]);
+    PyObject *o = handles[i] ? static_cast<PyObject *>(handles[i])
+                             : Py_None;
     Py_INCREF(o);
     PyList_SetItem(lst, i, o);
   }
@@ -859,5 +860,1874 @@ MXTPU_API int MXKVStoreGetGroupSize(KVStoreHandle kv, int *size) {
   if (!r) { set_error(py_error()); return -1; }
   *size = (int)PyLong_AsLong(r);
   Py_DECREF(r);
+  return 0;
+}
+
+// ===========================================================================
+// Round-3 C API expansion: symbol depth, DataIter, RecordIO, profiler,
+// CachedOp, sparse NDArray, SimpleBind/Reshape/monitor, kvstore
+// updater/server surface, legacy Function API, quantization, RTC.
+// Signatures follow include/mxnet/c_api.h so existing consumers relink.
+// ===========================================================================
+
+namespace {
+
+thread_local std::string g_str_single;
+thread_local std::vector<uint64_t> g_u64_store;
+thread_local std::vector<int> g_int_store;
+
+// terse return-marshalers: every bridge call funnels through one of these
+int rv(PyObject *r) {            // void return
+  if (!r) { set_error(py_error()); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int rh(PyObject *r, void **out) {  // handle return (ownership to caller)
+  if (!r) { set_error(py_error()); return -1; }
+  if (r == Py_None) { Py_DECREF(r); *out = nullptr; return 0; }
+  *out = r;
+  return 0;
+}
+
+int ri(PyObject *r, int *out) {
+  if (!r) { set_error(py_error()); return -1; }
+  *out = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int rs(PyObject *r, const char **out) {  // single string (own TLS slot)
+  if (!r) { set_error(py_error()); return -1; }
+  const char *c = PyUnicode_AsUTF8(r);
+  g_str_single = c ? c : "";
+  Py_DECREF(r);
+  *out = g_str_single.c_str();
+  return 0;
+}
+
+int rsl(PyObject *r, mx_uint *out_n, const char ***out) {  // string list
+  if (!r) { set_error(py_error()); return -1; }
+  fill_strs(r, out_n, out);
+  Py_DECREF(r);
+  return 0;
+}
+
+int rhl(PyObject *r, mx_uint *out_n, NDArrayHandle **out) {  // handle list
+  if (!r) { set_error(py_error()); return -1; }
+  fill_handles(r, out_n, out);
+  Py_DECREF(r);
+  return 0;
+}
+
+PyObject *int_list(const int *data, mx_uint n) {
+  PyObject *lst = PyList_New(n);
+  for (mx_uint i = 0; i < n; ++i)
+    PyList_SetItem(lst, i, PyLong_FromLong(data[i]));
+  return lst;
+}
+
+// CSR-encoded shape args (MXSymbolInferShape wire format) -> list of lists
+PyObject *csr_shapes(mx_uint num, const mx_uint *ind, const mx_uint *data) {
+  PyObject *out = PyList_New(num);
+  for (mx_uint i = 0; i < num; ++i) {
+    mx_uint lo = ind[i], hi = ind[i + 1];
+    PyObject *shp = PyList_New(hi - lo);
+    for (mx_uint j = lo; j < hi; ++j)
+      PyList_SetItem(shp, j - lo, PyLong_FromUnsignedLong(data[j]));
+    PyList_SetItem(out, i, shp);
+  }
+  return out;
+}
+
+}  // namespace
+
+#define PREP ensure_interpreter(); ScopedGIL gil
+#define H(x) static_cast<PyObject *>(x)
+
+namespace {
+// query each handle's real storage type through the bridge (the round-3
+// sparse dispatch means outputs are no longer always dense)
+int fill_stypes(NDArrayHandle *handles, int n, const int **out_stypes) {
+  g_int_store.assign(n > 0 ? n : 0, 0);
+  for (int i = 0; i < n; ++i) {
+    if (!handles[i]) continue;
+    PyObject *a = Py_BuildValue("(O)", H(handles[i]));
+    PyObject *r = call("ndarray_get_storage_type", a);
+    Py_DECREF(a);
+    if (!r) { set_error(py_error()); return -1; }
+    g_int_store[i] = (int)PyLong_AsLong(r);
+    Py_DECREF(r);
+  }
+  *out_stypes = g_int_store.data();
+  return 0;
+}
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// symbol depth
+// ---------------------------------------------------------------------------
+
+MXTPU_API int MXSymbolCopy(SymbolHandle sym, SymbolHandle *out) {
+  PREP;
+  PyObject *a = Py_BuildValue("(O)", H(sym));
+  PyObject *r = call("symbol_copy", a); Py_DECREF(a);
+  return rh(r, out);
+}
+
+MXTPU_API int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out) {
+  PREP;
+  PyObject *a = Py_BuildValue("(s)", fname);
+  PyObject *r = call("symbol_from_file", a); Py_DECREF(a);
+  return rh(r, out);
+}
+
+MXTPU_API int MXSymbolSaveToFile(SymbolHandle sym, const char *fname) {
+  PREP;
+  PyObject *a = Py_BuildValue("(Os)", H(sym), fname);
+  PyObject *r = call("symbol_save_to_file", a); Py_DECREF(a);
+  return rv(r);
+}
+
+MXTPU_API int MXSymbolCreateGroup(mx_uint num, SymbolHandle *syms,
+                                  SymbolHandle *out) {
+  PREP;
+  PyObject *lst = handle_list(syms, num);
+  PyObject *a = Py_BuildValue("(N)", lst);
+  PyObject *r = call("symbol_create_group", a); Py_DECREF(a);
+  return rh(r, out);
+}
+
+MXTPU_API int MXSymbolPrint(SymbolHandle sym, const char **out_str) {
+  PREP;
+  PyObject *a = Py_BuildValue("(O)", H(sym));
+  PyObject *r = call("symbol_print", a); Py_DECREF(a);
+  return rs(r, out_str);
+}
+
+static int sym_str_success(const char *fn, SymbolHandle sym,
+                           const char *key, const char **out, int *success) {
+  PREP;
+  PyObject *a = key ? Py_BuildValue("(Os)", H(sym), key)
+                    : Py_BuildValue("(O)", H(sym));
+  PyObject *r = call(fn, a); Py_DECREF(a);
+  if (!r) { set_error(py_error()); return -1; }
+  const char *c = PyUnicode_AsUTF8(PyTuple_GetItem(r, 0));
+  g_str_single = c ? c : "";
+  *success = (int)PyLong_AsLong(PyTuple_GetItem(r, 1));
+  Py_DECREF(r);
+  *out = *success ? g_str_single.c_str() : nullptr;
+  return 0;
+}
+
+MXTPU_API int MXSymbolGetName(SymbolHandle sym, const char **out,
+                              int *success) {
+  return sym_str_success("symbol_get_name", sym, nullptr, out, success);
+}
+
+MXTPU_API int MXSymbolGetAttr(SymbolHandle sym, const char *key,
+                              const char **out, int *success) {
+  return sym_str_success("symbol_get_attr", sym, key, out, success);
+}
+
+MXTPU_API int MXSymbolSetAttr(SymbolHandle sym, const char *key,
+                              const char *value) {
+  PREP;
+  PyObject *a = Py_BuildValue("(Oss)", H(sym), key, value);
+  PyObject *r = call("symbol_set_attr", a); Py_DECREF(a);
+  return rv(r);
+}
+
+MXTPU_API int MXSymbolListAttr(SymbolHandle sym, mx_uint *out_size,
+                               const char ***out) {
+  PREP;
+  PyObject *a = Py_BuildValue("(O)", H(sym));
+  PyObject *r = call("symbol_list_attr", a); Py_DECREF(a);
+  if (rsl(r, out_size, out)) return -1;
+  *out_size /= 2;  // reference reports PAIR count
+  return 0;
+}
+
+MXTPU_API int MXSymbolListAttrShallow(SymbolHandle sym, mx_uint *out_size,
+                                      const char ***out) {
+  PREP;
+  PyObject *a = Py_BuildValue("(O)", H(sym));
+  PyObject *r = call("symbol_list_attr_shallow", a); Py_DECREF(a);
+  if (rsl(r, out_size, out)) return -1;
+  *out_size /= 2;
+  return 0;
+}
+
+MXTPU_API int MXSymbolGetInternals(SymbolHandle sym, SymbolHandle *out) {
+  PREP;
+  PyObject *a = Py_BuildValue("(O)", H(sym));
+  PyObject *r = call("symbol_get_internals", a); Py_DECREF(a);
+  return rh(r, out);
+}
+
+MXTPU_API int MXSymbolGetChildren(SymbolHandle sym, SymbolHandle *out) {
+  PREP;
+  PyObject *a = Py_BuildValue("(O)", H(sym));
+  PyObject *r = call("symbol_get_children", a); Py_DECREF(a);
+  return rh(r, out);
+}
+
+MXTPU_API int MXSymbolGetOutput(SymbolHandle sym, mx_uint index,
+                                SymbolHandle *out) {
+  PREP;
+  PyObject *a = Py_BuildValue("(OI)", H(sym), index);
+  PyObject *r = call("symbol_get_output", a); Py_DECREF(a);
+  return rh(r, out);
+}
+
+MXTPU_API int MXSymbolGetNumOutputs(SymbolHandle sym, mx_uint *output_count) {
+  PREP;
+  PyObject *a = Py_BuildValue("(O)", H(sym));
+  PyObject *r = call("symbol_get_num_outputs", a); Py_DECREF(a);
+  int v = 0;
+  if (ri(r, &v)) return -1;
+  *output_count = (mx_uint)v;
+  return 0;
+}
+
+MXTPU_API int MXSymbolGrad(SymbolHandle sym, mx_uint num_wrt,
+                           const char **wrt, SymbolHandle *out) {
+  PREP;
+  PyObject *w = str_list(wrt, num_wrt);
+  PyObject *a = Py_BuildValue("(ON)", H(sym), w);
+  PyObject *r = call("symbol_grad", a); Py_DECREF(a);
+  return rh(r, out);  // bridge raises: parity with reference LOG(FATAL)
+}
+
+MXTPU_API int MXSymbolCutSubgraph(SymbolHandle sym, SymbolHandle **inputs,
+                                  int *input_size) {
+  PREP;
+  PyObject *a = Py_BuildValue("(O)", H(sym));
+  PyObject *r = call("symbol_cut_subgraph", a); Py_DECREF(a);
+  mx_uint n = 0;
+  if (rhl(r, &n, reinterpret_cast<NDArrayHandle **>(inputs))) return -1;
+  *input_size = (int)n;
+  return 0;
+}
+
+MXTPU_API int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
+                                               void ***out_array) {
+  PREP;
+  PyObject *r = call("symbol_list_atomic_symbol_creators", nullptr);
+  if (!r) { set_error(py_error()); return -1; }
+  // creator handles ARE interned python op-name strings
+  Py_ssize_t n = PyList_Size(r);
+  g_handle_store.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *o = PyList_GetItem(r, i);
+    Py_INCREF(o);
+    g_handle_store.push_back(o);
+  }
+  Py_DECREF(r);
+  *out_size = (mx_uint)n;
+  *out_array = g_handle_store.data();
+  return 0;
+}
+
+MXTPU_API int MXSymbolGetAtomicSymbolName(void *creator, const char **name) {
+  PREP;
+  PyObject *a = Py_BuildValue("(O)", H(creator));
+  PyObject *r = call("symbol_get_atomic_symbol_name", a); Py_DECREF(a);
+  return rs(r, name);
+}
+
+// shape-list TLS: three parallel stores (arg/out/aux), reused per call
+thread_local std::vector<std::vector<mx_uint>> g_shape_lists[3];
+thread_local std::vector<const mx_uint *> g_shape_ptrs[3];
+thread_local std::vector<mx_uint> g_shape_ndims[3];
+
+static void fill_shapes(PyObject *lst, int slot, mx_uint *out_n,
+                        const mx_uint ***out_data, const mx_uint **out_ndim) {
+  Py_ssize_t n = PyList_Size(lst);
+  auto &lists = g_shape_lists[slot];
+  auto &ptrs = g_shape_ptrs[slot];
+  auto &ndims = g_shape_ndims[slot];
+  lists.assign(n, {});
+  ptrs.assign(n, nullptr);
+  ndims.assign(n, 0);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *shp = PyList_GetItem(lst, i);
+    Py_ssize_t d = PyList_Size(shp);
+    for (Py_ssize_t j = 0; j < d; ++j)
+      lists[i].push_back(
+          (mx_uint)PyLong_AsUnsignedLong(PyList_GetItem(shp, j)));
+    ptrs[i] = lists[i].data();
+    ndims[i] = (mx_uint)d;
+  }
+  *out_n = (mx_uint)n;
+  *out_data = ptrs.data();
+  *out_ndim = ndims.data();
+}
+
+static int infer_shape_common(const char *which, SymbolHandle sym,
+                              mx_uint num_args, const char **keys,
+                              const mx_uint *arg_ind_ptr,
+                              const mx_uint *arg_shape_data, int partial,
+                              mx_uint *in_n, const mx_uint **in_ndim,
+                              const mx_uint ***in_data, mx_uint *out_n,
+                              const mx_uint **out_ndim,
+                              const mx_uint ***out_data, mx_uint *aux_n,
+                              const mx_uint **aux_ndim,
+                              const mx_uint ***aux_data, int *complete) {
+  PREP;
+  PyObject *k = str_list(keys, num_args);
+  PyObject *shp = csr_shapes(num_args, arg_ind_ptr, arg_shape_data);
+  PyObject *a = Py_BuildValue("(ONNi)", H(sym), k, shp, partial);
+  PyObject *r = call(which, a); Py_DECREF(a);
+  if (!r) { set_error(py_error()); return -1; }
+  fill_shapes(PyTuple_GetItem(r, 0), 0, in_n, in_data, in_ndim);
+  fill_shapes(PyTuple_GetItem(r, 1), 1, out_n, out_data, out_ndim);
+  fill_shapes(PyTuple_GetItem(r, 2), 2, aux_n, aux_data, aux_ndim);
+  *complete = PyTuple_Size(r) > 3
+      ? (int)PyLong_AsLong(PyTuple_GetItem(r, 3)) : 1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXSymbolInferShapePartial(
+    SymbolHandle sym, mx_uint num_args, const char **keys,
+    const mx_uint *arg_ind_ptr, const mx_uint *arg_shape_data,
+    mx_uint *in_shape_size, const mx_uint **in_shape_ndim,
+    const mx_uint ***in_shape_data, mx_uint *out_shape_size,
+    const mx_uint **out_shape_ndim, const mx_uint ***out_shape_data,
+    mx_uint *aux_shape_size, const mx_uint **aux_shape_ndim,
+    const mx_uint ***aux_shape_data, int *complete) {
+  return infer_shape_common("symbol_infer_shape_impl", sym, num_args, keys,
+                            arg_ind_ptr, arg_shape_data, 1, in_shape_size,
+                            in_shape_ndim, in_shape_data, out_shape_size,
+                            out_shape_ndim, out_shape_data, aux_shape_size,
+                            aux_shape_ndim, aux_shape_data, complete);
+}
+
+static int infer_type_common(SymbolHandle sym, mx_uint num_args,
+                             const char **keys, const int *arg_type_data,
+                             int partial, mx_uint *in_n, const int **in_t,
+                             mx_uint *out_n, const int **out_t,
+                             mx_uint *aux_n, const int **aux_t,
+                             int *complete) {
+  PREP;
+  PyObject *k = str_list(keys, num_args);
+  PyObject *t = int_list(arg_type_data, num_args);
+  PyObject *a = Py_BuildValue("(ONNi)", H(sym), k, t, partial);
+  PyObject *r = call("symbol_infer_type_impl", a); Py_DECREF(a);
+  if (!r) { set_error(py_error()); return -1; }
+  thread_local std::vector<int> stores[3];
+  mx_uint *ns[3] = {in_n, out_n, aux_n};
+  const int **outs[3] = {in_t, out_t, aux_t};
+  for (int s = 0; s < 3; ++s) {
+    PyObject *lst = PyTuple_GetItem(r, s);
+    Py_ssize_t n = PyList_Size(lst);
+    stores[s].assign(n, -1);
+    for (Py_ssize_t i = 0; i < n; ++i)
+      stores[s][i] = (int)PyLong_AsLong(PyList_GetItem(lst, i));
+    *ns[s] = (mx_uint)n;
+    *outs[s] = stores[s].data();
+  }
+  *complete = PyTuple_Size(r) > 3
+      ? (int)PyLong_AsLong(PyTuple_GetItem(r, 3)) : 1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXSymbolInferType(SymbolHandle sym, mx_uint num_args,
+                                const char **keys, const int *arg_type_data,
+                                mx_uint *in_type_size, const int **in_type_data,
+                                mx_uint *out_type_size,
+                                const int **out_type_data,
+                                mx_uint *aux_type_size,
+                                const int **aux_type_data, int *complete) {
+  return infer_type_common(sym, num_args, keys, arg_type_data, 0,
+                           in_type_size, in_type_data, out_type_size,
+                           out_type_data, aux_type_size, aux_type_data,
+                           complete);
+}
+
+MXTPU_API int MXSymbolInferTypePartial(
+    SymbolHandle sym, mx_uint num_args, const char **keys,
+    const int *arg_type_data, mx_uint *in_type_size, const int **in_type_data,
+    mx_uint *out_type_size, const int **out_type_data, mx_uint *aux_type_size,
+    const int **aux_type_data, int *complete) {
+  return infer_type_common(sym, num_args, keys, arg_type_data, 1,
+                           in_type_size, in_type_data, out_type_size,
+                           out_type_data, aux_type_size, aux_type_data,
+                           complete);
+}
+
+// ---------------------------------------------------------------------------
+// DataIter
+// ---------------------------------------------------------------------------
+
+MXTPU_API int MXListDataIters(mx_uint *out_size, void ***out_array) {
+  PREP;
+  PyObject *r = call("list_data_iters", nullptr);
+  if (!r) { set_error(py_error()); return -1; }
+  Py_ssize_t n = PyList_Size(r);
+  g_handle_store.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *o = PyList_GetItem(r, i);
+    Py_INCREF(o);
+    g_handle_store.push_back(o);  // creator handle = iterator-name string
+  }
+  Py_DECREF(r);
+  *out_size = (mx_uint)n;
+  *out_array = g_handle_store.data();
+  return 0;
+}
+
+MXTPU_API int MXDataIterCreateIter(void *creator, mx_uint num_param,
+                                   const char **keys, const char **vals,
+                                   void **out) {
+  PREP;
+  PyObject *k = str_list(keys, num_param);
+  PyObject *v = str_list(vals, num_param);
+  PyObject *a = Py_BuildValue("(ONN)", H(creator), k, v);
+  PyObject *r = call("data_iter_create", a); Py_DECREF(a);
+  return rh(r, out);
+}
+
+MXTPU_API int MXDataIterGetIterInfo(void *creator, const char **name,
+                                    const char **description,
+                                    mx_uint *num_args,
+                                    const char ***arg_names,
+                                    const char ***arg_type_infos,
+                                    const char ***arg_descriptions) {
+  PREP;
+  PyObject *a = Py_BuildValue("(O)", H(creator));
+  PyObject *r = call("data_iter_get_info", a); Py_DECREF(a);
+  if (!r) { set_error(py_error()); return -1; }
+  thread_local std::string s_name, s_desc;
+  const char *c = PyUnicode_AsUTF8(PyTuple_GetItem(r, 0));
+  s_name = c ? c : "";
+  c = PyUnicode_AsUTF8(PyTuple_GetItem(r, 1));
+  s_desc = c ? c : "";
+  fill_strs(PyTuple_GetItem(r, 2), num_args, arg_names);
+  Py_DECREF(r);
+  *name = s_name.c_str();
+  *description = s_desc.c_str();
+  *arg_type_infos = *arg_names;     // typed metadata folded into names
+  *arg_descriptions = *arg_names;
+  return 0;
+}
+
+MXTPU_API int MXDataIterFree(void *handle) {
+  if (!handle) return 0;
+  ScopedGIL gil;
+  Py_DECREF(H(handle));
+  return 0;
+}
+
+MXTPU_API int MXDataIterNext(void *handle, int *out) {
+  PREP;
+  PyObject *a = Py_BuildValue("(O)", H(handle));
+  PyObject *r = call("data_iter_next", a); Py_DECREF(a);
+  return ri(r, out);
+}
+
+MXTPU_API int MXDataIterBeforeFirst(void *handle) {
+  PREP;
+  PyObject *a = Py_BuildValue("(O)", H(handle));
+  PyObject *r = call("data_iter_before_first", a); Py_DECREF(a);
+  return rv(r);
+}
+
+MXTPU_API int MXDataIterGetData(void *handle, NDArrayHandle *out) {
+  PREP;
+  PyObject *a = Py_BuildValue("(O)", H(handle));
+  PyObject *r = call("data_iter_get_data", a); Py_DECREF(a);
+  return rh(r, out);
+}
+
+MXTPU_API int MXDataIterGetLabel(void *handle, NDArrayHandle *out) {
+  PREP;
+  PyObject *a = Py_BuildValue("(O)", H(handle));
+  PyObject *r = call("data_iter_get_label", a); Py_DECREF(a);
+  return rh(r, out);
+}
+
+MXTPU_API int MXDataIterGetIndex(void *handle, uint64_t **out_index,
+                                 uint64_t *out_size) {
+  PREP;
+  PyObject *a = Py_BuildValue("(O)", H(handle));
+  PyObject *r = call("data_iter_get_index", a); Py_DECREF(a);
+  if (!r) { set_error(py_error()); return -1; }
+  Py_ssize_t n = PyList_Size(r);
+  g_u64_store.clear();
+  for (Py_ssize_t i = 0; i < n; ++i)
+    g_u64_store.push_back(
+        (uint64_t)PyLong_AsUnsignedLongLong(PyList_GetItem(r, i)));
+  Py_DECREF(r);
+  *out_index = g_u64_store.data();
+  *out_size = (uint64_t)n;
+  return 0;
+}
+
+MXTPU_API int MXDataIterGetPadNum(void *handle, int *pad) {
+  PREP;
+  PyObject *a = Py_BuildValue("(O)", H(handle));
+  PyObject *r = call("data_iter_get_pad_num", a); Py_DECREF(a);
+  return ri(r, pad);
+}
+
+// ---------------------------------------------------------------------------
+// RecordIO
+// ---------------------------------------------------------------------------
+
+MXTPU_API int MXRecordIOWriterCreate(const char *uri, void **out) {
+  PREP;
+  PyObject *a = Py_BuildValue("(s)", uri);
+  PyObject *r = call("recordio_writer_create", a); Py_DECREF(a);
+  return rh(r, out);
+}
+
+MXTPU_API int MXRecordIOReaderCreate(const char *uri, void **out) {
+  PREP;
+  PyObject *a = Py_BuildValue("(s)", uri);
+  PyObject *r = call("recordio_reader_create", a); Py_DECREF(a);
+  return rh(r, out);
+}
+
+static int recordio_free(void *handle) {
+  if (!handle) return 0;
+  ScopedGIL gil;
+  PyObject *a = Py_BuildValue("(O)", H(handle));
+  PyObject *r = call("recordio_close", a); Py_DECREF(a);
+  Py_DECREF(H(handle));
+  if (!r) { set_error(py_error()); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXRecordIOWriterFree(void *handle) {
+  return recordio_free(handle);
+}
+
+MXTPU_API int MXRecordIOReaderFree(void *handle) {
+  return recordio_free(handle);
+}
+
+MXTPU_API int MXRecordIOWriterWriteRecord(void *handle, const char *buf,
+                                          size_t size) {
+  PREP;
+  PyObject *a = Py_BuildValue("(OKK)", H(handle),
+                              (unsigned long long)(uintptr_t)buf,
+                              (unsigned long long)size);
+  PyObject *r = call("recordio_write_record", a); Py_DECREF(a);
+  return rv(r);
+}
+
+MXTPU_API int MXRecordIOReaderReadRecord(void *handle, char const **buf,
+                                         size_t *size) {
+  PREP;
+  PyObject *a = Py_BuildValue("(O)", H(handle));
+  PyObject *r = call("recordio_read_record", a); Py_DECREF(a);
+  if (!r) { set_error(py_error()); return -1; }
+  if (r == Py_None) { Py_DECREF(r); *buf = nullptr; *size = 0; return 0; }
+  *buf = (const char *)(uintptr_t)
+      PyLong_AsUnsignedLongLong(PyTuple_GetItem(r, 0));
+  *size = (size_t)PyLong_AsUnsignedLongLong(PyTuple_GetItem(r, 1));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXRecordIOReaderSeek(void *handle, size_t pos) {
+  PREP;
+  PyObject *a = Py_BuildValue("(OK)", H(handle), (unsigned long long)pos);
+  PyObject *r = call("recordio_reader_seek", a); Py_DECREF(a);
+  return rv(r);
+}
+
+static int recordio_tell(void *handle, size_t *pos) {
+  PREP;
+  PyObject *a = Py_BuildValue("(O)", H(handle));
+  PyObject *r = call("recordio_tell", a); Py_DECREF(a);
+  if (!r) { set_error(py_error()); return -1; }
+  *pos = (size_t)PyLong_AsUnsignedLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXRecordIOWriterTell(void *handle, size_t *pos) {
+  return recordio_tell(handle, pos);
+}
+
+MXTPU_API int MXRecordIOReaderTell(void *handle, size_t *pos) {
+  return recordio_tell(handle, pos);
+}
+
+// ---------------------------------------------------------------------------
+// profiler
+// ---------------------------------------------------------------------------
+
+MXTPU_API int MXSetProfilerConfig(int num_params, const char *const *keys,
+                                  const char *const *vals) {
+  PREP;
+  PyObject *k = str_list(const_cast<const char **>(keys), num_params);
+  PyObject *v = str_list(const_cast<const char **>(vals), num_params);
+  PyObject *a = Py_BuildValue("(NN)", k, v);
+  PyObject *r = call("profiler_set_config", a); Py_DECREF(a);
+  return rv(r);
+}
+
+MXTPU_API int MXSetProcessProfilerConfig(int num_params,
+                                         const char *const *keys,
+                                         const char *const *vals,
+                                         KVStoreHandle kv) {
+  (void)kv;  // no separate server processes to configure
+  return MXSetProfilerConfig(num_params, keys, vals);
+}
+
+MXTPU_API int MXSetProfilerState(int state) {
+  PREP;
+  PyObject *a = Py_BuildValue("(i)", state);
+  PyObject *r = call("profiler_set_state", a); Py_DECREF(a);
+  return rv(r);
+}
+
+MXTPU_API int MXSetProcessProfilerState(int state, int profile_process,
+                                        KVStoreHandle kv) {
+  (void)profile_process; (void)kv;
+  return MXSetProfilerState(state);
+}
+
+MXTPU_API int MXDumpProfile(int finished) {
+  PREP;
+  PyObject *a = Py_BuildValue("(i)", finished);
+  PyObject *r = call("profiler_dump", a); Py_DECREF(a);
+  return rv(r);
+}
+
+MXTPU_API int MXDumpProcessProfile(int finished, int profile_process,
+                                   KVStoreHandle kv) {
+  (void)profile_process; (void)kv;
+  return MXDumpProfile(finished);
+}
+
+MXTPU_API int MXProfilePause(int paused) {
+  PREP;
+  PyObject *a = Py_BuildValue("(i)", paused);
+  PyObject *r = call("profiler_pause", a); Py_DECREF(a);
+  return rv(r);
+}
+
+MXTPU_API int MXProcessProfilePause(int paused, int profile_process,
+                                    KVStoreHandle kv) {
+  (void)profile_process; (void)kv;
+  return MXProfilePause(paused);
+}
+
+MXTPU_API int MXAggregateProfileStatsPrint(const char **out_str, int reset) {
+  PREP;
+  PyObject *a = Py_BuildValue("(i)", reset);
+  PyObject *r = call("profiler_aggregate_stats", a); Py_DECREF(a);
+  return rs(r, out_str);
+}
+
+MXTPU_API int MXProfileCreateDomain(const char *domain, void **out) {
+  PREP;
+  PyObject *a = Py_BuildValue("(s)", domain);
+  PyObject *r = call("profile_create_domain", a); Py_DECREF(a);
+  return rh(r, out);
+}
+
+MXTPU_API int MXProfileCreateTask(void *domain, const char *name,
+                                  void **out) {
+  PREP;
+  PyObject *a = Py_BuildValue("(Os)", H(domain), name);
+  PyObject *r = call("profile_create_task", a); Py_DECREF(a);
+  return rh(r, out);
+}
+
+MXTPU_API int MXProfileCreateFrame(void *domain, const char *name,
+                                   void **out) {
+  PREP;
+  PyObject *a = Py_BuildValue("(Os)", H(domain), name);
+  PyObject *r = call("profile_create_frame", a); Py_DECREF(a);
+  return rh(r, out);
+}
+
+MXTPU_API int MXProfileCreateEvent(const char *name, void **out) {
+  PREP;
+  PyObject *a = Py_BuildValue("(s)", name);
+  PyObject *r = call("profile_create_event", a); Py_DECREF(a);
+  return rh(r, out);
+}
+
+MXTPU_API int MXProfileCreateCounter(void *domain, const char *name,
+                                     void **out) {
+  PREP;
+  PyObject *a = Py_BuildValue("(OsO)", H(domain), name, Py_None);
+  PyObject *r = call("profile_create_counter", a); Py_DECREF(a);
+  return rh(r, out);
+}
+
+MXTPU_API int MXProfileDestroyHandle(void *handle) {
+  if (!handle) return 0;
+  ScopedGIL gil;
+  Py_DECREF(H(handle));
+  return 0;
+}
+
+MXTPU_API int MXProfileDurationStart(void *handle) {
+  PREP;
+  PyObject *a = Py_BuildValue("(O)", H(handle));
+  PyObject *r = call("profile_duration_start", a); Py_DECREF(a);
+  return rv(r);
+}
+
+MXTPU_API int MXProfileDurationStop(void *handle) {
+  PREP;
+  PyObject *a = Py_BuildValue("(O)", H(handle));
+  PyObject *r = call("profile_duration_stop", a); Py_DECREF(a);
+  return rv(r);
+}
+
+MXTPU_API int MXProfileSetCounter(void *handle, uint64_t value) {
+  PREP;
+  PyObject *a = Py_BuildValue("(OK)", H(handle), (unsigned long long)value);
+  PyObject *r = call("profile_set_counter", a); Py_DECREF(a);
+  return rv(r);
+}
+
+MXTPU_API int MXProfileAdjustCounter(void *handle, int64_t delta) {
+  PREP;
+  PyObject *a = Py_BuildValue("(OL)", H(handle), (long long)delta);
+  PyObject *r = call("profile_adjust_counter", a); Py_DECREF(a);
+  return rv(r);
+}
+
+MXTPU_API int MXProfileSetMarker(void *domain, const char *name,
+                                 const char *scope) {
+  PREP;
+  PyObject *a = Py_BuildValue("(Oss)", H(domain), name, scope);
+  PyObject *r = call("profile_set_marker", a); Py_DECREF(a);
+  return rv(r);
+}
+
+// ---------------------------------------------------------------------------
+// CachedOp
+// ---------------------------------------------------------------------------
+
+MXTPU_API int MXCreateCachedOpEx(SymbolHandle sym, int num_flags,
+                                 const char **keys, const char **vals,
+                                 void **out) {
+  PREP;
+  PyObject *k = str_list(keys, num_flags);
+  PyObject *v = str_list(vals, num_flags);
+  PyObject *a = Py_BuildValue("(ONN)", H(sym), k, v);
+  PyObject *r = call("cached_op_create", a); Py_DECREF(a);
+  return rh(r, out);
+}
+
+MXTPU_API int MXCreateCachedOp(SymbolHandle sym, void **out) {
+  return MXCreateCachedOpEx(sym, 0, nullptr, nullptr, out);
+}
+
+MXTPU_API int MXFreeCachedOp(void *handle) {
+  if (!handle) return 0;
+  ScopedGIL gil;
+  Py_DECREF(H(handle));
+  return 0;
+}
+
+MXTPU_API int MXInvokeCachedOp(void *handle, int num_inputs,
+                               NDArrayHandle *inputs, int *num_outputs,
+                               NDArrayHandle **outputs) {
+  PREP;
+  PyObject *ins = handle_list(inputs, num_inputs);
+  PyObject *a = Py_BuildValue("(ON)", H(handle), ins);
+  PyObject *r = call("cached_op_invoke", a); Py_DECREF(a);
+  mx_uint n = 0;
+  if (rhl(r, &n, outputs)) return -1;
+  *num_outputs = (int)n;
+  return 0;
+}
+
+MXTPU_API int MXInvokeCachedOpEx(void *handle, int num_inputs,
+                                 NDArrayHandle *inputs, int *num_outputs,
+                                 NDArrayHandle **outputs,
+                                 const int **out_stypes) {
+  if (MXInvokeCachedOp(handle, num_inputs, inputs, num_outputs, outputs))
+    return -1;
+  ScopedGIL gil;
+  return fill_stypes(*outputs, *num_outputs, out_stypes);
+}
+
+// ---------------------------------------------------------------------------
+// sparse NDArray
+// ---------------------------------------------------------------------------
+
+MXTPU_API int MXNDArrayCreateSparseEx(
+    int storage_type, const mx_uint *shape, mx_uint ndim, int dev_type,
+    int dev_id, int delay_alloc, int dtype, mx_uint num_aux, int *aux_type,
+    mx_uint *aux_ndims, const mx_uint *aux_shape, NDArrayHandle *out) {
+  (void)dev_type; (void)dev_id; (void)delay_alloc;
+  (void)num_aux; (void)aux_type; (void)aux_ndims; (void)aux_shape;
+  PREP;
+  PyObject *shp = uint_list(shape, ndim);
+  PyObject *a = Py_BuildValue("(iNi)", storage_type, shp, dtype);
+  PyObject *r = call("ndarray_create_sparse", a); Py_DECREF(a);
+  return rh(r, out);
+}
+
+MXTPU_API int MXNDArrayGetStorageType(NDArrayHandle handle,
+                                      int *out_storage_type) {
+  PREP;
+  PyObject *a = Py_BuildValue("(O)", H(handle));
+  PyObject *r = call("ndarray_get_storage_type", a); Py_DECREF(a);
+  return ri(r, out_storage_type);
+}
+
+MXTPU_API int MXNDArrayGetAuxNDArray(NDArrayHandle handle, mx_uint i,
+                                     NDArrayHandle *out) {
+  PREP;
+  PyObject *a = Py_BuildValue("(OI)", H(handle), i);
+  PyObject *r = call("ndarray_get_aux_ndarray", a); Py_DECREF(a);
+  return rh(r, out);
+}
+
+MXTPU_API int MXNDArrayGetAuxType(NDArrayHandle handle, mx_uint i,
+                                  int *out_type) {
+  PREP;
+  PyObject *a = Py_BuildValue("(OI)", H(handle), i);
+  PyObject *r = call("ndarray_get_aux_type", a); Py_DECREF(a);
+  return ri(r, out_type);
+}
+
+MXTPU_API int MXNDArrayGetDataNDArray(NDArrayHandle handle,
+                                      NDArrayHandle *out) {
+  PREP;
+  PyObject *a = Py_BuildValue("(O)", H(handle));
+  PyObject *r = call("ndarray_get_data_ndarray", a); Py_DECREF(a);
+  return rh(r, out);
+}
+
+MXTPU_API int MXNDArraySyncCheckFormat(NDArrayHandle handle,
+                                       const bool full_check) {
+  PREP;
+  PyObject *a = Py_BuildValue("(Oi)", H(handle), full_check ? 1 : 0);
+  PyObject *r = call("ndarray_sync_check_format", a); Py_DECREF(a);
+  return rv(r);
+}
+
+MXTPU_API int MXNDArraySyncCopyFromNDArray(NDArrayHandle dst,
+                                           const NDArrayHandle src,
+                                           const int i) {
+  PREP;
+  PyObject *a = Py_BuildValue("(OOi)", H(dst), H(src), i);
+  PyObject *r = call("ndarray_sync_copy_from_ndarray", a); Py_DECREF(a);
+  return rv(r);
+}
+
+// ---------------------------------------------------------------------------
+// NDArray depth
+// ---------------------------------------------------------------------------
+
+MXTPU_API int MXNDArrayWaitToRead(NDArrayHandle handle) {
+  PREP;
+  PyObject *a = Py_BuildValue("(O)", H(handle));
+  PyObject *r = call("ndarray_wait_to_read", a); Py_DECREF(a);
+  return rv(r);
+}
+
+MXTPU_API int MXNDArrayWaitToWrite(NDArrayHandle handle) {
+  PREP;
+  PyObject *a = Py_BuildValue("(O)", H(handle));
+  PyObject *r = call("ndarray_wait_to_write", a); Py_DECREF(a);
+  return rv(r);
+}
+
+MXTPU_API int MXNDArrayDetach(NDArrayHandle handle, NDArrayHandle *out) {
+  PREP;
+  PyObject *a = Py_BuildValue("(O)", H(handle));
+  PyObject *r = call("ndarray_detach", a); Py_DECREF(a);
+  return rh(r, out);
+}
+
+MXTPU_API int MXNDArrayGetContext(NDArrayHandle handle, int *out_dev_type,
+                                  int *out_dev_id) {
+  PREP;
+  PyObject *a = Py_BuildValue("(O)", H(handle));
+  PyObject *r = call("ndarray_get_context", a); Py_DECREF(a);
+  if (!r) { set_error(py_error()); return -1; }
+  *out_dev_type = (int)PyLong_AsLong(PyTuple_GetItem(r, 0));
+  *out_dev_id = (int)PyLong_AsLong(PyTuple_GetItem(r, 1));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXNDArrayGetData(NDArrayHandle handle, void **out_pdata) {
+  PREP;
+  PyObject *a = Py_BuildValue("(O)", H(handle));
+  PyObject *r = call("ndarray_get_data_ptr", a); Py_DECREF(a);
+  if (!r) { set_error(py_error()); return -1; }
+  *out_pdata = (void *)(uintptr_t)PyLong_AsUnsignedLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXNDArrayGetGradState(NDArrayHandle handle, int *out) {
+  PREP;
+  PyObject *a = Py_BuildValue("(O)", H(handle));
+  PyObject *r = call("ndarray_get_grad_state", a); Py_DECREF(a);
+  return ri(r, out);
+}
+
+MXTPU_API int MXNDArraySetGradState(NDArrayHandle handle, int state) {
+  PREP;
+  PyObject *a = Py_BuildValue("(Oi)", H(handle), state);
+  PyObject *r = call("ndarray_set_grad_state", a); Py_DECREF(a);
+  return rv(r);
+}
+
+MXTPU_API int MXNDArrayReshape64(NDArrayHandle handle, int ndim,
+                                 int64_t *dims, bool reverse,
+                                 NDArrayHandle *out) {
+  PREP;
+  PyObject *shp = PyList_New(ndim);
+  for (int i = 0; i < ndim; ++i)
+    PyList_SetItem(shp, i, PyLong_FromLongLong(dims[i]));
+  PyObject *a = Py_BuildValue("(ONi)", H(handle), shp, reverse ? 1 : 0);
+  PyObject *r = call("ndarray_reshape64", a); Py_DECREF(a);
+  return rh(r, out);
+}
+
+MXTPU_API int MXNDArraySaveRawBytes(NDArrayHandle handle, size_t *out_size,
+                                    const char **out_buf) {
+  PREP;
+  PyObject *a = Py_BuildValue("(O)", H(handle));
+  PyObject *r = call("ndarray_save_raw_bytes", a); Py_DECREF(a);
+  if (!r) { set_error(py_error()); return -1; }
+  *out_buf = (const char *)(uintptr_t)
+      PyLong_AsUnsignedLongLong(PyTuple_GetItem(r, 0));
+  *out_size = (size_t)PyLong_AsUnsignedLongLong(PyTuple_GetItem(r, 1));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXNDArrayLoadFromRawBytes(const void *buf, size_t size,
+                                        NDArrayHandle *out) {
+  PREP;
+  PyObject *a = Py_BuildValue("(KK)", (unsigned long long)(uintptr_t)buf,
+                              (unsigned long long)size);
+  PyObject *r = call("ndarray_load_from_raw_bytes", a); Py_DECREF(a);
+  return rh(r, out);
+}
+
+MXTPU_API int MXNDArrayLoadFromBuffer(const void *buf, size_t size,
+                                      mx_uint *out_size,
+                                      NDArrayHandle **out_arr,
+                                      mx_uint *out_name_size,
+                                      const char ***out_names) {
+  PREP;
+  PyObject *a = Py_BuildValue("(KK)", (unsigned long long)(uintptr_t)buf,
+                              (unsigned long long)size);
+  PyObject *r = call("ndarray_load_from_buffer", a); Py_DECREF(a);
+  if (!r) { set_error(py_error()); return -1; }
+  fill_strs(PyTuple_GetItem(r, 0), out_name_size, out_names);
+  fill_handles(PyTuple_GetItem(r, 1), out_size, out_arr);
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXNDArrayGetSharedMemHandle(NDArrayHandle handle,
+                                          int *shared_pid, int *shared_id) {
+  PREP;
+  PyObject *a = Py_BuildValue("(O)", H(handle));
+  PyObject *r = call("ndarray_get_shared_mem_handle", a); Py_DECREF(a);
+  if (!r) { set_error(py_error()); return -1; }
+  *shared_pid = (int)PyLong_AsLong(PyTuple_GetItem(r, 0));
+  *shared_id = (int)PyLong_AsLong(PyTuple_GetItem(r, 1));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXNDArrayCreateFromSharedMem(int shared_pid, int shared_id,
+                                           const mx_uint *shape,
+                                           mx_uint ndim, int dtype,
+                                           NDArrayHandle *out) {
+  PREP;
+  PyObject *shp = uint_list(shape, ndim);
+  PyObject *a = Py_BuildValue("(iiNis)", shared_pid, shared_id, shp, dtype,
+                              "");
+  PyObject *r = call("ndarray_create_from_shared_mem", a); Py_DECREF(a);
+  return rh(r, out);
+}
+
+MXTPU_API int MXNDArrayToDLPack(NDArrayHandle handle, void **out_dlpack) {
+  PREP;
+  PyObject *a = Py_BuildValue("(O)", H(handle));
+  PyObject *r = call("ndarray_to_dlpack", a); Py_DECREF(a);
+  return rh(r, out_dlpack);
+}
+
+MXTPU_API int MXNDArrayFromDLPack(void *dlpack, NDArrayHandle *out) {
+  PREP;
+  PyObject *a = Py_BuildValue("(O)", H(dlpack));
+  PyObject *r = call("ndarray_from_dlpack", a); Py_DECREF(a);
+  return rh(r, out);
+}
+
+MXTPU_API int MXNDArrayCallDLPackDeleter(void *dlpack) {
+  if (!dlpack) return 0;
+  ScopedGIL gil;
+  Py_DECREF(H(dlpack));
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// executor depth
+// ---------------------------------------------------------------------------
+
+MXTPU_API int MXExecutorSimpleBind(
+    SymbolHandle symbol_handle, int dev_type, int dev_id,
+    const mx_uint num_g2c_keys, const char **g2c_keys,
+    const int *g2c_dev_types, const int *g2c_dev_ids,
+    const mx_uint provided_grad_req_list_len,
+    const char **provided_grad_req_names,
+    const char **provided_grad_req_types,
+    const mx_uint num_provided_arg_shapes,
+    const char **provided_arg_shape_names,
+    const mx_uint *provided_arg_shape_data,
+    const mx_uint *provided_arg_shape_idx,
+    const mx_uint num_provided_arg_dtypes,
+    const char **provided_arg_dtype_names, const int *provided_arg_dtypes,
+    const mx_uint num_provided_arg_stypes,
+    const char **provided_arg_stype_names, const int *provided_arg_stypes,
+    const mx_uint num_shared_arg_names, const char **shared_arg_name_list,
+    int *shared_buffer_len, const char **shared_buffer_name_list,
+    NDArrayHandle *shared_buffer_handle_list,
+    const char ***updated_shared_buffer_name_list,
+    NDArrayHandle **updated_shared_buffer_handle_list,
+    mx_uint *num_in_args, NDArrayHandle **in_args, NDArrayHandle **arg_grads,
+    mx_uint *num_aux_states, NDArrayHandle **aux_states,
+    ExecutorHandle shared_exec_handle, ExecutorHandle *out) {
+  (void)dev_type; (void)dev_id; (void)num_g2c_keys; (void)g2c_keys;
+  (void)g2c_dev_types; (void)g2c_dev_ids;
+  (void)num_provided_arg_dtypes; (void)provided_arg_dtype_names;
+  (void)provided_arg_dtypes; (void)num_provided_arg_stypes;
+  (void)provided_arg_stype_names; (void)provided_arg_stypes;
+  (void)num_shared_arg_names; (void)shared_arg_name_list;
+  (void)shared_buffer_name_list; (void)shared_buffer_handle_list;
+  (void)shared_exec_handle;
+  PREP;
+  const char *grad_req = provided_grad_req_list_len > 0
+      ? provided_grad_req_types[0] : "write";
+  PyObject *names = str_list(provided_arg_shape_names,
+                             num_provided_arg_shapes);
+  PyObject *shapes = csr_shapes(num_provided_arg_shapes,
+                                provided_arg_shape_idx,
+                                provided_arg_shape_data);
+  PyObject *a = Py_BuildValue("(ONNs)", H(symbol_handle), names, shapes,
+                              grad_req);
+  PyObject *r = call("executor_simple_bind", a); Py_DECREF(a);
+  if (!r) { set_error(py_error()); return -1; }
+  // shared buffers pass through unchanged (XLA owns pooling)
+  if (shared_buffer_len && *shared_buffer_len >= 0) {
+    *updated_shared_buffer_name_list = nullptr;
+    *updated_shared_buffer_handle_list = nullptr;
+    *shared_buffer_len = 0;
+  }
+  thread_local std::vector<void *> arg_store, grad_store, aux_store;
+  PyObject *ex = PyTuple_GetItem(r, 0);
+  PyObject *args_l = PyTuple_GetItem(r, 1);
+  PyObject *grads_l = PyTuple_GetItem(r, 2);
+  PyObject *aux_l = PyTuple_GetItem(r, 3);
+  auto fill = [](PyObject *lst, std::vector<void *> &store) {
+    store.clear();
+    for (Py_ssize_t i = 0; i < PyList_Size(lst); ++i) {
+      PyObject *o = PyList_GetItem(lst, i);
+      if (o == Py_None) { store.push_back(nullptr); continue; }
+      Py_INCREF(o);
+      store.push_back(o);
+    }
+  };
+  fill(args_l, arg_store);
+  fill(grads_l, grad_store);
+  fill(aux_l, aux_store);
+  *num_in_args = (mx_uint)arg_store.size();
+  *in_args = arg_store.data();
+  *arg_grads = grad_store.empty() ? nullptr : grad_store.data();
+  *num_aux_states = (mx_uint)aux_store.size();
+  *aux_states = aux_store.data();
+  Py_INCREF(ex);
+  *out = ex;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXExecutorReshape(
+    int partial_shaping, int allow_up_sizing, int dev_type, int dev_id,
+    mx_uint num_map_keys, const char **map_keys, const int *map_dev_types,
+    const int *map_dev_ids, const mx_uint num_provided_arg_shapes,
+    const char **provided_arg_shape_names,
+    const mx_uint *provided_arg_shape_data,
+    const mx_uint *provided_arg_shape_idx, mx_uint *num_in_args,
+    NDArrayHandle **in_args, NDArrayHandle **arg_grads,
+    mx_uint *num_aux_states, NDArrayHandle **aux_states,
+    ExecutorHandle shared_exec, ExecutorHandle *out) {
+  (void)partial_shaping; (void)allow_up_sizing; (void)dev_type;
+  (void)dev_id; (void)num_map_keys; (void)map_keys; (void)map_dev_types;
+  (void)map_dev_ids; (void)shared_exec;
+  PREP;
+  if (!shared_exec) {
+    set_error("MXExecutorReshape: shared_exec handle required");
+    return -1;
+  }
+  PyObject *names = str_list(provided_arg_shape_names,
+                             num_provided_arg_shapes);
+  PyObject *shapes = csr_shapes(num_provided_arg_shapes,
+                                provided_arg_shape_idx,
+                                provided_arg_shape_data);
+  PyObject *a = Py_BuildValue("(ONN)", H(shared_exec), names, shapes);
+  PyObject *r = call("executor_reshape", a); Py_DECREF(a);
+  if (!r) { set_error(py_error()); return -1; }
+  thread_local std::vector<void *> arg_store, aux_store;
+  auto fill = [](PyObject *lst, std::vector<void *> &store) {
+    store.clear();
+    for (Py_ssize_t i = 0; i < PyList_Size(lst); ++i) {
+      PyObject *o = PyList_GetItem(lst, i);
+      Py_INCREF(o);
+      store.push_back(o);
+    }
+  };
+  fill(PyTuple_GetItem(r, 1), arg_store);
+  fill(PyTuple_GetItem(r, 2), aux_store);
+  *num_in_args = (mx_uint)arg_store.size();
+  *in_args = arg_store.data();
+  *arg_grads = nullptr;
+  *num_aux_states = (mx_uint)aux_store.size();
+  *aux_states = aux_store.data();
+  PyObject *ex = PyTuple_GetItem(r, 0);
+  Py_INCREF(ex);
+  *out = ex;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXExecutorBindX(SymbolHandle sym, int dev_type, int dev_id,
+                              mx_uint num_map_keys, const char **map_keys,
+                              const int *map_dev_types,
+                              const int *map_dev_ids, mx_uint len,
+                              NDArrayHandle *in_args,
+                              NDArrayHandle *arg_grad_store,
+                              mx_uint *grad_req_type, mx_uint aux_len,
+                              NDArrayHandle *aux_states,
+                              ExecutorHandle *out) {
+  (void)dev_type; (void)dev_id; (void)num_map_keys; (void)map_keys;
+  (void)map_dev_types; (void)map_dev_ids; (void)grad_req_type;
+  PREP;
+  // name-align positional arrays against the symbol's argument list
+  PyObject *a0 = Py_BuildValue("(O)", H(sym));
+  PyObject *arg_names_obj = call("symbol_list_arguments", a0);
+  Py_DECREF(a0);
+  if (!arg_names_obj) { set_error(py_error()); return -1; }
+  PyObject *aux0 = Py_BuildValue("(O)", H(sym));
+  PyObject *aux_names_obj = call("symbol_list_aux", aux0);
+  Py_DECREF(aux0);
+  if (!aux_names_obj) {
+    Py_DECREF(arg_names_obj);
+    set_error(py_error());
+    return -1;
+  }
+  PyObject *args_l = handle_list(in_args, len);
+  PyObject *grads_l = arg_grad_store ? handle_list(arg_grad_store, len)
+                                     : PyList_New(0);
+  PyObject *aux_l = handle_list(aux_states, aux_len);
+  PyObject *a = Py_BuildValue("(ONONONO)", H(sym), args_l, arg_names_obj,
+                              grads_l, arg_names_obj, aux_l,
+                              aux_names_obj);
+  // note: Py_BuildValue 'O' increfs arg_names_obj for each use
+  PyObject *r = call("executor_bind", a);
+  Py_DECREF(a);
+  Py_DECREF(arg_names_obj);
+  Py_DECREF(aux_names_obj);
+  return rh(r, out);
+}
+
+MXTPU_API int MXExecutorBindEX(SymbolHandle sym, int dev_type, int dev_id,
+                               mx_uint num_map_keys, const char **map_keys,
+                               const int *map_dev_types,
+                               const int *map_dev_ids, mx_uint len,
+                               NDArrayHandle *in_args,
+                               NDArrayHandle *arg_grad_store,
+                               mx_uint *grad_req_type, mx_uint aux_len,
+                               NDArrayHandle *aux_states,
+                               ExecutorHandle shared_exec,
+                               ExecutorHandle *out) {
+  (void)shared_exec;  // XLA owns cross-executor memory sharing
+  return MXExecutorBindX(sym, dev_type, dev_id, num_map_keys, map_keys,
+                         map_dev_types, map_dev_ids, len, in_args,
+                         arg_grad_store, grad_req_type, aux_len, aux_states,
+                         out);
+}
+
+MXTPU_API int MXExecutorBackwardEx(ExecutorHandle handle, mx_uint len,
+                                   NDArrayHandle *head_grads, int is_train) {
+  PREP;
+  PyObject *grads = len ? handle_list(head_grads, len)
+                        : (Py_INCREF(Py_None), Py_None);
+  PyObject *a = Py_BuildValue("(ONi)", H(handle), grads, is_train);
+  PyObject *r = call("executor_backward_ex", a); Py_DECREF(a);
+  return rv(r);
+}
+
+MXTPU_API int MXExecutorPrint(ExecutorHandle handle, const char **out_str) {
+  PREP;
+  PyObject *a = Py_BuildValue("(O)", H(handle));
+  PyObject *r = call("executor_print", a); Py_DECREF(a);
+  return rs(r, out_str);
+}
+
+MXTPU_API int MXExecutorGetOptimizedSymbol(ExecutorHandle handle,
+                                           SymbolHandle *out) {
+  PREP;
+  PyObject *a = Py_BuildValue("(O)", H(handle));
+  PyObject *r = call("executor_get_optimized_symbol", a); Py_DECREF(a);
+  return rh(r, out);
+}
+
+MXTPU_API int MXExecutorSetMonitorCallback(ExecutorHandle handle,
+                                           void (*callback)(const char *,
+                                                            NDArrayHandle,
+                                                            void *),
+                                           void *callback_handle) {
+  PREP;
+  PyObject *a = Py_BuildValue("(OKKi)", H(handle),
+                              (unsigned long long)(uintptr_t)callback,
+                              (unsigned long long)(uintptr_t)callback_handle,
+                              0);
+  PyObject *r = call("executor_set_monitor_callback", a); Py_DECREF(a);
+  return rv(r);
+}
+
+MXTPU_API int MXExecutorSetMonitorCallbackEX(ExecutorHandle handle,
+                                             void (*callback)(const char *,
+                                                              NDArrayHandle,
+                                                              void *),
+                                             void *callback_handle,
+                                             bool monitor_all) {
+  PREP;
+  PyObject *a = Py_BuildValue("(OKKi)", H(handle),
+                              (unsigned long long)(uintptr_t)callback,
+                              (unsigned long long)(uintptr_t)callback_handle,
+                              monitor_all ? 1 : 0);
+  PyObject *r = call("executor_set_monitor_callback", a); Py_DECREF(a);
+  return rv(r);
+}
+
+// ---------------------------------------------------------------------------
+// autograd depth + imperative Ex
+// ---------------------------------------------------------------------------
+
+MXTPU_API int MXAutogradIsRecording(bool *curr) {
+  PREP;
+  PyObject *r = call("autograd_is_recording", nullptr);
+  int v = 0;
+  if (ri(r, &v)) return -1;
+  *curr = v != 0;
+  return 0;
+}
+
+MXTPU_API int MXAutogradIsTraining(bool *curr) {
+  PREP;
+  PyObject *r = call("autograd_is_training", nullptr);
+  int v = 0;
+  if (ri(r, &v)) return -1;
+  *curr = v != 0;
+  return 0;
+}
+
+MXTPU_API int MXAutogradComputeGradient(mx_uint num_output,
+                                        NDArrayHandle *output_handles) {
+  PREP;
+  PyObject *outs = handle_list(output_handles, num_output);
+  PyObject *a = Py_BuildValue("(NOi)", outs, Py_None, 0);
+  PyObject *r = call("autograd_backward", a); Py_DECREF(a);
+  return rv(r);
+}
+
+MXTPU_API int MXAutogradBackwardEx(mx_uint num_output,
+                                   NDArrayHandle *output_handles,
+                                   NDArrayHandle *ograd_handles,
+                                   mx_uint num_variables,
+                                   NDArrayHandle *var_handles,
+                                   int retain_graph, int create_graph,
+                                   int is_train,
+                                   NDArrayHandle **grad_handles,
+                                   int **grad_stypes) {
+  PREP;
+  PyObject *outs = handle_list(output_handles, num_output);
+  PyObject *ograds = ograd_handles && ograd_handles[0]
+      ? handle_list(ograd_handles, num_output)
+      : (Py_INCREF(Py_None), Py_None);
+  PyObject *vars = num_variables
+      ? handle_list(var_handles, num_variables)
+      : (Py_INCREF(Py_None), Py_None);
+  PyObject *a = Py_BuildValue("(NNNiii)", outs, ograds, vars, retain_graph,
+                              create_graph, is_train);
+  PyObject *r = call("autograd_backward_ex", a); Py_DECREF(a);
+  if (!r) { set_error(py_error()); return -1; }
+  if (r == Py_None || !num_variables) {
+    Py_DECREF(r);
+    if (grad_handles) *grad_handles = nullptr;
+    if (grad_stypes) *grad_stypes = nullptr;
+    return 0;
+  }
+  mx_uint n = 0;
+  if (fill_handles(r, &n, grad_handles)) { Py_DECREF(r); return -1; }
+  Py_DECREF(r);
+  if (grad_stypes) {
+    const int *st = nullptr;
+    if (fill_stypes(*grad_handles, (int)n, &st)) return -1;
+    *grad_stypes = const_cast<int *>(st);
+  }
+  return 0;
+}
+
+MXTPU_API int MXAutogradGetSymbol(NDArrayHandle handle, SymbolHandle *out) {
+  PREP;
+  PyObject *a = Py_BuildValue("(O)", H(handle));
+  PyObject *r = call("autograd_get_symbol", a); Py_DECREF(a);
+  return rh(r, out);
+}
+
+
+MXTPU_API int MXImperativeInvokeEx(const char *op_name, int num_inputs,
+                                   NDArrayHandle *inputs, int *num_outputs,
+                                   NDArrayHandle **outputs, int num_params,
+                                   const char **param_keys,
+                                   const char **param_vals,
+                                   const int **out_stypes) {
+  if (MXImperativeInvoke(op_name, num_inputs, inputs, num_outputs, outputs,
+                         num_params, param_keys, param_vals))
+    return -1;
+  ScopedGIL gil;
+  return fill_stypes(*outputs, *num_outputs, out_stypes);
+}
+
+// ---------------------------------------------------------------------------
+// kvstore depth
+// ---------------------------------------------------------------------------
+
+namespace {
+// int-keyed wrappers: stringify keys into TLS storage and reuse kv_op
+int kv_int_op(const char *fn, KVStoreHandle kv, mx_uint num,
+              const int *keys, NDArrayHandle *vals) {
+  thread_local std::vector<std::string> key_strs;
+  thread_local std::vector<const char *> key_ptrs;
+  key_strs.clear();
+  key_ptrs.clear();
+  for (mx_uint i = 0; i < num; ++i)
+    key_strs.push_back(std::to_string(keys[i]));
+  for (auto &s : key_strs) key_ptrs.push_back(s.c_str());
+  return kv_op(fn, kv, num, key_ptrs.data(), vals);
+}
+}  // namespace
+
+MXTPU_API int MXKVStoreInit(KVStoreHandle kv, mx_uint num, const int *keys,
+                            NDArrayHandle *vals) {
+  return kv_int_op("kvstore_init", kv, num, keys, vals);
+}
+
+MXTPU_API int MXKVStorePush(KVStoreHandle kv, mx_uint num, const int *keys,
+                            NDArrayHandle *vals, int priority) {
+  (void)priority;
+  return kv_int_op("kvstore_push", kv, num, keys, vals);
+}
+
+MXTPU_API int MXKVStorePull(KVStoreHandle kv, mx_uint num, const int *keys,
+                            NDArrayHandle *outs, int priority) {
+  (void)priority;
+  return kv_int_op("kvstore_pull", kv, num, keys, outs);
+}
+
+static int kv_pull_sparse(KVStoreHandle kv, mx_uint num, PyObject *keys,
+                          NDArrayHandle *vals, int ignore_sparse) {
+  ScopedGIL gil;
+  PyObject *v = handle_list(vals, num);
+  PyObject *a = Py_BuildValue("(ONNi)", H(kv), keys, v, ignore_sparse);
+  PyObject *r = call("kvstore_pull_with_sparse", a); Py_DECREF(a);
+  return rv(r);
+}
+
+MXTPU_API int MXKVStorePullWithSparse(KVStoreHandle kv, mx_uint num,
+                                      const int *keys, NDArrayHandle *vals,
+                                      int priority, bool ignore_sparse) {
+  (void)priority;
+  ScopedGIL gil;
+  PyObject *k = PyList_New(num);
+  for (mx_uint i = 0; i < num; ++i)
+    PyList_SetItem(k, i, PyUnicode_FromString(
+        std::to_string(keys[i]).c_str()));
+  return kv_pull_sparse(kv, num, k, vals, ignore_sparse ? 1 : 0);
+}
+
+MXTPU_API int MXKVStorePullWithSparseEx(KVStoreHandle kv, mx_uint num,
+                                        const char **keys,
+                                        NDArrayHandle *vals, int priority,
+                                        bool ignore_sparse) {
+  (void)priority;
+  ScopedGIL gil;
+  return kv_pull_sparse(kv, num, str_list(keys, num), vals,
+                        ignore_sparse ? 1 : 0);
+}
+
+static int kv_pull_rsp(KVStoreHandle kv, mx_uint num, PyObject *keys,
+                       NDArrayHandle *vals, const NDArrayHandle *row_ids) {
+  ScopedGIL gil;
+  PyObject *v = handle_list(vals, num);
+  PyObject *r_ids = handle_list(const_cast<NDArrayHandle *>(row_ids), num);
+  PyObject *a = Py_BuildValue("(ONNN)", H(kv), keys, v, r_ids);
+  PyObject *r = call("kvstore_pull_row_sparse", a); Py_DECREF(a);
+  return rv(r);
+}
+
+MXTPU_API int MXKVStorePullRowSparse(KVStoreHandle kv, mx_uint num,
+                                     const int *keys, NDArrayHandle *vals,
+                                     const NDArrayHandle *row_ids,
+                                     int priority) {
+  (void)priority;
+  ScopedGIL gil;
+  PyObject *k = PyList_New(num);
+  for (mx_uint i = 0; i < num; ++i)
+    PyList_SetItem(k, i, PyUnicode_FromString(
+        std::to_string(keys[i]).c_str()));
+  return kv_pull_rsp(kv, num, k, vals, row_ids);
+}
+
+MXTPU_API int MXKVStorePullRowSparseEx(KVStoreHandle kv, mx_uint num,
+                                       const char **keys,
+                                       NDArrayHandle *vals,
+                                       const NDArrayHandle *row_ids,
+                                       int priority) {
+  (void)priority;
+  ScopedGIL gil;
+  return kv_pull_rsp(kv, num, str_list(keys, num), vals, row_ids);
+}
+
+MXTPU_API int MXKVStoreSetUpdater(KVStoreHandle kv,
+                                  void (*updater)(int, NDArrayHandle,
+                                                  NDArrayHandle, void *),
+                                  void *updater_handle) {
+  PREP;
+  PyObject *a = Py_BuildValue("(OKK)", H(kv),
+                              (unsigned long long)(uintptr_t)updater,
+                              (unsigned long long)(uintptr_t)updater_handle);
+  PyObject *r = call("kvstore_set_updater", a); Py_DECREF(a);
+  return rv(r);
+}
+
+MXTPU_API int MXKVStoreSetUpdaterEx(KVStoreHandle kv,
+                                    void (*updater)(int, NDArrayHandle,
+                                                    NDArrayHandle, void *),
+                                    void (*str_updater)(const char *,
+                                                        NDArrayHandle,
+                                                        NDArrayHandle,
+                                                        void *),
+                                    void *updater_handle) {
+  (void)updater;
+  PREP;
+  PyObject *a = Py_BuildValue("(OKK)", H(kv),
+                              (unsigned long long)(uintptr_t)str_updater,
+                              (unsigned long long)(uintptr_t)updater_handle);
+  PyObject *r = call("kvstore_set_updater_str", a); Py_DECREF(a);
+  return rv(r);
+}
+
+MXTPU_API int MXKVStoreBarrier(KVStoreHandle kv) {
+  PREP;
+  PyObject *a = Py_BuildValue("(O)", H(kv));
+  PyObject *r = call("kvstore_barrier", a); Py_DECREF(a);
+  return rv(r);
+}
+
+MXTPU_API int MXKVStoreGetType(KVStoreHandle kv, const char **type) {
+  PREP;
+  PyObject *a = Py_BuildValue("(O)", H(kv));
+  PyObject *r = call("kvstore_get_type", a); Py_DECREF(a);
+  return rs(r, type);
+}
+
+static int kv_role(int which, int *ret) {
+  PREP;
+  PyObject *r = call("kvstore_role_flags", nullptr);
+  if (!r) { set_error(py_error()); return -1; }
+  *ret = (int)PyLong_AsLong(PyTuple_GetItem(r, which));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXKVStoreIsWorkerNode(int *ret) { return kv_role(0, ret); }
+MXTPU_API int MXKVStoreIsServerNode(int *ret) { return kv_role(1, ret); }
+MXTPU_API int MXKVStoreIsSchedulerNode(int *ret) { return kv_role(2, ret); }
+
+MXTPU_API int MXKVStoreRunServer(KVStoreHandle kv,
+                                 void (*controller)(int, const char *,
+                                                    void *),
+                                 void *controller_handle) {
+  (void)controller; (void)controller_handle;
+  PREP;
+  PyObject *a = Py_BuildValue("(O)", H(kv));
+  PyObject *r = call("kvstore_run_server", a); Py_DECREF(a);
+  return rv(r);
+}
+
+MXTPU_API int MXKVStoreSendCommmandToServers(KVStoreHandle kv, int cmd_id,
+                                             const char *cmd_body) {
+  PREP;
+  PyObject *a = Py_BuildValue("(Ois)", H(kv), cmd_id, cmd_body);
+  PyObject *r = call("kvstore_send_command", a); Py_DECREF(a);
+  return rv(r);
+}
+
+MXTPU_API int MXKVStoreGetNumDeadNode(KVStoreHandle kv, const int node_id,
+                                      int *number, const int timeout_sec) {
+  (void)timeout_sec;
+  PREP;
+  PyObject *a = Py_BuildValue("(Oi)", H(kv), node_id);
+  PyObject *r = call("kvstore_get_num_dead_node", a); Py_DECREF(a);
+  return ri(r, number);
+}
+
+MXTPU_API int MXKVStoreSetBarrierBeforeExit(KVStoreHandle kv,
+                                            const int barrier_before_exit) {
+  PREP;
+  PyObject *a = Py_BuildValue("(Oi)", H(kv), barrier_before_exit);
+  PyObject *r = call("kvstore_set_barrier_before_exit", a); Py_DECREF(a);
+  return rv(r);
+}
+
+MXTPU_API int MXKVStoreSetGradientCompression(KVStoreHandle kv,
+                                              mx_uint num_params,
+                                              const char **keys,
+                                              const char **vals) {
+  PREP;
+  PyObject *k = str_list(keys, num_params);
+  PyObject *v = str_list(vals, num_params);
+  PyObject *a = Py_BuildValue("(ONN)", H(kv), k, v);
+  PyObject *r = call("kvstore_set_gradient_compression", a); Py_DECREF(a);
+  return rv(r);
+}
+
+MXTPU_API int MXInitPSEnv(mx_uint num_vars, const char **keys,
+                          const char **vals) {
+  PREP;
+  PyObject *k = str_list(keys, num_vars);
+  PyObject *v = str_list(vals, num_vars);
+  PyObject *a = Py_BuildValue("(NN)", k, v);
+  PyObject *r = call("init_ps_env", a); Py_DECREF(a);
+  return rv(r);
+}
+
+// ---------------------------------------------------------------------------
+// misc + legacy Function API + quantization + RTC
+// ---------------------------------------------------------------------------
+
+MXTPU_API int MXGetGPUCount(int *out) {
+  PREP;
+  PyObject *r = call("get_gpu_count", nullptr);
+  return ri(r, out);
+}
+
+MXTPU_API int MXGetGPUMemoryInformation64(int dev, uint64_t *free_mem,
+                                          uint64_t *total_mem) {
+  PREP;
+  PyObject *a = Py_BuildValue("(i)", dev);
+  PyObject *r = call("get_gpu_memory_info", a); Py_DECREF(a);
+  if (!r) { set_error(py_error()); return -1; }
+  *free_mem = (uint64_t)PyLong_AsUnsignedLongLong(PyTuple_GetItem(r, 0));
+  *total_mem = (uint64_t)PyLong_AsUnsignedLongLong(PyTuple_GetItem(r, 1));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXGetGPUMemoryInformation(int dev, int *free_mem,
+                                        int *total_mem) {
+  uint64_t f = 0, t = 0;
+  if (MXGetGPUMemoryInformation64(dev, &f, &t)) return -1;
+  *free_mem = (int)(f >> 20);   // MiB, like the reference's int variant
+  *total_mem = (int)(t >> 20);
+  return 0;
+}
+
+MXTPU_API int MXSetNumOMPThreads(int thread_num) {
+  PREP;
+  PyObject *a = Py_BuildValue("(i)", thread_num);
+  PyObject *r = call("set_num_omp_threads", a); Py_DECREF(a);
+  return rv(r);
+}
+
+MXTPU_API int MXEngineSetBulkSize(int bulk_size, int *prev_bulk_size) {
+  PREP;
+  PyObject *a = Py_BuildValue("(i)", bulk_size);
+  PyObject *r = call("engine_set_bulk_size", a); Py_DECREF(a);
+  return ri(r, prev_bulk_size);
+}
+
+MXTPU_API int MXNotifyShutdown() {
+  PREP;
+  PyObject *r = call("notify_shutdown", nullptr);
+  return rv(r);
+}
+
+struct LibFeature {
+  const char *name;
+  bool enabled;
+};
+
+MXTPU_API int MXLibInfoFeatures(const struct LibFeature **lib_features,
+                                size_t *size) {
+  PREP;
+  PyObject *r = call("libinfo_features", nullptr);
+  if (!r) { set_error(py_error()); return -1; }
+  thread_local std::vector<std::string> names;
+  thread_local std::vector<LibFeature> feats;
+  Py_ssize_t n = PyList_Size(r);
+  names.clear();
+  feats.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *t = PyList_GetItem(r, i);
+    const char *c = PyUnicode_AsUTF8(PyTuple_GetItem(t, 0));
+    names.emplace_back(c ? c : "");
+  }
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *t = PyList_GetItem(r, i);
+    feats.push_back({names[i].c_str(),
+                     PyLong_AsLong(PyTuple_GetItem(t, 1)) != 0});
+  }
+  Py_DECREF(r);
+  *lib_features = feats.data();
+  *size = (size_t)n;
+  return 0;
+}
+
+MXTPU_API int MXRandomSeedContext(int seed, int dev_type, int dev_id) {
+  PREP;
+  PyObject *a = Py_BuildValue("(iii)", seed, dev_type, dev_id);
+  PyObject *r = call("random_seed_context", a); Py_DECREF(a);
+  return rv(r);
+}
+
+MXTPU_API int MXGenBackendSubgraph(SymbolHandle sym, const char *backend,
+                                   SymbolHandle *out) {
+  PREP;
+  PyObject *a = Py_BuildValue("(Os)", H(sym), backend);
+  PyObject *r = call("gen_backend_subgraph", a); Py_DECREF(a);
+  return rh(r, out);
+}
+
+MXTPU_API int MXListFunctions(mx_uint *out_size, void ***out_array) {
+  PREP;
+  PyObject *r = call("list_functions", nullptr);
+  if (!r) { set_error(py_error()); return -1; }
+  Py_ssize_t n = PyList_Size(r);
+  g_handle_store.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *o = PyList_GetItem(r, i);
+    Py_INCREF(o);
+    g_handle_store.push_back(o);  // FunctionHandle = op-name string
+  }
+  Py_DECREF(r);
+  *out_size = (mx_uint)n;
+  *out_array = g_handle_store.data();
+  return 0;
+}
+
+MXTPU_API int MXGetFunction(const char *name, void **out) {
+  ScopedGIL gil;
+  *out = PyUnicode_FromString(name);
+  return 0;
+}
+
+MXTPU_API int MXFuncGetInfo(void *fun, const char **name,
+                            const char **description, mx_uint *num_args,
+                            const char ***arg_names,
+                            const char ***arg_type_infos,
+                            const char ***arg_descriptions,
+                            const char ***return_type) {
+  PREP;
+  PyObject *a = Py_BuildValue("(O)", H(fun));
+  PyObject *r = call("func_get_info", a); Py_DECREF(a);
+  if (!r) { set_error(py_error()); return -1; }
+  thread_local std::string s_name, s_desc;
+  const char *c = PyUnicode_AsUTF8(PyTuple_GetItem(r, 0));
+  s_name = c ? c : "";
+  c = PyUnicode_AsUTF8(PyTuple_GetItem(r, 1));
+  s_desc = c ? c : "";
+  Py_DECREF(r);
+  *name = s_name.c_str();
+  *description = s_desc.c_str();
+  *num_args = 0;
+  *arg_names = nullptr;
+  *arg_type_infos = nullptr;
+  *arg_descriptions = nullptr;
+  if (return_type) *return_type = nullptr;
+  return 0;
+}
+
+MXTPU_API int MXFuncDescribe(void *fun, mx_uint *num_use_vars,
+                             mx_uint *num_scalars, mx_uint *num_mutate_vars,
+                             int *type_mask) {
+  PREP;
+  PyObject *a = Py_BuildValue("(O)", H(fun));
+  PyObject *r = call("func_describe", a); Py_DECREF(a);
+  if (!r) { set_error(py_error()); return -1; }
+  *num_use_vars = (mx_uint)PyLong_AsLong(PyTuple_GetItem(r, 0));
+  *num_scalars = (mx_uint)PyLong_AsLong(PyTuple_GetItem(r, 1));
+  *num_mutate_vars = (mx_uint)PyLong_AsLong(PyTuple_GetItem(r, 2));
+  *type_mask = (int)PyLong_AsLong(PyTuple_GetItem(r, 3));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXFuncInvoke(void *fun, NDArrayHandle *use_vars,
+                           mx_float *scalar_args,
+                           NDArrayHandle *mutate_vars) {
+  PREP;
+  mx_uint n_use = 0, n_scalar = 0, n_mut = 0;
+  int mask = 0;
+  if (MXFuncDescribe(fun, &n_use, &n_scalar, &n_mut, &mask)) return -1;
+  (void)scalar_args;
+  PyObject *use = handle_list(use_vars, n_use);
+  PyObject *scal = PyList_New(0);
+  PyObject *mut = handle_list(mutate_vars, n_mut);
+  PyObject *a = Py_BuildValue("(ONNN)", H(fun), use, scal, mut);
+  PyObject *r = call("func_invoke", a); Py_DECREF(a);
+  return rv(r);
+}
+
+MXTPU_API int MXFuncInvokeEx(void *fun, NDArrayHandle *use_vars,
+                             mx_float *scalar_args,
+                             NDArrayHandle *mutate_vars, int num_params,
+                             char **param_keys, char **param_vals) {
+  (void)num_params; (void)param_keys; (void)param_vals;
+  return MXFuncInvoke(fun, use_vars, scalar_args, mutate_vars);
+}
+
+MXTPU_API int MXQuantizeSymbol(SymbolHandle sym, SymbolHandle *ret_sym,
+                               const mx_uint num_excluded,
+                               const char **excluded_op_names,
+                               const mx_uint num_offline,
+                               const char **offline_params,
+                               const char *quantized_dtype,
+                               const bool calib_quantize) {
+  (void)calib_quantize;
+  PREP;
+  PyObject *ex = str_list(excluded_op_names, num_excluded);
+  PyObject *off = str_list(offline_params, num_offline);
+  PyObject *a = Py_BuildValue("(ONNs)", H(sym), ex, off, quantized_dtype);
+  PyObject *r = call("quantize_symbol", a); Py_DECREF(a);
+  return rh(r, ret_sym);
+}
+
+MXTPU_API int MXSetCalibTableToQuantizedSymbol(
+    SymbolHandle qsym, const mx_uint num_layers, const char **layer_names,
+    const float *low_quantiles, const float *high_quantiles,
+    SymbolHandle *ret_sym) {
+  PREP;
+  PyObject *names = str_list(layer_names, num_layers);
+  PyObject *lows = PyList_New(num_layers);
+  PyObject *highs = PyList_New(num_layers);
+  for (mx_uint i = 0; i < num_layers; ++i) {
+    PyList_SetItem(lows, i, PyFloat_FromDouble(low_quantiles[i]));
+    PyList_SetItem(highs, i, PyFloat_FromDouble(high_quantiles[i]));
+  }
+  PyObject *a = Py_BuildValue("(ONNN)", H(qsym), names, lows, highs);
+  PyObject *r = call("set_calib_table", a); Py_DECREF(a);
+  return rh(r, ret_sym);
+}
+
+// RTC: CUDA-source runtime compilation has no TPU backend; these report
+// the same build-feature error a non-CUDA reference build raises, and
+// MXRtcCudaModuleCreate routes to mx.rtc (PallasModule is the supported
+// runtime-compile path).
+
+static int rtc_unsupported() {
+  PREP;
+  PyObject *r = call("rtc_legacy", PyTuple_New(0));
+  return rv(r);  // always raises with the guidance message
+}
+
+MXTPU_API int MXRtcCreate(char *name, mx_uint num_input, mx_uint num_output,
+                          char **input_names, char **output_names,
+                          NDArrayHandle *inputs, NDArrayHandle *outputs,
+                          char *kernel, void **out) {
+  (void)name; (void)num_input; (void)num_output; (void)input_names;
+  (void)output_names; (void)inputs; (void)outputs; (void)kernel; (void)out;
+  return rtc_unsupported();
+}
+
+MXTPU_API int MXRtcPush(void *handle, mx_uint num_input, mx_uint num_output,
+                        NDArrayHandle *inputs, NDArrayHandle *outputs,
+                        mx_uint gridDimX, mx_uint gridDimY, mx_uint gridDimZ,
+                        mx_uint blockDimX, mx_uint blockDimY,
+                        mx_uint blockDimZ) {
+  (void)handle; (void)num_input; (void)num_output; (void)inputs;
+  (void)outputs; (void)gridDimX; (void)gridDimY; (void)gridDimZ;
+  (void)blockDimX; (void)blockDimY; (void)blockDimZ;
+  return rtc_unsupported();
+}
+
+MXTPU_API int MXRtcFree(void *handle) {
+  if (handle) { ScopedGIL gil; Py_DECREF(H(handle)); }
+  return 0;
+}
+
+MXTPU_API int MXRtcCudaModuleCreate(const char *source, int num_options,
+                                    const char **options, int num_exports,
+                                    const char **exports, void **out) {
+  PREP;
+  PyObject *opt = str_list(options, num_options);
+  PyObject *exp = str_list(exports, num_exports);
+  PyObject *a = Py_BuildValue("(sNN)", source, opt, exp);
+  PyObject *r = call("rtc_cuda_module_create", a); Py_DECREF(a);
+  return rh(r, out);  // raises: CUDA RTC unavailable, use PallasModule
+}
+
+MXTPU_API int MXRtcCudaModuleFree(void *handle) {
+  if (handle) { ScopedGIL gil; Py_DECREF(H(handle)); }
+  return 0;
+}
+
+MXTPU_API int MXRtcCudaKernelCreate(void *handle, const char *name,
+                                    int num_args, int *is_ndarray,
+                                    int *is_const, int *arg_types,
+                                    void **out) {
+  (void)handle; (void)name; (void)num_args; (void)is_ndarray;
+  (void)is_const; (void)arg_types; (void)out;
+  return rtc_unsupported();
+}
+
+MXTPU_API int MXRtcCudaKernelFree(void *handle) {
+  if (handle) { ScopedGIL gil; Py_DECREF(H(handle)); }
+  return 0;
+}
+
+MXTPU_API int MXRtcCudaKernelCall(void *handle, int dev_id, void **args,
+                                  mx_uint grid_dim_x, mx_uint grid_dim_y,
+                                  mx_uint grid_dim_z, mx_uint block_dim_x,
+                                  mx_uint block_dim_y, mx_uint block_dim_z,
+                                  mx_uint shared_mem) {
+  (void)handle; (void)dev_id; (void)args; (void)grid_dim_x;
+  (void)grid_dim_y; (void)grid_dim_z; (void)block_dim_x;
+  (void)block_dim_y; (void)block_dim_z; (void)shared_mem;
+  return rtc_unsupported();
+}
+
+MXTPU_API int MXSymbolGetInputSymbols(SymbolHandle sym,
+                                      SymbolHandle **input_symbols,
+                                      int *input_size) {
+  PREP;
+  PyObject *a = Py_BuildValue("(O)", H(sym));
+  PyObject *r = call("symbol_get_input_symbols", a); Py_DECREF(a);
+  mx_uint n = 0;
+  if (rhl(r, &n, reinterpret_cast<NDArrayHandle **>(input_symbols)))
+    return -1;
+  *input_size = (int)n;
   return 0;
 }

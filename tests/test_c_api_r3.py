@@ -1,0 +1,590 @@
+"""Round-3 C API families driven through ctypes, the way a language
+binding would (ref: include/mxnet/c_api.h families that were absent in
+round 2: symbol depth, DataIter, RecordIO, profiler, CachedOp, sparse
+NDArray, SimpleBind/monitor, kvstore updater/row-sparse, misc)."""
+import ctypes
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx  # noqa: F401  (interpreter owns jax first)
+
+from test_c_api import lib, _check, _make_nd, _to_np, _vp, u, cp  # noqa: F401
+
+sz = ctypes.c_size_t
+
+
+def _make_sym(lib, op=b"relu"):
+    """data -> relu(data) symbol via the C API."""
+    var = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolCreateVariable(b"data", ctypes.byref(var)))
+    out = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolCreateAtomicSymbol(op, u(0), None, None,
+                                               ctypes.byref(out)))
+    args = (ctypes.c_void_p * 1)(var)
+    _check(lib, lib.MXSymbolCompose(out, b"act0", u(1), None, args))
+    return out
+
+
+# -- symbol depth ----------------------------------------------------------
+
+def test_symbol_copy_print_name(lib):
+    s = _make_sym(lib)
+    c = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolCopy(s, ctypes.byref(c)))
+    out = ctypes.c_char_p()
+    _check(lib, lib.MXSymbolPrint(c, ctypes.byref(out)))
+    assert b"act0" in out.value
+    name = ctypes.c_char_p()
+    ok = ctypes.c_int()
+    _check(lib, lib.MXSymbolGetName(c, ctypes.byref(name), ctypes.byref(ok)))
+    assert ok.value == 1 and name.value == b"act0"
+
+
+def test_symbol_attr_roundtrip(lib):
+    s = _make_sym(lib)
+    _check(lib, lib.MXSymbolSetAttr(s, b"lr_mult", b"2.0"))
+    val = ctypes.c_char_p()
+    ok = ctypes.c_int()
+    _check(lib, lib.MXSymbolGetAttr(s, b"lr_mult", ctypes.byref(val),
+                                    ctypes.byref(ok)))
+    assert ok.value == 1 and val.value == b"2.0"
+    n = u()
+    pairs = cp(ctypes.c_char_p)()
+    _check(lib, lib.MXSymbolListAttrShallow(s, ctypes.byref(n),
+                                            ctypes.byref(pairs)))
+    flat = [pairs[i] for i in range(n.value * 2)]
+    assert b"lr_mult" in flat and b"2.0" in flat
+
+
+def test_symbol_file_roundtrip(lib, tmp_path):
+    s = _make_sym(lib)
+    path = str(tmp_path / "sym.json").encode()
+    _check(lib, lib.MXSymbolSaveToFile(s, path))
+    loaded = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolCreateFromFile(path, ctypes.byref(loaded)))
+    n = u()
+    names = cp(ctypes.c_char_p)()
+    _check(lib, lib.MXSymbolListArguments(loaded, ctypes.byref(n),
+                                          ctypes.byref(names)))
+    assert [names[i] for i in range(n.value)] == [b"data"]
+
+
+def test_symbol_internals_outputs_children(lib):
+    s = _make_sym(lib)
+    nout = u()
+    _check(lib, lib.MXSymbolGetNumOutputs(s, ctypes.byref(nout)))
+    assert nout.value == 1
+    internals = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolGetInternals(s, ctypes.byref(internals)))
+    out0 = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolGetOutput(s, u(0), ctypes.byref(out0)))
+    kids = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolGetChildren(s, ctypes.byref(kids)))
+    inputs = cp(ctypes.c_void_p)()
+    n_in = ctypes.c_int()
+    _check(lib, lib.MXSymbolGetInputSymbols(s, ctypes.byref(inputs),
+                                            ctypes.byref(n_in)))
+    assert n_in.value == 1
+
+
+def test_symbol_infer_type(lib):
+    s = _make_sym(lib)
+    keys = (ctypes.c_char_p * 1)(b"data")
+    types = (ctypes.c_int * 1)(0)  # float32
+    n_in, n_out, n_aux = u(), u(), u()
+    t_in, t_out, t_aux = cp(ctypes.c_int)(), cp(ctypes.c_int)(), \
+        cp(ctypes.c_int)()
+    complete = ctypes.c_int()
+    _check(lib, lib.MXSymbolInferType(s, u(1), keys, types,
+                                      ctypes.byref(n_in), ctypes.byref(t_in),
+                                      ctypes.byref(n_out),
+                                      ctypes.byref(t_out),
+                                      ctypes.byref(n_aux),
+                                      ctypes.byref(t_aux),
+                                      ctypes.byref(complete)))
+    assert complete.value == 1 and t_out[0] == 0
+
+
+def test_symbol_creators_listing(lib):
+    n = u()
+    creators = cp(ctypes.c_void_p)()
+    _check(lib, lib.MXSymbolListAtomicSymbolCreators(ctypes.byref(n),
+                                                     ctypes.byref(creators)))
+    assert n.value > 400
+    name = ctypes.c_char_p()
+    _check(lib, lib.MXSymbolGetAtomicSymbolName(_vp(creators[0]),
+                                                ctypes.byref(name)))
+    assert len(name.value) > 0
+
+
+def test_symbol_grad_errors_like_reference(lib):
+    s = _make_sym(lib)
+    wrt = (ctypes.c_char_p * 1)(b"data")
+    out = ctypes.c_void_p()
+    rc = lib.MXSymbolGrad(s, u(1), wrt, ctypes.byref(out))
+    assert rc != 0
+    assert b"not implemented" in lib.MXGetLastError()
+
+
+# -- DataIter --------------------------------------------------------------
+
+def test_data_iter_family(lib, tmp_path):
+    csv = tmp_path / "d.csv"
+    data = np.arange(24, dtype=np.float32).reshape(8, 3)
+    np.savetxt(csv, data, delimiter=",", fmt="%.1f")
+    n = u()
+    creators = cp(ctypes.c_void_p)()
+    _check(lib, lib.MXListDataIters(ctypes.byref(n), ctypes.byref(creators)))
+    names = {}
+    for i in range(n.value):
+        nm = ctypes.c_char_p()
+        _check(lib, lib.MXSymbolGetAtomicSymbolName(_vp(creators[i]),
+                                                    ctypes.byref(nm)))
+        names[nm.value] = _vp(creators[i])
+    assert b"CSVIter" in names
+    # creator info
+    nm, desc = ctypes.c_char_p(), ctypes.c_char_p()
+    n_args = u()
+    a_names, a_types, a_descs = (cp(ctypes.c_char_p)() for _ in range(3))
+    _check(lib, lib.MXDataIterGetIterInfo(
+        names[b"CSVIter"], ctypes.byref(nm), ctypes.byref(desc),
+        ctypes.byref(n_args), ctypes.byref(a_names), ctypes.byref(a_types),
+        ctypes.byref(a_descs)))
+    assert nm.value == b"CSVIter"
+    # create + iterate
+    keys = (ctypes.c_char_p * 3)(b"data_csv", b"data_shape", b"batch_size")
+    vals = (ctypes.c_char_p * 3)(str(csv).encode(), b"(3,)", b"4")
+    it = ctypes.c_void_p()
+    _check(lib, lib.MXDataIterCreateIter(names[b"CSVIter"], u(3), keys, vals,
+                                         ctypes.byref(it)))
+    _check(lib, lib.MXDataIterBeforeFirst(it))
+    seen = 0
+    has = ctypes.c_int(1)
+    while True:
+        _check(lib, lib.MXDataIterNext(it, ctypes.byref(has)))
+        if not has.value:
+            break
+        batch = ctypes.c_void_p()
+        _check(lib, lib.MXDataIterGetData(it, ctypes.byref(batch)))
+        arr = _to_np(lib, batch)
+        assert arr.shape == (4, 3)
+        pad = ctypes.c_int()
+        _check(lib, lib.MXDataIterGetPadNum(it, ctypes.byref(pad)))
+        assert pad.value == 0
+        seen += 1
+    assert seen == 2
+    _check(lib, lib.MXDataIterFree(it))
+
+
+# -- RecordIO --------------------------------------------------------------
+
+def test_recordio_roundtrip(lib, tmp_path):
+    path = str(tmp_path / "t.rec").encode()
+    w = ctypes.c_void_p()
+    _check(lib, lib.MXRecordIOWriterCreate(path, ctypes.byref(w)))
+    records = [b"hello", b"tpu" * 100, b"z"]
+    for rec in records:
+        _check(lib, lib.MXRecordIOWriterWriteRecord(w, rec, sz(len(rec))))
+    pos = sz()
+    _check(lib, lib.MXRecordIOWriterTell(w, ctypes.byref(pos)))
+    assert pos.value > 0
+    _check(lib, lib.MXRecordIOWriterFree(w))
+
+    r = ctypes.c_void_p()
+    _check(lib, lib.MXRecordIOReaderCreate(path, ctypes.byref(r)))
+    got = []
+    while True:
+        buf = ctypes.c_char_p()
+        size = sz()
+        _check(lib, lib.MXRecordIOReaderReadRecord(r, ctypes.byref(buf),
+                                                   ctypes.byref(size)))
+        if not buf.value and size.value == 0:
+            break
+        got.append(ctypes.string_at(buf, size.value))
+    assert got == records
+    # seek back to start and re-read first record
+    _check(lib, lib.MXRecordIOReaderSeek(r, sz(0)))
+    buf = ctypes.c_char_p()
+    size = sz()
+    _check(lib, lib.MXRecordIOReaderReadRecord(r, ctypes.byref(buf),
+                                               ctypes.byref(size)))
+    assert ctypes.string_at(buf, size.value) == records[0]
+    _check(lib, lib.MXRecordIOReaderFree(r))
+
+
+# -- profiler --------------------------------------------------------------
+
+def test_profiler_family(lib, tmp_path):
+    fname = str(tmp_path / "prof.json").encode()
+    keys = (ctypes.c_char_p * 2)(b"filename", b"aggregate_stats")
+    vals = (ctypes.c_char_p * 2)(fname, b"True")
+    _check(lib, lib.MXSetProfilerConfig(ctypes.c_int(2), keys, vals))
+    _check(lib, lib.MXSetProfilerState(ctypes.c_int(1)))
+    dom = ctypes.c_void_p()
+    _check(lib, lib.MXProfileCreateDomain(b"test", ctypes.byref(dom)))
+    task = ctypes.c_void_p()
+    _check(lib, lib.MXProfileCreateTask(dom, b"step", ctypes.byref(task)))
+    _check(lib, lib.MXProfileDurationStart(task))
+    _check(lib, lib.MXProfileDurationStop(task))
+    ctr = ctypes.c_void_p()
+    _check(lib, lib.MXProfileCreateCounter(dom, b"items", ctypes.byref(ctr)))
+    _check(lib, lib.MXProfileSetCounter(ctr, ctypes.c_uint64(5)))
+    _check(lib, lib.MXProfileAdjustCounter(ctr, ctypes.c_int64(-2)))
+    _check(lib, lib.MXProfileSetMarker(dom, b"mark", b"process"))
+    out = ctypes.c_char_p()
+    _check(lib, lib.MXAggregateProfileStatsPrint(ctypes.byref(out),
+                                                 ctypes.c_int(0)))
+    assert b"step" in out.value or b"test" in out.value
+    _check(lib, lib.MXSetProfilerState(ctypes.c_int(0)))
+    _check(lib, lib.MXProfileDestroyHandle(task))
+    _check(lib, lib.MXProfileDestroyHandle(ctr))
+    _check(lib, lib.MXProfileDestroyHandle(dom))
+
+
+# -- CachedOp --------------------------------------------------------------
+
+def test_cached_op_invoke(lib):
+    s = _make_sym(lib)
+    op = ctypes.c_void_p()
+    _check(lib, lib.MXCreateCachedOp(s, ctypes.byref(op)))
+    x = np.array([[-1.0, 2.0], [3.0, -4.0]], np.float32)
+    h = _make_nd(lib, x)
+    ins = (ctypes.c_void_p * 1)(h)
+    n_out = ctypes.c_int(0)
+    outs = cp(ctypes.c_void_p)()
+    _check(lib, lib.MXInvokeCachedOp(op, ctypes.c_int(1), ins,
+                                     ctypes.byref(n_out),
+                                     ctypes.byref(outs)))
+    assert n_out.value == 1
+    np.testing.assert_allclose(_to_np(lib, outs[0]), np.maximum(x, 0))
+    # second invoke reuses the bound executor (same shapes)
+    _check(lib, lib.MXInvokeCachedOp(op, ctypes.c_int(1), ins,
+                                     ctypes.byref(n_out),
+                                     ctypes.byref(outs)))
+    stypes = cp(ctypes.c_int)()
+    _check(lib, lib.MXInvokeCachedOpEx(op, ctypes.c_int(1), ins,
+                                       ctypes.byref(n_out),
+                                       ctypes.byref(outs),
+                                       ctypes.byref(stypes)))
+    assert stypes[0] == 0
+    _check(lib, lib.MXFreeCachedOp(op))
+
+
+# -- sparse NDArray --------------------------------------------------------
+
+def test_sparse_ndarray_family(lib):
+    h = ctypes.c_void_p()
+    shape = (u * 2)(6, 4)
+    _check(lib, lib.MXNDArrayCreateSparseEx(
+        ctypes.c_int(1), shape, u(2), 1, 0, 0, 0, u(1), None, None, None,
+        ctypes.byref(h)))  # row_sparse zeros
+    st = ctypes.c_int()
+    _check(lib, lib.MXNDArrayGetStorageType(h, ctypes.byref(st)))
+    assert st.value == 1
+    _check(lib, lib.MXNDArraySyncCheckFormat(h, ctypes.c_bool(True)))
+    data_h = ctypes.c_void_p()
+    _check(lib, lib.MXNDArrayGetDataNDArray(h, ctypes.byref(data_h)))
+    aux_h = ctypes.c_void_p()
+    _check(lib, lib.MXNDArrayGetAuxNDArray(h, u(0), ctypes.byref(aux_h)))
+    t = ctypes.c_int()
+    _check(lib, lib.MXNDArrayGetAuxType(h, u(0), ctypes.byref(t)))
+    assert t.value == 4  # int32 indices
+    # dense arrays report default storage
+    d = _make_nd(lib, np.ones((2, 2), np.float32))
+    _check(lib, lib.MXNDArrayGetStorageType(d, ctypes.byref(st)))
+    assert st.value == 0
+
+
+# -- executor depth --------------------------------------------------------
+
+def test_executor_simple_bind_and_monitor(lib):
+    s = _make_sym(lib)
+    shape_names = (ctypes.c_char_p * 1)(b"data")
+    shape_idx = (u * 2)(0, 2)
+    shape_data = (u * 2)(3, 4)
+    n_args, n_aux = u(), u()
+    in_args, arg_grads, aux = (cp(ctypes.c_void_p)() for _ in range(3))
+    shared_len = ctypes.c_int(-1)
+    upd_names = cp(ctypes.c_char_p)()
+    upd_handles = cp(ctypes.c_void_p)()
+    ex = ctypes.c_void_p()
+    _check(lib, lib.MXExecutorSimpleBind(
+        s, 1, 0, u(0), None, None, None,
+        u(0), None, None,
+        u(1), shape_names, shape_data, shape_idx,
+        u(0), None, None, u(0), None, None, u(0), None,
+        ctypes.byref(shared_len), None, None,
+        ctypes.byref(upd_names), ctypes.byref(upd_handles),
+        ctypes.byref(n_args), ctypes.byref(in_args),
+        ctypes.byref(arg_grads), ctypes.byref(n_aux), ctypes.byref(aux),
+        None, ctypes.byref(ex)))
+    assert n_args.value == 1
+    # write data, forward, check output via monitor callback
+    x = np.random.randn(3, 4).astype(np.float32)
+    _check(lib, lib.MXNDArraySyncCopyFromCPU(
+        _vp(in_args[0]), x.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_size_t(x.size)))
+    seen = []
+    CB = ctypes.CFUNCTYPE(None, ctypes.c_char_p, ctypes.c_void_p,
+                          ctypes.c_void_p)
+
+    def monitor(name, handle, _):
+        seen.append((name, _to_np(lib, ctypes.c_void_p(handle))))
+        lib.MXNDArrayFree(ctypes.c_void_p(handle))
+
+    cb = CB(monitor)
+    _check(lib, lib.MXExecutorSetMonitorCallback(ex, cb, None))
+    _check(lib, lib.MXExecutorForward(ex, ctypes.c_int(0)))
+    n_out = u()
+    outs = cp(ctypes.c_void_p)()
+    _check(lib, lib.MXExecutorOutputs(ex, ctypes.byref(n_out),
+                                      ctypes.byref(outs)))
+    np.testing.assert_allclose(_to_np(lib, outs[0]), np.maximum(x, 0),
+                               rtol=1e-6)
+    assert seen and seen[0][0] is not None
+    pstr = ctypes.c_char_p()
+    _check(lib, lib.MXExecutorPrint(ex, ctypes.byref(pstr)))
+    assert b"Executor" in pstr.value
+    opt_sym = ctypes.c_void_p()
+    _check(lib, lib.MXExecutorGetOptimizedSymbol(ex, ctypes.byref(opt_sym)))
+    # reshape to a new batch
+    new_idx = (u * 2)(0, 2)
+    new_data = (u * 2)(5, 4)
+    r_args, r_grads, r_aux = (cp(ctypes.c_void_p)() for _ in range(3))
+    rn_args, rn_aux = u(), u()
+    new_ex = ctypes.c_void_p()
+    _check(lib, lib.MXExecutorReshape(
+        ctypes.c_int(0), ctypes.c_int(1), 1, 0, u(0), None, None, None,
+        u(1), shape_names, new_data, new_idx,
+        ctypes.byref(rn_args), ctypes.byref(r_args), ctypes.byref(r_grads),
+        ctypes.byref(rn_aux), ctypes.byref(r_aux), ex,
+        ctypes.byref(new_ex)))
+    assert rn_args.value == 1
+
+
+# -- kvstore depth ---------------------------------------------------------
+
+def test_kvstore_int_keys_updater_barrier(lib):
+    kv = ctypes.c_void_p()
+    _check(lib, lib.MXKVStoreCreate(b"local", ctypes.byref(kv)))
+    t = ctypes.c_char_p()
+    _check(lib, lib.MXKVStoreGetType(kv, ctypes.byref(t)))
+    assert t.value == b"local"
+    init = np.zeros((4,), np.float32)
+    h = _make_nd(lib, init)
+    keys = (ctypes.c_int * 1)(7)
+    vals = (ctypes.c_void_p * 1)(h)
+    _check(lib, lib.MXKVStoreInit(kv, u(1), keys, vals))
+
+    calls = []
+    UPD = ctypes.CFUNCTYPE(None, ctypes.c_int, ctypes.c_void_p,
+                           ctypes.c_void_p, ctypes.c_void_p)
+
+    def updater(key, recv, local, _):
+        grad = _to_np(lib, ctypes.c_void_p(recv))
+        stored = _to_np(lib, ctypes.c_void_p(local))
+        calls.append(key)
+        new = (stored + 2 * grad).astype(np.float32)
+        lib.MXNDArraySyncCopyFromCPU(
+            ctypes.c_void_p(local), new.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_size_t(new.size))
+
+    cb = UPD(updater)
+    _check(lib, lib.MXKVStoreSetUpdater(kv, cb, None))
+    g = _make_nd(lib, np.ones((4,), np.float32))
+    gvals = (ctypes.c_void_p * 1)(g)
+    _check(lib, lib.MXKVStorePush(kv, u(1), keys, gvals, ctypes.c_int(0)))
+    assert calls == [7]
+    out = _make_nd(lib, np.zeros((4,), np.float32))
+    ovals = (ctypes.c_void_p * 1)(out)
+    _check(lib, lib.MXKVStorePull(kv, u(1), keys, ovals, ctypes.c_int(0)))
+    np.testing.assert_allclose(_to_np(lib, out), 2 * np.ones(4), rtol=1e-6)
+    _check(lib, lib.MXKVStoreBarrier(kv))
+    ret = ctypes.c_int()
+    _check(lib, lib.MXKVStoreIsWorkerNode(ctypes.byref(ret)))
+    assert ret.value == 1
+    dead = ctypes.c_int()
+    _check(lib, lib.MXKVStoreGetNumDeadNode(kv, ctypes.c_int(0),
+                                            ctypes.byref(dead),
+                                            ctypes.c_int(1)))
+    assert dead.value == 0
+    _check(lib, lib.MXKVStoreSetBarrierBeforeExit(kv, ctypes.c_int(0)))
+    _check(lib, lib.MXKVStoreFree(kv))
+
+
+def test_kvstore_pull_row_sparse(lib):
+    kv = ctypes.c_void_p()
+    _check(lib, lib.MXKVStoreCreate(b"local", ctypes.byref(kv)))
+    table = np.arange(12, dtype=np.float32).reshape(6, 2)
+    _check(lib, lib.MXKVStoreInitEx(
+        kv, u(1), (ctypes.c_char_p * 1)(b"emb"),
+        (ctypes.c_void_p * 1)(_make_nd(lib, table))))
+    rows = _make_nd(lib, np.array([1, 4], np.float32))
+    out = _make_nd(lib, np.zeros((2, 2), np.float32))
+    _check(lib, lib.MXKVStorePullRowSparseEx(
+        kv, u(1), (ctypes.c_char_p * 1)(b"emb"),
+        (ctypes.c_void_p * 1)(out), (ctypes.c_void_p * 1)(rows),
+        ctypes.c_int(0)))
+    np.testing.assert_allclose(_to_np(lib, out), table[[1, 4]], rtol=1e-6)
+
+
+# -- NDArray depth ---------------------------------------------------------
+
+def test_ndarray_extras(lib):
+    x = np.random.randn(3, 4).astype(np.float32)
+    h = _make_nd(lib, x)
+    _check(lib, lib.MXNDArrayWaitToRead(h))
+    _check(lib, lib.MXNDArrayWaitToWrite(h))
+    dt, did = ctypes.c_int(), ctypes.c_int()
+    _check(lib, lib.MXNDArrayGetContext(h, ctypes.byref(dt),
+                                        ctypes.byref(did)))
+    assert dt.value in (1, 2)
+    ptr = ctypes.c_void_p()
+    _check(lib, lib.MXNDArrayGetData(h, ctypes.byref(ptr)))
+    host = np.ctypeslib.as_array(
+        ctypes.cast(ptr, cp(ctypes.c_float)), shape=(12,))
+    np.testing.assert_allclose(host.reshape(3, 4), x, rtol=1e-6)
+    det = ctypes.c_void_p()
+    _check(lib, lib.MXNDArrayDetach(h, ctypes.byref(det)))
+    # reshape64
+    dims = (ctypes.c_int64 * 2)(4, 3)
+    r = ctypes.c_void_p()
+    _check(lib, lib.MXNDArrayReshape64(h, ctypes.c_int(2), dims,
+                                       ctypes.c_bool(False),
+                                       ctypes.byref(r)))
+    assert _to_np(lib, r).shape == (4, 3)
+    # raw bytes roundtrip
+    size = sz()
+    buf = ctypes.c_char_p()
+    _check(lib, lib.MXNDArraySaveRawBytes(h, ctypes.byref(size),
+                                          ctypes.byref(buf)))
+    raw = ctypes.string_at(buf, size.value)
+    h2 = ctypes.c_void_p()
+    _check(lib, lib.MXNDArrayLoadFromRawBytes(raw, sz(len(raw)),
+                                              ctypes.byref(h2)))
+    np.testing.assert_allclose(_to_np(lib, h2), x, rtol=1e-6)
+    # dlpack roundtrip
+    cap = ctypes.c_void_p()
+    _check(lib, lib.MXNDArrayToDLPack(h, ctypes.byref(cap)))
+    h3 = ctypes.c_void_p()
+    _check(lib, lib.MXNDArrayFromDLPack(cap, ctypes.byref(h3)))
+    np.testing.assert_allclose(_to_np(lib, h3), x, rtol=1e-6)
+    _check(lib, lib.MXNDArrayCallDLPackDeleter(cap))
+
+
+def test_ndarray_shared_mem(lib):
+    x = np.random.randn(2, 3).astype(np.float32)
+    h = _make_nd(lib, x)
+    pid, sid = ctypes.c_int(), ctypes.c_int()
+    _check(lib, lib.MXNDArrayGetSharedMemHandle(h, ctypes.byref(pid),
+                                                ctypes.byref(sid)))
+    shape = (u * 2)(2, 3)
+    h2 = ctypes.c_void_p()
+    _check(lib, lib.MXNDArrayCreateFromSharedMem(pid, sid, shape, u(2),
+                                                 ctypes.c_int(0),
+                                                 ctypes.byref(h2)))
+    np.testing.assert_allclose(_to_np(lib, h2), x, rtol=1e-6)
+
+
+# -- autograd depth + misc -------------------------------------------------
+
+def test_autograd_backward_ex_with_variables(lib):
+    h = _make_nd(lib, np.array([2.0, 3.0], np.float32))
+    _check(lib, lib.MXNDArraySetGradState(h, ctypes.c_int(1)))
+    st = ctypes.c_int()
+    _check(lib, lib.MXNDArrayGetGradState(h, ctypes.byref(st)))
+    assert st.value == 1
+    prev = ctypes.c_int()
+    _check(lib, lib.MXAutogradSetIsRecording(ctypes.c_int(1),
+                                             ctypes.byref(prev)))
+    rec = ctypes.c_bool()
+    _check(lib, lib.MXAutogradIsRecording(ctypes.byref(rec)))
+    assert rec.value
+    n_out = ctypes.c_int(0)
+    outs = cp(ctypes.c_void_p)()
+    ins = (ctypes.c_void_p * 2)(h, h)
+    _check(lib, lib.MXImperativeInvoke(b"elemwise_mul", ctypes.c_int(2),
+                                       ins, ctypes.byref(n_out),
+                                       ctypes.byref(outs), ctypes.c_int(0),
+                                       None, None))
+    y = ctypes.c_void_p(outs[0])
+    _check(lib, lib.MXAutogradSetIsRecording(ctypes.c_int(0),
+                                             ctypes.byref(prev)))
+    grads = cp(ctypes.c_void_p)()
+    stypes = cp(ctypes.c_int)()
+    heads = (ctypes.c_void_p * 1)(y)
+    variables = (ctypes.c_void_p * 1)(h)
+    _check(lib, lib.MXAutogradBackwardEx(
+        u(1), heads, None, u(1), variables, ctypes.c_int(0),
+        ctypes.c_int(0), ctypes.c_int(1), ctypes.byref(grads),
+        ctypes.byref(stypes)))
+    np.testing.assert_allclose(_to_np(lib, grads[0]), [4.0, 6.0], rtol=1e-5)
+
+
+def test_misc_family(lib):
+    n = ctypes.c_int()
+    _check(lib, lib.MXGetGPUCount(ctypes.byref(n)))
+    assert n.value >= 0  # 0 on a CPU-only host (honest no-GPU signal)
+    f64, t64 = ctypes.c_uint64(), ctypes.c_uint64()
+    _check(lib, lib.MXGetGPUMemoryInformation64(0, ctypes.byref(f64),
+                                                ctypes.byref(t64)))
+    prev = ctypes.c_int()
+    _check(lib, lib.MXEngineSetBulkSize(ctypes.c_int(16),
+                                        ctypes.byref(prev)))
+    _check(lib, lib.MXSetNumOMPThreads(ctypes.c_int(2)))
+
+    class LibFeature(ctypes.Structure):
+        _fields_ = [("name", ctypes.c_char_p), ("enabled", ctypes.c_bool)]
+
+    feats = cp(LibFeature)()
+    count = sz()
+    _check(lib, lib.MXLibInfoFeatures(ctypes.byref(feats),
+                                      ctypes.byref(count)))
+    names = {feats[i].name for i in range(count.value)}
+    assert b"TPU" in names or len(names) > 3
+    _check(lib, lib.MXRandomSeedContext(ctypes.c_int(7), 1, 0))
+
+
+def test_legacy_function_api(lib):
+    n = u()
+    funcs = cp(ctypes.c_void_p)()
+    _check(lib, lib.MXListFunctions(ctypes.byref(n), ctypes.byref(funcs)))
+    assert n.value > 400
+    fh = ctypes.c_void_p()
+    _check(lib, lib.MXGetFunction(b"relu", ctypes.byref(fh)))
+    nu, nsc, nm = u(), u(), u()
+    mask = ctypes.c_int()
+    _check(lib, lib.MXFuncDescribe(fh, ctypes.byref(nu), ctypes.byref(nsc),
+                                   ctypes.byref(nm), ctypes.byref(mask)))
+    assert (nu.value, nm.value) == (1, 1)
+    x = np.array([-1.0, 5.0], np.float32)
+    src = _make_nd(lib, x)
+    dst = _make_nd(lib, np.zeros(2, np.float32))
+    _check(lib, lib.MXFuncInvoke(fh, (ctypes.c_void_p * 1)(src), None,
+                                 (ctypes.c_void_p * 1)(dst)))
+    np.testing.assert_allclose(_to_np(lib, dst), [0.0, 5.0], rtol=1e-6)
+
+
+def test_rtc_error_contract(lib):
+    out = ctypes.c_void_p()
+    rc = lib.MXRtcCudaModuleCreate(b"__global__ void k(){}", ctypes.c_int(0),
+                                   None, ctypes.c_int(0), None,
+                                   ctypes.byref(out))
+    assert rc != 0
+    assert b"PallasModule" in lib.MXGetLastError()
+
+
+def test_capi_coverage_gate(lib):
+    """>=150/197 reference functions exported, absences documented."""
+    import subprocess, sys, json, os
+    script = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "capi_coverage.py")
+    if not os.path.isdir("/root/reference"):
+        pytest.skip("reference tree unavailable")
+    res = subprocess.run([sys.executable, script, "--json"],
+                         capture_output=True, text=True)
+    report = json.loads(res.stdout[res.stdout.index("{"):])
+    assert report["implemented"] >= 150
+    assert report["missing_undocumented"] == []

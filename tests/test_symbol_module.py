@@ -22,7 +22,9 @@ def test_symbol_compose_and_lists():
     s = _mlp_symbol()
     args = s.list_arguments()
     assert "data" in args and "softmax_label" in args
-    assert "fc1_weight" not in args  # our sym ops don't auto-create weights
+    # missing op inputs become auto-created variables, reference-style
+    # (nnvm Symbol::Compose): fc1_weight/fc1_bias appear in arguments
+    assert "fc1_weight" in args and "fc1_bias" in args
     # explicit weight vars
     data = sym.var("data")
     w = sym.var("w")
