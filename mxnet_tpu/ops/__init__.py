@@ -24,6 +24,7 @@ from . import image_ops  # noqa: F401
 from . import misc_ops  # noqa: F401
 from . import rcnn_ops  # noqa: F401
 from . import sparse_ops  # noqa: F401
+from . import parity_ops  # noqa: F401
 from .. import operator as _custom_host  # noqa: F401  (registers Custom)
 
 from .registry import get_op, list_ops, register  # noqa: F401

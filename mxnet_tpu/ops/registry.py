@@ -156,11 +156,21 @@ def register_sparse(name: str, stypes: Sequence[str]) -> Callable:
 
 def stype_dispatch(name: str, stypes: Sequence[str]) -> Optional[Callable]:
     """FInferStorageType analog: pick the FComputeEx kernel for this input
-    stype combination, or None → dense fallback (DispatchMode::kFComputeFallback)."""
-    impl = _SPARSE_IMPLS.get((name, tuple(stypes)))
-    if impl is None:
-        impl = _SPARSE_IMPLS.get((name, ("*",)))
-    return impl
+    stype combination, or None → dense fallback
+    (DispatchMode::kFComputeFallback). Signature matching: exact tuple,
+    then signatures whose tail is "*" (any remaining inputs), then the
+    full wildcard ("*",)."""
+    stypes = tuple(stypes)
+    impl = _SPARSE_IMPLS.get((name, stypes))
+    if impl is not None:
+        return impl
+    for (n, sig), fn in _SPARSE_IMPLS.items():
+        if n != name or not sig or sig[-1] != "*":
+            continue
+        head = sig[:-1]
+        if stypes[:len(head)] == head:
+            return fn
+    return _SPARSE_IMPLS.get((name, ("*",)))
 
 
 def storage_fallback_warn(name: str, stypes: Sequence[str]) -> None:

@@ -1,0 +1,104 @@
+"""Reference-inventory parity ops (ops/parity_ops.py): init ops reachable
+from symbol graphs, the _random_*_like family, _grad_add,
+_contrib_div_sqrt_dim, and the csr-container registry identities.
+Ref: src/operator/tensor/init_op.cc, src/operator/random/sample_op.cc:210,
+src/operator/tensor/elemwise_binary_op_basic.cc:105,
+src/operator/contrib/transformer.cc:33."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+
+
+def test_init_ops_imperative():
+    assert nd.op.zeros(shape=(2, 3)).asnumpy().sum() == 0
+    assert nd.op.ones(shape=(4,)).asnumpy().sum() == 4
+    np.testing.assert_allclose(nd.op.full(shape=(2, 2), value=7).asnumpy(),
+                               np.full((2, 2), 7.0))
+    np.testing.assert_allclose(nd.op.eye(N=3).asnumpy(), np.eye(3))
+    np.testing.assert_allclose(nd.op.arange(start=2.0, stop=6.0).asnumpy(),
+                               np.arange(2.0, 6.0))
+    # reference arange(stop-only) form
+    np.testing.assert_allclose(nd.op.arange(start=4.0).asnumpy(),
+                               np.arange(4.0))
+
+
+def test_init_ops_symbolic():
+    # sym.zeros exists and evaluates inside a graph (the VERDICT item)
+    z = sym.zeros(shape=(2, 2))
+    out = (z + 1.0).eval()
+    np.testing.assert_allclose(out[0].asnumpy(), np.ones((2, 2)))
+    e = sym.eye(N=3)
+    np.testing.assert_allclose(e.eval()[0].asnumpy(), np.eye(3))
+
+
+@pytest.mark.parametrize("op,params", [
+    ("_random_uniform_like", dict(low=-1.0, high=1.0)),
+    ("_random_normal_like", dict(loc=0.0, scale=2.0)),
+    ("_random_exponential_like", dict(lam=2.0)),
+    ("_random_gamma_like", dict(alpha=2.0, beta=1.0)),
+    ("_random_poisson_like", dict(lam=3.0)),
+    ("_random_negative_binomial_like", dict(k=3, p=0.5)),
+    ("_random_generalized_negative_binomial_like", dict(mu=2.0, alpha=0.3)),
+])
+def test_random_like_family(op, params):
+    x = nd.zeros((200, 5))
+    fn = getattr(nd.op, op)
+    out = fn(x, **params)
+    assert out.shape == x.shape
+    vals = out.asnumpy()
+    assert np.isfinite(vals).all()
+    # distribution sanity (loose, seeded by the global stream)
+    if op == "_random_uniform_like":
+        assert -1.0 <= vals.min() and vals.max() <= 1.0
+    if op == "_random_exponential_like":
+        assert vals.min() >= 0 and abs(vals.mean() - 0.5) < 0.15
+    if op == "_random_poisson_like":
+        assert abs(vals.mean() - 3.0) < 0.5
+    # also exposed under mx.nd.random (reference namespace routing)
+    assert hasattr(nd.random, op[len("_random_"):])
+
+
+def test_grad_add_and_div_sqrt_dim():
+    a, b = nd.array(np.ones((2, 2))), nd.array(np.full((2, 2), 2.0))
+    np.testing.assert_allclose(nd.op._grad_add(a, b).asnumpy(), 3.0)
+    x = nd.array(np.ones((2, 16), np.float32))
+    np.testing.assert_allclose(nd.op._contrib_div_sqrt_dim(x).asnumpy(),
+                               0.25, rtol=1e-6)
+
+
+def test_sample_unique_zipfian():
+    s, c = nd.op._sample_unique_zipfian(range_max=10000, shape=(64,))
+    sv = s.asnumpy()
+    assert sv.shape == (64,) and (sv >= 0).all() and (sv < 10000).all()
+    # zipfian mass concentrates at small ids
+    assert np.median(sv) < 1000
+    assert (c.asnumpy() > 0).all()
+
+
+def test_container_ops_registered_and_dispatch():
+    from mxnet_tpu.ndarray import sparse
+    from mxnet_tpu.ops import registry as reg
+    for name in ("_contrib_edge_id", "_contrib_getnnz", "_sparse_retain",
+                 "_contrib_dgl_adjacency", "_contrib_dgl_subgraph",
+                 "_contrib_dgl_csr_neighbor_uniform_sample",
+                 "_contrib_dgl_csr_neighbor_non_uniform_sample",
+                 "_contrib_dgl_graph_compact"):
+        assert name in reg.list_ops()
+    csr = sparse.csr_matrix(np.eye(5, dtype=np.float32))
+    assert int(nd.op._contrib_getnnz(csr).asnumpy()) == 5
+    # dense invocation errors with guidance rather than silently wrong
+    from mxnet_tpu.base import MXNetError
+    with pytest.raises(MXNetError):
+        nd.op._contrib_getnnz(nd.ones((3, 3)))
+    # retain through the registry identity
+    rsp = sparse.row_sparse_array(np.diag([1.0, 2.0, 3.0]).astype(np.float32))
+    out = nd.op._sparse_retain(rsp, nd.array(np.array([0.0, 2.0])))
+    assert sorted(np.asarray(out._indices)) == [0, 2]
+
+
+def test_alias_names_exist():
+    from mxnet_tpu.ops import registry as reg
+    for n in ("_histogram", "_ravel_multi_index", "_unravel_index"):
+        assert n in reg.list_ops()
