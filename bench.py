@@ -70,7 +70,10 @@ def run(batch=256, k_steps=8, dtype=None, layout=None):
         layout = os.environ.get("MXTPU_BENCH_LAYOUT", "NHWC")
 
     mx.random.seed(0)
-    net = resnet50_v1(layout=layout)
+    # space-to-depth stem (exact 7x7/2 reparametrization, MXU-efficient;
+    # see SpaceToDepthStem + tests/test_model_zoo.py equivalence test)
+    s2d = os.environ.get("MXTPU_BENCH_S2D", "1") != "0"
+    net = resnet50_v1(layout=layout, stem_s2d=s2d)
     net.initialize(mx.init.Xavier())
 
     trainer = SPMDTrainer(net, gloss.SoftmaxCrossEntropyLoss(),
@@ -125,6 +128,49 @@ def run(batch=256, k_steps=8, dtype=None, layout=None):
     return imgs_per_sec
 
 
+def run_inference(batch=256, dtype=None, layout=None, reps=20):
+    """Forward-only throughput (regenerates the README inference numbers:
+    ref example/image-classification/benchmark_score.py)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+
+    if dtype is None:
+        dtype = os.environ.get("MXTPU_BENCH_DTYPE", "bfloat16")
+    if layout is None:
+        layout = os.environ.get("MXTPU_BENCH_LAYOUT", "NHWC")
+    mx.random.seed(0)
+    net = resnet50_v1(layout=layout,
+                      stem_s2d=os.environ.get("MXTPU_BENCH_S2D", "1") != "0")
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    shape = ((batch, 224, 224, 3) if layout == "NHWC"
+             else (batch, 3, 224, 224))
+    cdt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+    xf32 = mx.nd.from_jax(jnp.asarray(
+        np.random.RandomState(0).rand(*shape).astype(np.float32)))
+    net(xf32)  # materialize deferred-shape params before the dtype cast
+    x = mx.nd.from_jax(xf32._data.astype(cdt))
+    # params in compute dtype for inference
+    for _, p in net.collect_params().items():
+        if p._data is not None:
+            p._data._rebind(p._data._data.astype(cdt))
+    t0 = time.time()
+    out = net(x)
+    jax.block_until_ready(out._data)
+    log(f"inference compile took {time.time() - t0:.1f}s")
+    t0 = time.perf_counter()
+    for _ in range(reps - 1):
+        out = net(x)
+    jax.block_until_ready(net(x)._data)
+    dt = time.perf_counter() - t0
+    ips = batch * reps / dt
+    log(f"inference: {ips:.1f} img/s (batch {batch})")
+    return ips
+
+
 def _enable_compile_cache():
     """Persistent XLA compilation cache: full-graph ResNet-50 compiles
     take ~15 min through the tunnel; the cache cuts reruns to seconds."""
@@ -150,7 +196,13 @@ def main():
         batch, k = (int(v) for v in cfg.split("x"))
         try:
             value = run(batch=batch, k_steps=k)
-            print(json.dumps({
+            infer = None
+            if os.environ.get("MXTPU_BENCH_INFERENCE", "1") != "0":
+                try:
+                    infer = round(run_inference(batch=batch), 2)
+                except Exception as e:
+                    log(f"inference bench failed: {e}")
+            payload = {
                 "metric": "resnet50_train_imgs_per_sec",
                 "value": round(value, 2),
                 "unit": "img/s",
@@ -159,7 +211,10 @@ def main():
                 "layout": os.environ.get("MXTPU_BENCH_LAYOUT", "NHWC"),
                 "batch": batch,
                 "fused_steps": k,
-            }))
+            }
+            if infer:
+                payload["inference_imgs_per_sec"] = infer
+            print(json.dumps(payload))
             return
         except Exception as e:  # OOM or backend issue: try smaller config
             last_err = e

@@ -1,16 +1,27 @@
 """DataLoader (ref: python/mxnet/gluon/data/dataloader.py:595).
 
-The reference forks worker processes that exchange NDArrays over POSIX
-shared memory (ForkingPickler reductions :26-68, backed by
-cpu_shared_storage_manager.h). TPU-native: batches are assembled on the host
-with a *thread* pool — the heavy lifting (augmentation) is numpy which
-releases the GIL, and the device transfer is one ``device_put`` per batch;
-multiprocess + shm adds copies without wins here. ``num_workers`` therefore
-sizes a thread pool. Batchify semantics match the reference.
+Worker modes:
+- ``num_workers > 0`` (default ``thread_pool=False``): forked worker
+  PROCESSES assemble batches and ship them back zero-copy through POSIX
+  shared memory (``multiprocessing.shared_memory`` — the
+  ForkingPickler/cpu_shared_storage_manager.h analog, dataloader.py:26-68).
+  Python-side decode/augment code runs truly in parallel, not under one
+  GIL.
+- ``thread_pool=True``: the round-2 thread pool (fine when transforms are
+  GIL-releasing numpy).
+- ``pin_memory=True``: the parent eagerly stages each reassembled batch
+  onto the default device (the DeviceStagingIter handoff), overlapping
+  H2D with worker compute.
+
+Constraint shared with the reference's process workers: samples crossing
+the process boundary must be host data (numpy/python); device arrays
+cannot survive a fork (the reference has the same rule for GPU NDArrays).
 """
 from __future__ import annotations
 
 import concurrent.futures
+import multiprocessing as _mp
+import traceback
 from typing import Any, Callable, List, Optional
 
 import numpy as np
@@ -34,11 +45,100 @@ def default_batchify_fn(data):
     return _nd.array(arr, dtype=arr.dtype)
 
 
+def _np_batchify(data):
+    """Worker-side batchify: pure numpy (no device arrays in children)."""
+    if isinstance(data[0], tuple):
+        return tuple(_np_batchify(list(x)) for x in zip(*data))
+    arr = np.stack([np.asarray(d) for d in data]) if \
+        getattr(data[0], "ndim", 0) else np.asarray(data)
+    if arr.dtype == np.float64:
+        arr = arr.astype(np.float32)
+    return arr
+
+
+def _tree_to_shm(tree):
+    """np-array tree -> shm segment descriptors (one segment per array)."""
+    from multiprocessing import shared_memory
+    if isinstance(tree, tuple):
+        return tuple(_tree_to_shm(t) for t in tree)
+    if isinstance(tree, _nd.NDArray):  # custom batchify returning NDArray
+        tree = tree.asnumpy()
+    arr = np.ascontiguousarray(tree)
+    shm = shared_memory.SharedMemory(create=True, size=max(arr.nbytes, 1))
+    if arr.nbytes:
+        # write straight into the mapped segment (no tobytes() staging)
+        np.ndarray(arr.shape, arr.dtype, buffer=shm.buf)[...] = arr
+    desc = ("__shm__", shm.name, arr.shape, arr.dtype.str)
+    # ownership transfers to the parent (it unlinks after reading):
+    # unregister from this process's resource tracker so worker exit
+    # doesn't double-unlink (cpython's shared_memory fork-ownership wart)
+    try:
+        from multiprocessing import resource_tracker
+        resource_tracker.unregister(shm._name, "shared_memory")
+    except Exception:
+        pass
+    shm.close()
+    return desc
+
+
+def _unlink_tree(desc):
+    """Free the segments of an unconsumed payload (early iterator exit)."""
+    from multiprocessing import shared_memory
+    if isinstance(desc, tuple) and (not desc or desc[0] != "__shm__"):
+        for d in desc:
+            _unlink_tree(d)
+        return
+    try:
+        shm = shared_memory.SharedMemory(name=desc[1])
+        shm.close()
+        shm.unlink()
+    except Exception:
+        pass
+
+
+def _tree_from_shm(desc, pin_memory):
+    from multiprocessing import shared_memory
+    if isinstance(desc, tuple) and (not desc or desc[0] != "__shm__"):
+        return tuple(_tree_from_shm(d, pin_memory) for d in desc)
+    _, name, shape, dtype = desc
+    shm = shared_memory.SharedMemory(name=name)
+    try:
+        n = int(np.prod(shape)) if shape else 1
+        view = np.frombuffer(shm.buf, dtype=np.dtype(dtype),
+                             count=n).reshape(shape)
+        host = view.copy()  # one host copy: CPU backends may otherwise
+        del view            # alias the shm buffer past its lifetime
+        if pin_memory:
+            # eager device staging (DeviceStagingIter handoff): the H2D
+            # transfer overlaps with the workers producing the next batch
+            import jax
+            out = _nd.from_jax(jax.device_put(host))
+        else:
+            out = _nd.array(host)
+    finally:
+        shm.close()
+        shm.unlink()
+    return out
+
+
+def _worker_loop(dataset, batchify_fn, task_q, result_q):
+    while True:
+        job = task_q.get()
+        if job is None:
+            return
+        bidx, indices = job
+        try:
+            batch = batchify_fn([dataset[i] for i in indices])
+            result_q.put((bidx, _tree_to_shm(batch), None))
+        except Exception:
+            result_q.put((bidx, None, traceback.format_exc()))
+
+
 class DataLoader:
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, prefetch=None,
-                 thread_pool=True):
+                 thread_pool=None):
         self._dataset = dataset
         if batch_sampler is None:
             check(batch_size is not None,
@@ -57,6 +157,13 @@ class DataLoader:
         self._batch_sampler = batch_sampler
         self._batchify_fn = batchify_fn or default_batchify_fn
         self._num_workers = max(0, num_workers)
+        self._pin_memory = pin_memory
+        # thread_pool=None (default): process workers for the built-in
+        # numpy batchify (safe to fork), thread workers when a CUSTOM
+        # batchify_fn is supplied — user code may touch device arrays,
+        # which must not run in a child forked from a live JAX runtime
+        self._thread_pool = (batchify_fn is not None) if thread_pool is None \
+            else thread_pool
         self._prefetch = max(0, prefetch if prefetch is not None
                              else 2 * self._num_workers)
 
@@ -71,6 +178,12 @@ class DataLoader:
             for indices in self._batch_sampler:
                 yield self._load(indices)
             return
+        if self._thread_pool:
+            yield from self._iter_threads()
+        else:
+            yield from self._iter_processes()
+
+    def _iter_threads(self):
         with concurrent.futures.ThreadPoolExecutor(self._num_workers) as ex:
             pending = []
             it = iter(self._batch_sampler)
@@ -86,3 +199,78 @@ class DataLoader:
                 except StopIteration:
                     pass
                 yield fut.result()
+
+    def _iter_processes(self):
+        """Forked workers + shared-memory transport (ref:
+        dataloader.py:595 _MultiWorkerIter)."""
+        ctx = _mp.get_context("fork")
+        task_q = ctx.SimpleQueue()
+        result_q = ctx.Queue()
+        batchify = self._batchify_fn if self._batchify_fn \
+            is not default_batchify_fn else _np_batchify
+        workers = [ctx.Process(target=_worker_loop,
+                               args=(self._dataset, batchify, task_q,
+                                     result_q), daemon=True)
+                   for _ in range(self._num_workers)]
+        for w in workers:
+            w.start()
+        try:
+            it = iter(self._batch_sampler)
+            sent = 0
+            received = 0
+            buffered = {}
+            depth = self._prefetch or self._num_workers
+
+            def send_next():
+                nonlocal sent
+                try:
+                    task_q.put((sent, next(it)))
+                    sent += 1
+                    return True
+                except StopIteration:
+                    return False
+
+            for _ in range(depth):
+                if not send_next():
+                    break
+            import queue as _queue
+            while received < sent:
+                while received not in buffered:
+                    try:
+                        bidx, payload, err = result_q.get(timeout=5.0)
+                    except _queue.Empty:
+                        dead = [w for w in workers if not w.is_alive()]
+                        if dead:
+                            raise MXNetError(
+                                f"DataLoader worker pid(s) "
+                                f"{[w.pid for w in dead]} died "
+                                f"(exitcode {[w.exitcode for w in dead]}) "
+                                "without producing a batch — likely "
+                                "OOM-killed or crashed in native code")
+                        continue
+                    if err is not None:
+                        raise MXNetError(f"DataLoader worker failed:\n{err}")
+                    buffered[bidx] = payload
+                payload = buffered.pop(received)
+                received += 1
+                send_next()
+                yield _tree_from_shm(payload, self._pin_memory)
+        finally:
+            # free any in-flight payloads the consumer never took (early
+            # break / error): workers unregistered the segments, so they
+            # would otherwise outlive the process
+            for payload in buffered.values():
+                _unlink_tree(payload)
+            for _ in workers:
+                task_q.put(None)
+            for w in workers:
+                w.join(timeout=5)
+                if w.is_alive():
+                    w.terminate()
+            try:
+                while True:
+                    _bidx, payload, err = result_q.get_nowait()
+                    if payload is not None:
+                        _unlink_tree(payload)
+            except Exception:
+                pass

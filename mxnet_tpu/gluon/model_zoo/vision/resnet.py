@@ -153,9 +153,47 @@ class BottleneckV2(HybridBlock):
         return x + residual
 
 
+class SpaceToDepthStem(HybridBlock):
+    """MXU-efficient replacement for the 7x7/2 stem conv (the MLPerf
+    space-to-depth trick): rearrange 2x2 spatial blocks into channels
+    (H,W,3 -> H/2,W/2,12) and apply an equivalent 4x4/1 convolution.
+
+    Why: the stem's contraction dim is kh*kw*C = 7*7*3 = 147 padded up to
+    the MXU's lane multiple, at terrible utilization; after s2d it is
+    4*4*12 = 192 over a quarter the positions — the receptive field
+    (8x8 superset of 7x7) and output grid (112x112, stride-2-equivalent)
+    are preserved, and the stem trains directly in the rearranged basis.
+    """
+
+    def __init__(self, channels, layout="NCHW", **kwargs):
+        super().__init__(**kwargs)
+        self._layout = layout
+        self.conv = nn.Conv2D(channels, 4, 1, 0, use_bias=False,
+                              in_channels=12, layout=layout)
+
+    def hybrid_forward(self, F, x):
+        if self._layout == "NHWC":
+            # (B,H,W,C) -> (B,H/2,2,W/2,2,C) -> (B,H/2,W/2,2,2,C) -> 12ch
+            x = F.reshape(x, shape=(0, -4, -1, 2, -4, -1, 2, 0))
+            x = F.transpose(x, axes=(0, 1, 3, 2, 4, 5))
+            x = F.reshape(x, shape=(0, 0, 0, -3, 0))
+            x = F.reshape(x, shape=(0, 0, 0, -3))
+            # stride-2 7x7 pad-3 == stride-1 4x4 over s2d with pad (2,1)
+            x = F.pad(x, mode="constant",
+                      pad_width=(0, 0, 2, 1, 2, 1, 0, 0))
+        else:
+            x = F.reshape(x, shape=(0, 0, -4, -1, 2, -4, -1, 2))
+            x = F.transpose(x, axes=(0, 1, 3, 5, 2, 4))
+            x = F.reshape(x, shape=(0, -3, 0, 0, 0))
+            x = F.reshape(x, shape=(0, -3, 0, 0))
+            x = F.pad(x, mode="constant",
+                      pad_width=(0, 0, 0, 0, 2, 1, 2, 1))
+        return self.conv(x)
+
+
 class ResNetV1(HybridBlock):
     def __init__(self, block, layers, channels, classes=1000,
-                 thumbnail=False, layout="NCHW", **kwargs):
+                 thumbnail=False, layout="NCHW", stem_s2d=False, **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
         ax = _bn_axis(layout)
@@ -164,8 +202,13 @@ class ResNetV1(HybridBlock):
             if thumbnail:
                 self.features.add(_conv3x3(channels[0], 1, 0, layout))
             else:
-                self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
-                                            use_bias=False, layout=layout))
+                if stem_s2d:
+                    self.features.add(SpaceToDepthStem(channels[0],
+                                                       layout=layout))
+                else:
+                    self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
+                                                use_bias=False,
+                                                layout=layout))
                 self.features.add(nn.BatchNorm(axis=ax))
                 self.features.add(nn.Activation("relu"))
                 self.features.add(nn.MaxPool2D(3, 2, 1, layout=layout))

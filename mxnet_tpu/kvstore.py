@@ -178,8 +178,12 @@ class KVStoreBase:
                 if self._updater is not None:
                     self._updater(_key_int(k), merged, store)
                 else:
+                    # replace semantics, matching the dense branch's full
+                    # overwrite: untouched rows read as zero, not as stale
+                    # values from the previous contents
                     import jax.numpy as jnp
-                    store._rebind(store._data.at[
+                    base = jnp.zeros_like(store._data)
+                    store._rebind(base.at[
                         jnp.asarray(merged._indices)].set(
                         merged._data.astype(store._data.dtype)))
                 continue

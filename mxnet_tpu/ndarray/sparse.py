@@ -119,16 +119,37 @@ class RowSparseNDArray(BaseSparseNDArray):
         return self.todense().copyto(other)
 
     def retain(self, row_ids) -> "RowSparseNDArray":
-        """Keep only the listed rows (ref: sparse_retain op)."""
+        """Keep only the listed rows (ref: sparse_retain op). Compact:
+        requested rows are matched against the stored indices with a
+        searchsorted gather — memory stays O(len(row_ids) × dim), never
+        the full dense shape."""
         jnp = _jnp()
         rid = row_ids._data if isinstance(row_ids, _nd.NDArray) else row_ids
-        rid = jnp.asarray(rid, _np.int32)
-        dense = self.todense()._data
-        return RowSparseNDArray(dense[rid], rid, self._shape, self._ctx)
+        rid_np = _np.asarray(rid, _np.int64)
+        stored = _np.asarray(self._indices, _np.int64)
+        # user-built row_sparse arrays may carry UNSORTED indices: search
+        # the sorted view, then map hits back to storage order
+        order = _np.argsort(stored, kind="stable") if len(stored) else \
+            _np.zeros(0, _np.int64)
+        stored_sorted = stored[order] if len(stored) else stored
+        pos = _np.searchsorted(stored_sorted, rid_np)
+        pos_c = _np.clip(pos, 0, max(len(stored) - 1, 0))
+        present = (stored_sorted[pos_c] == rid_np) if len(stored) else \
+            _np.zeros(len(rid_np), bool)
+        gather = order[pos_c] if len(stored) else pos_c
+        rows = self._data[jnp.asarray(gather, _np.int32)] if len(stored) \
+            else jnp.zeros((len(rid_np),) + tuple(self._shape[1:]),
+                           self._data.dtype)
+        mask = jnp.asarray(present).reshape((-1,) + (1,) * (rows.ndim - 1))
+        rows = jnp.where(mask, rows, 0)
+        return RowSparseNDArray(rows, rid_np.astype(_np.int32),
+                                self._shape, self._ctx)
 
     def __add__(self, other):
         if isinstance(other, RowSparseNDArray):
-            return self.todense() + other.todense()
+            # stype-preserving compact add (ref: elemwise_add rsp/rsp
+            # dispatch) — no dense materialization
+            return add(self, other)
         return self.todense() + other
 
 

@@ -230,48 +230,113 @@ def _pooling(data, kernel=(), pool_type="max", global_pool=False,
 # l2_normalization.cc, lrn.cc)
 # ---------------------------------------------------------------------------
 
-@register("BatchNorm", aliases=("batch_norm",), num_outputs=3)
+def _bn_batch_stats(data, red, n):
+    """Single-pass f32 (mean, var) over the reduce axes. Assumed-mean
+    shift: subtracting one real sample per channel before reducing keeps
+    |d| ~ std, so E[d^2] - E[d]^2 has no catastrophic cancellation even
+    for data with mean >> std. The f32 converts fuse into the reduction,
+    so HBM reads stay at the input dtype's width."""
+    jnp = _jnp()
+    idx0 = tuple(slice(0, 1) if i in red else slice(None)
+                 for i in range(data.ndim))
+    shift = _lax().stop_gradient(data[idx0]).astype(jnp.float32)
+    d = data.astype(jnp.float32) - shift
+    m1 = jnp.sum(d, axis=red) / n
+    m2 = jnp.sum(jnp.square(d), axis=red) / n
+    mean = shift.reshape(-1) + m1
+    var = jnp.maximum(m2 - jnp.square(m1), 0.0)
+    return mean, var
+
+
+def _make_bn_core():
+    """Training-mode BatchNorm with a hand-fused backward
+    (jax.custom_vjp). Why not plain autodiff: value_and_grad over the
+    naive formula saves f32 activation-sized residuals (x - mean,
+    squares, ...) and runs the whole backward chain at f32 width — on
+    TPU that doubles the HBM traffic of exactly the op that is already
+    bandwidth-bound (the gap BENCH_r02/README identified). Here the only
+    activation-sized residual is the bf16 input itself; forward and
+    backward do their elementwise math in f32 REGISTERS but read/write
+    compute-dtype, and the per-channel reductions accumulate in f32
+    (ref: src/operator/nn/batch_norm.cu BatchNormalizationBackward —
+    the same sum_dy / sum_dy_xhat closed form cuDNN uses)."""
+    import jax
+    jnp = _jnp()
+
+    def core(data, g32, beta32, axis, eps):
+        ax = axis % data.ndim
+        red = tuple(i for i in range(data.ndim) if i != ax)
+        bshape = tuple(data.shape[ax] if i == ax else 1
+                       for i in range(data.ndim))
+        n = 1
+        for i in red:
+            n *= data.shape[i]
+        mean, var = _bn_batch_stats(data, red, n)
+        inv = _lax().rsqrt(var + eps)
+        out = (data.astype(jnp.float32) - mean.reshape(bshape)) \
+            * (inv * g32).reshape(bshape) + beta32.reshape(bshape)
+        return out.astype(data.dtype), mean, var
+
+    def fwd(data, g32, beta32, axis, eps):
+        out, mean, var = core(data, g32, beta32, axis, eps)
+        inv = _lax().rsqrt(var + eps)
+        return (out, mean, var), (data, mean, inv, g32)
+
+    def bwd(axis, eps, res, cots):
+        data, mean, inv, g32 = res
+        cot_out = cots[0]  # mean/var outputs only feed running-stat
+        #                    updates — no gradient path (stop-gradient
+        #                    semantics, like the reference's aux states)
+        ax = axis % data.ndim
+        red = tuple(i for i in range(data.ndim) if i != ax)
+        bshape = tuple(data.shape[ax] if i == ax else 1
+                       for i in range(data.ndim))
+        n = 1
+        for i in red:
+            n *= data.shape[i]
+        x32 = data.astype(jnp.float32)
+        dy32 = cot_out.astype(jnp.float32)
+        xhat = (x32 - mean.reshape(bshape)) * inv.reshape(bshape)
+        sum_dy = jnp.sum(dy32, axis=red)
+        sum_dy_xhat = jnp.sum(dy32 * xhat, axis=red)
+        dbeta = sum_dy
+        dgamma = sum_dy_xhat
+        dx = (g32 * inv).reshape(bshape) * (
+            dy32 - (sum_dy / n).reshape(bshape)
+            - xhat * (sum_dy_xhat / n).reshape(bshape))
+        return dx.astype(data.dtype), dgamma, dbeta
+
+    core = jax.custom_vjp(core, nondiff_argnums=(3, 4))
+    core.defvjp(fwd, bwd)
+    return core
+
+
+_BN_CORE = None
+
+
+@register("BatchNorm", aliases=("batch_norm",), num_outputs=3,
+          aux_inputs=(3, 4))
 def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
                 momentum=0.9, fix_gamma=True, use_global_stats=False,
                 output_mean_var=False, axis=1, cudnn_off=False,
                 _training=False):
     jnp = _jnp()
     ax = axis % data.ndim
-    red = tuple(i for i in range(data.ndim) if i != ax)
     bshape = tuple(data.shape[ax] if i == ax else 1 for i in range(data.ndim))
     # statistics in f32 (bf16 inputs would lose too much precision; matches
-    # the reference's fp16 BatchNorm running in fp32 internally). Both
-    # moments are INDEPENDENT reductions (var = E[x^2] - mean^2, not
-    # jnp.var's dependent two-pass), so XLA's multi-output fusion computes
-    # them in a single pass over the activation — one fewer full HBM read
-    # per BatchNorm, which is the bandwidth hot spot of train-mode conv
-    # nets on TPU.
+    # the reference's fp16 BatchNorm running in fp32 internally)
     g = jnp.ones(gamma.shape, jnp.float32) if fix_gamma \
         else gamma.astype(jnp.float32)
     if _training and not use_global_stats:
-        n = 1
-        for i in red:
-            n *= data.shape[i]
-        # assumed-mean shift: subtracting one real sample per channel
-        # before reducing keeps |d| ~ std, so E[d^2] - E[d]^2 has no
-        # catastrophic cancellation even for data with mean >> std
-        # (plain E[x^2] - mean^2 collapses to 0 there in f32)
-        idx0 = tuple(slice(0, 1) if i in red else slice(None)
-                     for i in range(data.ndim))
-        shift = _lax().stop_gradient(data[idx0]).astype(jnp.float32)
-        d = data.astype(jnp.float32) - shift
-        m1 = jnp.sum(d, axis=red) / n
-        m2 = jnp.sum(jnp.square(d), axis=red) / n
-        mean = shift.reshape(-1) + m1
-        var = jnp.maximum(m2 - jnp.square(m1), 0.0)
-    else:
-        mean = moving_mean.astype(jnp.float32)
-        var = moving_var.astype(jnp.float32)
+        global _BN_CORE
+        if _BN_CORE is None:
+            _BN_CORE = _make_bn_core()
+        return _BN_CORE(data, g, beta.astype(jnp.float32), ax, float(eps))
+    mean = moving_mean.astype(jnp.float32)
+    var = moving_var.astype(jnp.float32)
     inv = _lax().rsqrt(var + eps)
-    # normalize in f32 (the converts fuse into the surrounding elementwise
-    # kernel, so HBM traffic stays at read-bf16/write-bf16; subtracting
-    # the mean BEFORE scaling keeps precision for large-mean data, unlike
-    # a folded x*scale+bias affine)
+    # inference: normalize in f32 registers (converts fuse into the
+    # surrounding elementwise kernel; traffic stays at the input width)
     out = (data.astype(jnp.float32) - mean.reshape(bshape)) \
         * (inv * g).reshape(bshape) \
         + beta.astype(jnp.float32).reshape(bshape)

@@ -64,6 +64,12 @@ def apply_mirror(fn, explicit=None):
       full (default) - save nothing, recompute everything (max savings)
       dots           - save matmul/einsum results, recompute elementwise
                        (closest to the reference's mirror of cheap ops)
+      convs          - save conv AND matmul results, recompute elementwise
+                       (the conv-net sweet spot: halves saved-activation
+                       HBM traffic — each layer stores one tensor, the
+                       conv output, instead of conv output + post-BN/ReLU
+                       activation — at the cost of re-running the cheap
+                       normalize/activation chain inside backward)
     """
     if not mirror_enabled(explicit):
         return fn
@@ -73,11 +79,14 @@ def apply_mirror(fn, explicit=None):
     policy = None
     if policy_name == "dots":
         policy = jax.checkpoint_policies.checkpoint_dots
+    elif policy_name == "convs":
+        def policy(prim, *_args, **_params):
+            return prim.name in ("conv_general_dilated", "dot_general")
     elif policy_name not in ("full", ""):
         from .base import MXNetError
         raise MXNetError(
             f"unknown MXNET_BACKWARD_MIRROR_POLICY {policy_name!r} "
-            "(expected 'full' or 'dots')")
+            "(expected 'full', 'dots' or 'convs')")
     return jax.checkpoint(fn, policy=policy)
 
 

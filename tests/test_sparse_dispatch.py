@@ -303,18 +303,19 @@ def test_kvstore_push_row_sparse():
     np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5, atol=1e-6)
 
 
-def test_kvstore_push_row_sparse_no_updater_writes_rows():
+def test_kvstore_push_row_sparse_no_updater_replaces():
+    # replace semantics, like the dense push path: the store becomes the
+    # pushed value (untouched rows zero), not a mix with stale contents
     from mxnet_tpu import kvstore as kv_mod
     kv = kv_mod.create("local")
-    init = np.ones((8, 2), np.float32)
-    kv.init("w", nd.array(init))
+    kv.init("w", nd.array(np.ones((8, 2), np.float32)))
     g = _rsp_grad((8, 2), [2, 6], seed=3)
     kv.push("w", g)
     out = nd.zeros((8, 2))
     kv.pull("w", out=out)
     res = out.asnumpy()
     np.testing.assert_array_equal(res[[0, 1, 3, 4, 5, 7]],
-                                  init[[0, 1, 3, 4, 5, 7]])
+                                  np.zeros((6, 2), np.float32))
     np.testing.assert_allclose(res[[2, 6]], np.asarray(g._data), atol=1e-6)
 
 
