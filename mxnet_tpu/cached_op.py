@@ -25,7 +25,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from .base import MXNetError
 
-__all__ = ["CachedOp"]
+__all__ = ["CachedOp", "make_scan_forward", "scan_forward"]
 
 
 def _jax():
@@ -224,3 +224,51 @@ class CachedOp:
 
         result = jax.tree_util.tree_unflatten(entry.out_treedef, out_nds)
         return result
+
+
+def make_scan_forward(block, training: bool = False):
+    """Build a reusable K-batch scanned forward for a hybridizable block:
+    returns ``fn(xs)`` mapping (K, batch, ...) stacked inputs to
+    (K, batch, ...) stacked outputs in ONE jitted program per call.
+
+    The inference-side analog of SPMDTrainer.run_steps: lax.scan replays
+    the compiled forward K times per dispatch, amortizing per-dispatch
+    host/relay overhead — the serving pattern for batch scoring
+    (ref: the engine's bulk-exec of inference graphs,
+    MXNET_EXEC_BULK_EXEC_INFERENCE). The returned callable holds the
+    compiled program; build it ONCE and reuse it (rebuilding re-traces).
+    """
+    import jax
+    from jax import lax
+    from .ndarray.ndarray import NDArray, from_jax
+
+    co = CachedOp(block)
+    entry = _CacheEntry()
+    co._in_treedef = jax.tree_util.tree_flatten(
+        (from_jax(jax.numpy.zeros((1,))),),
+        is_leaf=lambda v: isinstance(v, NDArray))[1]
+    fwd = co._make_pure_fn(training, entry)
+
+    def multi(params_t, k, stacked):
+        def body(carry, x):
+            outs, _state = fwd(params_t, k, x)
+            return carry, outs[0]
+        _, ys = lax.scan(body, 0, stacked)
+        return ys
+
+    jitted = jax.jit(multi)
+    base_key = jax.random.PRNGKey(0)
+
+    def run(xs, key=None):
+        params = tuple(p._data._data for p in co._params())
+        xs_arr = xs._data if isinstance(xs, NDArray) else xs
+        return from_jax(jitted(params, key if key is not None else base_key,
+                               xs_arr))
+
+    return run
+
+
+def scan_forward(block, xs, key=None, training: bool = False):
+    """One-shot convenience over :func:`make_scan_forward` (traces per
+    call — hot loops should build the callable once)."""
+    return make_scan_forward(block, training)(xs, key=key)

@@ -129,6 +129,11 @@ def enable_compile_cache(cache_dir=None):
                 "MXTPU_COMPILE_CACHE",
                 os.path.join(os.path.dirname(os.path.dirname(
                     os.path.abspath(__file__))), ".jax_cache"))
+        if str(cache_dir).lower() in ("0", "off", "disabled", "none"):
+            # explicit opt-out: cached AOT artifacts compiled on the
+            # remote relay host can SIGILL this machine; callers retry
+            # crashed compiles with the cache off
+            return "disabled"
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
         return True

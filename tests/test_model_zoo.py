@@ -75,3 +75,24 @@ def test_resnet50_s2d_trains():
         out = net(x)
         out.sum().backward()
     assert out.shape == (2, 1000)
+
+
+def test_make_scan_forward_matches_eager():
+    """K-batch scanned inference (mxnet_tpu.cached_op.make_scan_forward)
+    equals per-batch eager forwards — the serving-pattern API bench.py
+    measures with."""
+    import jax.numpy as jnp
+    from mxnet_tpu.cached_op import make_scan_forward
+    from mxnet_tpu.gluon import nn as gnn
+    net = gnn.HybridSequential()
+    net.add(gnn.Dense(8, activation="relu"), gnn.Dense(3))
+    net.initialize(mx.init.Xavier())
+    xs = np.random.RandomState(0).rand(4, 5, 6).astype(np.float32)
+    net(nd.array(xs[0]))  # materialize
+    fwd_k = make_scan_forward(net)
+    out = fwd_k(jnp.asarray(xs))
+    assert out.shape == (4, 5, 3)
+    for k in range(4):
+        ref = net(nd.array(xs[k])).asnumpy()
+        np.testing.assert_allclose(out.asnumpy()[k], ref, rtol=1e-5,
+                                   atol=1e-5)
