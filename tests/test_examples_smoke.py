@@ -47,3 +47,24 @@ def test_tpu_fast_training_example(tmp_path):
     assert "img/s" in r.stdout
     # 3 outer batches of 2 fused steps, saving at i%2==1 -> exactly [4]
     assert "checkpoints: [4]" in r.stdout, r.stdout[-500:]
+
+
+@pytest.mark.slow
+def test_long_context_ring_attention_example_learns():
+    """dp x sp mesh training with ring attention converges (the
+    long-context recipe; examples/long_context/train_long_context.py)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                        " --xla_force_host_platform_device_count=8").strip()
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "examples/long_context/train_long_context.py"),
+         "--steps", "25", "--seq-len", "128"],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert r.returncode == 0, r.stderr[-500:]
+    import re
+    m = re.search(r"done \(loss ([\d.]+) -> ([\d.]+)\)", r.stdout)
+    assert m, r.stdout[-300:]
+    first, last = float(m.group(1)), float(m.group(2))
+    assert last < first * 0.5, (first, last)
