@@ -115,6 +115,16 @@ env.declare("MXNET_IS_RECOVERY", bool, False,
 env.declare("MXNET_STORAGE_FALLBACK_LOG_VERBOSE", bool, True,
             "Warn when an op without a sparse kernel densifies its inputs "
             "(storage fallback).")
+env.declare("MXNET_HOME", str, "",
+            "Root directory for datasets and model artifacts "
+            "(default ~/.mxnet; ref: docs/faq/env_var.md MXNET_HOME).")
+
+
+def data_dir() -> str:
+    """Dataset/model root: $MXNET_HOME or ~/.mxnet
+    (ref: python/mxnet/base.py data_dir)."""
+    return env.get("MXNET_HOME") or os.path.join(
+        os.path.expanduser("~"), ".mxnet")
 
 
 class classproperty:  # noqa: N801 - decorator style

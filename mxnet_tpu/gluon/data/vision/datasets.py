@@ -21,6 +21,11 @@ __all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
 
 class _DownloadedDataset(Dataset):
     def __init__(self, root, transform):
+        from ....base import data_dir
+        marker = os.path.join("~", ".mxnet")
+        if root.startswith(marker):
+            # default roots re-anchor onto $MXNET_HOME when set
+            root = os.path.join(data_dir(), os.path.relpath(root, marker))
         self._root = os.path.expanduser(root)
         self._transform = transform
         self._data = None
