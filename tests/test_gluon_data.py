@@ -182,7 +182,12 @@ def test_dataloader_process_scaling_beats_threads():
     import os
     import time
     from mxnet_tpu.gluon.data import DataLoader
-    required = 2.0 if (os.cpu_count() or 1) >= 4 else 1.2
+    cores = os.cpu_count() or 1
+    if cores < 2:
+        pytest.skip("scaling comparison needs >=2 cores: on one core "
+                    "there is no parallelism for processes to win and "
+                    "spawn overhead dominates")
+    required = 2.0 if cores >= 4 else 1.2
     ds = _GilBoundDataset()
     attempts = []
     for _ in range(3):  # retry: wall-clock ratios flake under host load
