@@ -78,8 +78,10 @@ _UNARY = {
     "gamma": lambda x: _jnp().exp(_jsp().gammaln(x)),
     "gammaln": lambda x: _jsp().gammaln(x),
     "logical_not": lambda x: (x == 0).astype(x.dtype),
-    "size_array": lambda x: _jnp().array([x.size], dtype=_np.int64),
-    "shape_array": lambda x: _jnp().array(x.shape, dtype=_np.int64),
+    # int64 in the reference; jax x64 is off, so int32 carries the values
+    # (shapes/sizes < 2^31 on one chip) without the truncation warning
+    "size_array": lambda x: _jnp().array([x.size], dtype=_np.int32),
+    "shape_array": lambda x: _jnp().array(x.shape, dtype=_np.int32),
 }
 
 for _name, _fn in _UNARY.items():
