@@ -128,30 +128,44 @@ def run(batch=256, k_steps=8, dtype=None, layout=None):
     return imgs_per_sec
 
 
-def run_inference(batch=256, dtype=None, layout=None, k_batches=8, reps=3):
+def run_inference(batch=256, dtype=None, layout=None, k_batches=8, reps=3,
+                  model=None):
     """Forward-only throughput (regenerates the README inference numbers:
     ref example/image-classification/benchmark_score.py).
 
     Like training, K forward batches are fused into ONE scanned XLA
     program so the ~100 ms tunneled-dispatch overhead is amortized — the
-    per-dispatch serving pattern would measure the relay, not the chip."""
+    per-dispatch serving pattern would measure the relay, not the chip.
+    MXTPU_BENCH_MODEL selects the architecture (resnet50_v1 default;
+    resnet152_v1 / inceptionv3 / vgg16 / alexnet cover the other
+    BASELINE.md rows — NCHW-only zoo models fall back to that layout)."""
     import numpy as np
     import jax
     import jax.numpy as jnp
     import mxnet_tpu as mx
     from mxnet_tpu.cached_op import make_scan_forward
-    from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+    from mxnet_tpu.gluon.model_zoo.vision import get_model, resnet50_v1
 
     if dtype is None:
         dtype = os.environ.get("MXTPU_BENCH_DTYPE", "bfloat16")
     if layout is None:
         layout = os.environ.get("MXTPU_BENCH_LAYOUT", "NHWC")
+    if model is None:
+        model = os.environ.get("MXTPU_BENCH_MODEL", "resnet50_v1")
     mx.random.seed(0)
-    net = resnet50_v1(layout=layout,
-                      stem_s2d=os.environ.get("MXTPU_BENCH_S2D", "1") != "0")
+    img = 299 if "inception" in model else 224
+    if model == "resnet50_v1":
+        net = resnet50_v1(layout=layout,
+                          stem_s2d=os.environ.get("MXTPU_BENCH_S2D",
+                                                  "1") != "0")
+    elif model.startswith("resnet"):
+        net = get_model(model, layout=layout)
+    else:
+        layout = "NCHW"  # non-resnet zoo models are channel-first
+        net = get_model(model)
     net.initialize(mx.init.Xavier())
-    shape = ((batch, 224, 224, 3) if layout == "NHWC"
-             else (batch, 3, 224, 224))
+    shape = ((batch, img, img, 3) if layout == "NHWC"
+             else (batch, 3, img, img))
     cdt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
     rs = np.random.RandomState(0)
     # materialize deferred-shape params on the HOST cpu device (fast; no
@@ -185,7 +199,8 @@ def run_inference(batch=256, dtype=None, layout=None, k_batches=8, reps=3):
     jax.block_until_ready(fwd_k(xs)._data)
     dt = time.perf_counter() - t0
     ips = batch * k_batches * reps / dt
-    log(f"inference: {ips:.1f} img/s (batch {batch}, {k_batches} fused)")
+    log(f"inference[{model}]: {ips:.1f} img/s (batch {batch}, "
+        f"{k_batches} fused)")
     return ips
 
 
