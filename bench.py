@@ -55,25 +55,34 @@ def _init_backend(timeout_s=900):
     return False
 
 
-def run(batch=256, k_steps=8, dtype=None, layout=None):
+def run(batch=256, k_steps=8, dtype=None, layout=None, model=None):
     import numpy as np
     import jax
     import jax.numpy as jnp
     import mxnet_tpu as mx
     from mxnet_tpu.gluon import loss as gloss
-    from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+    from mxnet_tpu.gluon.model_zoo.vision import get_model, resnet50_v1
     from mxnet_tpu.parallel import SPMDTrainer
 
     if dtype is None:
         dtype = os.environ.get("MXTPU_BENCH_DTYPE", "bfloat16")
     if layout is None:
         layout = os.environ.get("MXTPU_BENCH_LAYOUT", "NHWC")
+    if model is None:
+        model = os.environ.get("MXTPU_BENCH_MODEL", "resnet50_v1")
 
     mx.random.seed(0)
-    # space-to-depth stem (exact 7x7/2 reparametrization, MXU-efficient;
-    # see SpaceToDepthStem + tests/test_model_zoo.py equivalence test)
-    s2d = os.environ.get("MXTPU_BENCH_S2D", "1") != "0"
-    net = resnet50_v1(layout=layout, stem_s2d=s2d)
+    img = 299 if "inception" in model else 224
+    if model == "resnet50_v1":
+        # space-to-depth stem (exact 7x7/2 reparametrization; see
+        # SpaceToDepthStem + tests/test_model_zoo.py equivalence test)
+        s2d = os.environ.get("MXTPU_BENCH_S2D", "1") != "0"
+        net = resnet50_v1(layout=layout, stem_s2d=s2d)
+    elif model.startswith("resnet"):
+        net = get_model(model, layout=layout)
+    else:
+        layout = "NCHW"  # non-resnet zoo models are channel-first
+        net = get_model(model)
     net.initialize(mx.init.Xavier())
 
     trainer = SPMDTrainer(net, gloss.SoftmaxCrossEntropyLoss(),
@@ -83,8 +92,8 @@ def run(batch=256, k_steps=8, dtype=None, layout=None):
                           dtype=jnp.bfloat16 if dtype == "bfloat16" else None)
 
     rs = np.random.RandomState(0)
-    shape = ((k_steps, batch, 224, 224, 3) if layout == "NHWC"
-             else (k_steps, batch, 3, 224, 224))
+    shape = ((k_steps, batch, img, img, 3) if layout == "NHWC"
+             else (k_steps, batch, 3, img, img))
     # f32 input: it is resident on device once (the step casts to the
     # compute dtype inside the program, fused into the first conv)
     data = jnp.asarray(rs.rand(*shape).astype(np.float32))
