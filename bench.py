@@ -283,8 +283,9 @@ def main():
     # tighter-memory chips. k_steps amortizes dispatch overhead; batch
     # amortizes per-step fixed cost.
     # measured on one tunneled v5e chip (bf16 NHWC, round 3): 256x16 ->
-    # 2472 img/s (~30 TFLOP/s sustained vs the chip's ~73 TFLOP/s matmul
-    # peak — HBM-bandwidth-bound; see README perf ledger)
+    # 2494 img/s, 512x8 -> 2255 (bigger batch loses: same bytes/img,
+    # worse pipelining) — ~30 TFLOP/s sustained vs the chip's ~73 TFLOP/s
+    # matmul peak: HBM-bandwidth-bound; see README perf ledger
     configs = os.environ.get("MXTPU_BENCH_CONFIGS",
                              "256x16,256x8,128x8,128x2")
     last_err = None
