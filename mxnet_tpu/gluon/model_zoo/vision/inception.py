@@ -1,7 +1,23 @@
 """Inception V3 (ref: python/mxnet/gluon/model_zoo/vision/inception.py;
 "Rethinking the Inception Architecture for Computer Vision", Szegedy 2015).
 
-Input is 299x299. Every branch is convolutions + pooling, so the whole
+The whole architecture is expressed as a declarative table of compact
+unit strings (the same data-driven style as ``densenet.py``'s ``_SPEC``)
+interpreted by a ~20-line builder, rather than per-stage constructor
+functions.  Grammar for one unit:
+
+    "<ch>:<kh>[x<kw>][v|/2]"    conv -> BN(eps 1e-3) -> relu
+        kernel (kh, kw) (square if "x<kw>" absent); stride-1 convs are
+        SAME-padded (pad k//2 per dim) unless the "v" (valid) suffix is
+        present; "/2" means stride 2 and implies valid padding (the two
+        suffixes are mutually exclusive — every stride-2 conv in
+        Inception-v3 is valid-padded).
+    "avgpool"                   3x3 avg pool, stride 1, SAME
+    "maxpool"                   3x3 max pool, stride 2, valid
+    [branch, branch, ...]       nested concurrent split (used by the E
+                                blocks' 1x3/3x1 fan-outs)
+
+Input is 299x299.  Every branch is convolutions + pooling, so the whole
 network is one fused XLA program when hybridized; the HybridConcurrent
 branch joins become a single concat in HLO.
 """
@@ -11,109 +27,69 @@ from ...contrib.nn import HybridConcurrent
 
 __all__ = ["Inception3", "inception_v3"]
 
+# Stem: 299x299x3 -> 35x35x192.
+_STEM = ["32:3/2", "32:3v", "64:3", "maxpool", "80:1", "192:3v", "maxpool"]
 
-def _make_basic_conv(**kwargs):
+# One entry per mixed block, in network order: (tag, [branches]).
+# 3xA (35x35), reduction B (17x17), 4xC, reduction D (8x8), 2xE.
+_MIXED = (
+    [("A%d" % i, [["64:1"],
+                  ["48:1", "64:5"],
+                  ["64:1", "96:3", "96:3"],
+                  ["avgpool", "%d:1" % p]]) for i, p in enumerate((32, 64, 64), 1)]
+    + [("B", [["384:3/2"],
+              ["64:1", "96:3", "96:3/2"],
+              ["maxpool"]])]
+    + [("C%d" % i, [["192:1"],
+                    ["%d:1" % c, "%d:1x7" % c, "192:7x1"],
+                    ["%d:1" % c, "%d:7x1" % c, "%d:1x7" % c,
+                     "%d:7x1" % c, "192:1x7"],
+                    ["avgpool", "192:1"]]) for i, c in enumerate((128, 160, 160, 192), 1)]
+    + [("D", [["192:1", "320:3/2"],
+              ["192:1", "192:1x7", "192:7x1", "192:3/2"],
+              ["maxpool"]])]
+    + [("E%d" % i, [["320:1"],
+                    ["384:1", [["384:1x3"], ["384:3x1"]]],
+                    ["448:1", "384:3", [["384:1x3"], ["384:3x1"]]],
+                    ["avgpool", "192:1"]]) for i in (1, 2)]
+)
+
+
+def _unit(spec):
+    """Interpret one unit string of the grammar above into a block."""
+    if spec == "avgpool":
+        return nn.AvgPool2D(pool_size=3, strides=1, padding=1)
+    if spec == "maxpool":
+        return nn.MaxPool2D(pool_size=3, strides=2)
+    head, _, tail = spec.partition(":")
+    channels = int(head)
+    strides = 2 if tail.endswith("/2") else 1
+    valid = strides == 2 or tail.endswith("v")
+    kdims = tail.rstrip("v").split("/")[0].split("x")
+    kernel = tuple(int(k) for k in kdims) * (2 // len(kdims))
+    conv = nn.HybridSequential(prefix="")
+    conv.add(nn.Conv2D(channels, kernel_size=kernel, strides=strides,
+                       padding=(0, 0) if valid else tuple(k // 2 for k in kernel),
+                       use_bias=False))
+    conv.add(nn.BatchNorm(epsilon=0.001))
+    conv.add(nn.Activation("relu"))
+    return conv
+
+
+def _chain(units):
+    """A branch: sequential units, any of which may itself be a split."""
     out = nn.HybridSequential(prefix="")
-    out.add(nn.Conv2D(use_bias=False, **kwargs))
-    out.add(nn.BatchNorm(epsilon=0.001))
-    out.add(nn.Activation("relu"))
+    for u in units:
+        out.add(_split(u) if isinstance(u, list) else _unit(u))
     return out
 
 
-def _make_branch(use_pool, *conv_settings):
-    out = nn.HybridSequential(prefix="")
-    if use_pool == "avg":
-        out.add(nn.AvgPool2D(pool_size=3, strides=1, padding=1))
-    elif use_pool == "max":
-        out.add(nn.MaxPool2D(pool_size=3, strides=2))
-    setting_names = ["channels", "kernel_size", "strides", "padding"]
-    for setting in conv_settings:
-        kwargs = {}
-        for i, value in enumerate(setting):
-            if value is not None:
-                kwargs[setting_names[i]] = value
-        out.add(_make_basic_conv(**kwargs))
-    return out
-
-
-def _make_A(pool_features, prefix):
+def _split(branches, prefix=""):
+    """Concurrent branches joined by a channel concat."""
     out = HybridConcurrent(axis=1, prefix=prefix)
     with out.name_scope():
-        out.add(_make_branch(None, (64, 1, None, None)))
-        out.add(_make_branch(None, (48, 1, None, None),
-                             (64, 5, None, 2)))
-        out.add(_make_branch(None, (64, 1, None, None),
-                             (96, 3, None, 1),
-                             (96, 3, None, 1)))
-        out.add(_make_branch("avg", (pool_features, 1, None, None)))
-    return out
-
-
-def _make_B(prefix):
-    out = HybridConcurrent(axis=1, prefix=prefix)
-    with out.name_scope():
-        out.add(_make_branch(None, (384, 3, 2, None)))
-        out.add(_make_branch(None, (64, 1, None, None),
-                             (96, 3, None, 1),
-                             (96, 3, 2, None)))
-        out.add(_make_branch("max"))
-    return out
-
-
-def _make_C(channels_7x7, prefix):
-    out = HybridConcurrent(axis=1, prefix=prefix)
-    with out.name_scope():
-        out.add(_make_branch(None, (192, 1, None, None)))
-        out.add(_make_branch(None, (channels_7x7, 1, None, None),
-                             (channels_7x7, (1, 7), None, (0, 3)),
-                             (192, (7, 1), None, (3, 0))))
-        out.add(_make_branch(None, (channels_7x7, 1, None, None),
-                             (channels_7x7, (7, 1), None, (3, 0)),
-                             (channels_7x7, (1, 7), None, (0, 3)),
-                             (channels_7x7, (7, 1), None, (3, 0)),
-                             (192, (1, 7), None, (0, 3))))
-        out.add(_make_branch("avg", (192, 1, None, None)))
-    return out
-
-
-def _make_D(prefix):
-    out = HybridConcurrent(axis=1, prefix=prefix)
-    with out.name_scope():
-        out.add(_make_branch(None, (192, 1, None, None),
-                             (320, 3, 2, None)))
-        out.add(_make_branch(None, (192, 1, None, None),
-                             (192, (1, 7), None, (0, 3)),
-                             (192, (7, 1), None, (3, 0)),
-                             (192, 3, 2, None)))
-        out.add(_make_branch("max"))
-    return out
-
-
-def _make_E(prefix):
-    out = HybridConcurrent(axis=1, prefix=prefix)
-    with out.name_scope():
-        out.add(_make_branch(None, (320, 1, None, None)))
-
-        branch_3x3 = nn.HybridSequential(prefix="")
-        out.add(branch_3x3)
-        branch_3x3.add(_make_branch(None, (384, 1, None, None)))
-        branch_3x3_split = HybridConcurrent(axis=1, prefix="")
-        branch_3x3_split.add(_make_branch(None, (384, (1, 3), None, (0, 1))))
-        branch_3x3_split.add(_make_branch(None, (384, (3, 1), None, (1, 0))))
-        branch_3x3.add(branch_3x3_split)
-
-        branch_3x3dbl = nn.HybridSequential(prefix="")
-        out.add(branch_3x3dbl)
-        branch_3x3dbl.add(_make_branch(None, (448, 1, None, None),
-                                       (384, 3, None, 1)))
-        branch_3x3dbl_split = HybridConcurrent(axis=1, prefix="")
-        branch_3x3dbl.add(branch_3x3dbl_split)
-        branch_3x3dbl_split.add(_make_branch(None,
-                                             (384, (1, 3), None, (0, 1))))
-        branch_3x3dbl_split.add(_make_branch(None,
-                                             (384, (3, 1), None, (1, 0))))
-
-        out.add(_make_branch("avg", (192, 1, None, None)))
+        for units in branches:
+            out.add(_chain(units))
     return out
 
 
@@ -124,26 +100,10 @@ class Inception3(HybridBlock):
         super().__init__(**kwargs)
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
-            self.features.add(_make_basic_conv(channels=32, kernel_size=3,
-                                               strides=2))
-            self.features.add(_make_basic_conv(channels=32, kernel_size=3))
-            self.features.add(_make_basic_conv(channels=64, kernel_size=3,
-                                               padding=1))
-            self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-            self.features.add(_make_basic_conv(channels=80, kernel_size=1))
-            self.features.add(_make_basic_conv(channels=192, kernel_size=3))
-            self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-            self.features.add(_make_A(32, "A1_"))
-            self.features.add(_make_A(64, "A2_"))
-            self.features.add(_make_A(64, "A3_"))
-            self.features.add(_make_B("B_"))
-            self.features.add(_make_C(128, "C1_"))
-            self.features.add(_make_C(160, "C2_"))
-            self.features.add(_make_C(160, "C3_"))
-            self.features.add(_make_C(192, "C4_"))
-            self.features.add(_make_D("D_"))
-            self.features.add(_make_E("E1_"))
-            self.features.add(_make_E("E2_"))
+            for spec in _STEM:
+                self.features.add(_unit(spec))
+            for tag, branches in _MIXED:
+                self.features.add(_split(branches, prefix=tag + "_"))
             self.features.add(nn.AvgPool2D(pool_size=8))
             self.features.add(nn.Dropout(0.5))
             self.output = nn.Dense(classes)
