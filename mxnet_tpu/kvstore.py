@@ -132,6 +132,15 @@ class KVStoreBase:
             return vals[0]
         import jax
         arrays = [v._data for v in vals]
+        # values pushed from different workers arrive committed to
+        # different devices; gather them onto the first value's device
+        # before the fused sum (ref: CommDevice gathers onto the merge
+        # device before reducing)
+        devsets = {frozenset(getattr(a, "devices", lambda: ())())
+                   for a in arrays if hasattr(a, "devices")}
+        if len(devsets) > 1:
+            dev = next(iter(arrays[0].devices()))
+            arrays = jax.device_put(arrays, dev)
         total = jax.jit(lambda xs: sum(xs[1:], xs[0]))(arrays)
         return _nd.NDArray(total, ctx=vals[0]._ctx)
 
@@ -413,6 +422,42 @@ class KVStoreDistTPU(KVStoreBase):
         from .parallel.collectives import barrier as _barrier
         _barrier(self._mesh)
 
+    # -- worker command channel (ref: kvstore_dist.h SendCommandToServers,
+    # profiler commands kvstore.h:49) --------------------------------------
+    def _send_command_to_servers(self, head, body) -> None:
+        """Broadcast a command to every worker process's command endpoint
+        (the reference sends to server processes; the TPU design has no
+        server role, so 'servers' = the worker group)."""
+        for r in range(self._nproc):
+            self._command_rank(r, str(head), str(body))
+
+    def _command_rank(self, r: int, head: str, body: str) -> str:
+        """One command to rank r — loopback for self (works single-process
+        and skips a TCP round-trip), the command channel for peers."""
+        from . import kvstore_server
+        if r == self._rank:
+            return kvstore_server._handle_command(head, body)
+        return kvstore_server.send_command(r, head, body)
+
+    def send_command_to_servers(self, head, body) -> None:
+        """(ref: MXKVStoreSendCommmandToServers) public alias."""
+        self._send_command_to_servers(head, body)
+
+    def send_profiler_command(self, cmd: str, body: str = "",
+                              rank=None) -> list:
+        """Remote-control the profiler of worker `rank` (or all workers).
+
+        cmd in {set_config, state, pause, resume, dump, dumps} — the
+        KVStoreServerProfilerCommand set (kvstore.h:49). Returns the list
+        of reply payloads (`dump`/`dumps` return the remote trace /
+        aggregate table, so the controller collects profiles without a
+        shared filesystem)."""
+        check(cmd in ("set_config", "state", "pause", "resume", "dump",
+                      "dumps"), f"unknown profiler command {cmd!r}")
+        ranks = range(self._nproc) if rank is None else [int(rank)]
+        return [self._command_rank(r, f"profiler.{cmd}", body)
+                for r in ranks]
+
 
 @functools.lru_cache(maxsize=None)
 def _local_shard_mesh():
@@ -451,4 +496,10 @@ def create(name: str = "local") -> KVStoreBase:
     key = name.lower()
     if key not in _TYPES:
         raise MXNetError(f"unknown KVStore type {name!r}")
-    return _TYPES[key]()
+    kv = _TYPES[key]()
+    if isinstance(kv, KVStoreDistTPU):
+        # register as the profiler's command transport (the reference
+        # stores the handle at creation: profiler.set_kvstore_handle)
+        from . import profiler
+        profiler.set_kvstore_handle(kv)
+    return kv

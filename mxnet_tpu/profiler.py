@@ -27,7 +27,30 @@ from .base import MXNetError, check, env
 
 __all__ = ["set_config", "set_state", "state", "dump", "dumps", "pause",
            "resume", "Domain", "Task", "Event", "Frame", "Counter",
-           "Marker", "record_span", "start_xla_trace", "stop_xla_trace"]
+           "Marker", "record_span", "start_xla_trace", "stop_xla_trace",
+           "set_kvstore_handle"]
+
+# dist kvstore registered at creation; profile_process='server' commands
+# ride its worker command channel (ref: python/mxnet/profiler.py:27-31
+# profiler_kvstore_handle + KVStoreServerProfilerCommand, kvstore.h:49)
+_kvstore = None
+
+
+def set_kvstore_handle(kv) -> None:
+    """(ref: profiler.set_kvstore_handle)"""
+    global _kvstore
+    _kvstore = kv
+
+
+def _route_server(cmd: str, body: str = "") -> bool:
+    """True when the command was shipped to the remote worker group."""
+    if _kvstore is None:
+        from .base import MXNetError
+        raise MXNetError("profile_process='server' needs a dist kvstore "
+                         "(create one first; ref: 'server can only be "
+                         "profiled when kvstore is of type dist')")
+    _kvstore.send_profiler_command(cmd, body)
+    return True
 
 _lock = threading.Lock()
 _config = {"filename": "profile.json", "profile_all": False,
@@ -40,14 +63,20 @@ _agg: Dict[str, List[float]] = defaultdict(list)
 _t0 = time.perf_counter()
 
 
-def set_config(**kwargs) -> None:
+def set_config(profile_process: str = "worker", **kwargs) -> None:
     """(ref: MXSetProcessProfilerConfig / python profiler.set_config)"""
+    if profile_process == "server":
+        _route_server("set_config", json.dumps(kwargs))
+        return
     for k, v in kwargs.items():
         _config[k] = v
 
 
 def set_state(state_name: str = "stop", profile_process: str = "worker") -> None:
     check(state_name in ("run", "stop"), "state must be run|stop")
+    if profile_process == "server":
+        _route_server("state", state_name)
+        return
     was = _state["running"]
     _state["running"] = state_name == "run"
     if was and not _state["running"] and _config.get("continuous_dump"):
@@ -59,10 +88,16 @@ def state() -> str:
 
 
 def pause(profile_process: str = "worker") -> None:
+    if profile_process == "server":
+        _route_server("pause")
+        return
     _state["paused"] = True
 
 
 def resume(profile_process: str = "worker") -> None:
+    if profile_process == "server":
+        _route_server("resume")
+        return
     _state["paused"] = False
 
 
@@ -89,6 +124,9 @@ def record_span(name: str, category: str, t_start: float, t_end: float,
 
 def dump(finished: bool = True, profile_process: str = "worker") -> None:
     """Write chrome-trace JSON (ref: profiler.h:437 dump to profile.json)."""
+    if profile_process == "server":
+        _route_server("dump")
+        return
     with _lock:
         payload = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
     with open(_config["filename"], "w") as f:

@@ -1,0 +1,71 @@
+"""Remote profiler control over the worker command channel
+(ref: tests/nightly/ + kvstore_dist_server.h:276-287 profiler commands).
+
+Rank 0 is the controller: it remote-configures and starts rank 1's
+profiler, lets rank 1 record kvstore work, then collects rank 1's
+chrome-trace over the wire and asserts it contains events.
+"""
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+
+def main():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "..", ".."))
+    from mxnet_tpu.kvstore_server import init_distributed
+    assert init_distributed(), "MXTPU_* env missing (run via tools/launch.py)"
+    import mxnet_tpu as mx
+    from mxnet_tpu import profiler
+
+    kv = mx.kv.create("dist_tpu_sync")
+    rank, nw = kv.rank, kv.num_workers
+
+    if rank == 0:
+        # configure + start the REMOTE rank's profiler (the reference's
+        # kSetConfig + kState ride the ps-lite command channel)
+        kv.send_profiler_command(
+            "set_config",
+            json.dumps({"filename": f"/tmp/mxtpu_remote_prof_{nw}.json",
+                        "aggregate_stats": True}), rank=1)
+        kv.send_profiler_command("state", "run", rank=1)
+    kv.barrier()
+
+    # every rank does some eager + kvstore work; only rank 1 records
+    assert profiler.state() == ("run" if rank == 1 else "stop"), \
+        f"rank {rank} unexpected profiler state {profiler.state()}"
+    kv.init("w", mx.nd.zeros((4, 4)))
+    for _ in range(3):
+        kv.push("w", mx.nd.full((4, 4), float(rank + 1)))
+        out = mx.nd.zeros((4, 4))
+        kv.pull("w", out=out)
+        (out * 2 + 1).asnumpy()
+    kv.barrier()
+
+    if rank == 0:
+        # pause/resume round-trips (kPause)
+        kv.send_profiler_command("pause", rank=1)
+        kv.send_profiler_command("resume", rank=1)
+        # collect the remote trace + aggregate table (kDump)
+        trace = kv.send_profiler_command("dump", rank=1)[0]
+        events = json.loads(trace)["traceEvents"]
+        assert len(events) > 0, "remote trace has no events"
+        table = kv.send_profiler_command("dumps", rank=1)[0]
+        assert "Total(ms)" in table, table[:200]
+        # the profiler.py surface routes profile_process='server' the
+        # same way (reference python API parity)
+        profiler.set_kvstore_handle(kv)
+        profiler.set_state("stop", profile_process="server")
+        print(f"controller collected remote trace: {len(events)} events")
+    kv.barrier()
+    print(f"worker {rank}/{nw}: profiler command checks passed")
+
+
+if __name__ == "__main__":
+    main()

@@ -35,11 +35,19 @@ import subprocess
 import sys
 
 
-def _worker_env(args, rank):
+def _worker_env(args, rank, placement=None):
     env = {
         "MXTPU_COORDINATOR": args.coordinator,
         "MXTPU_NUM_WORKERS": str(args.num_workers),
         "MXTPU_WORKER_ID": str(rank),
+        # rank -> host placement: lets any worker reach any other's
+        # command endpoint (profiler remote control; kvstore_server.py).
+        # An operator-supplied MXTPU_WORKER_HOSTS wins — the mpi launcher
+        # cannot know mpirun's placement, so multi-host MPI jobs set it
+        # explicitly
+        "MXTPU_WORKER_HOSTS": os.environ.get(
+            "MXTPU_WORKER_HOSTS",
+            ",".join(placement or ["127.0.0.1"] * args.num_workers)),
         # reference-compatible aliases (DMLC_* consumers: fault.Heartbeat
         # rank default, ported worker scripts)
         "DMLC_PS_ROOT_URI": args.coordinator.rsplit(":", 1)[0],
@@ -105,7 +113,7 @@ def launch_ssh(args, cmd):
     procs = []
     for rank in range(args.num_workers):
         env = dict(fwd)
-        env.update(_worker_env(args, rank))
+        env.update(_worker_env(args, rank, placement))
         exports = " ".join(f"export {k}={shlex.quote(v)};"
                            for k, v in sorted(env.items()))
         quoted_cmd = " ".join(shlex.quote(c) for c in cmd)
