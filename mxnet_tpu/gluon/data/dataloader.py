@@ -252,12 +252,18 @@ class DataLoader:
         result_q = ctx.Queue()
         if self._batchify_fn is default_batchify_fn:
             batchify = _np_batchify
-        elif self._batchify_pickle is not None:
-            batchify = self._batchify_pickle
         else:
-            # explicit thread_pool=False with an unpicklable callable:
-            # fork inheritance still carries it (the pre-round-4 path)
-            batchify = self._batchify_fn
+            if self._batchify_pickle is None:
+                # explicit thread_pool=False skipped the auto-mode pickle
+                # attempt: still prefer shipping a pickle (fresh objects
+                # in the child, no parent-closure aliasing); only an
+                # unpicklable callable rides fork inheritance
+                import pickle
+                try:
+                    self._batchify_pickle = pickle.dumps(self._batchify_fn)
+                except Exception:
+                    pass
+            batchify = self._batchify_pickle or self._batchify_fn
         workers = [ctx.Process(target=_worker_loop,
                                args=(self._dataset, batchify, task_q,
                                      result_q), daemon=True)

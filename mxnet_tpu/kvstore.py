@@ -136,7 +136,7 @@ class KVStoreBase:
         # different devices; gather them onto the first value's device
         # before the fused sum (ref: CommDevice gathers onto the merge
         # device before reducing)
-        devsets = {frozenset(getattr(a, "devices", lambda: ())())
+        devsets = {frozenset(a.devices())
                    for a in arrays if hasattr(a, "devices")}
         if len(devsets) > 1:
             dev = next(iter(arrays[0].devices()))

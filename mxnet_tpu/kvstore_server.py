@@ -142,6 +142,8 @@ def _serve(sock) -> None:
         with conn:
             try:
                 req = _recv_msg(conn)
+                if req.get("token", "") != _cmd_token():
+                    raise PermissionError("bad or missing command token")
                 payload = _handle_command(req.get("head", ""),
                                           req.get("body", ""))
                 _send_msg(conn, {"ok": True, "payload": payload})
@@ -150,6 +152,15 @@ def _serve(sock) -> None:
                     _send_msg(conn, {"ok": False, "error": str(e)})
                 except Exception:
                     pass
+
+
+def _cmd_token() -> str:
+    """Shared job token (MXTPU_CMD_TOKEN, set by tools/launch.py): every
+    command must carry it. Without a token the endpoint binds LOOPBACK
+    only — an unauthenticated 0.0.0.0 listener whose set_config can point
+    the dump at an arbitrary path would hand remote control to any
+    network peer."""
+    return os.environ.get("MXTPU_CMD_TOKEN", "")
 
 
 def start_command_server():
@@ -165,7 +176,7 @@ def start_command_server():
             return None
         sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        sock.bind(("", port))
+        sock.bind(("" if _cmd_token() else "127.0.0.1", port))
         sock.listen(8)
         t = threading.Thread(target=_serve, args=(sock,), daemon=True,
                              name="mxtpu-cmd-server")
@@ -190,12 +201,15 @@ def send_command(rank: int, head: str, body: str = "",
         try:
             conn = socket.create_connection((host, port), timeout=timeout)
             break
-        except (ConnectionRefusedError, socket.timeout, OSError):
+        except (ConnectionRefusedError, socket.timeout):
+            # only the documented bind race retries; unreachable hosts /
+            # DNS errors (other OSErrors) fail fast
             if time.monotonic() >= deadline:
                 raise
             time.sleep(0.1)
     with conn:
-        _send_msg(conn, {"head": head, "body": body})
+        _send_msg(conn, {"head": head, "body": body,
+                         "token": _cmd_token()})
         rep = _recv_msg(conn)
     if not rep.get("ok"):
         raise MXNetError(f"worker {rank} command {head!r} failed: "

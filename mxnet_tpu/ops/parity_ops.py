@@ -147,22 +147,12 @@ def _random_gen_neg_binomial_like(data, _key, mu=1.0, alpha=1.0, **_):
                       data.shape).astype(data.dtype)
 
 
-@register("_sample_unique_zipfian", creation=True, rng=True,
-          differentiable=False)
-def _sample_unique_zipfian(_key, range_max=1, shape=(1,), **_):
-    """Approximately-unique Zipfian negatives (ref: sample_op.cc
-    _sample_unique_zipfian, the sampled-softmax helper). Sampling uses the
-    log-uniform inverse-CDF; expected counts come back alongside."""
-    jnp = _jnp()
-    n = int(_np.prod(shape))
-    u = _jr().uniform(_key, (n,), jnp.float32, 1e-9, 1.0)
-    log_range = jnp.log(float(range_max) + 1.0)
-    samples = jnp.minimum(
-        jnp.exp(u * log_range).astype(jnp.int32) - 1, range_max - 1)
-    # expected count of each drawn id under the zipfian proposal
-    probs = jnp.log((samples + 2.0) / (samples + 1.0)) / log_range
-    counts = -jnp.expm1(n * jnp.log1p(-probs))
-    return samples.reshape(shape), counts.reshape(shape)
+# _sample_unique_zipfian: the reference's registered name for the unique
+# log-uniform candidate sampler — one implementation (random_ops.py, the
+# rejection sampler returning (samples, num_tries)), two registry names.
+# A second approximate implementation used to live here; divergent
+# semantics under a near-identical name is exactly how facades start.
+alias("_sample_unique_zipfian", "sample_unique_zipfian")
 
 
 # ---------------------------------------------------------------------------

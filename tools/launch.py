@@ -233,6 +233,14 @@ def main():
         ap.error("no command given")
     cmd = args.command
 
+    # one shared command-channel token per job: workers authenticate the
+    # profiler/command endpoint with it, and ONLY with a token do they
+    # bind non-loopback interfaces (kvstore_server.py). Forwarded to
+    # every rank by the MXTPU_ prefix rule of _forward_env.
+    if "MXTPU_CMD_TOKEN" not in os.environ:
+        import uuid
+        os.environ["MXTPU_CMD_TOKEN"] = uuid.uuid4().hex
+
     if args.launcher == "manual":
         for rank in range(args.num_workers):
             env = " ".join(f"{k}={v}" for k, v in
