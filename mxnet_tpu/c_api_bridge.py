@@ -149,12 +149,15 @@ def symbol_create_variable(name: str):
     return sym.var(name)
 
 
-def symbol_compose(s, name, input_syms) -> None:
+def symbol_compose(s, name, input_syms, input_names=None) -> None:
     """Attach inputs to an input-less atomic symbol in place (ref:
     MXSymbolCompose — the CreateAtomicSymbol+Compose two-step every
-    language binding uses). Positional composition: rebuild the node via
-    symbol.create so aux auto-creation AND supplied-aux marking behave
-    exactly like the python frontend."""
+    language binding uses). Rebuilds the node via symbol.create so aux
+    auto-creation AND supplied-aux marking behave exactly like the python
+    frontend. ``input_names`` (the C API's ``keys``) selects KEYWORD
+    composition: each input binds the declared argument slot of that name
+    (ref: nnvm Symbol::Compose kwargs path); unbound interior slots
+    become free variables named ``{node}_{arg}`` like auto-creation."""
     node = s._outputs[0][0]
     check(node.op is not None, "cannot compose a variable")
     # an uncomposed atomic symbol carries only AUTO-CREATED placeholder
@@ -164,6 +167,35 @@ def symbol_compose(s, name, input_syms) -> None:
                    if not (i.is_variable and (i.extra.get("aux", False) or
                                               i.extra.get("auto", False)))]
     check(not real_inputs, "symbol already composed")
+    if input_names:
+        from mxnet_tpu.base import coerce_param
+        from mxnet_tpu.ops.opdoc import _split_params
+        req_inputs, fn_params, variadic = _split_params(node.op)
+        req_inputs = list(req_inputs)
+        # the no_bias-gated variadic slot of FC/Conv-style ops is a real
+        # keyword-addressable argument (ListArguments reports it)
+        if variadic and any(n == "no_bias" for n, _ in fn_params) and \
+                not coerce_param(node.attrs.get("no_bias", False)):
+            req_inputs.append("bias")
+        slots = {n: i for i, n in enumerate(req_inputs)}
+        ordered = [None] * len(req_inputs)
+        for nm, isym in zip(input_names, input_syms):
+            nm = str(nm)
+            check(nm in slots,
+                  f"MXSymbolCompose: op {node.op.name} has no input named "
+                  f"{nm!r}; arguments: {req_inputs}")
+            check(ordered[slots[nm]] is None,
+                  f"MXSymbolCompose: duplicate keyword input {nm!r}")
+            ordered[slots[nm]] = isym
+        base = str(name) if name else node.name
+        input_syms = []
+        for i, arg in enumerate(req_inputs):
+            if ordered[i] is not None:
+                input_syms.append(ordered[i])
+            elif any(o is not None for o in ordered[i + 1:]):
+                input_syms.append(sym.var(f"{base}_{arg}"))
+            else:
+                break  # trailing gap: create() auto-names the rest
     from mxnet_tpu.symbol.symbol import create
     composed = create(node.op.name, list(input_syms), dict(node.attrs),
                       name=str(name) if name else node.name)
@@ -1299,3 +1331,144 @@ def symbol_get_input_symbols(s):
     (ref: MXSymbolGetInputSymbols, c_api_symbolic.cc GetInputSymbols)."""
     from mxnet_tpu.symbol.symbol import Symbol
     return [Symbol([(n, 0)]) for n in s._variables()]
+
+
+# -- C-callback custom ops (MXCustomOpRegister / MXCustomFunctionRecord) ----
+
+def custom_c_op_register(op_type: str) -> None:
+    """Adapter: a CustomOpProp subclass whose every hook delegates to the
+    C callbacks a frontend registered through MXCustomOpRegister. The
+    callback tables live in libmxtpu_capi (`_mxtpu_chost`, planted in
+    sys.modules by the C side); tag/req codes match
+    src/operator/custom/custom.cc exactly, so a callback written against
+    the reference runtime behaves identically here."""
+    import _mxtpu_chost as chost
+    from mxnet_tpu import operator as op_mod
+
+    (P_DEL, P_ARGS, P_OUTS, P_AUX, P_SHAPE, P_DEP, P_CREATE,
+     P_TYPE) = range(8)
+    O_DEL, O_FWD, O_BWD = range(3)
+    REQ = {"null": 0, "write": 1, "inplace": 2, "add": 3}
+
+    class _COp(op_mod.CustomOp):
+        def __init__(self, oid):
+            self._oid = oid
+
+        def forward(self, is_train, req, in_data, out_data, aux):
+            handles = list(in_data) + list(out_data) + list(aux)
+            tags = ([0] * len(in_data) + [1] * len(out_data)
+                    + [4] * len(aux))
+            chost.op_call(self._oid, O_FWD, handles, tags,
+                          [REQ.get(r, 1) for r in req], int(bool(is_train)))
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            handles = (list(out_grad) + list(in_data) + list(out_data)
+                       + list(in_grad) + list(aux))
+            tags = ([3] * len(out_grad) + [0] * len(in_data)
+                    + [1] * len(out_data) + [2] * len(in_grad)
+                    + [4] * len(aux))
+            chost.op_call(self._oid, O_BWD, handles, tags,
+                          [REQ.get(r, 1) for r in req], 1)
+
+        def __del__(self):
+            try:
+                chost.release(self._oid, O_DEL)
+            except Exception:
+                pass
+
+    class _CProp(op_mod.CustomOpProp):
+        def __init__(self, **kwargs):
+            super().__init__(need_top_grad=True)
+            self._h = chost.create_prop(
+                op_type, [str(k) for k in kwargs],
+                [str(v) for v in kwargs.values()])
+            # the name lists are fixed per prop: one C round-trip each,
+            # not four per shape/type inference
+            self._args = chost.prop_list(self._h, P_ARGS) or ["data"]
+            self._outs = chost.prop_list(self._h, P_OUTS) or ["output"]
+            self._aux = chost.prop_list(self._h, P_AUX)
+
+        def list_arguments(self):
+            return self._args
+
+        def list_outputs(self):
+            return self._outs
+
+        def list_auxiliary_states(self):
+            return self._aux
+
+        def infer_shape(self, in_shape):
+            res = chost.prop_infer_shape(
+                self._h, [list(map(int, s)) for s in in_shape],
+                len(self._outs), len(self._aux))
+            if res is None:
+                return super().infer_shape(in_shape)
+            n_in = len(in_shape)
+            return (res[:n_in], res[n_in:n_in + len(self._outs)],
+                    res[n_in + len(self._outs):])
+
+        def infer_type(self, in_type):
+            res = chost.prop_infer_type(
+                self._h, [int(_DTYPE_RCODES[np.dtype(t)]) for t in in_type],
+                len(self._outs), len(self._aux))
+            if res is None:
+                return super().infer_type(in_type)
+            # -1 = "unknown, defer" (the sentinel the host seeds slots
+            # with; reference type inference treats it the same way)
+            default = np.dtype(in_type[0]).type if in_type else np.float32
+            tys = [default if c < 0 else _DTYPE_CODES[c] for c in res]
+            n_in = len(in_type)
+            return (tys[:n_in], tys[n_in:n_in + len(self._outs)],
+                    tys[n_in + len(self._outs):])
+
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            oid = chost.prop_create_operator(
+                self._h, str(ctx), [list(map(int, s)) for s in in_shapes],
+                [int(_DTYPE_RCODES[np.dtype(t)]) for t in in_dtypes])
+            return _COp(oid)
+
+        def __del__(self):
+            try:
+                chost.release(self._h, P_DEL)
+            except Exception:
+                pass
+
+    op_mod.register(op_type)(_CProp)
+
+
+def custom_function_record(inputs, outputs, fid) -> None:
+    """Record a C-callback autograd Function on the tape (ref:
+    MXCustomFunctionRecord, src/c_api/c_api_function.cc): backward hands
+    the callback ograds followed by writable igrads (tags 0 then 1 in the
+    reference's layout) and the callback fills the igrads through the
+    same C API."""
+    from mxnet_tpu import autograd
+    from mxnet_tpu.base import check as _check
+    from mxnet_tpu.ndarray.ndarray import from_jax
+    import _mxtpu_chost as chost
+    import jax.numpy as jnp
+
+    _check(autograd.is_recording(),
+           "MXCustomFunctionRecord outside autograd recording scope "
+           "(ref: Imperative::is_recording check)")
+    ins = tuple(inputs)
+    outs = tuple(outputs)
+
+    class _CFunction(autograd.Function):
+        def backward(self, *ograds):
+            igrads = [from_jax(jnp.zeros_like(x._data)) for x in ins]
+            handles = list(ograds) + igrads
+            chost.func_backward(fid, len(ograds), len(igrads), handles,
+                                [1] * len(igrads), 1)
+            return tuple(igrads)
+
+        def __del__(self):
+            # kCustomFunctionDelete fires when the tape node dies (the
+            # reference ties it to op-state destruction) — NOT after the
+            # first backward, which may legitimately run more than once
+            try:
+                chost.release(fid, 1)
+            except Exception:
+                pass
+
+    autograd._record_custom(_CFunction(), ins, outs)

@@ -1,0 +1,428 @@
+/* AI::MXNetTPU — minimal Perl XS binding over the flat C API
+ * (ref: perl-package/AI-MXNet — the reference ships a full Perl frontend
+ * over the same libmxnet C ABI; this module proves the same portability
+ * claim for libmxtpu_capi/libmxtpu_predict: NDArray lifecycle,
+ * imperative invoke, the predict API, and a C-callback custom op
+ * registered through MXCustomOpRegister).
+ *
+ * Everything below talks ONLY to the flat C API — no Python, no
+ * mxnet_tpu internals.
+ */
+#define PERL_NO_GET_CONTEXT
+#include "EXTERN.h"
+#include "perl.h"
+#include "XSUB.h"
+
+#include <dlfcn.h>
+#include <stdlib.h>
+#include <string.h>
+
+typedef unsigned int mx_uint;
+typedef void *NDArrayHandle;
+typedef void *PredictorHandle;
+
+struct MXCallbackList {
+  int num_callbacks;
+  int (**callbacks)(void);
+  void **contexts;
+};
+
+/* c_api surface used (signatures: include/mxnet/c_api.h contract) */
+extern const char *MXGetLastError(void);
+extern int MXNDArrayCreateEx(const mx_uint *, mx_uint, int, int, int, int,
+                             NDArrayHandle *);
+extern int MXNDArrayFree(NDArrayHandle);
+extern int MXNDArraySyncCopyFromCPU(NDArrayHandle, const void *, size_t);
+extern int MXNDArraySyncCopyToCPU(NDArrayHandle, void *, size_t);
+extern int MXNDArrayGetShape(NDArrayHandle, mx_uint *, const mx_uint **);
+extern int MXImperativeInvoke(const char *, int, NDArrayHandle *, int *,
+                              NDArrayHandle **, int, const char **,
+                              const char **);
+extern int MXCustomOpRegister(const char *, int (*)(const char *, int,
+                                                    const char **,
+                                                    const char **,
+                                                    struct MXCallbackList *));
+/* c_predict surface */
+extern int MXPredCreate(const char *, const void *, int, int, int, mx_uint,
+                        const char **, const mx_uint *, const mx_uint *,
+                        PredictorHandle *);
+extern int MXPredSetInput(PredictorHandle, const char *, const float *,
+                          mx_uint);
+extern int MXPredForward(PredictorHandle);
+extern int MXPredGetOutputShape(PredictorHandle, mx_uint, mx_uint **,
+                                mx_uint *);
+extern int MXPredGetOutput(PredictorHandle, mx_uint, float *, mx_uint);
+extern int MXPredFree(PredictorHandle);
+
+/* ---- helpers ---------------------------------------------------------- */
+
+static void croak_on(pTHX_ int rc, const char *what) {
+  if (rc != 0) croak("%s failed: %s", what, MXGetLastError());
+}
+
+static size_t av_to_floats(pTHX_ AV *av, float **out) {
+  size_t n = av_count(av);
+  float *buf = (float *)malloc(n * sizeof(float));
+  size_t i;
+  for (i = 0; i < n; ++i) {
+    SV **e = av_fetch(av, i, 0);
+    buf[i] = e ? (float)SvNV(*e) : 0.0f;
+  }
+  *out = buf;
+  return n;
+}
+
+/* ---- demo custom op: perl_sqr (x -> x*x, dx = 2*x*gy) ----------------- */
+/* the callbacks do their math through the SAME flat C API, like any
+ * frontend-supplied custom op (ref custom.cc tag protocol:
+ * fwd ptrs = in(0)+out(1)+aux(4); bwd = ograd(3)+in(0)+out(1)+igrad(2)) */
+
+static float *read_handle(void *h, size_t *out_n) {
+  mx_uint ndim = 0;
+  const mx_uint *shape = NULL;
+  size_t n = 1, i;
+  float *buf;
+  if (MXNDArrayGetShape(h, &ndim, &shape) != 0) return NULL;
+  for (i = 0; i < ndim; ++i) n *= shape[i];
+  buf = (float *)malloc(n * sizeof(float));
+  if (MXNDArraySyncCopyToCPU(h, buf, n) != 0) { free(buf); return NULL; }
+  *out_n = n;
+  return buf;
+}
+
+static int sqr_forward(int size, void **ptrs, int *tags, const int *reqs,
+                       int is_train, void *state) {
+  void *in = NULL, *out = NULL;
+  size_t n = 0, i;
+  float *x;
+  int k;
+  (void)reqs; (void)is_train; (void)state;
+  for (k = 0; k < size; ++k) {
+    if (tags[k] == 0 && in == NULL) in = ptrs[k];
+    if (tags[k] == 1 && out == NULL) out = ptrs[k];
+  }
+  x = read_handle(in, &n);
+  if (x == NULL) return 0;
+  for (i = 0; i < n; ++i) x[i] *= x[i];
+  k = MXNDArraySyncCopyFromCPU(out, x, n) == 0;
+  free(x);
+  return k;
+}
+
+static int sqr_backward(int size, void **ptrs, int *tags, const int *reqs,
+                        int is_train, void *state) {
+  void *og = NULL, *in = NULL, *ig = NULL;
+  size_t n = 0, m = 0, i;
+  float *gy, *x;
+  int k;
+  (void)reqs; (void)is_train; (void)state;
+  for (k = 0; k < size; ++k) {
+    if (tags[k] == 3 && og == NULL) og = ptrs[k];
+    if (tags[k] == 0 && in == NULL) in = ptrs[k];
+    if (tags[k] == 2 && ig == NULL) ig = ptrs[k];
+  }
+  gy = read_handle(og, &n);
+  x = read_handle(in, &m);
+  if (gy == NULL || x == NULL || n != m) { free(gy); free(x); return 0; }
+  for (i = 0; i < n; ++i) x[i] = 2.0f * x[i] * gy[i];
+  k = MXNDArraySyncCopyFromCPU(ig, x, n) == 0;
+  free(gy);
+  free(x);
+  return k;
+}
+
+static int sqr_del(void *state) { (void)state; return 1; }
+
+static int sqr_list_args(char ***out, void *state) {
+  static char *names[] = {(char *)"data", NULL};
+  (void)state;
+  *out = names;
+  return 1;
+}
+
+static int sqr_list_outs(char ***out, void *state) {
+  static char *names[] = {(char *)"output", NULL};
+  (void)state;
+  *out = names;
+  return 1;
+}
+
+static int sqr_list_aux(char ***out, void *state) {
+  static char *names[] = {NULL};
+  (void)state;
+  *out = names;
+  return 1;
+}
+
+static int sqr_infer_shape(int num_tensor, int *ndims, unsigned **shapes,
+                           void *state) {
+  (void)num_tensor; (void)state;
+  ndims[1] = ndims[0];
+  shapes[1] = shapes[0];
+  return 1;
+}
+
+static int sqr_create_operator(const char *ctx, int num_inputs,
+                               unsigned **shapes, const int *ndims,
+                               const int *dtypes,
+                               struct MXCallbackList *ret, void *state) {
+  static int (*cbs[3])(void);
+  static void *ctxs[3] = {NULL, NULL, NULL};
+  (void)ctx; (void)num_inputs; (void)shapes; (void)ndims; (void)dtypes;
+  (void)state;
+  cbs[0] = (int (*)(void))sqr_del;
+  cbs[1] = (int (*)(void))sqr_forward;
+  cbs[2] = (int (*)(void))sqr_backward;
+  ret->num_callbacks = 3;
+  ret->callbacks = cbs;
+  ret->contexts = ctxs;
+  return 1;
+}
+
+static int sqr_creator(const char *op_type, int num_kwargs,
+                       const char **keys, const char **vals,
+                       struct MXCallbackList *ret) {
+  static int (*cbs[7])(void);
+  static void *ctxs[7];
+  (void)op_type; (void)num_kwargs; (void)keys; (void)vals;
+  memset(ctxs, 0, sizeof(ctxs));
+  cbs[0] = (int (*)(void))sqr_del;           /* kCustomOpPropDelete */
+  cbs[1] = (int (*)(void))sqr_list_args;     /* ListArguments */
+  cbs[2] = (int (*)(void))sqr_list_outs;     /* ListOutputs */
+  cbs[3] = (int (*)(void))sqr_list_aux;      /* ListAuxiliaryStates */
+  cbs[4] = (int (*)(void))sqr_infer_shape;   /* InferShape */
+  cbs[5] = NULL;                             /* DeclareBackwardDependency */
+  cbs[6] = (int (*)(void))sqr_create_operator;
+  ret->num_callbacks = 7;
+  ret->callbacks = cbs;
+  ret->contexts = ctxs;
+  return 1;
+}
+
+MODULE = AI::MXNetTPU  PACKAGE = AI::MXNetTPU
+
+PROTOTYPES: DISABLE
+
+BOOT:
+{
+  /* perl dlopens this module RTLD_LOCAL, which would leave the embedded
+   * CPython's symbols invisible to numpy/jax C extensions (they expect
+   * libpython symbols to be global, manylinux-style). Re-promote it. */
+  void *h = dlopen("libpython3.12.so.1.0", RTLD_NOW | RTLD_GLOBAL);
+  if (h == NULL) dlopen("libpython3.12.so", RTLD_NOW | RTLD_GLOBAL);
+}
+
+const char *
+last_error()
+  CODE:
+    RETVAL = MXGetLastError();
+  OUTPUT:
+    RETVAL
+
+IV
+nd_create(shape_av)
+    AV *shape_av
+  CODE:
+  {
+    size_t ndim = av_count(shape_av), i;
+    mx_uint shape[8];
+    NDArrayHandle h = NULL;
+    for (i = 0; i < ndim && i < 8; ++i) {
+      SV **e = av_fetch(shape_av, i, 0);
+      shape[i] = e ? (mx_uint)SvUV(*e) : 0;
+    }
+    croak_on(aTHX_ MXNDArrayCreateEx(shape, (mx_uint)ndim, 1, 0, 0, 0, &h),
+             "MXNDArrayCreateEx");
+    RETVAL = PTR2IV(h);
+  }
+  OUTPUT:
+    RETVAL
+
+void
+nd_free(h)
+    IV h
+  CODE:
+    MXNDArrayFree(INT2PTR(NDArrayHandle, h));
+
+void
+nd_set(h, values_av)
+    IV h
+    AV *values_av
+  CODE:
+  {
+    float *buf;
+    size_t n = av_to_floats(aTHX_ values_av, &buf);
+    int rc = MXNDArraySyncCopyFromCPU(INT2PTR(NDArrayHandle, h), buf, n);
+    free(buf);
+    croak_on(aTHX_ rc, "MXNDArraySyncCopyFromCPU");
+  }
+
+AV *
+nd_shape(h)
+    IV h
+  CODE:
+  {
+    mx_uint ndim = 0;
+    const mx_uint *shape = NULL;
+    size_t i;
+    croak_on(aTHX_ MXNDArrayGetShape(INT2PTR(NDArrayHandle, h), &ndim,
+                                     &shape),
+             "MXNDArrayGetShape");
+    RETVAL = newAV();
+    sv_2mortal((SV *)RETVAL);
+    for (i = 0; i < ndim; ++i) av_push(RETVAL, newSVuv(shape[i]));
+  }
+  OUTPUT:
+    RETVAL
+
+AV *
+nd_values(h)
+    IV h
+  CODE:
+  {
+    mx_uint ndim = 0;
+    const mx_uint *shape = NULL;
+    size_t n = 1, i;
+    float *buf;
+    croak_on(aTHX_ MXNDArrayGetShape(INT2PTR(NDArrayHandle, h), &ndim,
+                                     &shape),
+             "MXNDArrayGetShape");
+    for (i = 0; i < ndim; ++i) n *= shape[i];
+    buf = (float *)malloc(n * sizeof(float));
+    if (MXNDArraySyncCopyToCPU(INT2PTR(NDArrayHandle, h), buf, n) != 0) {
+      free(buf);
+      croak("MXNDArraySyncCopyToCPU failed: %s", MXGetLastError());
+    }
+    RETVAL = newAV();
+    sv_2mortal((SV *)RETVAL);
+    for (i = 0; i < n; ++i) av_push(RETVAL, newSVnv(buf[i]));
+    free(buf);
+  }
+  OUTPUT:
+    RETVAL
+
+AV *
+invoke(op, in_av, key_av, val_av)
+    const char *op
+    AV *in_av
+    AV *key_av
+    AV *val_av
+  CODE:
+  {
+    size_t n_in = av_count(in_av), n_p = av_count(key_av), i;
+    NDArrayHandle ins[16];
+    const char *keys[16], *vals[16];
+    NDArrayHandle *outs = NULL;
+    int n_out = 0;
+    for (i = 0; i < n_in && i < 16; ++i) {
+      SV **e = av_fetch(in_av, i, 0);
+      ins[i] = INT2PTR(NDArrayHandle, SvIV(*e));
+    }
+    for (i = 0; i < n_p && i < 16; ++i) {
+      SV **k = av_fetch(key_av, i, 0);
+      SV **v = av_fetch(val_av, i, 0);
+      keys[i] = SvPV_nolen(*k);
+      vals[i] = SvPV_nolen(*v);
+    }
+    croak_on(aTHX_ MXImperativeInvoke(op, (int)n_in, ins, &n_out, &outs,
+                                      (int)n_p, keys, vals),
+             "MXImperativeInvoke");
+    RETVAL = newAV();
+    sv_2mortal((SV *)RETVAL);
+    for (i = 0; i < (size_t)n_out; ++i)
+      av_push(RETVAL, newSViv(PTR2IV(outs[i])));
+  }
+  OUTPUT:
+    RETVAL
+
+void
+register_sqr_op()
+  CODE:
+    croak_on(aTHX_ MXCustomOpRegister("perl_sqr", sqr_creator),
+             "MXCustomOpRegister");
+
+IV
+pred_create(sym_json, params_sv, input_name, shape_av)
+    const char *sym_json
+    SV *params_sv
+    const char *input_name
+    AV *shape_av
+  CODE:
+  {
+    STRLEN plen;
+    const char *pbytes = SvPV(params_sv, plen);
+    size_t ndim = av_count(shape_av), i;
+    mx_uint sdata[8];
+    mx_uint indptr[2];
+    const char *keys[1];
+    PredictorHandle h = NULL;
+    for (i = 0; i < ndim && i < 8; ++i) {
+      SV **e = av_fetch(shape_av, i, 0);
+      sdata[i] = e ? (mx_uint)SvUV(*e) : 0;
+    }
+    indptr[0] = 0;
+    indptr[1] = (mx_uint)ndim;
+    keys[0] = input_name;
+    croak_on(aTHX_ MXPredCreate(sym_json, pbytes, (int)plen, 1, 0, 1, keys,
+                                indptr, sdata, &h),
+             "MXPredCreate");
+    RETVAL = PTR2IV(h);
+  }
+  OUTPUT:
+    RETVAL
+
+void
+pred_set_input(h, name, values_av)
+    IV h
+    const char *name
+    AV *values_av
+  CODE:
+  {
+    float *buf;
+    size_t n = av_to_floats(aTHX_ values_av, &buf);
+    int rc = MXPredSetInput(INT2PTR(PredictorHandle, h), name, buf,
+                            (mx_uint)n);
+    free(buf);
+    croak_on(aTHX_ rc, "MXPredSetInput");
+  }
+
+void
+pred_forward(h)
+    IV h
+  CODE:
+    croak_on(aTHX_ MXPredForward(INT2PTR(PredictorHandle, h)),
+             "MXPredForward");
+
+AV *
+pred_output(h, index)
+    IV h
+    UV index
+  CODE:
+  {
+    mx_uint *shape = NULL;
+    mx_uint ndim = 0;
+    size_t n = 1, i;
+    float *buf;
+    croak_on(aTHX_ MXPredGetOutputShape(INT2PTR(PredictorHandle, h),
+                                        (mx_uint)index, &shape, &ndim),
+             "MXPredGetOutputShape");
+    for (i = 0; i < ndim; ++i) n *= shape[i];
+    buf = (float *)malloc(n * sizeof(float));
+    if (MXPredGetOutput(INT2PTR(PredictorHandle, h), (mx_uint)index, buf,
+                        (mx_uint)n) != 0) {
+      free(buf);
+      croak("MXPredGetOutput failed: %s", MXGetLastError());
+    }
+    RETVAL = newAV();
+    sv_2mortal((SV *)RETVAL);
+    for (i = 0; i < n; ++i) av_push(RETVAL, newSVnv(buf[i]));
+    free(buf);
+  }
+  OUTPUT:
+    RETVAL
+
+void
+pred_free(h)
+    IV h
+  CODE:
+    MXPredFree(INT2PTR(PredictorHandle, h));

@@ -1,0 +1,32 @@
+package AI::MXNetTPU;
+
+# Minimal Perl frontend over the mxnet_tpu flat C API (ref: the
+# reference's perl-package/AI-MXNet over libmxnet's identical ABI).
+# Proves the C surface hosts a non-C++ language binding: NDArray
+# lifecycle, imperative operator invocation, the predict API, and a
+# C-callback custom operator (MXCustomOpRegister).
+
+use strict;
+use warnings;
+
+our $VERSION = '0.01';
+
+require XSLoader;
+XSLoader::load('AI::MXNetTPU', $VERSION);
+
+1;
+__END__
+
+=head1 NAME
+
+AI::MXNetTPU - minimal Perl binding over the mxnet_tpu C API
+
+=head1 SYNOPSIS
+
+  use AI::MXNetTPU;
+  my $h = AI::MXNetTPU::nd_create([2, 2]);
+  AI::MXNetTPU::nd_set($h, [1, 2, 3, 4]);
+  my $out = AI::MXNetTPU::invoke("broadcast_mul", [$h, $h], [], [])->[0];
+  my $vals = AI::MXNetTPU::nd_values($out);   # [1, 4, 9, 16]
+
+=cut

@@ -1,0 +1,59 @@
+#!/usr/bin/perl
+# Smoke drive of AI::MXNetTPU (run by tests/test_perl_binding.py):
+#   1. NDArray + imperative invoke
+#   2. C-callback custom op (registered from this module's XS glue)
+#   3. LeNet predict through the predict API
+# argv: <model-prefix> (files <prefix>-symbol.json / <prefix>-0000.params)
+use strict;
+use warnings;
+use AI::MXNetTPU;
+
+sub approx {
+    my ($got, $want, $what) = @_;
+    die "$what: size @{[scalar @$got]} vs @{[scalar @$want]}\n"
+        unless @$got == @$want;
+    for my $i (0 .. $#$want) {
+        die "$what\[$i]: $got->[$i] vs $want->[$i]\n"
+            if abs($got->[$i] - $want->[$i]) > 1e-4 * (1 + abs($want->[$i]));
+    }
+}
+
+# -- 1. imperative ---------------------------------------------------------
+my $a = AI::MXNetTPU::nd_create([2, 3]);
+AI::MXNetTPU::nd_set($a, [1, 2, 3, 4, 5, 6]);
+my $b = AI::MXNetTPU::nd_create([2, 3]);
+AI::MXNetTPU::nd_set($b, [10, 20, 30, 40, 50, 60]);
+my $sum = AI::MXNetTPU::invoke("broadcast_add", [$a, $b], [], [])->[0];
+approx(AI::MXNetTPU::nd_values($sum), [11, 22, 33, 44, 55, 66], "add");
+my $scaled = AI::MXNetTPU::invoke("_mul_scalar", [$a], ["scalar"], ["2.5"])->[0];
+approx(AI::MXNetTPU::nd_values($scaled), [2.5, 5, 7.5, 10, 12.5, 15], "mul_scalar");
+print "perl imperative ok\n";
+
+# -- 2. C-callback custom op ------------------------------------------------
+AI::MXNetTPU::register_sqr_op();
+my $sq = AI::MXNetTPU::invoke("Custom", [$a], ["op_type"], ["perl_sqr"])->[0];
+approx(AI::MXNetTPU::nd_values($sq), [1, 4, 9, 16, 25, 36], "custom sqr");
+print "perl custom op ok\n";
+
+# -- 3. LeNet predict -------------------------------------------------------
+my $prefix = $ARGV[0] or die "usage: smoke.pl <model-prefix>\n";
+open my $jf, '<', "$prefix-symbol.json" or die "no symbol json: $!";
+my $json = do { local $/; <$jf> };
+close $jf;
+open my $pf, '<:raw', "$prefix-0000.params" or die "no params: $!";
+my $params = do { local $/; <$pf> };
+close $pf;
+
+my $pred = AI::MXNetTPU::pred_create($json, $params, "data", [1, 1, 28, 28]);
+my @img = map { ($_ % 7) / 7.0 } 0 .. 28 * 28 - 1;
+AI::MXNetTPU::pred_set_input($pred, "data", \@img);
+AI::MXNetTPU::pred_forward($pred);
+my $out = AI::MXNetTPU::pred_output($pred, 0);
+die "lenet: expected 10 logits, got @{[scalar @$out]}\n" unless @$out == 10;
+my $finite = 1;
+for (@$out) { $finite = 0 if $_ != $_; }
+die "lenet: NaN logits\n" unless $finite;
+AI::MXNetTPU::pred_free($pred);
+printf "perl lenet predict ok: [%s]\n", join(", ", map { sprintf "%.3f", $_ } @$out);
+AI::MXNetTPU::nd_free($_) for ($a, $b, $sum, $scaled, $sq);
+print "PERL_BINDING_OK\n";

@@ -16,6 +16,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <map>
 #include <mutex>
 #include <string>
 #include <vector>
@@ -450,24 +451,21 @@ MXTPU_API int MXSymbolCreateAtomicSymbol(const char *op_name,
 }
 
 // compose an atomic symbol with inputs: the CreateAtomicSymbol+Compose
-// two-step every reference language binding uses. Positional args only —
-// keyword composition (keys != NULL) is rejected loudly rather than
-// silently wiring inputs into the wrong slots.
+// two-step every reference language binding uses. keys == NULL is
+// positional; non-NULL keys compose by argument NAME (the bridge matches
+// them against the op's declared input slots, ref: nnvm Symbol::Compose
+// kwargs path).
 MXTPU_API int MXSymbolCompose(SymbolHandle sym, const char *name,
                               mx_uint num_args, const char **keys,
                               SymbolHandle *args_h) {
   ensure_interpreter();
   ScopedGIL gil;
-  if (keys != nullptr) {
-    // silent positional wiring under keyword intent would transpose
-    // input roles — refuse loudly instead
-    set_error("MXSymbolCompose: keyword composition is not supported; "
-              "pass inputs positionally (keys must be NULL)");
-    return -1;
-  }
   PyObject *ins = handle_list(args_h, num_args);
-  PyObject *args = Py_BuildValue("(OsN)", static_cast<PyObject *>(sym),
-                                 name ? name : "", ins);
+  PyObject *names = keys == nullptr
+                        ? (Py_INCREF(Py_None), Py_None)
+                        : str_list(keys, num_args);
+  PyObject *args = Py_BuildValue("(OsNN)", static_cast<PyObject *>(sym),
+                                 name ? name : "", ins, names);
   PyObject *r = call("symbol_compose", args);
   Py_DECREF(args);
   if (!r) { set_error(py_error()); return -1; }
@@ -2730,4 +2728,389 @@ MXTPU_API int MXSymbolGetInputSymbols(SymbolHandle sym,
     return -1;
   *input_size = (int)n;
   return 0;
+}
+
+// ---------------------------------------------------------------------------
+// C-callback custom operators + autograd functions
+// (ref: include/mxnet/c_api.h:2459 MXCustomOpRegister / :2468
+//  MXCustomFunctionRecord; src/operator/custom/custom.cc tag protocol,
+//  src/c_api/c_api_function.cc). These are THE two functions a non-Python
+//  language binding needs to define ops: the frontend supplies C function
+//  pointers (prop creator -> prop callbacks -> operator callbacks), the
+//  runtime calls them with NDArray handles. Here the callbacks plug into
+//  the Python Custom-op host (mxnet_tpu/operator.py) through a tiny
+//  embedded extension module `_mxtpu_chost` the bridge adapter consumes —
+//  the callbacks themselves drive the SAME flat C API to do their math.
+// ---------------------------------------------------------------------------
+
+extern "C" {
+struct MXCallbackList {
+  int num_callbacks;
+  int (**callbacks)(void);
+  void **contexts;
+};
+}
+
+typedef int (*CustomOpPropCreator)(const char *, const int, const char **,
+                                   const char **, MXCallbackList *);
+typedef int (*CustomOpFBFunc)(int, void **, int *, const int *, const int,
+                              void *);
+typedef int (*CustomOpDelFunc)(void *);
+typedef int (*CustomOpListFunc)(char ***, void *);
+typedef int (*CustomOpInferShapeFunc)(int, int *, unsigned **, void *);
+typedef int (*CustomOpInferTypeFunc)(int, int *, void *);
+typedef int (*CustomOpCreateFunc)(const char *, int, unsigned **,
+                                  const int *, const int *,
+                                  MXCallbackList *, void *);
+typedef int (*CustomFunctionBwdFunc)(int, int, void **, const int *,
+                                     const int, void *);
+
+namespace {
+
+// enum values mirror include/mxnet/c_api.h
+enum { kCustomOpDelete = 0, kCustomOpForward = 1, kCustomOpBackward = 2 };
+enum {
+  kCustomOpPropDelete = 0,
+  kCustomOpPropListArguments = 1,
+  kCustomOpPropListOutputs = 2,
+  kCustomOpPropListAuxiliaryStates = 3,
+  kCustomOpPropInferShape = 4,
+  kCustomOpPropDeclareBackwardDependency = 5,
+  kCustomOpPropCreateOperator = 6,
+  kCustomOpPropInferType = 7
+};
+enum { kCustomFunctionBackward = 0, kCustomFunctionDelete = 1 };
+
+std::mutex g_cop_mu;
+std::map<std::string, CustomOpPropCreator> g_cop_creators;
+std::map<long, MXCallbackList> g_cop_lists;  // props, operators, functions
+long g_cop_next = 1;
+
+long stash_cblist(const MXCallbackList &cb) {
+  std::lock_guard<std::mutex> lk(g_cop_mu);
+  long id = g_cop_next++;
+  g_cop_lists[id] = cb;  // struct copy; the frontend owns the arrays and
+  return id;             // keeps them alive while the op exists (same
+}                        // contract as the reference runtime)
+
+MXCallbackList *get_cblist(long id) {
+  std::lock_guard<std::mutex> lk(g_cop_mu);
+  auto it = g_cop_lists.find(id);
+  return it == g_cop_lists.end() ? nullptr : &it->second;
+}
+
+bool has_cb(const MXCallbackList *l, int i) {
+  return l != nullptr && i < l->num_callbacks && l->callbacks[i] != nullptr;
+}
+
+#define CHOST_GET(idvar)                                                   \
+  MXCallbackList *cb = get_cblist(idvar);                                  \
+  if (cb == nullptr) {                                                     \
+    PyErr_SetString(PyExc_KeyError, "unknown custom-op callback handle");  \
+    return nullptr;                                                        \
+  }
+
+PyObject *chost_has_creator(PyObject *, PyObject *args) {
+  const char *op_type;
+  if (!PyArg_ParseTuple(args, "s", &op_type)) return nullptr;
+  std::lock_guard<std::mutex> lk(g_cop_mu);
+  return PyBool_FromLong(g_cop_creators.count(op_type) ? 1 : 0);
+}
+
+PyObject *chost_create_prop(PyObject *, PyObject *args) {
+  const char *op_type;
+  PyObject *keys, *vals;
+  if (!PyArg_ParseTuple(args, "sOO", &op_type, &keys, &vals)) return nullptr;
+  CustomOpPropCreator creator = nullptr;
+  {
+    std::lock_guard<std::mutex> lk(g_cop_mu);
+    auto it = g_cop_creators.find(op_type);
+    if (it != g_cop_creators.end()) creator = it->second;
+  }
+  if (creator == nullptr) {
+    PyErr_Format(PyExc_KeyError, "no C creator registered for %s", op_type);
+    return nullptr;
+  }
+  Py_ssize_t n = PyList_Size(keys);
+  std::vector<std::string> ks, vs;
+  std::vector<const char *> kp, vp;
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    ks.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(keys, i)));
+    vs.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(vals, i)));
+  }
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    kp.push_back(ks[i].c_str());
+    vp.push_back(vs[i].c_str());
+  }
+  MXCallbackList cb{0, nullptr, nullptr};
+  if (!creator(op_type, (int)n, kp.data(), vp.data(), &cb)) {
+    PyErr_Format(PyExc_RuntimeError, "C prop creator for %s failed",
+                 op_type);
+    return nullptr;
+  }
+  return PyLong_FromLong(stash_cblist(cb));
+}
+
+PyObject *chost_prop_list(PyObject *, PyObject *args) {
+  long id;
+  int which;
+  if (!PyArg_ParseTuple(args, "li", &id, &which)) return nullptr;
+  CHOST_GET(id);
+  char **names = nullptr;
+  if (!has_cb(cb, which)) return PyList_New(0);
+  if (!((CustomOpListFunc)cb->callbacks[which])(&names,
+                                                cb->contexts[which])) {
+    PyErr_SetString(PyExc_RuntimeError, "custom-op list callback failed");
+    return nullptr;
+  }
+  PyObject *out = PyList_New(0);
+  for (char **p = names; p != nullptr && *p != nullptr; ++p) {
+    PyObject *s = PyUnicode_FromString(*p);
+    PyList_Append(out, s);
+    Py_DECREF(s);
+  }
+  return out;
+}
+
+PyObject *chost_prop_infer_shape(PyObject *, PyObject *args) {
+  long id;
+  int n_out, n_aux;
+  PyObject *in_shapes;
+  if (!PyArg_ParseTuple(args, "lOii", &id, &in_shapes, &n_out, &n_aux))
+    return nullptr;
+  CHOST_GET(id);
+  if (!has_cb(cb, kCustomOpPropInferShape)) Py_RETURN_NONE;
+  int n_in = (int)PyList_Size(in_shapes);
+  int total = n_in + n_out + n_aux;
+  std::vector<int> ndims(total, 0);
+  std::vector<std::vector<unsigned>> store(total);
+  std::vector<unsigned *> ptrs(total, nullptr);
+  for (int i = 0; i < n_in; ++i) {
+    PyObject *s = PyList_GetItem(in_shapes, i);
+    Py_ssize_t d = PyList_Size(s);
+    ndims[i] = (int)d;
+    store[i].resize(d);
+    for (Py_ssize_t j = 0; j < d; ++j)
+      store[i][j] = (unsigned)PyLong_AsUnsignedLong(PyList_GetItem(s, j));
+    ptrs[i] = store[i].data();
+  }
+  if (!((CustomOpInferShapeFunc)cb->callbacks[kCustomOpPropInferShape])(
+          total, ndims.data(), ptrs.data(),
+          cb->contexts[kCustomOpPropInferShape])) {
+    PyErr_SetString(PyExc_RuntimeError, "custom-op infer_shape failed");
+    return nullptr;
+  }
+  PyObject *out = PyList_New(total);  // copy out IMMEDIATELY: the pointers
+  for (int i = 0; i < total; ++i) {   // target callee-owned storage
+    PyObject *s = PyList_New(ndims[i]);
+    for (int j = 0; j < ndims[i]; ++j)
+      PyList_SetItem(s, j, PyLong_FromUnsignedLong(ptrs[i][j]));
+    PyList_SetItem(out, i, s);
+  }
+  return out;
+}
+
+PyObject *chost_prop_infer_type(PyObject *, PyObject *args) {
+  long id;
+  int n_out, n_aux;
+  PyObject *in_types;
+  if (!PyArg_ParseTuple(args, "lOii", &id, &in_types, &n_out, &n_aux))
+    return nullptr;
+  CHOST_GET(id);
+  if (!has_cb(cb, kCustomOpPropInferType)) Py_RETURN_NONE;
+  int n_in = (int)PyList_Size(in_types);
+  int total = n_in + n_out + n_aux;
+  std::vector<int> types(total, -1);
+  for (int i = 0; i < n_in; ++i)
+    types[i] = (int)PyLong_AsLong(PyList_GetItem(in_types, i));
+  if (!((CustomOpInferTypeFunc)cb->callbacks[kCustomOpPropInferType])(
+          total, types.data(), cb->contexts[kCustomOpPropInferType])) {
+    PyErr_SetString(PyExc_RuntimeError, "custom-op infer_type failed");
+    return nullptr;
+  }
+  PyObject *out = PyList_New(total);
+  for (int i = 0; i < total; ++i)
+    PyList_SetItem(out, i, PyLong_FromLong(types[i]));
+  return out;
+}
+
+PyObject *chost_prop_create_operator(PyObject *, PyObject *args) {
+  long id;
+  const char *ctx;
+  PyObject *shapes, *dtypes;
+  if (!PyArg_ParseTuple(args, "lsOO", &id, &ctx, &shapes, &dtypes))
+    return nullptr;
+  CHOST_GET(id);
+  if (!has_cb(cb, kCustomOpPropCreateOperator)) {
+    PyErr_SetString(PyExc_RuntimeError,
+                    "custom-op prop has no create_operator callback");
+    return nullptr;
+  }
+  int n = (int)PyList_Size(shapes);
+  std::vector<int> ndims(n), dts(n);
+  std::vector<std::vector<unsigned>> store(n);
+  std::vector<unsigned *> ptrs(n);
+  for (int i = 0; i < n; ++i) {
+    PyObject *s = PyList_GetItem(shapes, i);
+    Py_ssize_t d = PyList_Size(s);
+    ndims[i] = (int)d;
+    store[i].resize(d);
+    for (Py_ssize_t j = 0; j < d; ++j)
+      store[i][j] = (unsigned)PyLong_AsUnsignedLong(PyList_GetItem(s, j));
+    ptrs[i] = store[i].data();
+    dts[i] = (int)PyLong_AsLong(PyList_GetItem(dtypes, i));
+  }
+  MXCallbackList op{0, nullptr, nullptr};
+  if (!((CustomOpCreateFunc)cb->callbacks[kCustomOpPropCreateOperator])(
+          ctx, n, ptrs.data(), ndims.data(), dts.data(), &op,
+          cb->contexts[kCustomOpPropCreateOperator])) {
+    PyErr_SetString(PyExc_RuntimeError, "custom-op create_operator failed");
+    return nullptr;
+  }
+  return PyLong_FromLong(stash_cblist(op));
+}
+
+PyObject *chost_op_call(PyObject *, PyObject *args) {
+  long id;
+  int which, is_train;
+  PyObject *handles, *tags, *reqs;
+  if (!PyArg_ParseTuple(args, "liOOOi", &id, &which, &handles, &tags, &reqs,
+                        &is_train))
+    return nullptr;
+  CHOST_GET(id);
+  if (!has_cb(cb, which)) {
+    PyErr_SetString(PyExc_RuntimeError, "custom op callback missing");
+    return nullptr;
+  }
+  int n = (int)PyList_Size(handles);
+  std::vector<void *> ptrs(n);
+  std::vector<int> tg(n);
+  for (int i = 0; i < n; ++i) {
+    ptrs[i] = PyList_GetItem(handles, i);  // NDArrayHandle == PyObject*
+    tg[i] = (int)PyLong_AsLong(PyList_GetItem(tags, i));
+  }
+  int m = (int)PyList_Size(reqs);
+  std::vector<int> rq(m);
+  for (int i = 0; i < m; ++i)
+    rq[i] = (int)PyLong_AsLong(PyList_GetItem(reqs, i));
+  if (!((CustomOpFBFunc)cb->callbacks[which])(n, ptrs.data(), tg.data(),
+                                              rq.data(), is_train,
+                                              cb->contexts[which])) {
+    PyErr_SetString(PyExc_RuntimeError, "custom op callback failed");
+    return nullptr;
+  }
+  Py_RETURN_NONE;
+}
+
+PyObject *chost_func_backward(PyObject *, PyObject *args) {
+  long id;
+  int n_ograds, n_igrads, is_train;
+  PyObject *handles, *reqs;
+  if (!PyArg_ParseTuple(args, "liiOOi", &id, &n_ograds, &n_igrads, &handles,
+                        &reqs, &is_train))
+    return nullptr;
+  CHOST_GET(id);
+  if (!has_cb(cb, kCustomFunctionBackward)) {
+    PyErr_SetString(PyExc_RuntimeError, "custom function has no backward");
+    return nullptr;
+  }
+  int n = (int)PyList_Size(handles);
+  std::vector<void *> ptrs(n);
+  for (int i = 0; i < n; ++i) ptrs[i] = PyList_GetItem(handles, i);
+  int m = (int)PyList_Size(reqs);
+  std::vector<int> rq(m);
+  for (int i = 0; i < m; ++i)
+    rq[i] = (int)PyLong_AsLong(PyList_GetItem(reqs, i));
+  if (!((CustomFunctionBwdFunc)cb->callbacks[kCustomFunctionBackward])(
+          n_ograds, n_igrads, ptrs.data(), rq.data(), is_train,
+          cb->contexts[kCustomFunctionBackward])) {
+    PyErr_SetString(PyExc_RuntimeError, "custom function backward failed");
+    return nullptr;
+  }
+  Py_RETURN_NONE;
+}
+
+PyObject *chost_release(PyObject *, PyObject *args) {
+  long id;
+  int del_index;
+  if (!PyArg_ParseTuple(args, "li", &id, &del_index)) return nullptr;
+  MXCallbackList cb{0, nullptr, nullptr};
+  {
+    std::lock_guard<std::mutex> lk(g_cop_mu);
+    auto it = g_cop_lists.find(id);
+    if (it == g_cop_lists.end()) Py_RETURN_NONE;
+    cb = it->second;
+    g_cop_lists.erase(it);
+  }
+  if (del_index >= 0 && has_cb(&cb, del_index))
+    ((CustomOpDelFunc)cb.callbacks[del_index])(cb.contexts[del_index]);
+  Py_RETURN_NONE;
+}
+
+PyMethodDef g_chost_methods[] = {
+    {"has_creator", chost_has_creator, METH_VARARGS, nullptr},
+    {"create_prop", chost_create_prop, METH_VARARGS, nullptr},
+    {"prop_list", chost_prop_list, METH_VARARGS, nullptr},
+    {"prop_infer_shape", chost_prop_infer_shape, METH_VARARGS, nullptr},
+    {"prop_infer_type", chost_prop_infer_type, METH_VARARGS, nullptr},
+    {"prop_create_operator", chost_prop_create_operator, METH_VARARGS,
+     nullptr},
+    {"op_call", chost_op_call, METH_VARARGS, nullptr},
+    {"func_backward", chost_func_backward, METH_VARARGS, nullptr},
+    {"release", chost_release, METH_VARARGS, nullptr},
+    {nullptr, nullptr, 0, nullptr}};
+
+PyModuleDef g_chost_module = {PyModuleDef_HEAD_INIT, "_mxtpu_chost",
+                              "C custom-op callback host", -1,
+                              g_chost_methods,
+                              nullptr, nullptr, nullptr, nullptr};
+
+// the interpreter may predate this library (ctypes-loaded into a live
+// python process) so AppendInittab is not an option: create the module
+// lazily and plant it in sys.modules for the bridge adapter to import
+void ensure_chost() {
+  PyObject *mods = PyImport_GetModuleDict();
+  if (PyDict_GetItemString(mods, "_mxtpu_chost") != nullptr) return;
+  PyObject *m = PyModule_Create(&g_chost_module);
+  if (m != nullptr) {
+    PyDict_SetItemString(mods, "_mxtpu_chost", m);
+    Py_DECREF(m);
+  }
+}
+
+}  // namespace
+
+MXTPU_API int MXCustomOpRegister(const char *op_type,
+                                 CustomOpPropCreator creator) {
+  PREP;
+  {
+    std::lock_guard<std::mutex> lk(g_cop_mu);
+    g_cop_creators[op_type] = creator;
+  }
+  ensure_chost();
+  PyObject *a = Py_BuildValue("(s)", op_type);
+  PyObject *r = call("custom_c_op_register", a);
+  Py_DECREF(a);
+  return rv(r);
+}
+
+MXTPU_API int MXCustomFunctionRecord(int num_inputs, NDArrayHandle *inputs,
+                                     int num_outputs,
+                                     NDArrayHandle *outputs,
+                                     MXCallbackList *callbacks) {
+  PREP;
+  ensure_chost();
+  long id = stash_cblist(*callbacks);
+  PyObject *a = Py_BuildValue(
+      "(NNl)", handle_list(inputs, (mx_uint)num_inputs),
+      handle_list(outputs, (mx_uint)num_outputs), id);
+  PyObject *r = call("custom_function_record", a);
+  Py_DECREF(a);
+  if (r == nullptr) {
+    // failed record (e.g. not recording): drop the stashed entry — the
+    // frontend retains ownership of its callbacks, so no delete fires
+    std::lock_guard<std::mutex> lk(g_cop_mu);
+    g_cop_lists.erase(id);
+  }
+  return rv(r);
 }

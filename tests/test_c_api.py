@@ -305,6 +305,59 @@ def test_symbol_compose_two_step(lib):
         ["data", "w", "b"]
 
 
+def test_symbol_compose_keywords(lib):
+    """Keyword composition (keys != NULL): inputs bind argument slots by
+    NAME, in any order; unbound slots auto-create variables (ref: nnvm
+    Symbol::Compose kwargs path — Scala/R bindings compose this way)."""
+    fc = ctypes.c_void_p()
+    keys = (ctypes.c_char_p * 1)(b"num_hidden")
+    vals = (ctypes.c_char_p * 1)(b"3")
+    _check(lib, lib.MXSymbolCreateAtomicSymbol(b"FullyConnected", u(1),
+                                               keys, vals,
+                                               ctypes.byref(fc)))
+    data = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolCreateVariable(b"x", ctypes.byref(data)))
+    w = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolCreateVariable(b"myw", ctypes.byref(w)))
+    # supply weight and data OUT OF ORDER by keyword; bias auto-creates
+    in_keys = (ctypes.c_char_p * 2)(b"weight", b"data")
+    args = (ctypes.c_void_p * 2)(w, data)
+    _check(lib, lib.MXSymbolCompose(fc, b"fck", u(2), in_keys, args))
+    n = u()
+    names = cp(ctypes.c_char_p)()
+    _check(lib, lib.MXSymbolListArguments(fc, ctypes.byref(n),
+                                          ctypes.byref(names)))
+    got = [names[i].decode() for i in range(n.value)]
+    assert got == ["x", "myw", "fck_bias"], got
+    # the no_bias-gated variadic slot is keyword-addressable too
+    fcb = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolCreateAtomicSymbol(b"FullyConnected", u(1),
+                                               keys, vals,
+                                               ctypes.byref(fcb)))
+    d2 = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolCreateVariable(b"x2", ctypes.byref(d2)))
+    bias = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolCreateVariable(b"myb", ctypes.byref(bias)))
+    kb = (ctypes.c_char_p * 2)(b"bias", b"data")
+    ab = (ctypes.c_void_p * 2)(bias, d2)
+    _check(lib, lib.MXSymbolCompose(fcb, b"fcb", u(2), kb, ab))
+    _check(lib, lib.MXSymbolListArguments(fcb, ctypes.byref(n),
+                                          ctypes.byref(names)))
+    got = [names[i].decode() for i in range(n.value)]
+    assert got == ["x2", "fcb_weight", "myb"], got
+
+    # a bogus keyword must error loudly, naming the op's real arguments
+    bogus = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolCreateAtomicSymbol(b"FullyConnected", u(1),
+                                               keys, vals,
+                                               ctypes.byref(bogus)))
+    bad_keys = (ctypes.c_char_p * 1)(b"nonsense")
+    bad_args = (ctypes.c_void_p * 1)(data)
+    rc = lib.MXSymbolCompose(bogus, b"fbad", u(1), bad_keys, bad_args)
+    assert rc != 0
+    assert b"no input named" in lib.MXGetLastError()
+
+
 def test_symbol_infer_shape(lib):
     data = ctypes.c_void_p()
     _check(lib, lib.MXSymbolCreateVariable(b"data", ctypes.byref(data)))

