@@ -17,7 +17,8 @@ import numpy as _np
 from ..base import MXNetError, check
 
 __all__ = ["quantize_model", "calib_graph", "CalibrationCollector",
-           "HistogramCollector", "get_optimal_threshold"]
+           "HistogramCollector", "get_optimal_threshold", "fold_batchnorm",
+           "quantize_net", "QuantizedConv2D", "QuantizedDense"]
 
 _QUANTIZABLE = {"FullyConnected"}
 
@@ -256,3 +257,344 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
 
     qsym = Symbol([(conv(n), i) for n, i in sym._outputs])
     return qsym, qarg_params, dict(aux_params)
+
+
+# ---------------------------------------------------------------------------
+# Gluon int8 inference flow: fold_batchnorm + quantize_net
+# (ref: the quantize_graph_pass.cc rewrite + example/quantization/
+# imagenet_gen_qsym.py applied at the Gluon level — the repo's inference
+# bench serves Gluon blocks through scanned XLA programs, so the int8
+# story rewrites blocks, not symbols)
+# ---------------------------------------------------------------------------
+
+def _walk_blocks(block):
+    """Yield (parent, child_key, child) over the whole block tree."""
+    for key, child in list(block._children.items()):
+        yield block, key, child
+        yield from _walk_blocks(child)
+
+
+def _replace_child(parent, key, old, new):
+    parent._children[key] = new
+    # attribute references (self.conv1 = ...) shadow _children entries
+    for attr, val in list(parent.__dict__.items()):
+        if val is old:
+            object.__setattr__(parent, attr, new)
+
+
+def fold_batchnorm(net):
+    """Fold inference-mode BatchNorm into the preceding convolution
+    (in place): for each adjacent (Conv2D, BatchNorm) pair inside a
+    sequential container, ``W' = W * gamma/sqrt(var+eps)`` per output
+    channel and ``b' = beta - mean * gamma/sqrt(var+eps)``; the BatchNorm
+    is replaced with an identity. Also handles the SpaceToDepthStem
+    wrapper (folds into its inner conv). Exact at inference (the folded
+    graph computes the same function); a prerequisite of int8 conv
+    quantization — quantizing around an unfolded BN would need an int8
+    requantize per BN instead of fusing scales into the conv epilogue
+    (ref: the conv+BN fusion pass MKLDNN int8 relies on,
+    src/operator/subgraph/mkldnn/mkldnn_conv_property.h)."""
+    from ..gluon import nn as _gnn
+    from ..gluon.nn.conv_layers import _Conv
+
+    def conv_of(block):
+        # a conv with a FUSED activation computes BN(act(conv(x))) when
+        # followed by BN — the fold identity only holds for BN(conv(x))
+        if isinstance(block, _Conv) and block._op_name == "Convolution" \
+                and block._activation is None:
+            return block
+        # wrapper blocks whose forward ENDS in `self.conv(...)` declare
+        # _tail_conv = True (SpaceToDepthStem does); mere possession of a
+        # `.conv` attribute is not proof the block's output is conv output
+        if getattr(block, "_tail_conv", False):
+            inner = getattr(block, "conv", None)
+            if isinstance(inner, _Conv) and \
+                    inner._op_name == "Convolution" and \
+                    inner._activation is None:
+                return inner
+        return None
+
+    from ..gluon.nn.basic_layers import Sequential, HybridSequential
+
+    def containers(block, acc):
+        if isinstance(block, (Sequential, HybridSequential)):
+            acc.append(block)
+        for child in block._children.values():
+            containers(child, acc)
+        return acc
+
+    n_folded = 0
+    # only sequential containers guarantee declaration order == dataflow
+    # order; attribute-adjacent (conv, bn) pairs in a custom block may wire
+    # differently in hybrid_forward and must NOT be folded
+    for parent in containers(net, []):
+        kids = list(parent._children.items())
+        for (k1, b1), (k2, b2) in zip(kids, kids[1:]):
+            conv = conv_of(b1)
+            if conv is None or not isinstance(b2, _gnn.BatchNorm):
+                continue
+            ndim = len(conv._kwargs["kernel"]) + 2
+            if b2._axis % ndim != conv._channel_axis % ndim:
+                continue  # BN normalizes a non-channel axis: not foldable
+            if conv.weight._data is None or b2.running_var._data is None:
+                raise MXNetError(
+                    "fold_batchnorm: parameters not initialized (run a "
+                    "forward pass first)")
+            gamma = b2.gamma.data().asnumpy().astype(_np.float64) \
+                if b2._scale else 1.0
+            beta = b2.beta.data().asnumpy().astype(_np.float64) \
+                if b2._center else 0.0
+            mean = b2.running_mean.data().asnumpy().astype(_np.float64)
+            var = b2.running_var.data().asnumpy().astype(_np.float64)
+            s = gamma / _np.sqrt(var + b2._epsilon)
+            w = conv.weight.data().asnumpy().astype(_np.float64)
+            wdt = conv.weight.data().dtype
+            new_w = w * s.reshape((-1,) + (1,) * (w.ndim - 1))
+            new_b = beta - mean * s
+            if conv.bias is not None:
+                new_b = new_b + conv.bias.data().asnumpy() * s
+            from ..ndarray import ndarray as _ndar
+            conv.weight.set_data(_ndar.array(new_w.astype(_np.float32))
+                                 .astype(wdt))
+            if conv.bias is None:
+                p = conv.params.get("bias", shape=(new_b.size,),
+                                    init="zeros")
+                p.set_data(_ndar.array(new_b.astype(_np.float32)))
+                conv.bias = p
+                conv._kwargs["no_bias"] = False
+            else:
+                conv.bias.set_data(_ndar.array(new_b.astype(_np.float32)))
+            _replace_child(parent, k2, b2,
+                           _gnn.HybridLambda(lambda F, x: x))
+            n_folded += 1
+    if n_folded:
+        # a hybridized net would otherwise replay the stale compiled
+        # conv+BN graph against the rescaled weights (double-applying BN)
+        for blk in [net] + [c for _, _, c in _walk_blocks(net)]:
+            if getattr(blk, "_cached_op", None) is not None:
+                blk._cached_op = None
+    return n_folded
+
+
+from ..gluon.block import HybridBlock as _HybridBlock  # noqa: E402
+
+
+def _null_param(pdict, name, np_data):
+    """Register a frozen (non-trainable) parameter holding np_data."""
+    from ..ndarray import ndarray as _ndar
+    p = pdict.get(name, shape=np_data.shape,
+                  dtype=str(np_data.dtype), differentiable=False)
+    p.set_data(_ndar.array(np_data))
+    return p
+
+
+class _QuantizedLayer(_HybridBlock):
+    """Shared base of the calibrated int8 blocks: per-output-channel
+    symmetric int8 weights (channel axis 0 for both conv (O,...) and dense
+    (O, I) weights — per-channel scales are what keeps int8 top-1 within
+    1% of fp32; a single per-tensor scale wastes range on channels with
+    small weights), a static calibrated input scale, and an optional f32
+    (BN-folded) bias."""
+
+    def __init__(self, src, in_scale, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._in_scale = float(in_scale)
+        self._activation = src._activation
+        # fallback output dtype for traces whose inputs carry no dtype
+        # (Symbol proxies during export); the imperative/CachedOp path
+        # follows the live input dtype instead
+        self._default_out_dtype = str(src.weight.data().dtype)
+        w32 = _np.asarray(src.weight.data().asnumpy(), _np.float32)
+        absmax = _np.abs(w32).reshape(w32.shape[0], -1).max(axis=1)
+        scale = _np.maximum(absmax, 1e-8) / 127.0
+        q = _np.clip(_np.round(w32 / scale.reshape((-1,) + (1,) *
+                                                   (w32.ndim - 1))),
+                     -127, 127).astype(_np.int8)
+        self.qweight = _null_param(self.params, "qweight", q)
+        self.wscale = _null_param(self.params, "wscale",
+                                  scale.astype(_np.float32))
+        if src.bias is not None:
+            self.bias = _null_param(
+                self.params, "bias",
+                _np.asarray(src.bias.data().asnumpy(), _np.float32))
+        else:
+            self.bias = None
+
+    def _invoke(self, F, qx, qweight, wscale, bias):
+        raise NotImplementedError
+
+    def hybrid_forward(self, F, x, qweight, wscale, bias=None):
+        qx = F._internal._quantize_static(x, scale=self._in_scale)
+        dt = getattr(x, "dtype", None)  # Symbol proxies have no dtype
+        out = self._invoke(F, qx, qweight, wscale, bias,
+                           out_dtype=str(dt) if dt is not None
+                           else self._default_out_dtype)
+        if self._activation:
+            out = F.Activation(out, act_type=self._activation)
+        return out
+
+
+class QuantizedConv2D(_QuantizedLayer):
+    """Calibrated int8 convolution block. Emitted by quantize_net in place
+    of Conv2D (ref: the quantized_conv nodes of
+    src/operator/quantization/quantize_graph_pass.cc)."""
+
+    def __init__(self, conv, in_scale, prefix=None, params=None):
+        self._kwargs = {k: conv._kwargs[k] for k in
+                        ("kernel", "stride", "dilate", "pad", "num_filter",
+                         "num_group", "layout")}
+        super().__init__(conv, in_scale, prefix=prefix, params=params)
+
+    def _invoke(self, F, qx, qweight, wscale, bias, out_dtype):
+        args = (qx, qweight, wscale) + (() if bias is None else (bias,))
+        return F._internal._quantized_conv_v2(
+            *args, **self._kwargs, in_scale=self._in_scale,
+            no_bias=bias is None, out_dtype=out_dtype)
+
+    def __repr__(self):
+        return (f"QuantizedConv2D({self._kwargs['num_filter']}, "
+                f"kernel={self._kwargs['kernel']}, "
+                f"in_scale={self._in_scale:.4g})")
+
+
+class QuantizedDense(_QuantizedLayer):
+    """Calibrated int8 FullyConnected block (see QuantizedConv2D;
+    ref: src/operator/quantization/quantized_fully_connected.cc)."""
+
+    def __init__(self, dense, in_scale, prefix=None, params=None):
+        self._units = dense._units
+        self._flatten = dense._flatten
+        super().__init__(dense, in_scale, prefix=prefix, params=params)
+
+    def _invoke(self, F, qx, qweight, wscale, bias, out_dtype):
+        args = (qx, qweight, wscale) + (() if bias is None else (bias,))
+        return F._internal._quantized_dense_v2(
+            *args, num_hidden=self._units, flatten=self._flatten,
+            in_scale=self._in_scale, no_bias=bias is None,
+            out_dtype=out_dtype)
+
+    def __repr__(self):
+        return f"QuantizedDense({self._units}, in_scale={self._in_scale:.4g})"
+
+
+def quantize_net(net, calib_data, calib_mode: str = "naive",
+                 exclude=(), quantize_dense: bool = True,
+                 fold_bn: bool = True, logger=None):
+    """Quantize a Gluon network for int8 inference, IN PLACE
+    (ref: python/mxnet/contrib/quantization.py quantize_model applied to
+    the Gluon surface; the repo serves Gluon blocks through scanned XLA
+    programs — cached_op.make_scan_forward — so the rewrite happens at the
+    block level and the result hybridizes/scans like any other net).
+
+    Flow: fold BatchNorm into convs (exact) -> run ``calib_data`` batches
+    recording per-layer input ranges (naive absmax or entropy/KL) ->
+    replace each Conv2D/Dense with its calibrated int8 twin whose
+    int8 x int8 -> int32 kernels run natively on the MXU.
+
+    calib_data: iterable of input batches (NDArray/array).
+    exclude: block-name substrings to keep in float (e.g. the first conv).
+    Returns the net (mutated).
+    """
+    from ..gluon.nn.conv_layers import _Conv
+    from ..gluon import nn as _gnn
+    from .. import autograd as _ag
+    from ..ndarray.ndarray import NDArray, array as _arr
+
+    check(calib_mode in ("naive", "entropy"),
+          f"calib_mode must be naive|entropy, got {calib_mode!r}")
+    check(not isinstance(exclude, str),
+          "exclude must be a collection of name substrings, not a bare "
+          "string (a string would match per-character)")
+    # a hybridized net replays stale compiled float graphs and its CachedOp
+    # trace would defeat the calibration hooks — drop to imperative mode
+    # and invalidate every cache; callers re-hybridize the returned net
+    # capture per-block hybridize state (active flag + kwargs like mirror)
+    # so the round-trip below can restore it exactly — hybridize(False)
+    # resets _cached_op_kwargs to defaults
+    hyb_state = [(b, b._active, dict(b._cached_op_kwargs))
+                 for b in [net] + [c for _, _, c in _walk_blocks(net)]
+                 if hasattr(b, "_active")]
+    was_hybridized = any(active for _, active, _ in hyb_state)
+    if was_hybridized:
+        net.hybridize(False)  # also clears every _cached_op in the tree
+    if fold_bn:
+        n = fold_batchnorm(net)
+        if logger:
+            logger.info("fold_batchnorm: folded %d conv+BN pairs", n)
+
+    sites = []     # EVERY (parent, key) occurrence — shared blocks appear
+    #                at multiple sites and all must be replaced
+    uniq = {}      # id(block) -> block (calibrate/quantize once each)
+    for parent, key, child in _walk_blocks(net):
+        is_conv = (isinstance(child, _Conv)
+                   and child._op_name == "Convolution"
+                   and len(child._kwargs["kernel"]) == 2)
+        is_dense = quantize_dense and isinstance(child, _gnn.Dense)
+        if not (is_conv or is_dense):
+            continue
+        if any(pat in child.name for pat in exclude):
+            continue
+        uniq[id(child)] = child
+        sites.append((parent, key, child))
+    targets = [(None, None, b) for b in uniq.values()]
+
+    # --- calibration: record each target's INPUT distribution ----------
+    collector = CalibrationCollector() if calib_mode == "naive" \
+        else HistogramCollector()
+    originals = {}
+    for _, _, blk in targets:
+        orig = type(blk).hybrid_forward
+        name = blk.name
+
+        def wrapped(self, F, x, *a, _orig=orig, _name=name, **kw):
+            collector.collect(_name, x.asnumpy()
+                              if isinstance(x, NDArray) else x)
+            return _orig(self, F, x, *a, **kw)
+
+        originals[id(blk)] = blk.hybrid_forward
+        # instance attribute shadows the class method; bind self explicitly
+        blk.hybrid_forward = wrapped.__get__(blk, type(blk))
+    try:
+        with _ag.pause():
+            for batch in calib_data:
+                x = batch if isinstance(batch, NDArray) else _arr(batch)
+                net(x)
+    finally:
+        for _, _, blk in targets:
+            if id(blk) in originals:
+                del blk.__dict__["hybrid_forward"]
+
+    def in_scale_of(name):
+        seen_names = collector.min_max if calib_mode == "naive" \
+            else collector.hists
+        check(name in seen_names,
+              f"no calibration data reached layer {name!r}: pass calib "
+              "batches that exercise every quantized layer (or add it to "
+              "`exclude`)")
+        if calib_mode == "naive":
+            mn, mx = collector.min_max[name]
+            return max(abs(mn), abs(mx), 1e-8) / 127.0
+        hist, th = collector.hists[name]
+        return get_optimal_threshold(hist, th) / 127.0
+
+    # --- rewrite (scales validated up front: no partial mutation) -------
+    scales = {id(blk): in_scale_of(blk.name) for _, _, blk in targets}
+    qblocks = {}   # one quantized twin per unique source block
+    for _, _, blk in targets:
+        scale = scales[id(blk)]
+        if isinstance(blk, _gnn.Dense):
+            qblocks[id(blk)] = QuantizedDense(blk, scale)
+        else:
+            qblocks[id(blk)] = QuantizedConv2D(blk, scale)
+        if logger:
+            logger.info("quantized %s (in_scale=%.5g)", blk.name, scale)
+    for parent, key, blk in sites:
+        _replace_child(parent, key, blk, qblocks[id(blk)])
+    if was_hybridized:
+        for b, active, kwargs in hyb_state:
+            b._active = active
+            b._cached_op = None
+            b._cached_op_kwargs = kwargs
+        for q in qblocks.values():
+            q.hybridize(True)
+    return net

@@ -165,6 +165,10 @@ class SpaceToDepthStem(HybridBlock):
     are preserved, and the stem trains directly in the rearranged basis.
     """
 
+    # forward ends in self.conv(...): BN folding / quantization may treat
+    # this block's output as that conv's output (contrib.quantization)
+    _tail_conv = True
+
     def __init__(self, channels, layout="NCHW", **kwargs):
         super().__init__(**kwargs)
         self._layout = layout

@@ -185,6 +185,91 @@ def _quantize_v1(data, min_range, max_range, out_type="uint8"):
     return q, -real, real
 
 
+@register("_quantize_static", differentiable=False)
+def _quantize_static(data, scale=1.0):
+    """Symmetric int8 quantization with a STATIC (calibration-time) scale:
+    ``q = clip(round(x / scale), -127, 127)``. The graph-rewrite flow bakes
+    the calibrated activation scale in as an attr so inference needs no
+    per-batch min/max reduction (ref: the quantize nodes emitted by
+    src/operator/quantization/quantize_graph_pass.cc with calibrated
+    min/max attrs)."""
+    jnp = _jnp()
+    # the same 1e-8 floor is applied by the consuming _quantized_*_v2 ops'
+    # dequantize multiply — quantize and dequantize must agree on the
+    # effective scale or near-zero calibrated layers change magnitude
+    inv = 1.0 / max(float(scale), 1e-8)
+    return jnp.clip(jnp.round(data.astype(jnp.float32) * inv),
+                    -127, 127).astype(jnp.int8)
+
+
+def _conv_dn(layout, wlayout=None):
+    """(data, weight, out) dimension-number spec for a layout string."""
+    if layout.endswith("C"):  # NHWC/NWC/NDHWC: weight is (O, *k, I/g)
+        w = "O" + layout[1:-1] + "I"
+    else:  # NCHW-family: weight is (O, I/g, *k)
+        w = "OI" + layout[2:]
+    return (layout, w, layout)
+
+
+@register("_quantized_conv_v2", differentiable=False)
+def _quantized_conv_v2(qdata, qweight, wscale, *maybe_bias, kernel=(),
+                       stride=(), dilate=(), pad=(), num_filter=1,
+                       num_group=1, layout="NHWC", in_scale=1.0,
+                       no_bias=True, out_dtype="float32"):
+    """int8 x int8 -> int32 convolution on the MXU with PER-CHANNEL weight
+    scales and a static input scale; the dequantize multiply and bias add
+    fuse into the conv epilogue. This is the node quantize_net emits for
+    Conv2D blocks — the TPU analog of the reference's calibrated MKLDNN/
+    cuDNN int8 conv kernels (src/operator/quantization/quantized_conv.cu).
+
+    qdata: int8, ``layout``; qweight: int8, (O, *k, I/g) for channel-last
+    layouts / (O, I/g, *k) otherwise; wscale: f32 (O,) per-output-channel
+    dequant scales; optional bias: f32 (O,) (already BN-folded)."""
+    import jax
+    jnp = _jnp()
+    nd = len(kernel)
+    stride = tuple(stride) if stride else (1,) * nd
+    dilate = tuple(dilate) if dilate else (1,) * nd
+    pad = tuple(pad) if pad else (0,) * nd
+    dn = jax.lax.conv_dimension_numbers(qdata.shape, qweight.shape,
+                                        _conv_dn(layout))
+    acc = jax.lax.conv_general_dilated(
+        qdata, qweight, window_strides=stride,
+        padding=[(p, p) for p in pad], rhs_dilation=dilate,
+        dimension_numbers=dn, feature_group_count=num_group,
+        preferred_element_type=jnp.int32)
+    ax = layout.index("C")
+    bshape = tuple(num_filter if i == ax else 1 for i in range(qdata.ndim))
+    out = acc.astype(jnp.float32) * \
+        (wscale.astype(jnp.float32) *
+         max(float(in_scale), 1e-8)).reshape(bshape)
+    if not no_bias and maybe_bias:
+        out = out + maybe_bias[0].astype(jnp.float32).reshape(bshape)
+    return out.astype(jnp.dtype(out_dtype))
+
+
+@register("_quantized_dense_v2", differentiable=False)
+def _quantized_dense_v2(qdata, qweight, wscale, *maybe_bias, num_hidden=1,
+                        flatten=True, in_scale=1.0, no_bias=True,
+                        out_dtype="float32"):
+    """int8 x int8 -> int32 matmul with per-output-channel weight scales
+    (the FullyConnected counterpart of ``_quantized_conv_v2``;
+    ref: src/operator/quantization/quantized_fully_connected.cc)."""
+    import jax
+    jnp = _jnp()
+    x = qdata
+    if flatten and x.ndim > 2:
+        x = x.reshape(x.shape[0], -1)
+    acc = jax.lax.dot_general(x, qweight,
+                              (((x.ndim - 1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) * \
+        (wscale.astype(jnp.float32) * max(float(in_scale), 1e-8))
+    if not no_bias and maybe_bias:
+        out = out + maybe_bias[0].astype(jnp.float32)
+    return out.astype(jnp.dtype(out_dtype))
+
+
 @register("_contrib_quantized_concat", aliases=("quantized_concat",),
           num_outputs=3, variadic=True, differentiable=False)
 def _quantized_concat(*args, dim=1, num_args=1):
