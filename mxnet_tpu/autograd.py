@@ -274,7 +274,9 @@ _VJP_CACHE: Dict[Tuple, Any] = {}
 def _vjp_call(node: _TapeNode, cotangents: Tuple):
     """jit-cached vjp of one op (the FGradient analog, compiled)."""
     import jax
-    key = (node.opdef.name, node.params_key, node.train_mode)
+    from .ops.registry import _trace_time_flags
+    key = (node.opdef.name, node.params_key, node.train_mode,
+           _trace_time_flags())
     fn = _VJP_CACHE.get(key)
     if fn is None:
         opdef = node.opdef

@@ -115,6 +115,11 @@ env.declare("MXNET_IS_RECOVERY", bool, False,
 env.declare("MXNET_STORAGE_FALLBACK_LOG_VERBOSE", bool, True,
             "Warn when an op without a sparse kernel densifies its inputs "
             "(storage fallback).")
+env.declare("MXNET_RESID_DTYPE", str, "",
+            "Store backward activation residuals 8-bit (fp8|e4m3|e5m2). "
+            "Conv dx stays exact (needs only weights); conv dW, BN "
+            "grads/dx (via fp8 xhat) and ReLU masks see small zero-mean "
+            "rounding (ops/resid8.py).")
 env.declare("MXNET_HOME", str, "",
             "Root directory for datasets and model artifacts "
             "(default ~/.mxnet; ref: docs/faq/env_var.md MXNET_HOME).")

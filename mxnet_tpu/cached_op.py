@@ -197,9 +197,13 @@ class CachedOp:
         param_arrays = tuple(p._data._data for p in params)
         training = autograd.is_training()
 
+        from .ops.registry import _trace_time_flags
         key_sig = (tuple((tuple(a.shape), str(a.dtype)) for a in in_arrays),
                    tuple((tuple(a.shape), str(a.dtype)) for a in param_arrays),
-                   in_treedef, training)
+                   in_treedef, training,
+                   # env flags read inside op impls change the traced
+                   # program: toggling them must re-trace, not replay
+                   _trace_time_flags())
         entry = self._cache.get(key_sig)
         rng_key = _random.next_key()
         if entry is None:

@@ -103,16 +103,24 @@ def _convolution(data, weight, *maybe_bias, kernel=(), stride=(), dilate=(),
     dilate = _tuplify(dilate, nd)
     pad = _tuplify(pad if pad else 0, nd)
     layout = _conv_layout(layout, nd)
-    dn = lax.conv_dimension_numbers(data.shape, weight.shape,
-                                    _CONV_DN[layout])
-    out = lax.conv_general_dilated(
-        data, weight,
-        window_strides=stride,
-        padding=[(p, p) for p in pad],
-        rhs_dilation=dilate,
-        dimension_numbers=dn,
-        feature_group_count=num_group,
-    )
+    from . import resid8
+    rdt = resid8.resid_dtype()
+    if rdt is not None and _jnp().issubdtype(data.dtype, _jnp().floating):
+        # 8-bit residual mode: the saved backward input is stored fp8
+        # (bias add stays outside — its grad needs no residual)
+        out = resid8.conv_resid8(data, weight, stride, pad, dilate,
+                                 _CONV_DN[layout], num_group, rdt)
+    else:
+        dn = lax.conv_dimension_numbers(data.shape, weight.shape,
+                                        _CONV_DN[layout])
+        out = lax.conv_general_dilated(
+            data, weight,
+            window_strides=stride,
+            padding=[(p, p) for p in pad],
+            rhs_dilation=dilate,
+            dimension_numbers=dn,
+            feature_group_count=num_group,
+        )
     if not no_bias and maybe_bias:
         bias = maybe_bias[0]
         bshape = [1] * (nd + 2)
@@ -248,22 +256,26 @@ def _bn_batch_stats(data, red, n):
     return mean, var
 
 
-def _make_bn_core():
+def _make_bn_core(resid_dtype_name=None):
     """Training-mode BatchNorm with a hand-fused backward
     (jax.custom_vjp). Why not plain autodiff: value_and_grad over the
     naive formula saves f32 activation-sized residuals (x - mean,
     squares, ...) and runs the whole backward chain at f32 width — on
     TPU that doubles the HBM traffic of exactly the op that is already
     bandwidth-bound (the gap BENCH_r02/README identified). Here the only
-    activation-sized residual is the bf16 input itself; forward and
-    backward do their elementwise math in f32 REGISTERS but read/write
-    compute-dtype, and the per-channel reductions accumulate in f32
+    activation-sized residual is the bf16 input itself — or, under
+    MXNET_RESID_DTYPE (ops/resid8.py), the fp8 NORMALIZED input xhat,
+    halving the residual bytes again AND skipping the backward's
+    recompute of xhat. Forward and backward do their elementwise math in
+    f32 REGISTERS but read/write compute-dtype, and the per-channel
+    reductions accumulate in f32
     (ref: src/operator/nn/batch_norm.cu BatchNormalizationBackward —
     the same sum_dy / sum_dy_xhat closed form cuDNN uses)."""
     import jax
     jnp = _jnp()
+    rdt = jnp.dtype(resid_dtype_name) if resid_dtype_name else None
 
-    def core(data, g32, beta32, axis, eps):
+    def _shapes(data, axis):
         ax = axis % data.ndim
         red = tuple(i for i in range(data.ndim) if i != ax)
         bshape = tuple(data.shape[ax] if i == ax else 1
@@ -271,6 +283,10 @@ def _make_bn_core():
         n = 1
         for i in red:
             n *= data.shape[i]
+        return red, bshape, n
+
+    def core(data, g32, beta32, axis, eps):
+        red, bshape, n = _shapes(data, axis)
         mean, var = _bn_batch_stats(data, red, n)
         inv = _lax().rsqrt(var + eps)
         out = (data.astype(jnp.float32) - mean.reshape(bshape)) \
@@ -280,23 +296,29 @@ def _make_bn_core():
     def fwd(data, g32, beta32, axis, eps):
         out, mean, var = core(data, g32, beta32, axis, eps)
         inv = _lax().rsqrt(var + eps)
-        return (out, mean, var), (data, mean, inv, g32)
+        if rdt is None:
+            return (out, mean, var), (data, mean, inv, g32)
+        _, bshape, _ = _shapes(data, axis)
+        xhat = (data.astype(jnp.float32) - mean.reshape(bshape)) \
+            * inv.reshape(bshape)
+        return (out, mean, var), (xhat.astype(rdt), inv, g32)
 
     def bwd(axis, eps, res, cots):
-        data, mean, inv, g32 = res
         cot_out = cots[0]  # mean/var outputs only feed running-stat
         #                    updates — no gradient path (stop-gradient
         #                    semantics, like the reference's aux states)
-        ax = axis % data.ndim
-        red = tuple(i for i in range(data.ndim) if i != ax)
-        bshape = tuple(data.shape[ax] if i == ax else 1
-                       for i in range(data.ndim))
-        n = 1
-        for i in red:
-            n *= data.shape[i]
-        x32 = data.astype(jnp.float32)
+        if rdt is None:
+            data, mean, inv, g32 = res
+            red, bshape, n = _shapes(data, axis)
+            xhat = (data.astype(jnp.float32) - mean.reshape(bshape)) \
+                * inv.reshape(bshape)
+            out_dtype = data.dtype
+        else:
+            xhat_q, inv, g32 = res
+            red, bshape, n = _shapes(xhat_q, axis)
+            xhat = xhat_q.astype(jnp.float32)
+            out_dtype = cot_out.dtype
         dy32 = cot_out.astype(jnp.float32)
-        xhat = (x32 - mean.reshape(bshape)) * inv.reshape(bshape)
         sum_dy = jnp.sum(dy32, axis=red)
         sum_dy_xhat = jnp.sum(dy32 * xhat, axis=red)
         dbeta = sum_dy
@@ -304,14 +326,14 @@ def _make_bn_core():
         dx = (g32 * inv).reshape(bshape) * (
             dy32 - (sum_dy / n).reshape(bshape)
             - xhat * (sum_dy_xhat / n).reshape(bshape))
-        return dx.astype(data.dtype), dgamma, dbeta
+        return dx.astype(out_dtype), dgamma, dbeta
 
     core = jax.custom_vjp(core, nondiff_argnums=(3, 4))
     core.defvjp(fwd, bwd)
     return core
 
 
-_BN_CORE = None
+_BN_CORE = {}
 
 
 @register("BatchNorm", aliases=("batch_norm",), num_outputs=3,
@@ -328,10 +350,13 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     g = jnp.ones(gamma.shape, jnp.float32) if fix_gamma \
         else gamma.astype(jnp.float32)
     if _training and not use_global_stats:
-        global _BN_CORE
-        if _BN_CORE is None:
-            _BN_CORE = _make_bn_core()
-        return _BN_CORE(data, g, beta.astype(jnp.float32), ax, float(eps))
+        from . import resid8
+        rdt = resid8.resid_dtype() if \
+            jnp.issubdtype(data.dtype, jnp.floating) else None
+        core = _BN_CORE.get(rdt)
+        if core is None:
+            core = _BN_CORE[rdt] = _make_bn_core(rdt)
+        return core(data, g, beta.astype(jnp.float32), ax, float(eps))
     mean = moving_mean.astype(jnp.float32)
     var = moving_var.astype(jnp.float32)
     inv = _lax().rsqrt(var + eps)
@@ -401,6 +426,10 @@ def _lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
 def _activation(data, act_type="relu"):
     jnp = _jnp()
     if act_type == "relu":
+        from . import resid8
+        rdt = resid8.resid_dtype()
+        if rdt is not None and jnp.issubdtype(data.dtype, jnp.floating):
+            return resid8.relu_resid8(data, rdt)
         return jnp.maximum(data, 0)
     if act_type == "sigmoid":
         return 1.0 / (1.0 + jnp.exp(-data))

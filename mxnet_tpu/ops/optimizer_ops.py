@@ -29,14 +29,14 @@ def _prep_grad(grad, rescale_grad, clip_gradient, wd, weight):
     return g + wd * weight
 
 
-@register("sgd_update")
+@register("sgd_update", dynamic_params=("lr",))
 def _sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
                 clip_gradient=-1.0, lazy_update=True):
     g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
     return weight - lr * g
 
 
-@register("sgd_mom_update", num_outputs=2)
+@register("sgd_mom_update", dynamic_params=("lr",), num_outputs=2)
 def _sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
                     rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
     g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
@@ -44,7 +44,7 @@ def _sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
     return weight + new_mom, new_mom
 
 
-@register("mp_sgd_update", num_outputs=2)
+@register("mp_sgd_update", dynamic_params=("lr",), num_outputs=2)
 def _mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
                    clip_gradient=-1.0, lazy_update=True):
     g = _prep_grad(grad.astype(weight32.dtype), rescale_grad, clip_gradient,
@@ -53,7 +53,7 @@ def _mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
     return w32.astype(weight.dtype), w32
 
 
-@register("mp_sgd_mom_update", num_outputs=3)
+@register("mp_sgd_mom_update", dynamic_params=("lr",), num_outputs=3)
 def _mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
                        wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                        lazy_update=True):
@@ -64,7 +64,7 @@ def _mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
     return w32.astype(weight.dtype), new_mom, w32
 
 
-@register("nag_mom_update", num_outputs=2)
+@register("nag_mom_update", dynamic_params=("lr",), num_outputs=2)
 def _nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
                     rescale_grad=1.0, clip_gradient=-1.0):
     g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
@@ -72,7 +72,7 @@ def _nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
     return weight - lr * (g + momentum * new_mom), new_mom
 
 
-@register("adam_update", num_outputs=3)
+@register("adam_update", dynamic_params=("lr",), num_outputs=3)
 def _adam_update(weight, grad, mean, var, lr=0.01, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                  lazy_update=True):
@@ -84,7 +84,7 @@ def _adam_update(weight, grad, mean, var, lr=0.01, beta1=0.9, beta2=0.999,
     return w, m, v
 
 
-@register("signsgd_update")
+@register("signsgd_update", dynamic_params=("lr",))
 def _signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
                     clip_gradient=-1.0):
     jnp = _jnp()
@@ -94,7 +94,7 @@ def _signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
     return weight - lr * (jnp.sign(g) + wd * weight)
 
 
-@register("signum_update", num_outputs=2)
+@register("signum_update", dynamic_params=("lr",), num_outputs=2)
 def _signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
                    rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
     jnp = _jnp()
@@ -107,7 +107,7 @@ def _signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
     return w - lr * wd * weight, new_mom
 
 
-@register("rmsprop_update", num_outputs=2)
+@register("rmsprop_update", dynamic_params=("lr",), num_outputs=2)
 def _rmsprop_update(weight, grad, n, lr=0.01, gamma1=0.95, epsilon=1e-8,
                     wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                     clip_weights=-1.0):
@@ -120,7 +120,7 @@ def _rmsprop_update(weight, grad, n, lr=0.01, gamma1=0.95, epsilon=1e-8,
     return w, new_n
 
 
-@register("rmspropalex_update", num_outputs=4)
+@register("rmspropalex_update", dynamic_params=("lr",), num_outputs=4)
 def _rmspropalex_update(weight, grad, n, g_avg, delta, lr=0.01, gamma1=0.95,
                         gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
                         clip_gradient=-1.0, clip_weights=-1.0):
@@ -133,7 +133,7 @@ def _rmspropalex_update(weight, grad, n, g_avg, delta, lr=0.01, gamma1=0.95,
     return weight + new_delta, new_n, new_gavg, new_delta
 
 
-@register("ftrl_update", num_outputs=3)
+@register("ftrl_update", dynamic_params=("lr",), num_outputs=3)
 def _ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
                  rescale_grad=1.0, clip_gradient=-1.0):
     jnp = _jnp()
@@ -150,7 +150,7 @@ def _ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
     return w, new_z, new_n
 
 
-@register("ftml_update", num_outputs=3)
+@register("ftml_update", dynamic_params=("lr", "t"), num_outputs=3)
 def _ftml_update(weight, grad, d, v, z, lr=0.01, beta1=0.6, beta2=0.999,
                  epsilon=1e-8, t=1, wd=0.0, rescale_grad=1.0,
                  clip_grad=-1.0):
@@ -165,7 +165,7 @@ def _ftml_update(weight, grad, d, v, z, lr=0.01, beta1=0.6, beta2=0.999,
     return -new_z / d_t, d_t, new_v
 
 
-@register("_adamw_update", aliases=("adamw_update",), num_outputs=3)
+@register("_adamw_update", dynamic_params=("lr",), aliases=("adamw_update",), num_outputs=3)
 def _adamw_update(weight, grad, mean, var, rescale_grad_t, lr=0.01, beta1=0.9,
                   beta2=0.999, epsilon=1e-8, wd=0.0, eta=1.0,
                   clip_gradient=-1.0):
@@ -266,7 +266,7 @@ def _multi_mp_sgd_mom_update(*tensors, lrs=(), wds=(), momentum=0.0,
     return tuple(ws) + tuple(moms) + tuple(w32s)
 
 
-@register("_contrib_group_adagrad_update",
+@register("_contrib_group_adagrad_update", dynamic_params=("lr",),
           aliases=("group_adagrad_update",), num_outputs=2)
 def _group_adagrad_update(weight, grad, history, lr=0.01, rescale_grad=1.0,
                           clip_gradient=-1.0, epsilon=1e-5):
@@ -283,7 +283,7 @@ def _group_adagrad_update(weight, grad, history, lr=0.01, rescale_grad=1.0,
     return weight - lr * g / denom, new_hist
 
 
-@register("_sparse_adagrad_update", aliases=("sparse_adagrad_update",),
+@register("_sparse_adagrad_update", dynamic_params=("lr",), aliases=("sparse_adagrad_update",),
           num_outputs=2)
 def _sparse_adagrad_update(weight, grad, history, lr=0.01, rescale_grad=1.0,
                            clip_gradient=-1.0, epsilon=1e-7, wd=0.0):
@@ -301,7 +301,7 @@ def _sparse_adagrad_update(weight, grad, history, lr=0.01, rescale_grad=1.0,
     return weight - lr * g / jnp.sqrt(new_hist + epsilon), new_hist
 
 
-@register("_mp_adamw_update", aliases=("mp_adamw_update",), num_outputs=4)
+@register("_mp_adamw_update", dynamic_params=("lr",), aliases=("mp_adamw_update",), num_outputs=4)
 def _mp_adamw_update(weight, grad, mean, var, weight32, rescale_grad_t,
                      lr=0.01, beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
                      eta=1.0, clip_gradient=-1.0):

@@ -202,13 +202,11 @@ def _quantize_static(data, scale=1.0):
                     -127, 127).astype(jnp.int8)
 
 
-def _conv_dn(layout, wlayout=None):
-    """(data, weight, out) dimension-number spec for a layout string."""
-    if layout.endswith("C"):  # NHWC/NWC/NDHWC: weight is (O, *k, I/g)
-        w = "O" + layout[1:-1] + "I"
-    else:  # NCHW-family: weight is (O, I/g, *k)
-        w = "OI" + layout[2:]
-    return (layout, w, layout)
+def _conv_dn(layout):
+    """(data, weight, out) dimension-number spec — the one authoritative
+    layout table lives with the float conv (ops/nn.py)."""
+    from .nn import _CONV_DN
+    return _CONV_DN[layout]
 
 
 @register("_quantized_conv_v2", differentiable=False)

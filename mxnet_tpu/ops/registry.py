@@ -61,13 +61,19 @@ class OpDef:
 
     __slots__ = ("name", "fn", "num_outputs", "differentiable", "creation",
                  "namespaces", "_jit_cache", "doc", "variadic", "backward_fn",
-                 "rng", "aux_inputs")
+                 "rng", "aux_inputs", "dynamic_params")
 
     def __init__(self, name: str, fn: Callable, num_outputs=1,
                  differentiable: bool = True, creation: bool = False,
                  namespaces: Sequence[str] = ("op",), variadic: bool = False,
                  backward_fn: Optional[Callable] = None, doc: str = "",
-                 rng: bool = False, aux_inputs: Sequence[int] = ()):
+                 rng: bool = False, aux_inputs: Sequence[int] = (),
+                 dynamic_params: Sequence[str] = ()):
+        # float params traced as device scalars instead of baked into the
+        # compiled program: a per-step value (Adam's bias-corrected lr_t, a
+        # scheduled lr) must NOT key the jit cache, or every step
+        # recompiles (measured: eager Adam recompiled 15x/step before this)
+        self.dynamic_params = tuple(dynamic_params)
         self.rng = rng
         # input slots that are auxiliary states in symbolic graphs
         # (ref: OperatorProperty::ListAuxiliaryStates — e.g. BatchNorm's
@@ -85,15 +91,19 @@ class OpDef:
         self._jit_cache: Dict[Tuple, Callable] = {}
 
     # -- eager execution ------------------------------------------------
-    def jitted(self, params_key: Tuple) -> Callable:
+    def jitted(self, params_key: Tuple, dyn_names: Tuple = ()) -> Callable:
         """One ``jax.jit`` per (op, params); XLA caches per shape/dtype.
 
         This is the eager hot path: the analog of the reference's per-op
         engine push, except each (op, params, shape, dtype) combination is
         compiled once into a fused XLA executable and then replayed
         (SURVEY.md §7 stage 4: "compile-and-cache tiny HLO modules").
+
+        ``dyn_names``: declared dynamic params bound on this call — their
+        VALUES arrive as a traced tuple argument, not in the cache key.
         """
-        cached = self._jit_cache.get(params_key)
+        cache_key = (params_key, dyn_names)
+        cached = self._jit_cache.get(cache_key)
         if cached is None:
             import jax
             # strip the trace-time flag suffix (booleans) — only real
@@ -102,11 +112,12 @@ class OpDef:
                           if isinstance(kv, tuple) and len(kv) == 2)
             fn = self.fn
 
-            def call(*arrays):
-                return fn(*arrays, **kwargs)
+            def call(dyn_vals, *arrays):
+                return fn(*arrays, **kwargs,
+                          **dict(zip(dyn_names, dyn_vals)))
 
             cached = jax.jit(call)
-            self._jit_cache[params_key] = cached
+            self._jit_cache[cache_key] = cached
         return cached
 
     def __call__(self, *inputs, **params):
@@ -214,7 +225,8 @@ def _trace_time_flags() -> Tuple:
     """Env flags read INSIDE op impls at trace time (they change the
     compiled program, so they must be part of the jit-cache key —
     otherwise toggling the flag after first compile is a silent no-op)."""
-    return (bool(env.get("MXNET_SAFE_ACCUMULATION")),)
+    return (bool(env.get("MXNET_SAFE_ACCUMULATION")),
+            env.get("MXNET_RESID_DTYPE") or "")
 
 
 def invoke_jax(opdef: OpDef, arrays: Sequence, params: Dict[str, Any]):
@@ -225,16 +237,25 @@ def invoke_jax(opdef: OpDef, arrays: Sequence, params: Dict[str, Any]):
     with the engine push replaced by XLA async dispatch.
     """
     params = normalize_params(params)
+    dyn = {}
+    if opdef.dynamic_params:
+        for n in opdef.dynamic_params:
+            if n in params and isinstance(params[n], (int, float)) \
+                    and not isinstance(params[n], bool):
+                # plain python float: jit traces it as a WEAK-typed scalar,
+                # so `weight - lr * g` keeps the weight's (bf16) dtype —
+                # a strong f32 scalar would silently promote the update
+                dyn[n] = float(params.pop(n))
     key = hashable_params(params) + _trace_time_flags()
     from .. import profiler as _prof
     profiling = _prof.is_active()
     t0 = __import__("time").perf_counter() if profiling else 0.0
     try:
-        out = opdef.jitted(key)(*arrays)
+        out = opdef.jitted(key, tuple(dyn))(tuple(dyn.values()), *arrays)
     except TypeError:
         # Non-jittable param combination (e.g. python callable param):
         # fall back to direct tracing-free eval.
-        out = opdef.fn(*arrays, **params)
+        out = opdef.fn(*arrays, **params, **dyn)
     if _naive_engine():
         import jax
         jax.block_until_ready(out)

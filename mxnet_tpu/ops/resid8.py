@@ -1,0 +1,131 @@
+"""8-bit activation residuals: trade backward-pass numerical headroom for
+HBM bytes.
+
+Why this exists: the ResNet-50 training step on one v5e chip is
+HBM-bandwidth-bound on activation traffic, not compute-bound (README perf
+ledger; ~30 TFLOP/s sustained vs ~145 TFLOP/s demonstrated conv peak).
+The residuals autodiff saves between forward and backward are
+activation-sized tensors read exactly once in backward — storing them as
+fp8 (float8_e4m3fn) halves those bytes at small zero-mean rounding error
+per element. For CONVOLUTIONS dx needs only the weights and stays exact;
+conv dW, the BN backward (which reads fp8 xhat for both its dx and
+dgamma), and the ReLU mask see the rounding, which the per-channel
+reductions average out over the batch.
+
+Design rules (all enforced here):
+- storage-only: fp8 matmul is software-emulated on v5e (~1.8 TFLOP/s
+  measured) — residuals are CAST to fp8 on store and back to the compute
+  dtype before any FLOP.
+- pure casts, no dynamic scales: a per-tensor absmax scale would add a
+  full extra read pass over the activation; e4m3's exponent range (±448)
+  covers post-BN/ReLU activations without one. Saturation clamps the
+  (rare) outliers.
+- shared copies: ReLU saves fp8(out) with the same cast expression the
+  following Convolution saves for its input, so XLA CSE keeps ONE fp8
+  copy per activation.
+
+Enabled by MXNET_RESID_DTYPE=fp8 (read at trace time; see base.env).
+Reference analog: none — the reference's closest lever is fp16 training
+(src/operator/nn/convolution.cu DType=half); this is the TPU-native
+extension of the same memory/precision trade.
+"""
+from __future__ import annotations
+
+from functools import lru_cache
+
+from ..base import env
+
+__all__ = ["resid_dtype", "conv_resid8", "relu_resid8"]
+
+_NAMES = {"fp8": "float8_e4m3fn", "e4m3": "float8_e4m3fn",
+          "e5m2": "float8_e5m2"}
+
+
+def resid_dtype():
+    """The configured residual storage dtype name, or None (disabled)."""
+    v = env.get("MXNET_RESID_DTYPE")
+    if not v:
+        return None
+    name = _NAMES.get(v, v)
+    if name not in ("float8_e4m3fn", "float8_e5m2"):
+        from ..base import MXNetError
+        raise MXNetError(
+            f"MXNET_RESID_DTYPE={v!r}: expected fp8|e4m3|e5m2")
+    return name
+
+
+@lru_cache(maxsize=None)
+def _conv8(cfg, rdt_name):
+    """Convolution whose saved input residual is stored 8-bit.
+
+    cfg = (stride, pad, dilate, dn_spec, num_group); the backward
+    re-derives both cotangents via jax.vjp of the same conv so dx (which
+    needs only weights) is exact and only dW sees the 8-bit input."""
+    import jax
+    import jax.numpy as jnp
+    stride, pad, dilate, dn_spec, groups = cfg
+    rdt = jnp.dtype(rdt_name)
+
+    def core(data, weight):
+        dn = jax.lax.conv_dimension_numbers(data.shape, weight.shape,
+                                            dn_spec)
+        return jax.lax.conv_general_dilated(
+            data, weight, window_strides=stride,
+            padding=[(p, p) for p in pad], rhs_dilation=dilate,
+            dimension_numbers=dn, feature_group_count=groups)
+
+    @jax.custom_vjp
+    def f(data, weight):
+        return core(data, weight)
+
+    def fwd(data, weight):
+        # the fp8 cast fuses into whichever elementwise kernel produced
+        # `data`; only the 1-byte copy reaches HBM for the backward
+        return core(data, weight), (data.astype(rdt), weight)
+
+    def bwd(res, dy):
+        xq, w = res
+        x = xq.astype(dy.dtype)
+        _, vjp = jax.vjp(core, x, w)
+        return vjp(dy)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def conv_resid8(data, weight, stride, pad, dilate, dn_spec, groups,
+                rdt_name):
+    cfg = (tuple(stride), tuple(pad), tuple(dilate), tuple(dn_spec),
+           int(groups))
+    return _conv8(cfg, rdt_name)(data, weight)
+
+
+@lru_cache(maxsize=None)
+def _relu8(rdt_name):
+    """ReLU saving fp8(out): the mask is re-derived as fp8(out) > 0 — the
+    cast expression is IDENTICAL to the one the following convolution
+    saves for its input, so XLA CSE materializes one fp8 copy serving
+    both. (fp8 rounds denormal-small positives to 0; the gradient there
+    is the valid 0 subgradient.)"""
+    import jax
+    import jax.numpy as jnp
+    rdt = jnp.dtype(rdt_name)
+
+    @jax.custom_vjp
+    def f(x):
+        return jnp.maximum(x, 0)
+
+    def fwd(x):
+        y = jnp.maximum(x, 0)
+        return y, (y.astype(rdt),)
+
+    def bwd(res, dy):
+        (yq,) = res
+        return (jnp.where(yq > 0, dy, jnp.zeros((), dy.dtype)),)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def relu_resid8(data, rdt_name):
+    return _relu8(rdt_name)(data)

@@ -285,7 +285,7 @@ def _row_grad(gdata, rows, rescale_grad, clip_gradient, wd):
     return g + wd * rows
 
 
-@register("_sparse_sgd_update")
+@register("_sparse_sgd_update", dynamic_params=("lr",))
 def _sparse_sgd_update(weight, gdata, gidx, lr=0.01, wd=0.0, rescale_grad=1.0,
                        clip_gradient=-1.0):
     rows = weight[gidx]
@@ -293,7 +293,7 @@ def _sparse_sgd_update(weight, gdata, gidx, lr=0.01, wd=0.0, rescale_grad=1.0,
     return weight.at[gidx].set(rows - lr * g)
 
 
-@register("_sparse_sgd_mom_update", num_outputs=2)
+@register("_sparse_sgd_mom_update", dynamic_params=("lr",), num_outputs=2)
 def _sparse_sgd_mom_update(weight, gdata, gidx, mom, lr=0.01, momentum=0.0,
                            wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
     rows = weight[gidx]
@@ -303,7 +303,7 @@ def _sparse_sgd_mom_update(weight, gdata, gidx, mom, lr=0.01, momentum=0.0,
             mom.at[gidx].set(new_mom_rows))
 
 
-@register("_sparse_adam_update", num_outputs=3)
+@register("_sparse_adam_update", dynamic_params=("lr",), num_outputs=3)
 def _sparse_adam_update(weight, gdata, gidx, mean, var, lr=0.01, beta1=0.9,
                         beta2=0.999, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
                         clip_gradient=-1.0):
