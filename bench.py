@@ -138,7 +138,7 @@ def run(batch=256, k_steps=8, dtype=None, layout=None, model=None):
 
 
 def run_inference(batch=256, dtype=None, layout=None, k_batches=8, reps=3,
-                  model=None):
+                  model=None, int8=None):
     """Forward-only throughput (regenerates the README inference numbers:
     ref example/image-classification/benchmark_score.py).
 
@@ -147,7 +147,12 @@ def run_inference(batch=256, dtype=None, layout=None, k_batches=8, reps=3,
     per-dispatch serving pattern would measure the relay, not the chip.
     MXTPU_BENCH_MODEL selects the architecture (resnet50_v1 default;
     resnet152_v1 / inceptionv3 / vgg16 / alexnet cover the other
-    BASELINE.md rows — NCHW-only zoo models fall back to that layout)."""
+    BASELINE.md rows — NCHW-only zoo models fall back to that layout).
+
+    MXTPU_BENCH_INT8=1: calibrated int8 path — BN folded into convs,
+    weights int8 per-channel, activations int8 between layers
+    (contrib.quantization.quantize_net). The v5e MXU runs int8 conv at
+    ~1.5x bf16 FLOPs and inter-layer activations at half the HBM bytes."""
     import numpy as np
     import jax
     import jax.numpy as jnp
@@ -185,11 +190,30 @@ def run_inference(batch=256, dtype=None, layout=None, k_batches=8, reps=3,
     with jax.default_device(cpu):
         net(mx.nd.from_jax(jnp.asarray(rs.rand(*small).astype(np.float32),
                                        device=cpu)))
+    if int8 is None:
+        int8 = os.environ.get("MXTPU_BENCH_INT8", "0") != "0"
+    if int8:
+        # fold + calibrate + rewrite ON HOST (eager per-block calls would
+        # each pay the ~100 ms relay RTT on the accelerator)
+        from mxnet_tpu.contrib.quantization import quantize_net
+        with jax.default_device(cpu):
+            calib = [jnp.asarray(
+                rs.rand(*small).astype(np.float32) * 2 - 1, device=cpu)
+                for _ in range(4)]
+            t0 = time.time()
+            net = quantize_net(net, [mx.nd.from_jax(c) for c in calib])
+            log(f"quantize_net (fold+calibrate+rewrite) took "
+                f"{time.time() - t0:.1f}s")
     accel = jax.devices()[0]
     for _, p in net.collect_params().items():
         if p._data is not None:
-            p._data._rebind(jax.device_put(
-                p._data._data.astype(cdt), accel))
+            a = p._data._data
+            # int8 weights/scales keep their dtype; floats go compute-dtype
+            # except the quantized path's f32 scales/biases (tiny, and the
+            # dequant epilogue multiplies in f32 registers anyway)
+            if not int8 and a.dtype == jnp.float32:
+                a = a.astype(cdt)
+            p._data._rebind(jax.device_put(a, accel))
 
     # cast to the compute dtype ON HOST (ml_dtypes): halves tunnel bytes
     # and avoids double residency of f32+bf16 copies on the chip
