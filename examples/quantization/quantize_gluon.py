@@ -1,0 +1,90 @@
+"""End-to-end Gluon int8 quantization (ref: example/quantization/
+imagenet_gen_qsym.py + imagenet_inference.py, Gluon-surface analog):
+train a small convnet to convergence, quantize it with calibration
+(fold BN -> per-channel int8 weights -> calibrated activation scales),
+and report the int8-vs-float accuracy delta and output agreement.
+
+Run: python examples/quantization/quantize_gluon.py [--calib-mode entropy]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+
+def make_data(rs, n, classes=4, size=12):
+    """A learnable synthetic task: class = dominant color channel+quadrant."""
+    y = rs.randint(0, classes, n)
+    x = rs.rand(n, size, size, 3).astype(np.float32) * 0.4
+    for i, c in enumerate(y):
+        ch, quad = c % 3, c // 3
+        h = slice(0, size // 2) if quad == 0 else slice(size // 2, size)
+        x[i, h, :, ch] += 1.0
+    return x, y.astype(np.float32)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--calib-mode", default="naive",
+                    choices=["naive", "entropy"])
+    ap.add_argument("--epochs", type=int, default=40)
+    ap.add_argument("--batch-size", type=int, default=64)
+    args = ap.parse_args()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.contrib.quantization import quantize_net
+
+    rs = np.random.RandomState(0)
+    mx.random.seed(0)
+
+    net = nn.HybridSequential(prefix="")
+    net.add(nn.Conv2D(16, 3, padding=1, use_bias=False, in_channels=3,
+                      layout="NHWC"))
+    net.add(nn.BatchNorm(axis=-1))
+    net.add(nn.Activation("relu"))
+    net.add(nn.Conv2D(32, 3, padding=1, strides=2, use_bias=False,
+                      in_channels=16, layout="NHWC"))
+    net.add(nn.BatchNorm(axis=-1))
+    net.add(nn.Activation("relu"))
+    net.add(nn.GlobalAvgPool2D(layout="NHWC"))
+    net.add(nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.3, "momentum": 0.9})
+    lossfn = gluon.loss.SoftmaxCrossEntropyLoss()
+    for ep in range(args.epochs):
+        x, y = make_data(rs, args.batch_size)
+        with autograd.record():
+            loss = lossfn(net(mx.nd.array(x)), mx.nd.array(y))
+        loss.backward()
+        trainer.step(args.batch_size)
+    print(f"final train loss: {float(loss.mean().asnumpy()):.4f}")
+
+    xt, yt = make_data(rs, 1024)
+    float_out = net(mx.nd.array(xt)).asnumpy()
+    float_acc = (float_out.argmax(1) == yt).mean()
+
+    calib = [make_data(rs, args.batch_size)[0] for _ in range(8)]
+    qnet = quantize_net(net, calib, calib_mode=args.calib_mode)
+    qnet.hybridize()
+    int8_out = qnet(mx.nd.array(xt)).asnumpy()
+    int8_acc = (int8_out.argmax(1) == yt).mean()
+    agree = (int8_out.argmax(1) == float_out.argmax(1)).mean()
+
+    print(f"float32 top-1: {float_acc:.4f}")
+    print(f"int8    top-1: {int8_acc:.4f}  (delta {float_acc - int8_acc:+.4f})")
+    print(f"argmax agreement: {agree:.4f}")
+    assert abs(float_acc - int8_acc) <= 0.01, "int8 accuracy delta >1%"
+    assert agree >= 0.98
+    print("quantize_gluon done")
+
+
+if __name__ == "__main__":
+    main()

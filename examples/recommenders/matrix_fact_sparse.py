@@ -1,0 +1,107 @@
+"""Recommender: matrix factorization with large sparse embeddings
+(ref: example/recommenders/matrix_fact.py — MovieLens MF; rebuilt
+TPU-first over synthetic interactions with planted low-rank structure).
+
+What it exercises beyond the basic MF example (examples/model_parallel):
+- REAL vocab sizes (default 100k users x 50k items) where dense
+  gradient updates would touch 150k rows per step for a 4k-row batch —
+  Embedding(sparse_grad=True) produces row_sparse gradients and the
+  lazy Adam update (ref: optimizer_op.cc AdamUpdateRspImpl) rewrites
+  state ONLY for touched rows.
+- rating prediction = dot(user_vec, item_vec) + user/item biases,
+  trained with L2 loss against the planted factors + noise.
+
+Success = held-out RMSE approaching the injected noise floor.
+
+Run: python examples/recommenders/matrix_fact_sparse.py --iters 200
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--iters", type=int, default=200)
+    ap.add_argument("--batch-size", type=int, default=4096)
+    ap.add_argument("--users", type=int, default=100000)
+    ap.add_argument("--items", type=int, default=50000)
+    ap.add_argument("--dim", type=int, default=16)
+    ap.add_argument("--true-rank", type=int, default=4)
+    ap.add_argument("--noise", type=float, default=0.1)
+    ap.add_argument("--lr", type=float, default=0.02)
+    args = ap.parse_args()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+
+    rs = np.random.RandomState(0)
+    mx.random.seed(0)
+
+    # planted low-rank rating structure (the "true" preferences)
+    u_true = rs.randn(args.users, args.true_rank).astype(np.float32) * 0.7
+    i_true = rs.randn(args.items, args.true_rank).astype(np.float32) * 0.7
+
+    def sample_batch(n):
+        u = rs.randint(0, args.users, n)
+        i = rs.randint(0, args.items, n)
+        r = (u_true[u] * i_true[i]).sum(1) + \
+            rs.randn(n).astype(np.float32) * args.noise
+        return u, i, r.astype(np.float32)
+
+    class MFNet(nn.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            # sparse_grad: backward emits row_sparse grads so the
+            # optimizer touches only the batch's rows
+            self.user_emb = nn.Embedding(args.users, args.dim,
+                                         sparse_grad=True)
+            self.item_emb = nn.Embedding(args.items, args.dim,
+                                         sparse_grad=True)
+            self.user_b = nn.Embedding(args.users, 1, sparse_grad=True)
+            self.item_b = nn.Embedding(args.items, 1, sparse_grad=True)
+
+        def hybrid_forward(self, F, user, item):
+            p = F.sum(self.user_emb(user) * self.item_emb(item), axis=-1)
+            return p + self.user_b(user).reshape((-1,)) + \
+                self.item_b(item).reshape((-1,))
+
+    net = MFNet()
+    net.initialize(mx.init.Normal(0.1))
+    # lazy Adam: m/v state advances only on touched rows
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr, "lazy_update": True})
+    l2 = gluon.loss.L2Loss()
+
+    for it in range(args.iters):
+        u, i, r = sample_batch(args.batch_size)
+        with autograd.record():
+            pred = net(mx.nd.array(u), mx.nd.array(i))
+            loss = l2(pred, mx.nd.array(r))
+        loss.backward()
+        # proof the sparse path is live: grads really are row_sparse
+        if it == 0:
+            g = net.user_emb.weight.grad()
+            assert getattr(g, "stype", "default") == "row_sparse", g
+            print(f"user_emb grad stype={g.stype}, "
+                  f"touched rows={g._indices.shape[0]} of {args.users}")
+        trainer.step(args.batch_size)
+        if it % 40 == 0 or it == args.iters - 1:
+            print(f"iter {it} l2-loss "
+                  f"{float(loss.mean().asnumpy()):.4f}", flush=True)
+
+    u, i, r = sample_batch(8192)
+    pred = net(mx.nd.array(u), mx.nd.array(i)).asnumpy()
+    rmse = float(np.sqrt(np.mean((pred - r) ** 2)))
+    print(f"held-out RMSE: {rmse:.4f} (noise floor {args.noise})")
+    return rmse
+
+
+if __name__ == "__main__":
+    main()
