@@ -120,6 +120,14 @@ env.declare("MXNET_RESID_DTYPE", str, "",
             "Conv dx stays exact (needs only weights); conv dW, BN "
             "grads/dx (via fp8 xhat) and ReLU masks see small zero-mean "
             "rounding (ops/resid8.py).")
+env.declare("MXNET_CONV_COMPUTE", str, "",
+            "Set to 'int8' to run training convolutions int8 on the MXU "
+            "(static activation range + per-channel weight scales; "
+            "~1.5x the bf16 conv rate and half the conv-input HBM reads; "
+            "ops/resid8.py conv_int8_train).")
+env.declare("MXNET_CONV_INT8_RANGE", float, 8.0,
+            "Symmetric activation clip range for MXNET_CONV_COMPUTE=int8 "
+            "(post-BN/ReLU activations are O(1); widen if a model clips).")
 env.declare("MXNET_HOME", str, "",
             "Root directory for datasets and model artifacts "
             "(default ~/.mxnet; ref: docs/faq/env_var.md MXNET_HOME).")

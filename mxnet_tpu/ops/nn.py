@@ -105,7 +105,12 @@ def _convolution(data, weight, *maybe_bias, kernel=(), stride=(), dilate=(),
     layout = _conv_layout(layout, nd)
     from . import resid8
     rdt = resid8.resid_dtype()
-    if rdt is not None and _jnp().issubdtype(data.dtype, _jnp().floating):
+    is_float = _jnp().issubdtype(data.dtype, _jnp().floating)
+    if is_float and resid8.conv_int8():
+        # int8-on-MXU training conv (quantized forward, exact dx)
+        out = resid8.conv_int8_train(data, weight, stride, pad, dilate,
+                                     _CONV_DN[layout], num_group)
+    elif rdt is not None and is_float:
         # 8-bit residual mode: the saved backward input is stored fp8
         # (bias add stays outside — its grad needs no residual)
         out = resid8.conv_resid8(data, weight, stride, pad, dilate,

@@ -226,7 +226,9 @@ def _trace_time_flags() -> Tuple:
     compiled program, so they must be part of the jit-cache key —
     otherwise toggling the flag after first compile is a silent no-op)."""
     return (bool(env.get("MXNET_SAFE_ACCUMULATION")),
-            env.get("MXNET_RESID_DTYPE") or "")
+            env.get("MXNET_RESID_DTYPE") or "",
+            env.get("MXNET_CONV_COMPUTE") or "",
+            float(env.get("MXNET_CONV_INT8_RANGE")))
 
 
 def invoke_jax(opdef: OpDef, arrays: Sequence, params: Dict[str, Any]):

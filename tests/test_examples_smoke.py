@@ -79,3 +79,44 @@ def test_quantize_gluon_example_accuracy_delta():
     assert "quantize_gluon done" in r.stdout
     delta = [l for l in r.stdout.splitlines() if "delta" in l][0]
     assert abs(float(delta.split("delta")[1].strip(" )+"))) <= 0.01
+
+
+@pytest.mark.slow
+def test_ctc_example_learns():
+    """CTC loss must collapse by >5x within a short run (full sequence
+    accuracy needs ~400 iters; the smoke bar is learning, like rcnn's)."""
+    r = _run("examples/ctc/lstm_ocr.py", ["--iters", "60"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [l for l in r.stdout.splitlines() if "ctc-loss" in l]
+    first = float(lines[0].split("ctc-loss")[1])
+    last = float(lines[-1].split("ctc-loss")[1])
+    assert last < first / 5, (first, last)
+
+
+@pytest.mark.slow
+def test_nce_example_retrieves_pairs():
+    r = _run("examples/nce_loss/wordvec_nce.py", ["--iters", "200"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    acc = float(r.stdout.splitlines()[-1].split(":")[1])
+    assert acc >= 0.8, acc
+
+
+@pytest.mark.slow
+def test_recommender_example_sparse_path_and_learns():
+    r = _run("examples/recommenders/matrix_fact_sparse.py",
+             ["--iters", "150", "--users", "800", "--items", "400",
+              "--batch-size", "1024", "--lr", "0.02"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "grad stype=row_sparse" in r.stdout
+    rmse = float(r.stdout.splitlines()[-1].split("RMSE:")[1].split()[0])
+    assert rmse < 0.3, rmse  # planted-structure RMSE -> noise floor 0.1
+
+
+@pytest.mark.slow
+def test_multi_task_example_both_heads_learn():
+    r = _run("examples/multi_task/multi_task.py", ["--iters", "150"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    tail = r.stdout.splitlines()[-1]
+    digit = float(tail.split("digit accuracy:")[1].split()[0])
+    parity = float(tail.split("parity accuracy:")[1].split()[0])
+    assert digit > 0.7 and parity > 0.7, (digit, parity)
