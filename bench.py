@@ -205,13 +205,22 @@ def run_inference(batch=256, dtype=None, layout=None, k_batches=8, reps=3,
             log(f"quantize_net (fold+calibrate+rewrite) took "
                 f"{time.time() - t0:.1f}s")
     accel = jax.devices()[0]
+    # quantized blocks keep int8 weights + f32 scales/biases (tiny; the
+    # dequant epilogue multiplies in f32 registers anyway) — but every
+    # OTHER float param (excluded/non-quantized layers) still follows the
+    # compute-dtype policy, so a partially-quantized net doesn't run
+    # f32-weight x bf16-activation convs
+    qids = set()
+    if int8:
+        from mxnet_tpu.contrib.quantization import (_QuantizedLayer,
+                                                    _walk_blocks)
+        for _, _, blk in _walk_blocks(net):
+            if isinstance(blk, _QuantizedLayer):
+                qids.update(id(p) for _, p in blk.collect_params().items())
     for _, p in net.collect_params().items():
         if p._data is not None:
             a = p._data._data
-            # int8 weights/scales keep their dtype; floats go compute-dtype
-            # except the quantized path's f32 scales/biases (tiny, and the
-            # dequant epilogue multiplies in f32 registers anyway)
-            if not int8 and a.dtype == jnp.float32:
+            if a.dtype == jnp.float32 and id(p) not in qids:
                 a = a.astype(cdt)
             p._data._rebind(jax.device_put(a, accel))
 

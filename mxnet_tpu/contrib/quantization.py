@@ -517,10 +517,12 @@ def quantize_net(net, calib_data, calib_mode: str = "naive",
     was_hybridized = any(active for _, active, _ in hyb_state)
     if was_hybridized:
         net.hybridize(False)  # also clears every _cached_op in the tree
-    if fold_bn:
-        n = fold_batchnorm(net)
-        if logger:
-            logger.info("fold_batchnorm: folded %d conv+BN pairs", n)
+
+    def _restore_hyb():
+        for b, active, kwargs in hyb_state:
+            b._active = active
+            b._cached_op = None
+            b._cached_op_kwargs = kwargs
 
     sites = []     # EVERY (parent, key) occurrence — shared blocks appear
     #                at multiple sites and all must be replaced
@@ -554,16 +556,6 @@ def quantize_net(net, calib_data, calib_mode: str = "naive",
         originals[id(blk)] = blk.hybrid_forward
         # instance attribute shadows the class method; bind self explicitly
         blk.hybrid_forward = wrapped.__get__(blk, type(blk))
-    try:
-        with _ag.pause():
-            for batch in calib_data:
-                x = batch if isinstance(batch, NDArray) else _arr(batch)
-                net(x)
-    finally:
-        for _, _, blk in targets:
-            if id(blk) in originals:
-                del blk.__dict__["hybrid_forward"]
-
     def in_scale_of(name):
         seen_names = collector.min_max if calib_mode == "naive" \
             else collector.hists
@@ -577,8 +569,43 @@ def quantize_net(net, calib_data, calib_mode: str = "naive",
         hist, th = collector.hists[name]
         return get_optimal_threshold(hist, th) / 127.0
 
-    # --- rewrite (scales validated up front: no partial mutation) -------
-    scales = {id(blk): in_scale_of(blk.name) for _, _, blk in targets}
+    # --- calibrate and validate BEFORE any structural mutation: a calib
+    # forward that raises (bad batch shape/dtype), an empty calib_data,
+    # or a target layer no calibration batch reached all raise HERE,
+    # while the net is still un-folded (BatchNorm params intact and
+    # trainable) and its hybridize state restored — no partial mutation
+    # on any error path --------------------------------------------------
+    n_batches = 0
+    try:
+        try:
+            with _ag.pause():
+                for batch in calib_data:
+                    x = batch if isinstance(batch, NDArray) else _arr(batch)
+                    net(x)
+                    n_batches += 1
+        finally:
+            for _, _, blk in targets:
+                if id(blk) in originals:
+                    del blk.__dict__["hybrid_forward"]
+        check(n_batches > 0,
+              "quantize_net: calib_data yielded no calibration batches — "
+              "pass at least one batch that exercises every quantized "
+              "layer")
+        scales = {id(blk): in_scale_of(blk.name) for _, _, blk in targets}
+    except Exception:
+        if was_hybridized:
+            _restore_hyb()
+        raise
+
+    # folding is exact (the folded graph computes the same function), so
+    # the conv input ranges recorded above are unchanged by it; it must
+    # precede the rewrite because the quantized twins capture the FOLDED
+    # weights at construction
+    if fold_bn:
+        n = fold_batchnorm(net)
+        if logger:
+            logger.info("fold_batchnorm: folded %d conv+BN pairs", n)
+
     qblocks = {}   # one quantized twin per unique source block
     for _, _, blk in targets:
         scale = scales[id(blk)]
@@ -591,10 +618,7 @@ def quantize_net(net, calib_data, calib_mode: str = "naive",
     for parent, key, blk in sites:
         _replace_child(parent, key, blk, qblocks[id(blk)])
     if was_hybridized:
-        for b, active, kwargs in hyb_state:
-            b._active = active
-            b._cached_op = None
-            b._cached_op_kwargs = kwargs
+        _restore_hyb()
         for q in qblocks.values():
             q.hybridize(True)
     return net

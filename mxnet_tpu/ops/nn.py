@@ -306,7 +306,8 @@ def _make_bn_core(resid_dtype_name=None):
         _, bshape, _ = _shapes(data, axis)
         xhat = (data.astype(jnp.float32) - mean.reshape(bshape)) \
             * inv.reshape(bshape)
-        return (out, mean, var), (xhat.astype(rdt), inv, g32)
+        from .resid8 import _sat_cast
+        return (out, mean, var), (_sat_cast(xhat, rdt), inv, g32)
 
     def bwd(axis, eps, res, cots):
         cot_out = cots[0]  # mean/var outputs only feed running-stat

@@ -241,8 +241,13 @@ def invoke_jax(opdef: OpDef, arrays: Sequence, params: Dict[str, Any]):
     params = normalize_params(params)
     dyn = {}
     if opdef.dynamic_params:
+        import numbers
         for n in opdef.dynamic_params:
-            if n in params and isinstance(params[n], (int, float)) \
+            # numbers.Real (not just int/float): an lr computed by a
+            # numpy-based LRScheduler arrives as np.float32, which is not
+            # a python float — missing it would bake the value into the
+            # jit-cache key and recompile every step
+            if n in params and isinstance(params[n], numbers.Real) \
                     and not isinstance(params[n], bool):
                 # plain python float: jit traces it as a WEAK-typed scalar,
                 # so `weight - lr * g` keeps the weight's (bf16) dtype —

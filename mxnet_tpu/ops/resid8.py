@@ -18,8 +18,11 @@ Design rules (all enforced here):
   dtype before any FLOP.
 - pure casts, no dynamic scales: a per-tensor absmax scale would add a
   full extra read pass over the activation; e4m3's exponent range (±448)
-  covers post-BN/ReLU activations without one. Saturation clamps the
-  (rare) outliers.
+  covers post-BN/ReLU activations without one. Outliers beyond the fp8
+  max are explicitly CLAMPED before the cast (``_sat_cast``) — XLA's
+  float->fp8 conversion overflows to NaN (e4m3) / inf (e5m2), which
+  would otherwise poison dW and, through the relu mask (NaN > 0 is
+  False), silently zero gradients.
 - shared copies: ReLU saves fp8(out) with the same cast expression the
   following Convolution saves for its input, so XLA CSE keeps ONE fp8
   copy per activation.
@@ -40,6 +43,20 @@ __all__ = ["resid_dtype", "conv_resid8", "relu_resid8", "conv_int8",
 
 _NAMES = {"fp8": "float8_e4m3fn", "e4m3": "float8_e4m3fn",
           "e5m2": "float8_e5m2"}
+
+
+def _sat_cast(x, rdt):
+    """Saturating cast to the fp8 residual dtype.
+
+    float32->fp8 on XLA rounds values beyond the format's max to NaN
+    (e4m3fn) or inf (e5m2), not to the max finite value; one NaN in a
+    stored residual poisons the whole dW on the next backward. The clip
+    fuses into the producing elementwise kernel, so it costs no extra
+    HBM pass. Every residual cast in this module (and the BN xhat cast
+    in ops/nn.py) must go through here."""
+    import jax.numpy as jnp
+    m = float(jnp.finfo(rdt).max)
+    return jnp.clip(x, -m, m).astype(rdt)
 
 
 def conv_int8():
@@ -94,9 +111,10 @@ def _conv8(cfg, rdt_name):
         return core(data, weight)
 
     def fwd(data, weight):
-        # the fp8 cast fuses into whichever elementwise kernel produced
-        # `data`; only the 1-byte copy reaches HBM for the backward
-        return core(data, weight), (data.astype(rdt), weight)
+        # the saturating fp8 cast fuses into whichever elementwise
+        # kernel produced `data`; only the 1-byte copy reaches HBM for
+        # the backward
+        return core(data, weight), (_sat_cast(data, rdt), weight)
 
     def bwd(res, dy):
         xq, w = res
@@ -204,7 +222,7 @@ def _relu8(rdt_name):
 
     def fwd(x):
         y = jnp.maximum(x, 0)
-        return y, (y.astype(rdt),)
+        return y, (_sat_cast(y, rdt),)
 
     def bwd(res, dy):
         (yq,) = res

@@ -1035,7 +1035,8 @@ COVERED_ELSEWHERE = {
         "_contrib_requantize", "_contrib_quantized_conv",
         "_contrib_quantized_fully_connected", "_contrib_quantized_pooling",
         "_contrib_quantized_concat", "_contrib_quantized_flatten",
-        "_quantized_fc_static"]},
+        "_quantized_fc_static", "_quantize_static", "_quantized_conv_v2",
+        "_quantized_dense_v2"]},
     # pallas attention kernels
     **{op: "tests/test_pallas_ops.py" for op in [
         "_contrib_flash_attention", "_contrib_interleaved_matmul_selfatt_qk",

@@ -1,0 +1,195 @@
+"""int8-on-MXU training convolutions (MXNET_CONV_COMPUTE=int8,
+ops/resid8.py conv_int8_train).
+
+The mode's contract (round-4 design, proven here per the round-4
+directive — registered-but-untested is how facades start):
+  - forward: x quantized with the STATIC MXNET_CONV_INT8_RANGE, w
+    quantized per-output-channel with dynamic scales, int8 x int8 ->
+    int32 on the MXU, dequantized in the epilogue -> small bounded
+    quantization noise vs the float conv.
+  - dx is EXACT: the conv is linear in x, so dx = conv_T(dy, W) uses
+    only the exact bf16/f32 weights — zero error vs the float conv.
+  - dW is straight-through: it reads the SAVED int8 input (that is the
+    HBM win), so it equals the float dW computed over the dequantized
+    input — noisy vs the true dW, exact vs the dequantized one.
+  - the env switch must actually switch (trace-time flags are part of
+    every jit-cache key).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.gluon import nn, loss as gloss
+
+RS = np.random.RandomState(13)
+
+DN = ("NHWC", "OHWI", "NHWC")
+
+
+@pytest.fixture
+def int8_mode():
+    os.environ["MXNET_CONV_COMPUTE"] = "int8"
+    try:
+        yield
+    finally:
+        os.environ["MXNET_CONV_COMPUTE"] = ""
+
+
+def _plain(d, w):
+    import jax
+    dn = jax.lax.conv_dimension_numbers(d.shape, w.shape, DN)
+    return jax.lax.conv_general_dilated(
+        d, w, (1, 1), [(1, 1), (1, 1)], dimension_numbers=dn)
+
+
+def _i8(d, w):
+    from mxnet_tpu.ops import resid8
+    return resid8.conv_int8_train(d, w, (1, 1), (1, 1), (1, 1), DN, 1)
+
+
+def test_forward_close_dx_exact_dw_straight_through():
+    import jax
+    import jax.numpy as jnp
+    os.environ["MXNET_CONV_INT8_RANGE"] = "8.0"
+    try:
+        x = jnp.asarray(RS.rand(2, 6, 6, 3).astype(np.float32) * 4)
+        w = jnp.asarray((RS.rand(8, 3, 3, 3) - 0.5).astype(np.float32))
+        dy = jnp.asarray((RS.rand(2, 6, 6, 8) - 0.5).astype(np.float32))
+
+        y0, vjp0 = jax.vjp(_plain, x, w)
+        y8, vjp8 = jax.vjp(_i8, x, w)
+        # forward: quantization noise bounded by the step sizes
+        rel = float(jnp.abs(y0 - y8).max() / jnp.abs(y0).max())
+        assert 1e-5 < rel < 0.05, rel
+
+        (dx0, dw0), (dx8, dw8) = vjp0(dy), vjp8(dy)
+        # dx: conv is linear in x -> depends only on (dy, w); exact
+        assert float(jnp.abs(dx0 - dx8).max()) == 0.0
+        # dW: straight-through over the saved int8 input — equals the
+        # float dW over the DEQUANTIZED input exactly...
+        s = 8.0 / 127.0
+        xq = jnp.round(jnp.clip(x / s, -127, 127)) * s
+        _, vjpq = jax.vjp(_plain, xq, w)
+        _, dwq = vjpq(dy)
+        np.testing.assert_allclose(np.asarray(dw8), np.asarray(dwq),
+                                   rtol=1e-4, atol=1e-5)
+        # ...and is close-but-not-equal to the true float dW
+        reldw = float(jnp.abs(dw0 - dw8).max() / jnp.abs(dw0).max())
+        assert 1e-5 < reldw < 0.05, reldw
+    finally:
+        os.environ.pop("MXNET_CONV_INT8_RANGE", None)
+
+
+def test_activation_range_clips_not_overflows():
+    """|x| beyond MXNET_CONV_INT8_RANGE saturates at +-127 (the documented
+    clip), never wraps or NaNs."""
+    import jax.numpy as jnp
+    x = jnp.full((1, 4, 4, 1), 1e6, jnp.float32)
+    w = jnp.ones((1, 3, 3, 1), jnp.float32)
+    from mxnet_tpu.ops import resid8
+    y = resid8.conv_int8_train(x, w, (1, 1), (1, 1), (1, 1), DN, 1)
+    assert np.isfinite(np.asarray(y)).all()
+    # center tap: 9 weights, each contribution clipped to range
+    rng = 8.0
+    assert float(y[0, 1, 1, 0]) == pytest.approx(9 * rng, rel=1e-5)
+
+
+def _convnet():
+    np.random.seed(0)
+    mx.random.seed(0)
+    net = nn.HybridSequential(prefix="")
+    net.add(nn.Conv2D(8, 3, padding=1, use_bias=False, in_channels=3,
+                      layout="NHWC"))
+    net.add(nn.BatchNorm(axis=-1))
+    net.add(nn.Activation("relu"))
+    net.add(nn.Conv2D(16, 3, padding=1, use_bias=False, in_channels=8,
+                      layout="NHWC"))
+    net.add(nn.BatchNorm(axis=-1))
+    net.add(nn.Activation("relu"))
+    net.add(nn.GlobalAvgPool2D(layout="NHWC"))
+    net.add(nn.Dense(5))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def _grads():
+    x = np.random.RandomState(1).rand(8, 12, 12, 3).astype(np.float32)
+    y = np.random.RandomState(2).randint(0, 5, 8).astype(np.float32)
+    net = _convnet()
+    lossfn = gloss.SoftmaxCrossEntropyLoss()
+    with autograd.record():
+        loss = lossfn(net(mx.nd.array(x)), mx.nd.array(y))
+    loss.backward()
+    grads = [p.grad().asnumpy()
+             for _, p in sorted(net.collect_params().items())
+             if p.grad_req != "null"]
+    return float(loss.mean().asnumpy()), grads
+
+
+def test_env_switch_actually_switches():
+    """Toggling MXNET_CONV_COMPUTE=int8 must change the compiled kernels
+    (regression: trace-time env flags must be in the jit-cache keys) and
+    keep whole-net grads within a few percent of exact."""
+    os.environ["MXNET_CONV_COMPUTE"] = ""
+    l0, g0 = _grads()
+    os.environ["MXNET_CONV_COMPUTE"] = "int8"
+    try:
+        l8, g8 = _grads()
+    finally:
+        os.environ["MXNET_CONV_COMPUTE"] = ""
+    # int8 quantizes the FORWARD too: losses differ slightly
+    assert abs(l0 - l8) < 0.05
+    diffs = [np.abs(a - b).max() / max(np.abs(a).max(), 1e-6)
+             for a, b in zip(g0, g8)]
+    assert max(diffs) > 1e-6, "int8 mode did not engage (stale jit cache?)"
+    # unlike fp8 residuals (exact forward), int8 quantizes the forward:
+    # at toy scale (batch 8) the noise doesn't average out of per-channel
+    # BN reductions, so the per-param bound is loose; correctness weight
+    # is on dx exactness + straight-through parity + convergence above
+    for a, b in zip(g0, g8):
+        if np.abs(a).max() > 1e-4:
+            assert np.abs(a - b).max() / np.abs(a).max() < 0.35
+
+
+def test_training_converges_under_int8(int8_mode):
+    from mxnet_tpu import gluon
+    net = _convnet()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.3, "momentum": 0.9})
+    lossfn = gloss.SoftmaxCrossEntropyLoss()
+
+    def make_data(n):
+        y = np.random.randint(0, 3, n)
+        x = np.random.rand(n, 8, 8, 3).astype(np.float32) * 0.3
+        for i, c in enumerate(y):
+            x[i, :, :, c] += 1.0
+        return x, y.astype(np.float32)
+
+    first = last = None
+    for _ in range(25):
+        x, y = make_data(64)
+        with autograd.record():
+            loss = lossfn(net(mx.nd.array(x)), mx.nd.array(y))
+        loss.backward()
+        tr.step(64)
+        last = float(loss.mean().asnumpy())
+        first = first if first is not None else last
+    assert last < first * 0.5, (first, last)
+
+
+def test_spmd_trainer_under_int8(int8_mode):
+    """The bench path: SPMDTrainer fused step with int8 forward convs."""
+    import jax.numpy as jnp
+    from mxnet_tpu.parallel import SPMDTrainer
+    net = _convnet()
+    tr = SPMDTrainer(net, gloss.SoftmaxCrossEntropyLoss(),
+                     optimizer="sgd",
+                     optimizer_params={"learning_rate": 0.1},
+                     dtype=jnp.bfloat16)
+    x = jnp.asarray(RS.rand(2, 8, 12, 12, 3).astype(np.float32))
+    y = jnp.asarray(RS.randint(0, 5, (2, 8)).astype(np.float32))
+    losses = tr.run_steps(x, y)
+    assert np.isfinite(np.asarray(losses)).all()

@@ -79,6 +79,25 @@ def test_safe_accumulation_is_in_jit_cache_key(monkeypatch):
     assert np.isfinite(safe_n) and abs(safe_n - 1200.0) < 2.0
 
 
+def test_numpy_scalar_lr_stays_out_of_jit_cache_key():
+    """An lr arriving as np.float32 (e.g. from a numpy-computing
+    LRScheduler) must be treated as a weak dynamic scalar exactly like a
+    python float — otherwise every step's lr bakes into the jit-cache
+    key and recompiles (round-4 advisor finding: the isinstance check
+    only accepted int/float)."""
+    from mxnet_tpu.ops import registry as reg
+    opdef = reg.get_op("sgd_update")
+    opdef._jit_cache.clear()
+    w, g = nd.ones((4,)), nd.ones((4,))
+    nd.op.sgd_update(w, g, lr=0.1, wd=0.0)
+    n1 = len(opdef._jit_cache)
+    out2 = nd.op.sgd_update(w, g, lr=np.float32(0.2), wd=0.0)
+    nd.op.sgd_update(w, g, lr=np.float64(0.3), wd=0.0)
+    assert len(opdef._jit_cache) == n1, \
+        "numpy-scalar lr created new jit-cache entries (recompile/step)"
+    np.testing.assert_allclose(out2.asnumpy(), 1.0 - 0.2 * 1.0, rtol=1e-6)
+
+
 def test_bulk_exec_flags_fall_back_to_imperative(monkeypatch):
     from mxnet_tpu import autograd, gluon
     net = gluon.nn.Dense(4)

@@ -326,3 +326,128 @@ def test_quantize_net_hybridized_and_export_paths():
         raise AssertionError("expected MXNetError")
     except MXNetError as e:
         assert "calibration" in str(e)
+
+
+def test_quantize_net_error_leaves_net_unmutated():
+    """A failed quantize_net (empty calib_data) must NOT leave the net
+    BN-folded (BatchNorm params destroyed) or de-hybridized — validation
+    runs before any structural mutation (round-4 advisor finding)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.contrib.quantization import quantize_net
+    from mxnet_tpu.base import MXNetError
+
+    net = nn.HybridSequential(prefix="")
+    net.add(nn.Conv2D(4, 3, padding=1, in_channels=3, layout="NHWC",
+                      use_bias=False))
+    net.add(nn.BatchNorm(axis=-1))
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(RS.uniform(0, 1, (2, 8, 8, 3)).astype(np.float32))
+    net(x)
+    net.hybridize()
+    ref = net(x).asnumpy()
+    bn = net[1]
+    gamma_before = bn.gamma.data().asnumpy().copy()
+    w_before = net[0].weight.data().asnumpy().copy()
+    with pytest.raises(MXNetError):
+        quantize_net(net, [])
+    # BN still a BatchNorm with its params intact; conv weights untouched
+    assert type(bn).__name__ == "BatchNorm"
+    np.testing.assert_array_equal(bn.gamma.data().asnumpy(), gamma_before)
+    np.testing.assert_array_equal(net[0].weight.data().asnumpy(), w_before)
+    # hybridize state restored, forward unchanged
+    assert net._active
+    np.testing.assert_allclose(net(x).asnumpy(), ref, rtol=1e-6)
+    # a calib batch that makes the forward RAISE (wrong rank) must also
+    # restore hybridize state, not leave the net silently imperative
+    bad = mx.nd.array(RS.uniform(0, 1, (2, 3)).astype(np.float32))
+    with pytest.raises(Exception):
+        quantize_net(net, [bad])
+    assert net._active
+    assert type(net[1]).__name__ == "BatchNorm"
+    np.testing.assert_allclose(net(x).asnumpy(), ref, rtol=1e-6)
+
+
+def test_quantize_static_case_table():
+    """_quantize_static: q = clip(round(x/scale), -127, 127) as int8 —
+    exact integer parity against the formula, incl. saturation and the
+    1e-8 zero-scale floor (matches the consuming _quantized_*_v2 ops)."""
+    x = np.array([[0.0, 0.05, -0.05, 1.0, -1.0, 3.99, -3.99, 100.0,
+                   -100.0, 0.024, 0.025]], np.float32)
+    for scale in (0.05, 1.0, 0.5):
+        q, = _q("_quantize_static", (x,), {"scale": scale})
+        assert q.dtype == np.int8
+        expect = np.clip(np.round(x / scale), -127, 127).astype(np.int8)
+        np.testing.assert_array_equal(q.asnumpy(), expect)
+    # zero/denormal scale floors at 1e-8 instead of dividing by zero
+    q, = _q("_quantize_static", (np.array([1e-9, -1e-9], np.float32),),
+            {"scale": 0.0})
+    np.testing.assert_array_equal(
+        q.asnumpy(),
+        np.clip(np.round(np.array([1e-9, -1e-9]) / 1e-8), -127,
+                127).astype(np.int8))
+
+
+def test_quantized_conv_v2_int32_accumulation_parity():
+    """_quantized_conv_v2 must equal the float conv over DEQUANTIZED
+    int8 inputs exactly (int32 accumulation is exact for int8 operands)
+    — the defining property separating it from an approximate kernel."""
+    import jax
+    import jax.numpy as jnp
+    in_scale = 0.04
+    x = RS.uniform(-4, 4, (2, 7, 7, 3)).astype(np.float32)
+    qx = np.clip(np.round(x / in_scale), -127, 127).astype(np.int8)
+    w = RS.uniform(-0.5, 0.5, (8, 3, 3, 3)).astype(np.float32)  # OHWI
+    wscale = (np.abs(w.reshape(8, -1)).max(axis=1) / 127.0
+              ).astype(np.float32)
+    qw = np.clip(np.round(w / wscale[:, None, None, None]), -127,
+                 127).astype(np.int8)
+    bias = RS.uniform(-1, 1, (8,)).astype(np.float32)
+
+    out, = _q("_quantized_conv_v2", (qx, qw, wscale, bias),
+              {"kernel": (3, 3), "stride": (1, 1), "pad": (1, 1),
+               "num_filter": 8, "layout": "NHWC", "in_scale": in_scale,
+               "no_bias": False})
+    # float reference over the SAME dequantized operands
+    dn = jax.lax.conv_dimension_numbers(qx.shape, qw.shape,
+                                        ("NHWC", "OHWI", "NHWC"))
+    ref = jax.lax.conv_general_dilated(
+        jnp.asarray(qx, jnp.float32) * in_scale,
+        jnp.asarray(qw, jnp.float32) * wscale[:, None, None, None],
+        (1, 1), [(1, 1), (1, 1)], dimension_numbers=dn)
+    ref = np.asarray(ref) + bias
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5, atol=1e-5)
+    # int32 accumulation really is exact: no drift at saturated operands
+    sat, = _q("_quantized_conv_v2",
+              (np.full((1, 4, 4, 3), 127, np.int8),
+               np.full((2, 3, 3, 3), -127, np.int8),
+               np.ones(2, np.float32)),
+              {"kernel": (3, 3), "num_filter": 2, "layout": "NHWC",
+               "in_scale": 1.0, "no_bias": True})
+    assert float(sat.asnumpy()[0, 1, 1, 0]) == 127.0 * -127.0 * 27
+
+
+def test_quantized_dense_v2_int32_accumulation_parity():
+    in_scale = 0.02
+    x = RS.uniform(-2, 2, (4, 6)).astype(np.float32)
+    qx = np.clip(np.round(x / in_scale), -127, 127).astype(np.int8)
+    w = RS.uniform(-0.5, 0.5, (5, 6)).astype(np.float32)
+    wscale = (np.abs(w).max(axis=1) / 127.0).astype(np.float32)
+    qw = np.clip(np.round(w / wscale[:, None]), -127, 127).astype(np.int8)
+    bias = RS.uniform(-1, 1, (5,)).astype(np.float32)
+
+    out, = _q("_quantized_dense_v2", (qx, qw, wscale, bias),
+              {"num_hidden": 5, "in_scale": in_scale, "no_bias": False})
+    ref = (qx.astype(np.int64) @ qw.astype(np.int64).T).astype(np.float32) \
+        * (wscale * in_scale) + bias
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5, atol=1e-5)
+    # flatten: trailing dims collapse before the matmul
+    x3 = np.clip(RS.randint(-127, 128, (3, 2, 3)), -127, 127) \
+        .astype(np.int8)
+    out3, = _q("_quantized_dense_v2",
+               (x3, qw, wscale),
+               {"num_hidden": 5, "flatten": True, "in_scale": 1.0,
+                "no_bias": True})
+    ref3 = (x3.reshape(3, -1).astype(np.int64)
+            @ qw.astype(np.int64).T).astype(np.float32) * wscale
+    np.testing.assert_allclose(out3.asnumpy(), ref3, rtol=1e-5)

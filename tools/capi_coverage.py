@@ -74,6 +74,12 @@ def main():
     else:
         print(f"C API coverage: {len(implemented)}/{len(ref)} reference "
               f"functions exported")
+        print("  note: 5 MXRtc* entry points (Create/Push/CudaModuleCreate/"
+              "CudaKernelCreate/CudaKernelCall) return a documented 'CUDA "
+              "RTC has no TPU analog' error routing callers to "
+              "PallasModule (the 3 *Free variants are functional) — "
+              f"honest count: {len(implemented) - 5} working + 5 "
+              "documented-unsupported")
         for n in missing:
             why = EXCLUDED.get(n, "!! UNDOCUMENTED ABSENCE")
             print(f"  missing: {n} — {why}")
