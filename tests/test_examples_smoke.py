@@ -113,6 +113,42 @@ def test_recommender_example_sparse_path_and_learns():
 
 
 @pytest.mark.slow
+def test_text_cnn_example_learns():
+    """Kim-style multi-width conv text classifier on planted-keyword
+    sentences: must clearly beat chance on held-out data."""
+    r = _run("examples/cnn_text_classification/text_cnn.py",
+             ["--iters", "120"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    acc = float(r.stdout.splitlines()[-1].split(":")[1])
+    assert acc >= 0.8, acc
+
+
+@pytest.mark.slow
+def test_deepspeech_example_learns():
+    """DeepSpeech-lite (conv stem + BiGRU + CTC over length buckets):
+    CTC loss must collapse and held-out phoneme error rate go low."""
+    r = _run("examples/speech_recognition/deepspeech.py", ["--iters", "40"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [l for l in r.stdout.splitlines() if "ctc-loss" in l]
+    first = float(lines[0].split("ctc-loss")[1])
+    last = float(lines[-1].split("ctc-loss")[1])
+    assert last < first / 5, (first, last)
+    per = float(r.stdout.splitlines()[-1].split(":")[1])
+    assert per < 0.3, per
+
+
+@pytest.mark.slow
+def test_dqn_example_learns():
+    """DQN on Catch (imperative rollouts + replay + target net): greedy
+    policy must catch most balls; random play catches ~1/6."""
+    r = _run("examples/reinforcement_learning/dqn.py",
+             ["--episodes", "300"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    rate = float(r.stdout.splitlines()[-1].split(":")[1])
+    assert rate >= 0.7, rate
+
+
+@pytest.mark.slow
 def test_multi_task_example_both_heads_learn():
     r = _run("examples/multi_task/multi_task.py", ["--iters", "150"])
     assert r.returncode == 0, r.stderr[-2000:]
