@@ -42,6 +42,18 @@ extern int MXCustomOpRegister(const char *, int (*)(const char *, int,
                                                     const char **,
                                                     const char **,
                                                     struct MXCallbackList *));
+/* op enumeration — the codegen source for the idiomatic NDArray API
+ * (ref: the reference Perl frontend generates its method table from
+ * MXSymbolListAtomicSymbolCreators at load time) */
+extern int MXSymbolListAtomicSymbolCreators(mx_uint *, void ***);
+extern int MXSymbolGetAtomicSymbolName(void *, const char **);
+/* autograd */
+extern int MXAutogradSetIsRecording(int, int *);
+extern int MXAutogradSetIsTraining(int, int *);
+extern int MXAutogradMarkVariables(mx_uint, NDArrayHandle *);
+extern int MXAutogradBackward(mx_uint, NDArrayHandle *, NDArrayHandle *,
+                              int);
+extern int MXNDArrayGetGrad(NDArrayHandle, NDArrayHandle *);
 /* c_predict surface */
 extern int MXPredCreate(const char *, const void *, int, int, int, mx_uint,
                         const char **, const mx_uint *, const mx_uint *,
@@ -58,6 +70,19 @@ extern int MXPredFree(PredictorHandle);
 
 static void croak_on(pTHX_ int rc, const char *what) {
   if (rc != 0) croak("%s failed: %s", what, MXGetLastError());
+}
+
+/* copy an AV of IV handles into a malloc'd array (caller frees); the
+ * terminating extra slot keeps zero-length allocations valid */
+static NDArrayHandle *av_to_handles(pTHX_ AV *av) {
+  size_t n = av_count(av), i;
+  NDArrayHandle *h =
+      (NDArrayHandle *)malloc((n + 1) * sizeof(NDArrayHandle));
+  for (i = 0; i < n; ++i) {
+    SV **e = av_fetch(av, i, 0);
+    h[i] = e ? INT2PTR(NDArrayHandle, SvIV(*e)) : NULL;
+  }
+  return h;
 }
 
 static size_t av_to_floats(pTHX_ AV *av, float **out) {
@@ -227,6 +252,7 @@ nd_create(shape_av)
     size_t ndim = av_count(shape_av), i;
     mx_uint shape[8];
     NDArrayHandle h = NULL;
+    if (ndim > 8) croak("nd_create: at most 8 dimensions supported");
     for (i = 0; i < ndim && i < 8; ++i) {
       SV **e = av_fetch(shape_av, i, 0);
       shape[i] = e ? (mx_uint)SvUV(*e) : 0;
@@ -310,23 +336,23 @@ invoke(op, in_av, key_av, val_av)
   CODE:
   {
     size_t n_in = av_count(in_av), n_p = av_count(key_av), i;
-    NDArrayHandle ins[16];
-    const char *keys[16], *vals[16];
+    NDArrayHandle *ins = av_to_handles(aTHX_ in_av);
+    const char **keys = (const char **)malloc((n_p + 1) * sizeof(char *));
+    const char **vals = (const char **)malloc((n_p + 1) * sizeof(char *));
     NDArrayHandle *outs = NULL;
-    int n_out = 0;
-    for (i = 0; i < n_in && i < 16; ++i) {
-      SV **e = av_fetch(in_av, i, 0);
-      ins[i] = INT2PTR(NDArrayHandle, SvIV(*e));
-    }
-    for (i = 0; i < n_p && i < 16; ++i) {
+    int n_out = 0, rc;
+    for (i = 0; i < n_p; ++i) {
       SV **k = av_fetch(key_av, i, 0);
       SV **v = av_fetch(val_av, i, 0);
-      keys[i] = SvPV_nolen(*k);
-      vals[i] = SvPV_nolen(*v);
+      keys[i] = k ? SvPV_nolen(*k) : "";
+      vals[i] = v ? SvPV_nolen(*v) : "";
     }
-    croak_on(aTHX_ MXImperativeInvoke(op, (int)n_in, ins, &n_out, &outs,
-                                      (int)n_p, keys, vals),
-             "MXImperativeInvoke");
+    rc = MXImperativeInvoke(op, (int)n_in, ins, &n_out, &outs,
+                            (int)n_p, keys, vals);
+    free(ins);
+    free(keys);
+    free(vals);
+    croak_on(aTHX_ rc, "MXImperativeInvoke");
     RETVAL = newAV();
     sv_2mortal((SV *)RETVAL);
     for (i = 0; i < (size_t)n_out; ++i)
@@ -340,6 +366,124 @@ register_sqr_op()
   CODE:
     croak_on(aTHX_ MXCustomOpRegister("perl_sqr", sqr_creator),
              "MXCustomOpRegister");
+
+AV *
+list_op_names()
+  CODE:
+  {
+    mx_uint n = 0, i;
+    void **creators = NULL;
+    croak_on(aTHX_ MXSymbolListAtomicSymbolCreators(&n, &creators),
+             "MXSymbolListAtomicSymbolCreators");
+    RETVAL = newAV();
+    sv_2mortal((SV *)RETVAL);
+    for (i = 0; i < n; ++i) {
+      const char *name = NULL;
+      if (MXSymbolGetAtomicSymbolName(creators[i], &name) == 0 && name)
+        av_push(RETVAL, newSVpv(name, 0));
+    }
+  }
+  OUTPUT:
+    RETVAL
+
+AV *
+invoke_into(op, in_av, key_av, val_av, out_av)
+    const char *op
+    AV *in_av
+    AV *key_av
+    AV *val_av
+    AV *out_av
+  CODE:
+  {
+    size_t n_in = av_count(in_av), n_p = av_count(key_av);
+    size_t n_out_req = av_count(out_av), i;
+    NDArrayHandle *ins = av_to_handles(aTHX_ in_av);
+    NDArrayHandle *outs = av_to_handles(aTHX_ out_av);
+    const char **keys = (const char **)malloc((n_p + 1) * sizeof(char *));
+    const char **vals = (const char **)malloc((n_p + 1) * sizeof(char *));
+    int n_out = (int)n_out_req, rc;
+    for (i = 0; i < n_p; ++i) {
+      SV **k = av_fetch(key_av, i, 0);
+      SV **v = av_fetch(val_av, i, 0);
+      keys[i] = k ? SvPV_nolen(*k) : "";
+      vals[i] = v ? SvPV_nolen(*v) : "";
+    }
+    rc = MXImperativeInvoke(op, (int)n_in, ins, &n_out, &outs,
+                            (int)n_p, keys, vals);
+    free(ins);
+    free(keys);
+    free(vals);
+    if (rc != 0) free(outs);
+    croak_on(aTHX_ rc, "MXImperativeInvoke");
+    RETVAL = newAV();
+    sv_2mortal((SV *)RETVAL);
+    for (i = 0; i < (size_t)n_out; ++i)
+      av_push(RETVAL, newSViv(PTR2IV(outs[i])));
+    free(outs);
+  }
+  OUTPUT:
+    RETVAL
+
+IV
+autograd_recording(flag)
+    IV flag
+  CODE:
+  {
+    int prev = 0;
+    croak_on(aTHX_ MXAutogradSetIsRecording((int)flag, &prev),
+             "MXAutogradSetIsRecording");
+    RETVAL = prev;
+  }
+  OUTPUT:
+    RETVAL
+
+IV
+autograd_training(flag)
+    IV flag
+  CODE:
+  {
+    int prev = 0;
+    croak_on(aTHX_ MXAutogradSetIsTraining((int)flag, &prev),
+             "MXAutogradSetIsTraining");
+    RETVAL = prev;
+  }
+  OUTPUT:
+    RETVAL
+
+void
+mark_variables(av)
+    AV *av
+  CODE:
+  {
+    size_t n = av_count(av);
+    NDArrayHandle *vars = av_to_handles(aTHX_ av);
+    int rc = MXAutogradMarkVariables((mx_uint)n, vars);
+    free(vars);
+    croak_on(aTHX_ rc, "MXAutogradMarkVariables");
+  }
+
+void
+backward(h)
+    IV h
+  CODE:
+  {
+    NDArrayHandle out = INT2PTR(NDArrayHandle, h);
+    croak_on(aTHX_ MXAutogradBackward(1, &out, NULL, 0),
+             "MXAutogradBackward");
+  }
+
+IV
+nd_grad(h)
+    IV h
+  CODE:
+  {
+    NDArrayHandle g = NULL;
+    croak_on(aTHX_ MXNDArrayGetGrad(INT2PTR(NDArrayHandle, h), &g),
+             "MXNDArrayGetGrad");
+    RETVAL = PTR2IV(g);
+  }
+  OUTPUT:
+    RETVAL
 
 IV
 pred_create(sym_json, params_sv, input_name, shape_av)
@@ -356,6 +500,7 @@ pred_create(sym_json, params_sv, input_name, shape_av)
     mx_uint indptr[2];
     const char *keys[1];
     PredictorHandle h = NULL;
+    if (ndim > 8) croak("pred_create: at most 8 dimensions supported");
     for (i = 0; i < ndim && i < 8; ++i) {
       SV **e = av_fetch(shape_av, i, 0);
       sdata[i] = e ? (mx_uint)SvUV(*e) : 0;

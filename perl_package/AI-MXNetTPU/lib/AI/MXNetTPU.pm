@@ -1,10 +1,14 @@
 package AI::MXNetTPU;
 
-# Minimal Perl frontend over the mxnet_tpu flat C API (ref: the
-# reference's perl-package/AI-MXNet over libmxnet's identical ABI).
-# Proves the C surface hosts a non-C++ language binding: NDArray
-# lifecycle, imperative operator invocation, the predict API, and a
-# C-callback custom operator (MXCustomOpRegister).
+# Perl frontend over the mxnet_tpu flat C API (ref: the reference's
+# perl-package/AI-MXNet over libmxnet's identical ABI). This module is
+# the low-level XS surface (NDArray lifecycle, imperative invoke incl.
+# preallocated outputs, autograd tape control, op enumeration, the
+# predict API, and a C-callback custom operator); the idiomatic API
+# lives in AI::MXNetTPU::NDArray (operator methods GENERATED from
+# MXSymbolListAtomicSymbolCreators, overloaded arithmetic, autograd)
+# and AI::MXNetTPU::AutoGrad (record/pause scopes) — deep enough to
+# train a net end to end from Perl (t/train_mnist.pl).
 
 use strict;
 use warnings;

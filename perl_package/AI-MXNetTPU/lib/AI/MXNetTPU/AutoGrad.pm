@@ -1,0 +1,31 @@
+package AI::MXNetTPU::AutoGrad;
+
+# Autograd scoping for the Perl frontend: record { ... } runs the block
+# with the C API tape recording in training mode and restores the
+# previous state even if the block dies (ref: the reference Perl
+# frontend's AI::MXNet::AutoGrad record/pause scopes over
+# MXAutogradSetIsRecording/MXAutogradSetIsTraining).
+
+use strict;
+use warnings;
+use Exporter 'import';
+use AI::MXNetTPU ();
+
+our @EXPORT_OK = qw(record pause);
+
+sub _scoped {
+    my ($rec, $train, $code) = @_;
+    my $pr = AI::MXNetTPU::autograd_recording($rec);
+    my $pt = AI::MXNetTPU::autograd_training($train);
+    my @out = eval { $code->() };
+    my $err = $@;
+    AI::MXNetTPU::autograd_recording($pr);
+    AI::MXNetTPU::autograd_training($pt);
+    die $err if $err;
+    return wantarray ? @out : $out[0];
+}
+
+sub record (&) { _scoped(1, 1, $_[0]) }
+sub pause  (&) { _scoped(0, 0, $_[0]) }
+
+1;

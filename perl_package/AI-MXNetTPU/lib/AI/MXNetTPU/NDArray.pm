@@ -1,0 +1,236 @@
+package AI::MXNetTPU::NDArray;
+
+# Idiomatic Perl NDArray over the mxnet_tpu flat C API.
+#
+# The operator surface is NOT hand-written: at load time the module asks
+# the runtime for every registered atomic-symbol creator
+# (MXSymbolListAtomicSymbolCreators) and installs one method per op —
+# the same codegen pattern the reference Perl frontend uses to build
+# AI::MXNet::NDArray's method table from libmxnet
+# (ref: perl-package/AI-MXNet/lib/AI/MXNet.pm function generation).
+# So $x->FullyConnected($w, $b, num_hidden => 10), $x->log_softmax,
+# $x->argmax(axis => 1), ... all exist without any per-op Perl code.
+#
+# Overloaded arithmetic (+ - * /) dispatches to broadcast_* for
+# NDArray-NDArray and _*_scalar for NDArray-number, autograd
+# (attach_grad/grad/backward) rides the C API's tape, and in-place
+# optimizer steps go through the preallocated-output invoke
+# (AI::MXNetTPU::invoke_into), which is how sgd_update mutates a weight
+# instead of allocating a new one.
+
+use strict;
+use warnings;
+use Scalar::Util qw(blessed);
+use AI::MXNetTPU ();
+
+our $VERSION = '0.02';
+
+use overload
+    '+'  => \&_op_add,
+    '-'  => \&_op_sub,
+    '*'  => \&_op_mul,
+    '/'  => \&_op_div,
+    '""' => \&_op_str,
+    '==' => \&_op_eq;
+
+# ---- lifecycle -----------------------------------------------------------
+
+sub _wrap {
+    my ($h, $own) = @_;
+    return bless { h => $h, own => defined $own ? $own : 1 },
+        __PACKAGE__;
+}
+
+sub handle { $_[0]{h} }
+
+sub new {
+    my ($class, $shape, $values) = @_;
+    my $h = AI::MXNetTPU::nd_create($shape);
+    my $self = _wrap($h);
+    $self->set($values) if $values;
+    return $self;
+}
+
+sub zeros {
+    my ($class, $shape) = @_;
+    return $class->invoke('_zeros', [], shape => _shape_str($shape));
+}
+
+sub ones {
+    my ($class, $shape) = @_;
+    return $class->invoke('_ones', [], shape => _shape_str($shape));
+}
+
+sub uniform {
+    my ($class, $shape, $lo, $hi) = @_;
+    my $n = 1;
+    $n *= $_ for @$shape;
+    my @v = map { $lo + rand() * ($hi - $lo) } 1 .. $n;
+    return $class->new($shape, \@v);
+}
+
+sub DESTROY {
+    my ($self) = @_;
+    AI::MXNetTPU::nd_free($self->{h}) if $self->{own};
+}
+
+# ---- data access ---------------------------------------------------------
+
+sub set    { AI::MXNetTPU::nd_set($_[0]{h}, $_[1]); $_[0] }
+sub shape  { AI::MXNetTPU::nd_shape($_[0]{h}) }
+sub aslist { AI::MXNetTPU::nd_values($_[0]{h}) }
+sub asscalar { AI::MXNetTPU::nd_values($_[0]{h})->[0] }
+
+sub _op_eq {
+    my ($self, $other) = @_;
+    return 0 unless blessed($other) && $other->isa(__PACKAGE__);
+    my ($sa, $sb) = ($self->shape, $other->shape);
+    return 0 unless @$sa == @$sb;
+    $sa->[$_] == $sb->[$_] or return 0 for 0 .. $#$sa;
+    my ($va, $vb) = ($self->aslist, $other->aslist);
+    $va->[$_] == $vb->[$_] or return 0 for 0 .. $#$va;
+    return 1;
+}
+
+sub _op_str {
+    my ($self) = @_;
+    my $v = $self->aslist;
+    my $body = join(', ', map { sprintf('%.4g', $_) }
+                    @$v > 8 ? @{$v}[0 .. 7] : @$v);
+    $body .= ', ...' if @$v > 8;
+    return "[$body]";
+}
+
+# ---- autograd ------------------------------------------------------------
+
+sub attach_grad {
+    my ($self) = @_;
+    AI::MXNetTPU::mark_variables([$self->{h}]);
+    return $self;
+}
+
+sub grad {
+    my ($self) = @_;
+    my $g = AI::MXNetTPU::nd_grad($self->{h});
+    # MXNDArrayGetGrad returns a NEW handle reference each call; own it
+    # so DESTROY releases it (own=0 here would leak one ref per call)
+    return $g ? _wrap($g, 1) : undef;
+}
+
+sub backward { AI::MXNetTPU::backward($_[0]{h}); $_[0] }
+
+# ---- operator invocation -------------------------------------------------
+
+# trailing comma so a 1-d shape parses as a tuple, not a bare int
+sub _shape_str { '(' . join(',', @{$_[0]}) . ',)' }
+
+# functional form: AI::MXNetTPU::NDArray->invoke($op, [@ndarray_args],
+# %kwargs) — also what every generated method calls into.
+sub invoke {
+    my ($class, $op, $ins, %kw) = @_;
+    my @handles = map { blessed($_) ? $_->{h} : $_ } @$ins;
+    my (@keys, @vals);
+    for my $k (sort keys %kw) {
+        push @keys, $k;
+        push @vals, "$kw{$k}";
+    }
+    my $outs = AI::MXNetTPU::invoke($op, \@handles, \@keys, \@vals);
+    my @wrapped = map { _wrap($_) } @$outs;
+    return wantarray ? @wrapped : $wrapped[0];
+}
+
+# in-place form: results land in the given output NDArrays (preallocated
+# -output contract of MXImperativeInvoke) — optimizer updates use this.
+sub invoke_into {
+    my ($class, $op, $ins, $outs, %kw) = @_;
+    my @ih = map { blessed($_) ? $_->{h} : $_ } @$ins;
+    my @oh = map { blessed($_) ? $_->{h} : $_ } @$outs;
+    my (@keys, @vals);
+    for my $k (sort keys %kw) {
+        push @keys, $k;
+        push @vals, "$kw{$k}";
+    }
+    AI::MXNetTPU::invoke_into($op, \@ih, \@keys, \@vals, \@oh);
+    return wantarray ? @$outs : $outs->[0];
+}
+
+# ---- overloaded arithmetic ----------------------------------------------
+
+sub _binop {
+    my ($bcast, $scalar, $rscalar) = @_;
+    return sub {
+        my ($self, $other, $swap) = @_;
+        if (blessed($other) && $other->isa(__PACKAGE__)) {
+            my ($a, $b) = $swap ? ($other, $self) : ($self, $other);
+            return __PACKAGE__->invoke($bcast, [$a, $b]);
+        }
+        return __PACKAGE__->invoke($swap ? $rscalar : $scalar, [$self],
+                                   scalar => $other);
+    };
+}
+
+{
+    no warnings 'once';
+    *_op_add = _binop('broadcast_add', '_plus_scalar', '_plus_scalar');
+    *_op_sub = _binop('broadcast_sub', '_minus_scalar', '_rminus_scalar');
+    *_op_mul = _binop('broadcast_mul', '_mul_scalar', '_mul_scalar');
+    *_op_div = _binop('broadcast_div', '_div_scalar', '_rdiv_scalar');
+}
+
+# ---- generated op methods ------------------------------------------------
+
+my %RESERVED = map { $_ => 1 }
+    qw(new zeros ones uniform set shape aslist asscalar attach_grad grad
+       backward handle invoke invoke_into DESTROY AUTOLOAD BEGIN import);
+
+sub _install_op_methods {
+    my $names = AI::MXNetTPU::list_op_names();
+    my $installed = 0;
+    for my $op (@$names) {
+        next unless $op =~ /^[A-Za-z_][A-Za-z0-9_]*$/;
+        next if $RESERVED{$op} || __PACKAGE__->can($op);
+        no strict 'refs';
+        *{__PACKAGE__ . '::' . $op} = sub {
+            my $self = shift;
+            my @ins = ($self);
+            # leading NDArray positionals are further op inputs; the
+            # remainder is key => value op params
+            push @ins, shift
+                while @_ && blessed($_[0]) && $_[0]->isa(__PACKAGE__);
+            return __PACKAGE__->invoke($op, \@ins, @_);
+        };
+        ++$installed;
+    }
+    return $installed;
+}
+
+our $NUM_GENERATED_OPS = _install_op_methods();
+
+1;
+__END__
+
+=head1 NAME
+
+AI::MXNetTPU::NDArray - idiomatic NDArray API with generated operators
+
+=head1 SYNOPSIS
+
+  use AI::MXNetTPU::NDArray;
+
+  my $x = AI::MXNetTPU::NDArray->new([2, 3], [1 .. 6]);
+  my $w = AI::MXNetTPU::NDArray->uniform([4, 3], -0.1, 0.1);
+  $w->attach_grad;
+
+  AI::MXNetTPU::autograd_recording(1);
+  my $y = $x->FullyConnected($w, num_hidden => 4, no_bias => 1)
+            ->Activation(act_type => 'relu')
+            ->sum;
+  AI::MXNetTPU::autograd_recording(0);
+  $y->backward;
+  print $w->grad, "\n";
+
+  # in-place optimizer step
+  AI::MXNetTPU::NDArray->invoke_into('sgd_update', [$w, $w->grad], [$w],
+                                     lr => 0.1, wd => 0);
+
+=cut
