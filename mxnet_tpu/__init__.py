@@ -15,7 +15,7 @@ Usage mirrors the reference::
         y = (x * 2).sum()
     y.backward()
 """
-__version__ = "0.1.0"
+__version__ = "0.5.0"
 
 from .base import MXNetError  # noqa: F401
 from .context import (Context, cpu, gpu, tpu, cpu_pinned,  # noqa: F401

@@ -30,12 +30,17 @@ def _load_lib():
     if _LIB is not None or _LIB_TRIED:
         return _LIB
     _LIB_TRIED = True
-    so = os.path.join(_SRC_DIR, "libmxtpu_io.so")
-    if not os.path.exists(so):
+    from ..libinfo import find_lib_path
+    so = find_lib_path("libmxtpu_io.so")
+    if so is None:
+        # source tree without a build yet: build lazily
         try:
             subprocess.run(["make", "-C", _SRC_DIR], check=True,
                            capture_output=True, timeout=120)
         except Exception:
+            return None
+        so = find_lib_path("libmxtpu_io.so")
+        if so is None:
             return None
     try:
         lib = ctypes.CDLL(so)

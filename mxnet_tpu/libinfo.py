@@ -1,0 +1,44 @@
+"""Locate the native runtime libraries (ref: python/mxnet/libinfo.py
+find_lib_path — the reference resolves libmxnet.so from the installed
+package dir first, then the source tree; same contract here for the
+libmxtpu_* trio).
+
+Search order:
+1. ``MXTPU_LIBRARY_PATH`` env var, if set — an explicit override that
+   wins over everything.
+2. ``mxnet_tpu/_native/`` — where the pip wheel bundles the libraries
+   (`setup.py` build_py hook).
+3. ``<repo>/src/`` — the source-tree layout, where `make -C src` puts
+   them during development.
+"""
+import os
+
+__all__ = ["find_lib_path", "lib_dirs"]
+
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def lib_dirs():
+    """Candidate directories for the native libraries, in search order."""
+    dirs = [
+        os.path.join(_PKG_DIR, "_native"),
+        os.path.join(os.path.dirname(_PKG_DIR), "src"),
+    ]
+    env = os.environ.get("MXTPU_LIBRARY_PATH")
+    if env:
+        dirs.insert(0, env)
+    return dirs
+
+
+def find_lib_path(name="libmxtpu_io.so", required=False):
+    """Full path of a native library, or None (raises if ``required``)."""
+    for d in lib_dirs():
+        p = os.path.join(d, name)
+        if os.path.exists(p):
+            return p
+    if required:
+        from .base import MXNetError
+        raise MXNetError(
+            f"native library {name!r} not found in {lib_dirs()} — build "
+            "it with `make -C src` (source tree) or reinstall the wheel")
+    return None

@@ -1,0 +1,58 @@
+"""Build a pip-installable wheel of the whole runtime (ref:
+tools/pip/setup.py — the reference wheels libmxnet.so plus the python
+package; here the native trio libmxtpu_io/_predict/_capi is built with
+`make -C src` and bundled under ``mxnet_tpu/_native/``, where
+``mxnet_tpu.libinfo.find_lib_path`` resolves it at runtime).
+
+    python setup.py bdist_wheel          # or: pip wheel . --no-deps
+    pip install dist/mxnet_tpu-*.whl
+    python -c "import mxnet_tpu; print(mxnet_tpu.nd.ones((2,2)))"
+"""
+import os
+import shutil
+import subprocess
+
+from setuptools import setup, find_packages
+from setuptools.command.build_py import build_py
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(HERE, "src")
+NATIVE_LIBS = ["libmxtpu_io.so", "libmxtpu_predict.so", "libmxtpu_capi.so"]
+
+
+class build_py_with_native(build_py):
+    """Build the native libs and bundle them into the wheel."""
+
+    def run(self):
+        super().run()
+        # make is incremental: always invoke it so a wheel rebuilt after
+        # a src/*.cc edit never bundles stale binaries
+        subprocess.run(["make", "-C", SRC], check=True)
+        dest = os.path.join(self.build_lib, "mxnet_tpu", "_native")
+        os.makedirs(dest, exist_ok=True)
+        for n in NATIVE_LIBS:
+            src_so = os.path.join(SRC, n)
+            if os.path.exists(src_so):
+                shutil.copy2(src_so, os.path.join(dest, n))
+
+
+def _pkg_version():
+    """Single source of truth: mxnet_tpu/__init__.py's __version__ (read
+    textually — importing the package would pull in jax at build time)."""
+    import re
+    with open(os.path.join(HERE, "mxnet_tpu", "__init__.py")) as f:
+        return re.search(r'__version__\s*=\s*"([^"]+)"', f.read()).group(1)
+
+
+setup(
+    name="mxnet_tpu",
+    version=_pkg_version(),
+    description="TPU-native deep learning framework with the MXNet API "
+                "surface (JAX/XLA compute path, native C runtime)",
+    packages=find_packages(include=["mxnet_tpu", "mxnet_tpu.*"]),
+    python_requires=">=3.10",
+    install_requires=["jax", "numpy"],
+    cmdclass={"build_py": build_py_with_native},
+    # wheels are platform-specific because of the bundled native libs
+    has_ext_modules=lambda: True,
+)
