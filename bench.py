@@ -266,14 +266,15 @@ def _run_child(mode, args_rest):
         print(f"TRAIN_IPS {run(batch=batch, k_steps=k):.2f}", flush=True)
 
 
-def _subprocess_metric(mode, args_list, marker, timeout_s=2100):
+def _subprocess_metric(mode, args_list, marker, timeout_s=2100,
+                       env_extra=None):
     """Run a measurement in an isolated child (a crash — e.g. a SIGILL
     from relay-compiled AOT cache artifacts — must not kill the bench);
     retry once with the compile cache disabled if the child dies."""
     import subprocess
     here = os.path.dirname(os.path.abspath(__file__))
-    for attempt, env_extra in ((0, {}), (1, {"MXTPU_COMPILE_CACHE": "0"})):
-        env = dict(os.environ, **env_extra)
+    for attempt, cache_extra in ((0, {}), (1, {"MXTPU_COMPILE_CACHE": "0"})):
+        env = dict(os.environ, **(env_extra or {}), **cache_extra)
         try:
             res = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), mode,
@@ -347,6 +348,25 @@ def main():
             }
             if infer:
                 payload["inference_imgs_per_sec"] = infer
+            if os.environ.get("MXTPU_BENCH_LOWBIT", "1") != "0":
+                # the round-4/5 low-precision levers, measured into the
+                # SAME artifact so results outlive commit messages:
+                # int8 calibrated inference (quantize_net) and int8
+                # quantized-forward training (MXNET_CONV_COMPUTE) —
+                # docs/perf.md carries the accuracy evidence
+                if os.environ.get("MXTPU_BENCH_INFERENCE", "1") != "0":
+                    i8 = _subprocess_metric(
+                        "--inference-only", [batch], "INFERENCE_IPS",
+                        env_extra={"MXTPU_BENCH_INT8": "1"})
+                    if i8:
+                        payload["inference_int8_imgs_per_sec"] = \
+                            round(i8, 2)
+                t8 = _subprocess_metric(
+                    "--train-only", [batch, k], "TRAIN_IPS",
+                    env_extra={"MXNET_CONV_COMPUTE": "int8",
+                               "MXNET_RESID_DTYPE": "fp8"})
+                if t8:
+                    payload["train_int8_fp8_imgs_per_sec"] = round(t8, 2)
             print(json.dumps(payload))
             return
         except Exception as e:  # OOM or backend issue: try smaller config

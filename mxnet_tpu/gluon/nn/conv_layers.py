@@ -37,11 +37,6 @@ class _Conv(HybridBlock):
         if adj is not None:
             self._kwargs["adj"] = _tup(adj, nd)
         channel_last = bool(layout) and layout.endswith("C")
-        if channel_last and op_name != "Convolution":
-            from ...base import MXNetError
-            raise MXNetError(
-                f"{op_name} supports channel-first layouts only; got "
-                f"{layout!r}")
         if op_name == "Convolution":
             if channel_last:
                 # MXNet NHWC weight convention: (O, *k, I/groups)

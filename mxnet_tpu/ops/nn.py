@@ -150,22 +150,25 @@ def _deconvolution(data, weight, *maybe_bias, kernel=(), stride=(), dilate=(),
     pads = [(dilate[i] * (kernel[i] - 1) - pad[i],
              dilate[i] * (kernel[i] - 1) - pad[i] + adj[i])
             for i in range(nd)]
-    if layout is not None and layout not in ("NCW", "NCHW", "NCDHW"):
-        raise MXNetError(
-            "Deconvolution supports channel-first layouts only (transpose "
-            "channel-last data around the op)")
-    # weight layout is (C_in, num_filter, *k); with transpose_kernel=True
-    # lax treats the "OIHW" spec relative to the FORWARD conv, giving the
-    # exact gradient-of-conv semantics the reference implements
-    dn = ("NCHW", "OIHW", "NCHW") if nd == 2 else (
-        ("NCW", "OIW", "NCW") if nd == 1 else ("NCDHW", "OIDHW", "NCDHW"))
+    # weight layout is (C_in, num_filter, *k) in EVERY data layout (the
+    # reference convention), so only the DATA spec follows `layout`; the
+    # kernel spec is always the channel-first "OI*", which with
+    # transpose_kernel=True lax treats relative to the FORWARD conv —
+    # the exact gradient-of-conv semantics the reference implements.
+    # Channel-last data layouts (NWC/NHWC/NDHWC) are first-class: on TPU
+    # they avoid the transposes NCHW forces around every (de)convolution.
+    layout = _conv_layout(layout, nd)
+    kspec = _CONV_DN[_DEFAULT_LAYOUT[nd]][1]
+    dn = (layout, kspec, layout)
     if num_group != 1:
         raise MXNetError("grouped Deconvolution not yet supported")
     out = lax.conv_transpose(data, weight, strides=stride, padding=pads,
                              rhs_dilation=dilate, dimension_numbers=dn,
                              transpose_kernel=True)
     if not no_bias and maybe_bias:
-        out = out + maybe_bias[0].reshape((1, -1) + (1,) * nd)
+        bshape = [1] * (nd + 2)
+        bshape[layout.index("C")] = -1
+        out = out + maybe_bias[0].reshape(tuple(bshape))
     return out
 
 

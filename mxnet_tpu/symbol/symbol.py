@@ -398,8 +398,12 @@ def _deconv_hint(in_shapes, params):
     kernel = tuple(params.get("kernel", ()))
     nf = int(params.get("num_filter", 1))
     g = int(params.get("num_group", 1))
+    # weight is (C_in, num_filter/g, *k) in EVERY layout; only where C
+    # sits in the DATA depends on the layout (channel-last: last axis)
+    layout = str(params.get("layout") or "")
+    c_in = data[-1] if layout.endswith("C") else data[1]
     if len(in_shapes) > 1 and in_shapes[1] is None:
-        out[1] = (data[1], nf // g) + kernel
+        out[1] = (c_in, nf // g) + kernel
     if len(in_shapes) > 2 and in_shapes[2] is None:
         out[2] = (nf,)
     return out

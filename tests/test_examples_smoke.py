@@ -149,6 +149,32 @@ def test_dqn_example_learns():
 
 
 @pytest.mark.slow
+def test_autoencoder_example_learns():
+    """Conv autoencoder (NHWC Conv2DTranspose decoder): reconstruction
+    error must fall well below input variance and the bottleneck must
+    stay linearly class-separable (probe >> 10% chance)."""
+    r = _run("examples/autoencoder/conv_autoencoder.py", ["--iters", "150"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    tail = r.stdout.splitlines()[-1]
+    mse = float(tail.split("recon-mse")[1].split()[0])
+    var = float(tail.split("input-var")[1].split()[0])
+    probe = float(tail.split("probe accuracy:")[1])
+    assert mse < var / 4, (mse, var)
+    assert probe >= 0.3, probe
+
+
+@pytest.mark.slow
+def test_ner_example_learns():
+    """BiLSTM NER tagger: entity F1 on held-out sentences; the
+    trigger-word construction makes context (the BiLSTM) mandatory."""
+    r = _run("examples/named_entity_recognition/ner_bilstm.py",
+             ["--iters", "120"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    f1 = float(r.stdout.splitlines()[-1].split("entity F1:")[1])
+    assert f1 >= 0.7, f1
+
+
+@pytest.mark.slow
 def test_multi_task_example_both_heads_learn():
     r = _run("examples/multi_task/multi_task.py", ["--iters", "150"])
     assert r.returncode == 0, r.stderr[-2000:]

@@ -115,10 +115,21 @@ def test_gluon_nhwc_net_trains():
     assert losses[-1] < losses[0]
 
 
-def test_deconv_channel_last_raises():
-    with pytest.raises(MXNetError, match="channel-first"):
-        nd.Deconvolution(nd.zeros((1, 4, 4, 2)), nd.zeros((2, 3, 3, 4)),
-                         kernel=(3, 3), num_filter=4, layout="NHWC")
+def test_deconv_channel_last_parity():
+    """NHWC Deconvolution (TPU-native layout) computes exactly the NCHW
+    result on transposed data — weight stays (in, out/g, *k) in both."""
+    rs = np.random.RandomState(3)
+    x = rs.rand(2, 4, 5, 5).astype(np.float32)
+    w = rs.rand(4, 3, 4, 4).astype(np.float32)
+    b = rs.rand(3).astype(np.float32)
+    kw = dict(kernel=(4, 4), stride=(2, 2), pad=(1, 1), num_filter=3,
+              no_bias=False)
+    cf = nd.Deconvolution(nd.array(x), nd.array(w), nd.array(b),
+                          layout="NCHW", **kw).asnumpy()
+    cl = nd.Deconvolution(nd.array(x.transpose(0, 2, 3, 1)), nd.array(w),
+                          nd.array(b), layout="NHWC", **kw).asnumpy()
+    assert cl.shape == (2, 10, 10, 3)
+    np.testing.assert_array_equal(cf, cl.transpose(0, 3, 1, 2))
 
 
 def test_bad_layout_raises():
@@ -154,9 +165,26 @@ def test_deconv_dilation_applied():
     assert ys[1] - ys[0] == 2, out[0, 0]
 
 
-def test_conv_transpose_channel_last_rejected_at_init():
-    with pytest.raises(MXNetError, match="channel-first"):
-        nn.Conv2DTranspose(8, 3, layout="NHWC")
+def test_conv_transpose_channel_last_trains():
+    """Gluon Conv2DTranspose accepts NHWC and trains (the autoencoder
+    example's decoder path)."""
+    from mxnet_tpu import autograd, gluon
+    net = nn.Conv2DTranspose(3, 4, strides=2, padding=1, layout="NHWC",
+                             in_channels=2)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1})
+    x = nd.array(np.random.RandomState(0)
+                 .rand(2, 4, 4, 2).astype(np.float32))
+    losses = []
+    for _ in range(3):
+        with autograd.record():
+            loss = (net(x) ** 2).mean()
+        loss.backward()
+        tr.step(2)
+        losses.append(float(loss.asscalar()))
+    assert net(x).shape == (2, 8, 8, 3)
+    assert losses[-1] < losses[0]
 
 
 def test_onnx_export_rejects_channel_last(tmp_path):
