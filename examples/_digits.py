@@ -1,0 +1,41 @@
+"""Shared synthetic-digit assets for the example trees (the zero-egress
+MNIST stand-in): 3x5 glyph bitmaps plus a stamp helper. One definition
+so a glyph or jitter fix reaches every example at once."""
+import numpy as np
+
+GLYPHS = {
+    0: ["111", "101", "101", "101", "111"],
+    1: ["010", "110", "010", "010", "111"],
+    2: ["111", "001", "111", "100", "111"],
+    3: ["111", "001", "111", "001", "111"],
+    4: ["101", "101", "111", "001", "001"],
+    5: ["111", "100", "111", "001", "111"],
+    6: ["111", "100", "111", "101", "111"],
+    7: ["111", "001", "010", "010", "010"],
+    8: ["111", "101", "111", "101", "111"],
+    9: ["111", "101", "111", "001", "111"],
+}
+GLYPH_H, GLYPH_W = 5, 3
+
+
+def stamp(img, digit, r0, c0, value=1.0, scale=1):
+    """Add glyph ``digit`` into 2-d ``img`` at (r0, c0), each glyph cell
+    drawn as a ``scale`` x ``scale`` block."""
+    for r, row in enumerate(GLYPHS[int(digit)]):
+        for c, bit in enumerate(row):
+            if bit == "1":
+                img[r0 + scale * r:r0 + scale * (r + 1),
+                    c0 + scale * c:c0 + scale * (c + 1)] += value
+    return img
+
+
+def digit_batch(rs, n, size, noise=0.2, jitter=3, scale=1):
+    """(n, size, size) noisy images each holding one jittered digit."""
+    y = rs.randint(0, 10, n)
+    x = rs.rand(n, size, size).astype(np.float32) * noise
+    hi = max(size - GLYPH_H * scale, 1)
+    wi = max(size - GLYPH_W * scale, 1)
+    for i, d in enumerate(y):
+        stamp(x[i], d, rs.randint(0, min(jitter, hi)),
+              rs.randint(0, min(jitter, wi)), scale=scale)
+    return x, y

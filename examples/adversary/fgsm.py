@@ -1,0 +1,88 @@
+"""Adversarial examples via FGSM (ref: example/adversary/adversary_generation.ipynb
+— train an MNIST net, then perturb inputs along the SIGN of the input
+gradient and watch accuracy collapse; rebuilt TPU-first with Gluon +
+autograd).
+
+What this exercises that the other examples don't: gradients with
+respect to INPUTS (x.attach_grad() on a non-parameter array — the
+autograd tape treats data and parameters uniformly, like the
+reference's mark_variables on the data blob), and using those
+gradients OUTSIDE the training loop.
+
+Run: python examples/adversary/fgsm.py --iters 120
+"""
+import argparse
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(_HERE, "..", ".."))
+sys.path.insert(0, os.path.join(_HERE, ".."))  # examples/_digits.py
+
+import numpy as np
+
+from _digits import digit_batch
+
+SIZE = 10
+
+
+def make_batch(rs, n):
+    x, y = digit_batch(rs, n, SIZE, noise=0.2, jitter=3)
+    return x[..., None], y.astype(np.float32)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--iters", type=int, default=120)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--epsilon", type=float, default=0.25)
+    args = ap.parse_args()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+
+    rs = np.random.RandomState(0)
+    mx.random.seed(0)
+    net = nn.HybridSequential(prefix="")
+    net.add(nn.Conv2D(16, 3, padding=1, layout="NHWC", in_channels=1,
+                      activation="relu"))
+    net.add(nn.MaxPool2D(2, layout="NHWC"))
+    net.add(nn.Flatten())
+    net.add(nn.Dense(64, activation="relu"))
+    net.add(nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 2e-3})
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    for it in range(args.iters):
+        x, y = make_batch(rs, args.batch_size)
+        with autograd.record():
+            L = ce(net(mx.nd.array(x)), mx.nd.array(y))
+        L.backward()
+        trainer.step(args.batch_size)
+        if it % 30 == 0 or it == args.iters - 1:
+            print(f"iter {it} loss {float(L.mean().asnumpy()):.4f}",
+                  flush=True)
+
+    # ---- FGSM: x_adv = x + eps * sign(dL/dx) --------------------------
+    xte, yte = make_batch(np.random.RandomState(9), 512)
+    xa = mx.nd.array(xte)
+    xa.attach_grad()            # input gradients, not parameter ones
+    with autograd.record():
+        L = ce(net(xa), mx.nd.array(yte))
+    L.backward()
+    gsign = np.sign(xa.grad.asnumpy())
+    x_adv = np.clip(xte + args.epsilon * gsign, 0, 1.4)
+
+    clean = net(mx.nd.array(xte)).asnumpy().argmax(axis=1)
+    adv = net(mx.nd.array(x_adv)).asnumpy().argmax(axis=1)
+    acc_clean = float((clean == yte).mean())
+    acc_adv = float((adv == yte).mean())
+    print(f"clean accuracy {acc_clean:.3f} "
+          f"adversarial accuracy: {acc_adv:.3f} (eps={args.epsilon})")
+
+
+if __name__ == "__main__":
+    main()

@@ -29,14 +29,16 @@ CLASSES = 10
 SIZE = 40
 
 
-def make_batch(rs, n):
+def make_batch(rs, n, size=SIZE, classes=CLASSES):
     """Oriented-grating textures: class k -> angle k*18deg, frequency
-    2+(k%3), color channel k%3."""
-    y = rs.randint(0, CLASSES, n)
-    yy, xx = np.mgrid[0:SIZE, 0:SIZE].astype(np.float32) / SIZE
-    x = rs.rand(n, SIZE, SIZE, 3).astype(np.float32) * 0.35
+    2+(k%3), color channel k%3. Parameterized by image size so the
+    chip-scale accuracy tool (tools/accuracy_int8_resnet50.py) measures
+    the SAME task definition at 224px."""
+    y = rs.randint(0, classes, n)
+    yy, xx = np.mgrid[0:size, 0:size].astype(np.float32) / size
+    x = rs.rand(n, size, size, 3).astype(np.float32) * 0.35
     for i, c in enumerate(y):
-        ang = c * np.pi / CLASSES
+        ang = c * np.pi / classes
         freq = 2.0 + (c % 3) * 2.0
         phase = rs.rand() * 2 * np.pi
         contrast = 0.7 + 0.6 * rs.rand()

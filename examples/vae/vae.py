@@ -1,0 +1,109 @@
+"""Variational autoencoder (ref: example/vae/VAE_example.ipynb — MLP
+encoder/decoder VAE on MNIST with the classic ELBO; rebuilt TPU-first:
+the reparameterization sample draws from mx.random INSIDE
+autograd.record, so the pathwise gradient flows through mu/sigma
+exactly as the reference's sample_normal node does).
+
+Surfaces exercised: stochastic nodes under the tape (reparameterization
+trick), a composite loss (Bernoulli reconstruction + analytic KL), and
+generation from the prior at the end.
+
+Run: python examples/vae/vae.py --iters 200
+"""
+import argparse
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(_HERE, "..", ".."))
+sys.path.insert(0, os.path.join(_HERE, ".."))  # examples/_digits.py
+
+import numpy as np
+
+from _digits import digit_batch
+
+SIZE = 10
+DIM = SIZE * SIZE
+
+
+def make_batch(rs, n):
+    x, _ = digit_batch(rs, n, SIZE, noise=0.0, jitter=3)
+    return x.reshape(n, DIM)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--iters", type=int, default=200)
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--latent", type=int, default=8)
+    ap.add_argument("--hidden", type=int, default=128)
+    ap.add_argument("--lr", type=float, default=2e-3)
+    args = ap.parse_args()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+    from mxnet_tpu.gluon import nn
+
+    rs = np.random.RandomState(0)
+    mx.random.seed(0)
+
+    class VAE(nn.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.enc = nn.Dense(args.hidden, activation="tanh")
+            self.mu = nn.Dense(args.latent)
+            self.logvar = nn.Dense(args.latent)
+            self.dec1 = nn.Dense(args.hidden, activation="tanh")
+            self.dec2 = nn.Dense(DIM)
+
+        def decode(self, F, z):
+            return self.dec2(self.dec1(z))
+
+        def hybrid_forward(self, F, x):
+            h = self.enc(x)
+            mu, logvar = self.mu(h), self.logvar(h)
+            # reparameterization: z = mu + sigma * eps, eps ~ N(0, 1) —
+            # the random draw happens under the tape; gradients flow
+            # through mu/logvar pathwise. Shape follows mu so any batch
+            # size works (the net is never hybridized here).
+            eps = F.random.normal(0, 1, shape=mu.shape)
+            z = mu + F.exp(0.5 * logvar) * eps
+            return self.decode(F, z), mu, logvar
+
+    net = VAE()
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+
+    first = last = None
+    for it in range(args.iters):
+        x = nd.array(make_batch(rs, args.batch_size))
+        with autograd.record():
+            logits, mu, logvar = net(x)
+            # Bernoulli reconstruction (logits) + analytic KL(q || N(0,1))
+            rec = nd.op.relu(logits) - logits * x + \
+                nd.op.Activation(nd.op.abs(logits) * -1.0,
+                                 act_type="softrelu")
+            rec = rec.sum(axis=1)
+            kl = 0.5 * (nd.op.exp(logvar) + mu * mu - 1.0 - logvar) \
+                .sum(axis=1)
+            loss = (rec + kl).mean()
+        loss.backward()
+        trainer.step(args.batch_size)
+        last = float(loss.asnumpy())
+        first = first if first is not None else last
+        if it % 40 == 0 or it == args.iters - 1:
+            print(f"iter {it} elbo-loss {last:.2f}", flush=True)
+
+    # generate from the prior and score how digit-like samples are:
+    # fraction of mass inside the glyph grid (5x3 region) vs outside
+    z = nd.array(np.random.RandomState(5).randn(64, args.latent)
+                 .astype(np.float32))
+    gen = 1.0 / (1.0 + np.exp(-net.decode(None, z).asnumpy()))
+    on = (gen > 0.5).mean()
+    print(f"first-loss {first:.2f} final-loss {last:.2f} "
+          f"gen-on-fraction {on:.3f}")
+
+
+if __name__ == "__main__":
+    main()

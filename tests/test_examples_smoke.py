@@ -175,6 +175,34 @@ def test_ner_example_learns():
 
 
 @pytest.mark.slow
+def test_fgsm_example_attacks_succeed():
+    """FGSM (input-gradient attack): the model must be accurate on clean
+    data and collapse under eps-sign perturbation — proves grads w.r.t.
+    non-parameter inputs flow through the tape."""
+    r = _run("examples/adversary/fgsm.py", ["--iters", "120"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    tail = r.stdout.splitlines()[-1]
+    clean = float(tail.split("clean accuracy")[1].split()[0])
+    adv = float(tail.split("adversarial accuracy:")[1].split()[0])
+    assert clean >= 0.8, clean
+    assert adv < clean / 2, (clean, adv)
+
+
+@pytest.mark.slow
+def test_vae_example_learns():
+    """VAE: ELBO collapses and prior samples emit sparse digit-like
+    mass (reparameterized sampling under the autograd tape)."""
+    r = _run("examples/vae/vae.py", ["--iters", "200"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    tail = r.stdout.splitlines()[-1]
+    first = float(tail.split("first-loss")[1].split()[0])
+    final = float(tail.split("final-loss")[1].split()[0])
+    on = float(tail.split("gen-on-fraction")[1])
+    assert final < first / 3, (first, final)
+    assert 0.03 < on < 0.6, on
+
+
+@pytest.mark.slow
 def test_multi_task_example_both_heads_learn():
     r = _run("examples/multi_task/multi_task.py", ["--iters", "150"])
     assert r.returncode == 0, r.stderr[-2000:]
