@@ -82,28 +82,48 @@ def get_optimal_threshold(hist, threshold, num_quantized_bins=255):
     best_div = _np.inf
     best_th = threshold
     step = threshold / zero
-    total = hist.sum()
+    # Clip-mass rail: restrict the search to thresholds discarding at
+    # most 0.01% of the NONZERO mass (the zero bin quantizes exactly to
+    # 0 at any threshold, so it is excluded from the budget). Why: on
+    # post-ReLU activations the histogram is a giant zero spike plus a
+    # sparse decisive tail; the KL objective gains more from finely
+    # resolving the spike than it loses from clipping the
+    # small-in-count tail, and picks thresholds 6-10x below absmax that
+    # collapse model accuracy (measured: ResNet-50 int8 top-1 1.00 ->
+    # 0.55 on chip, tools/accuracy_int8_resnet50.py). Genuine lone
+    # outliers are far below the budget and still get clipped — the
+    # point of KL calibration.
+    nz_hist = hist.copy()
+    nz_hist[zero] = 0.0
+    total_nz = nz_hist.sum()
+    budget = 1e-4 * total_nz
     for i in range(num_quantized_bins // 2 + 1, zero + 1):
-        inside = hist[zero - i:zero + i + 1].sum()
-        # degenerate guard: a candidate that clips most of the mass can
-        # still score KL~0 on sparse histograms (q ~= p when the edge
-        # spikes dominate); real calibration clips OUTLIERS, not the bulk
-        if total > 0 and inside / total < 0.9:
+        clipped_nz = nz_hist[:zero - i].sum() + nz_hist[zero + i + 1:].sum()
+        if total_nz > 0 and clipped_nz > budget:
             continue
-        p = hist[zero - i:zero + i + 1].copy()
+        sliced = hist[zero - i:zero + i + 1]
+        p = sliced.copy()
         p[0] += hist[:zero - i].sum()
         p[-1] += hist[zero + i + 1:].sum()
         if p.sum() == 0:
             continue
-        # quantize p into num_quantized_bins levels
-        idx = (_np.arange(p.size) * num_quantized_bins // p.size)
-        counts = _np.bincount(idx, weights=p, minlength=num_quantized_bins)
-        nonzero = _np.bincount(idx, weights=(p > 0).astype(_np.float64),
+        # q models the 255-level quantization of the UNCLIPPED slice
+        # only (reference semantics: the clipped outlier mass lives in
+        # p's edge bins but NOT in q, so clipping the bulk is penalized
+        # by the KL — a round-5 fix: building q from p instead silently
+        # removed that penalty and let the search pick thresholds that
+        # clip real activations, collapsing model-scale int8 top-1)
+        idx = (_np.arange(sliced.size) * num_quantized_bins
+               // sliced.size)
+        counts = _np.bincount(idx, weights=sliced,
+                              minlength=num_quantized_bins)
+        nonzero = _np.bincount(idx,
+                               weights=(sliced > 0).astype(_np.float64),
                                minlength=num_quantized_bins)
         with _np.errstate(divide="ignore", invalid="ignore"):
             expanded = _np.where(nonzero[idx] > 0,
                                  counts[idx] / nonzero[idx], 0.0)
-        q = _np.where(p > 0, expanded, 0.0)
+        q = _np.where(sliced > 0, expanded, 0.0)
         # smooth (ref: _smooth_distribution) so KL stays finite
         eps = 1e-4
         for d in (p, q):

@@ -237,6 +237,36 @@ class SPMDTrainer:
 
         return step
 
+    def program_stats(self):
+        """XLA cost-model stats of the most recently dispatched fused
+        step program: ``{"flops", "bytes_accessed", "argument_bytes",
+        "temp_bytes"}``.
+
+        The compiler's own accounting of what the compiled program
+        touches — the honest numerator/denominator pair for roofline
+        analysis (tools/roofline_ledger.py): achieved FLOP/s vs achieved
+        HBM bandwidth. Re-lowers from the recorded ABSTRACT signature
+        (donated buffers die with each call), so with a persistent
+        compile cache this costs one trace, not a recompile. Single-mesh
+        programs only — shardings are not threaded through the abstract
+        signature."""
+        if getattr(self, "_last_program", None) is None:
+            from ..base import MXNetError
+            raise MXNetError(
+                "program_stats: no fused step program dispatched yet — "
+                "call run_steps() first")
+        fn, abstract_args = self._last_program
+        comp = fn.lower(*abstract_args).compile()
+        ca = comp.cost_analysis()
+        ca = ca[0] if isinstance(ca, list) else dict(ca)
+        mem = comp.memory_analysis()
+        return {
+            "flops": float(ca.get("flops", 0.0)),
+            "bytes_accessed": float(ca.get("bytes accessed", 0.0)),
+            "argument_bytes": int(mem.argument_size_in_bytes),
+            "temp_bytes": int(mem.temp_size_in_bytes),
+        }
+
     def _make_step(self, treedef_key):
         import jax
         return jax.jit(self._build_step_fn(), donate_argnums=(0, 1, 2))
@@ -362,8 +392,14 @@ class SPMDTrainer:
         if fn is None:
             fn = self._step_fns[sig] = self._make_multi_step(sig)
         t0 = jnp.asarray(self._t + 1, jnp.int32)
-        losses, new_params, new_aux, new_opt = fn(
-            train_arrays, aux_arrays, self._opt_state, key, t0, data, label)
+        args = (train_arrays, aux_arrays, self._opt_state, key, t0, data,
+                label)
+        # abstract signature only (donated buffers die with the call) —
+        # program_stats() re-lowers from this
+        import jax
+        self._last_program = (fn, jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), args))
+        losses, new_params, new_aux, new_opt = fn(*args)
         self._t += int(k_steps)
         self._finish(new_params, new_aux, new_opt)
         return losses

@@ -368,6 +368,42 @@ def test_quantize_net_error_leaves_net_unmutated():
     np.testing.assert_allclose(net(x).asnumpy(), ref, rtol=1e-6)
 
 
+def test_kl_threshold_penalizes_clipping_the_bulk():
+    """get_optimal_threshold (entropy calibration): q must be built from
+    the UNCLIPPED slice so clipped mass — present in p's edge bins but
+    absent from q — raises the KL. Round-5 regression: building q from p
+    removed that penalty and the search clipped real activations,
+    collapsing ResNet-50 int8 top-1 from 1.00 to 0.47 on the chip.
+    Contract: a clean gaussian keeps >=90% of its range; a lone extreme
+    outlier IS clipped (that is the point of KL calibration)."""
+    from mxnet_tpu.contrib.quantization import (HistogramCollector,
+                                                get_optimal_threshold)
+    rs = np.random.RandomState(0)
+
+    def th_of(a):
+        c = HistogramCollector()
+        c.collect("t", a.astype(np.float32))
+        hist, th = c.hists["t"]
+        return get_optimal_threshold(hist, th), float(np.abs(a).max())
+
+    opt, mx_ = th_of(rs.randn(200000))
+    assert opt > 0.9 * mx_, (opt, mx_)
+    # symmetric binary-ish activations: clipping the +-3 mode would
+    # destroy the signal — threshold must stay near absmax
+    a = np.where(rs.rand(200000) < 0.7, rs.randn(200000) * 0.05,
+                 np.sign(rs.randn(200000)) * (3.0 + rs.randn(200000) * 0.3))
+    opt, mx_ = th_of(a)
+    assert opt > 0.8 * mx_, (opt, mx_)
+    # post-ReLU shape (giant zero spike + sparse decisive tail): the
+    # clip-mass rail (<=0.01% of NONZERO mass discarded) must stop the
+    # KL from clipping the tail to resolve the spike
+    opt, mx_ = th_of(np.maximum(rs.randn(200000) * 1.5, 0))
+    assert opt > 0.6 * mx_, (opt, mx_)
+    # one huge outlier in a gaussian: MUST clip far below absmax
+    opt, mx_ = th_of(np.concatenate([rs.randn(200000), [50.0]]))
+    assert opt < 0.2 * mx_, (opt, mx_)
+
+
 def test_quantize_static_case_table():
     """_quantize_static: q = clip(round(x/scale), -127, 127) as int8 —
     exact integer parity against the formula, incl. saturation and the

@@ -68,25 +68,51 @@ def main():
 
     rs = np.random.RandomState(0)
     k, batch = 16, 256
-    xs, ys = make_batch(rs, k * batch)
-    data = jnp.asarray(xs.reshape(k, batch, IMG, IMG, 3))
-    label = jnp.asarray(ys.reshape(k, batch).astype(np.float32))
-    t0 = time.time()
-    losses = np.asarray(trainer.run_steps(data, label))
-    log(f"first dispatch (compile) {time.time() - t0:.0f}s "
-        f"loss {losses[0]:.3f} -> {losses[-1]:.3f}")
-    for rep in range(14):
-        losses = trainer.run_steps(data, label)
-    losses = np.asarray(losses)
-    log(f"trained 240 steps; final loss {losses[-1]:.4f}")
-
-    # ---- bf16 eval (the bench inference program: scanned 8x256) -------
     accel = jax.devices()[0]
     cpu = jax.devices("cpu")[0]
+    ckpt = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "_resnet50_textures_params.npz")
+    if os.path.exists(ckpt):
+        # trained-params checkpoint from a previous run: skip the train
+        loaded = dict(np.load(ckpt))
+        with jax.default_device(cpu):
+            net(mx.nd.from_jax(jnp.asarray(
+                np.zeros((1, IMG, IMG, 3), np.float32), device=cpu)))
+        dst = sorted(net.collect_params().items())
+        assert len(dst) == len(loaded), \
+            (f"stale checkpoint {ckpt}: {len(loaded)} arrays vs "
+             f"{len(dst)} params — delete it and re-train")
+        for (name, p), key in zip(dst, sorted(loaded)):
+            a = loaded[key]
+            assert tuple(p.shape) == a.shape, \
+                (f"stale checkpoint {ckpt}: {name} {p.shape} vs "
+                 f"{a.shape} — delete it and re-train")
+            p._data._rebind(jax.device_put(jnp.asarray(a), cpu))
+        log(f"loaded trained params from {ckpt}")
+    else:
+        xs, ys = make_batch(rs, k * batch)
+        data = jnp.asarray(xs.reshape(k, batch, IMG, IMG, 3))
+        label = jnp.asarray(ys.reshape(k, batch).astype(np.float32))
+        t0 = time.time()
+        losses = np.asarray(trainer.run_steps(data, label))
+        log(f"first dispatch (compile) {time.time() - t0:.0f}s "
+            f"loss {losses[0]:.3f} -> {losses[-1]:.3f}")
+        for rep in range(14):
+            losses = trainer.run_steps(data, label)
+        losses = np.asarray(losses)
+        log(f"trained 240 steps; final loss {losses[-1]:.4f}")
+
     f32_params = {}
     for name, p in net.collect_params().items():
         a = p._data._data
-        f32_params[name] = np.asarray(jax.device_put(a, cpu))
+        f32_params[name] = np.asarray(jax.device_put(a, cpu),
+                                      np.float32)
+    if not os.path.exists(ckpt):
+        np.savez(ckpt, **{f"{i:03d}": f32_params[k2] for i, k2 in
+                          enumerate(sorted(f32_params))})
+        log(f"saved trained params to {ckpt}")
+
+    # ---- bf16 eval (the bench inference program: scanned 8x256) -------
 
     def place_on_accel(block):
         """bench.py's placement policy: quantized blocks keep int8
@@ -149,19 +175,35 @@ def main():
         ("naive", ("dense", "conv2d0"), 4, 2),
         ("naive", (), 16, 8),
     ]
+    class ScaleLog:
+        """Captures quantize_net's per-layer 'quantized <name>
+        (in_scale=...)' lines so calibration modes can be diffed."""
+
+        def __init__(self):
+            self.scales = {}
+
+        def info(self, fmt, *args):
+            if "in_scale" in fmt and len(args) == 2:
+                # strip the per-instance net prefix for cross-net diffs
+                self.scales[str(args[0]).split("_", 2)[-1]] = \
+                    float(args[1])
+
     results = []
+    mode_scales = {}
     for mode, exclude, n_batches, bsz in configs:
         fresh = restore_f32()
         calib_rs = np.random.RandomState(555)
+        slog = ScaleLog()
         with jax.default_device(cpu):
             calib = [mx.nd.from_jax(jnp.asarray(
                 make_batch(calib_rs, bsz)[0], device=cpu))
                 for _ in range(n_batches)]
             t0 = time.time()
             qnet = quantize_net(fresh, calib, calib_mode=mode,
-                                exclude=exclude)
+                                exclude=exclude, logger=slog)
             log(f"quantize_net {mode} exclude={exclude} "
                 f"({n_batches}x{bsz}) {time.time() - t0:.0f}s")
+        mode_scales[(mode, exclude, n_batches * bsz)] = slog.scales
         place_on_accel(qnet)
         fwd_q = make_scan_forward(qnet)
         t0 = time.time()
@@ -172,6 +214,17 @@ def main():
         log(f"  -> top1 {top1_q:.4f} agree {agree:.4f} "
             f"({time.time() - t0:.0f}s)")
         results.append((mode, exclude, n_batches * bsz, top1_q, agree))
+
+    # scale diff: where does entropy clip relative to naive-absmax?
+    ent = mode_scales.get(("entropy", (), 8))
+    nai = mode_scales.get(("naive", (), 8))
+    if ent and nai:
+        ratios = sorted(((nai[k] / max(ent[k], 1e-12), k)
+                         for k in ent if k in nai), reverse=True)
+        log("largest naive/entropy scale ratios (entropy clips here):")
+        for r, k in ratios[:12]:
+            log(f"  {k:28s} naive {nai[k]:10.5g} entropy {ent[k]:10.5g} "
+                f"ratio {r:6.2f}")
 
     best = max(results, key=lambda r: r[3])
     for mode, exclude, n, t1, ag in results:
