@@ -203,6 +203,26 @@ def test_vae_example_learns():
 
 
 @pytest.mark.slow
+def test_fcn_segmentation_example_learns():
+    """FCN-8s-style segmentation (NHWC deconv upsampling + skip fuse):
+    mean foreground IoU is the task's metric."""
+    r = _run("examples/fcn_xs/fcn_seg.py", ["--iters", "150"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    iou = float(r.stdout.splitlines()[-1].split("mean IoU:")[1])
+    assert iou >= 0.6, iou
+
+
+@pytest.mark.slow
+def test_capsnet_example_learns():
+    """CapsNet dynamic routing (3 unrolled routing iterations, batch_dot
+    capsule transform): classify by digit-capsule LENGTH."""
+    r = _run("examples/capsnet/capsnet.py", ["--iters", "150"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    acc = float(r.stdout.splitlines()[-1].split(":")[1])
+    assert acc >= 0.8, acc
+
+
+@pytest.mark.slow
 def test_multi_task_example_both_heads_learn():
     r = _run("examples/multi_task/multi_task.py", ["--iters", "150"])
     assert r.returncode == 0, r.stderr[-2000:]
