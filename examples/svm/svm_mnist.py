@@ -1,0 +1,73 @@
+"""SVM digit classification via the SVMOutput head (ref:
+example/svm_mnist/svm_mnist.py — an MLP whose output layer is SVMOutput,
+trained with the squared hinge loss instead of softmax; same surface
+here: the SVMOutput op's backward IS the hinge gradient, so the example
+exercises an op-defined loss rather than a Gluon loss object).
+
+Run: python examples/svm/svm_mnist.py --iters 200
+"""
+import argparse
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(_HERE, "..", ".."))
+sys.path.insert(0, os.path.join(_HERE, ".."))  # examples/_digits.py
+
+import numpy as np
+
+from _digits import digit_batch
+
+SIZE = 10
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--iters", type=int, default=200)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--linear", action="store_true",
+                    help="L1 hinge instead of squared hinge")
+    args = ap.parse_args()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+    from mxnet_tpu.gluon import nn
+
+    rs = np.random.RandomState(0)
+    mx.random.seed(0)
+    net = nn.HybridSequential(prefix="")
+    net.add(nn.Dense(128, activation="relu"))
+    net.add(nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9})
+
+    for it in range(args.iters):
+        x, y = digit_batch(rs, args.batch_size, SIZE, noise=0.2,
+                           jitter=3)
+        xa = nd.array(x.reshape(args.batch_size, -1))
+        ya = nd.array(y.astype(np.float32))
+        with autograd.record():
+            scores = net(xa)
+            # SVMOutput: forward passes scores through; backward is the
+            # (squared) hinge gradient at margin 1 — the op IS the loss
+            out = nd.op.SVMOutput(scores, ya, margin=1.0,
+                                  regularization_coefficient=1.0,
+                                  use_linear=args.linear)
+        out.backward()
+        trainer.step(args.batch_size)
+        if it % 40 == 0 or it == args.iters - 1:
+            hinge = float(nd.op.relu(
+                1.0 - (scores - scores.max(axis=1, keepdims=True))
+            ).mean().asnumpy())
+            print(f"iter {it} (proxy margin stat {hinge:.3f})", flush=True)
+
+    x, y = digit_batch(np.random.RandomState(99), 512, SIZE, noise=0.2,
+                       jitter=3)
+    pred = net(nd.array(x.reshape(512, -1))).asnumpy().argmax(axis=1)
+    print(f"svm accuracy: {float((pred == y).mean()):.3f}")
+
+
+if __name__ == "__main__":
+    main()

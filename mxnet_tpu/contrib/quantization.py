@@ -96,7 +96,23 @@ def get_optimal_threshold(hist, threshold, num_quantized_bins=255):
     nz_hist = hist.copy()
     nz_hist[zero] = 0.0
     total_nz = nz_hist.sum()
-    budget = 1e-4 * total_nz
+    # floor of 2: small calibration tensors must still be able to clip
+    # a lone extreme outlier (1e-4 of a 96-sample tensor is < 1 count,
+    # which would forbid ALL clipping and return raw absmax) — but never
+    # more than 5% of the nonzero mass, so a near-dead channel with 1-2
+    # real activations keeps them instead of clipping everything
+    budget = min(max(1e-4 * total_nz, 2.0), 0.05 * total_nz)
+    if total_nz < 2 * num_quantized_bins:
+        # too sparse for the KL statistic (well under one count per
+        # quantized level: the divergence is dominated by histogram
+        # sampling noise, not by clipping) — apply the budget as a
+        # percentile rule directly: the tightest threshold discarding
+        # at most `budget` nonzero counts
+        for i in range(num_quantized_bins // 2 + 1, zero + 1):
+            clipped = nz_hist[:zero - i].sum() + nz_hist[zero + i + 1:].sum()
+            if clipped <= budget:
+                return (i + 0.5) * step
+        return threshold
     for i in range(num_quantized_bins // 2 + 1, zero + 1):
         clipped_nz = nz_hist[:zero - i].sum() + nz_hist[zero + i + 1:].sum()
         if total_nz > 0 and clipped_nz > budget:

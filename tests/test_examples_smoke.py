@@ -223,6 +223,33 @@ def test_capsnet_example_learns():
 
 
 @pytest.mark.slow
+def test_svm_example_learns():
+    """SVMOutput head: the op's backward IS the squared-hinge gradient
+    (no Gluon loss object in the loop)."""
+    r = _run("examples/svm/svm_mnist.py", ["--iters", "200"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    acc = float(r.stdout.splitlines()[-1].split(":")[1])
+    assert acc >= 0.8, acc
+
+
+@pytest.mark.slow
+def test_stochastic_depth_example():
+    """Stochastic depth: training forwards vary (blocks drop), inference
+    forwards are bit-identical (every block kept), and the thinned net
+    still learns."""
+    r = _run("examples/stochastic_depth/stochastic_depth.py",
+             ["--iters", "150"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    tail = r.stdout.splitlines()[-1]
+    train_var = float(tail.split("train-mode variation")[1].split()[0])
+    infer_var = float(tail.split("infer-mode variation")[1].split()[0])
+    acc = float(tail.split("accuracy:")[1])
+    assert train_var > 0, "blocks never dropped in training mode"
+    assert infer_var == 0.0, infer_var
+    assert acc >= 0.6, acc
+
+
+@pytest.mark.slow
 def test_multi_task_example_both_heads_learn():
     r = _run("examples/multi_task/multi_task.py", ["--iters", "150"])
     assert r.returncode == 0, r.stderr[-2000:]

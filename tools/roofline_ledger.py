@@ -42,9 +42,15 @@ def log(msg):
 
 def stream_bandwidth_gbs():
     """Measured HBM stream ceiling: sum-reduce a resident 2 GiB bf16
-    buffer inside a scanned program (the probe methodology of
-    tools/probe_lowbit_conv.py: slope between two scan lengths cancels
-    the fixed dispatch overhead)."""
+    buffer k times inside one scanned program; the slope between two
+    scan lengths cancels the ~100 ms relay dispatch overhead.
+
+    Two relay pitfalls this probe works around (both verified live):
+    - identical (executable, args) dispatches are MEMOIZED by the relay
+      — every timed call carries a fresh scalar operand;
+    - block_until_ready returns before remote execution completes for
+      small outputs — sync on a host FETCH of the scalar (bench.py's
+      sync note)."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -54,23 +60,43 @@ def stream_bandwidth_gbs():
 
     def reader(k):
         @jax.jit
-        def f(xx):
-            def body(c, i):
-                # i-dependent scale so the read cannot be hoisted
-                return c + (xx * i.astype(jnp.bfloat16)).sum(), None
+        def f(xx, s):
+            def body(c, _):
+                # abs(x*s - c) cannot be factored into s*sum(x) - n*c by
+                # the algebraic simplifier, and c changes per iteration,
+                # so every iteration must re-read the full buffer
+                return c + jnp.abs(xx * s - c.astype(jnp.bfloat16)) \
+                    .sum(dtype=jnp.float32), None
             out, _ = lax.scan(body, jnp.zeros((), jnp.float32),
-                              jnp.arange(k))
+                              None, length=k)
             return out
         return f
 
-    f_lo, f_hi = reader(4), reader(12)
-    jax.block_until_ready(f_lo(x)); jax.block_until_ready(f_hi(x))
-    t0 = time.perf_counter(); jax.block_until_ready(f_lo(x))
-    t_lo = time.perf_counter() - t0
-    t0 = time.perf_counter(); jax.block_until_ready(f_hi(x))
-    t_hi = time.perf_counter() - t0
-    per_pass = (t_hi - t_lo) / 8.0
-    return (2.0 * n) / per_pass / 1e9
+    k_lo, k_hi = 2, 64   # 62-pass slope (~150 ms at nominal BW) so
+    #                      relay dispatch jitter cannot drown it
+    f_lo, f_hi = reader(k_lo), reader(k_hi)
+    seed = [0]
+
+    def timed(f):
+        best = float("inf")
+        for _ in range(3):
+            seed[0] += 1
+            s = jnp.asarray(1.0 + 1e-3 * seed[0], jnp.bfloat16)
+            t0 = time.perf_counter()
+            float(f(x, s))          # host fetch = real sync
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    timed(f_lo); timed(f_hi)        # warm both executables
+    for attempt in range(3):
+        per_pass = (timed(f_hi) - timed(f_lo)) / (k_hi - k_lo)
+        if per_pass > 0:
+            return (2.0 * n) / per_pass / 1e9
+        log(f"stream probe: non-positive slope (attempt {attempt}) — "
+            "dispatch jitter; retrying")
+    raise RuntimeError(
+        "stream bandwidth probe: slope non-positive after 3 attempts — "
+        "refusing to write a garbage bandwidth into the ledger")
 
 
 def mode_stats(env_overrides):
@@ -91,11 +117,14 @@ def mode_stats(env_overrides):
                               optimizer_params={"learning_rate": 0.05,
                                                 "momentum": 0.9},
                               dtype=jnp.bfloat16)
-        rs = np.random.RandomState(0)
-        data = jnp.asarray(rs.rand(K, BATCH, 224, 224, 3)
-                           .astype(np.float32))
-        label = jnp.asarray(rs.randint(0, 1000, (K, BATCH))
-                            .astype(np.float32))
+        # generate on DEVICE: pushing 2.5 GB through the tunnel takes
+        # ~6 min and is not what this tool measures
+        import jax
+        kk = jax.random.PRNGKey(0)
+        data = jax.random.uniform(kk, (K, BATCH, 224, 224, 3),
+                                  jnp.float32)
+        label = jax.random.randint(jax.random.PRNGKey(1), (K, BATCH),
+                                   0, 1000).astype(jnp.float32)
         t0 = time.time()
         trainer.run_steps(data, label)
         log(f"  dispatch (compile-cached) {time.time() - t0:.0f}s")
@@ -126,15 +155,17 @@ def main():
         s = mode_stats(env)
         ips = MEASURED_IMGS_PER_SEC[name]
         step_s = BATCH * K / ips / K          # seconds per step
-        per_step_flops = s["flops"] / K
-        per_step_bytes = s["bytes_accessed"] / K
+        # XLA's cost model counts a While/scan BODY once, not times its
+        # trip count — so the program totals ARE per-step numbers
+        per_step_flops = s["flops"]
+        per_step_bytes = s["bytes_accessed"]
         rows[name] = {
             "imgs_per_sec_measured": ips,
-            "ms_per_step": 1e3 * step_s,
+            "ms_per_step": round(1e3 * step_s, 2),
             "program_flops_per_step": per_step_flops,
             "program_bytes_per_step": per_step_bytes,
-            "achieved_tflops": per_step_flops / step_s / 1e12,
-            "achieved_hbm_gbs": per_step_bytes / step_s / 1e9,
+            "achieved_tflops": round(per_step_flops / step_s / 1e12, 1),
+            "achieved_hbm_gbs": round(per_step_bytes / step_s / 1e9, 0),
         }
         log(f"  {name}: {per_step_flops/1e12:.2f} TFLOP/step, "
             f"{per_step_bytes/1e9:.2f} GB/step -> "
@@ -142,6 +173,10 @@ def main():
             f"{rows[name]['achieved_hbm_gbs']:.0f} GB/s")
 
     out = {
+        "note": "XLA cost-model stats of the exact fused 16-step bench "
+                "train program (scan body counted once = per-step "
+                "numbers); regenerate with tools/roofline_ledger.py on "
+                "the axon TPU",
         "stream_bandwidth_gbs_measured": round(bw, 1),
         "matmul_peak_tflops_demonstrated": 73.0,
         "batch": BATCH, "fused_steps": K,
