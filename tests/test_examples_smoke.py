@@ -250,6 +250,22 @@ def test_stochastic_depth_example():
 
 
 @pytest.mark.slow
+def test_sgld_example_samples_posterior():
+    """SGLD (Bayesian methods): the sgld optimizer's Langevin noise
+    must give a genuinely spread posterior whose predictive mean still
+    matches the data — a point optimizer would collapse the spread."""
+    r = _run("examples/bayesian_methods/sgld_regression.py",
+             ["--steps", "1200"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    tail = r.stdout.splitlines()[-1]
+    pred = float(tail.split("predictive mean")[1].split()[0])
+    data_mean = float(tail.split("(data mean")[1].split(")")[0])
+    spread = float(tail.split("posterior-spread")[1])
+    assert abs(pred - data_mean) < 0.35, (pred, data_mean)
+    assert spread > 0.1, spread
+
+
+@pytest.mark.slow
 def test_multi_task_example_both_heads_learn():
     r = _run("examples/multi_task/multi_task.py", ["--iters", "150"])
     assert r.returncode == 0, r.stderr[-2000:]
