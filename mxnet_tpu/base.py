@@ -128,6 +128,12 @@ env.declare("MXNET_CONV_COMPUTE", str, "",
 env.declare("MXNET_CONV_INT8_RANGE", float, 8.0,
             "Symmetric activation clip range for MXNET_CONV_COMPUTE=int8 "
             "(post-BN/ReLU activations are O(1); widen if a model clips).")
+env.declare("MXTPU_FUSED_EPILOGUE", bool, True,
+            "Route the fused conv-epilogue ops (_contrib_fused_bn_relu / "
+            "_contrib_fused_bn_add_relu) through the Pallas BN(+add)+ReLU "
+            "kernels (compiled on TPU, interpret mode elsewhere). Set 0 "
+            "to fall back to the composed unfused lowering. Read at "
+            "trace time — part of every op jit-cache key.")
 env.declare("MXNET_HOME", str, "",
             "Root directory for datasets and model artifacts "
             "(default ~/.mxnet; ref: docs/faq/env_var.md MXNET_HOME).")

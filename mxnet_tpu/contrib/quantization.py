@@ -400,8 +400,18 @@ def fold_batchnorm(net):
                 conv._kwargs["no_bias"] = False
             else:
                 conv.bias.set_data(_ndar.array(new_b.astype(_np.float32)))
-            _replace_child(parent, k2, b2,
-                           _gnn.HybridLambda(lambda F, x: x))
+            # the fused epilogue blocks (gluon/nn/fused.py) are BatchNorms
+            # carrying a relu / add+relu tail — the fold must leave that
+            # tail behind, not an identity
+            epi = getattr(b2, "_epilogue", None)
+            if epi == "relu":
+                repl = _gnn.Activation("relu")
+            elif epi == "add_relu":
+                repl = _gnn.HybridLambda(
+                    lambda F, x, r: F.Activation(x + r, act_type="relu"))
+            else:
+                repl = _gnn.HybridLambda(lambda F, x: x)
+            _replace_child(parent, k2, b2, repl)
             n_folded += 1
     if n_folded:
         # a hybridized net would otherwise replay the stale compiled

@@ -25,17 +25,30 @@ def _bn_axis(layout):
     return -1 if layout.endswith("C") else 1
 
 
+def _fuse_epilogue(layout):
+    """Channel-last blocks use the fused Pallas BN(+add)+ReLU epilogues
+    (ops/pallas_kernels.py): C on the lane-minor dim is what the kernels
+    tile. Channel-first keeps the composed lowering."""
+    return bool(layout) and layout.endswith("C")
+
+
 class BasicBlockV1(HybridBlock):
     def __init__(self, channels, stride, downsample=False, in_channels=0,
                  layout="NCHW", **kwargs):
         super().__init__(**kwargs)
         ax = _bn_axis(layout)
+        self._fused = _fuse_epilogue(layout)
         self.body = nn.HybridSequential(prefix="")
         self.body.add(_conv3x3(channels, stride, in_channels, layout))
-        self.body.add(nn.BatchNorm(axis=ax))
-        self.body.add(nn.Activation("relu"))
-        self.body.add(_conv3x3(channels, 1, channels, layout))
-        self.body.add(nn.BatchNorm(axis=ax))
+        if self._fused:
+            self.body.add(nn.FusedBatchNormReLU(axis=ax))
+            self.body.add(_conv3x3(channels, 1, channels, layout))
+            self.body.add(nn.FusedBatchNormAddReLU(axis=ax))
+        else:
+            self.body.add(nn.BatchNorm(axis=ax))
+            self.body.add(nn.Activation("relu"))
+            self.body.add(_conv3x3(channels, 1, channels, layout))
+            self.body.add(nn.BatchNorm(axis=ax))
         if downsample:
             self.downsample = nn.HybridSequential(prefix="")
             self.downsample.add(nn.Conv2D(channels, kernel_size=1,
@@ -48,9 +61,16 @@ class BasicBlockV1(HybridBlock):
 
     def hybrid_forward(self, F, x):
         residual = x
-        x = self.body(x)
         if self.downsample:
             residual = self.downsample(residual)
+        if self._fused:
+            kids = list(self.body)
+            for child in kids[:-1]:
+                x = child(x)
+            # tail child is the fused BN+add+ReLU (or, after int8
+            # BN-folding, the add+relu epilogue it leaves behind)
+            return kids[-1](x, residual)
+        x = self.body(x)
         return F.Activation(residual + x, act_type="relu")
 
 
@@ -59,17 +79,26 @@ class BottleneckV1(HybridBlock):
                  layout="NCHW", **kwargs):
         super().__init__(**kwargs)
         ax = _bn_axis(layout)
+        self._fused = _fuse_epilogue(layout)
         self.body = nn.HybridSequential(prefix="")
         self.body.add(nn.Conv2D(channels // 4, kernel_size=1, strides=stride,
                                use_bias=False, layout=layout))
-        self.body.add(nn.BatchNorm(axis=ax))
-        self.body.add(nn.Activation("relu"))
-        self.body.add(_conv3x3(channels // 4, 1, channels // 4, layout))
-        self.body.add(nn.BatchNorm(axis=ax))
-        self.body.add(nn.Activation("relu"))
-        self.body.add(nn.Conv2D(channels, kernel_size=1, strides=1,
-                               use_bias=False, layout=layout))
-        self.body.add(nn.BatchNorm(axis=ax))
+        if self._fused:
+            self.body.add(nn.FusedBatchNormReLU(axis=ax))
+            self.body.add(_conv3x3(channels // 4, 1, channels // 4, layout))
+            self.body.add(nn.FusedBatchNormReLU(axis=ax))
+            self.body.add(nn.Conv2D(channels, kernel_size=1, strides=1,
+                                   use_bias=False, layout=layout))
+            self.body.add(nn.FusedBatchNormAddReLU(axis=ax))
+        else:
+            self.body.add(nn.BatchNorm(axis=ax))
+            self.body.add(nn.Activation("relu"))
+            self.body.add(_conv3x3(channels // 4, 1, channels // 4, layout))
+            self.body.add(nn.BatchNorm(axis=ax))
+            self.body.add(nn.Activation("relu"))
+            self.body.add(nn.Conv2D(channels, kernel_size=1, strides=1,
+                                   use_bias=False, layout=layout))
+            self.body.add(nn.BatchNorm(axis=ax))
         if downsample:
             self.downsample = nn.HybridSequential(prefix="")
             self.downsample.add(nn.Conv2D(channels, kernel_size=1,
@@ -82,10 +111,21 @@ class BottleneckV1(HybridBlock):
 
     def hybrid_forward(self, F, x):
         residual = x
-        x = self.body(x)
         if self.downsample:
             residual = self.downsample(residual)
+        if self._fused:
+            kids = list(self.body)
+            for child in kids[:-1]:
+                x = child(x)
+            return kids[-1](x, residual)
+        x = self.body(x)
         return F.Activation(x + residual, act_type="relu")
+
+
+def _bn_relu(ax, fused):
+    """Pre-activation BN+ReLU pair: one fused block channel-last, the
+    composed pair otherwise (the caller applies the relu itself)."""
+    return nn.FusedBatchNormReLU(axis=ax) if fused else nn.BatchNorm(axis=ax)
 
 
 class BasicBlockV2(HybridBlock):
@@ -93,9 +133,10 @@ class BasicBlockV2(HybridBlock):
                  layout="NCHW", **kwargs):
         super().__init__(**kwargs)
         ax = _bn_axis(layout)
-        self.bn1 = nn.BatchNorm(axis=ax)
+        self._fused = _fuse_epilogue(layout)
+        self.bn1 = _bn_relu(ax, self._fused)
         self.conv1 = _conv3x3(channels, stride, in_channels, layout)
-        self.bn2 = nn.BatchNorm(axis=ax)
+        self.bn2 = _bn_relu(ax, self._fused)
         self.conv2 = _conv3x3(channels, 1, channels, layout)
         if downsample:
             self.downsample = nn.Conv2D(channels, 1, stride, use_bias=False,
@@ -107,12 +148,14 @@ class BasicBlockV2(HybridBlock):
     def hybrid_forward(self, F, x):
         residual = x
         x = self.bn1(x)
-        x = F.Activation(x, act_type="relu")
+        if not self._fused:
+            x = F.Activation(x, act_type="relu")
         if self.downsample:
             residual = self.downsample(x)
         x = self.conv1(x)
         x = self.bn2(x)
-        x = F.Activation(x, act_type="relu")
+        if not self._fused:
+            x = F.Activation(x, act_type="relu")
         x = self.conv2(x)
         return x + residual
 
@@ -122,12 +165,13 @@ class BottleneckV2(HybridBlock):
                  layout="NCHW", **kwargs):
         super().__init__(**kwargs)
         ax = _bn_axis(layout)
-        self.bn1 = nn.BatchNorm(axis=ax)
+        self._fused = _fuse_epilogue(layout)
+        self.bn1 = _bn_relu(ax, self._fused)
         self.conv1 = nn.Conv2D(channels // 4, kernel_size=1, strides=1,
                                use_bias=False, layout=layout)
-        self.bn2 = nn.BatchNorm(axis=ax)
+        self.bn2 = _bn_relu(ax, self._fused)
         self.conv2 = _conv3x3(channels // 4, stride, channels // 4, layout)
-        self.bn3 = nn.BatchNorm(axis=ax)
+        self.bn3 = _bn_relu(ax, self._fused)
         self.conv3 = nn.Conv2D(channels, kernel_size=1, strides=1,
                                use_bias=False, layout=layout)
         if downsample:
@@ -140,15 +184,18 @@ class BottleneckV2(HybridBlock):
     def hybrid_forward(self, F, x):
         residual = x
         x = self.bn1(x)
-        x = F.Activation(x, act_type="relu")
+        if not self._fused:
+            x = F.Activation(x, act_type="relu")
         if self.downsample:
             residual = self.downsample(x)
         x = self.conv1(x)
         x = self.bn2(x)
-        x = F.Activation(x, act_type="relu")
+        if not self._fused:
+            x = F.Activation(x, act_type="relu")
         x = self.conv2(x)
         x = self.bn3(x)
-        x = F.Activation(x, act_type="relu")
+        if not self._fused:
+            x = F.Activation(x, act_type="relu")
         x = self.conv3(x)
         return x + residual
 

@@ -168,18 +168,25 @@ class BatchNorm(HybridBlock):
         for p in (self.gamma, self.beta, self.running_mean, self.running_var):
             p.shape_hint((c,))
 
-    def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
+    def _update_running_stats(self, running_mean, running_var, mean, var):
+        """Momentum-blend the batch stats into the running buffers
+        (training mode only) — the functional replacement for the
+        reference's in-op aux-state mutation; shared with the fused
+        epilogue subclasses (fused.py)."""
         from ... import autograd
-        out, mean, var = F.BatchNorm(
-            x, gamma, beta, running_mean, running_var,
-            eps=self._epsilon, momentum=self._momentum,
-            fix_gamma=not self._scale,
-            use_global_stats=self._use_global_stats, axis=self._axis)
         if autograd.is_training() and not self._use_global_stats:
             with autograd.pause():
                 m = self._momentum
                 running_mean._rebind((running_mean * m + mean * (1 - m))._data)
                 running_var._rebind((running_var * m + var * (1 - m))._data)
+
+    def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
+        out, mean, var = F.BatchNorm(
+            x, gamma, beta, running_mean, running_var,
+            eps=self._epsilon, momentum=self._momentum,
+            fix_gamma=not self._scale,
+            use_global_stats=self._use_global_stats, axis=self._axis)
+        self._update_running_stats(running_mean, running_var, mean, var)
         return out
 
     def __repr__(self):

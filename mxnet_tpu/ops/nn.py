@@ -377,6 +377,78 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     return out.astype(data.dtype), mean, var
 
 
+# ---------------------------------------------------------------------------
+# Fused bottleneck epilogues: conv -> BN -> ReLU and
+# conv -> BN -> add(residual) -> ReLU as ONE op (Pallas kernels in
+# ops/pallas_kernels.py). The separate BatchNorm/add/Activation ops leave
+# XLA free to materialize the intermediate activations between them —
+# measured as the dominant HBM traffic of the ResNet-50 train step
+# (docs/perf.md roofline). MXTPU_FUSED_EPILOGUE=0 (trace-time flag, part
+# of every jit-cache key) falls back to the composed unfused lowering.
+# ---------------------------------------------------------------------------
+
+def _fused_epilogue_enabled() -> bool:
+    from ..base import env
+    return bool(env.get("MXTPU_FUSED_EPILOGUE"))
+
+
+def _fused_bn_act_impl(data, residual, gamma, beta, moving_mean, moving_var,
+                       eps, fix_gamma, use_global_stats, axis, _training):
+    jnp = _jnp()
+    ax = axis % data.ndim
+    g32 = jnp.ones(gamma.shape, jnp.float32) if fix_gamma \
+        else gamma.astype(jnp.float32)
+    b32 = beta.astype(jnp.float32)
+    is_float = jnp.issubdtype(data.dtype, jnp.floating)
+    if _training and not use_global_stats:
+        if ax == data.ndim - 1 and is_float and _fused_epilogue_enabled():
+            from .pallas_kernels import fused_bn_act
+            return fused_bn_act(data, residual, g32, b32, float(eps))
+        # composed fallback: exactly the unfused BatchNorm -> (add) ->
+        # ReLU chain, including the fp8-residual lowering of each piece
+        from . import resid8
+        rdt = resid8.resid_dtype() if is_float else None
+        core = _BN_CORE.get(rdt)
+        if core is None:
+            core = _BN_CORE[rdt] = _make_bn_core(rdt)
+        out, mean, var = core(data, g32, b32, ax, float(eps))
+        if residual is not None:
+            out = out + residual
+        return _activation(out, act_type="relu"), mean, var
+    # inference: moving stats, f32 registers, one fused elementwise chain
+    bshape = tuple(data.shape[ax] if i == ax else 1 for i in range(data.ndim))
+    mean = moving_mean.astype(jnp.float32)
+    var = moving_var.astype(jnp.float32)
+    inv = _lax().rsqrt(var + eps)
+    out = (data.astype(jnp.float32) - mean.reshape(bshape)) \
+        * (inv * g32).reshape(bshape) + b32.reshape(bshape)
+    if residual is not None:
+        out = out + residual.astype(jnp.float32)
+    return jnp.maximum(out, 0.0).astype(data.dtype), mean, var
+
+
+@register("_contrib_fused_bn_relu", num_outputs=3, aux_inputs=(3, 4))
+def _fused_bn_relu(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
+                   momentum=0.9, fix_gamma=False, use_global_stats=False,
+                   axis=-1, _training=False):
+    """Fused ``BatchNorm -> ReLU`` (returns (out, mean, var) like
+    BatchNorm; moving-stat update is the caller's, as everywhere)."""
+    return _fused_bn_act_impl(data, None, gamma, beta, moving_mean,
+                              moving_var, eps, fix_gamma, use_global_stats,
+                              axis, _training)
+
+
+@register("_contrib_fused_bn_add_relu", num_outputs=3, aux_inputs=(4, 5))
+def _fused_bn_add_relu(data, residual, gamma, beta, moving_mean, moving_var,
+                       eps=1e-5, momentum=0.9, fix_gamma=False,
+                       use_global_stats=False, axis=-1, _training=False):
+    """Fused ``BatchNorm -> add(residual) -> ReLU`` — the ResNet
+    bottleneck tail: relu(BN(conv(x)) + shortcut)."""
+    return _fused_bn_act_impl(data, residual, gamma, beta, moving_mean,
+                              moving_var, eps, fix_gamma, use_global_stats,
+                              axis, _training)
+
+
 @register("LayerNorm", aliases=("layer_norm",), num_outputs=3)
 def _layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
     jnp = _jnp()

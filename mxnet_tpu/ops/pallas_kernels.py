@@ -38,6 +38,17 @@ def _interpret_mode() -> bool:
     key = jax.default_backend()
     cached = _INTERPRET_CACHE.get(key)
     if cached is None:
+        try:
+            clean = jax.core.trace_state_clean()
+        except Exception:
+            clean = True
+        if not clean:
+            # Inside a trace the probe's pallas_call would be traced INTO
+            # the caller's program as a compiled-mode kernel (and fail at
+            # the caller's lowering on CPU backends) instead of compiling
+            # eagerly. Fall back to the platform heuristic WITHOUT
+            # caching; the next untraced call runs the real probe.
+            return key != "tpu"
         import jax.numpy as jnp
         from jax.experimental import pallas as pl
 
@@ -172,6 +183,289 @@ def _interleaved_qk(qkv, heads=1):
     q = x[:, :, :, 0].transpose(1, 2, 0, 3).reshape(b * heads, t, d)
     k = x[:, :, :, 1].transpose(1, 2, 0, 3).reshape(b * heads, t, d)
     return jnp.matmul(q, k.transpose(0, 2, 1)) / math.sqrt(d)
+
+
+# ---------------------------------------------------------------------------
+# Fused bottleneck epilogues: BatchNorm(+residual add)+ReLU consuming the
+# convolution output (the ResNet hot path — docs/perf.md roofline: the bf16
+# activations materialized BETWEEN the conv and its BN/ReLU/add epilogue are
+# the dominant HBM traffic of the train step). Two passes over the conv
+# output, both hand-tiled through VMEM:
+#   pass 1 (stats):  per-channel sum / sum-of-squares, f32 accumulators
+#   pass 2 (apply):  out = relu(norm(x) [+ residual]), written once
+# Backward mirrors it (custom_vjp): the ReLU mask is RE-DERIVED from the
+# saved output inside both backward passes, so the masked cotangent — an
+# activation-sized intermediate the unfused lowering materializes between
+# the ReLU backward and the BN reductions — never touches HBM.
+# Channel-last (NHWC) only: C rides the 128-lane minor dim.
+# ---------------------------------------------------------------------------
+
+_EPILOGUE_VMEM_BUDGET = 10 * 1024 * 1024  # leave headroom in ~16 MB VMEM
+
+
+def _epilogue_rows(r: int, c: int, n_bufs: int, interpret: bool,
+                   itemsize: int = 2) -> int:
+    """Row-block size for the (R, C) flattened activation.
+
+    Interpret mode runs one whole-array block (each grid step is a python
+    round-trip; correctness is identical and tests stay fast). Compiled
+    mode sizes the block so n_bufs double-buffered (BR, C) tiles fit the
+    VMEM budget, 8-row (sublane) aligned."""
+    if interpret:
+        return max(1, r)
+    per_row = max(c, 128) * itemsize  # lane-padded row
+    br = _EPILOGUE_VMEM_BUDGET // (2 * n_bufs * per_row)
+    br = max(8, min(1024, br - br % 8))
+    return max(1, min(br, r))
+
+
+def _row_mask(i, br, r, xb):
+    """Zero rows past R (the last block of a non-divisible grid reads
+    padding whose contents are unspecified)."""
+    import jax
+    import jax.numpy as jnp
+    rows = i * br + jax.lax.broadcasted_iota(jnp.int32, (br, 1), 0)
+    return jnp.where(rows < r, xb, 0.0)
+
+
+def _bn_stats_call(x2d, interpret):
+    """(R, C) -> (2, C) f32: per-channel [sum, sum of squares]."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    r, c = x2d.shape
+    br = _epilogue_rows(r, c, 1, interpret, x2d.dtype.itemsize)
+
+    def kernel(x_ref, acc_ref):
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        xb = _row_mask(i, br, r, x_ref[...].astype(jnp.float32))
+        acc_ref[0:1, :] += jnp.sum(xb, axis=0, keepdims=True)
+        acc_ref[1:2, :] += jnp.sum(xb * xb, axis=0, keepdims=True)
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((2, c), jnp.float32),
+        grid=(pl.cdiv(r, br),),
+        in_specs=[pl.BlockSpec((br, c), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((2, c), lambda i: (0, 0)),
+        interpret=interpret,
+    )(x2d)
+
+
+def _bn_apply_call(x2d, res2d, coef, interpret):
+    """out = relu(x * coef[0] + coef[1] [+ res]), written once."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    r, c = x2d.shape
+    has_res = res2d is not None
+    br = _epilogue_rows(r, c, 3 if has_res else 2, interpret,
+                        x2d.dtype.itemsize)
+
+    def kernel(*refs):
+        if has_res:
+            x_ref, res_ref, coef_ref, o_ref = refs
+        else:
+            x_ref, coef_ref, o_ref = refs
+        y = x_ref[...].astype(jnp.float32) * coef_ref[0:1, :] \
+            + coef_ref[1:2, :]
+        if has_res:
+            y = y + res_ref[...].astype(jnp.float32)
+        o_ref[...] = jnp.maximum(y, 0.0).astype(o_ref.dtype)
+
+    row_spec = pl.BlockSpec((br, c), lambda i: (i, 0))
+    coef_spec = pl.BlockSpec((2, c), lambda i: (0, 0))
+    in_specs = [row_spec, row_spec, coef_spec] if has_res \
+        else [row_spec, coef_spec]
+    args = (x2d, res2d, coef) if has_res else (x2d, coef)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((r, c), x2d.dtype),
+        grid=(pl.cdiv(r, br),),
+        in_specs=in_specs,
+        out_specs=row_spec,
+        interpret=interpret,
+    )(*args)
+
+
+def _bn_bwd_stats_call(dy2d, out2d, x2d, coef, interpret):
+    """(2, C) f32 per-channel [sum g, sum g*xhat] with g = relu-masked dy
+    (mask from the saved output — no materialized masked cotangent) and
+    xhat = (x - coef[0]) * coef[1]."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    r, c = x2d.shape
+    br = _epilogue_rows(r, c, 3, interpret, x2d.dtype.itemsize)
+
+    def kernel(dy_ref, out_ref, x_ref, coef_ref, acc_ref):
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        dyb = dy_ref[...].astype(jnp.float32)
+        g = jnp.where(out_ref[...] > 0, dyb, 0.0)
+        g = _row_mask(i, br, r, g)
+        xhat = (x_ref[...].astype(jnp.float32) - coef_ref[0:1, :]) \
+            * coef_ref[1:2, :]
+        acc_ref[0:1, :] += jnp.sum(g, axis=0, keepdims=True)
+        # mask the PRODUCT (where() selects, so Inf/NaN decoded from the
+        # last block's unspecified padding rows cannot produce 0*Inf=NaN
+        # in the accumulator — g alone being 0 there is not enough)
+        acc_ref[1:2, :] += jnp.sum(_row_mask(i, br, r, g * xhat),
+                                   axis=0, keepdims=True)
+
+    row_spec = pl.BlockSpec((br, c), lambda i: (i, 0))
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((2, c), jnp.float32),
+        grid=(pl.cdiv(r, br),),
+        in_specs=[row_spec, row_spec, row_spec,
+                  pl.BlockSpec((2, c), lambda i: (0, 0))],
+        out_specs=pl.BlockSpec((2, c), lambda i: (0, 0)),
+        interpret=interpret,
+    )(dy2d, out2d, x2d, coef)
+
+
+def _bn_bwd_apply_call(dy2d, out2d, x2d, coef, has_res, interpret):
+    """dx = coef[2] * (g - coef[3] - xhat * coef[4]); g re-derived from the
+    saved output in-kernel; dres (the residual branch cotangent) is g,
+    emitted as a second output of the SAME pass."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    r, c = x2d.shape
+    br = _epilogue_rows(r, c, 5 if has_res else 4, interpret,
+                        x2d.dtype.itemsize)
+
+    def kernel(*refs):
+        if has_res:
+            dy_ref, out_ref, x_ref, coef_ref, dx_ref, dres_ref = refs
+        else:
+            dy_ref, out_ref, x_ref, coef_ref, dx_ref = refs
+        g = jnp.where(out_ref[...] > 0, dy_ref[...].astype(jnp.float32),
+                      0.0)
+        xhat = (x_ref[...].astype(jnp.float32) - coef_ref[0:1, :]) \
+            * coef_ref[1:2, :]
+        dx = coef_ref[2:3, :] * (g - coef_ref[3:4, :]
+                                 - xhat * coef_ref[4:5, :])
+        dx_ref[...] = dx.astype(dx_ref.dtype)
+        if has_res:
+            dres_ref[...] = g.astype(dres_ref.dtype)
+
+    row_spec = pl.BlockSpec((br, c), lambda i: (i, 0))
+    out_shape = jax.ShapeDtypeStruct((r, c), x2d.dtype)
+    out_shapes = (out_shape, out_shape) if has_res else out_shape
+    out_specs = (row_spec, row_spec) if has_res else row_spec
+    return pl.pallas_call(
+        kernel,
+        out_shape=out_shapes,
+        grid=(pl.cdiv(r, br),),
+        in_specs=[row_spec, row_spec, row_spec,
+                  pl.BlockSpec((5, c), lambda i: (0, 0))],
+        out_specs=out_specs,
+        interpret=interpret,
+    )(dy2d, out2d, x2d, coef)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_fused_bn_act(eps: float, has_res: bool, interpret: bool):
+    """Training-mode fused BN(+add)+ReLU over (R, C) channel-last data
+    with a hand-fused backward (jax.custom_vjp).
+
+    Residuals saved for backward: the bf16 input x (conv output), the
+    bf16 output (already materialized for the next layer — XLA CSEs the
+    two into one buffer) and the per-channel mean/inv/gamma vectors.
+    Returns (out, mean, var); mean/var feed running-stat updates only
+    (stop-gradient, like the unfused BatchNorm)."""
+    import jax
+    import jax.numpy as jnp
+
+    def run_fwd(x2d, res2d, g32, beta32):
+        n = float(x2d.shape[0])
+        sums = _bn_stats_call(x2d, interpret)
+        mean = sums[0] / n
+        var = jnp.maximum(sums[1] / n - mean * mean, 0.0)
+        inv = jax.lax.rsqrt(var + eps)
+        scale = inv * g32
+        coef = jnp.stack([scale, beta32 - mean * scale])
+        out2d = _bn_apply_call(x2d, res2d, coef, interpret)
+        return out2d, mean, var, inv
+
+    def run_bwd(x2d, out2d, mean, inv, g32, dy2d):
+        n = float(x2d.shape[0])
+        sums = _bn_bwd_stats_call(dy2d, out2d, x2d,
+                                  jnp.stack([mean, inv]), interpret)
+        sum_g, sum_gxhat = sums[0], sums[1]
+        coef = jnp.stack([mean, inv, g32 * inv, sum_g / n,
+                          sum_gxhat / n])
+        outs = _bn_bwd_apply_call(dy2d, out2d, x2d, coef, has_res,
+                                  interpret)
+        return outs, sum_g, sum_gxhat
+
+    if has_res:
+        @jax.custom_vjp
+        def f(x2d, res2d, g32, beta32):
+            out2d, mean, var, _ = run_fwd(x2d, res2d, g32, beta32)
+            return out2d, mean, var
+
+        def fwd(x2d, res2d, g32, beta32):
+            out2d, mean, var, inv = run_fwd(x2d, res2d, g32, beta32)
+            return (out2d, mean, var), (x2d, out2d, mean, inv, g32)
+
+        def bwd(res, cots):
+            x2d, out2d, mean, inv, g32 = res
+            (dx, dres), sum_g, sum_gxhat = run_bwd(x2d, out2d, mean, inv,
+                                                   g32, cots[0])
+            return dx, dres, sum_gxhat, sum_g
+    else:
+        @jax.custom_vjp
+        def f(x2d, g32, beta32):
+            out2d, mean, var, _ = run_fwd(x2d, None, g32, beta32)
+            return out2d, mean, var
+
+        def fwd(x2d, g32, beta32):
+            out2d, mean, var, inv = run_fwd(x2d, None, g32, beta32)
+            return (out2d, mean, var), (x2d, out2d, mean, inv, g32)
+
+        def bwd(res, cots):
+            x2d, out2d, mean, inv, g32 = res
+            dx, sum_g, sum_gxhat = run_bwd(x2d, out2d, mean, inv, g32,
+                                           cots[0])
+            return dx, sum_gxhat, sum_g
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def fused_bn_act(data, residual, gamma32, beta32, eps):
+    """Fused training-mode ``BatchNorm [+ add(residual)] + ReLU`` epilogue.
+
+    ``data``: channel-LAST activation (the conv output); ``residual``:
+    same shape or None; ``gamma32``/``beta32``: f32 ``(C,)`` vectors.
+    Returns ``(out, mean, var)`` with out in data's dtype and f32 batch
+    stats. Dispatches compiled Pallas on TPU, interpret mode elsewhere
+    (same code path, so CPU tests exercise the real kernels)."""
+    c = data.shape[-1]
+    x2d = data.reshape(-1, c)
+    interpret = _interpret_for(data)
+    f = _build_fused_bn_act(float(eps), residual is not None, interpret)
+    if residual is not None:
+        out2d, mean, var = f(x2d, residual.reshape(-1, c), gamma32, beta32)
+    else:
+        out2d, mean, var = f(x2d, gamma32, beta32)
+    return out2d.reshape(data.shape), mean, var
 
 
 @register("_contrib_interleaved_matmul_selfatt_valatt")

@@ -228,7 +228,8 @@ def _trace_time_flags() -> Tuple:
     return (bool(env.get("MXNET_SAFE_ACCUMULATION")),
             env.get("MXNET_RESID_DTYPE") or "",
             env.get("MXNET_CONV_COMPUTE") or "",
-            float(env.get("MXNET_CONV_INT8_RANGE")))
+            float(env.get("MXNET_CONV_INT8_RANGE")),
+            bool(env.get("MXTPU_FUSED_EPILOGUE")))
 
 
 def invoke_jax(opdef: OpDef, arrays: Sequence, params: Dict[str, Any]):

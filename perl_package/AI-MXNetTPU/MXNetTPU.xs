@@ -73,11 +73,14 @@ static void croak_on(pTHX_ int rc, const char *what) {
 }
 
 /* copy an AV of IV handles into a malloc'd array (caller frees); the
- * terminating extra slot keeps zero-length allocations valid */
+ * terminating extra slot keeps zero-length allocations valid. Returns
+ * NULL on OOM (no croak: call sites holding other allocations must be
+ * able to free them first). */
 static NDArrayHandle *av_to_handles(pTHX_ AV *av) {
   size_t n = av_count(av), i;
   NDArrayHandle *h =
       (NDArrayHandle *)malloc((n + 1) * sizeof(NDArrayHandle));
+  if (h == NULL) return NULL;
   for (i = 0; i < n; ++i) {
     SV **e = av_fetch(av, i, 0);
     h[i] = e ? INT2PTR(NDArrayHandle, SvIV(*e)) : NULL;
@@ -85,10 +88,13 @@ static NDArrayHandle *av_to_handles(pTHX_ AV *av) {
   return h;
 }
 
+/* single-allocation sites only: croaks on OOM (nothing else to free) */
 static size_t av_to_floats(pTHX_ AV *av, float **out) {
   size_t n = av_count(av);
-  float *buf = (float *)malloc(n * sizeof(float));
+  float *buf = (float *)malloc((n + 1) * sizeof(float));
   size_t i;
+  if (buf == NULL) croak("av_to_floats: out of memory (%lu floats)",
+                         (unsigned long)n);
   for (i = 0; i < n; ++i) {
     SV **e = av_fetch(av, i, 0);
     buf[i] = e ? (float)SvNV(*e) : 0.0f;
@@ -110,6 +116,7 @@ static float *read_handle(void *h, size_t *out_n) {
   if (MXNDArrayGetShape(h, &ndim, &shape) != 0) return NULL;
   for (i = 0; i < ndim; ++i) n *= shape[i];
   buf = (float *)malloc(n * sizeof(float));
+  if (buf == NULL) return NULL;
   if (MXNDArraySyncCopyToCPU(h, buf, n) != 0) { free(buf); return NULL; }
   *out_n = n;
   return buf;
@@ -315,6 +322,7 @@ nd_values(h)
              "MXNDArrayGetShape");
     for (i = 0; i < ndim; ++i) n *= shape[i];
     buf = (float *)malloc(n * sizeof(float));
+    if (buf == NULL) croak("nd_values: out of memory");
     if (MXNDArraySyncCopyToCPU(INT2PTR(NDArrayHandle, h), buf, n) != 0) {
       free(buf);
       croak("MXNDArraySyncCopyToCPU failed: %s", MXGetLastError());
@@ -341,6 +349,10 @@ invoke(op, in_av, key_av, val_av)
     const char **vals = (const char **)malloc((n_p + 1) * sizeof(char *));
     NDArrayHandle *outs = NULL;
     int n_out = 0, rc;
+    if (ins == NULL || keys == NULL || vals == NULL) {
+      free(ins); free(keys); free(vals);
+      croak("invoke: out of memory");
+    }
     for (i = 0; i < n_p; ++i) {
       SV **k = av_fetch(key_av, i, 0);
       SV **v = av_fetch(val_av, i, 0);
@@ -397,11 +409,20 @@ invoke_into(op, in_av, key_av, val_av, out_av)
   {
     size_t n_in = av_count(in_av), n_p = av_count(key_av);
     size_t n_out_req = av_count(out_av), i;
-    NDArrayHandle *ins = av_to_handles(aTHX_ in_av);
-    NDArrayHandle *outs = av_to_handles(aTHX_ out_av);
-    const char **keys = (const char **)malloc((n_p + 1) * sizeof(char *));
-    const char **vals = (const char **)malloc((n_p + 1) * sizeof(char *));
+    NDArrayHandle *ins, *outs;
+    const char **keys, **vals;
     int n_out = (int)n_out_req, rc;
+    if (n_out_req == 0)
+      croak("invoke_into: out_av is empty — the preallocated-output "
+            "contract requires n_out > 0");
+    ins = av_to_handles(aTHX_ in_av);
+    outs = av_to_handles(aTHX_ out_av);
+    keys = (const char **)malloc((n_p + 1) * sizeof(char *));
+    vals = (const char **)malloc((n_p + 1) * sizeof(char *));
+    if (ins == NULL || outs == NULL || keys == NULL || vals == NULL) {
+      free(ins); free(outs); free(keys); free(vals);
+      croak("invoke_into: out of memory");
+    }
     for (i = 0; i < n_p; ++i) {
       SV **k = av_fetch(key_av, i, 0);
       SV **v = av_fetch(val_av, i, 0);
@@ -457,7 +478,9 @@ mark_variables(av)
   {
     size_t n = av_count(av);
     NDArrayHandle *vars = av_to_handles(aTHX_ av);
-    int rc = MXAutogradMarkVariables((mx_uint)n, vars);
+    int rc;
+    if (vars == NULL) croak("mark_variables: out of memory");
+    rc = MXAutogradMarkVariables((mx_uint)n, vars);
     free(vars);
     croak_on(aTHX_ rc, "MXAutogradMarkVariables");
   }
@@ -553,6 +576,7 @@ pred_output(h, index)
              "MXPredGetOutputShape");
     for (i = 0; i < ndim; ++i) n *= shape[i];
     buf = (float *)malloc(n * sizeof(float));
+    if (buf == NULL) croak("pred_output: out of memory");
     if (MXPredGetOutput(INT2PTR(PredictorHandle, h), (mx_uint)index, buf,
                         (mx_uint)n) != 0) {
       free(buf);

@@ -1041,6 +1041,9 @@ COVERED_ELSEWHERE = {
     **{op: "tests/test_pallas_ops.py" for op in [
         "_contrib_flash_attention", "_contrib_interleaved_matmul_selfatt_qk",
         "_contrib_interleaved_matmul_selfatt_valatt"]},
+    # pallas fused conv epilogues (fwd+grad parity, fallback, fold)
+    **{op: "tests/test_fused_epilogue.py" for op in [
+        "_contrib_fused_bn_relu", "_contrib_fused_bn_add_relu"]},
     # symbolic control flow + graph-level sparse ops
     **{op: "tests/test_symbol_control_flow.py" for op in [
         "_foreach", "_while_loop", "_cond", "cast_storage",

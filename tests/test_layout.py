@@ -200,6 +200,35 @@ def test_onnx_export_rejects_channel_last(tmp_path):
             onnx_file_path=str(tmp_path / "x.onnx"))
 
 
+def test_fused_epilogue_path_stays_nhwc():
+    """The fused BN(+add)+ReLU epilogue ops consume and produce NHWC
+    directly — no transpose may appear anywhere in their lowering
+    (fwd or bwd); C stays on the lane-minor dim end to end."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops import registry as reg
+    fn = reg.get_op("_contrib_fused_bn_add_relu").fn
+    n, h, w, c = 2, 6, 6, 8
+    x = jnp.zeros((n, h, w, c), jnp.bfloat16)
+    r = jnp.zeros((n, h, w, c), jnp.bfloat16)
+    g = jnp.ones((c,), jnp.float32)
+    b = jnp.zeros((c,), jnp.float32)
+    mm, mv = jnp.zeros((c,)), jnp.ones((c,))
+
+    def train_step(x, r, g, b):
+        out, _, _ = fn(x, r, g, b, mm, mv, eps=1e-5, axis=-1,
+                       _training=True)
+        return out
+
+    out_shape = jax.eval_shape(train_step, x, r, g, b)
+    assert out_shape.shape == (n, h, w, c)        # NHWC in, NHWC out
+    fwd_bwd = str(jax.make_jaxpr(
+        lambda x, r: jax.vjp(train_step, x, r, g, b)[1](
+            jnp.ones((n, h, w, c), jnp.bfloat16)))(x, r))
+    assert "transpose" not in fwd_bwd, \
+        "fused epilogue lowering re-layouts the activation"
+
+
 @pytest.mark.parametrize("ctor_name", ["resnet18_v1", "resnet50_v1",
                                        "resnet18_v2"])
 def test_resnet_nhwc_variant(ctor_name):
