@@ -467,6 +467,7 @@ def _backward_impl(heads, head_grads, retain_graph, train_mode_flag,
                                            g.shape), g)
                 data, uniq = g.compact()
                 gbuf._update(data.astype(gbuf._data.dtype), uniq)
+                gbuf._fresh_grad = True
                 continue
             if isinstance(g, _RspGrad):
                 g = g.densify()
@@ -474,6 +475,7 @@ def _backward_impl(heads, head_grads, retain_graph, train_mode_flag,
                 gbuf._rebind(gbuf._data + g)
             else:
                 gbuf._rebind(g.astype(gbuf._data.dtype))
+            gbuf._fresh_grad = True
 
     if not retain_graph:
         for node in order:

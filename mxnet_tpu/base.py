@@ -112,6 +112,23 @@ env.declare("MXNET_SAFE_ACCUMULATION", bool, False,
 env.declare("MXNET_IS_RECOVERY", bool, False,
             "Set by the relauncher on restarted nodes; read by "
             "mx.fault.is_recovery().")
+env.declare("MXTPU_CHAOS", str, "",
+            "Deterministic fault-injection plan for resilience testing, "
+            "e.g. 'nan_grad@12,kill@40,ckpt_corrupt@latest,kv_flake:0.2' "
+            "(contrib/chaos.py grammar; hooks in trainer/kvstore/fault).")
+env.declare("MXTPU_CHAOS_SEED", int, 0,
+            "Seed for the chaos plan's RNG (kv_flake rolls) so injected "
+            "failure sequences replay identically.")
+env.declare("MXNET_KV_RETRY_MAX", int, 3,
+            "Bounded retries (exponential backoff) around kvstore "
+            "push/pull on TransientKVError before giving up.")
+env.declare("MXNET_KV_RETRY_BASE_MS", float, 50.0,
+            "Backoff base for kvstore push/pull retries: attempt n sleeps "
+            "base * 2**(n-1) milliseconds.")
+env.declare("MXTPU_RESUMABLE_EXIT_CODE", int, 75,
+            "Exit code FitLoop uses after a SIGTERM/SIGINT-triggered final "
+            "checkpoint (default 75 = EX_TEMPFAIL), so the relauncher can "
+            "tell 'resume me' from a real failure.")
 env.declare("MXNET_STORAGE_FALLBACK_LOG_VERBOSE", bool, True,
             "Warn when an op without a sparse kernel densifies its inputs "
             "(storage fallback).")

@@ -5,6 +5,7 @@ from . import quantization  # noqa: F401
 from . import text  # noqa: F401
 from . import svrg_optimization  # noqa: F401
 from . import onnx  # noqa: F401
+from . import chaos  # noqa: F401
 
 # surface on mx.nd.contrib like the reference; mx.sym.contrib carries the
 # SYMBOLIC control-flow builders (symbol/control_flow.py), installed by

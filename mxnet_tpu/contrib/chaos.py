@@ -1,0 +1,243 @@
+"""Deterministic fault injection for resilience testing.
+
+The reference stack *survives* worker death (ps-lite heartbeats +
+restart-from-checkpoint, kvstore_dist.h GetDeadNodes/is_recovery) but never
+*proves* it: nothing in the tree injects the failures the recovery code
+claims to handle. This module turns every robustness claim into a test.
+
+A :class:`ChaosPlan` is a deterministic schedule of faults, parsed from the
+``MXTPU_CHAOS`` env var (or installed programmatically)::
+
+    MXTPU_CHAOS=nan_grad@12,kill@40,ckpt_corrupt@latest,kv_flake:0.2
+
+Grammar: comma-separated events, each ``kind[:prob][@target]``:
+
+- ``nan_grad@S`` / ``inf_grad@S`` — poison one parameter gradient with
+  NaN/Inf at step ``S`` (hook: ``gluon.Trainer.step`` and ``fit.FitLoop``).
+- ``kill@S`` — abrupt simulated worker death at step ``S``: raises
+  :class:`ChaosKilled` with nothing flushed (hook: ``fit.FitLoop``).
+- ``preempt@S`` — simulated TPU preemption at step ``S``: delivers SIGTERM
+  to this process, exercising the graceful final-checkpoint exit path.
+- ``ckpt_corrupt@latest`` / ``ckpt_corrupt@S`` — flip bytes inside the
+  ``params`` file of the next completed checkpoint (/ of checkpoint ``S``)
+  *after* its DONE marker lands: a forged-complete corrupt checkpoint,
+  exactly what ``CheckpointManager.verify`` + quarantine must catch
+  (hook: ``fault.CheckpointManager._write``).
+- ``kv_flake:P`` — every kvstore push/pull raises
+  :class:`~mxnet_tpu.kvstore.TransientKVError` with probability ``P``
+  (seeded RNG, ``MXTPU_CHAOS_SEED``), exercising the bounded
+  retry-with-backoff (hook: ``kvstore.KVStoreBase.push/pull``).
+
+Step-scheduled events fire on the plan's step clock, advanced exactly once
+per training step by the loop owner (``FitLoop`` and ``Trainer.step`` both
+call :meth:`ChaosPlan.begin_step`); each fires once and is consumed. All
+randomness comes from one seeded ``random.Random`` so a plan replays
+identically — chaos runs are regression tests, not flake generators.
+"""
+from __future__ import annotations
+
+import os
+import random
+import signal
+from typing import Dict, Optional, Set
+
+from ..base import MXNetError, env
+
+__all__ = ["ChaosKilled", "ChaosPlan", "install", "uninstall", "active"]
+
+
+class ChaosKilled(MXNetError):
+    """Simulated abrupt worker death (``kill@step``): the process 'dies'
+    with nothing flushed. Deliberately NOT caught by FitLoop — recovery is
+    restart + ``restore_latest``, same as a real kill -9."""
+
+    def __init__(self, step: int):
+        super().__init__(f"chaos: simulated worker death at step {step}")
+        self.step = step
+
+
+_KINDS = ("nan_grad", "inf_grad", "kill", "preempt", "ckpt_corrupt",
+          "kv_flake")
+
+
+class ChaosPlan:
+    """Parsed, deterministic fault schedule. See module docstring for the
+    grammar."""
+
+    def __init__(self, spec: str = "", seed: Optional[int] = None,
+                 _env_spec: Optional[str] = None):
+        if seed is None:
+            seed = int(env.get("MXTPU_CHAOS_SEED"))
+        self._rng = random.Random(seed)
+        self._env_spec = _env_spec
+        self._step: Optional[int] = None
+        self._at: Dict[str, Set[int]] = {k: set() for k in _KINDS}
+        self._ckpt_latest = False
+        self.kv_flake_p = 0.0
+        # observability: how many of each fault actually fired
+        self.injected: Dict[str, int] = {k: 0 for k in _KINDS}
+        for tok in (spec or "").split(","):
+            tok = tok.strip()
+            if not tok:
+                continue
+            self._parse_token(tok)
+
+    def _parse_token(self, tok: str) -> None:
+        target: Optional[str] = None
+        if "@" in tok:
+            tok, target = tok.split("@", 1)
+        prob: Optional[str] = None
+        if ":" in tok:
+            tok, prob = tok.split(":", 1)
+        kind = tok.strip()
+        if kind not in _KINDS:
+            raise MXNetError(f"chaos: unknown event kind {kind!r} "
+                             f"(known: {', '.join(_KINDS)})")
+        if kind == "kv_flake":
+            if target is not None:
+                raise MXNetError("chaos: kv_flake takes no step target "
+                                 "(it flakes every push/pull attempt)")
+            if prob is None:
+                raise MXNetError("chaos: kv_flake needs a probability, "
+                                 "e.g. kv_flake:0.2")
+            p = float(prob)
+            if not 0.0 <= p <= 1.0:
+                raise MXNetError(f"chaos: kv_flake probability {p} "
+                                 "outside [0, 1]")
+            self.kv_flake_p = p
+            return
+        if prob is not None:
+            raise MXNetError(f"chaos: {kind} takes no probability")
+        if target is None:
+            raise MXNetError(f"chaos: {kind} needs a step target, "
+                             f"e.g. {kind}@12")
+        if kind == "ckpt_corrupt" and target.strip() == "latest":
+            self._ckpt_latest = True
+            return
+        try:
+            self._at[kind].add(int(target))
+        except ValueError:
+            raise MXNetError(
+                f"chaos: bad target {target!r} for {kind} "
+                "(expected an integer step"
+                + (" or 'latest'" if kind == "ckpt_corrupt" else "") + ")")
+
+    # -- step clock -----------------------------------------------------
+    def begin_step(self, step: int) -> None:
+        """Advance the plan's step clock; called once per training step by
+        the loop owner (FitLoop)."""
+        self._step = int(step)
+
+    def should(self, kind: str) -> bool:
+        """True iff a ``kind`` event is scheduled at the current step.
+        Consumes the event (fires once)."""
+        if self._step is None or self._step not in self._at[kind]:
+            return False
+        self._at[kind].discard(self._step)
+        self.injected[kind] += 1
+        return True
+
+    # -- injection actions ----------------------------------------------
+    def maybe_kill(self) -> None:
+        """kill@step -> raise ChaosKilled; preempt@step -> SIGTERM to self
+        (the TPU-preemption signal, caught by FitLoop's handler)."""
+        if self.should("kill"):
+            raise ChaosKilled(self._step)
+        if self.should("preempt"):
+            signal.raise_signal(signal.SIGTERM)
+
+    def poison_grads(self, params) -> bool:
+        """nan_grad/inf_grad@step: overwrite the first trainable
+        parameter's gradient with NaN (resp. Inf), simulating an overflowed
+        backward. Returns True when poison was applied."""
+        fill = None
+        if self.should("nan_grad"):
+            fill = float("nan")
+        elif self.should("inf_grad"):
+            fill = float("inf")
+        if fill is None:
+            return False
+        import jax.numpy as jnp
+        for p in params:
+            if getattr(p, "grad_req", "null") == "null" or p._grad is None:
+                continue
+            g = p.grad()
+            g._rebind(jnp.full(g.shape, fill, g._data.dtype))
+            return True
+        return False
+
+    def kv_maybe_fail(self, op: str, key) -> None:
+        """kv_flake:P — raise TransientKVError with probability P on each
+        push/pull attempt (retries re-roll, so a retry loop eventually
+        succeeds for P < 1)."""
+        if self.kv_flake_p and self._rng.random() < self.kv_flake_p:
+            self.injected["kv_flake"] += 1
+            from ..kvstore import TransientKVError
+            raise TransientKVError(
+                f"chaos: injected transient {op} failure (key={key!r})")
+
+    def on_checkpoint_complete(self, step: int, path: str) -> None:
+        """ckpt_corrupt — called by CheckpointManager._write after the DONE
+        marker lands; corrupts the params payload while leaving DONE and the
+        manifest intact (a forged-complete checkpoint)."""
+        if self._ckpt_latest:
+            self._ckpt_latest = False
+        elif step in self._at["ckpt_corrupt"]:
+            self._at["ckpt_corrupt"].discard(step)
+        else:
+            return
+        self.injected["ckpt_corrupt"] += 1
+        corrupt_file(os.path.join(path, "params"))
+
+
+def corrupt_file(path: str, nbytes: int = 64) -> None:
+    """Flip a run of bytes in the middle of ``path`` (size preserved, so
+    only content verification — not a length check — can catch it)."""
+    with open(path, "r+b") as f:
+        f.seek(0, os.SEEK_END)
+        size = f.tell()
+        if size == 0:
+            f.write(b"\xff")
+            return
+        start = size // 2
+        n = min(nbytes, size - start)
+        f.seek(start)
+        chunk = f.read(n)
+        f.seek(start)
+        f.write(bytes(b ^ 0xFF for b in chunk))
+
+
+_plan: Optional[ChaosPlan] = None
+
+
+def install(plan) -> ChaosPlan:
+    """Install a plan (a ChaosPlan or a spec string) programmatically."""
+    global _plan
+    if isinstance(plan, str):
+        plan = ChaosPlan(plan)
+    if not isinstance(plan, ChaosPlan):
+        raise MXNetError(f"chaos.install needs a ChaosPlan or spec string, "
+                         f"got {type(plan).__name__}")
+    _plan = plan
+    return plan
+
+
+def uninstall() -> None:
+    global _plan
+    _plan = None
+
+
+def active() -> Optional[ChaosPlan]:
+    """The installed plan, auto-installing from ``MXTPU_CHAOS`` when set.
+    An env-installed plan is dropped/reparsed when the env var changes
+    (keeps monkeypatched tests honest); a programmatic plan sticks until
+    :func:`uninstall`."""
+    global _plan
+    spec = os.environ.get("MXTPU_CHAOS") or None
+    if _plan is not None:
+        if _plan._env_spec is not None and spec != _plan._env_spec:
+            _plan = ChaosPlan(spec, _env_spec=spec) if spec else None
+        return _plan
+    if spec:
+        _plan = ChaosPlan(spec, _env_spec=spec)
+    return _plan

@@ -17,6 +17,7 @@ change mid-program, so the TPU-native story (SURVEY §5.3 design note) is:
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import threading
@@ -24,9 +25,20 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from .base import MXNetError, check
+from .log import get_logger
 from . import ndarray as nd
 
-__all__ = ["Heartbeat", "dead_nodes", "is_recovery", "CheckpointManager"]
+__all__ = ["Heartbeat", "dead_nodes", "is_recovery", "CheckpointManager",
+           "CheckpointCorruptError"]
+
+_LOG = get_logger("mxnet_tpu.fault")
+
+
+class CheckpointCorruptError(MXNetError):
+    """A checkpoint failed content verification (manifest hash mismatch,
+    truncated/unreadable payload, missing file). ``restore_latest``
+    quarantines such checkpoints and falls back to the newest one that
+    verifies; a direct ``restore(step)`` surfaces it to the caller."""
 
 
 def _hb_path(dir_path: str, rank: int) -> str:
@@ -261,6 +273,16 @@ class CheckpointManager:
             meta.update(extra)
         with open(os.path.join(tmp, "meta.json"), "w") as f:
             json.dump(meta, f)
+        # per-file SHA-256 manifest, verified on restore: a DONE marker
+        # alone proves the writer got to the end, not that the bytes on
+        # disk are the bytes it wrote (torn write, forged DONE, bit rot)
+        manifest = {}
+        for name in sorted(os.listdir(tmp)):
+            fpath = os.path.join(tmp, name)
+            manifest[name] = {"sha256": _sha256_file(fpath),
+                              "bytes": os.path.getsize(fpath)}
+        with open(os.path.join(tmp, "manifest.json"), "w") as f:
+            json.dump(manifest, f)
         with open(os.path.join(tmp, "DONE"), "w") as f:
             f.write("ok")
         if os.path.isdir(path):
@@ -268,6 +290,10 @@ class CheckpointManager:
             shutil.rmtree(path)
         os.replace(tmp, path)
         self._prune()
+        from .contrib import chaos
+        plan = chaos.active()
+        if plan is not None:
+            plan.on_checkpoint_complete(int(step), path)
 
     def _prune(self) -> None:
         # _steps_nowait: _prune runs INSIDE the engine write task when
@@ -281,43 +307,135 @@ class CheckpointManager:
         steps = self.steps()
         return steps[-1] if steps else None
 
-    def restore(self, step: int, net=None, trainer=None
+    def verify(self, step: int) -> None:
+        """Check checkpoint ``step`` against its SHA-256 manifest; raises
+        :class:`CheckpointCorruptError` on any mismatch/missing file.
+        Pre-manifest checkpoints (no ``manifest.json``) are accepted as
+        legacy — they carry no content proof to check."""
+        path = self._ckpt_dir(step)
+        if not os.path.exists(os.path.join(path, "DONE")):
+            raise CheckpointCorruptError(
+                f"checkpoint {step} is missing or incomplete (no DONE)")
+        man_path = os.path.join(path, "manifest.json")
+        if not os.path.exists(man_path):
+            return  # legacy checkpoint: nothing to verify against
+        try:
+            with open(man_path) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError) as e:
+            raise CheckpointCorruptError(
+                f"checkpoint {step}: unreadable manifest: {e}") from e
+        for name, rec in manifest.items():
+            fpath = os.path.join(path, name)
+            if not os.path.exists(fpath):
+                raise CheckpointCorruptError(
+                    f"checkpoint {step}: file {name!r} listed in manifest "
+                    "is missing")
+            if os.path.getsize(fpath) != rec["bytes"] or \
+                    _sha256_file(fpath) != rec["sha256"]:
+                raise CheckpointCorruptError(
+                    f"checkpoint {step}: file {name!r} fails content "
+                    "verification (size/sha256 mismatch with manifest)")
+
+    def _quarantine(self, step: int, reason: str = "") -> str:
+        """Rename a corrupt/incomplete checkpoint to ``ckpt-<step>.bad``
+        (suffixed if taken) so it is never restored again but stays on
+        disk for post-mortem."""
+        path = self._ckpt_dir(step)
+        bad = path + ".bad"
+        i = 0
+        while os.path.exists(bad):
+            i += 1
+            bad = f"{path}.bad{i}"
+        os.replace(path, bad)
+        _LOG.warning("quarantined corrupt checkpoint %s -> %s (%s)",
+                     path, bad, reason)
+        return bad
+
+    def restore(self, step: int, net=None, trainer=None,
+                allow_missing: bool = False
                 ) -> Tuple[int, Dict[str, "nd.NDArray"], dict]:
-        """Load checkpoint ``step``; when ``net``/``trainer`` are given,
-        their parameters/optimizer states are set in place."""
+        """Load checkpoint ``step`` (content-verified against its
+        manifest); when ``net``/``trainer`` are given, their
+        parameters/optimizer states are set in place.
+
+        The net restore is strict in BOTH directions: checkpoint keys
+        missing from the net raise, and net parameters absent from the
+        checkpoint raise too (they would silently keep their current
+        values) — pass ``allow_missing=True` to opt out of the latter."""
         self.wait()  # fence pending async writes
         path = self._ckpt_dir(step)
-        check(os.path.exists(os.path.join(path, "DONE")),
-              f"checkpoint {step} is missing or incomplete")
-        params = nd.load(os.path.join(path, "params"))
-        with open(os.path.join(path, "meta.json")) as f:
-            meta = json.load(f)
+        self.verify(step)  # typed CheckpointCorruptError on missing/bad
+        try:
+            params = nd.load(os.path.join(path, "params"))
+            with open(os.path.join(path, "meta.json")) as f:
+                meta = json.load(f)
+        except MXNetError:
+            raise
+        except Exception as e:
+            # legacy (manifest-less) checkpoints can still be truncated;
+            # surface it as corruption so restore_latest quarantines it
+            raise CheckpointCorruptError(
+                f"checkpoint {step}: payload unreadable: {e}") from e
         if net is not None:
             # structural names first (instance-independent, the save(net=)
             # format), falling back to collect_params naming; unmatched
-            # keys are an error, not a silent skip
+            # keys are an error, not a silent skip. BOTH key-set checks
+            # run before any set_data so a failed restore leaves the net
+            # untouched, never half-restored.
             structural = net._collect_params_with_prefix()
             flat = net.collect_params()
+            assign = []
             for k, v in params.items():
                 if k in structural:
-                    structural[k].set_data(v)
+                    assign.append((structural[k], v))
                 elif k in flat:
-                    flat[k].set_data(v)
+                    assign.append((flat[k], v))
                 else:
                     raise MXNetError(
                         f"checkpoint parameter {k!r} not found in net "
                         f"(known: {sorted(structural)[:5]}...)")
+            if not allow_missing:
+                covered = {id(p) for p, _ in assign}
+                stale = [k for k, p in structural.items()
+                         if id(p) not in covered]
+                if stale:
+                    raise MXNetError(
+                        f"net parameters absent from checkpoint {step} "
+                        f"would keep their current values: {stale[:8]}"
+                        f"{'...' if len(stale) > 8 else ''} — pass "
+                        "allow_missing=True to accept a partial restore")
+            for p, v in assign:
+                p.set_data(v)
         tr_path = os.path.join(path, "trainer")
         if trainer is not None and os.path.exists(tr_path):
             trainer.load_states(tr_path)
         return int(meta["step"]), params, meta
 
-    def restore_latest(self, net=None, trainer=None
+    def restore_latest(self, net=None, trainer=None,
+                       allow_missing: bool = False
                        ) -> Optional[Tuple[int, Dict, dict]]:
         """Resume point for restart-based recovery: returns None on a
-        fresh start, else (step, params, meta) of the newest complete
-        checkpoint (optionally loading net/trainer in place)."""
-        step = self.latest()
-        if step is None:
-            return None
-        return self.restore(step, net=net, trainer=trainer)
+        fresh start, else (step, params, meta) of the newest checkpoint
+        that passes content verification (optionally loading net/trainer
+        in place). Corrupt/incomplete checkpoints are quarantined
+        (renamed ``ckpt-<step>.bad``) and the next-newest is tried —
+        a truncated latest never takes down recovery."""
+        self.wait()
+        for step in reversed(self._steps_nowait()):
+            try:
+                return self.restore(step, net=net, trainer=trainer,
+                                    allow_missing=allow_missing)
+            except CheckpointCorruptError as e:
+                self._quarantine(step, str(e))
+        return None
+
+
+def _sha256_file(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                return h.hexdigest()
+            h.update(block)

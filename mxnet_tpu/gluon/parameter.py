@@ -68,6 +68,20 @@ class Parameter:
             else:
                 self._attach()
 
+    @property
+    def _fresh_grad(self) -> bool:
+        """True while the grad buffer holds a gradient written by backward
+        that no optimizer step has consumed yet (ref: parameter.py
+        _fresh_grad via NDArray fresh_out_grad)."""
+        if self._grad is None:
+            return False
+        return bool(getattr(self._grad, "_fresh_grad", False))
+
+    @_fresh_grad.setter
+    def _fresh_grad(self, fresh: bool) -> None:
+        if self._grad is not None:
+            self._grad._fresh_grad = bool(fresh)
+
     def _shape_known(self) -> bool:
         return self.shape is not None and all(s > 0 for s in self.shape)
 
@@ -171,6 +185,7 @@ class Parameter:
                                else data._data)
 
     def zero_grad(self) -> None:
+        self._fresh_grad = False
         if self._grad is None:
             return
         from ..ndarray import sparse as _sp

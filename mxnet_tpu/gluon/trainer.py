@@ -41,6 +41,7 @@ class Trainer:
         self._kvstore = None
         self._kv_initialized = False
         self._params_synced = False
+        self._chaos_step = 0  # step clock for env-driven chaos plans
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: p for i, p in enumerate(self._params)}
@@ -62,13 +63,26 @@ class Trainer:
             self._kvstore = self._kvstore_arg
         elif self._kvstore_arg:
             from .. import kvstore as kv_mod
+            arg = str(self._kvstore_arg).lower()
             try:
                 kv = kv_mod.create(self._kvstore_arg)
-                # a 1-device local store adds nothing over direct update
-                self._kvstore = kv if kv.num_devices > 1 or kv.rank is not None \
-                    and kv.size > 1 else None
-            except Exception:
+            except Exception as e:
+                # Only the benign default local/device store may degrade to
+                # direct updates; a dist or explicitly-requested exotic
+                # store failing to come up must NOT silently turn a
+                # multi-worker run into single-device training.
+                if arg not in ("local", "device"):
+                    raise MXNetError(
+                        f"failed to create kvstore {self._kvstore_arg!r} "
+                        "(refusing to fall back to local updates — a "
+                        "misconfigured dist run would silently train "
+                        f"single-device): {e}") from e
                 self._kvstore = None
+            else:
+                # a 1-device single-worker store adds nothing over direct
+                # update
+                self._kvstore = kv if (kv.num_devices > 1 or
+                                       kv.num_workers > 1) else None
         self._kv_initialized = True
         if self._kvstore is not None:
             for i, p in enumerate(self._params):
@@ -122,6 +136,14 @@ class Trainer:
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
+        from ..contrib import chaos
+        plan = chaos.active()
+        if plan is not None:
+            # drive the plan's step clock for classic backward+step loops
+            # (FitLoop drives it itself and never calls step())
+            plan.begin_step(self._chaos_step)
+            self._chaos_step += 1
+            plan.poison_grads(self._params)
         self.allreduce_grads()
         self._update(ignore_stale_grad)
 
@@ -131,10 +153,25 @@ class Trainer:
 
     def _update(self, ignore_stale_grad=False):
         updater = self._updaters[0]
-        for i, p in enumerate(self._params):
-            if p.grad_req == "null":
-                continue
-            updater(i, p.grad(), p.data())
+        live = [(i, p) for i, p in enumerate(self._params)
+                if p.grad_req != "null"]
+        if not ignore_stale_grad:
+            # pre-scan BEFORE applying any update: raising mid-loop would
+            # leave a half-stepped model behind a supposedly recoverable
+            # error (ref: trainer.py _fresh_grad check)
+            stale = [p.name for _, p in live if not p._fresh_grad]
+            if stale:
+                raise MXNetError(
+                    f"gradient of parameter(s) {stale[:4]} is stale (not "
+                    "updated by backward since the last step). This "
+                    "usually means the parameter was unused in the loss, "
+                    "or step() ran twice per backward. Call backward "
+                    "first, or pass ignore_stale_grad=True to skip stale "
+                    "parameters. No update was applied.")
+        for i, p in live:
+            if p._fresh_grad:
+                updater(i, p.grad(), p.data())
+                p._fresh_grad = False
 
     def save_states(self, fname):
         with open(fname, "wb") as f:

@@ -126,7 +126,7 @@ class NDArrayIter(DataIter):
 
     def __init__(self, data, label=None, batch_size=1, shuffle=False,
                  last_batch_handle="pad", data_name="data",
-                 label_name="softmax_label"):
+                 label_name="softmax_label", seed=None):
         super().__init__(batch_size)
         self.data = _init_data(data, False, data_name)
         self.label = _init_data(label, True, label_name)
@@ -134,9 +134,18 @@ class NDArrayIter(DataIter):
         self.shuffle = shuffle
         self.last_batch_handle = last_batch_handle
         self.cursor = -batch_size
+        # seed makes the shuffle order a pure function of (seed, epoch):
+        # a killed-and-resumed run (fit.FitLoop) replays the exact batch
+        # sequence instead of reshuffling from the global RNG's new state
+        self._seed = seed
+        self._epoch = 0
         self._order = _np.arange(self.num_data)
         if shuffle:
-            _np.random.shuffle(self._order)
+            if seed is not None:
+                self._order = _np.random.RandomState(seed).permutation(
+                    self.num_data)
+            else:
+                _np.random.shuffle(self._order)
         if last_batch_handle == "discard":
             self.num_batches = self.num_data // batch_size
         else:
@@ -154,8 +163,27 @@ class NDArrayIter(DataIter):
 
     def reset(self):
         self.cursor = -self.batch_size
+        self._epoch += 1
         if self.shuffle:
-            _np.random.shuffle(self._order)
+            if self._seed is not None:
+                self._order = _np.random.RandomState(
+                    self._seed + self._epoch).permutation(self.num_data)
+            else:
+                _np.random.shuffle(self._order)
+
+    def set_epoch(self, epoch):
+        """Deterministically position the iterator at the start of
+        ``epoch``: with a seed the order depends only on (seed, epoch), so
+        a resumed run (fit.FitLoop fast-forward) replays the original
+        batch sequence no matter how many resets already happened."""
+        check(not self.shuffle or self._seed is not None,
+              "set_epoch with shuffle=True needs NDArrayIter(seed=...) — "
+              "an unseeded shuffle cannot be replayed after a restart")
+        self._epoch = int(epoch)
+        self.cursor = -self.batch_size
+        if self.shuffle:
+            self._order = _np.random.RandomState(
+                self._seed + self._epoch).permutation(self.num_data)
 
     def iter_next(self):
         self.cursor += self.batch_size

@@ -30,12 +30,53 @@ from __future__ import annotations
 
 import functools
 import pickle
+import time
 from typing import Any, Dict, List, Optional, Sequence, Union
 
-from .base import MXNetError, check
+from .base import MXNetError, check, env
 from .ndarray import ndarray as _nd
 
-__all__ = ["KVStore", "KVStoreLocal", "KVStoreDistTPU", "create"]
+__all__ = ["KVStore", "KVStoreLocal", "KVStoreDistTPU", "TransientKVError",
+           "create"]
+
+
+class TransientKVError(MXNetError):
+    """A push/pull failed in a way that is safe to retry (network flake on
+    DCN, a peer mid-rejoin — the conditions ps-lite absorbs with resends,
+    Van::Send retry). push/pull retry with exponential backoff up to
+    ``MXNET_KV_RETRY_MAX`` attempts before giving up."""
+
+
+def _retry_op(what: str, fn):
+    """Bounded retry with exponential backoff around one kvstore op.
+
+    Only :class:`TransientKVError` is retried; anything else is a real
+    error and propagates immediately. The retry unit is ONE key's work:
+    the transient failure points (chaos at entry, the _reduce_global wire
+    hop) precede that key's store mutation, so a retry never
+    double-applies an updater. Transports that raise TransientKVError
+    must do so before consuming the payload — a failure after the wire
+    compressor's error-feedback update would re-quantize on retry."""
+    max_retries = int(env.get("MXNET_KV_RETRY_MAX"))
+    base = float(env.get("MXNET_KV_RETRY_BASE_MS")) / 1000.0
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except TransientKVError as e:
+            attempt += 1
+            if attempt > max_retries:
+                raise MXNetError(
+                    f"kvstore {what} still failing after {max_retries} "
+                    f"retries: {e}") from e
+            time.sleep(base * (2 ** (attempt - 1)))
+
+
+def _chaos_kv(op: str, key) -> None:
+    from .contrib import chaos
+    plan = chaos.active()
+    if plan is not None:
+        plan.kv_maybe_fail(op, key)
 
 
 def _group(keys, values):
@@ -174,43 +215,51 @@ class KVStoreBase:
         return _sp.mask_unpack(packed, merged.shape, merged._ctx)
 
     def push(self, key, value, priority: int = 0) -> None:
-        from .ndarray import sparse as _sp
+        # retry granularity is ONE key: the transient failure points
+        # (chaos entry, the _reduce_global wire hop) precede that key's
+        # store mutation, so a retry never re-applies an updater — and a
+        # failure on key N never re-runs keys < N that already applied
         for k, vals in _group(key, value):
-            check(k in self._store, f"kvstore key {k} not initialized")
-            if any(isinstance(v, _sp.BaseSparseNDArray) for v in vals):
-                # row_sparse push: no wire compression (the reference
-                # rejects compression for sparse grads too), updater gets
-                # the compact rows for a lazy update
-                merged = self._reduce_global_rsp(self._merge_rsp(vals),
-                                                 key=k)
-                store = self._store[k]
-                if self._updater is not None:
-                    self._updater(_key_int(k), merged, store)
-                else:
-                    # replace semantics, matching the dense branch's full
-                    # overwrite: untouched rows read as zero, not as stale
-                    # values from the previous contents
-                    import jax.numpy as jnp
-                    base = jnp.zeros_like(store._data)
-                    store._rebind(base.at[
-                        jnp.asarray(merged._indices)].set(
-                        merged._data.astype(store._data.dtype)))
-                continue
-            merged = self._merge(vals)
-            if self._compressor is not None and not self._wire_compresses():
-                # no wire hop here (local store): compress->decompress
-                # round trip with error feedback reproduces the numeric
-                # effect (ref: push-path quantization,
-                # gradient_compression.cc)
-                merged = _nd.NDArray(
-                    self._compressor.roundtrip(k, merged._data),
-                    ctx=merged._ctx)
-            merged = self._reduce_global(merged, key=k)
-            merged = self._match_store_sharding(merged, self._store[k])
+            _retry_op("push", lambda k=k, vals=vals: self._push_one(k, vals))
+
+    def _push_one(self, k, vals) -> None:
+        _chaos_kv("push", k)
+        from .ndarray import sparse as _sp
+        check(k in self._store, f"kvstore key {k} not initialized")
+        if any(isinstance(v, _sp.BaseSparseNDArray) for v in vals):
+            # row_sparse push: no wire compression (the reference
+            # rejects compression for sparse grads too), updater gets
+            # the compact rows for a lazy update
+            merged = self._reduce_global_rsp(self._merge_rsp(vals),
+                                             key=k)
+            store = self._store[k]
             if self._updater is not None:
-                self._updater(_key_int(k), merged, self._store[k])
+                self._updater(_key_int(k), merged, store)
             else:
-                self._store[k]._rebind(merged._data)
+                # replace semantics, matching the dense branch's full
+                # overwrite: untouched rows read as zero, not as stale
+                # values from the previous contents
+                import jax.numpy as jnp
+                base = jnp.zeros_like(store._data)
+                store._rebind(base.at[
+                    jnp.asarray(merged._indices)].set(
+                    merged._data.astype(store._data.dtype)))
+            return
+        merged = self._merge(vals)
+        if self._compressor is not None and not self._wire_compresses():
+            # no wire hop here (local store): compress->decompress
+            # round trip with error feedback reproduces the numeric
+            # effect (ref: push-path quantization,
+            # gradient_compression.cc)
+            merged = _nd.NDArray(
+                self._compressor.roundtrip(k, merged._data),
+                ctx=merged._ctx)
+        merged = self._reduce_global(merged, key=k)
+        merged = self._match_store_sharding(merged, self._store[k])
+        if self._updater is not None:
+            self._updater(_key_int(k), merged, self._store[k])
+        else:
+            self._store[k]._rebind(merged._data)
 
     def _wire_compresses(self) -> bool:
         """True when _reduce_global itself moves the compressed payload
@@ -222,22 +271,26 @@ class KVStoreBase:
              ignore_sparse: bool = True) -> None:
         check(out is not None, "pull requires out=")
         for k, outs in _group(key, out):
-            check(k in self._store, f"kvstore key {k} not initialized")
-            src = self._store[k]
-            data = src._data
-            from jax.sharding import NamedSharding
-            if isinstance(getattr(data, "sharding", None), NamedSharding) \
-                    and getattr(data.sharding, "spec", None) and \
-                    data.sharding.spec[0] is not None:
-                # the table lives sharded in the store; a FULL pull hands
-                # the worker a plain single-device array (the reference's
-                # worker-side copy semantics) — use row_sparse_pull to
-                # touch only active rows without the gather
-                import jax
-                data = jax.device_put(data, jax.devices()[0])
-            for o in outs:
-                o._rebind(_nd.NDArray(data, ctx=src._ctx)
-                          .as_in_context(o.context)._data)
+            _retry_op("pull", lambda k=k, outs=outs: self._pull_one(k, outs))
+
+    def _pull_one(self, k, outs) -> None:
+        _chaos_kv("pull", k)
+        check(k in self._store, f"kvstore key {k} not initialized")
+        src = self._store[k]
+        data = src._data
+        from jax.sharding import NamedSharding
+        if isinstance(getattr(data, "sharding", None), NamedSharding) \
+                and getattr(data.sharding, "spec", None) and \
+                data.sharding.spec[0] is not None:
+            # the table lives sharded in the store; a FULL pull hands
+            # the worker a plain single-device array (the reference's
+            # worker-side copy semantics) — use row_sparse_pull to
+            # touch only active rows without the gather
+            import jax
+            data = jax.device_put(data, jax.devices()[0])
+        for o in outs:
+            o._rebind(_nd.NDArray(data, ctx=src._ctx)
+                      .as_in_context(o.context)._data)
 
     def pushpull(self, key, value, out=None, priority: int = 0) -> None:
         self.push(key, value, priority)

@@ -62,6 +62,9 @@ def _as_dtype(dtype):
 class NDArray:
     __slots__ = ("_data", "_ctx", "_version", "_grad", "_grad_req",
                  "_tape_entry", "_stype", "_dlpack_staged", "__weakref__",
+                 # grad-buffer freshness: set by backward, cleared by
+                 # Trainer._update / zero_grad (ref: NDArray fresh_out_grad)
+                 "_fresh_grad",
                  # C API keep-alive anchors (MXNDArrayGetData host snapshot,
                  # SaveRawBytes buffer, shared-mem segment)
                  "_c_host_copy", "_c_raw_bytes", "_c_shm")

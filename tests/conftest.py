@@ -28,3 +28,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running end-to-end example tests")
+    config.addinivalue_line(
+        "markers", "chaos: fault-injection resilience tests "
+        "(contrib/chaos.py plans; the unmarked-slow subset is a "
+        "tier-1-safe fast smoke)")

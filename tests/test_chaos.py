@@ -1,0 +1,385 @@
+"""Chaos-injection resilience tests: every robustness claim in the
+recovery stack (fault.py + fit.py + kvstore retry) is proven by injecting
+the failure it guards against, deterministically, and asserting recovery.
+
+Tier-1-safe fast smoke: tiny MLP, CPU, seeded everything — the full
+kill/resume chain runs in seconds.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import fault, fit, gluon, io, nd
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.contrib import chaos
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    chaos.uninstall()
+    yield
+    chaos.uninstall()
+
+
+# ---------------------------------------------------------------- grammar
+
+def test_plan_grammar():
+    p = chaos.ChaosPlan("nan_grad@3,kill@10,ckpt_corrupt@latest,"
+                        "kv_flake:0.25")
+    assert p.kv_flake_p == 0.25
+    assert p._ckpt_latest
+    p.begin_step(3)
+    assert p.should("nan_grad")
+    assert not p.should("nan_grad"), "events fire once"
+    p.begin_step(10)
+    with pytest.raises(chaos.ChaosKilled):
+        p.maybe_kill()
+    assert p.injected["kill"] == 1
+
+
+@pytest.mark.parametrize("bad", ["bogus@3", "kv_flake", "kv_flake:1.5",
+                                 "nan_grad", "nan_grad@latest",
+                                 "kill:0.5@3", "kv_flake:0.5@3"])
+def test_plan_grammar_rejects(bad):
+    with pytest.raises(MXNetError):
+        chaos.ChaosPlan(bad)
+
+
+def test_env_activation_tracks_env(monkeypatch):
+    monkeypatch.delenv("MXTPU_CHAOS", raising=False)
+    assert chaos.active() is None
+    monkeypatch.setenv("MXTPU_CHAOS", "kv_flake:0.1")
+    plan = chaos.active()
+    assert plan is not None and plan.kv_flake_p == 0.1
+    monkeypatch.delenv("MXTPU_CHAOS")
+    assert chaos.active() is None, "env-installed plan dies with the env"
+
+
+# ------------------------------------------------------------- kv retry
+
+def test_kv_flake_retry_recovers(monkeypatch):
+    monkeypatch.setenv("MXNET_KV_RETRY_BASE_MS", "1")
+    plan = chaos.install("kv_flake:0.4")
+    kv = mx.kv.create("local")
+    kv.init(0, nd.ones((4,)))
+    out = nd.zeros((4,))
+    for _ in range(40):  # p(4 consecutive flakes) per op = 0.4^4 ~ 2.6%
+        kv.push(0, nd.ones((4,)))
+        kv.pull(0, out=out)
+    assert plan.injected["kv_flake"] > 0, "plan never fired"
+    assert np.all(np.isfinite(out.asnumpy()))
+
+
+def test_kv_flake_retry_exhausts(monkeypatch):
+    monkeypatch.setenv("MXNET_KV_RETRY_BASE_MS", "1")
+    monkeypatch.setenv("MXNET_KV_RETRY_MAX", "2")
+    chaos.install("kv_flake:1.0")
+    kv = mx.kv.create("local")
+    chaos.uninstall()
+    kv.init(0, nd.ones((4,)))
+    chaos.install("kv_flake:1.0")
+    with pytest.raises(MXNetError, match="after 2 retries"):
+        kv.push(0, nd.ones((4,)))
+
+
+# ------------------------------------------------------------- fit chain
+
+def _data(n=64, d=4, bs=8):
+    rs = np.random.RandomState(42)
+    X = rs.randn(n, d).astype(np.float32)
+    w = rs.randn(d, 1).astype(np.float32)
+    Y = X @ w + 0.01 * rs.randn(n, 1).astype(np.float32)
+    return X, Y, bs
+
+
+def _build(ckpt_dir, ckpt_every=2, loss_scale=1.0):
+    """Fully deterministic net/trainer/iter/loop so two runs replay the
+    same trajectory bit-for-bit."""
+    mx.random.seed(0)  # initializers draw from mx.random's global key
+    np.random.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8, activation="relu"), gluon.nn.Dense(1))
+    net.initialize(mx.init.Xavier())
+    with mx.autograd.pause():
+        net(nd.ones((1, 4)))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05, "momentum": 0.9},
+                            kvstore=None)
+    X, Y, bs = _data()
+    itr = io.NDArrayIter(X, Y, batch_size=bs, shuffle=True, seed=13)
+    loop = fit.FitLoop(net, trainer,
+                       lambda p, y: ((p - y) ** 2).mean(), itr,
+                       ckpt_dir=ckpt_dir, ckpt_every=ckpt_every,
+                       async_ckpt=False, heartbeat=False,
+                       loss_scale=loss_scale)
+    return net, trainer, loop
+
+
+def test_kill_resume_replays_fault_free_trajectory(tmp_path):
+    """THE acceptance chain: a run killed at step k and resumed via
+    restore_latest reproduces the fault-free run's loss trajectory —
+    same steps, allclose losses — including the data-iterator position."""
+    _, _, loop_a = _build(str(tmp_path / "a"))
+    res_a = loop_a.fit(epochs=2)
+    assert res_a.step == 16 and not res_a.skipped_steps
+
+    chaos.install("kill@10")
+    _, _, loop_b = _build(str(tmp_path / "b"))
+    with pytest.raises(chaos.ChaosKilled):
+        loop_b.fit(epochs=2)
+    chaos.uninstall()
+
+    # relaunch: fresh objects, recovery entirely via restore_latest
+    _, _, loop_b2 = _build(str(tmp_path / "b"))
+    res_b = loop_b2.fit(epochs=2)
+    assert res_b.resumed_from == 10, "kill@10 should resume from ckpt-10"
+    assert res_b.step == 16
+    # the resumed tail IS the fault-free tail: same batches, same losses
+    np.testing.assert_allclose(res_b.losses, res_a.losses[10:], rtol=1e-5)
+
+
+def test_corrupt_latest_falls_back_then_replays(tmp_path):
+    """A corrupted latest checkpoint (forged-complete, byte-flipped by
+    chaos after DONE landed) is quarantined; restore falls back to the
+    previous verified checkpoint and the rerun still matches fault-free."""
+    ck = str(tmp_path / "ck")
+    _, _, loop_a = _build(str(tmp_path / "a"), ckpt_every=4)
+    res_a = loop_a.fit(epochs=1)
+    assert res_a.step == 8
+
+    chaos.install("ckpt_corrupt@8")  # corrupt the final checkpoint
+    _, _, loop_b = _build(ck, ckpt_every=4)
+    res_b = loop_b.fit(epochs=1)
+    assert res_b.step == 8
+    chaos.uninstall()
+
+    _, _, loop_b2 = _build(ck, ckpt_every=4)
+    res_b2 = loop_b2.fit(epochs=1)
+    assert res_b2.resumed_from == 4, \
+        "corrupt ckpt-8 must fall back to verified ckpt-4"
+    assert os.path.isdir(os.path.join(ck, "ckpt-8.bad"))
+    # steps 4..7 replayed on the fault-free trajectory
+    np.testing.assert_allclose(res_b2.losses, res_a.losses[4:], rtol=1e-5)
+
+
+def test_nan_grad_step_skipped_params_untouched(tmp_path):
+    """An injected NaN-grad step is skipped: parameters and optimizer
+    state keep their pre-step values and the loss scale backs off."""
+    net, trainer, loop = _build(None, loss_scale=2.0)
+    chaos.install("nan_grad@0")
+    res = loop.fit(epochs=1)
+    assert res.skipped_steps[0] == 0
+    assert res.loss_scale < 2.0, "scale must back off after the skip"
+
+    # replay fault-free: trajectories must agree from step 1 on being
+    # *shifted by one skipped update* — i.e. the skipped step changed
+    # nothing: net2 after step 0 == net after steps {0 skipped, 1}? No:
+    # directly verify the invariant instead — a single-step run whose only
+    # step is poisoned leaves params exactly at init.
+    net3, trainer3, loop3 = _build(None)
+    before = {k: p.data().asnumpy().copy()
+              for k, p in net3.collect_params().items()}
+    chaos.install("nan_grad@0,kill@1")  # poison step 0, stop before step 1
+    with pytest.raises(chaos.ChaosKilled):
+        loop3.fit(epochs=1)
+    for k, p in net3.collect_params().items():
+        np.testing.assert_array_equal(p.data().asnumpy(), before[k])
+    assert not trainer3._updaters[0].states, \
+        "optimizer state must not be created by a skipped step"
+
+
+def test_nan_skip_recovers_with_accumulating_grads():
+    """Regression: the skip path must ZERO the poisoned grad buffers, not
+    just mark them stale — a grad_req='add' buffer would otherwise fold
+    NaN into every later backward and stall the sentinel forever."""
+    net, trainer, loop = _build(None)
+    for p in trainer._params:
+        p.grad_req = "add"
+    chaos.install("nan_grad@1")
+    res = loop.fit(epochs=1)
+    assert res.skipped_steps == [1], \
+        "only the injected step may be skipped — NaN must not persist"
+
+
+def test_nan_grad_training_reconverges():
+    """Training with a mid-run NaN injection still converges: the skip +
+    loss-scale backoff recovers instead of diverging."""
+    _, _, loop = _build(None)
+    chaos.install("nan_grad@5")
+    res = loop.fit(epochs=4)
+    assert res.skipped_steps == [5]
+    assert res.step == 32
+    head = float(np.mean(res.losses[:4]))
+    tail = float(np.mean(res.losses[-4:]))
+    assert np.isfinite(tail) and tail < head * 0.5, (head, tail)
+
+
+def test_preempt_writes_final_checkpoint_and_exits_resumable(tmp_path):
+    """SIGTERM (the TPU-preemption signal, here injected by chaos) is
+    trapped at a step boundary: a final verified checkpoint is written and
+    the process exits with the distinct resumable code; a relaunch
+    completes the run on the fault-free trajectory."""
+    ck = str(tmp_path / "ck")
+    _, _, loop_a = _build(str(tmp_path / "a"), ckpt_every=100)
+    res_a = loop_a.fit(epochs=2)
+
+    chaos.install("preempt@5")
+    _, _, loop_b = _build(ck, ckpt_every=100)
+    with pytest.raises(SystemExit) as ei:
+        loop_b.fit(epochs=2)
+    assert ei.value.code == fit.resumable_exit_code() == 75
+    chaos.uninstall()
+
+    cm = fault.CheckpointManager(ck)
+    assert cm.latest() == 5, "final checkpoint at the preempted step"
+    cm.verify(5)
+
+    _, _, loop_b2 = _build(ck, ckpt_every=100)
+    res_b = loop_b2.fit(epochs=2)
+    assert res_b.resumed_from == 5 and res_b.step == 16
+    np.testing.assert_allclose(res_b.losses, res_a.losses[5:], rtol=1e-5)
+
+
+def test_preempt_without_ckpt_dir_is_not_resumable():
+    """With no checkpoint dir there is nothing to resume: the trapped
+    signal must be re-delivered with its original disposition (here:
+    KeyboardInterrupt), NOT converted into the 'resume me' exit code."""
+    import signal as _signal
+    _, _, loop = _build(None)
+    loop._preempted = _signal.SIGINT
+    res = fit.FitResult(status="done", step=0, epoch=0)
+    with pytest.raises(KeyboardInterrupt):
+        loop._final_exit(None, res, 0, 0)
+
+
+def test_fitloop_ignore_stale_grad_passthrough():
+    """A net with a trainable parameter the loss never reaches must be
+    usable through FitLoop via the ignore_stale_grad escape hatch."""
+    mx.random.seed(0)
+    used = gluon.nn.Dense(1, in_units=4, use_bias=False)
+    unused = gluon.nn.Dense(1, in_units=4, use_bias=False)
+
+    class TwoHead(gluon.nn.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.used, self.unused = used, unused
+            self.register_child(used)
+            self.register_child(unused)
+
+        def hybrid_forward(self, F, x):
+            return self.used(x)  # aux head never reached
+
+    net = TwoHead()
+    net.initialize(mx.init.Xavier())
+    with mx.autograd.pause():
+        net(nd.ones((1, 4)))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05}, kvstore=None)
+    X, Y, bs = _data()
+    itr = io.NDArrayIter(X, Y, batch_size=bs, shuffle=False)
+    loss = lambda p, y: ((p - y) ** 2).mean()
+    strict = fit.FitLoop(net, trainer, loss, itr, heartbeat=False)
+    with pytest.raises(MXNetError, match="stale"):
+        strict.fit(epochs=1)
+    lenient = fit.FitLoop(net, trainer, loss, itr, heartbeat=False,
+                          ignore_stale_grad=True)
+    res = lenient.fit(epochs=1)
+    assert res.step == 8 and np.isfinite(res.losses[-1])
+
+
+def test_noop_resume_preserves_position(tmp_path):
+    """Regression (found by driving a real SIGTERM+resume): resuming a run
+    whose epochs are already complete trains zero steps and must NOT
+    re-save the checkpoint with a reset iterator position — that would
+    make the NEXT resume replay from epoch 0 at full step count."""
+    ck = str(tmp_path / "ck")
+    _, _, loop = _build(ck, ckpt_every=3)
+    res = loop.fit(epochs=1)  # 8 steps; final save at 8 with pos (1, 0)
+    assert res.step == 8
+
+    _, _, loop2 = _build(ck, ckpt_every=3)
+    res2 = loop2.fit(epochs=1)  # nothing left to train
+    assert res2.resumed_from == 8 and res2.losses == []
+
+    cm = fault.CheckpointManager(ck)
+    meta = cm.restore_latest()[2]
+    assert meta["data_state"]["epoch"] == 1, \
+        "no-op resume must not clobber the saved iterator position"
+
+    # and a real continuation still lands on the fault-free trajectory
+    _, _, loop_a = _build(str(tmp_path / "a"), ckpt_every=3)
+    res_a = loop_a.fit(epochs=2)
+    _, _, loop3 = _build(ck, ckpt_every=3)
+    res3 = loop3.fit(epochs=2)
+    np.testing.assert_allclose(res3.losses, res_a.losses[8:], rtol=1e-5)
+
+
+def test_trainer_step_chaos_hook():
+    """The standalone Trainer.step hook: step() drives the plan's step
+    clock itself, so classic backward+step loops (no FitLoop) are
+    injectable straight from MXTPU_CHAOS."""
+    net = gluon.nn.Dense(1, in_units=3, use_bias=False)
+    net.initialize(mx.init.Constant(1.0))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore=None)
+    plan = chaos.install("nan_grad@1")
+    x, y = nd.ones((4, 3)), nd.ones((4, 1))
+    for _ in range(2):
+        with mx.autograd.record():
+            loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        trainer.step(4)
+    assert plan.injected["nan_grad"] == 1
+    assert not np.all(np.isfinite(net.weight.data().asnumpy())), \
+        "without a sentinel the poisoned step must visibly corrupt params"
+
+
+def test_stale_grad_raises_and_optout():
+    """Satellite: ignore_stale_grad is real now — a second step() without
+    a backward raises; ignore_stale_grad=True skips the stale update."""
+    net = gluon.nn.Dense(1, in_units=2, use_bias=False)
+    net.initialize(mx.init.Constant(1.0))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore=None)
+    x = nd.ones((2, 2))
+    with mx.autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(2)
+    w_after = net.weight.data().asnumpy().copy()
+    with pytest.raises(MXNetError, match="stale"):
+        trainer.step(2)  # same grad again: refused
+    trainer.step(2, ignore_stale_grad=True)  # explicit opt-out: skipped
+    np.testing.assert_array_equal(net.weight.data().asnumpy(), w_after)
+
+
+def test_trainer_dist_kvstore_failure_is_loud(monkeypatch):
+    """Satellite: a dist kvstore that fails to come up must raise, not
+    silently degrade to single-device training."""
+    from mxnet_tpu.gluon import trainer as trainer_mod
+
+    net = gluon.nn.Dense(1, in_units=2, use_bias=False)
+    net.initialize(mx.init.Constant(1.0))
+
+    def boom(name="local"):
+        raise RuntimeError("coordination service unreachable")
+
+    monkeypatch.setattr(mx.kvstore, "create", boom)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1}, kvstore="dist_sync")
+    with pytest.raises(MXNetError, match="refusing to fall back"):
+        tr._init_kvstore()
+    # a typoed/exotic explicit store is loud too
+    tr2 = gluon.Trainer(net.collect_params(), "sgd",
+                        {"learning_rate": 0.1}, kvstore="devcie")
+    with pytest.raises(MXNetError):
+        tr2._init_kvstore()
+    # ...but the benign default degrades quietly, as before
+    tr3 = gluon.Trainer(net.collect_params(), "sgd",
+                        {"learning_rate": 0.1}, kvstore="device")
+    tr3._init_kvstore()
+    assert tr3._kvstore is None

@@ -1,12 +1,16 @@
 """Failure-detection + checkpoint/restart tests (SURVEY §5.3 analog of
 tests around ps-lite GetDeadNodes / model_backwards_compatibility_check)."""
 import os
+import subprocess
+import sys
 import time
 
 import numpy as np
+import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import fault, gluon, nd, autograd
+from mxnet_tpu.base import MXNetError
 
 
 def test_heartbeat_and_dead_nodes(tmp_path):
@@ -131,6 +135,129 @@ def test_checkpoint_prune_and_incomplete(tmp_path):
 def test_fresh_start_returns_none(tmp_path):
     cm = fault.CheckpointManager(str(tmp_path))
     assert cm.restore_latest() is None
+
+
+def _params_of(net):
+    return {k: p.data() for k, p in net.collect_params().items()}
+
+
+def test_crash_mid_write_is_never_restored(tmp_path, monkeypatch):
+    """A writer killed between the params write and the DONE marker leaves
+    a checkpoint that is never listed nor restored (atomic tmp+rename)."""
+    cm = fault.CheckpointManager(str(tmp_path))
+    params = _params_of(_make_net())
+    cm.save(1, params)
+    with monkeypatch.context() as m:
+        def die(*a, **k):
+            raise RuntimeError("simulated crash mid-write")
+        m.setattr(fault.json, "dump", die)  # fires before manifest/DONE
+        with pytest.raises(RuntimeError):
+            cm.save(2, params)
+    assert cm.steps() == [1]
+    restored = cm.restore_latest()
+    assert restored is not None and restored[0] == 1
+
+
+def test_truncated_params_with_forged_done_is_quarantined(tmp_path):
+    """DONE claims complete but the payload is truncated: the manifest
+    check catches it, restore_latest quarantines ckpt-2 -> ckpt-2.bad and
+    falls back to the previous verified checkpoint."""
+    cm = fault.CheckpointManager(str(tmp_path), max_keep=3)
+    net = _make_net()
+    cm.save(1, net=net)
+    net.weight.set_data(nd.ones((2, 3)) * 7)
+    cm.save(2, net=net)
+    p2 = os.path.join(str(tmp_path), "ckpt-2", "params")
+    with open(p2, "rb") as f:
+        blob = f.read()
+    with open(p2, "wb") as f:
+        f.write(blob[:len(blob) // 2])  # truncate, DONE stays forged
+    with pytest.raises(fault.CheckpointCorruptError):
+        cm.verify(2)
+    restored = cm.restore_latest()
+    assert restored is not None and restored[0] == 1
+    np.testing.assert_allclose(restored[1]["weight"].asnumpy(),
+                               np.ones((2, 3)), rtol=1e-6)
+    assert os.path.isdir(os.path.join(str(tmp_path), "ckpt-2.bad"))
+    assert cm.steps() == [1]
+
+
+def test_corrupt_in_place_detected_and_all_bad_returns_none(tmp_path):
+    """Same-size byte flips (no truncation) still fail the sha256 check;
+    when every checkpoint is corrupt, restore_latest quarantines them all
+    and reports a fresh start instead of restoring garbage."""
+    from mxnet_tpu.contrib import chaos
+    cm = fault.CheckpointManager(str(tmp_path), max_keep=3)
+    params = _params_of(_make_net())
+    for s in (1, 2):
+        cm.save(s, params)
+        chaos.corrupt_file(os.path.join(str(tmp_path), f"ckpt-{s}",
+                                        "params"))
+    assert cm.restore_latest() is None
+    assert cm.steps() == []
+    assert os.path.isdir(os.path.join(str(tmp_path), "ckpt-1.bad"))
+    assert os.path.isdir(os.path.join(str(tmp_path), "ckpt-2.bad"))
+
+
+def test_restore_strict_both_directions(tmp_path):
+    """Checkpoint keys missing from the net already raise; net parameters
+    absent from the checkpoint must be loud too (they would silently keep
+    their current values) unless allow_missing=True."""
+    cm = fault.CheckpointManager(str(tmp_path))
+    slim = _make_net()  # Dense(2, use_bias=False): weight only
+    cm.save(1, net=slim)
+
+    wide = gluon.nn.Dense(2)  # weight + bias
+    wide.initialize(mx.init.Constant(0.5))
+    with autograd.pause():
+        wide(nd.ones((1, 3)))
+    wide.bias.set_data(nd.ones((2,)) * 0.5)  # bias init default is zeros
+    before = {k: p.data().asnumpy().copy()
+              for k, p in wide.collect_params().items()}
+    with pytest.raises(MXNetError, match="absent from checkpoint"):
+        cm.restore(1, net=wide)
+    for k, p in wide.collect_params().items():
+        np.testing.assert_array_equal(
+            p.data().asnumpy(), before[k],
+            err_msg="a failed restore must leave the net untouched")
+    # opt-out accepts the partial restore: weight loaded, bias kept
+    cm.restore(1, net=wide, allow_missing=True)
+    np.testing.assert_allclose(wide.weight.data().asnumpy(),
+                               np.ones((2, 3)), rtol=1e-6)
+    np.testing.assert_allclose(wide.bias.data().asnumpy(),
+                               np.full((2,), 0.5), rtol=1e-6)
+
+    # the pre-existing direction: checkpoint key unknown to the net
+    cm.save(2, net=wide)
+    with pytest.raises(MXNetError, match="not found in net"):
+        cm.restore(2, net=_make_net())
+
+
+def test_async_save_then_hard_exit_is_complete_or_absent(tmp_path):
+    """An async save() followed by immediate process death must leave
+    either a fully verified checkpoint or nothing restorable — never a
+    half-written one that restore would trust."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = (
+        "import os, sys\n"
+        f"sys.path.insert(0, {repo!r})\n"
+        "os.environ.setdefault('JAX_PLATFORMS', 'cpu')\n"
+        "from mxnet_tpu import fault, nd\n"
+        "cm = fault.CheckpointManager(sys.argv[1], async_write=True)\n"
+        "cm.save(1, {'w': nd.ones((128, 128))})\n"
+        "os._exit(0)\n"  # die with the write possibly in flight
+    )
+    r = subprocess.run([sys.executable, "-c", script, str(tmp_path)],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr[-2000:]
+    cm = fault.CheckpointManager(str(tmp_path))
+    steps = cm.steps()
+    assert steps in ([], [1])
+    for s in steps:
+        cm.verify(s)  # whatever survived must verify end to end
+        restored = cm.restore(s)
+        np.testing.assert_allclose(restored[1]["w"].asnumpy(),
+                                   np.ones((128, 128)))
 
 
 def test_heartbeat_restart_and_numeric_order(tmp_path):
