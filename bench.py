@@ -246,6 +246,153 @@ def run_inference(batch=256, dtype=None, layout=None, k_batches=8, reps=3,
     return ips
 
 
+def _serve_model():
+    """Small shape-polymorphic CNN (conv -> global pool -> dense): cheap
+    enough to serve on CPU in CI, conv-shaped enough that img/s means
+    something on a real chip."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd
+    mx.random.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, kernel_size=3, padding=1, in_channels=3))
+    net.add(gluon.nn.GlobalAvgPool2D())
+    net.add(gluon.nn.Flatten())
+    net.add(gluon.nn.Dense(10, in_units=8))
+    net.initialize(mx.init.Xavier())
+    with mx.autograd.pause():
+        net(nd.ones((1, 3, 32, 32)))
+    return net
+
+
+def _serve_closed_loop_rps(server, item, seconds=1.0, clients=4):
+    """Capacity probe: closed-loop clients hammer predict() to find the
+    saturation throughput the offered-load points are scaled from."""
+    import threading
+    stop = time.perf_counter() + seconds
+    counts = [0] * clients
+
+    def worker(i):
+        while time.perf_counter() < stop:
+            try:
+                server.predict(item, timeout=10)
+                counts[i] += 1
+            except Exception:
+                pass
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return sum(counts) / seconds
+
+
+def _serve_load_point(server, item, rate_rps, duration_s):
+    """Open-loop offered load at ``rate_rps`` for ``duration_s``; returns
+    the point's latency percentiles + achieved throughput."""
+    from mxnet_tpu.serving import ServingError
+    server.reset_metrics()
+    futs, rejected = [], 0
+    n = max(2, int(rate_rps * duration_s))
+    t0 = time.perf_counter()
+    for i in range(n):
+        nxt = t0 + i / rate_rps
+        now = time.perf_counter()
+        if nxt > now:
+            time.sleep(nxt - now)
+        try:
+            futs.append(server.submit(item))
+        except ServingError:
+            rejected += 1
+    for f in futs:
+        try:
+            f.result(timeout=30)
+        except ServingError:
+            rejected += 1
+        except Exception as e:
+            # a stuck/errored future must cost one sample, not the whole
+            # row — the bench's contract is "always ship a number"
+            log(f"serve load point: dropped result ({e})")
+            rejected += 1
+    dt = time.perf_counter() - t0
+    j = server.metrics_json()
+    lat = j["latency_ms"]["total"]
+    return {
+        "offered_rps": round(rate_rps, 1),
+        "duration_s": round(dt, 2),
+        "throughput_rps": round(j["responses_total"] / dt, 1),
+        "p50_ms": lat["p50"], "p95_ms": lat["p95"], "p99_ms": lat["p99"],
+        "rejected": rejected,
+        "batches": j["batches_total"],
+        "mean_batch": j["batch_size"]["mean"],
+    }
+
+
+def run_serve():
+    """The `serve` row: dynamic-batching ModelServer under an offered-load
+    sweep (>=2 points scaled off a measured capacity probe). One JSON
+    line: p50/p95/p99 end-to-end latency + achieved img/s per point.
+    Respects MXTPU_BENCH_DEADLINE_S like every other row."""
+    import numpy as np
+    if not _init_backend():
+        return
+    _enable_compile_cache()
+    from mxnet_tpu.serving import ModelServer
+    shape = (3, 32, 32)
+    # batching knobs come from the declared MXTPU_SERVE_* env defaults —
+    # one source of truth with a default-configured ModelServer
+    server = ModelServer(_serve_model(), bucket_shapes=[shape],
+                         name="bench_cnn32")
+    server.start()
+    t0 = time.time()
+    compiles = server.warmup()
+    log(f"serve warmup: {compiles} signatures compiled "
+        f"in {time.time() - t0:.1f}s")
+    rs = np.random.RandomState(0)
+    item = rs.rand(*shape).astype(np.float32)
+    # floor at 0.5s: a warmup that ate the whole deadline budget must not
+    # drive the probe window to <= 0 (negative/div-zero capacity)
+    cap = _serve_closed_loop_rps(server, item,
+                                 seconds=min(2.0, max(0.5,
+                                                      _budget_left() / 8)))
+    log(f"serve capacity probe: {cap:.0f} req/s closed-loop")
+    fractions = [float(v) for v in os.environ.get(
+        "MXTPU_BENCH_SERVE_LOADS", "0.5,0.8").split(",")]
+    per_point_s = float(os.environ.get("MXTPU_BENCH_SERVE_SECONDS", "5"))
+    points = []
+    for frac in fractions:
+        budget = _budget_left() - 30
+        if budget < 1.0 and points:
+            log(f"serve: budget exhausted after {len(points)} points")
+            break
+        rate = max(1.0, cap * frac)
+        pt = _serve_load_point(server, item, rate,
+                               min(per_point_s, max(1.0, budget)))
+        pt["load_fraction"] = frac
+        log(f"serve @{frac:.0%} capacity ({rate:.0f} rps offered): "
+            f"p50={pt['p50_ms']}ms p99={pt['p99_ms']}ms "
+            f"-> {pt['throughput_rps']} img/s")
+        points.append(pt)
+    top = points[-1]
+    # the row ships BEFORE the drain: a wedged worker making stop() time
+    # out must not throw away already-measured points
+    print(json.dumps({
+        "metric": "serve_p99_latency_ms",
+        "value": top["p99_ms"],
+        "unit": "ms",
+        "imgs_per_sec": top["throughput_rps"],
+        "capacity_rps": round(cap, 1),
+        "compiled_signatures": compiles,
+        "max_batch": server.max_batch_size,
+        "points": points,
+    }), flush=True)
+    try:
+        server.stop(drain=True)
+    except Exception as e:
+        log(f"serve: drain after row emission failed: {e}")
+
+
 def _enable_compile_cache():
     """Persistent XLA compilation cache: full-graph ResNet-50 compiles
     take ~15 min through the tunnel; the cache cuts reruns to seconds."""
@@ -329,6 +476,13 @@ def _subprocess_metric(mode, args_list, marker, timeout_s=2100,
 
 
 def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "serve":
+        # serving row is self-deadlined like the train rows; it runs
+        # in-process (tiny model — a crash here has nothing to protect)
+        _DEADLINE[0] = time.time() + float(
+            os.environ.get("MXTPU_BENCH_DEADLINE_S", "2400"))
+        run_serve()
+        return
     if len(sys.argv) > 1 and sys.argv[1] in ("--inference-only",
                                              "--train-only"):
         if len(sys.argv) < 3:

@@ -48,6 +48,7 @@ from . import storage  # noqa: F401
 from . import recordio  # noqa: F401
 from . import fault  # noqa: F401
 from . import fit  # noqa: F401
+from . import serving  # noqa: F401
 from . import test_utils  # noqa: F401
 from . import contrib  # noqa: F401
 from . import parallel  # noqa: F401

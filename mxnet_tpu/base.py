@@ -151,6 +151,20 @@ env.declare("MXTPU_FUSED_EPILOGUE", bool, True,
             "kernels (compiled on TPU, interpret mode elsewhere). Set 0 "
             "to fall back to the composed unfused lowering. Read at "
             "trace time — part of every op jit-cache key.")
+env.declare("MXTPU_CACHEDOP_CACHE_SIZE", int, 256,
+            "LRU bound on CachedOp's per-signature compiled-program cache "
+            "(each entry is a full XLA executable). 0 = unbounded. "
+            "CachedOp.cache_info() reports hits/misses/evictions.")
+env.declare("MXTPU_SERVE_MAX_BATCH", int, 32,
+            "serving.ModelServer: maximum coalesced batch size per "
+            "dispatch; also the largest batch-padding bucket.")
+env.declare("MXTPU_SERVE_MAX_LATENCY_MS", float, 5.0,
+            "serving.ModelServer: maximum time a request may wait in its "
+            "shape bucket before the batch is flushed partially full.")
+env.declare("MXTPU_SERVE_QUEUE_DEPTH", int, 256,
+            "serving.ModelServer: bounded admission-queue depth; a full "
+            "queue sheds load with a typed QueueFull rejection "
+            "(backpressure) instead of buffering without bound.")
 env.declare("MXNET_HOME", str, "",
             "Root directory for datasets and model artifacts "
             "(default ~/.mxnet; ref: docs/faq/env_var.md MXNET_HOME).")

@@ -21,11 +21,16 @@ compiled program stays cached.
 """
 from __future__ import annotations
 
+import threading
+from collections import OrderedDict, namedtuple
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-from .base import MXNetError
+from .base import MXNetError, env
 
-__all__ = ["CachedOp", "make_scan_forward", "scan_forward"]
+__all__ = ["CachedOp", "CacheInfo", "make_scan_forward", "scan_forward"]
+
+CacheInfo = namedtuple("CacheInfo",
+                       ["hits", "misses", "evictions", "currsize", "maxsize"])
 
 
 def _jax():
@@ -35,7 +40,7 @@ def _jax():
 
 class _CacheEntry:
     __slots__ = ("jitted", "mutated_idx", "out_treedef", "vjp_jitted",
-                 "n_outputs")
+                 "n_outputs", "warm")
 
     def __init__(self):
         self.jitted = None
@@ -43,6 +48,58 @@ class _CacheEntry:
         self.out_treedef = None
         self.vjp_jitted = None
         self.n_outputs = 0
+        # False until the first execution (which runs the python trace)
+        # has completed — concurrent callers must treat a cold entry like
+        # a miss and take the exclusive trace path
+        self.warm = False
+
+
+class _RWLock:
+    """Many concurrent replays, exclusive traces. Tracing a cold
+    signature swaps every Parameter's storage to jax Tracers for the
+    duration of the trace (_make_pure_fn), so a concurrent reader could
+    capture a Tracer into its param tuple; replays of warm entries only
+    read, and may overlap freely (serving workers). The lock is shared
+    per BLOCK (stashed on it), not per CachedOp — two executors over the
+    same net mutate the same Parameter objects. Threads that bypass
+    CachedOp entirely (direct un-hybridized calls, checkpoint saves)
+    during another thread's trace remain outside this guard — don't mix
+    those with concurrent serving traffic over the same net."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._readers = 0
+        self._writing = False
+        self._writers_waiting = 0
+
+    def acquire_read(self):
+        with self._cond:
+            # writer preference: back-to-back warm replays must not
+            # starve a cold signature's one-time trace forever
+            while self._writing or self._writers_waiting:
+                self._cond.wait()
+            self._readers += 1
+
+    def release_read(self):
+        with self._cond:
+            self._readers -= 1
+            if not self._readers:
+                self._cond.notify_all()
+
+    def acquire_write(self):
+        with self._cond:
+            self._writers_waiting += 1
+            try:
+                while self._writing or self._readers:
+                    self._cond.wait()
+            finally:
+                self._writers_waiting -= 1
+            self._writing = True
+
+    def release_write(self):
+        with self._cond:
+            self._writing = False
+            self._cond.notify_all()
 
 
 class _CachedOpGrad:
@@ -78,6 +135,16 @@ class _CachedOpGrad:
                 return vjp(tuple(cots))
 
             entry.vjp_jitted = jax.jit(run)
+            # first call traces: fn swaps Parameter storage to Tracers,
+            # so it needs the same exclusivity as a cold forward trace
+            self.op._trace_rw.acquire_write()
+            try:
+                grads = entry.vjp_jitted(self.param_arrays, self.key,
+                                         tuple(self.in_arrays),
+                                         tuple(cotangents))
+            finally:
+                self.op._trace_rw.release_write()
+            return list(grads[0]) + list(grads[1:])
         grads = entry.vjp_jitted(self.param_arrays, self.key,
                                  tuple(self.in_arrays), tuple(cotangents))
         param_grads = grads[0]
@@ -94,7 +161,8 @@ class CachedOp:
 
     def __init__(self, block, static_alloc: bool = False,
                  static_shape: bool = False, inline_limit: int = 2,
-                 flags: Sequence = (), mirror: Optional[bool] = None):
+                 flags: Sequence = (), mirror: Optional[bool] = None,
+                 cache_size: Optional[int] = None):
         # static_alloc/static_shape are implied by XLA compilation; kept for
         # API compat (ref: CachedOpConfig, cached_op.h:32-53). ``mirror``
         # (default: the MXNET_BACKWARD_DO_MIRROR env flag) rematerializes
@@ -102,8 +170,37 @@ class CachedOp:
         # mirror_fun path of src/nnvm/gradient.cc:271).
         self.block = block
         self.mirror = mirror
-        self._cache: Dict[Tuple, _CacheEntry] = {}
+        # LRU-bounded signature cache: every distinct (shapes, dtypes,
+        # train-mode, trace flags) key holds a full compiled executable, so
+        # shape-churny workloads (variable batch/seq) otherwise grow
+        # without bound. 0 = unbounded.
+        if cache_size is None:
+            cache_size = int(env.get("MXTPU_CACHEDOP_CACHE_SIZE"))
+        self._cache_size = int(cache_size)
+        self._cache: "OrderedDict[Tuple, _CacheEntry]" = OrderedDict()
+        # bookkeeping lock (lookup/insert/evict + counters); execution
+        # runs outside it under _trace_rw: warm replays share a read
+        # lock (serving workers overlap), cold first executions take the
+        # write lock because the trace mutates shared Parameter storage
+        self._cache_lock = threading.Lock()
+        self._trace_rw = getattr(block, "_mxtpu_trace_rw", None)
+        if self._trace_rw is None:
+            self._trace_rw = _RWLock()
+            try:
+                block._mxtpu_trace_rw = self._trace_rw
+            except AttributeError:
+                pass  # slotted/exotic block: fall back to per-op lock
+        self._hits = 0
+        self._misses = 0
+        self._evictions = 0
         self._param_objs: Optional[List] = None
+
+    def cache_info(self) -> CacheInfo:
+        """Hit/miss/eviction counters + occupancy of the signature cache
+        (shape of :func:`functools.lru_cache`'s ``cache_info``)."""
+        return CacheInfo(self._hits, self._misses, self._evictions,
+                         len(self._cache),
+                         self._cache_size if self._cache_size > 0 else None)
 
     # -----------------------------------------------------------------
     def _params(self) -> List:
@@ -194,28 +291,66 @@ class CachedOp:
         for p in params:
             if p._data is None:
                 raise MXNetError(f"parameter {p.name} not initialized")
-        param_arrays = tuple(p._data._data for p in params)
         training = autograd.is_training()
+        rng_key = _random.next_key()
 
         from .ops.registry import _trace_time_flags
-        key_sig = (tuple((tuple(a.shape), str(a.dtype)) for a in in_arrays),
-                   tuple((tuple(a.shape), str(a.dtype)) for a in param_arrays),
-                   in_treedef, training,
-                   # env flags read inside op impls change the traced
-                   # program: toggling them must re-trace, not replay
-                   _trace_time_flags())
-        entry = self._cache.get(key_sig)
-        rng_key = _random.next_key()
-        if entry is None:
-            entry = _CacheEntry()
-            fn = self._make_pure_fn(training, entry)
-            entry.jitted = jax.jit(fn)
-            self._cache[key_sig] = entry
-        out_arrays, state = entry.jitted(param_arrays, rng_key, *in_arrays)
+        mode = "read"
+        self._trace_rw.acquire_read()
+        try:
+            param_arrays = tuple(p._data._data for p in params)
+            key_sig = (tuple((tuple(a.shape), str(a.dtype))
+                             for a in in_arrays),
+                       tuple((tuple(a.shape), str(a.dtype))
+                             for a in param_arrays),
+                       in_treedef, training,
+                       # env flags read inside op impls change the traced
+                       # program: toggling them must re-trace, not replay
+                       _trace_time_flags())
+            with self._cache_lock:
+                entry = self._cache.get(key_sig)
+                if entry is None:
+                    self._misses += 1
+                    entry = _CacheEntry()
+                    fn = self._make_pure_fn(training, entry)
+                    entry.jitted = jax.jit(fn)
+                    self._cache[key_sig] = entry
+                    if self._cache_size > 0:
+                        while len(self._cache) > self._cache_size:
+                            self._cache.popitem(last=False)
+                            self._evictions += 1
+                else:
+                    self._hits += 1
+                    self._cache.move_to_end(key_sig)
+            if not entry.warm:
+                # cold entry (ours or a concurrent thread's): the first
+                # execution runs the python trace, which swaps Parameter
+                # storage to Tracers — upgrade to the exclusive lock and
+                # re-read the params after no reader/trace is in flight
+                self._trace_rw.release_read()
+                mode = None
+                self._trace_rw.acquire_write()
+                mode = "write"
+                param_arrays = tuple(p._data._data for p in params)
+            out_arrays, state = entry.jitted(param_arrays, rng_key,
+                                             *in_arrays)
+            entry.warm = True
+        finally:
+            if mode == "read":
+                self._trace_rw.release_read()
+            elif mode == "write":
+                self._trace_rw.release_write()
 
-        # write back mutable state (moving stats) — versioned-var rebind
-        for i, s in zip(entry.mutated_idx, state):
-            params[i]._data._rebind(s)
+        # write back mutable state (moving stats) — versioned-var rebind,
+        # exclusive: a concurrent replay must not capture a torn set of
+        # params (only training-mode calls mutate, so serving never pays)
+        if entry.mutated_idx:
+            self._trace_rw.acquire_write()
+            try:
+                for i, s in zip(entry.mutated_idx, state):
+                    params[i]._data._rebind(s)
+            finally:
+                self._trace_rw.release_write()
 
         ctx = flat_in[0]._ctx if flat_in else params[0]._data._ctx
         out_nds = [NDArray(a, ctx=ctx) for a in out_arrays]

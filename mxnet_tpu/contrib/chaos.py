@@ -27,6 +27,11 @@ Grammar: comma-separated events, each ``kind[:prob][@target]``:
   :class:`~mxnet_tpu.kvstore.TransientKVError` with probability ``P``
   (seeded RNG, ``MXTPU_CHAOS_SEED``), exercising the bounded
   retry-with-backoff (hook: ``kvstore.KVStoreBase.push/pull``).
+- ``serve_slow:P@MS`` — each serving batch dispatch sleeps ``MS``
+  milliseconds with probability ``P`` (``serve_slow@MS`` = always),
+  simulating compute stragglers/compile stalls so deadline shedding and
+  queue backpressure are testable (hook: ``serving.ModelServer`` worker,
+  before the batch is padded and dispatched).
 
 Step-scheduled events fire on the plan's step clock, advanced exactly once
 per training step by the loop owner (``FitLoop`` and ``Trainer.step`` both
@@ -39,6 +44,7 @@ from __future__ import annotations
 import os
 import random
 import signal
+import threading
 from typing import Dict, Optional, Set
 
 from ..base import MXNetError, env
@@ -57,7 +63,7 @@ class ChaosKilled(MXNetError):
 
 
 _KINDS = ("nan_grad", "inf_grad", "kill", "preempt", "ckpt_corrupt",
-          "kv_flake")
+          "kv_flake", "serve_slow")
 
 
 class ChaosPlan:
@@ -69,11 +75,18 @@ class ChaosPlan:
         if seed is None:
             seed = int(env.get("MXTPU_CHAOS_SEED"))
         self._rng = random.Random(seed)
+        # serving workers roll serve_slow concurrently; the lock keeps the
+        # draw sequence + injected counters data-race-free (which batch
+        # consumes which draw is still scheduling-dependent with >1
+        # worker — exact replay holds for single-worker servers)
+        self._rng_lock = threading.Lock()
         self._env_spec = _env_spec
         self._step: Optional[int] = None
         self._at: Dict[str, Set[int]] = {k: set() for k in _KINDS}
         self._ckpt_latest = False
         self.kv_flake_p = 0.0
+        self.serve_slow_p = 0.0
+        self.serve_slow_ms = 0.0
         # observability: how many of each fault actually fired
         self.injected: Dict[str, int] = {k: 0 for k in _KINDS}
         for tok in (spec or "").split(","):
@@ -105,6 +118,21 @@ class ChaosPlan:
                 raise MXNetError(f"chaos: kv_flake probability {p} "
                                  "outside [0, 1]")
             self.kv_flake_p = p
+            return
+        if kind == "serve_slow":
+            if target is None:
+                raise MXNetError("chaos: serve_slow needs a delay target "
+                                 "in ms, e.g. serve_slow:0.5@20 or "
+                                 "serve_slow@20")
+            ms = float(target)
+            if ms < 0:
+                raise MXNetError(f"chaos: serve_slow delay {ms} < 0")
+            p = 1.0 if prob is None else float(prob)
+            if not 0.0 <= p <= 1.0:
+                raise MXNetError(f"chaos: serve_slow probability {p} "
+                                 "outside [0, 1]")
+            self.serve_slow_p = p
+            self.serve_slow_ms = ms
             return
         if prob is not None:
             raise MXNetError(f"chaos: {kind} takes no probability")
@@ -175,6 +203,20 @@ class ChaosPlan:
             from ..kvstore import TransientKVError
             raise TransientKVError(
                 f"chaos: injected transient {op} failure (key={key!r})")
+
+    def serve_delay_s(self) -> float:
+        """serve_slow:P@MS — seconds of injected per-batch compute delay
+        for this dispatch (0.0 when the roll misses). The serving worker
+        sleeps this long before running the model, simulating a straggler
+        batch; rolls come from the plan's seeded RNG so runs replay."""
+        if not self.serve_slow_ms:
+            return 0.0
+        with self._rng_lock:
+            if self.serve_slow_p < 1.0 and \
+                    self._rng.random() >= self.serve_slow_p:
+                return 0.0
+            self.injected["serve_slow"] += 1
+        return self.serve_slow_ms / 1000.0
 
     def on_checkpoint_complete(self, step: int, path: str) -> None:
         """ckpt_corrupt — called by CheckpointManager._write after the DONE

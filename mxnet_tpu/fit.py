@@ -44,7 +44,10 @@ _LOG = get_logger("mxnet_tpu.fit")
 
 def resumable_exit_code() -> int:
     """The 'killed but resumable' exit code (MXTPU_RESUMABLE_EXIT_CODE,
-    default 75 = BSD EX_TEMPFAIL)."""
+    default 75 = BSD EX_TEMPFAIL). Shared contract: FitLoop's preemption
+    path AND serving.ModelServer.serve_forever's SIGTERM drain both exit
+    with this code, so one relauncher policy covers trainers and
+    servers."""
     return int(env.get("MXTPU_RESUMABLE_EXIT_CODE"))
 
 

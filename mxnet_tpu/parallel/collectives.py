@@ -30,7 +30,7 @@ def allreduce(x, mesh, axis: str = "dp", op: str = "sum"):
     shard_map psum (ref: the kvstore push+pull round trip)."""
     import jax
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from .compat import shard_map
 
     def f(v):
         if op == "sum":
@@ -68,7 +68,7 @@ def _cross_process_fn(mesh, axis, op, ndim):
     function object)."""
     import jax
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from .compat import shard_map
 
     def f(v):
         red = {"sum": jax.lax.psum, "mean": jax.lax.pmean,
@@ -136,7 +136,7 @@ def cross_process_allgather(local, mesh, axis: str = "hosts"):
 def _cross_process_gather_fn(mesh, axis, ndim):
     import jax
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from .compat import shard_map
 
     def f(v):
         return jax.lax.all_gather(v[0], axis)
@@ -152,7 +152,7 @@ def device_allreduce(arrays, mesh, axis: str = "dp", op: str = "sum"):
     kvstore_nccl.h:270-296)."""
     import jax
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from .compat import shard_map
 
     specs = tuple(P(*(None,) * a.ndim) for a in arrays)
 
@@ -167,7 +167,7 @@ def device_allreduce(arrays, mesh, axis: str = "dp", op: str = "sum"):
 def allgather(x, mesh, axis: str = "dp", tiled_axis: int = 0):
     import jax
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from .compat import shard_map
 
     in_spec = [None] * x.ndim
     in_spec[tiled_axis] = axis
@@ -180,7 +180,7 @@ def allgather(x, mesh, axis: str = "dp", tiled_axis: int = 0):
 def reduce_scatter(x, mesh, axis: str = "dp", scatter_axis: int = 0):
     import jax
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from .compat import shard_map
 
     out_spec = [None] * x.ndim
     out_spec[scatter_axis] = axis
@@ -196,7 +196,7 @@ def broadcast(x, mesh, axis: str = "dp", root: int = 0):
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from .compat import shard_map
 
     def f(v):
         idx = jax.lax.axis_index(axis)
@@ -212,7 +212,7 @@ def ppermute_ring(x, mesh, axis: str = "sp", shift: int = 1):
     """Ring rotation along an axis — the building block of ring attention."""
     import jax
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from .compat import shard_map
 
     n = dict(zip(mesh.axis_names, mesh.devices.shape))[axis]
     perm = [(i, (i + shift) % n) for i in range(n)]
@@ -230,7 +230,7 @@ def all_to_all(x, mesh, axis: str = "sp", split_axis: int = 1,
     """DeepSpeed-Ulysses style axis exchange for sequence parallelism."""
     import jax
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from .compat import shard_map
 
     in_spec = [None] * x.ndim
     in_spec[concat_axis] = axis

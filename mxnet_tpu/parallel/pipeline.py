@@ -77,8 +77,9 @@ def pipeline_apply(stage_fn: Callable, stage_params: Any, x, mesh,
         outs = jnp.where(stage == S - 1, outs, jnp.zeros_like(outs))
         return jax.lax.psum(outs, axis)
 
-    f = jax.shard_map(local_fn, mesh=mesh,
-                      in_specs=(P(axis), P()), out_specs=P(),
-                      axis_names={axis}, check_vma=False)
+    from .compat import shard_map
+    f = shard_map(local_fn, mesh=mesh,
+                  in_specs=(P(axis), P()), out_specs=P(),
+                  axis_names={axis}, check_vma=False)
     y = f(stage_params, mb)
     return y.reshape(B, *y.shape[2:])

@@ -47,7 +47,7 @@ def ring_attention(q, k, v, mesh, axis: str = "sp", causal: bool = False,
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from .compat import shard_map
 
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     n = sizes[axis]

@@ -47,7 +47,7 @@ def _lookup_fn(mesh, axis, rows_per_shard):
     must run under jit on multi-host meshes — see collectives.py)."""
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from .compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     def body(local_table, ids):
@@ -92,7 +92,7 @@ def sharded_lookup(table, ids, mesh, axis: str = "mp"):
 def _scatter_add_fn(mesh, axis, rows_per_shard):
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from .compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     def body(local_table, ids, rows):

@@ -27,8 +27,8 @@ from .base import MXNetError, check, env
 
 __all__ = ["set_config", "set_state", "state", "dump", "dumps", "pause",
            "resume", "Domain", "Task", "Event", "Frame", "Counter",
-           "Marker", "record_span", "start_xla_trace", "stop_xla_trace",
-           "set_kvstore_handle"]
+           "Marker", "record_span", "events", "start_xla_trace",
+           "stop_xla_trace", "set_kvstore_handle"]
 
 # dist kvstore registered at creation; profile_process='server' commands
 # ride its worker command channel (ref: python/mxnet/profiler.py:27-31
@@ -120,6 +120,17 @@ def record_span(name: str, category: str, t_start: float, t_end: float,
         })
         if _config.get("aggregate_stats"):
             _agg[f"{category}::{name}"].append((t_end - t_start) * 1e3)
+
+
+def events(category: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Snapshot of recorded trace events, optionally filtered by category
+    — lets subsystems (e.g. serving's metrics plane) and tests inspect
+    their spans without round-tripping through a dump file."""
+    with _lock:
+        evs = list(_events)
+    if category is None:
+        return evs
+    return [e for e in evs if e.get("cat") == category]
 
 
 def dump(finished: bool = True, profile_process: str = "worker") -> None:

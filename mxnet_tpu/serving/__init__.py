@@ -1,0 +1,38 @@
+"""mxnet_tpu.serving — the online inference subsystem.
+
+The reference pairs MXNet with an external model server (MMS/multi-model-
+server: dynamic batching, warm workers, a metrics sidecar). Here serving
+is a first-class in-process subsystem, built for the TPU cost model where
+the two dominant taxes are per-dispatch overhead (amortized by the dynamic
+batcher) and per-signature XLA compiles (bounded by shape buckets + the
+compiled-signature cache).
+
+Pieces (one module each):
+
+- :mod:`.batcher` — bucketing/padding policy + typed admission errors
+  (``QueueFull``, ``DeadlineExceeded``, ``NoBucket``, ``ServerClosed``).
+- :mod:`.cache` — ``SignatureCache``: warm CachedOp executables per
+  (item shape, batch bucket), LRU-bounded, counted.
+- :mod:`.server` — ``ModelServer``: worker threads, bounded admission,
+  deadlines, SIGTERM drain with the resumable exit code.
+- :mod:`.metrics` — ``ServerMetrics``: latency/batch/queue histograms,
+  Prometheus text + JSON export, profiler spans per dispatch.
+
+Quick start::
+
+    server = serving.ModelServer(net, bucket_shapes=[(3, 224, 224)])
+    server.warmup()
+    fut = server.submit(image)          # -> PredictionFuture
+    probs = fut.result(timeout=1.0)
+    print(server.metrics_text())        # Prometheus exposition
+"""
+from .batcher import (Batch, BucketTable, DeadlineExceeded,  # noqa: F401
+                      NoBucket, PredictionFuture, QueueFull, Request,
+                      ServerClosed, ServingError, batch_buckets, pad_rows)
+from .cache import SignatureCache  # noqa: F401
+from .metrics import ServerMetrics  # noqa: F401
+from .server import ModelServer  # noqa: F401
+
+__all__ = ["ModelServer", "SignatureCache", "ServerMetrics", "ServingError",
+           "QueueFull", "DeadlineExceeded", "NoBucket", "ServerClosed",
+           "PredictionFuture", "BucketTable", "batch_buckets", "pad_rows"]

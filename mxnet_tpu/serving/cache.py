@@ -1,0 +1,92 @@
+"""Compiled-signature cache for serving: warm executables per bucket.
+
+Layered on :class:`~mxnet_tpu.cached_op.CachedOp` — the whole-graph XLA
+compile-and-replay executor — with the serving-specific pieces on top:
+
+- an **LRU bound** sized to the bucket set (the batcher guarantees a
+  closed signature set, so the bound is a guard rail, not a working
+  policy; see ``CachedOp(cache_size=...)``),
+- **explicit warmup**: :meth:`SignatureCache.warmup` drives a zero batch
+  through every (item shape, batch bucket) combination up front, so the
+  first real request never pays a multi-second XLA compile,
+- **hit/miss/evict counters** surfaced to the metrics plane via
+  :meth:`cache_info` (a CachedOp miss == one trace + compile, which is how
+  the serving tests count compiles).
+
+A plain callable (no gluon Parameters) is accepted too and invoked
+directly — useful for tests and for pre-jitted jax functions; counters
+then track signatures seen rather than compiles.
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Callable, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..base import MXNetError
+from ..cached_op import CachedOp, CacheInfo
+
+__all__ = ["SignatureCache"]
+
+
+class SignatureCache:
+    """Executable cache keyed on (item shape, batch bucket, dtype)."""
+
+    def __init__(self, model, cache_size: Optional[int] = None):
+        self._lock = threading.Lock()
+        self._is_block = hasattr(model, "collect_params")
+        if self._is_block:
+            self._op: Optional[CachedOp] = CachedOp(model,
+                                                    cache_size=cache_size)
+            self._fn: Callable = self._op.__call__
+        else:
+            if not callable(model):
+                raise MXNetError(
+                    f"SignatureCache needs a gluon Block or a callable, "
+                    f"got {type(model).__name__}")
+            self._op = None
+            self._fn = model
+            self._seen: "OrderedDict[Tuple, None]" = OrderedDict()
+            self._plain_hits = 0
+            self._plain_misses = 0
+
+    # -----------------------------------------------------------------
+    def __call__(self, batch_nd):
+        """Run one padded batch (NDArray in, NDArray/tuple out)."""
+        if self._op is None:
+            key = (tuple(batch_nd.shape), str(batch_nd.dtype))
+            with self._lock:
+                if key in self._seen:
+                    self._plain_hits += 1
+                else:
+                    self._seen[key] = None
+                    self._plain_misses += 1
+        return self._fn(batch_nd)
+
+    def warmup(self, item_shapes: Sequence[Tuple[int, ...]],
+               batch_sizes: Sequence[int],
+               dtype: str = "float32") -> int:
+        """Compile every (item shape, batch bucket) signature by running a
+        zero batch through the model. Returns the number of executables
+        compiled (signatures that were not already resident)."""
+        from ..ndarray import ndarray as _nd
+        before = self.cache_info().misses
+        for shape in item_shapes:
+            for b in batch_sizes:
+                x = _nd.array(np.zeros((int(b),) + tuple(shape), np.dtype(dtype)))
+                out = self(x)
+                # force the compile + execution to finish now, not on the
+                # first real request
+                outs = out if isinstance(out, (list, tuple)) else (out,)
+                for o in outs:
+                    o.asnumpy()
+        return self.cache_info().misses - before
+
+    def cache_info(self) -> CacheInfo:
+        if self._op is not None:
+            return self._op.cache_info()
+        with self._lock:
+            return CacheInfo(self._plain_hits, self._plain_misses, 0,
+                             len(self._seen), None)

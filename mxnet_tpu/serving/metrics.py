@@ -1,0 +1,330 @@
+"""Serving metrics plane: latency histograms, counters, gauges.
+
+The reference pairs MXNet with a model server (MMS) whose ops story is a
+metrics sidecar (mms/metrics/*: request counts, latency, queue time,
+worker memory — logged and scraped). Here the metrics plane is in-process
+and first-class: every request is timed in three components,
+
+- ``queue``    — admission to batch formation (waiting for peers),
+- ``batch``    — batch formation to worker pickup (waiting for a worker),
+- ``compute``  — model execution including device sync,
+
+plus an end-to-end ``total``. Batch sizes, queue depth, shed load and
+compiled-signature cache traffic are tracked alongside. Two export
+formats: Prometheus text exposition (:meth:`ServerMetrics.render_prometheus`)
+and JSON (:meth:`ServerMetrics.render_json`); batch dispatches are also
+emitted as ``profiler.record_span`` events so chrome://tracing shows the
+serving timeline next to op execution.
+
+Percentiles (p50/p95/p99) are computed from a bounded reservoir of raw
+samples — exact for short windows, a sliding approximation under sustained
+load — while the Prometheus histogram buckets are cumulative counters over
+the full lifetime, as scrapers expect.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+__all__ = ["LatencyHistogram", "Counter", "Gauge", "ServerMetrics"]
+
+# log-ish spaced, ms. Chosen to resolve both sub-ms CPU models and
+# multi-second cold compiles.
+DEFAULT_LATENCY_BUCKETS_MS = (0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
+                              250.0, 500.0, 1000.0, 2500.0, 5000.0, 10000.0)
+
+
+def _fmt(v: float) -> str:
+    """Prometheus sample value: render integers without the trailing .0."""
+    f = float(v)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+class LatencyHistogram:
+    """Thread-safe histogram: cumulative buckets for Prometheus plus a
+    bounded raw-sample reservoir for exact recent percentiles."""
+
+    def __init__(self, buckets: Iterable[float] = DEFAULT_LATENCY_BUCKETS_MS,
+                 max_samples: int = 8192):
+        self.bounds: Tuple[float, ...] = tuple(sorted(buckets))
+        self._counts = [0] * (len(self.bounds) + 1)  # last = +Inf overflow
+        self._sum = 0.0
+        self._count = 0
+        self._samples: deque = deque(maxlen=max_samples)
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            i = 0
+            for i, b in enumerate(self.bounds):
+                if value <= b:
+                    break
+            else:
+                i = len(self.bounds)
+            self._counts[i] += 1
+            self._sum += value
+            self._count += 1
+            self._samples.append(value)
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def percentile(self, q: float) -> float:
+        """Exact percentile over the sample reservoir (0 when empty)."""
+        with self._lock:
+            if not self._samples:
+                return 0.0
+            s = sorted(self._samples)
+        k = max(0, min(len(s) - 1, int(round(q / 100.0 * (len(s) - 1)))))
+        return float(s[k])
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            count, total = self._count, self._sum
+            s = sorted(self._samples)  # ONE sort for all three percentiles
+
+        def pct(q):
+            if not s:
+                return 0.0
+            k = max(0, min(len(s) - 1, int(round(q / 100.0 * (len(s) - 1)))))
+            return round(float(s[k]), 3)
+
+        return {
+            "count": count,
+            "sum": round(total, 3),
+            "mean": round(total / count, 3) if count else 0.0,
+            "p50": pct(50),
+            "p95": pct(95),
+            "p99": pct(99),
+        }
+
+    def prometheus_lines(self, name: str, help_: str) -> List[str]:
+        with self._lock:
+            counts = list(self._counts)
+            total, count = self._sum, self._count
+        lines = [f"# HELP {name} {help_}", f"# TYPE {name} histogram"]
+        cum = 0
+        for bound, c in zip(self.bounds, counts):
+            cum += c
+            lines.append(f'{name}_bucket{{le="{_fmt(bound)}"}} {cum}')
+        lines.append(f'{name}_bucket{{le="+Inf"}} {count}')
+        lines.append(f"{name}_sum {_fmt(round(total, 6))}")
+        lines.append(f"{name}_count {count}")
+        return lines
+
+
+class Counter:
+    """Monotone counter, optionally labelled (one label dimension)."""
+
+    def __init__(self, label: Optional[str] = None):
+        self.label = label
+        self._value = 0
+        self._labelled: "OrderedDict[str, int]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1, label_value: Optional[str] = None) -> None:
+        with self._lock:
+            self._value += n
+            if label_value is not None:
+                self._labelled[label_value] = \
+                    self._labelled.get(label_value, 0) + n
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+    def by_label(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._labelled)
+
+    def prometheus_lines(self, name: str, help_: str) -> List[str]:
+        lines = [f"# HELP {name} {help_}", f"# TYPE {name} counter"]
+        with self._lock:
+            if self.label and self._labelled:
+                for lv, v in self._labelled.items():
+                    lines.append(f'{name}{{{self.label}="{lv}"}} {v}')
+            else:
+                lines.append(f"{name} {self._value}")
+        return lines
+
+
+class Gauge:
+    """Point-in-time value; tracks its high-water mark."""
+
+    def __init__(self):
+        self._value = 0.0
+        self.peak = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = v
+            if v > self.peak:
+                self.peak = v
+
+    def inc(self, delta: float = 1.0) -> None:
+        """Atomic read-modify-write (set(value+1) from two threads loses
+        an increment; concurrent workers must use this)."""
+        with self._lock:
+            self._value += delta
+            if self._value > self.peak:
+                self.peak = self._value
+
+    def dec(self, delta: float = 1.0) -> None:
+        self.inc(-delta)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def prometheus_lines(self, name: str, help_: str) -> List[str]:
+        return [f"# HELP {name} {help_}", f"# TYPE {name} gauge",
+                f"{name} {_fmt(self._value)}"]
+
+
+class ServerMetrics:
+    """The full serving metrics surface for one :class:`ModelServer`.
+
+    ``cache_info_fn`` (set by the server) is polled at export time so cache
+    hit/miss/evict counters always reflect the live signature cache.
+    """
+
+    #: batch-size histogram bounds: powers of two cover every batch bucket
+    BATCH_SIZE_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
+
+    def __init__(self, name: str = "model"):
+        self.name = name
+        self.started = time.time()
+        self.requests_total = Counter()
+        self.responses_total = Counter()
+        self.rejected_total = Counter(label="reason")
+        self.batches_total = Counter()
+        self.dispatched_rows_total = Counter()
+        self.padded_rows_total = Counter()
+        self.queue_depth = Gauge()
+        self.inflight_batches = Gauge()
+        self.queue_ms = LatencyHistogram()
+        self.batch_ms = LatencyHistogram()
+        self.compute_ms = LatencyHistogram()
+        self.total_ms = LatencyHistogram()
+        self.batch_size = LatencyHistogram(buckets=self.BATCH_SIZE_BUCKETS)
+        self.cache_info_fn: Optional[Callable] = None
+
+    # -- recording helpers (one call site each in the server) ------------
+    def record_request(self) -> None:
+        self.requests_total.inc()
+
+    def record_rejection(self, reason: str) -> None:
+        self.rejected_total.inc(label_value=reason)
+
+    def record_batch(self, rows: int, padded_to: int, t_dispatch: float,
+                     t_done: float) -> None:
+        self.batches_total.inc()
+        self.dispatched_rows_total.inc(rows)
+        self.padded_rows_total.inc(padded_to - rows)
+        self.batch_size.observe(rows)
+        self.compute_ms.observe((t_done - t_dispatch) * 1000.0)
+
+    def record_response(self, t_submit: float, t_formed: float,
+                        t_dispatch: float, t_done: float) -> None:
+        self.responses_total.inc()
+        self.queue_ms.observe((t_formed - t_submit) * 1000.0)
+        self.batch_ms.observe((t_dispatch - t_formed) * 1000.0)
+        self.total_ms.observe((t_done - t_submit) * 1000.0)
+
+    # -- export -----------------------------------------------------------
+    def _cache_counts(self) -> dict:
+        if self.cache_info_fn is None:
+            return {}
+        info = self.cache_info_fn()
+        return {"hits": info.hits, "misses": info.misses,
+                "evictions": info.evictions, "entries": info.currsize,
+                "max_entries": info.maxsize}
+
+    def render_prometheus(self, prefix: str = "mxtpu_serve") -> str:
+        up = time.time() - self.started
+        lines: List[str] = []
+        lines += self.requests_total.prometheus_lines(
+            f"{prefix}_requests_total", "Requests admitted or rejected.")
+        lines += self.responses_total.prometheus_lines(
+            f"{prefix}_responses_total", "Requests answered successfully.")
+        lines += self.rejected_total.prometheus_lines(
+            f"{prefix}_rejected_total",
+            "Requests shed, by reason (queue_full|deadline|no_bucket|closed).")
+        lines += self.batches_total.prometheus_lines(
+            f"{prefix}_batches_total", "Batches dispatched to the model.")
+        lines += self.dispatched_rows_total.prometheus_lines(
+            f"{prefix}_dispatched_rows_total",
+            "Real (unpadded) rows dispatched.")
+        lines += self.padded_rows_total.prometheus_lines(
+            f"{prefix}_padded_rows_total",
+            "Padding rows added to reach a batch bucket.")
+        lines += self.queue_depth.prometheus_lines(
+            f"{prefix}_queue_depth", "Admitted requests not yet dispatched.")
+        lines += [f"# HELP {prefix}_queue_depth_peak "
+                  "High-water mark of the admission queue.",
+                  f"# TYPE {prefix}_queue_depth_peak gauge",
+                  f"{prefix}_queue_depth_peak {_fmt(self.queue_depth.peak)}"]
+        lines += self.inflight_batches.prometheus_lines(
+            f"{prefix}_inflight_batches", "Batches currently executing.")
+        lines += self.queue_ms.prometheus_lines(
+            f"{prefix}_queue_latency_ms",
+            "Admission to batch formation, milliseconds.")
+        lines += self.batch_ms.prometheus_lines(
+            f"{prefix}_batch_latency_ms",
+            "Batch formation to worker pickup, milliseconds.")
+        lines += self.compute_ms.prometheus_lines(
+            f"{prefix}_compute_latency_ms",
+            "Model execution including device sync, milliseconds.")
+        lines += self.total_ms.prometheus_lines(
+            f"{prefix}_total_latency_ms",
+            "End-to-end request latency, milliseconds.")
+        lines += self.batch_size.prometheus_lines(
+            f"{prefix}_batch_size", "Real rows per dispatched batch.")
+        cache = self._cache_counts()
+        for key in ("hits", "misses", "evictions"):
+            if key in cache:
+                lines += [f"# HELP {prefix}_cache_{key}_total "
+                          f"Compiled-signature cache {key}.",
+                          f"# TYPE {prefix}_cache_{key}_total counter",
+                          f"{prefix}_cache_{key}_total {cache[key]}"]
+        if "entries" in cache:
+            lines += [f"# HELP {prefix}_cache_entries "
+                      "Resident compiled signatures.",
+                      f"# TYPE {prefix}_cache_entries gauge",
+                      f"{prefix}_cache_entries {cache['entries']}"]
+        lines += [f"# HELP {prefix}_uptime_seconds Server uptime.",
+                  f"# TYPE {prefix}_uptime_seconds gauge",
+                  f"{prefix}_uptime_seconds {_fmt(round(up, 3))}"]
+        return "\n".join(lines) + "\n"
+
+    def render_json(self) -> dict:
+        up = max(time.time() - self.started, 1e-9)
+        return {
+            "model": self.name,
+            "uptime_s": round(up, 3),
+            "requests_total": self.requests_total.value,
+            "responses_total": self.responses_total.value,
+            "rejected": self.rejected_total.by_label(),
+            "batches_total": self.batches_total.value,
+            "dispatched_rows_total": self.dispatched_rows_total.value,
+            "padded_rows_total": self.padded_rows_total.value,
+            "queue_depth": self.queue_depth.value,
+            "queue_depth_peak": self.queue_depth.peak,
+            "throughput_rps": round(self.responses_total.value / up, 3),
+            "latency_ms": {
+                "queue": self.queue_ms.snapshot(),
+                "batch": self.batch_ms.snapshot(),
+                "compute": self.compute_ms.snapshot(),
+                "total": self.total_ms.snapshot(),
+            },
+            "batch_size": self.batch_size.snapshot(),
+            "cache": self._cache_counts(),
+        }
+
+    def render_json_text(self) -> str:
+        return json.dumps(self.render_json())

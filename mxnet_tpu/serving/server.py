@@ -1,0 +1,435 @@
+"""ModelServer: the serving runtime (threads, admission, deadlines, drain).
+
+Request life cycle::
+
+    submit() ── admission control ──> admit deque ──> batcher thread
+      │   QueueFull / NoBucket / ServerClosed          │ shape buckets,
+      │   shed HERE, typed, never queued               │ flush on size/age
+      ▼                                                ▼
+    PredictionFuture  <── worker threads <── dispatch queue (bounded)
+                           │ deadline filter BEFORE dispatch
+                           │ pad to batch bucket, one CachedOp replay
+                           └ split rows back to futures + metrics
+
+Design decisions, mirrored from the evidence in PRs 1-2:
+
+- **Backpressure, not buffering**: the admitted-but-undispatched count is
+  bounded by ``queue_depth``; excess load is rejected at ``submit`` with
+  :class:`QueueFull`. Nothing in the server blocks a client thread.
+- **Deadlines drop work before compute**: a request whose deadline expired
+  while queued is rejected by the worker *before* the batch is padded and
+  dispatched — expired work never occupies the accelerator.
+- **Graceful drain**: SIGTERM/SIGINT (or ``stop(drain=True)``) stops
+  admission, flushes every pending bucket, finishes in-flight batches,
+  then ``serve_forever`` exits with the resumable code shared with
+  ``fit.FitLoop`` so one relauncher policy covers training and serving.
+- **Chaos-testable**: an installed ``contrib.chaos`` plan with a
+  ``serve_slow:P@ms`` event delays batch compute deterministically, which
+  is how the deadline/saturation behaviors are regression-tested.
+"""
+from __future__ import annotations
+
+import queue
+import signal
+import sys
+import threading
+import time
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..base import MXNetError, env
+from ..log import get_logger
+from .batcher import (Batch, BucketTable, DeadlineExceeded, NoBucket,
+                      PredictionFuture, QueueFull, Request, ServerClosed,
+                      pad_rows)
+from .cache import SignatureCache
+from .metrics import ServerMetrics
+
+__all__ = ["ModelServer"]
+
+_LOG = get_logger("mxnet_tpu.serving")
+
+_STOP = object()  # worker sentinel
+
+
+class ModelServer:
+    """Dynamic-batching inference server over a gluon block (or callable).
+
+    Parameters
+    ----------
+    model : gluon Block (compiled per signature through CachedOp) or any
+        callable mapping a batched NDArray to an NDArray / tuple of them.
+    bucket_shapes : closed set of admissible item shapes (no batch dim);
+        None lets every observed shape open its own bucket (open signature
+        set — fine for experiments, not for production compile budgets).
+    max_batch_size / max_queue_latency_ms / queue_depth : batching policy
+        knobs; default from MXTPU_SERVE_MAX_BATCH / _MAX_LATENCY_MS /
+        _QUEUE_DEPTH.
+    workers : worker threads running model dispatch (host-side pre/post
+        overlap; XLA executions already queue device-side).
+    default_deadline_ms : per-request deadline applied when ``submit``
+        gets none; None = no deadline.
+    dtype : the server's input dtype; every admitted payload is coerced
+        to it (a python list would otherwise arrive float64 and open an
+        unwarmed XLA signature on the hot path). Uncastable payloads are
+        rejected with :class:`NoBucket`.
+    """
+
+    def __init__(self, model, bucket_shapes: Optional[Sequence] = None,
+                 max_batch_size: Optional[int] = None,
+                 max_queue_latency_ms: Optional[float] = None,
+                 queue_depth: Optional[int] = None, workers: int = 1,
+                 cache_size: Optional[int] = None,
+                 default_deadline_ms: Optional[float] = None,
+                 dtype: str = "float32", name: str = "model"):
+        if max_batch_size is None:
+            max_batch_size = int(env.get("MXTPU_SERVE_MAX_BATCH"))
+        if max_queue_latency_ms is None:
+            max_queue_latency_ms = float(env.get("MXTPU_SERVE_MAX_LATENCY_MS"))
+        if queue_depth is None:
+            queue_depth = int(env.get("MXTPU_SERVE_QUEUE_DEPTH"))
+        if queue_depth < 1:
+            raise MXNetError("queue_depth must be >= 1")
+        if int(workers) < 1:
+            raise MXNetError("workers must be >= 1 (0 workers would admit "
+                             "requests whose futures never resolve)")
+        self.name = name
+        self.dtype = np.dtype(dtype)
+        self._table = BucketTable(max_batch_size, max_queue_latency_ms,
+                                  bucket_shapes)
+        self.queue_depth = int(queue_depth)
+        self._default_deadline_ms = default_deadline_ms
+        self.cache = SignatureCache(model, cache_size=cache_size)
+        self.metrics = ServerMetrics(name)
+        self.metrics.cache_info_fn = self.cache.cache_info
+        self._cond = threading.Condition()
+        self._admit: "list[Request]" = []
+        self._queued = 0            # admitted, not yet dispatched/rejected
+        self._dispatch_q: "queue.Queue" = queue.Queue(
+            maxsize=max(2, 2 * int(workers)))
+        self._workers = int(workers)
+        self._threads: "list[threading.Thread]" = []
+        self._started = False
+        self._closed = False        # no new admissions
+        self._abort = False         # drop queued work instead of finishing
+        self._sig_event = threading.Event()
+        self._signum: Optional[int] = None
+        self._old_handlers: dict = {}
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "ModelServer":
+        with self._cond:
+            if self._started:
+                return self
+            self._started = True
+        t = threading.Thread(target=self._batcher_loop,
+                             name=f"serve-batcher[{self.name}]", daemon=True)
+        t.start()
+        self._threads.append(t)
+        for i in range(self._workers):
+            w = threading.Thread(target=self._worker_loop,
+                                 name=f"serve-worker[{self.name}]-{i}",
+                                 daemon=True)
+            w.start()
+            self._threads.append(w)
+        return self
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Stop admission; ``drain=True`` finishes everything already
+        admitted, ``drain=False`` rejects it with :class:`ServerClosed`."""
+        with self._cond:
+            self._closed = True
+            if not drain:
+                self._abort = True
+            self._cond.notify_all()
+        if not self._started:
+            return
+        deadline = time.perf_counter() + timeout
+        for t in self._threads:
+            t.join(max(0.0, deadline - time.perf_counter()))
+        alive = [t.name for t in self._threads if t.is_alive()]
+        if alive:
+            raise MXNetError(f"serving drain timed out after {timeout}s "
+                             f"(stuck threads: {alive})")
+
+    def install_signal_handlers(self) -> None:
+        """Trap SIGTERM/SIGINT (main thread only) so ``serve_forever``
+        drains and exits resumable instead of dying mid-batch."""
+        if threading.current_thread() is not threading.main_thread():
+            return
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                self._old_handlers[sig] = signal.signal(sig, self._on_signal)
+            except (ValueError, OSError):
+                pass
+
+    def _on_signal(self, signum, frame) -> None:
+        self._signum = signum
+        self._sig_event.set()
+
+    def serve_forever(self) -> None:
+        """Run until SIGTERM/SIGINT, then drain in-flight work and exit
+        with the resumable code shared with ``fit.FitLoop`` (the
+        relauncher treats a preempted server like a preempted trainer)."""
+        from ..fit import resumable_exit_code
+        self.start()
+        self.install_signal_handlers()
+        # timed wait, not wait(): a signal raised on a non-main thread
+        # only trips the C-level flag — the main thread must re-enter the
+        # bytecode loop for the python handler (which sets this event) to
+        # run at all
+        while not self._sig_event.wait(0.2):
+            pass
+        _LOG.warning("signal %s: draining serving queues", self._signum)
+        self.stop(drain=True)
+        for sig, old in self._old_handlers.items():
+            try:
+                signal.signal(sig, old)
+            except (ValueError, OSError):
+                pass
+        sys.exit(resumable_exit_code())
+
+    # -- client surface ---------------------------------------------------
+    def submit(self, x, deadline_ms: Optional[float] = None
+               ) -> PredictionFuture:
+        """Admit one example (item shape, no batch dim). Returns a
+        :class:`PredictionFuture`; raises :class:`QueueFull`,
+        :class:`NoBucket` or :class:`ServerClosed` when load is shed."""
+        if not self._started:   # benign race: start() re-checks under
+            self.start()        # the lock; avoids a hot-path acquisition
+        self.metrics.record_request()
+        if deadline_ms is None:
+            deadline_ms = self._default_deadline_ms
+        deadline = (None if deadline_ms is None
+                    else time.perf_counter() + float(deadline_ms) / 1000.0)
+        try:
+            # own the bytes (a client reusing one preallocated buffer must
+            # not mutate the queued request) AND coerce to the server
+            # dtype — the signature set must stay closed on the dtype
+            # axis, not just the shape axis
+            if hasattr(x, "asnumpy"):
+                payload = x.asnumpy().astype(self.dtype, copy=False)
+            else:
+                payload = np.array(x, dtype=self.dtype, copy=True)
+        except (TypeError, ValueError) as e:
+            self.metrics.record_rejection("no_bucket")
+            raise NoBucket(f"payload is not castable to the server dtype "
+                           f"{self.dtype}: {e}")
+        try:
+            key = self._table.key_for(payload.shape, payload.dtype)
+        except NoBucket:
+            self.metrics.record_rejection("no_bucket")
+            raise
+        with self._cond:
+            if self._closed:
+                self.metrics.record_rejection("closed")
+                raise ServerClosed(
+                    f"server {self.name!r} is draining; not admitting")
+            if self._queued >= self.queue_depth:
+                self.metrics.record_rejection("queue_full")
+                raise QueueFull(
+                    f"admission queue full ({self._queued}/"
+                    f"{self.queue_depth} requests queued) — retry with "
+                    "backoff or add capacity")
+            req = Request(payload, key, deadline)
+            self._queued += 1
+            self.metrics.queue_depth.set(self._queued)
+            self._admit.append(req)
+            self._cond.notify()
+        return req.future
+
+    def predict(self, x, deadline_ms: Optional[float] = None,
+                timeout: Optional[float] = None):
+        """Blocking convenience over :meth:`submit`."""
+        return self.submit(x, deadline_ms=deadline_ms).result(timeout)
+
+    def warmup(self, item_shapes: Optional[Sequence[Tuple[int, ...]]] = None,
+               batch_sizes: Optional[Sequence[int]] = None,
+               dtype: Optional[str] = None) -> int:
+        """Precompile (item shape x batch bucket) signatures so first
+        traffic replays instead of compiling. Returns compiles performed."""
+        if dtype is None:
+            dtype = str(self.dtype)  # warm what admission coerces to
+        if item_shapes is None:
+            if self._table.bucket_shapes is None:
+                raise MXNetError("warmup needs item_shapes when no "
+                                 "bucket_shapes were configured")
+            item_shapes = sorted(self._table.bucket_shapes)
+        if batch_sizes is None:
+            batch_sizes = self._table.batch_sizes
+        return self.cache.warmup(item_shapes, batch_sizes, dtype)
+
+    @property
+    def max_batch_size(self) -> int:
+        """The resolved batching policy (public: bench/ops tooling reads
+        these rather than reaching into the bucket table)."""
+        return self._table.max_batch_size
+
+    @property
+    def max_queue_latency_ms(self) -> float:
+        return self._table.max_latency_s * 1000.0
+
+    # -- metrics export ---------------------------------------------------
+    def reset_metrics(self) -> ServerMetrics:
+        """Swap in a fresh metrics plane (warm executables untouched) —
+        lets an offered-load sweep isolate per-load-point statistics."""
+        self.metrics = ServerMetrics(self.name)
+        self.metrics.cache_info_fn = self.cache.cache_info
+        return self.metrics
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition of the full metrics plane."""
+        return self.metrics.render_prometheus()
+
+    def metrics_json(self) -> dict:
+        return self.metrics.render_json()
+
+    @classmethod
+    def load(cls, prefix: str, epoch: int = 0, input_names=("data",),
+             ctx=None, **kwargs) -> "ModelServer":
+        """Serve an exported checkpoint (``HybridBlock.export`` layout:
+        ``prefix-symbol.json`` + ``prefix-NNNN.params``), loaded through
+        ``gluon.SymbolBlock.imports``."""
+        from ..gluon.block import SymbolBlock
+        net = SymbolBlock.imports(f"{prefix}-symbol.json", list(input_names),
+                                  f"{prefix}-{epoch:04d}.params", ctx=ctx)
+        return cls(net, **kwargs)
+
+    # -- internals --------------------------------------------------------
+    def _reject(self, req: Request, reason: str, err: Exception) -> None:
+        with self._cond:
+            self._queued -= 1
+            self.metrics.queue_depth.set(self._queued)
+        self.metrics.record_rejection(reason)
+        req.future.set_exception(err)
+
+    def _put_batch(self, batch: Batch) -> None:
+        while True:
+            try:
+                self._dispatch_q.put(batch, timeout=0.1)
+                return
+            except queue.Full:
+                if self._abort:
+                    for r in batch.requests:
+                        self._reject(r, "closed",
+                                     ServerClosed("server aborted"))
+                    return
+
+    def _batcher_loop(self) -> None:
+        table = self._table
+        while True:
+            batches: "list[Batch]" = []
+            with self._cond:
+                while not self._admit:
+                    if self._closed:
+                        break
+                    nxt = table.next_deadline()
+                    now = time.perf_counter()
+                    if nxt is not None and now >= nxt:
+                        break
+                    # untimed when nothing is aging: submit/stop notify
+                    # the condvar, so an idle server takes zero wakeups
+                    self._cond.wait(None if nxt is None
+                                    else min(0.05, nxt - now))
+                drained = list(self._admit)
+                self._admit.clear()
+                closed = self._closed
+                abort = self._abort
+            for req in drained:
+                if abort:
+                    self._reject(req, "closed", ServerClosed("server aborted"))
+                    continue
+                full = table.add(req)
+                if full is not None:
+                    batches.append(full)
+            batches.extend(table.due())
+            if closed:
+                # drain: everything still bucketed goes out now (or is
+                # rejected on abort), then the workers get their sentinels
+                final = table.flush_all()
+                if abort:
+                    for b in final:
+                        for r in b.requests:
+                            self._reject(r, "closed",
+                                         ServerClosed("server aborted"))
+                else:
+                    batches.extend(final)
+            for b in batches:
+                self._put_batch(b)
+            if closed:
+                with self._cond:
+                    empty = not self._admit
+                if empty and table.pending_count == 0:
+                    for _ in range(self._workers):
+                        self._dispatch_q.put(_STOP)
+                    return
+
+    def _worker_loop(self) -> None:
+        from .. import profiler
+        from ..contrib import chaos as _chaos
+        from ..ndarray import ndarray as _nd
+        while True:
+            batch = self._dispatch_q.get()
+            if batch is _STOP:
+                return
+            now = time.perf_counter()
+            live: "list[Request]" = []
+            for r in batch.requests:
+                if self._abort:
+                    self._reject(r, "closed", ServerClosed("server aborted"))
+                elif r.expired(now):
+                    # the whole point of deadlines: expired work is dropped
+                    # BEFORE padding/dispatch — zero accelerator time spent
+                    self._reject(r, "deadline", DeadlineExceeded(
+                        f"deadline expired {1000 * (now - r.deadline):.1f}ms "
+                        "ago while queued; request was never dispatched"))
+                else:
+                    live.append(r)
+            if not live:
+                continue
+            t_dispatch = time.perf_counter()
+            # capture ONE metrics plane per batch: reset_metrics() may
+            # swap self.metrics mid-batch, and a split inc/dec pair would
+            # wedge the fresh inflight gauge at -1
+            metrics = self.metrics
+            with self._cond:
+                self._queued -= len(live)
+                metrics.queue_depth.set(self._queued)
+            metrics.inflight_batches.inc()
+            padded_to = self._table.pad_to(len(live))
+            try:
+                plan = _chaos.active()
+                if plan is not None:
+                    delay = plan.serve_delay_s()
+                    if delay:
+                        time.sleep(delay)
+                x = pad_rows([r.payload for r in live], padded_to)
+                out = self.cache(_nd.array(x))
+                outs = tuple(out) if isinstance(out, (list, tuple)) \
+                    else (out,)
+                # asnumpy blocks until the device result is real — compute
+                # time includes the sync, same as a client would see
+                host = [o.asnumpy() for o in outs]
+                t_done = time.perf_counter()
+                for i, r in enumerate(live):
+                    rows = [h[i] for h in host]
+                    r.future.set_result(rows[0] if len(rows) == 1
+                                        else tuple(rows))
+                    metrics.record_response(r.t_submit, r.t_formed,
+                                            t_dispatch, t_done)
+                metrics.record_batch(len(live), padded_to, t_dispatch,
+                                     t_done)
+                profiler.record_span(
+                    f"serve_batch[{self.name}]", "serving", t_dispatch,
+                    t_done, args={"bucket": str(batch.key),
+                                  "rows": len(live),
+                                  "padded_to": padded_to})
+            except Exception as e:  # model error: fail the batch, not the
+                _LOG.exception("serving batch failed")        # server
+                for r in live:
+                    if not r.future.done():
+                        metrics.record_rejection("error")
+                        r.future.set_exception(e)
+            finally:
+                metrics.inflight_batches.dec()

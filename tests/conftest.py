@@ -32,3 +32,7 @@ def pytest_configure(config):
         "markers", "chaos: fault-injection resilience tests "
         "(contrib/chaos.py plans; the unmarked-slow subset is a "
         "tier-1-safe fast smoke)")
+    config.addinivalue_line(
+        "markers", "serving: inference-serving subsystem tests "
+        "(mxnet_tpu/serving: batcher, signature cache, admission, "
+        "metrics). Tier-1-safe: CPU, in-process transport, no sockets.")

@@ -309,6 +309,13 @@ def test_moe_transformer_ep_sharded_step():
 def test_pipeline_transformer_step():
     import jax
     import jax.numpy as jnp
+    if not hasattr(jax, "shard_map"):
+        # the experimental-shard_map fallback maps axis_names= to auto=,
+        # whose partial-manual lowering emits PartitionId — UNIMPLEMENTED
+        # for SPMD partitioning in this jax/XLA vintage
+        pytest.skip("partial-manual shard_map (axis_names=) needs "
+                    "top-level jax.shard_map; experimental fallback "
+                    "cannot partition PartitionId")
     from mxnet_tpu.models import transformer as tfm
     mesh = _mesh(dp=2, pp=2, ep=2)
     cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
