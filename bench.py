@@ -16,12 +16,17 @@ TPU mapping decisions (the parts that matter for MFU):
 """
 import json
 import os
+import signal
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 BASELINE_IMGS_PER_SEC = 298.51
+# global wall-clock default: must undercut the harness's own timeout with
+# margin (BENCH_r02-r05 all died rc:124 with parsed:null because the old
+# 2400 s default sat beyond it). Overridable via MXTPU_BENCH_DEADLINE_S.
+DEFAULT_DEADLINE_S = 900.0
 
 
 def log(msg):
@@ -401,6 +406,51 @@ def _enable_compile_cache():
         log("compile cache unavailable")
 
 
+def _dispatch_probe(n_params=50):
+    """Per-step optimizer-dispatch counts with aggregation on vs off.
+
+    A 50-tensor synthetic parameter set (the regime the aggregated path
+    targets: many small tensors) is stepped once per mode through the
+    gluon Trainer; `last_update_dispatches` counts compiled-call launches
+    — O(buckets) aggregated, O(params) per-param. Recorded into the
+    headline JSON so the trajectory catches launch-count regressions."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, gluon
+    from mxnet_tpu.optimizer import grouped as _grouped
+
+    rs = np.random.RandomState(0)
+
+    def one_mode(agg):
+        os.environ["MXTPU_OPTIMIZER_AGGREGATION"] = str(agg)
+        try:
+            params = []
+            for j in range(n_params):
+                p = gluon.Parameter(f"bench_p{j}", shape=(16, 4))
+                p.initialize(mx.init.Constant(0.0))
+                p.set_data(nd.array(rs.randn(16, 4).astype(np.float32)))
+                params.append(p)
+            tr = gluon.Trainer(params, "sgd",
+                               {"learning_rate": 0.1, "momentum": 0.9},
+                               kvstore=None)
+            for p in params:
+                p._grad._rebind(nd.array(
+                    rs.randn(16, 4).astype(np.float32))._data)
+                p._fresh_grad = True
+            tr.step(32)
+            return tr.last_update_dispatches
+        finally:
+            os.environ.pop("MXTPU_OPTIMIZER_AGGREGATION", None)
+
+    agg_size = _grouped.aggregation_size()
+    aggregated = one_mode(agg_size if agg_size > 0 else 4)
+    per_param = one_mode(0)
+    return {"params": n_params, "agg_size": agg_size,
+            "aggregated_dispatches": aggregated,
+            "per_param_dispatches": per_param,
+            "dispatch_reduction": round(per_param / max(1, aggregated), 2)}
+
+
 def _run_child(mode, args_rest):
     if not _init_backend():
         os._exit(1)
@@ -411,6 +461,14 @@ def _run_child(mode, args_rest):
     else:
         batch, k = int(args_rest[0]), int(args_rest[1])
         print(f"TRAIN_IPS {run(batch=batch, k_steps=k):.2f}", flush=True)
+        if os.environ.get("MXTPU_BENCH_DISPATCH_PROBE", "1") != "0":
+            try:
+                probe = _dispatch_probe()
+                print("EXTRA_ROW " + json.dumps({"update_dispatch": probe}),
+                      flush=True)
+            except Exception as e:
+                # the probe is an optional row: must never cost TRAIN_IPS
+                log(f"dispatch probe failed: {e}")
 
 
 # global wall-clock budget: the driver kills the whole bench at some
@@ -419,12 +477,53 @@ def _run_child(mode, args_rest):
 MIN_CHILD_S = 120          # don't bother launching a child below this
 _DEADLINE = [None]
 _HEADLINE_SHIPPED = [False]
+_EXTRAS = {}               # side-channel rows parsed from child stdout
+
+
+def _emit_on_signal(signum, frame):
+    """SIGTERM/SIGINT (the harness pulling the plug): a truncated run must
+    still parse. If the headline already shipped, stdout already holds a
+    good JSON line — just exit cleanly; otherwise emit an error row NOW.
+    os._exit, not sys.exit: unwinding would block on an in-flight child
+    (subprocess.run waits for it on non-timeout exceptions) and the
+    harness's kill -9 would land before any JSON did."""
+    if not _HEADLINE_SHIPPED[0]:
+        print(json.dumps({
+            "metric": "resnet50_train_imgs_per_sec",
+            "value": 0.0,
+            "unit": "img/s",
+            "vs_baseline": 0.0,
+            "error": f"terminated by signal {signum} before the train row "
+                     f"landed ({_budget_left():.0f}s of budget left)",
+        }), flush=True)
+    sys.stdout.flush()
+    os._exit(0)
 
 
 def _budget_left():
     if _DEADLINE[0] is None:
         return float("inf")
     return _DEADLINE[0] - time.time()
+
+
+def _scan_child_stdout(stdout, marker):
+    """Harvest a child's stdout: stash every EXTRA_ROW side-channel line
+    into _EXTRAS (e.g. the update-dispatch probe) and return the marker's
+    value, or None. Applied to complete AND timeout-truncated stdout, so
+    rows that printed before a stall are never lost."""
+    value = None
+    for line in stdout.splitlines():
+        if line.startswith("EXTRA_ROW "):
+            try:
+                _EXTRAS.update(json.loads(line[len("EXTRA_ROW "):]))
+            except ValueError:
+                pass
+        elif line.startswith(marker + " ") and value is None:
+            try:
+                value = float(line.split()[1])
+            except (IndexError, ValueError):
+                pass
+    return value
 
 
 def _subprocess_metric(mode, args_list, marker, timeout_s=2100,
@@ -448,12 +547,25 @@ def _subprocess_metric(mode, args_list, marker, timeout_s=2100,
                  *[str(a) for a in args_list]],
                 capture_output=True, text=True, timeout=attempt_s,
                 cwd=here, env=env)
-        except subprocess.TimeoutExpired:
+        except subprocess.TimeoutExpired as e:
+            # the child may have printed its rows BEFORE stalling (e.g.
+            # TRAIN_IPS + the probe landed, then teardown hung): salvage
+            # the partial stdout instead of discarding measurements we
+            # already paid for
+            partial = e.stdout or b""
+            if isinstance(partial, bytes):
+                partial = partial.decode("utf-8", "replace")
+            value = _scan_child_stdout(partial, marker)
+            if value is not None:
+                log(f"{marker} child timed out AFTER printing its row "
+                    f"(attempt {attempt}): salvaged")
+                return value
             log(f"{marker} child timed out (attempt {attempt})")
             return None  # a longer recompile will not beat the timeout
+        value = _scan_child_stdout(res.stdout, marker)
+        if value is not None:
+            return value
         for line in res.stdout.splitlines():
-            if line.startswith(marker + " "):
-                return float(line.split()[1])
             if line.startswith("{") and '"error"' in line:
                 # backend init failed in the child — fatal for every
                 # config; surface the real cause and stop retrying.
@@ -480,7 +592,7 @@ def main():
         # serving row is self-deadlined like the train rows; it runs
         # in-process (tiny model — a crash here has nothing to protect)
         _DEADLINE[0] = time.time() + float(
-            os.environ.get("MXTPU_BENCH_DEADLINE_S", "2400"))
+            os.environ.get("MXTPU_BENCH_DEADLINE_S", DEFAULT_DEADLINE_S))
         run_serve()
         return
     if len(sys.argv) > 1 and sys.argv[1] in ("--inference-only",
@@ -494,14 +606,21 @@ def main():
     # children own the backend; the parent stays jax-free so a child
     # crash can never take the JSON emission with it.
     # MXTPU_BENCH_DEADLINE_S: global wall-clock budget. The headline
-    # JSON line ships the moment the train row lands; the extended line
-    # (inference / int8 rows) is re-emitted only if budget remains —
-    # BENCH_r05's failure mode (rc:124, no number, because five
-    # open-loop 2100 s child timeouts stacked past the driver's budget)
-    # is structurally impossible: every child timeout is clipped to the
-    # remaining budget and the headline never waits on optional rows.
+    # JSON line ships the moment the train row lands and is RE-EMITTED
+    # after every optional row that lands (incremental extended lines) —
+    # a run truncated at any point still parses to the newest complete
+    # payload. SIGTERM/SIGINT emit an error row if nothing shipped yet.
+    # BENCH_r02-r05's failure mode (rc:124, no number: the old 2400 s
+    # default outlived the harness timeout) is structurally impossible:
+    # the default deadline undercuts the harness budget and every child
+    # timeout is clipped to what remains.
     _DEADLINE[0] = time.time() + float(
-        os.environ.get("MXTPU_BENCH_DEADLINE_S", "2400"))
+        os.environ.get("MXTPU_BENCH_DEADLINE_S", DEFAULT_DEADLINE_S))
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, _emit_on_signal)
+        except (ValueError, OSError):
+            pass
     # batch x k_steps configs, largest first; smaller fallbacks cover
     # tighter-memory chips. k_steps amortizes dispatch overhead; batch
     # amortizes per-step fixed cost.
@@ -529,18 +648,24 @@ def main():
                 "batch": batch,
                 "fused_steps": k,
             }
-            # the train number is safe on stdout NOW; optional rows
-            # below re-emit an extended line if they land in budget
+            if "update_dispatch" in _EXTRAS:
+                # the dispatch probe rode along in the train child: the
+                # per-step compiled-call launch counts with aggregation
+                # on vs off, so the trajectory catches a regression in
+                # launch count, not just img/s
+                payload["update_dispatch"] = _EXTRAS["update_dispatch"]
+            # the train number is safe on stdout NOW; each optional row
+            # that lands re-emits the extended line immediately, so a
+            # truncated run keeps everything measured so far
             print(json.dumps(payload), flush=True)
             _HEADLINE_SHIPPED[0] = True
             try:
-                extended = False
                 if os.environ.get("MXTPU_BENCH_INFERENCE", "1") != "0":
                     infer = _subprocess_metric("--inference-only", [batch],
                                                "INFERENCE_IPS")
                     if infer:
                         payload["inference_imgs_per_sec"] = round(infer, 2)
-                        extended = True
+                        print(json.dumps(payload), flush=True)
                 if os.environ.get("MXTPU_BENCH_LOWBIT", "1") != "0":
                     # the round-4/5 low-precision levers, measured into
                     # the SAME artifact so results outlive commit
@@ -555,18 +680,19 @@ def main():
                         if i8:
                             payload["inference_int8_imgs_per_sec"] = \
                                 round(i8, 2)
-                            extended = True
+                            print(json.dumps(payload), flush=True)
                     # int8-only: stacking fp8 residuals on top REGRESSES
                     # (2376 vs 2550 img/s measured r5 — the extra cast
                     # kernels break fusions); see docs/perf.md roofline
                     t8 = _subprocess_metric(
                         "--train-only", [batch, k], "TRAIN_IPS",
-                        env_extra={"MXNET_CONV_COMPUTE": "int8"})
+                        env_extra={"MXNET_CONV_COMPUTE": "int8",
+                                   # probe already ran in the headline
+                                   # train child; don't pay it twice
+                                   "MXTPU_BENCH_DISPATCH_PROBE": "0"})
                     if t8:
                         payload["train_int8_imgs_per_sec"] = round(t8, 2)
-                        extended = True
-                if extended:
-                    print(json.dumps(payload), flush=True)
+                        print(json.dumps(payload), flush=True)
             except Exception as e:
                 # optional rows must NEVER cost us the shipped headline:
                 # no config retry (a second headline), no error JSON

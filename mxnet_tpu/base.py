@@ -168,6 +168,20 @@ env.declare("MXTPU_SERVE_QUEUE_DEPTH", int, 256,
 env.declare("MXNET_HOME", str, "",
             "Root directory for datasets and model artifacts "
             "(default ~/.mxnet; ref: docs/faq/env_var.md MXNET_HOME).")
+env.declare("MXTPU_OPTIMIZER_AGGREGATION", int, 4,
+            "Multi-tensor optimizer aggregation: dense parameters are "
+            "grouped into dtype/device buckets of up to this many params "
+            "and each bucket is stepped by ONE jitted program with "
+            "donated weight/state buffers (ref: the reference's "
+            "MXNET_OPTIMIZER_AGGREGATION_SIZE, default 4). 0 disables "
+            "(per-parameter updates).")
+env.declare("MXTPU_GRAD_BUCKET_MB", float, 25.0,
+            "Gradient-allreduce bucketing: Trainer.allreduce_grads "
+            "concatenates same-dtype dense gradients into flat buffers "
+            "capped at this many MB and issues one kvstore push/pull "
+            "(one collective) per bucket instead of one per key "
+            "(ref: DDP gradient bucketing). 0 disables (per-key "
+            "push/pull).")
 
 
 def data_dir() -> str:

@@ -27,10 +27,87 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from .base import MXNetError, env
 
-__all__ = ["CachedOp", "CacheInfo", "make_scan_forward", "scan_forward"]
+__all__ = ["CachedOp", "CacheInfo", "SignatureLRU", "make_scan_forward",
+           "scan_forward"]
 
 CacheInfo = namedtuple("CacheInfo",
                        ["hits", "misses", "evictions", "currsize", "maxsize"])
+
+
+class SignatureLRU:
+    """Thread-safe signature-keyed LRU of compiled programs — the caching
+    discipline CachedOp applies to whole-graph executables, reusable by
+    any subsystem that compiles per-signature (optimizer/grouped.py's
+    bucket programs). Bounded by ``MXTPU_CACHEDOP_CACHE_SIZE`` unless an
+    explicit ``maxsize`` is given; 0 = unbounded."""
+
+    def __init__(self, maxsize: Optional[int] = None):
+        self._explicit_maxsize = maxsize
+        self._cache: "OrderedDict[Any, Any]" = OrderedDict()
+        self._lock = threading.Lock()
+        self._hits = 0
+        self._misses = 0
+        self._evictions = 0
+
+    def _bound(self) -> int:
+        if self._explicit_maxsize is not None:
+            return int(self._explicit_maxsize)
+        return int(env.get("MXTPU_CACHEDOP_CACHE_SIZE"))
+
+    def get_or_build(self, key, build):
+        """Return the cached value for ``key``, building (outside the
+        lock — ``build`` may trace/compile) and inserting on miss."""
+        with self._lock:
+            val = self._cache.get(key)
+            if val is not None:
+                self._hits += 1
+                self._cache.move_to_end(key)
+                return val
+        val = build()
+        with self._lock:
+            self._misses += 1
+            self._cache[key] = val
+            self._evict_locked()
+        return val
+
+    def get_or_insert(self, key, factory):
+        """Lock-held get-or-create for CHEAP factories (a jit wrapper, an
+        entry object — never a trace/compile): exactly one caller creates
+        the value for a key, so concurrent cold lookups cannot race two
+        half-initialized entries into existence (CachedOp's requirement)."""
+        with self._lock:
+            val = self._cache.get(key)
+            if val is not None:
+                self._hits += 1
+                self._cache.move_to_end(key)
+                return val
+            self._misses += 1
+            val = factory()
+            self._cache[key] = val
+            self._evict_locked()
+            return val
+
+    def _evict_locked(self) -> None:
+        bound = self._bound()
+        if bound > 0:
+            while len(self._cache) > bound:
+                self._cache.popitem(last=False)
+                self._evictions += 1
+
+    def cache_info(self) -> CacheInfo:
+        bound = self._bound()
+        return CacheInfo(self._hits, self._misses, self._evictions,
+                         len(self._cache), bound if bound > 0 else None)
+
+    def __len__(self) -> int:
+        # truthiness == occupancy, like the plain dict this replaced
+        # (callers probe `not op._cache` for "no entries were built")
+        return len(self._cache)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._cache.clear()
+            self._hits = self._misses = self._evictions = 0
 
 
 def _jax():
@@ -173,16 +250,15 @@ class CachedOp:
         # LRU-bounded signature cache: every distinct (shapes, dtypes,
         # train-mode, trace flags) key holds a full compiled executable, so
         # shape-churny workloads (variable batch/seq) otherwise grow
-        # without bound. 0 = unbounded.
+        # without bound. 0 = unbounded. Bookkeeping lives in SignatureLRU
+        # (shared with optimizer/grouped.py); execution runs outside its
+        # lock under _trace_rw: warm replays share a read lock (serving
+        # workers overlap), cold first executions take the write lock
+        # because the trace mutates shared Parameter storage.
         if cache_size is None:
             cache_size = int(env.get("MXTPU_CACHEDOP_CACHE_SIZE"))
         self._cache_size = int(cache_size)
-        self._cache: "OrderedDict[Tuple, _CacheEntry]" = OrderedDict()
-        # bookkeeping lock (lookup/insert/evict + counters); execution
-        # runs outside it under _trace_rw: warm replays share a read
-        # lock (serving workers overlap), cold first executions take the
-        # write lock because the trace mutates shared Parameter storage
-        self._cache_lock = threading.Lock()
+        self._cache = SignatureLRU(maxsize=self._cache_size)
         self._trace_rw = getattr(block, "_mxtpu_trace_rw", None)
         if self._trace_rw is None:
             self._trace_rw = _RWLock()
@@ -190,17 +266,12 @@ class CachedOp:
                 block._mxtpu_trace_rw = self._trace_rw
             except AttributeError:
                 pass  # slotted/exotic block: fall back to per-op lock
-        self._hits = 0
-        self._misses = 0
-        self._evictions = 0
         self._param_objs: Optional[List] = None
 
     def cache_info(self) -> CacheInfo:
         """Hit/miss/eviction counters + occupancy of the signature cache
         (shape of :func:`functools.lru_cache`'s ``cache_info``)."""
-        return CacheInfo(self._hits, self._misses, self._evictions,
-                         len(self._cache),
-                         self._cache_size if self._cache_size > 0 else None)
+        return self._cache.cache_info()
 
     # -----------------------------------------------------------------
     def _params(self) -> List:
@@ -307,21 +378,14 @@ class CachedOp:
                        # env flags read inside op impls change the traced
                        # program: toggling them must re-trace, not replay
                        _trace_time_flags())
-            with self._cache_lock:
-                entry = self._cache.get(key_sig)
-                if entry is None:
-                    self._misses += 1
-                    entry = _CacheEntry()
-                    fn = self._make_pure_fn(training, entry)
-                    entry.jitted = jax.jit(fn)
-                    self._cache[key_sig] = entry
-                    if self._cache_size > 0:
-                        while len(self._cache) > self._cache_size:
-                            self._cache.popitem(last=False)
-                            self._evictions += 1
-                else:
-                    self._hits += 1
-                    self._cache.move_to_end(key_sig)
+            def _new_entry():
+                # cheap: builds the entry + jit WRAPPER only (no trace/
+                # compile happens until the first execution below)
+                e = _CacheEntry()
+                e.jitted = jax.jit(self._make_pure_fn(training, e))
+                return e
+
+            entry = self._cache.get_or_insert(key_sig, _new_entry)
             if not entry.warm:
                 # cold entry (ours or a concurrent thread's): the first
                 # execution runs the python trace, which swaps Parameter

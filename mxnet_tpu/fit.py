@@ -241,7 +241,23 @@ class FitLoop:
                     # a second blocking sync to every step
                     import jax
                     loss_dev = loss.mean()._data
-                    if self._skip_nonfinite:
+                    fused_flag = None
+                    if self._skip_nonfinite and \
+                            hasattr(self._trainer, "update_with_sentinel"):
+                        # aggregated fast path: the finiteness check is ONE
+                        # fused reduction inside the compiled step and the
+                        # update is where-guarded on device — a non-finite
+                        # step already left params/state untouched, only
+                        # the host counters need rolling back
+                        fused_flag = self._trainer.update_with_sentinel(
+                            bs * self._loss_scale,
+                            ignore_stale_grad=self._ignore_stale_grad)
+                    if fused_flag is not None:
+                        ok, lval = jax.device_get((fused_flag, loss_dev))
+                        finite, loss_val = bool(ok), float(lval)
+                        if not finite:
+                            self._trainer.rollback_step()
+                    elif self._skip_nonfinite:
                         ok, lval = jax.device_get(
                             (self._grads_finite_flag(), loss_dev))
                         finite, loss_val = bool(ok), float(lval)
@@ -267,9 +283,10 @@ class FitLoop:
                             "skipped, loss scale -> %g",
                             result.step, self._loss_scale)
                     else:
-                        self._trainer.update(
-                            bs * self._loss_scale,
-                            ignore_stale_grad=self._ignore_stale_grad)
+                        if fused_flag is None:  # fused path already updated
+                            self._trainer.update(
+                                bs * self._loss_scale,
+                                ignore_stale_grad=self._ignore_stale_grad)
                         good_streak += 1
                         if self._scale_growth and \
                                 good_streak % self._scale_growth == 0 and \

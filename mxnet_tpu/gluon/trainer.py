@@ -4,16 +4,64 @@ Applies an Optimizer to a set of Parameters after backward. With a kvstore,
 gradients ride the communication layer (XLA collectives over the mesh — see
 kvstore.py) exactly like the reference's push/pull flow (trainer.py:327
 allreduce_grads); without one, updates are local fused ops.
+
+Aggregated hot path (ref: optimizer_op.cc:654 multi_sgd_update +
+MXNET_OPTIMIZER_AGGREGATION_SIZE): dense parameters are grouped into
+dtype/device buckets of up to ``MXTPU_OPTIMIZER_AGGREGATION`` params and
+each bucket is stepped by ONE jitted program with donated weight/state
+buffers (optimizer/grouped.py), so a step costs O(buckets) compiled-call
+launches instead of O(params). ``allreduce_grads`` likewise concatenates
+same-dtype gradients into flat buckets (``MXTPU_GRAD_BUCKET_MB``) and
+issues one kvstore push/pull — one collective — per bucket instead of one
+per key; the kvstore's retry/chaos hooks wrap each bucketed call, so fault
+semantics are preserved per bucket. Sparse (row_sparse) parameters and
+gradients always take the original per-key/per-param paths.
 """
 from __future__ import annotations
 
+import functools
 from typing import Dict, List, Optional
 
-from ..base import MXNetError, check
+from ..base import MXNetError, check, env
 from .. import optimizer as opt_mod
+from ..optimizer import grouped as _grouped
 from .parameter import Parameter, ParameterDict
 
 __all__ = ["Trainer"]
+
+
+@functools.lru_cache(maxsize=1)
+def _flatten_fn():
+    """One jitted concat of a gradient bucket into a flat wire buffer
+    (jit's own trace cache specializes per input shapes/dtypes, so a
+    single wrapper serves every bucket signature)."""
+    import jax
+    import jax.numpy as jnp
+
+    def fn(*gs):
+        return jnp.concatenate([g.ravel() for g in gs])
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=256)
+def _split_fn(sig):
+    """Inverse of :func:`_flatten_fn`. The split outputs are rebound over
+    the old per-param grad buffers (which then free), so steady-state
+    grad memory stays one copy; XLA cannot alias one flat buffer into
+    many differently-shaped outputs, so ``donate_argnums`` would only
+    warn, not help."""
+    import jax
+
+    def fn(flat):
+        out, off = [], 0
+        for shape, _ in sig:
+            n = 1
+            for s in shape:
+                n *= s
+            out.append(flat[off:off + n].reshape(shape))
+            off += n
+        return tuple(out)
+    return jax.jit(fn)
 
 
 class Trainer:
@@ -42,6 +90,15 @@ class Trainer:
         self._kv_initialized = False
         self._params_synced = False
         self._chaos_step = 0  # step clock for env-driven chaos plans
+        # per-call observability for the aggregated paths (bench + the
+        # dispatch-count regression test read these)
+        self.last_update_dispatches = 0
+        self.last_allreduce_collectives = 0
+        self._last_fused_indices: List[int] = []
+        self._last_fused_created: List[int] = []
+        # bucket keys already init'ed on the kvstore (keyed by the full
+        # shape-signature string, so a layout change mints a fresh key)
+        self._bucket_keys: Dict[str, bool] = {}
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: p for i, p in enumerate(self._params)}
@@ -101,12 +158,26 @@ class Trainer:
 
     def allreduce_grads(self):
         """Sum gradients across devices (ref: trainer.py:327). With the SPMD
-        mesh backend this is an XLA psum ridden through the kvstore."""
+        mesh backend this is an XLA psum ridden through the kvstore.
+
+        Dense gradients are bucketed: same-dtype grads are concatenated
+        into flat buffers capped at ``MXTPU_GRAD_BUCKET_MB`` and reduced
+        with ONE push/pull (one collective) per bucket (ref: kvstore key
+        flattening / DDP gradient bucketing), then split back over the old
+        per-param grad buffers (which then free) — the flat wire buffer is
+        transient, see :func:`_split_fn`. Row-sparse grads keep the
+        per-key mask-pack path."""
         if not self._kv_initialized:
             self._init_kvstore()
+        self.last_allreduce_collectives = 0
         if self._kvstore is None:
             return
         from ..ndarray import sparse as _sp
+        try:
+            bucket_mb = float(env.get("MXTPU_GRAD_BUCKET_MB"))
+        except (TypeError, ValueError):
+            bucket_mb = 0.0
+        flat_items = []
         for i, p in enumerate(self._params):
             if p.grad_req == "null":
                 continue
@@ -126,9 +197,78 @@ class Trainer:
                     self._kvstore.pull(i, packed)
                     reduced = _sp.mask_unpack(packed, g.shape)
                     g._update(reduced._data, reduced._indices)
+                    self.last_allreduce_collectives += 1
                 continue
-            self._kvstore.push(i, g)
-            self._kvstore.pull(i, g)
+            if bucket_mb > 0:
+                flat_items.append((i, g))
+            else:
+                self._kvstore.push(i, g)
+                self._kvstore.pull(i, g)
+                self.last_allreduce_collectives += 1
+        if flat_items:
+            self._allreduce_bucketed(flat_items, bucket_mb)
+
+    def _grad_buckets(self, items, bucket_mb):
+        """Deterministic same-dtype runs capped at ``bucket_mb`` MB — the
+        layout is a pure function of (param order, dtypes, cap), so the
+        kvstore keys stay stable across steps."""
+        cap = max(1, int(bucket_mb * (1 << 20)))
+        buckets, cur, cur_bytes, cur_dtype = [], [], 0, None
+        for i, g in items:
+            nbytes = g.size * g._data.dtype.itemsize
+            dt = str(g._data.dtype)
+            if cur and (dt != cur_dtype or cur_bytes + nbytes > cap):
+                buckets.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append((i, g))
+            cur_bytes += nbytes
+            cur_dtype = dt
+        if cur:
+            buckets.append(cur)
+        return buckets
+
+    def _allreduce_bucketed(self, items, bucket_mb):
+        from ..ndarray import ndarray as _nd
+        for bid, bucket in enumerate(self._grad_buckets(items, bucket_mb)):
+            if len(bucket) == 1:
+                # a lone grad (or one larger than the cap) rides its own
+                # already-initialized per-param key — no copy overhead
+                i, g = bucket[0]
+                self._kvstore.push(i, g)
+                self._kvstore.pull(i, g)
+                self.last_allreduce_collectives += 1
+                continue
+            sig = tuple((g.shape, str(g._data.dtype)) for _, g in bucket)
+            flat = _flatten_fn()(*[g._data for _, g in bucket])
+            flat_nd = _nd.NDArray(flat, ctx=bucket[0][1]._ctx)
+            # the key encodes the bucket's FULL shape signature (digest):
+            # if the layout changes mid-run (a param frozen, the MB cap
+            # changed) a fresh key gets a fresh store buffer and a fresh
+            # compressor error-feedback residual — a stale key would push
+            # a differently-laid-out flat into old state. init() is a
+            # no-op when the key already exists; superseded keys linger in
+            # the store (bounded by layout changes, not steps).
+            import hashlib
+            digest = hashlib.md5(repr(sig).encode()).hexdigest()[:10]
+            key = (f"_gbkt{bid}:{sig[0][1]}:{int(flat.shape[0])}"
+                   f":n{len(bucket)}:{digest}")
+            if key not in self._bucket_keys:
+                try:
+                    # the flat wire buffer must NOT be row-sharded by the
+                    # big-array bound — it is split back immediately
+                    self._kvstore.init(key, flat_nd, shard=False)
+                except TypeError:  # user-supplied store without shard=
+                    self._kvstore.init(key, flat_nd)
+                self._bucket_keys[key] = True
+            # retry/chaos hooks (TransientKVError backoff, kv_flake) wrap
+            # these calls per BUCKET key inside the kvstore, preserving
+            # the fault semantics of the per-key path
+            self._kvstore.push(key, flat_nd)
+            self._kvstore.pull(key, out=flat_nd)
+            self.last_allreduce_collectives += 1
+            parts = _split_fn(sig)(flat_nd._data)
+            for (_, g), arr in zip(bucket, parts):
+                g._rebind(arr)
 
     def step(self, batch_size, ignore_stale_grad=False):
         """One optimization step: rescale by 1/batch_size, allreduce, update
@@ -151,7 +291,31 @@ class Trainer:
         self._optimizer.rescale_grad = self._scale / batch_size
         self._update(ignore_stale_grad)
 
-    def _update(self, ignore_stale_grad=False):
+    def update_with_sentinel(self, batch_size, ignore_stale_grad=False):
+        """Aggregated update with the global-finiteness sentinel folded
+        into the compiled bucket programs: every update is guarded by one
+        fused all-grads-finite reduction, applied as ``where(ok, new,
+        old)`` on device. Returns the device-resident flag (fetch it with
+        the loss in one transfer; on False call :meth:`rollback_step`), or
+        None when the fused path cannot cover the whole parameter set —
+        the caller must then use the classic check-then-update flow."""
+        self._optimizer.rescale_grad = self._scale / batch_size
+        return self._update(ignore_stale_grad, sentinel=True)
+
+    def rollback_step(self):
+        """Undo the host-side effects of the last fused sentinel step (the
+        device state was already left untouched by the ``where`` guard):
+        update counters, and optimizer-state objects first materialized by
+        that step — so a skipped step is indistinguishable from the
+        per-param path's never-applied update."""
+        _grouped.rollback_counts(self._optimizer, self._last_fused_indices)
+        for i in self._last_fused_created:
+            self._updaters[0].states.pop(i, None)
+            self._updaters[0].states_synced.pop(i, None)
+        self._last_fused_indices = []
+        self._last_fused_created = []
+
+    def _update(self, ignore_stale_grad=False, sentinel=False):
         updater = self._updaters[0]
         live = [(i, p) for i, p in enumerate(self._params)
                 if p.grad_req != "null"]
@@ -160,6 +324,14 @@ class Trainer:
             # leave a half-stepped model behind a supposedly recoverable
             # error (ref: trainer.py _fresh_grad check)
             stale = [p.name for _, p in live if not p._fresh_grad]
+            if stale and sentinel:
+                # decline instead of raising: the classic flow checks
+                # finiteness FIRST and skips a non-finite step without
+                # ever reaching this pre-scan — the fused path must not
+                # turn that survivable skip into a crash. The fallback
+                # reproduces the old ordering exactly (skip silently on
+                # non-finite, raise on the next finite step).
+                return None
             if stale:
                 raise MXNetError(
                     f"gradient of parameter(s) {stale[:4]} is stale (not "
@@ -168,10 +340,49 @@ class Trainer:
                     "or step() ran twice per backward. Call backward "
                     "first, or pass ignore_stale_grad=True to skip stale "
                     "parameters. No update was applied.")
-        for i, p in live:
-            if p._fresh_grad:
-                updater(i, p.grad(), p.data())
+        todo = [(i, p) for i, p in live if p._fresh_grad]
+        self.last_update_dispatches = 0
+        agg = _grouped.aggregation_size()
+        if sentinel and (agg <= 0 or not todo or
+                         not _grouped.eligible(updater, todo)):
+            # all-or-nothing: the sentinel's single skip decision must
+            # cover the complete parameter set, so aggregation off or any
+            # ineligible param (sparse, un-grouped optimizer) declines
+            # the fused path WITHOUT touching a single parameter — the
+            # caller falls back to check-then-update
+            return None
+        handled, flag = set(), None
+        if agg > 0 and todo:
+            if sentinel:
+                # the flag must cover EVERY live grad — including stale
+                # ones skipped under ignore_stale_grad — exactly like the
+                # classic host check (FitLoop._grads_finite_flag), or the
+                # two paths would diverge on whether a step is skipped
+                sentinel_grads = tuple(p._grad._data for _, p in live
+                                       if p._grad is not None)
+                idxs, n, flag, created = _grouped.grouped_update(
+                    updater, todo, agg, sentinel=True,
+                    sentinel_grads=sentinel_grads)
+                handled = set(idxs)
+                self._last_fused_indices = idxs
+                self._last_fused_created = created
+                self.last_update_dispatches += n + 1  # + finite reduction
+            else:
+                dense = [(i, p) for i, p in todo
+                         if _grouped.eligible(updater, [(i, p)])]
+                if dense:
+                    idxs, n, _, _ = _grouped.grouped_update(updater, dense,
+                                                            agg)
+                    handled = set(idxs)
+                    self.last_update_dispatches += n
+        for i, p in todo:
+            if i in handled:
                 p._fresh_grad = False
+                continue
+            updater(i, p.grad(), p.data())
+            p._fresh_grad = False
+            self.last_update_dispatches += 1
+        return flag
 
     def save_states(self, fname):
         with open(fname, "wb") as f:

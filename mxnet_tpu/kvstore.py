@@ -122,13 +122,16 @@ class KVStoreBase:
         return len(jax.devices())
 
     # -- core ops -------------------------------------------------------
-    def init(self, key, value) -> None:
+    def init(self, key, value, shard: bool = True) -> None:
+        """``shard=False`` opts a key out of the big-array row sharding —
+        used for transient flat gradient-bucket buffers that are split
+        right back apart (Trainer._allreduce_bucketed)."""
         for k, vals in _group(key, value):
             if k in self._store:
                 continue
             v = vals[0]
             v = v.copy() if isinstance(v, _nd.NDArray) else _nd.array(v)
-            self._store[k] = self._maybe_shard(v)
+            self._store[k] = self._maybe_shard(v) if shard else v
 
     def _maybe_shard(self, v: _nd.NDArray) -> _nd.NDArray:
         """Row-shard big tables across this process's local devices (ref:

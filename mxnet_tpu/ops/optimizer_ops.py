@@ -266,6 +266,79 @@ def _multi_mp_sgd_mom_update(*tensors, lrs=(), wds=(), momentum=0.0,
     return tuple(ws) + tuple(moms) + tuple(w32s)
 
 
+def _multi_adam_nout(n_inputs, params):
+    return 3 * int(params.get("num_weights", n_inputs // 4))
+
+
+@register("multi_adam_update", num_outputs=_multi_adam_nout, variadic=True)
+def _multi_adam_update(*tensors, lrs=(), wds=(), beta1=0.9, beta2=0.999,
+                       epsilon=1e-8, rescale_grad=1.0, clip_gradient=-1.0,
+                       num_weights=1):
+    """Multi-tensor Adam over (weight, grad, mean, var) quadruplets
+    (extends the reference's multi_sgd family — optimizer_op.cc:654 — to
+    Adam; ``lrs`` arrive bias-corrected like the single-tensor op). The
+    Trainer hot path uses the signature-cached pytree programs of
+    optimizer/grouped.py built from the SAME per-param kernel; this op is
+    the imperative/symbolic surface of the fused group update."""
+    ws, ms, vs = [], [], []
+    for i in range(num_weights):
+        w, g, m, v = tensors[4 * i:4 * i + 4]
+        nw, nm, nv = _adam_update(w, g, m, v, lr=lrs[i], beta1=beta1,
+                                  beta2=beta2, epsilon=epsilon, wd=wds[i],
+                                  rescale_grad=rescale_grad,
+                                  clip_gradient=clip_gradient)
+        ws.append(nw)
+        ms.append(nm)
+        vs.append(nv)
+    return tuple(ws) + tuple(ms) + tuple(vs)
+
+
+def _multi_nag_nout(n_inputs, params):
+    return 2 * int(params.get("num_weights", n_inputs // 3))
+
+
+@register("multi_nag_mom_update", num_outputs=_multi_nag_nout, variadic=True)
+def _multi_nag_mom_update(*tensors, lrs=(), wds=(), momentum=0.0,
+                          rescale_grad=1.0, clip_gradient=-1.0,
+                          num_weights=1):
+    """Multi-tensor Nesterov momentum over (weight, grad, mom) triplets
+    (the reference ships preloaded_multi_sgd variants; NAG rides the same
+    grouping here)."""
+    ws, moms = [], []
+    for i in range(num_weights):
+        w, g, m = tensors[3 * i:3 * i + 3]
+        nw, nm = _nag_mom_update(w, g, m, lr=lrs[i], momentum=momentum,
+                                 wd=wds[i], rescale_grad=rescale_grad,
+                                 clip_gradient=clip_gradient)
+        ws.append(nw)
+        moms.append(nm)
+    return tuple(ws) + tuple(moms)
+
+
+def _multi_rmsprop_nout(n_inputs, params):
+    return 2 * int(params.get("num_weights", n_inputs // 3))
+
+
+@register("multi_rmsprop_update", num_outputs=_multi_rmsprop_nout,
+          variadic=True)
+def _multi_rmsprop_update(*tensors, lrs=(), wds=(), gamma1=0.95,
+                          epsilon=1e-8, rescale_grad=1.0,
+                          clip_gradient=-1.0, clip_weights=-1.0,
+                          num_weights=1):
+    """Multi-tensor RMSProp over (weight, grad, n) triplets."""
+    ws, ns = [], []
+    for i in range(num_weights):
+        w, g, n = tensors[3 * i:3 * i + 3]
+        nw, nn = _rmsprop_update(w, g, n, lr=lrs[i], gamma1=gamma1,
+                                 epsilon=epsilon, wd=wds[i],
+                                 rescale_grad=rescale_grad,
+                                 clip_gradient=clip_gradient,
+                                 clip_weights=clip_weights)
+        ws.append(nw)
+        ns.append(nn)
+    return tuple(ws) + tuple(ns)
+
+
 @register("_contrib_group_adagrad_update", dynamic_params=("lr",),
           aliases=("group_adagrad_update",), num_outputs=2)
 def _group_adagrad_update(weight, grad, history, lr=0.01, rescale_grad=1.0,

@@ -2,4 +2,5 @@
 from .optimizer import *  # noqa: F401,F403
 from .optimizer import Optimizer, create, register, get_updater, Updater  # noqa: F401
 from . import contrib  # noqa: F401
+from . import grouped  # noqa: F401  (aggregated multi-tensor updates)
 from .contrib import GroupAdaGrad  # noqa: F401

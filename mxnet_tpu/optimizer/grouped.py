@@ -1,0 +1,482 @@
+"""Aggregated (multi-tensor) optimizer updates.
+
+Reference: src/operator/optimizer_op.cc:654 multi_sgd_update and the
+``MXNET_OPTIMIZER_AGGREGATION_SIZE`` knob — the reference fuses groups of
+small parameters into one kernel launch because per-parameter dispatch
+dominates step time on models with hundreds of tensors.
+
+TPU-native version: a whole dtype/device bucket of parameters is stepped by
+ONE jitted pytree-level program per (optimizer, bucket signature), cached by
+signature the way :class:`~mxnet_tpu.cached_op.CachedOp` caches compiled
+graphs, so regrouping/resharding re-uses programs instead of recompiling
+every step. Weight and optimizer-state buffers are **donated** into the
+program (``donate_argnums``) so the update stops double-buffering optimizer
+memory; gradients are NOT donated (they stay readable for the sentinel,
+chaos hooks and user inspection, exactly like the per-parameter path).
+
+The per-parameter update math is the SAME pure function the per-parameter
+ops use (``ops/optimizer_ops.py``), so the aggregated step is numerically
+the per-parameter step minus the dispatch overhead. The FitLoop
+global-finiteness sentinel folds in: one fused reduction over every
+gradient produces a device flag, and each bucket program guards its
+updates with ``where(ok, new, old)`` — a non-finite step costs zero
+parameter bytes and the host only fetches one scalar.
+"""
+from __future__ import annotations
+
+import functools
+import math as _math
+import operator
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as _np
+
+from ..base import MXNetError, check, env
+
+__all__ = ["aggregation_size", "eligible", "grouped_update",
+           "global_finite_flag", "rollback_counts", "cache_info",
+           "clear_cache"]
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def aggregation_size() -> int:
+    """Bucket-size cap from ``MXTPU_OPTIMIZER_AGGREGATION`` (0 = off)."""
+    try:
+        return int(env.get("MXTPU_OPTIMIZER_AGGREGATION"))
+    except (TypeError, ValueError):
+        return 0
+
+
+# ---------------------------------------------------------------------------
+# Per-optimizer grouping rules.
+#
+# A rule maps one parameter's (weight, grad, state arrays, lr, wd, rescale)
+# to (new weight, new state arrays) using the SAME kernel function the
+# per-parameter path invokes. ``statics`` is every hyper-parameter baked
+# into the traced program — part of the cache key.
+# ---------------------------------------------------------------------------
+
+_RULES: Dict[str, Any] = {}
+
+
+class _Rule:
+    __slots__ = ("name", "statics", "make_kernel")
+
+    def __init__(self, name, statics, make_kernel):
+        self.name = name
+        self.statics = statics          # opt -> hashable tuple
+        self.make_kernel = make_kernel  # (opt, has_state) -> kernel fn
+
+
+def _rule(cls_name, statics, make_kernel):
+    _RULES[cls_name] = _Rule(cls_name, statics, make_kernel)
+
+
+def _clipv(cg):
+    return -1.0 if cg is None else float(cg)
+
+
+def _sgd_statics(opt):
+    return (float(opt.momentum), _clipv(opt.clip_gradient))
+
+
+def _sgd_kernel(opt, has_state):
+    from ..ops.optimizer_ops import _sgd_update, _sgd_mom_update
+    mom, clip = float(opt.momentum), _clipv(opt.clip_gradient)
+    if not has_state:
+        def k(w, g, states, lr, wd, rs):
+            return _sgd_update(w, g, lr=lr, wd=wd, rescale_grad=rs,
+                               clip_gradient=clip), ()
+    else:
+        def k(w, g, states, lr, wd, rs):
+            nw, nm = _sgd_mom_update(w, g, states[0], lr=lr, momentum=mom,
+                                     wd=wd, rescale_grad=rs,
+                                     clip_gradient=clip)
+            return nw, (nm,)
+    return k
+
+
+def _nag_statics(opt):
+    return (float(opt.momentum), _clipv(opt.clip_gradient))
+
+
+def _nag_kernel(opt, has_state):
+    from ..ops.optimizer_ops import _sgd_update, _nag_mom_update
+    mom, clip = float(opt.momentum), _clipv(opt.clip_gradient)
+    if not has_state:
+        # NAG without momentum degenerates to plain SGD (ref: NAG.update)
+        def k(w, g, states, lr, wd, rs):
+            return _sgd_update(w, g, lr=lr, wd=wd, rescale_grad=rs,
+                               clip_gradient=clip), ()
+    else:
+        def k(w, g, states, lr, wd, rs):
+            nw, nm = _nag_mom_update(w, g, states[0], lr=lr, momentum=mom,
+                                     wd=wd, rescale_grad=rs,
+                                     clip_gradient=clip)
+            return nw, (nm,)
+    return k
+
+
+def _adam_statics(opt):
+    return (float(opt.beta1), float(opt.beta2), float(opt.epsilon),
+            _clipv(opt.clip_gradient))
+
+
+def _adam_kernel(opt, has_state):
+    from ..ops.optimizer_ops import _adam_update
+    b1, b2, eps = float(opt.beta1), float(opt.beta2), float(opt.epsilon)
+    clip = _clipv(opt.clip_gradient)
+
+    def k(w, g, states, lr, wd, rs):
+        # lr arrives already bias-corrected (lr_t), exactly like the
+        # per-parameter path computes it host-side from the update count
+        nw, nm, nv = _adam_update(w, g, states[0], states[1], lr=lr,
+                                  beta1=b1, beta2=b2, epsilon=eps, wd=wd,
+                                  rescale_grad=rs, clip_gradient=clip)
+        return nw, (nm, nv)
+    return k
+
+
+def _rmsprop_statics(opt):
+    return (float(opt.gamma1), float(opt.gamma2), float(opt.epsilon),
+            bool(opt.centered), _clipv(opt.clip_gradient),
+            _clipv(opt.clip_weights))
+
+
+def _rmsprop_kernel(opt, has_state):
+    from ..ops.optimizer_ops import _rmsprop_update, _rmspropalex_update
+    g1, g2, eps = float(opt.gamma1), float(opt.gamma2), float(opt.epsilon)
+    clip, clipw = _clipv(opt.clip_gradient), _clipv(opt.clip_weights)
+    if not opt.centered:
+        def k(w, g, states, lr, wd, rs):
+            nw, nn = _rmsprop_update(w, g, states[0], lr=lr, gamma1=g1,
+                                     epsilon=eps, wd=wd, rescale_grad=rs,
+                                     clip_gradient=clip, clip_weights=clipw)
+            return nw, (nn,)
+    else:
+        def k(w, g, states, lr, wd, rs):
+            nw, nn, ng, nd = _rmspropalex_update(
+                w, g, states[0], states[1], states[2], lr=lr, gamma1=g1,
+                gamma2=g2, epsilon=eps, wd=wd, rescale_grad=rs,
+                clip_gradient=clip, clip_weights=clipw)
+            return nw, (nn, ng, nd)
+    return k
+
+
+_rule("SGD", _sgd_statics, _sgd_kernel)
+_rule("NAG", _nag_statics, _nag_kernel)
+_rule("Adam", _adam_statics, _adam_kernel)
+_rule("RMSProp", _rmsprop_statics, _rmsprop_kernel)
+
+
+def _rule_for(opt):
+    """Exact-type match only: a subclass may override ``update`` with
+    different math, so it must NOT silently inherit the parent's fused
+    kernel (LBSGD is whitelisted — it does not override SGD.update)."""
+    from . import optimizer as _opt
+    t = type(opt)
+    if t is _opt.SGD or t is _opt.LBSGD:
+        return _RULES["SGD"]
+    if t is _opt.NAG:
+        return _RULES["NAG"]
+    if t is _opt.Adam:
+        return _RULES["Adam"]
+    if t is _opt.RMSProp:
+        return _RULES["RMSProp"]
+    return None
+
+
+# ---------------------------------------------------------------------------
+# State flattening. ``create_state_multi_precision`` yields, per parameter:
+#   non-mp: None | NDArray | tuple[NDArray, ...]
+#   mp    : (inner_state, w32)   (active iff multi_precision and w != f32)
+# ---------------------------------------------------------------------------
+
+def _mp_active(opt, weight) -> bool:
+    return bool(opt.multi_precision) and \
+        weight._data.dtype != _np.float32
+
+
+def _flatten_inner(inner) -> List:
+    if inner is None:
+        return []
+    if isinstance(inner, (tuple, list)):
+        return [s for s in inner if s is not None]
+    return [inner]
+
+
+def _state_handles(opt, weight, state) -> Tuple[List, bool]:
+    """NDArray handles of one param's state in kernel order; last slot is
+    the f32 master weight when multi-precision is active."""
+    if _mp_active(opt, weight):
+        inner, w32 = state
+        return _flatten_inner(inner) + [w32], True
+    return _flatten_inner(state), False
+
+
+def _wrap_mp(base_kernel):
+    """Generic multi-precision wrapper, mirroring
+    ``Optimizer.update_multi_precision``: cast the grad to f32, update the
+    f32 master copy, cast the result back into the working weight."""
+    def k(w, g, states, lr, wd, rs):
+        w32 = states[-1]
+        nw32, ns = base_kernel(w32, g.astype(w32.dtype), states[:-1],
+                               lr, wd, rs)
+        return nw32.astype(w.dtype), ns + (nw32,)
+    return k
+
+
+def _with_cast(kernel, mp: bool):
+    """Cast the dynamic f32 scalars to the kernel's compute dtype so
+    low-precision params see the same arithmetic as the per-param path's
+    weak-typed python floats (a strong f32 scalar would silently promote
+    a bf16 update to f32)."""
+    def k(w, g, states, lr, wd, rs):
+        cdt = states[-1].dtype if mp else w.dtype
+        return kernel(w, g, states, lr.astype(cdt), wd.astype(cdt),
+                      rs.astype(cdt))
+    return k
+
+
+# ---------------------------------------------------------------------------
+# Signature-keyed compiled-program cache: the CachedOp discipline, shared
+# via cached_op.SignatureLRU (LRU-bounded by MXTPU_CACHEDOP_CACHE_SIZE,
+# hit/miss/eviction counters).
+# ---------------------------------------------------------------------------
+
+def _cache():
+    global _CACHE
+    if _CACHE is None:
+        from ..cached_op import SignatureLRU
+        _CACHE = SignatureLRU()
+    return _CACHE
+
+
+_CACHE = None
+
+
+def cache_info():
+    return _cache().cache_info()
+
+
+def clear_cache():
+    _cache().clear()
+
+
+def _build_bucket_fn(kernels, guarded: bool):
+    """One jitted program stepping a whole bucket.
+
+    Arguments: (lrs, wds, rescale[, ok], donated, grads) where ``donated``
+    is a tuple of per-param (weight, *state_arrays) tuples — donated to the
+    program so XLA writes updates into the same buffers — and ``grads`` is
+    the matching tuple of gradient arrays (NOT donated).
+    """
+    import jax
+    jnp = _jnp()
+
+    def step(lrs, wds, rescale, ok, donated, grads):
+        outs = []
+        for i, (bundle, g) in enumerate(zip(donated, grads)):
+            w, states = bundle[0], tuple(bundle[1:])
+            nw, ns = kernels[i](w, g, states, lrs[i], wds[i], rescale)
+            if ok is not None:
+                nw = jnp.where(ok, nw, w)
+                ns = tuple(jnp.where(ok, a, b) for a, b in zip(ns, states))
+            outs.append((nw,) + tuple(ns))
+        return tuple(outs)
+
+    if guarded:
+        def fn(lrs, wds, rescale, ok, donated, grads):
+            return step(lrs, wds, rescale, ok, donated, grads)
+        return jax.jit(fn, donate_argnums=(4,))
+
+    def fn(lrs, wds, rescale, donated, grads):
+        return step(lrs, wds, rescale, None, donated, grads)
+    return jax.jit(fn, donate_argnums=(3,))
+
+
+@functools.lru_cache(maxsize=256)
+def _finite_fn(n: int):
+    """One fused reduction: every gradient's finiteness AND-ed into a
+    single device scalar (replaces FitLoop's per-grad host check)."""
+    import jax
+    jnp = _jnp()
+
+    def fn(*grads):
+        flags = [jnp.isfinite(g).all() for g in grads]
+        return functools.reduce(operator.and_, flags)
+    return jax.jit(fn)
+
+
+def global_finite_flag(grads):
+    """Device-resident all-finite scalar over raw jax arrays (no host
+    sync; the caller fetches it together with the loss)."""
+    return _finite_fn(len(grads))(*grads)
+
+
+# ---------------------------------------------------------------------------
+# Driver
+# ---------------------------------------------------------------------------
+
+def _is_dense(p) -> bool:
+    from ..ndarray.sparse import BaseSparseNDArray
+    if p.stype != "default":
+        return False
+    g = p._grad
+    return g is not None and not isinstance(g, BaseSparseNDArray)
+
+
+def eligible(updater, items) -> bool:
+    """True when EVERY (index, Parameter) item can ride the grouped path:
+    a grouping rule exists for the optimizer and all params/grads are
+    dense. All-or-nothing by design — the fused sentinel's skip decision
+    must cover the complete parameter set or none of it."""
+    if not items:
+        return False
+    if _rule_for(updater.optimizer) is None:
+        return False
+    return all(_is_dense(p) for _, p in items)
+
+
+def _devices_key(arr) -> Tuple:
+    devs = getattr(arr, "devices", None)
+    if devs is None:
+        return ()
+    try:
+        return tuple(sorted(d.id for d in arr.devices()))
+    except Exception:
+        return ()
+
+
+def grouped_update(updater, items, agg_size: int, sentinel: bool = False,
+                   sentinel_grads=None):
+    """Apply one aggregated optimizer step to ``items`` ([(index, Parameter)]
+    with fresh dense gradients).
+
+    ``sentinel_grads``: the raw grad arrays the finiteness flag must cover
+    — the CALLER's full live set, which may be wider than ``items`` (a
+    stale param skipped under ``ignore_stale_grad`` still poisons the
+    classic host check, so it must poison the fused flag identically).
+    Defaults to the items' own grads.
+
+    Returns ``(handled_indices, n_dispatches, finite_flag, created)``
+    where ``finite_flag`` is a device scalar when ``sentinel`` and None
+    otherwise, and ``created`` lists the indices whose optimizer state was
+    first materialized by THIS call (a sentinel-skipped step must delete
+    them again — state creation is an observable side effect the
+    per-param skip path never has). Raises :class:`MXNetError` if any
+    input is sparse — callers route ``stype != 'default'`` /
+    row-sparse-grad parameters through the per-parameter loop instead.
+    """
+    opt = updater.optimizer
+    rule = _rule_for(opt)
+    check(rule is not None,
+          f"optimizer {type(opt).__name__} has no grouped-update rule")
+    for _, p in items:
+        if not _is_dense(p):
+            raise MXNetError(
+                f"grouped optimizer update requires dense parameters and "
+                f"gradients; {p.name!r} (stype={p.stype!r}) must take the "
+                "per-parameter path")
+
+    jnp = _jnp()
+    is_adam = rule.name == "Adam"
+
+    # host-side bookkeeping first (identical order to the per-param loop:
+    # every count bumps before any lr is resolved within the step)
+    created = []
+    for i, p in items:
+        if i not in updater.states:
+            updater.states[i] = opt.create_state_multi_precision(i, p.data())
+            created.append(i)
+        opt._update_count(i)
+
+    prepared = []
+    for i, p in items:
+        lr, wd = opt._get_lr(i), opt._get_wd(i)
+        if is_adam:
+            t = opt._index_update_count[i]
+            lr = lr * _math.sqrt(1 - opt.beta2 ** t) / (1 - opt.beta1 ** t)
+        handles, mp = _state_handles(opt, p, updater.states[i])
+        prepared.append((i, p, handles, mp, float(lr), float(wd)))
+
+    # bucket by (weight dtype, device placement, mp-ness), capped at
+    # agg_size, preserving parameter order within a bucket
+    buckets: "OrderedDict[Tuple, List]" = OrderedDict()
+    for ent in prepared:
+        i, p, handles, mp = ent[0], ent[1], ent[2], ent[3]
+        bkey = (str(p._data._data.dtype), _devices_key(p._data._data), mp,
+                len(handles))
+        buckets.setdefault(bkey, []).append(ent)
+
+    chunks = []
+    for ents in buckets.values():
+        for s in range(0, len(ents), max(1, agg_size)):
+            chunks.append(ents[s:s + max(1, agg_size)])
+
+    flag = None
+    if sentinel:
+        if sentinel_grads is None:
+            sentinel_grads = tuple(p._grad._data for _, p in items)
+        flag = global_finite_flag(tuple(sentinel_grads))
+
+    rescale = jnp.asarray(float(opt.rescale_grad), dtype=jnp.float32)
+    statics_key = rule.statics(opt)
+    n_dispatch = 0
+    handled = []
+    for chunk in chunks:
+        lrs = jnp.asarray([e[4] for e in chunk], dtype=jnp.float32)
+        wds = jnp.asarray([e[5] for e in chunk], dtype=jnp.float32)
+        donated, grads = [], []
+        for (_i, p, handles, _mp, _lr, _wd) in chunk:
+            donated.append((p._data._data,) +
+                           tuple(h._data for h in handles))
+            grads.append(p._grad._data)
+        donated = tuple(donated)
+        grads = tuple(grads)
+        sig = (rule.name, statics_key, bool(sentinel),
+               tuple(tuple((tuple(a.shape), str(a.dtype)) for a in bundle)
+                     for bundle in donated),
+               tuple((tuple(g.shape), str(g.dtype)) for g in grads))
+
+        def _build(chunk=chunk, s=sentinel):
+            # kernel closures are built ONLY on a signature-cache miss —
+            # the warm path (every step after the first) pays a key
+            # lookup, not O(params) closure allocations
+            kernels = []
+            for (_i2, _p2, handles2, mp2, _lr2, _wd2) in chunk:
+                n_inner = len(handles2) - (1 if mp2 else 0)
+                k = rule.make_kernel(opt, n_inner > 0)
+                if mp2:
+                    k = _wrap_mp(k)
+                kernels.append(_with_cast(k, mp2))
+            return _build_bucket_fn(tuple(kernels), s)
+
+        fn = _cache().get_or_build(sig, _build)
+        if sentinel:
+            outs = fn(lrs, wds, rescale, flag, donated, grads)
+        else:
+            outs = fn(lrs, wds, rescale, donated, grads)
+        n_dispatch += 1
+        for (i, p, handles, _mp, _lr, _wd), bundle_out in zip(chunk, outs):
+            p._data._rebind(bundle_out[0])
+            for h, arr in zip(handles, bundle_out[1:]):
+                h._rebind(arr)
+            handled.append(i)
+    return handled, n_dispatch, flag, created
+
+
+def rollback_counts(opt, indices: Sequence[int]) -> None:
+    """Undo the host-side update counters after a sentinel-skipped fused
+    step, so Adam's bias correction (and any lr scheduler) sees the same
+    ``t`` the per-parameter skip path would."""
+    for i in indices:
+        if i in opt._index_update_count:
+            opt._index_update_count[i] -= 1
+    counts = list(opt._index_update_count.values())
+    opt.num_update = max(counts + [opt.begin_num_update])

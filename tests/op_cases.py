@@ -997,6 +997,11 @@ COVERED_ELSEWHERE = {
         "multi_sgd_update", "multi_sgd_mom_update", "multi_mp_sgd_update",
         "multi_mp_sgd_mom_update", "_adamw_update", "_mp_adamw_update",
         "_sparse_adagrad_update", "_contrib_group_adagrad_update"]},
+    # aggregated multi-tensor update family (beyond SGD): parity vs the
+    # single-tensor kernels in the aggregation suite
+    **{op: "tests/test_optimizer_aggregation.py" for op in [
+        "multi_adam_update", "multi_nag_mom_update",
+        "multi_rmsprop_update"]},
     # random samplers: distribution tests
     **{op: "tests/test_operator_extended.py" for op in [
         "_random_uniform", "_random_normal", "_random_gamma",
