@@ -451,6 +451,46 @@ def _dispatch_probe(n_params=50):
             "dispatch_reduction": round(per_param / max(1, aggregated), 2)}
 
 
+def _step_breakdown_probe(steps=4, batch=64):
+    """Segment shares of a short instrumented FitLoop run (telemetry
+    subsystem): where does the step time go — data_wait / h2d / compute /
+    optimizer / comm — folded into the headline JSON so the segment
+    shares become part of the perf trajectory (an input pipeline
+    regression shows up as a data_wait share jump even when img/s only
+    drifts)."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, io as mxio, telemetry
+    from mxnet_tpu.fit import FitLoop
+    from mxnet_tpu.io.staging import DeviceStagingIter
+
+    rs = np.random.RandomState(0)
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(64, activation="relu"), gluon.nn.Dense(8))
+    net.initialize(mx.init.Xavier())
+    data = rs.randn(steps * batch, 32).astype(np.float32)
+    label = rs.randint(0, 8, (steps * batch,)).astype(np.float32)
+    train_iter = DeviceStagingIter(
+        mxio.NDArrayIter(data, label, batch_size=batch))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.01})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    was_on = telemetry.tracer.enabled  # MXTPU_PROFILE may have it on
+    telemetry.enable()
+    try:
+        loop = FitLoop(net, trainer, loss_fn, train_iter, ckpt_dir=None)
+        result = loop.fit(epochs=1)
+    finally:
+        if not was_on:
+            telemetry.disable()
+    summary = result.step_breakdown or {}
+    return {"steps": summary.get("steps", 0),
+            "mean_step_s": summary.get("mean_step_s", 0.0),
+            "shares": summary.get("shares", {}),
+            "accounted_frac": summary.get("accounted_frac", 0.0),
+            "diagnoses": summary.get("diagnoses", [])[:3]}
+
+
 def _run_child(mode, args_rest):
     if not _init_backend():
         os._exit(1)
@@ -469,6 +509,13 @@ def _run_child(mode, args_rest):
             except Exception as e:
                 # the probe is an optional row: must never cost TRAIN_IPS
                 log(f"dispatch probe failed: {e}")
+        if os.environ.get("MXTPU_BENCH_STEP_BREAKDOWN", "1") != "0":
+            try:
+                bd = _step_breakdown_probe()
+                print("EXTRA_ROW " + json.dumps({"step_breakdown": bd}),
+                      flush=True)
+            except Exception as e:
+                log(f"step breakdown probe failed: {e}")
 
 
 # global wall-clock budget: the driver kills the whole bench at some
@@ -654,6 +701,11 @@ def main():
                 # on vs off, so the trajectory catches a regression in
                 # launch count, not just img/s
                 payload["update_dispatch"] = _EXTRAS["update_dispatch"]
+            if "step_breakdown" in _EXTRAS:
+                # telemetry step-time shares from the same child: an
+                # input-pipeline or comm regression shows up as a segment
+                # share shift even when img/s only drifts
+                payload["step_breakdown"] = _EXTRAS["step_breakdown"]
             # the train number is safe on stdout NOW; each optional row
             # that lands re-emits the extended line immediately, so a
             # truncated run keeps everything measured so far

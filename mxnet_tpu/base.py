@@ -182,6 +182,17 @@ env.declare("MXTPU_GRAD_BUCKET_MB", float, 25.0,
             "(one collective) per bucket instead of one per key "
             "(ref: DDP gradient bucketing). 0 disables (per-key "
             "push/pull).")
+env.declare("MXTPU_PROFILE", str, "",
+            "Telemetry tracer spec, applied at import: comma-separated "
+            "tokens 'on'|'off'|'ring=N'|'cat=a|b'|'file=PATH' (see "
+            "telemetry.tracer). Empty = tracing off (near-zero overhead: "
+            "one flag check per span site).")
+env.declare("MXTPU_PROFILE_BOUND_FRAC", float, 0.4,
+            "Step-breakdown detector threshold: any non-compute segment "
+            "(data_wait/h2d/comm/optimizer/checkpoint) whose share of "
+            "wall-clock step time reaches this fraction logs a one-line "
+            "input-bound/comm-bound diagnosis. <=0 disables the "
+            "detector.")
 
 
 def data_dir() -> str:

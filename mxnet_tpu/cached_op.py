@@ -33,6 +33,35 @@ __all__ = ["CachedOp", "CacheInfo", "SignatureLRU", "make_scan_forward",
 CacheInfo = namedtuple("CacheInfo",
                        ["hits", "misses", "evictions", "currsize", "maxsize"])
 
+# Every live SignatureLRU (CachedOp signature caches, grouped-optimizer
+# program caches, serving signature caches) reports into the shared
+# telemetry registry as polled gauges — zero hot-path cost: the counters
+# are summed at export time, not on every lookup.
+_all_caches: "weakref.WeakSet" = None  # type: ignore[assignment]
+_track_lock = threading.Lock()
+
+
+def _track_cache(cache: "SignatureLRU") -> None:
+    global _all_caches
+    with _track_lock:
+        if _all_caches is None:
+            import weakref
+            _all_caches = weakref.WeakSet()
+            try:
+                from .telemetry import default_registry
+                reg = default_registry()
+                for field in ("hits", "misses", "evictions", "currsize"):
+                    reg.callback_gauge(
+                        f"mxtpu_cachedop_cache_{field}",
+                        (lambda f=field: sum(
+                            getattr(c.cache_info(), f)
+                            for c in list(_all_caches))),
+                        f"Sum of signature-cache {field} over all live "
+                        "compiled-program caches.")
+            except Exception:
+                pass
+        _all_caches.add(cache)
+
 
 class SignatureLRU:
     """Thread-safe signature-keyed LRU of compiled programs — the caching
@@ -48,6 +77,7 @@ class SignatureLRU:
         self._hits = 0
         self._misses = 0
         self._evictions = 0
+        _track_cache(self)
 
     def _bound(self) -> int:
         if self._explicit_maxsize is not None:

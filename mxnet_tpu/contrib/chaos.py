@@ -52,6 +52,19 @@ from ..base import MXNetError, env
 __all__ = ["ChaosKilled", "ChaosPlan", "install", "uninstall", "active"]
 
 
+def _count_injection(kind: str) -> None:
+    """Mirror a fired fault into the shared telemetry registry (the
+    per-plan ``injected`` dict stays the test-facing source of truth)."""
+    try:
+        from ..telemetry import default_registry
+        default_registry().counter(
+            "mxtpu_chaos_injections_total",
+            "Chaos faults actually fired, by kind.",
+            label="kind").inc(label_value=kind)
+    except Exception:
+        pass
+
+
 class ChaosKilled(MXNetError):
     """Simulated abrupt worker death (``kill@step``): the process 'dies'
     with nothing flushed. Deliberately NOT caught by FitLoop — recovery is
@@ -163,6 +176,7 @@ class ChaosPlan:
             return False
         self._at[kind].discard(self._step)
         self.injected[kind] += 1
+        _count_injection(kind)
         return True
 
     # -- injection actions ----------------------------------------------
@@ -200,6 +214,7 @@ class ChaosPlan:
         succeeds for P < 1)."""
         if self.kv_flake_p and self._rng.random() < self.kv_flake_p:
             self.injected["kv_flake"] += 1
+            _count_injection("kv_flake")
             from ..kvstore import TransientKVError
             raise TransientKVError(
                 f"chaos: injected transient {op} failure (key={key!r})")
@@ -216,6 +231,7 @@ class ChaosPlan:
                     self._rng.random() >= self.serve_slow_p:
                 return 0.0
             self.injected["serve_slow"] += 1
+        _count_injection("serve_slow")
         return self.serve_slow_ms / 1000.0
 
     def on_checkpoint_complete(self, step: int, path: str) -> None:

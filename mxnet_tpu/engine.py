@@ -164,10 +164,14 @@ class NativeEngine:
                 pass
 
     def wait_for_var(self, var, version: int = 0) -> None:
-        self._lib.mxtpu_engine_wait_var(self._h, var, version)
+        # a closed engine (interpreter-shutdown teardown order) has
+        # nothing left to wait on; blocking would hang process exit
+        if self._h:
+            self._lib.mxtpu_engine_wait_var(self._h, var, version)
 
     def wait_all(self) -> None:
-        self._lib.mxtpu_engine_wait_all(self._h)
+        if self._h:
+            self._lib.mxtpu_engine_wait_all(self._h)
         self._keepalive.clear()
 
     def var_version(self, var) -> int:

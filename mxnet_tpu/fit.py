@@ -36,6 +36,7 @@ from .base import MXNetError, check, env
 from .log import get_logger
 from . import fault
 from .contrib import chaos as _chaos
+from .telemetry.step_breakdown import StepBreakdown, segment as _segment
 
 __all__ = ["FitLoop", "FitResult", "resumable_exit_code"]
 
@@ -60,6 +61,7 @@ class FitResult:
     skipped_steps: List[int] = field(default_factory=list)
     loss_scale: float = 1.0
     resumed_from: Optional[int] = None  # checkpoint step, None = fresh
+    step_breakdown: Optional[dict] = None  # telemetry summary (shares)
 
 
 class FitLoop:
@@ -89,7 +91,8 @@ class FitLoop:
                  scale_growth_interval: int = 200,
                  max_loss_scale: float = 2.0 ** 16,
                  skip_nonfinite: bool = True, seed: Optional[int] = None,
-                 ignore_stale_grad: bool = False):
+                 ignore_stale_grad: bool = False,
+                 collect_breakdown: bool = True):
         check(ckpt_every >= 1, "ckpt_every must be >= 1")
         self._net = net
         self._trainer = trainer
@@ -110,6 +113,11 @@ class FitLoop:
         # passthrough to Trainer.update for nets with trainable params the
         # loss never reaches (auxiliary heads, conditional branches)
         self._ignore_stale_grad = ignore_stale_grad
+        # per-step telemetry (data_wait/h2d/compute/optimizer/comm/
+        # checkpoint + the input-bound/comm-bound detector); the summary
+        # lands in FitResult.step_breakdown. A dozen clock reads per step
+        # — leave on unless the step loop is sub-millisecond.
+        self._collect_breakdown = collect_breakdown
         self._preempted: Optional[int] = None  # signum once trapped
         self._old_handlers = {}
 
@@ -208,11 +216,23 @@ class FitLoop:
             hb = fault.Heartbeat(self._ckpt_dir,
                                  interval=self._hb_interval).start()
         self._install_handlers()
+        bd = StepBreakdown().install() if self._collect_breakdown else None
         try:
             for epoch in range(start_epoch, epochs):
                 self._position_iter(epoch)
                 consumed = 0
-                for batch in self._iter:
+                data_it = iter(self._iter)
+                while True:
+                    if bd is not None:
+                        bd.begin_step(result.step)
+                    # data_wait: blocked on the input pipeline (staging
+                    # iterators emit nested h2d spans; exclusive-time
+                    # accounting charges each second once)
+                    try:
+                        with _segment("data_wait"):
+                            batch = next(data_it)
+                    except StopIteration:
+                        break
                     if consumed < skip_batches:
                         consumed += 1  # fast-forward: replayed, not trained
                         continue
@@ -224,23 +244,26 @@ class FitLoop:
                     x = batch.data[0]
                     y = batch.label[0] if batch.label else None
                     from . import autograd
-                    with autograd.record():
-                        out = self._net(x)
-                        loss = self._loss_fn(out, y) if y is not None \
-                            else self._loss_fn(out)
-                        scaled = loss * self._loss_scale \
-                            if self._loss_scale != 1.0 else loss
-                    scaled.backward()
+                    with _segment("compute"):
+                        with autograd.record():
+                            out = self._net(x)
+                            loss = self._loss_fn(out, y) if y is not None \
+                                else self._loss_fn(out)
+                            scaled = loss * self._loss_scale \
+                                if self._loss_scale != 1.0 else loss
+                        scaled.backward()
                     if plan is not None:
                         plan.poison_grads(self._trainer._params)
                     bs = batch_size if batch_size is not None \
                         else x.shape[0]
-                    self._trainer.allreduce_grads()
+                    with _segment("comm"):
+                        self._trainer.allreduce_grads()
                     # fetch the finiteness verdict and the loss in ONE
                     # device-to-host transfer: the sentinel must not add
                     # a second blocking sync to every step
                     import jax
-                    loss_dev = loss.mean()._data
+                    with _segment("compute"):
+                        loss_dev = loss.mean()._data
                     fused_flag = None
                     if self._skip_nonfinite and \
                             hasattr(self._trainer, "update_with_sentinel"):
@@ -249,21 +272,27 @@ class FitLoop:
                         # update is where-guarded on device — a non-finite
                         # step already left params/state untouched, only
                         # the host counters need rolling back
-                        fused_flag = self._trainer.update_with_sentinel(
-                            bs * self._loss_scale,
-                            ignore_stale_grad=self._ignore_stale_grad)
+                        with _segment("optimizer"):
+                            fused_flag = self._trainer.update_with_sentinel(
+                                bs * self._loss_scale,
+                                ignore_stale_grad=self._ignore_stale_grad)
+                    # the blocking fetch realizes the whole async step
+                    # (forward/backward dominate): charged to compute
                     if fused_flag is not None:
-                        ok, lval = jax.device_get((fused_flag, loss_dev))
+                        with _segment("compute"):
+                            ok, lval = jax.device_get((fused_flag, loss_dev))
                         finite, loss_val = bool(ok), float(lval)
                         if not finite:
                             self._trainer.rollback_step()
                     elif self._skip_nonfinite:
-                        ok, lval = jax.device_get(
-                            (self._grads_finite_flag(), loss_dev))
+                        with _segment("compute"):
+                            ok, lval = jax.device_get(
+                                (self._grads_finite_flag(), loss_dev))
                         finite, loss_val = bool(ok), float(lval)
                     else:
                         finite = True
-                        loss_val = float(jax.device_get(loss_dev))
+                        with _segment("compute"):
+                            loss_val = float(jax.device_get(loss_dev))
                     if not finite:
                         # sentinel: skip the update entirely — params and
                         # optimizer state stay at the pre-step values —
@@ -284,9 +313,10 @@ class FitLoop:
                             result.step, self._loss_scale)
                     else:
                         if fused_flag is None:  # fused path already updated
-                            self._trainer.update(
-                                bs * self._loss_scale,
-                                ignore_stale_grad=self._ignore_stale_grad)
+                            with _segment("optimizer"):
+                                self._trainer.update(
+                                    bs * self._loss_scale,
+                                    ignore_stale_grad=self._ignore_stale_grad)
                         good_streak += 1
                         if self._scale_growth and \
                                 good_streak % self._scale_growth == 0 and \
@@ -298,7 +328,10 @@ class FitLoop:
                     result.step += 1
                     if cm is not None and \
                             result.step % self._ckpt_every == 0:
-                        self._save(cm, result.step, epoch, consumed)
+                        with _segment("checkpoint"):
+                            self._save(cm, result.step, epoch, consumed)
+                    if bd is not None:
+                        bd.end_step()
                 skip_batches = 0
                 result.epoch = epoch + 1
                 pos_epoch, pos_batch = epoch + 1, 0
@@ -310,10 +343,14 @@ class FitLoop:
             if cm is not None:
                 cm.wait()
         finally:
+            if bd is not None:
+                bd.uninstall()
             if hb is not None:
                 hb.stop()
             self._restore_handlers()
         result.loss_scale = self._loss_scale
+        if bd is not None and bd.steps:
+            result.step_breakdown = bd.summary()
         return result
 
     def _final_exit(self, cm, result: FitResult, epoch: int,

@@ -20,6 +20,7 @@ gradients always take the original per-key/per-param paths.
 from __future__ import annotations
 
 import functools
+import re
 from typing import Dict, List, Optional
 
 from ..base import MXNetError, check, env
@@ -64,12 +65,44 @@ def _split_fn(sig):
     return jax.jit(fn)
 
 
+@functools.lru_cache(maxsize=1)
+def _update_dispatch_counter():
+    from ..telemetry import default_registry
+    return default_registry().counter(
+        "mxtpu_update_dispatches_total",
+        "Compiled-program launches per optimizer update "
+        "(aggregated: one per dtype/device bucket).")
+
+
+@functools.lru_cache(maxsize=1)
+def _allreduce_counter():
+    from ..telemetry import default_registry
+    return default_registry().counter(
+        "mxtpu_allreduce_collectives_total",
+        "kvstore collectives issued by Trainer.allreduce_grads "
+        "(bucketed: one per gradient bucket).")
+
+
+def _natural_key(name: str):
+    """Numeric-aware sort key: ``dense9_weight`` < ``dense10_weight``.
+
+    Positional parameter indices (kvstore keys, checkpointed optimizer
+    state slots) derive from this order, and gluon block names embed a
+    process-global counter — a plain lexicographic sort flips the order
+    of structurally identical nets created at different counter values
+    (``dense10_*`` < ``dense8_*``), so a resumed run would bind restored
+    optimizer state to the wrong parameters."""
+    return [(1, int(t)) if t.isdigit() else (0, t)
+            for t in re.split(r"(\d+)", name)]
+
+
 class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None,
                  kvstore="device", compression_params=None,
                  update_on_kvstore=None):
         if isinstance(params, (dict, ParameterDict)):
-            params = [params[k] for k in sorted(params.keys())]
+            params = [params[k] for k in sorted(params.keys(),
+                                                key=_natural_key)]
         if not isinstance(params, (list, tuple)):
             raise MXNetError("params must be a ParameterDict/list of Parameter")
         self._params: List[Parameter] = []
@@ -207,6 +240,8 @@ class Trainer:
                 self.last_allreduce_collectives += 1
         if flat_items:
             self._allreduce_bucketed(flat_items, bucket_mb)
+        if self.last_allreduce_collectives:
+            _allreduce_counter().inc(self.last_allreduce_collectives)
 
     def _grad_buckets(self, items, bucket_mb):
         """Deterministic same-dtype runs capped at ``bucket_mb`` MB — the
@@ -382,6 +417,8 @@ class Trainer:
             updater(i, p.grad(), p.data())
             p._fresh_grad = False
             self.last_update_dispatches += 1
+        if self.last_update_dispatches:
+            _update_dispatch_counter().inc(self.last_update_dispatches)
         return flag
 
     def save_states(self, fname):

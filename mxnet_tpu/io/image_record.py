@@ -101,10 +101,18 @@ class ImageRecordIter(DataIter):
     def _drop_pending(self):
         """Wait out and release an unconsumed prefetched batch (its
         trampolines hold the decoded arrays — leaking them in the shared
-        engine would pin one batch per reset for the process lifetime)."""
+        engine would pin one batch per reset for the process lifetime).
+
+        At interpreter shutdown the engine's worker threads can no longer
+        enter Python (ctypes trampolines need a live interpreter), so an
+        unfinished prefetch would never complete — skip the wait and let
+        process exit reclaim everything (__del__ ordering is arbitrary at
+        finalization anyway)."""
         if self._engine is not None and self._pending is not None:
-            self._engine.wait_for_var(self._batch_var)
-            self._engine.release(self._pending[2])
+            import sys
+            if not sys.is_finalizing():
+                self._engine.wait_for_var(self._batch_var)
+                self._engine.release(self._pending[2])
             self._pending = None
 
     def close(self):

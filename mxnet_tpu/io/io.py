@@ -355,6 +355,11 @@ class ResizeIter(DataIter):
         raise StopIteration
 
 
+#: how long reset() waits for the old worker before relying on the iter
+#: lock to fence it off (patchable in tests)
+_PREFETCH_JOIN_TIMEOUT_S = 5
+
+
 class PrefetchingIter(DataIter):
     """Double-buffering thread over one or more iterators
     (ref: python/mxnet/io.py PrefetchingIter:345; the C++ analog is
@@ -368,8 +373,15 @@ class PrefetchingIter(DataIter):
         self.iters = iters
         self.rename_data = rename_data
         self.rename_label = rename_label
-        self._queue: _queue.Queue = _queue.Queue(maxsize=prefetch_depth)
+        self._depth = int(prefetch_depth)
+        self._queue: _queue.Queue = _queue.Queue(maxsize=self._depth)
         self._stop = threading.Event()
+        self._error: Optional[Exception] = None  # sticky until reset()
+        self._done = False                       # sticky until reset()
+        # serializes underlying-iterator access across worker generations:
+        # a worker that outlives reset()'s join timeout must not consume
+        # from (or race it.reset() on) the shared base iterators
+        self._iter_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._start()
 
@@ -392,23 +404,49 @@ class PrefetchingIter(DataIter):
                     for i, it in enumerate(self.iters)], [])
 
     def _start(self):
+        # the worker closes over THIS generation's queue/stop, not
+        # self.<attr>: a worker that outlives reset()'s join timeout
+        # (blocked in a slow it.next()) must publish its stale batch into
+        # the abandoned queue, never the new epoch's
+        queue = self._queue
+        stop = self._stop
+
+        def put(item) -> bool:
+            """Bounded put that stays responsive to reset(): a full queue
+            abandoned by the consumer must not wedge the worker (and
+            therefore reset's join) forever."""
+            while not stop.is_set():
+                try:
+                    queue.put(item, timeout=0.1)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
+
         def worker():
             try:
-                while not self._stop.is_set():
+                while not stop.is_set():
                     batches = []
                     try:
-                        for it in self.iters:
-                            batches.append(it.next())
+                        with self._iter_lock:
+                            if stop.is_set():
+                                # reset() won the lock first and already
+                                # rewound the base iterators — this
+                                # generation must not consume from them
+                                return
+                            for it in self.iters:
+                                batches.append(it.next())
                     except StopIteration:
-                        self._queue.put(None)
+                        put(None)
                         return
                     data = sum([b.data for b in batches], [])
                     label = sum([(b.label or []) for b in batches], [])
                     merged = DataBatch(data, label, pad=batches[0].pad,
                                        index=batches[0].index)
-                    self._queue.put(merged)
+                    if not put(merged):
+                        return
             except Exception as e:  # surface errors at next()
-                self._queue.put(e)
+                put(e)
 
         self._thread = threading.Thread(target=worker, daemon=True)
         self._thread.start()
@@ -421,18 +459,35 @@ class PrefetchingIter(DataIter):
         except _queue.Empty:
             pass
         if self._thread is not None:
-            self._thread.join(timeout=5)
-        for it in self.iters:
-            it.reset()
+            self._thread.join(timeout=_PREFETCH_JOIN_TIMEOUT_S)
+        # even if the old worker outlived the join (blocked in a slow
+        # it.next()), the iter lock waits out its one in-flight call, so
+        # the rewind below cannot interleave with it and the new epoch
+        # cannot lose a batch to the zombie
+        with self._iter_lock:
+            for it in self.iters:
+                it.reset()
         self._stop = threading.Event()
-        self._queue = _queue.Queue(maxsize=2)
+        self._error = None
+        self._done = False
+        self._queue = _queue.Queue(maxsize=self._depth)
         self._start()
 
     def next(self):
+        if self._error is not None:
+            # the worker is dead; every subsequent next() must keep
+            # surfacing the failure, not block on a queue nobody fills
+            raise self._error
+        if self._done:
+            # exhaustion is sticky too: the worker exited after its one
+            # None sentinel, so another get() would block forever
+            raise StopIteration
         item = self._queue.get()
         if item is None:
+            self._done = True
             raise StopIteration
         if isinstance(item, Exception):
+            self._error = item
             raise item
         return item
 

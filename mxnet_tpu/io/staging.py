@@ -16,6 +16,7 @@ from __future__ import annotations
 from typing import Optional
 
 from ..base import check
+from ..telemetry.step_breakdown import segment as _segment
 from .io import DataBatch, DataIter
 
 __all__ = ["DeviceStagingIter"]
@@ -78,11 +79,12 @@ class DeviceStagingIter(DataIter):
             return NDArray(jax.device_put(nd_arr._data, self._device),
                            ctx=self._ctx)
 
-        self._staged.append(DataBatch(
-            [put(d) for d in (batch.data or [])],
-            [put(l) for l in (batch.label or [])],
-            pad=batch.pad, index=getattr(batch, "index", None),
-            bucket_key=getattr(batch, "bucket_key", None)))
+        with _segment("h2d"):
+            self._staged.append(DataBatch(
+                [put(d) for d in (batch.data or [])],
+                [put(l) for l in (batch.label or [])],
+                pad=batch.pad, index=getattr(batch, "index", None),
+                bucket_key=getattr(batch, "bucket_key", None)))
         return True
 
     def next(self) -> DataBatch:

@@ -35,6 +35,7 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 
 from .base import MXNetError, check, env
 from .ndarray import ndarray as _nd
+from .telemetry.tracer import tracer as _tracer
 
 __all__ = ["KVStore", "KVStoreLocal", "KVStoreDistTPU", "TransientKVError",
            "create"]
@@ -65,11 +66,29 @@ def _retry_op(what: str, fn):
             return fn()
         except TransientKVError as e:
             attempt += 1
+            # retries are rare by construction — the registry lookup is
+            # off the happy path
+            from .telemetry import default_registry
+            default_registry().counter(
+                "mxtpu_kv_retries_total",
+                "kvstore push/pull retries after TransientKVError.",
+                label="op").inc(label_value=what)
             if attempt > max_retries:
                 raise MXNetError(
                     f"kvstore {what} still failing after {max_retries} "
                     f"retries: {e}") from e
             time.sleep(base * (2 ** (attempt - 1)))
+
+
+def _traced_retry(what: str, k, fn):
+    """One kvstore op under retry, with a per-key comm span when traced.
+    Tracing-off contract: no span-name formatting unless the tracer will
+    actually record it."""
+    if _tracer.wants("comm"):
+        with _tracer.span(f"kv_{what}:{k}", "comm"):
+            _retry_op(what, fn)
+    else:
+        _retry_op(what, fn)
 
 
 def _chaos_kv(op: str, key) -> None:
@@ -223,7 +242,8 @@ class KVStoreBase:
         # store mutation, so a retry never re-applies an updater — and a
         # failure on key N never re-runs keys < N that already applied
         for k, vals in _group(key, value):
-            _retry_op("push", lambda k=k, vals=vals: self._push_one(k, vals))
+            _traced_retry("push", k,
+                          lambda k=k, vals=vals: self._push_one(k, vals))
 
     def _push_one(self, k, vals) -> None:
         _chaos_kv("push", k)
@@ -274,7 +294,8 @@ class KVStoreBase:
              ignore_sparse: bool = True) -> None:
         check(out is not None, "pull requires out=")
         for k, outs in _group(key, out):
-            _retry_op("pull", lambda k=k, outs=outs: self._pull_one(k, outs))
+            _traced_retry("pull", k,
+                          lambda k=k, outs=outs: self._pull_one(k, outs))
 
     def _pull_one(self, k, outs) -> None:
         _chaos_kv("pull", k)

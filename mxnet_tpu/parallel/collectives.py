@@ -25,6 +25,66 @@ def _jax():
     return jax
 
 
+# -- CPU-backend cross-process fallback ------------------------------------
+# This jaxlib build cannot run multiprocess XLA computations on the CPU
+# backend ("Multiprocess computations aren't implemented on the CPU
+# backend"), which took out every dist_tpu_sync collective in CPU CI. The
+# fallback rides the jax.distributed *coordination service* key-value store
+# (the same service the processes already rendezvoused through): each rank
+# publishes its buffer, reads its peers', reduces on host, and passes a
+# barrier. Functional parity, not bandwidth — the XLA path stays the one
+# and only transport on real accelerator backends.
+
+import itertools as _itertools
+
+_coord_seq = _itertools.count()
+_COORD_TIMEOUT_MS = 120_000
+
+
+def _coord_client():
+    from jax._src import distributed
+    client = distributed.global_state.client
+    check(client is not None,
+          "cross-process collective without jax.distributed initialized")
+    return client
+
+
+def _use_coord_fallback() -> bool:
+    import jax
+    return jax.process_count() > 1 and jax.default_backend() == "cpu"
+
+
+def _coord_exchange(arr, tag: str):
+    """Publish this rank's array under ``tag`` and fetch every rank's;
+    returns the list indexed by rank. All ranks must call with the SAME
+    tag sequence (the usual SPMD collective contract)."""
+    import jax
+    import numpy as np
+    client = _coord_client()
+    rank, nproc = jax.process_index(), jax.process_count()
+    prefix = f"mxtpu_coll/{tag}"
+    arr = np.ascontiguousarray(arr)
+    client.key_value_set_bytes(f"{prefix}/{rank}", arr.tobytes())
+    parts = []
+    for r in range(nproc):
+        if r == rank:
+            parts.append(arr)
+            continue
+        buf = client.blocking_key_value_get_bytes(f"{prefix}/{r}",
+                                                  _COORD_TIMEOUT_MS)
+        parts.append(np.frombuffer(bytearray(buf),
+                                   arr.dtype).reshape(arr.shape))
+    # everyone has read everything before rank 0 garbage-collects the keys
+    client.wait_at_barrier(f"{prefix}/done", _COORD_TIMEOUT_MS)
+    if rank == 0:
+        for r in range(nproc):
+            try:
+                client.key_value_delete(f"{prefix}/{r}")
+            except Exception:
+                pass
+    return parts
+
+
 def allreduce(x, mesh, axis: str = "dp", op: str = "sum"):
     """AllReduce a replicated-per-shard array along a mesh axis using a
     shard_map psum (ref: the kvstore push+pull round trip)."""
@@ -102,6 +162,16 @@ def cross_process_allreduce(local, mesh, axis: str = "hosts",
           f"cross_process_allreduce needs a one-device-per-process mesh "
           f"(make_host_mesh); got {nproc} devices for "
           f"{jax.process_count()} processes")
+    if _use_coord_fallback():
+        parts = _coord_exchange(np.asarray(local),
+                                f"ar{next(_coord_seq)}")
+        if op == "sum":
+            return sum(parts[1:], parts[0].copy())
+        if op == "mean":
+            return sum(parts[1:], parts[0].copy()) / len(parts)
+        if op == "max":
+            return np.maximum.reduce(parts)
+        raise MXNetError(f"unknown reduce op {op}")
     local = np.asarray(local)[None]
     gshape = (nproc,) + local.shape[1:]
     garr = jax.make_array_from_process_local_data(
@@ -124,6 +194,9 @@ def cross_process_allgather(local, mesh, axis: str = "hosts"):
     check(nproc == jax.process_count(),
           f"cross_process_allgather needs a one-device-per-process mesh; "
           f"got {nproc} devices for {jax.process_count()} processes")
+    if _use_coord_fallback():
+        return np.stack(_coord_exchange(np.asarray(local),
+                                        f"ag{next(_coord_seq)}"))
     local = np.asarray(local)[None]
     gshape = (nproc,) + local.shape[1:]
     garr = jax.make_array_from_process_local_data(
@@ -253,6 +326,10 @@ def barrier(mesh=None) -> None:
         (jax.device_put(0) + 0).block_until_ready()
         return
     if jax.process_count() > 1:
+        if _use_coord_fallback():
+            _coord_client().wait_at_barrier(
+                f"mxtpu_coll/bar{next(_coord_seq)}", _COORD_TIMEOUT_MS)
+            return
         import numpy as np
         # the collective itself is the rendezvous
         cross_process_allreduce(np.zeros((), np.float32), mesh,

@@ -1,29 +1,29 @@
-"""Profiler: chrome://tracing output + aggregate stats.
+"""Profiler: MXNet-compatible facade over the telemetry subsystem.
 
 Reference: src/profiler/profiler.h (Profiler singleton, ProfileTask/Event/
 Counter/Domain objects, chrome-trace JSON default profile.json :456,
 aggregate stats table dumped by mx.profiler.dumps(); python surface
 python/mxnet/profiler.py:42-64).
 
-TPU-native: two layers of tracing.
-1. Framework level (this module): every eager op dispatch, CachedOp/
-   Executor invocation and custom scope is recorded with wall-clock spans
-   into chrome-trace JSON + an aggregate table — same artifact formats as
-   the reference.
-2. Device level: XLA/TPU execution detail comes from the JAX profiler;
-   ``start_xla_trace(logdir)`` / ``stop_xla_trace`` wrap it (TensorBoard/
-   perfetto consumable) — the analog of the reference's VTune/NVTX hooks.
+The span store, ring buffer and exporters live in
+:mod:`mxnet_tpu.telemetry` — this module keeps the reference's API shape
+(set_config/set_state/dump/dumps, Domain/Task/Event/Frame/Counter/Marker)
+and the ``profile_process='server'`` remote routing over the kvstore
+command channel (KVStoreServerProfilerCommand, include/mxnet/kvstore.h:49),
+all delegating to the shared tracer. Device-level XLA tracing
+(``start_xla_trace``/``stop_xla_trace``) wraps the JAX profiler — the
+analog of the reference's VTune/NVTX hooks.
 """
 from __future__ import annotations
 
 import atexit
 import json
-import threading
 import time
-from collections import defaultdict
 from typing import Any, Dict, List, Optional
 
 from .base import MXNetError, check, env
+from .telemetry import chrome_trace as _ct
+from .telemetry.tracer import tracer as _tracer
 
 __all__ = ["set_config", "set_state", "state", "dump", "dumps", "pause",
            "resume", "Domain", "Task", "Event", "Frame", "Counter",
@@ -45,22 +45,17 @@ def set_kvstore_handle(kv) -> None:
 def _route_server(cmd: str, body: str = "") -> bool:
     """True when the command was shipped to the remote worker group."""
     if _kvstore is None:
-        from .base import MXNetError
         raise MXNetError("profile_process='server' needs a dist kvstore "
                          "(create one first; ref: 'server can only be "
                          "profiled when kvstore is of type dist')")
     _kvstore.send_profiler_command(cmd, body)
     return True
 
-_lock = threading.Lock()
+
 _config = {"filename": "profile.json", "profile_all": False,
            "profile_symbolic": True, "profile_imperative": True,
            "profile_memory": False, "profile_api": False,
            "aggregate_stats": False, "continuous_dump": False}
-_state = {"running": False, "paused": False}
-_events: List[Dict[str, Any]] = []
-_agg: Dict[str, List[float]] = defaultdict(list)
-_t0 = time.perf_counter()
 
 
 def set_config(profile_process: str = "worker", **kwargs) -> None:
@@ -70,6 +65,7 @@ def set_config(profile_process: str = "worker", **kwargs) -> None:
         return
     for k, v in kwargs.items():
         _config[k] = v
+    _tracer.set_aggregate(bool(_config.get("aggregate_stats")))
 
 
 def set_state(state_name: str = "stop", profile_process: str = "worker") -> None:
@@ -77,60 +73,53 @@ def set_state(state_name: str = "stop", profile_process: str = "worker") -> None
     if profile_process == "server":
         _route_server("state", state_name)
         return
-    was = _state["running"]
-    _state["running"] = state_name == "run"
-    if was and not _state["running"] and _config.get("continuous_dump"):
-        dump()
+    was = _tracer._on  # not .enabled: a paused profiler still dumps on stop
+    if state_name == "run":
+        _tracer.set_aggregate(bool(_config.get("aggregate_stats")))
+        _tracer.enable()
+    else:
+        _tracer.disable()
+        if was and _config.get("continuous_dump"):
+            dump()
 
 
 def state() -> str:
-    return "run" if _state["running"] else "stop"
+    return "run" if _tracer._on else "stop"
 
 
 def pause(profile_process: str = "worker") -> None:
     if profile_process == "server":
         _route_server("pause")
         return
-    _state["paused"] = True
+    _tracer.pause()
 
 
 def resume(profile_process: str = "worker") -> None:
     if profile_process == "server":
         _route_server("resume")
         return
-    _state["paused"] = False
+    _tracer.resume()
 
 
 def is_active() -> bool:
-    return _state["running"] and not _state["paused"]
+    return _tracer.enabled
 
 
 def record_span(name: str, category: str, t_start: float, t_end: float,
                 args: Optional[dict] = None) -> None:
     """Append one complete event (chrome trace 'X' phase)."""
-    if not is_active():
-        return
-    with _lock:
-        _events.append({
-            "name": name, "cat": category, "ph": "X",
-            "ts": (t_start - _t0) * 1e6,
-            "dur": (t_end - t_start) * 1e6,
-            "pid": 0, "tid": threading.get_ident() % 100000,
-            "args": args or {},
-        })
-        if _config.get("aggregate_stats"):
-            _agg[f"{category}::{name}"].append((t_end - t_start) * 1e3)
+    _tracer.record(name, category, t_start, t_end, args)
 
 
 def events(category: Optional[str] = None) -> List[Dict[str, Any]]:
     """Snapshot of recorded trace events, optionally filtered by category
     — lets subsystems (e.g. serving's metrics plane) and tests inspect
     their spans without round-tripping through a dump file."""
-    with _lock:
-        evs = list(_events)
-    if category is None:
-        return evs
-    return [e for e in evs if e.get("cat") == category]
+    evs = _tracer.events(category)
+    for e in evs:  # historical shape: every event carries ph + args
+        e.setdefault("ph", "X")
+        e.setdefault("args", {})
+    return evs
 
 
 def dump(finished: bool = True, profile_process: str = "worker") -> None:
@@ -138,26 +127,12 @@ def dump(finished: bool = True, profile_process: str = "worker") -> None:
     if profile_process == "server":
         _route_server("dump")
         return
-    with _lock:
-        payload = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
-    with open(_config["filename"], "w") as f:
-        json.dump(payload, f)
+    _ct.dump_chrome_trace(_config["filename"])
 
 
 def dumps(reset: bool = False) -> str:
     """Aggregate stats table (ref: AggregateStats dump, mx.profiler.dumps)."""
-    with _lock:
-        lines = [f"{'Name':<50}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>10}"
-                 f"{'Min':>10}{'Max':>10}"]
-        for name, times in sorted(_agg.items(),
-                                  key=lambda kv: -sum(kv[1])):
-            lines.append(f"{name[:50]:<50}{len(times):>8}"
-                         f"{sum(times):>12.3f}"
-                         f"{sum(times) / len(times):>10.3f}"
-                         f"{min(times):>10.3f}{max(times):>10.3f}")
-        if reset:
-            _agg.clear()
-    return "\n".join(lines)
+    return _tracer.aggregate_table(reset)
 
 
 class Domain:
@@ -208,11 +183,7 @@ class Marker:
         self.name = name
 
     def mark(self, scope: str = "process") -> None:
-        if is_active():
-            with _lock:
-                _events.append({"name": self.name, "ph": "i",
-                                "ts": (time.perf_counter() - _t0) * 1e6,
-                                "pid": 0, "tid": 0, "s": "g"})
+        _tracer.instant(self.name, "marker")
 
 
 class Counter:
@@ -224,12 +195,7 @@ class Counter:
 
     def set_value(self, value) -> None:
         self.value = value
-        if is_active():
-            with _lock:
-                _events.append({"name": self.name, "ph": "C",
-                                "ts": (time.perf_counter() - _t0) * 1e6,
-                                "pid": 0,
-                                "args": {"value": value}})
+        _tracer.counter_event(self.name, value)
 
     def increment(self, delta=1):
         self.set_value(self.value + delta)

@@ -16,174 +16,24 @@ and JSON (:meth:`ServerMetrics.render_json`); batch dispatches are also
 emitted as ``profiler.record_span`` events so chrome://tracing shows the
 serving timeline next to op execution.
 
-Percentiles (p50/p95/p99) are computed from a bounded reservoir of raw
-samples — exact for short windows, a sliding approximation under sustained
-load — while the Prometheus histogram buckets are cumulative counters over
-the full lifetime, as scrapers expect.
+The metric primitives (Counter / Gauge / Histogram with percentile
+reservoirs) live in :mod:`mxnet_tpu.telemetry.registry` — they started
+here and were promoted to the shared telemetry layer; this module re-exports
+them under their historical names and keeps :class:`ServerMetrics`'s
+expositions byte-identical.
 """
 from __future__ import annotations
 
 import json
-import threading
 import time
-from collections import OrderedDict, deque
-from typing import Callable, Dict, Iterable, List, Optional, Tuple
+from typing import Callable, List, Optional
 
-__all__ = ["LatencyHistogram", "Counter", "Gauge", "ServerMetrics"]
+from ..telemetry.registry import (Counter, Gauge, Histogram,
+                                  LatencyHistogram,
+                                  DEFAULT_LATENCY_BUCKETS_MS, _fmt)
 
-# log-ish spaced, ms. Chosen to resolve both sub-ms CPU models and
-# multi-second cold compiles.
-DEFAULT_LATENCY_BUCKETS_MS = (0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
-                              250.0, 500.0, 1000.0, 2500.0, 5000.0, 10000.0)
-
-
-def _fmt(v: float) -> str:
-    """Prometheus sample value: render integers without the trailing .0."""
-    f = float(v)
-    return str(int(f)) if f == int(f) else repr(f)
-
-
-class LatencyHistogram:
-    """Thread-safe histogram: cumulative buckets for Prometheus plus a
-    bounded raw-sample reservoir for exact recent percentiles."""
-
-    def __init__(self, buckets: Iterable[float] = DEFAULT_LATENCY_BUCKETS_MS,
-                 max_samples: int = 8192):
-        self.bounds: Tuple[float, ...] = tuple(sorted(buckets))
-        self._counts = [0] * (len(self.bounds) + 1)  # last = +Inf overflow
-        self._sum = 0.0
-        self._count = 0
-        self._samples: deque = deque(maxlen=max_samples)
-        self._lock = threading.Lock()
-
-    def observe(self, value: float) -> None:
-        with self._lock:
-            i = 0
-            for i, b in enumerate(self.bounds):
-                if value <= b:
-                    break
-            else:
-                i = len(self.bounds)
-            self._counts[i] += 1
-            self._sum += value
-            self._count += 1
-            self._samples.append(value)
-
-    @property
-    def count(self) -> int:
-        return self._count
-
-    def percentile(self, q: float) -> float:
-        """Exact percentile over the sample reservoir (0 when empty)."""
-        with self._lock:
-            if not self._samples:
-                return 0.0
-            s = sorted(self._samples)
-        k = max(0, min(len(s) - 1, int(round(q / 100.0 * (len(s) - 1)))))
-        return float(s[k])
-
-    def snapshot(self) -> dict:
-        with self._lock:
-            count, total = self._count, self._sum
-            s = sorted(self._samples)  # ONE sort for all three percentiles
-
-        def pct(q):
-            if not s:
-                return 0.0
-            k = max(0, min(len(s) - 1, int(round(q / 100.0 * (len(s) - 1)))))
-            return round(float(s[k]), 3)
-
-        return {
-            "count": count,
-            "sum": round(total, 3),
-            "mean": round(total / count, 3) if count else 0.0,
-            "p50": pct(50),
-            "p95": pct(95),
-            "p99": pct(99),
-        }
-
-    def prometheus_lines(self, name: str, help_: str) -> List[str]:
-        with self._lock:
-            counts = list(self._counts)
-            total, count = self._sum, self._count
-        lines = [f"# HELP {name} {help_}", f"# TYPE {name} histogram"]
-        cum = 0
-        for bound, c in zip(self.bounds, counts):
-            cum += c
-            lines.append(f'{name}_bucket{{le="{_fmt(bound)}"}} {cum}')
-        lines.append(f'{name}_bucket{{le="+Inf"}} {count}')
-        lines.append(f"{name}_sum {_fmt(round(total, 6))}")
-        lines.append(f"{name}_count {count}")
-        return lines
-
-
-class Counter:
-    """Monotone counter, optionally labelled (one label dimension)."""
-
-    def __init__(self, label: Optional[str] = None):
-        self.label = label
-        self._value = 0
-        self._labelled: "OrderedDict[str, int]" = OrderedDict()
-        self._lock = threading.Lock()
-
-    def inc(self, n: int = 1, label_value: Optional[str] = None) -> None:
-        with self._lock:
-            self._value += n
-            if label_value is not None:
-                self._labelled[label_value] = \
-                    self._labelled.get(label_value, 0) + n
-
-    @property
-    def value(self) -> int:
-        return self._value
-
-    def by_label(self) -> Dict[str, int]:
-        with self._lock:
-            return dict(self._labelled)
-
-    def prometheus_lines(self, name: str, help_: str) -> List[str]:
-        lines = [f"# HELP {name} {help_}", f"# TYPE {name} counter"]
-        with self._lock:
-            if self.label and self._labelled:
-                for lv, v in self._labelled.items():
-                    lines.append(f'{name}{{{self.label}="{lv}"}} {v}')
-            else:
-                lines.append(f"{name} {self._value}")
-        return lines
-
-
-class Gauge:
-    """Point-in-time value; tracks its high-water mark."""
-
-    def __init__(self):
-        self._value = 0.0
-        self.peak = 0.0
-        self._lock = threading.Lock()
-
-    def set(self, v: float) -> None:
-        with self._lock:
-            self._value = v
-            if v > self.peak:
-                self.peak = v
-
-    def inc(self, delta: float = 1.0) -> None:
-        """Atomic read-modify-write (set(value+1) from two threads loses
-        an increment; concurrent workers must use this)."""
-        with self._lock:
-            self._value += delta
-            if self._value > self.peak:
-                self.peak = self._value
-
-    def dec(self, delta: float = 1.0) -> None:
-        self.inc(-delta)
-
-    @property
-    def value(self) -> float:
-        return self._value
-
-    def prometheus_lines(self, name: str, help_: str) -> List[str]:
-        return [f"# HELP {name} {help_}", f"# TYPE {name} gauge",
-                f"{name} {_fmt(self._value)}"]
+__all__ = ["LatencyHistogram", "Counter", "Gauge", "ServerMetrics",
+           "DEFAULT_LATENCY_BUCKETS_MS"]
 
 
 class ServerMetrics:

@@ -1,0 +1,48 @@
+"""Unified telemetry subsystem: tracer, metrics registry, step breakdown.
+
+The reference dedicates a whole subsystem to observability — ``src/profiler/``
+with aggregate stats, chrome trace-event dumps and a process-profiler C API
+(``MXSetProcessProfilerConfig`` / ``MXDumpProfile``), plus remote profiler
+commands shipped over the kvstore command channel
+(``KVStoreServerProfilerCommand``, include/mxnet/kvstore.h:49). This package
+is the TPU-native generalization; the whole stack reports into it:
+
+- :mod:`.tracer` — thread-safe structured span tracer with a bounded ring
+  buffer, category filtering and the ``MXTPU_PROFILE`` env grammar. Near-zero
+  overhead when off (one flag check per span).
+- :mod:`.chrome_trace` — strict Chrome trace-event JSON exporter (loadable in
+  Perfetto / chrome://tracing) plus the validator the test-suite enforces it
+  with.
+- :mod:`.registry` — shared metrics registry (counters / gauges /
+  histograms). ``serving/metrics.py`` is built on these types; CachedOp cache
+  traffic, kvstore retries, chaos injections, Trainer dispatch counts, XLA
+  compile events and device-memory watermarks all land in the default
+  registry.
+- :mod:`.step_breakdown` — per-step time accounting (data_wait / h2d /
+  compute / optimizer / comm / checkpoint) with the input-bound / comm-bound
+  detector. ``fit.FitLoop`` drives it; ``bench.py`` ships the segment shares
+  as the ``step_breakdown`` headline row.
+
+``mxnet_tpu.profiler`` remains the MXNet-compatible facade over this
+package, and the kvstore remote profiler command channel
+(``KVStore.send_profiler_command``) is served by it, so the controller can
+collect per-rank chrome traces without a shared filesystem.
+"""
+from __future__ import annotations
+
+from .tracer import (Tracer, tracer, span, instant, counter_event, enabled,
+                     configure, enable, disable)
+from .chrome_trace import (chrome_trace_events, dump_chrome_trace,
+                           validate_chrome_trace)
+from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
+                       default_registry)
+from .step_breakdown import (StepBreakdown, segment, current_breakdown,
+                             SEGMENTS)
+
+__all__ = [
+    "Tracer", "tracer", "span", "instant", "counter_event", "enabled",
+    "configure", "enable", "disable",
+    "chrome_trace_events", "dump_chrome_trace", "validate_chrome_trace",
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "default_registry",
+    "StepBreakdown", "segment", "current_breakdown", "SEGMENTS",
+]

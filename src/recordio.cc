@@ -23,6 +23,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <deque>
+#include <map>
 #include <mutex>
 #include <string>
 #include <thread>
@@ -49,6 +50,8 @@ struct Reader {
 
 struct Record {
   std::vector<char> data;
+  bool bad = false;  // read failed for this index (tombstone: skipped in
+                     // order, so a corrupt record can't stall the window)
 };
 
 }  // namespace
@@ -141,19 +144,27 @@ void recio_reader_close(void* handle) {
 // -------------------------------------------------------------- pipeline --
 // Threaded prefetcher: worker threads read records sequentially partitioned
 // by (part_index, num_parts) for distributed sharding (ref:
-// iter_image_recordio_2.cc part_index/num_parts) and fill a bounded queue.
+// iter_image_recordio_2.cc part_index/num_parts) and fill a bounded
+// REORDER buffer keyed by record index. Records are delivered to the
+// consumer in submission (index) order, not completion order — with
+// num_threads > 1 a bare FIFO queue interleaved batches (labels came back
+// permuted), which broke every consumer that pairs records with external
+// state (ref: ThreadedIter preserves order for the same reason).
 
 struct Pipeline {
   std::string path;
   std::vector<int64_t> offsets;  // record start offsets (shard-local)
-  std::deque<Record> queue;
+  std::map<size_t, Record> reorder;  // index -> record, delivered in order
+  size_t next_emit = 0;              // next index the consumer gets
   std::mutex mu;
   std::condition_variable cv_push, cv_pop;
-  size_t capacity = 256;
+  size_t capacity = 256;  // producer window: [next_emit, next_emit + cap)
   std::atomic<size_t> cursor{0};
+  size_t active_workers = 0;
   std::atomic<bool> done{false};
   std::atomic<bool> stop{false};
   std::vector<std::thread> workers;
+  int num_threads = 1;
   bool shuffle = false;
   uint64_t seed = 0;
   int epoch = 0;
@@ -204,31 +215,43 @@ static void ShuffleOffsets(Pipeline* p) {
 
 static void WorkerLoop(Pipeline* p) {
   FILE* fp = std::fopen(p->path.c_str(), "rb");
-  if (!fp) return;
-  while (!p->stop.load()) {
+  while (fp && !p->stop.load()) {
     size_t i = p->cursor.fetch_add(1);
     if (i >= p->offsets.size()) break;
+    // a failed read becomes a TOMBSTONE, not a silent worker exit: a
+    // claimed index must always reach the reorder buffer, or next_emit
+    // would stall and every window-blocked sibling deadlock with it
+    Record rec;
     std::fseek(fp, p->offsets[i], SEEK_SET);
     uint32_t magic = 0, lrec = 0;
-    if (std::fread(&magic, 4, 1, fp) != 1 || magic != kMagic) break;
-    if (std::fread(&lrec, 4, 1, fp) != 1) break;
-    uint32_t len = DecodeLen(lrec);
-    Record rec;
-    rec.data.resize(len);
-    if (len && std::fread(rec.data.data(), 1, len, fp) != len) break;
+    if (std::fread(&magic, 4, 1, fp) == 1 && magic == kMagic &&
+        std::fread(&lrec, 4, 1, fp) == 1) {
+      uint32_t len = DecodeLen(lrec);
+      rec.data.resize(len);
+      if (len && std::fread(rec.data.data(), 1, len, fp) != len)
+        rec.bad = true;
+    } else {
+      rec.bad = true;
+    }
+    if (rec.bad) rec.data.clear();
     std::unique_lock<std::mutex> lk(p->mu);
-    p->cv_push.wait(lk, [p] {
-      return p->queue.size() < p->capacity || p->stop.load();
+    // admit only indices inside the reorder window: the worker holding
+    // next_emit always fits (next_emit < next_emit + capacity), so the
+    // consumer can always advance — no producer/consumer deadlock
+    p->cv_push.wait(lk, [p, i] {
+      return i < p->next_emit + p->capacity || p->stop.load();
     });
     if (p->stop.load()) break;
-    p->queue.emplace_back(std::move(rec));
-    p->cv_pop.notify_one();
+    p->reorder.emplace(i, std::move(rec));
+    p->cv_pop.notify_all();
   }
-  std::fclose(fp);
-  // last worker out marks done
+  if (fp) std::fclose(fp);
+  // last worker out marks done; wake BOTH sides (a window-blocked sibling
+  // must re-check, not sleep through the shutdown)
   std::unique_lock<std::mutex> lk(p->mu);
-  p->done.store(p->cursor.load() >= p->offsets.size());
+  if (--p->active_workers == 0) p->done.store(true);
   p->cv_pop.notify_all();
+  p->cv_push.notify_all();
 }
 
 void* recio_pipeline_create(const char* path, int num_threads,
@@ -243,8 +266,10 @@ void* recio_pipeline_create(const char* path, int num_threads,
     return nullptr;
   }
   if (p->shuffle) ShuffleOffsets(p);
-  int nt = num_threads < 1 ? 1 : num_threads;
-  for (int i = 0; i < nt; ++i) p->workers.emplace_back(WorkerLoop, p);
+  p->num_threads = num_threads < 1 ? 1 : num_threads;
+  p->active_workers = static_cast<size_t>(p->num_threads);
+  for (int i = 0; i < p->num_threads; ++i)
+    p->workers.emplace_back(WorkerLoop, p);
   return p;
 }
 
@@ -252,19 +277,36 @@ int64_t recio_pipeline_size(void* handle) {
   return static_cast<Pipeline*>(handle)->offsets.size();
 }
 
-// Pops one record; returns length (copied into out, up to cap bytes) or -1
-// when the epoch is exhausted.
+// Pops the next record IN SUBMISSION ORDER; returns length (copied into
+// out, up to cap bytes) or -1 when the epoch is exhausted.
 int64_t recio_pipeline_next(void* handle, char* out, int64_t cap) {
   auto* p = static_cast<Pipeline*>(handle);
-  std::unique_lock<std::mutex> lk(p->mu);
-  p->cv_pop.wait(lk, [p] {
-    return !p->queue.empty() || p->done.load() || p->stop.load();
-  });
-  if (p->queue.empty()) return -1;
-  Record rec = std::move(p->queue.front());
-  p->queue.pop_front();
-  p->cv_push.notify_one();
-  lk.unlock();
+  Record rec;
+  {
+    std::unique_lock<std::mutex> lk(p->mu);
+    for (;;) {
+      auto it = p->reorder.find(p->next_emit);
+      if (it != p->reorder.end()) {
+        bool bad = it->second.bad;
+        if (!bad) rec = std::move(it->second);
+        p->reorder.erase(it);
+        ++p->next_emit;
+        p->cv_push.notify_all();
+        if (bad) continue;  // tombstone: record lost to a read error —
+                            // skip it, stay ordered
+        break;
+      }
+      if (p->stop.load()) return -1;
+      if (p->done.load()) {
+        if (p->reorder.empty()) return -1;
+        // catastrophic worker loss (e.g. its fopen failed): indices it
+        // claimed never arrived — skip to the next record that did
+        p->next_emit = p->reorder.begin()->first;
+        continue;
+      }
+      p->cv_pop.wait(lk);
+    }
+  }
   int64_t n = static_cast<int64_t>(rec.data.size());
   if (n > cap) n = cap;
   std::memcpy(out, rec.data.data(), n);
@@ -281,14 +323,16 @@ void recio_pipeline_reset(void* handle) {
   }
   for (auto& t : p->workers) t.join();
   p->workers.clear();
-  p->queue.clear();
+  p->reorder.clear();
+  p->next_emit = 0;
   p->cursor.store(0);
   p->done.store(false);
   p->stop.store(false);
   p->epoch += 1;
   if (p->shuffle) ShuffleOffsets(p);
-  size_t nt = 2;
-  for (size_t i = 0; i < nt; ++i) p->workers.emplace_back(WorkerLoop, p);
+  p->active_workers = static_cast<size_t>(p->num_threads);
+  for (int i = 0; i < p->num_threads; ++i)
+    p->workers.emplace_back(WorkerLoop, p);
 }
 
 void recio_pipeline_destroy(void* handle) {

@@ -36,3 +36,7 @@ def pytest_configure(config):
         "markers", "serving: inference-serving subsystem tests "
         "(mxnet_tpu/serving: batcher, signature cache, admission, "
         "metrics). Tier-1-safe: CPU, in-process transport, no sockets.")
+    config.addinivalue_line(
+        "markers", "telemetry: unified telemetry subsystem tests "
+        "(mxnet_tpu/telemetry: tracer, chrome-trace export, metrics "
+        "registry, step breakdown). Tier-1-safe: CPU, in-process.")

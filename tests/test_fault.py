@@ -276,3 +276,29 @@ def test_heartbeat_restart_and_numeric_order(tmp_path):
         with open(os.path.join(d, f"heartbeat-{r}"), "w") as f:
             f.write(str(time.time() - 100))
     assert fault.dead_nodes(d, timeout=30.0) == [0, 1, 2, 10, 11]
+
+
+def test_trainer_param_order_stable_across_name_counter():
+    """Positional optimizer-state indices (checkpoint slots, kvstore keys)
+    derive from the Trainer's parameter order, and gluon layer names embed
+    a process-global counter: ``dense10_*`` < ``dense8_*`` under a plain
+    lexicographic sort, so a run checkpointed at one counter value and
+    resumed at another bound restored momentum to the WRONG parameters
+    (kill/resume straddling the dense9 -> dense10 boundary). The order
+    must be numeric-aware and therefore identical for structurally equal
+    nets regardless of where the counter sits."""
+    def order(p1, p2):
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(8, activation="relu", prefix=p1),
+                gluon.nn.Dense(1, prefix=p2))
+        net.initialize(mx.init.Xavier())
+        with autograd.pause():
+            net(nd.ones((1, 4)))
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1, "momentum": 0.9},
+                           kvstore=None)
+        return [tuple(p.data().shape) for p in tr._params]
+
+    straddling = order("dense9_", "dense10_")
+    plain = order("dense11_", "dense12_")
+    assert straddling == plain, (straddling, plain)

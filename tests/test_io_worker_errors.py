@@ -1,0 +1,223 @@
+"""Worker-error paths of the prefetching data plane: an exception inside a
+PrefetchingIter thread or a DataLoader worker (thread or process mode) must
+surface on the consumer's next ``next()`` — never hang, never vanish.
+"""
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon.data import DataLoader
+from mxnet_tpu.gluon.data.dataset import ArrayDataset
+from mxnet_tpu.io.io import DataBatch, DataIter, NDArrayIter, PrefetchingIter
+
+
+class _BoomIter(DataIter):
+    """Yields ``good`` batches, then raises."""
+
+    def __init__(self, good=2, batch_size=2):
+        super().__init__(batch_size)
+        self._good = good
+        self._i = 0
+
+    @property
+    def provide_data(self):
+        return []
+
+    @property
+    def provide_label(self):
+        return []
+
+    def reset(self):
+        self._i = 0
+
+    def next(self):
+        if self._i >= self._good:
+            raise RuntimeError("decode exploded")
+        self._i += 1
+        from mxnet_tpu import nd
+        return DataBatch([nd.ones((self.batch_size, 2))], [], pad=0)
+
+
+def test_prefetching_iter_surfaces_worker_error():
+    it = PrefetchingIter(_BoomIter(good=2))
+    assert next(it) is not None
+    assert next(it) is not None
+    with pytest.raises(RuntimeError, match="decode exploded"):
+        next(it)
+
+
+def test_prefetching_iter_error_is_sticky_not_a_hang():
+    """After the worker died, every subsequent next() must keep raising
+    immediately (a bare queue.get() would block forever)."""
+    it = PrefetchingIter(_BoomIter(good=0))
+    for _ in range(3):
+        t0 = time.perf_counter()
+        with pytest.raises(RuntimeError, match="decode exploded"):
+            next(it)
+        assert time.perf_counter() - t0 < 1.0
+
+
+def test_prefetching_iter_reset_recovers_from_error():
+    it = PrefetchingIter(_BoomIter(good=1))
+    assert next(it) is not None
+    with pytest.raises(RuntimeError):
+        next(it)
+    it.reset()
+    assert next(it) is not None  # fresh worker, fresh underlying iter
+
+
+def test_prefetching_iter_reset_with_full_abandoned_queue():
+    """reset() while the worker is blocked on a full queue must not wedge
+    (the bounded put stays responsive to the stop flag)."""
+    base = NDArrayIter(np.arange(64, dtype=np.float32).reshape(32, 2),
+                       np.zeros(32, np.float32), batch_size=2)
+    it = PrefetchingIter(base, prefetch_depth=2)
+    next(it)
+    time.sleep(0.2)  # let the worker fill + block on the bounded queue
+    t0 = time.perf_counter()
+    it.reset()
+    assert time.perf_counter() - t0 < 5.0
+    assert next(it) is not None
+
+
+class _BoomDataset(ArrayDataset):
+    """Raises on one poisoned index."""
+
+    def __init__(self, n=16, poison=9):
+        super().__init__(np.arange(n * 2, dtype=np.float32).reshape(n, 2),
+                         np.zeros(n, np.float32))
+        self._poison = poison
+
+    def __getitem__(self, idx):
+        if idx == self._poison:
+            raise ValueError("poisoned sample")
+        return super().__getitem__(idx)
+
+
+def test_dataloader_thread_mode_surfaces_worker_error():
+    dl = DataLoader(_BoomDataset(), batch_size=4, num_workers=2,
+                    thread_pool=True)
+    with pytest.raises(ValueError, match="poisoned sample"):
+        for _ in dl:
+            pass
+
+
+def test_dataloader_process_mode_surfaces_worker_error():
+    dl = DataLoader(_BoomDataset(), batch_size=4, num_workers=2,
+                    thread_pool=False)
+    with pytest.raises(MXNetError, match="poisoned sample"):
+        for _ in dl:
+            pass
+
+
+def test_dataloader_process_mode_error_does_not_hang_cleanup():
+    """The failing iteration must tear down its workers promptly so the
+    next epoch (a fresh __iter__) works."""
+    dl = DataLoader(_BoomDataset(poison=1), batch_size=4, num_workers=2,
+                    thread_pool=False)
+    t0 = time.perf_counter()
+    with pytest.raises(MXNetError):
+        list(dl)
+    assert time.perf_counter() - t0 < 30.0
+    clean = DataLoader(_BoomDataset(poison=10 ** 9), batch_size=4,
+                       num_workers=2, thread_pool=False)
+    assert len(list(clean)) == 4
+
+
+def test_dataloader_dead_worker_process_is_reported():
+    """A worker killed outright (OOM-killer stand-in: os._exit) must be
+    detected and reported, not waited on forever."""
+    dl = DataLoader(_ExitingDataset(), batch_size=2, num_workers=1,
+                    thread_pool=False)
+    with pytest.raises(MXNetError, match="died|failed"):
+        for _ in dl:
+            pass
+
+
+class _ExitingDataset(ArrayDataset):
+    def __init__(self):
+        super().__init__(np.zeros((8, 2), np.float32),
+                         np.zeros(8, np.float32))
+
+    def __getitem__(self, idx):
+        if idx == 5:
+            import os
+            os._exit(17)
+        return super().__getitem__(idx)
+
+
+class _GatedIter(DataIter):
+    """next() blocks on an external gate at batch ``block_at`` — simulates
+    a slow disk/network read stalling a prefetch worker."""
+
+    def __init__(self, gate, n=4, block_at=1, batch_size=2):
+        super().__init__(batch_size)
+        self._gate = gate
+        self._n = n
+        self._block_at = block_at
+        self._i = 0
+        self.served = 0
+
+    @property
+    def provide_data(self):
+        return []
+
+    @property
+    def provide_label(self):
+        return []
+
+    def reset(self):
+        self._i = 0
+
+    def next(self):
+        if self._i == self._block_at:
+            self._gate.wait()
+        if self._i >= self._n:
+            raise StopIteration
+        self._i += 1
+        self.served += 1
+        from mxnet_tpu import nd
+        return DataBatch([nd.ones((self.batch_size, 2))], [], pad=0)
+
+
+def test_prefetching_iter_zombie_worker_cannot_eat_new_epoch(monkeypatch):
+    """A worker that outlives reset()'s join timeout (blocked in a slow
+    underlying next()) must neither consume the new epoch's batches nor
+    race it.reset(): reset() serializes on the iter lock, so the new
+    epoch always yields its full batch count."""
+    import threading
+    from mxnet_tpu.io import io as io_mod
+    monkeypatch.setattr(io_mod, "_PREFETCH_JOIN_TIMEOUT_S", 0.2)
+
+    gate = threading.Event()
+    base = _GatedIter(gate, n=4, block_at=1)
+    it = PrefetchingIter(base, prefetch_depth=1)
+    assert next(it) is not None          # batch 0; worker now blocked at 1
+
+    done = threading.Event()
+
+    def do_reset():
+        it.reset()
+        done.set()
+
+    t = threading.Thread(target=do_reset, daemon=True)
+    t.start()
+    # join times out at 0.2s, but reset() must then wait on the iter lock
+    # — the zombie is still inside the underlying next()
+    assert not done.wait(1.0), "reset() finished while a zombie worker " \
+                               "was mid-next() on the shared iterator"
+    gate.set()                           # slow read completes
+    assert done.wait(5.0), "reset() wedged after the zombie exited"
+    t.join(timeout=5)
+
+    # the new epoch must see ALL n batches — none eaten by the zombie
+    got = 0
+    while True:
+        try:
+            next(it)
+            got += 1
+        except StopIteration:
+            break
+    assert got == 4, got
