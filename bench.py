@@ -60,6 +60,21 @@ def _init_backend(timeout_s=900):
     return False
 
 
+def _smoke_net():
+    """MXTPU_BENCH_MODEL=smoke (tests/test_bench_smoke.py): a 2-layer MLP
+    that compiles in seconds on CPU, so a tiny MXTPU_BENCH_DEADLINE_S run
+    still exercises the WHOLE artifact path — child subprocess, TRAIN_IPS/
+    INFERENCE_IPS markers, probe EXTRA_ROWs, incremental headline JSON
+    re-emission — without ResNet compile times. Shared by the train and
+    inference children so both smoke models stay one model; the img/s it
+    measures is meaningless as a perf signal. Returns (net, img_size)."""
+    from mxnet_tpu.gluon import nn as gnn
+    net = gnn.HybridSequential()  # SPMDTrainer needs a HybridBlock
+    net.add(gnn.Dense(64, activation="relu"))
+    net.add(gnn.Dense(1000))
+    return net, 32
+
+
 def run(batch=256, k_steps=8, dtype=None, layout=None, model=None):
     import numpy as np
     import jax
@@ -78,7 +93,9 @@ def run(batch=256, k_steps=8, dtype=None, layout=None, model=None):
 
     mx.random.seed(0)
     img = 299 if "inception" in model else 224
-    if model == "resnet50_v1":
+    if model == "smoke":
+        net, img = _smoke_net()
+    elif model == "resnet50_v1":
         # space-to-depth stem (exact 7x7/2 reparametrization; see
         # SpaceToDepthStem + tests/test_model_zoo.py equivalence test)
         s2d = os.environ.get("MXTPU_BENCH_S2D", "1") != "0"
@@ -173,7 +190,9 @@ def run_inference(batch=256, dtype=None, layout=None, k_batches=8, reps=3,
         model = os.environ.get("MXTPU_BENCH_MODEL", "resnet50_v1")
     mx.random.seed(0)
     img = 299 if "inception" in model else 224
-    if model == "resnet50_v1":
+    if model == "smoke":
+        net, img = _smoke_net()
+    elif model == "resnet50_v1":
         net = resnet50_v1(layout=layout,
                           stem_s2d=os.environ.get("MXTPU_BENCH_S2D",
                                                   "1") != "0")
@@ -491,6 +510,92 @@ def _step_breakdown_probe(steps=4, batch=64):
             "diagnoses": summary.get("diagnoses", [])[:3]}
 
 
+def _autotune_probe(steps=30, batch=32, width=64, n_layers=6):
+    """The `autotune` row: does the telemetry-driven tuner actually move
+    the needle it watches? A deliberately comm-heavy FitLoop (kv_slow
+    chaos injects a deterministic per-collective wire delay, so the comm
+    segment dominates even on a laptop CPU run) is trained twice —
+    untuned, then with MXTPU_AUTOTUNE on — and the row records the
+    chosen knobs plus the before/after exclusive comm-segment share, so
+    the perf trajectory catches a tuner that stops choosing (or a chosen
+    knob that stops helping)."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, io as mxio
+    from mxnet_tpu import kvstore as kvs
+    from mxnet_tpu.contrib import chaos
+    from mxnet_tpu.fit import FitLoop
+
+    def seg_share(recs, *names):
+        wall = sum(r.get("wall", 0.0) for r in recs)
+        c = sum(r.get(n, 0.0) for n in names for r in recs)
+        return round(c / wall, 4) if wall > 0 else 0.0
+
+    def one_run(autotune_spec):
+        mx.random.seed(0)
+        rs = np.random.RandomState(0)
+        net = gluon.nn.Sequential()
+        for _ in range(n_layers):  # several grads -> several buckets
+            net.add(gluon.nn.Dense(width, activation="relu"))
+        net.add(gluon.nn.Dense(8))
+        net.initialize(mx.init.Xavier())
+        data = rs.randn(steps * batch, width).astype(np.float32)
+        label = rs.randint(0, 8, (steps * batch,)).astype(np.float32)
+        it = mxio.NDArrayIter(data, label, batch_size=batch)
+        # an explicit store OBJECT: the "device" string degrades to no
+        # store at all on a 1-device host (direct updates add nothing),
+        # and with no store there are no collectives to slow down, hide,
+        # or tune — the whole probe would measure an empty comm segment
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.01},
+                                kvstore=kvs.create("device"))
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        old = os.environ.get("MXTPU_AUTOTUNE")
+        if autotune_spec is None:
+            os.environ.pop("MXTPU_AUTOTUNE", None)
+        else:
+            os.environ["MXTPU_AUTOTUNE"] = autotune_spec
+        chaos.install("kv_slow@3")  # 3 ms per collective, every attempt
+        try:
+            result = FitLoop(net, trainer, loss_fn, it,
+                             ckpt_dir=None).fit(epochs=1)
+        finally:
+            chaos.uninstall()
+            if old is None:
+                os.environ.pop("MXTPU_AUTOTUNE", None)
+            else:
+                os.environ["MXTPU_AUTOTUNE"] = old
+        return result
+
+    before = one_run(None)
+    after = one_run("on,probe=2,warmup=1")
+    report = after.tuning_report or {}
+    recs = (after.step_breakdown or {}).get("per_step", [])
+    locked_at = report.get("locked_at_step")
+    # post-lock steps only: probing deliberately visits bad configs, and
+    # the row's claim is about the configuration the tuner LOCKED. The
+    # lock fires at the END of step `locked_at` (that step still ran
+    # under the final candidate's knobs) — the locked config owns
+    # locked_at+1 onward. `is not None`, not truthiness: a lock at step
+    # 0 (nothing to vary) still counts, and never-locked keeps all steps
+    post = recs[locked_at + 1:] if locked_at is not None else recs
+    pre = (before.step_breakdown or {}).get("per_step", [])
+    return {
+        "steps": steps,
+        "status": report.get("status"),
+        "locked_at_step": locked_at,
+        "baseline": report.get("baseline", {}),
+        "chosen": report.get("chosen", {}),
+        # exposed comm = the post-backward barrier segment the overlap
+        # scheduler exists to hide; the overlapped share is reported
+        # alongside so the hidden time stays visible
+        "comm_share_before": seg_share(pre, "comm"),
+        "comm_share_after": seg_share(post, "comm"),
+        "comm_overlapped_share_after": seg_share(post, "comm_overlapped"),
+        "probe_candidates": len(report.get("candidates", [])),
+    }
+
+
 def _run_child(mode, args_rest):
     if not _init_backend():
         os._exit(1)
@@ -516,6 +621,13 @@ def _run_child(mode, args_rest):
                       flush=True)
             except Exception as e:
                 log(f"step breakdown probe failed: {e}")
+        if os.environ.get("MXTPU_BENCH_AUTOTUNE", "1") != "0":
+            try:
+                at = _autotune_probe()
+                print("EXTRA_ROW " + json.dumps({"autotune": at}),
+                      flush=True)
+            except Exception as e:
+                log(f"autotune probe failed: {e}")
 
 
 # global wall-clock budget: the driver kills the whole bench at some
@@ -706,6 +818,10 @@ def main():
                 # input-pipeline or comm regression shows up as a segment
                 # share shift even when img/s only drifts
                 payload["step_breakdown"] = _EXTRAS["step_breakdown"]
+            if "autotune" in _EXTRAS:
+                # the self-tuning loop's evidence: chosen knobs + the
+                # before/after comm-segment share on a comm-heavy config
+                payload["autotune"] = _EXTRAS["autotune"]
             # the train number is safe on stdout NOW; each optional row
             # that lands re-emits the extended line immediately, so a
             # truncated run keeps everything measured so far
@@ -739,9 +855,14 @@ def main():
                     t8 = _subprocess_metric(
                         "--train-only", [batch, k], "TRAIN_IPS",
                         env_extra={"MXNET_CONV_COMPUTE": "int8",
-                                   # probe already ran in the headline
-                                   # train child; don't pay it twice
-                                   "MXTPU_BENCH_DISPATCH_PROBE": "0"})
+                                   # probes already ran in the headline
+                                   # train child; don't pay them twice —
+                                   # and don't let the int8 child's
+                                   # EXTRA_ROWs overwrite the headline
+                                   # rows with int8-config numbers
+                                   "MXTPU_BENCH_DISPATCH_PROBE": "0",
+                                   "MXTPU_BENCH_STEP_BREAKDOWN": "0",
+                                   "MXTPU_BENCH_AUTOTUNE": "0"})
                     if t8:
                         payload["train_int8_imgs_per_sec"] = round(t8, 2)
                         print(json.dumps(payload), flush=True)

@@ -25,7 +25,7 @@ from .base import MXNetError, check, hashable_params
 
 __all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
            "is_training", "set_recording", "set_training", "mark_variables",
-           "backward", "grad", "Function", "get_symbol"]
+           "backward", "grad", "grad_ready_scope", "Function", "get_symbol"]
 
 
 class _State(threading.local):
@@ -33,6 +33,7 @@ class _State(threading.local):
         self.recording = False
         self.training = False
         self.capture_stack = []
+        self.grad_ready_hook = None
 
 
 _state = _State()
@@ -229,6 +230,34 @@ class _OutputEntry:
         self.index = index
 
 
+class grad_ready_scope:
+    """Install a gradient-finality hook for backward passes on this thread.
+
+    ``fn(grad_buffer)`` is called DURING the reverse pass, the moment a
+    marked variable's gradient buffer receives its final contribution (no
+    remaining tape node can add to it). This is the dependency-resolution
+    signal the reference engine schedules kvstore pushes on (PAPER.md
+    §engine): a consumer can start communicating a gradient while backward
+    is still producing the earlier layers' gradients. The hook runs on the
+    backward thread; delivery order is reverse-creation order (later
+    layers' grads finalize first). Whole-graph (CachedOp) backward bypasses
+    the tape and fires no hooks — consumers must treat the hook as an
+    optimization signal, not a completeness guarantee."""
+
+    def __init__(self, fn):
+        self._fn = fn
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = _state.grad_ready_hook
+        _state.grad_ready_hook = self._fn
+        return self
+
+    def __exit__(self, *a):
+        _state.grad_ready_hook = self._prev
+        return False
+
+
 def mark_variables(variables: Sequence, gradients: Sequence,
                    grad_reqs="write") -> None:
     """Associate gradient buffers with arrays
@@ -349,6 +378,41 @@ def grad(heads, variables, head_grads=None, retain_graph=None,
                           variables=variables)
 
 
+def _deliver_grad(e: "_VariableEntry", g):
+    """Write one accumulated cotangent into a variable's attached grad
+    buffer (honoring grad_req and row_sparse buffers). Returns the buffer
+    written, or None when the variable has no live buffer."""
+    var = e.array_ref()
+    if var is None or e.grad_ref is None:
+        return None
+    gbuf = e.grad_ref()
+    if gbuf is None or e.grad_req == "null":
+        return None
+    from .ndarray.sparse import RowSparseNDArray
+    if isinstance(gbuf, RowSparseNDArray):
+        # row_sparse grad buffer (attach_grad(stype='row_sparse') /
+        # Parameter grad_stype): store only the touched rows
+        if not isinstance(g, _RspGrad):
+            g = _RspGrad(g, _np.arange(g.shape[0], dtype=_np.int64),
+                         g.shape)
+        if e.grad_req == "add" and gbuf._data.shape[0]:
+            g = _grad_sum(_RspGrad(gbuf._data,
+                                   _np.asarray(gbuf._indices),
+                                   g.shape), g)
+        data, uniq = g.compact()
+        gbuf._update(data.astype(gbuf._data.dtype), uniq)
+        gbuf._fresh_grad = True
+        return gbuf
+    if isinstance(g, _RspGrad):
+        g = g.densify()
+    if e.grad_req == "add":
+        gbuf._rebind(gbuf._data + g)
+    else:
+        gbuf._rebind(g.astype(gbuf._data.dtype))
+    gbuf._fresh_grad = True
+    return gbuf
+
+
 def _backward_impl(heads, head_grads, retain_graph, train_mode_flag,
                    variables=None):
     import jax.numpy as jnp
@@ -385,6 +449,20 @@ def _backward_impl(heads, head_grads, retain_graph, train_mode_flag,
 
     order = _toposort(root_nodes)
 
+    # grad-ready scheduling (overlap consumers): count, per marked
+    # variable, how many tape nodes can still contribute to its gradient;
+    # when the count hits zero during the reverse pass the grad is FINAL
+    # and can be delivered + announced immediately, while backward keeps
+    # running. Zero-cost when no hook is installed.
+    hook = _state.grad_ready_hook
+    pending: Dict[int, int] = {}
+    delivered = set()
+    if hook is not None:
+        for node in order:
+            for e in node.input_entries:
+                if isinstance(e, _VariableEntry):
+                    pending[id(e)] = pending.get(id(e), 0) + 1
+
     for node in reversed(order):
         # gather cotangents for this node's outputs
         cots = []
@@ -401,30 +479,45 @@ def _backward_impl(heads, head_grads, retain_graph, train_mode_flag,
                             else found)
             else:
                 cots.append(jnp.zeros(shape, dtype))
-        if not has_any:
+        if has_any:
+            if node.input_vals is None:
+                raise MXNetError("graph has already been freed; pass "
+                                 "retain_graph=True to backward() to reuse "
+                                 "it")
+            if node.custom is not None:
+                in_grads = node.custom._run_backward(cots)
+            elif node.opdef.name == "Embedding" \
+                    and dict(node.params_key).get("sparse_grad"):
+                # row_sparse weight gradient: ship (cot rows, ids) without
+                # the dense (vocab, dim) scatter (ref: indexing_op.cc
+                # SparseEmbeddingOpBackwardRspImpl)
+                data_in, weight_in = node.input_vals[0], node.input_vals[1]
+                cot = cots[0]
+                dim = weight_in.shape[-1]
+                in_grads = (None, _RspGrad(cot.reshape(-1, dim),
+                                           _np.asarray(data_in).reshape(-1)
+                                           .astype(_np.int64),
+                                           weight_in.shape))
+            else:
+                in_grads = _vjp_call(node, tuple(cots))
+            for e, g in zip(node.input_entries, in_grads):
+                if e is not None and g is not None:
+                    add_grad(e, g)
+        if hook is None:
             continue
-        if node.input_vals is None:
-            raise MXNetError("graph has already been freed; pass "
-                             "retain_graph=True to backward() to reuse it")
-        if node.custom is not None:
-            in_grads = node.custom._run_backward(cots)
-        elif node.opdef.name == "Embedding" \
-                and dict(node.params_key).get("sparse_grad"):
-            # row_sparse weight gradient: ship (cot rows, ids) without the
-            # dense (vocab, dim) scatter (ref: indexing_op.cc
-            # SparseEmbeddingOpBackwardRspImpl)
-            data_in, weight_in = node.input_vals[0], node.input_vals[1]
-            cot = cots[0]
-            dim = weight_in.shape[-1]
-            in_grads = (None, _RspGrad(cot.reshape(-1, dim),
-                                       _np.asarray(data_in).reshape(-1)
-                                       .astype(_np.int64),
-                                       weight_in.shape))
-        else:
-            in_grads = _vjp_call(node, tuple(cots))
-        for e, g in zip(node.input_entries, in_grads):
-            if e is not None and g is not None:
-                add_grad(e, g)
+        # a node consumed (whether or not it contributed a cotangent) can
+        # no longer add to its input variables' grads — decrement, and on
+        # zero deliver into the attached buffer + fire the hook
+        for e in node.input_entries:
+            if not isinstance(e, _VariableEntry):
+                continue
+            k = id(e)
+            pending[k] -= 1
+            if pending[k] == 0 and k in acc and k not in delivered:
+                delivered.add(k)
+                gbuf = _deliver_grad(e, acc[k])
+                if gbuf is not None:
+                    hook(gbuf)
 
     # deliver to variables
     results = None
@@ -444,38 +537,12 @@ def _backward_impl(heads, head_grads, retain_graph, train_mode_flag,
                                                     v._ctx))
                 continue
             results.append(NDArray(g, ctx=v._ctx))
-    # accumulate into attached grad buffers
+    # accumulate into attached grad buffers (entries already delivered
+    # early by the grad-ready path are skipped — delivering twice would
+    # double-accumulate a grad_req='add' buffer)
     for k, e in entry_of.items():
-        if isinstance(e, _VariableEntry):
-            var = e.array_ref()
-            if var is None or e.grad_ref is None:
-                continue
-            gbuf = e.grad_ref()
-            if gbuf is None or e.grad_req == "null":
-                continue
-            g = acc[k]
-            from .ndarray.sparse import RowSparseNDArray
-            if isinstance(gbuf, RowSparseNDArray):
-                # row_sparse grad buffer (attach_grad(stype='row_sparse') /
-                # Parameter grad_stype): store only the touched rows
-                if not isinstance(g, _RspGrad):
-                    g = _RspGrad(g, _np.arange(g.shape[0], dtype=_np.int64),
-                                 g.shape)
-                if e.grad_req == "add" and gbuf._data.shape[0]:
-                    g = _grad_sum(_RspGrad(gbuf._data,
-                                           _np.asarray(gbuf._indices),
-                                           g.shape), g)
-                data, uniq = g.compact()
-                gbuf._update(data.astype(gbuf._data.dtype), uniq)
-                gbuf._fresh_grad = True
-                continue
-            if isinstance(g, _RspGrad):
-                g = g.densify()
-            if e.grad_req == "add":
-                gbuf._rebind(gbuf._data + g)
-            else:
-                gbuf._rebind(g.astype(gbuf._data.dtype))
-            gbuf._fresh_grad = True
+        if isinstance(e, _VariableEntry) and k not in delivered:
+            _deliver_grad(e, acc[k])
 
     if not retain_graph:
         for node in order:

@@ -182,6 +182,29 @@ env.declare("MXTPU_GRAD_BUCKET_MB", float, 25.0,
             "(one collective) per bucket instead of one per key "
             "(ref: DDP gradient bucketing). 0 disables (per-key "
             "push/pull).")
+env.declare("MXTPU_COMM_OVERLAP", str, "off",
+            "Overlap gradient communication with backward: 'on' launches "
+            "each gradient bucket's kvstore push/pull the moment its "
+            "constituent grads receive their final contribution during "
+            "the reverse pass (reverse-creation-order bucket scheduling, "
+            "ref: the reference engine ordering kvstore pushes on write "
+            "dependencies), instead of one barrier after backward. "
+            "Numerically identical to 'off' (same bucket sums, earlier "
+            "launch). Driven per step by fit.FitLoop; overlapped time is "
+            "charged to the step-breakdown segment 'comm_overlapped'. "
+            "Unknown values raise.")
+env.declare("MXTPU_AUTOTUNE", str, "off",
+            "Telemetry-driven knob autotuner (telemetry/autotune.py): "
+            "'on' makes FitLoop spend a few instrumented probe steps per "
+            "candidate varying MXTPU_GRAD_BUCKET_MB, "
+            "MXTPU_OPTIMIZER_AGGREGATION, DeviceStagingIter prefetch "
+            "depth and MXTPU_COMM_OVERLAP, score each candidate with the "
+            "step-breakdown exclusive-time data, lock the best config and "
+            "record the decision (trace category 'autotune', metrics "
+            "registry, FitResult.tuning_report). Grammar: "
+            "'on[,probe=N][,warmup=N][,knobs=a|b][,bucket_mb=v|v]"
+            "[,agg=v|v][,prefetch=v|v][,overlap=0|1]'; typos raise. "
+            "'off' (default) reproduces untuned behavior exactly.")
 env.declare("MXTPU_PROFILE", str, "",
             "Telemetry tracer spec, applied at import: comma-separated "
             "tokens 'on'|'off'|'ring=N'|'cat=a|b'|'file=PATH' (see "

@@ -27,6 +27,12 @@ Grammar: comma-separated events, each ``kind[:prob][@target]``:
   :class:`~mxnet_tpu.kvstore.TransientKVError` with probability ``P``
   (seeded RNG, ``MXTPU_CHAOS_SEED``), exercising the bounded
   retry-with-backoff (hook: ``kvstore.KVStoreBase.push/pull``).
+- ``kv_slow:P@MS`` — each kvstore push/pull attempt sleeps ``MS``
+  milliseconds with probability ``P`` (``kv_slow@MS`` = always),
+  simulating a slow interconnect so comm-bound steps are reproducible on
+  a laptop: the step-breakdown comm-bound detector, the comm/backward
+  overlap path and the autotuner are all testable against it (hook:
+  ``kvstore.KVStoreBase.push/pull``, same entry point as ``kv_flake``).
 - ``serve_slow:P@MS`` — each serving batch dispatch sleeps ``MS``
   milliseconds with probability ``P`` (``serve_slow@MS`` = always),
   simulating compute stragglers/compile stalls so deadline shedding and
@@ -76,7 +82,7 @@ class ChaosKilled(MXNetError):
 
 
 _KINDS = ("nan_grad", "inf_grad", "kill", "preempt", "ckpt_corrupt",
-          "kv_flake", "serve_slow")
+          "kv_flake", "kv_slow", "serve_slow")
 
 
 class ChaosPlan:
@@ -98,6 +104,8 @@ class ChaosPlan:
         self._at: Dict[str, Set[int]] = {k: set() for k in _KINDS}
         self._ckpt_latest = False
         self.kv_flake_p = 0.0
+        self.kv_slow_p = 0.0
+        self.kv_slow_ms = 0.0
         self.serve_slow_p = 0.0
         self.serve_slow_ms = 0.0
         # observability: how many of each fault actually fired
@@ -132,20 +140,24 @@ class ChaosPlan:
                                  "outside [0, 1]")
             self.kv_flake_p = p
             return
-        if kind == "serve_slow":
+        if kind in ("serve_slow", "kv_slow"):
             if target is None:
-                raise MXNetError("chaos: serve_slow needs a delay target "
-                                 "in ms, e.g. serve_slow:0.5@20 or "
-                                 "serve_slow@20")
+                raise MXNetError(f"chaos: {kind} needs a delay target "
+                                 f"in ms, e.g. {kind}:0.5@20 or "
+                                 f"{kind}@20")
             ms = float(target)
             if ms < 0:
-                raise MXNetError(f"chaos: serve_slow delay {ms} < 0")
+                raise MXNetError(f"chaos: {kind} delay {ms} < 0")
             p = 1.0 if prob is None else float(prob)
             if not 0.0 <= p <= 1.0:
-                raise MXNetError(f"chaos: serve_slow probability {p} "
+                raise MXNetError(f"chaos: {kind} probability {p} "
                                  "outside [0, 1]")
-            self.serve_slow_p = p
-            self.serve_slow_ms = ms
+            if kind == "kv_slow":
+                self.kv_slow_p = p
+                self.kv_slow_ms = ms
+            else:
+                self.serve_slow_p = p
+                self.serve_slow_ms = ms
             return
         if prob is not None:
             raise MXNetError(f"chaos: {kind} takes no probability")
@@ -207,6 +219,32 @@ class ChaosPlan:
             g._rebind(jnp.full(g.shape, fill, g._data.dtype))
             return True
         return False
+
+    def poisons_step(self, step: int) -> bool:
+        """True when a grad-poison event (nan_grad/inf_grad) is scheduled
+        at ``step``. The FitLoop consults this BEFORE backward to disable
+        comm/backward overlap for exactly that step: the poison is written
+        AFTER backward, and overlapped collectives would already have
+        shipped the clean gradients (the deferred bucket split would then
+        overwrite the poisoned buffers), silently neutering the injected
+        fault the chaos test exists to exercise."""
+        return (int(step) in self._at["nan_grad"] or
+                int(step) in self._at["inf_grad"])
+
+    def kv_delay_s(self) -> float:
+        """kv_slow:P@MS — seconds of injected wire delay for this kvstore
+        push/pull attempt (0.0 when the roll misses). The caller sleeps
+        this long before the op, simulating a congested DCN hop; rolls
+        come from the plan's seeded RNG so runs replay."""
+        if not self.kv_slow_ms:
+            return 0.0
+        with self._rng_lock:
+            if self.kv_slow_p < 1.0 and \
+                    self._rng.random() >= self.kv_slow_p:
+                return 0.0
+            self.injected["kv_slow"] += 1
+        _count_injection("kv_slow")
+        return self.kv_slow_ms / 1000.0
 
     def kv_maybe_fail(self, op: str, key) -> None:
         """kv_flake:P — raise TransientKVError with probability P on each

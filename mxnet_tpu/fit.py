@@ -26,6 +26,7 @@ the detection half. This module owns the *survival* half end to end:
 """
 from __future__ import annotations
 
+import contextlib
 import signal
 import sys
 import threading
@@ -36,6 +37,7 @@ from .base import MXNetError, check, env
 from .log import get_logger
 from . import fault
 from .contrib import chaos as _chaos
+from .telemetry import autotune as _autotune
 from .telemetry.step_breakdown import StepBreakdown, segment as _segment
 
 __all__ = ["FitLoop", "FitResult", "resumable_exit_code"]
@@ -62,6 +64,7 @@ class FitResult:
     loss_scale: float = 1.0
     resumed_from: Optional[int] = None  # checkpoint step, None = fresh
     step_breakdown: Optional[dict] = None  # telemetry summary (shares)
+    tuning_report: Optional[dict] = None  # autotune protocol (MXTPU_AUTOTUNE)
 
 
 class FitLoop:
@@ -216,7 +219,21 @@ class FitLoop:
             hb = fault.Heartbeat(self._ckpt_dir,
                                  interval=self._hb_interval).start()
         self._install_handlers()
-        bd = StepBreakdown().install() if self._collect_breakdown else None
+        # MXTPU_AUTOTUNE: probe-then-lock controller; malformed specs
+        # raise HERE, before any step runs. The tuner scores candidates
+        # with the step breakdown, so probing forces one on even when the
+        # caller disabled collection — only until the lock, after which
+        # the opt-out is honored again (uninstalled below).
+        tuner = None
+        if _autotune.requested():
+            tuner = _autotune.AutoTuner(trainer=self._trainer,
+                                        data_iter=self._iter)
+        bd = StepBreakdown().install() \
+            if (self._collect_breakdown or tuner is not None) else None
+        # comm/backward overlap (MXTPU_COMM_OVERLAP / tuner-probed):
+        # brackets backward so gradient collectives launch during the
+        # reverse pass; inactive scopes are free
+        overlap_scope = getattr(self._trainer, "overlap_scope", None)
         try:
             for epoch in range(start_epoch, epochs):
                 self._position_iter(epoch)
@@ -241,9 +258,19 @@ class FitLoop:
                         plan.maybe_kill()  # ChaosKilled propagates (abrupt)
                     if self._preempted is not None:
                         self._final_exit(cm, result, epoch, consumed)
+                    if tuner is not None:
+                        tuner.on_step_begin(result.step)
                     x = batch.data[0]
                     y = batch.label[0] if batch.label else None
                     from . import autograd
+                    # comm/backward overlap: the scope itself goes
+                    # inactive for a step whose grads the chaos plan will
+                    # poison AFTER backward (clean grads must not ship
+                    # early) — pass OUR chaos clock, the trainer's own
+                    # step() counter never advances under FitLoop
+                    ov = overlap_scope(chaos_step=result.step) \
+                        if overlap_scope is not None \
+                        else contextlib.nullcontext()
                     with _segment("compute"):
                         with autograd.record():
                             out = self._net(x)
@@ -251,7 +278,8 @@ class FitLoop:
                                 else self._loss_fn(out)
                             scaled = loss * self._loss_scale \
                                 if self._loss_scale != 1.0 else loss
-                        scaled.backward()
+                        with ov:
+                            scaled.backward()
                     if plan is not None:
                         plan.poison_grads(self._trainer._params)
                     bs = batch_size if batch_size is not None \
@@ -331,7 +359,23 @@ class FitLoop:
                         with _segment("checkpoint"):
                             self._save(cm, result.step, epoch, consumed)
                     if bd is not None:
-                        bd.end_step()
+                        rec = bd.end_step()
+                        if tuner is not None:
+                            # result.step already incremented: report the
+                            # step that RAN (result.step - 1), matching
+                            # on_step_begin, the breakdown record index,
+                            # and the step:N trace marker — locked_at is
+                            # then the last step under probe knobs, and
+                            # locked_at+1 the first fully-locked record
+                            tuner.on_step_end(result.step - 1, rec,
+                                              breakdown=bd)
+                            if tuner.locked and \
+                                    not self._collect_breakdown:
+                                # the breakdown existed only to score the
+                                # probes: the caller's opt-out resumes
+                                # now that the tuner is quiescent
+                                bd.uninstall()
+                                bd = None
                 skip_batches = 0
                 result.epoch = epoch + 1
                 pos_epoch, pos_batch = epoch + 1, 0
@@ -343,14 +387,22 @@ class FitLoop:
             if cm is not None:
                 cm.wait()
         finally:
+            if tuner is not None:
+                # the decision persists in the report; the env mutation
+                # must not leak past this fit() call
+                tuner.restore_env()
             if bd is not None:
                 bd.uninstall()
             if hb is not None:
                 hb.stop()
             self._restore_handlers()
         result.loss_scale = self._loss_scale
-        if bd is not None and bd.steps:
+        if bd is not None and bd.steps and self._collect_breakdown:
+            # a probe-only breakdown (collect_breakdown=False, run ended
+            # mid-probe) is not published either — the caller opted out
             result.step_breakdown = bd.summary()
+        if tuner is not None:
+            result.tuning_report = tuner.report()
         return result
 
     def _final_exit(self, cm, result: FitResult, epoch: int,

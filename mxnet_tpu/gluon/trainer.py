@@ -16,6 +16,22 @@ issues one kvstore push/pull — one collective — per bucket instead of one
 per key; the kvstore's retry/chaos hooks wrap each bucketed call, so fault
 semantics are preserved per bucket. Sparse (row_sparse) parameters and
 gradients always take the original per-key/per-param paths.
+
+Comm/backward overlap (ref: the dependency engine scheduling each key's
+push as soon as its write dependency resolves — PAPER.md §engine,
+§KVStore): with ``MXTPU_COMM_OVERLAP=on`` the loop owner brackets
+``backward()`` in :meth:`Trainer.overlap_scope`, which installs the
+autograd grad-ready hook and launches each bucket's collective the moment
+its constituent gradients receive their final contribution DURING the
+reverse pass. Buckets use the SAME forward-order layout (and so the same
+``_gbkt`` keys) as the barrier path, but *launch* in finalization order —
+backward finalizes later layers' grads first, so the last buckets are in
+flight while backward is still producing the early layers' gradients;
+``allreduce_grads`` then only flushes stragglers and splits the flat wire
+buffers back. Numerically identical to the barrier path — the same
+buckets, the same sums, launched earlier. Overlapped communication is charged to
+the step-breakdown segment ``comm_overlapped`` (exclusive time, nested
+inside ``compute``).
 """
 from __future__ import annotations
 
@@ -26,9 +42,22 @@ from typing import Dict, List, Optional
 from ..base import MXNetError, check, env
 from .. import optimizer as opt_mod
 from ..optimizer import grouped as _grouped
+from ..telemetry.step_breakdown import segment as _bd_segment
 from .parameter import Parameter, ParameterDict
 
 __all__ = ["Trainer"]
+
+
+def _overlap_requested() -> bool:
+    """Strict MXTPU_COMM_OVERLAP parse — a typo'd request to overlap must
+    not silently train with the barrier path."""
+    raw = str(env.get("MXTPU_COMM_OVERLAP") or "").strip().lower()
+    if raw in ("", "0", "off", "false"):
+        return False
+    if raw in ("1", "on", "true"):
+        return True
+    raise MXNetError(
+        f"MXTPU_COMM_OVERLAP: unknown value {raw!r} (known: on, off)")
 
 
 @functools.lru_cache(maxsize=1)
@@ -132,6 +161,9 @@ class Trainer:
         # bucket keys already init'ed on the kvstore (keyed by the full
         # shape-signature string, so a layout change mints a fresh key)
         self._bucket_keys: Dict[str, bool] = {}
+        # live comm/backward overlap scope (set on scope entry, consumed
+        # by the next allreduce_grads)
+        self._overlap_state: Optional["_OverlapScope"] = None
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: p for i, p in enumerate(self._params)}
@@ -189,6 +221,42 @@ class Trainer:
     def optimizer(self):
         return self._optimizer
 
+    @staticmethod
+    def _bucket_mb() -> float:
+        try:
+            return float(env.get("MXTPU_GRAD_BUCKET_MB"))
+        except (TypeError, ValueError):
+            return 0.0
+
+    def overlap_scope(self, chaos_step: Optional[int] = None):
+        """Context manager for one backward pass that overlaps gradient
+        communication with the reverse pass (``MXTPU_COMM_OVERLAP=on``):
+        the autograd grad-ready hook launches each dense bucket's kvstore
+        push/pull as soon as its constituent grads are final, and the
+        following :meth:`allreduce_grads` call only flushes stragglers +
+        splits the flat buffers. Returns an inactive no-op scope when
+        overlap is off or there is no kvstore argument — the caller can
+        always write ``with trainer.overlap_scope(): loss.backward()``.
+
+        ``chaos_step``: the chaos clock index the upcoming step will run
+        under (defaults to this trainer's own ``step()`` clock; FitLoop
+        passes its step counter). A step whose grads the chaos plan will
+        poison AFTER backward gets an inactive scope: overlapped
+        collectives would ship the clean grads during backward — and,
+        through a compressing store, advance per-key error-feedback
+        residuals a second push on the same keys would then corrupt."""
+        # parse FIRST: a typo'd MXTPU_COMM_OVERLAP must raise even when
+        # there is no store (short-circuiting the parse away would let
+        # the typo silently train with the barrier path)
+        active = _overlap_requested() and bool(self._kvstore_arg)
+        if active:
+            from ..contrib import chaos
+            plan = chaos.active()
+            if plan is not None and plan.poisons_step(
+                    self._chaos_step if chaos_step is None else chaos_step):
+                active = False
+        return _OverlapScope(self, active)
+
     def allreduce_grads(self):
         """Sum gradients across devices (ref: trainer.py:327). With the SPMD
         mesh backend this is an XLA psum ridden through the kvstore.
@@ -199,38 +267,28 @@ class Trainer:
         flattening / DDP gradient bucketing), then split back over the old
         per-param grad buffers (which then free) — the flat wire buffer is
         transient, see :func:`_split_fn`. Row-sparse grads keep the
-        per-key mask-pack path."""
+        per-key mask-pack path. Under an active :meth:`overlap_scope` the
+        collectives were already launched during backward; this call
+        flushes the remainder and completes the splits."""
+        st = self._overlap_state
+        if st is not None:
+            self._overlap_state = None
+            st.finalize()
+            return
         if not self._kv_initialized:
             self._init_kvstore()
         self.last_allreduce_collectives = 0
         if self._kvstore is None:
             return
         from ..ndarray import sparse as _sp
-        try:
-            bucket_mb = float(env.get("MXTPU_GRAD_BUCKET_MB"))
-        except (TypeError, ValueError):
-            bucket_mb = 0.0
+        bucket_mb = self._bucket_mb()
         flat_items = []
         for i, p in enumerate(self._params):
             if p.grad_req == "null":
                 continue
             g = p.grad()
             if isinstance(g, _sp.RowSparseNDArray):
-                # single-process grads are already complete (the tape saw
-                # every device's batch); a cross-worker reduce would need
-                # the dist store's sparse wire path — densify for it
-                # (ref: trainer.py requires update_on_kvstore for
-                # row_sparse params for the same reason)
-                if self._kvstore.num_workers > 1:
-                    # dense [grad | row-mask] reduce: the mask column makes
-                    # the rebuilt row set the union across workers, even
-                    # for rows whose reduced gradient is exactly zero
-                    packed = _sp.mask_pack(g)
-                    self._kvstore.push(i, packed)
-                    self._kvstore.pull(i, packed)
-                    reduced = _sp.mask_unpack(packed, g.shape)
-                    g._update(reduced._data, reduced._indices)
-                    self.last_allreduce_collectives += 1
+                self._allreduce_rowsparse(i, g)
                 continue
             if bucket_mb > 0:
                 flat_items.append((i, g))
@@ -242,6 +300,24 @@ class Trainer:
             self._allreduce_bucketed(flat_items, bucket_mb)
         if self.last_allreduce_collectives:
             _allreduce_counter().inc(self.last_allreduce_collectives)
+
+    def _allreduce_rowsparse(self, i, g):
+        """Cross-worker reduce of one row_sparse gradient. Single-process
+        grads are already complete (the tape saw every device's batch); a
+        cross-worker reduce would need the dist store's sparse wire path —
+        densify for it (ref: trainer.py requires update_on_kvstore for
+        row_sparse params for the same reason)."""
+        from ..ndarray import sparse as _sp
+        if self._kvstore.num_workers > 1:
+            # dense [grad | row-mask] reduce: the mask column makes
+            # the rebuilt row set the union across workers, even
+            # for rows whose reduced gradient is exactly zero
+            packed = _sp.mask_pack(g)
+            self._kvstore.push(i, packed)
+            self._kvstore.pull(i, packed)
+            reduced = _sp.mask_unpack(packed, g.shape)
+            g._update(reduced._data, reduced._indices)
+            self.last_allreduce_collectives += 1
 
     def _grad_buckets(self, items, bucket_mb):
         """Deterministic same-dtype runs capped at ``bucket_mb`` MB — the
@@ -263,47 +339,60 @@ class Trainer:
         return buckets
 
     def _allreduce_bucketed(self, items, bucket_mb):
-        from ..ndarray import ndarray as _nd
         for bid, bucket in enumerate(self._grad_buckets(items, bucket_mb)):
-            if len(bucket) == 1:
-                # a lone grad (or one larger than the cap) rides its own
-                # already-initialized per-param key — no copy overhead
-                i, g = bucket[0]
-                self._kvstore.push(i, g)
-                self._kvstore.pull(i, g)
-                self.last_allreduce_collectives += 1
-                continue
-            sig = tuple((g.shape, str(g._data.dtype)) for _, g in bucket)
-            flat = _flatten_fn()(*[g._data for _, g in bucket])
-            flat_nd = _nd.NDArray(flat, ctx=bucket[0][1]._ctx)
-            # the key encodes the bucket's FULL shape signature (digest):
-            # if the layout changes mid-run (a param frozen, the MB cap
-            # changed) a fresh key gets a fresh store buffer and a fresh
-            # compressor error-feedback residual — a stale key would push
-            # a differently-laid-out flat into old state. init() is a
-            # no-op when the key already exists; superseded keys linger in
-            # the store (bounded by layout changes, not steps).
-            import hashlib
-            digest = hashlib.md5(repr(sig).encode()).hexdigest()[:10]
-            key = (f"_gbkt{bid}:{sig[0][1]}:{int(flat.shape[0])}"
-                   f":n{len(bucket)}:{digest}")
-            if key not in self._bucket_keys:
-                try:
-                    # the flat wire buffer must NOT be row-sharded by the
-                    # big-array bound — it is split back immediately
-                    self._kvstore.init(key, flat_nd, shard=False)
-                except TypeError:  # user-supplied store without shard=
-                    self._kvstore.init(key, flat_nd)
-                self._bucket_keys[key] = True
-            # retry/chaos hooks (TransientKVError backoff, kv_flake) wrap
-            # these calls per BUCKET key inside the kvstore, preserving
-            # the fault semantics of the per-key path
-            self._kvstore.push(key, flat_nd)
-            self._kvstore.pull(key, out=flat_nd)
+            flat = self._launch_bucket(bid, bucket)
+            if flat is not None:
+                self._split_bucket(bucket, *flat)
+
+    def _launch_bucket(self, bid, bucket):
+        """Push+pull one dense bucket. A flattened multi-grad bucket
+        returns ``(sig, flat_nd)`` with the split DEFERRED to the caller
+        (overlap launches split after backward finishes); a singleton
+        rides its per-param key, pulled in place, and returns None."""
+        from ..ndarray import ndarray as _nd
+        if len(bucket) == 1:
+            # a lone grad (or one larger than the cap) rides its own
+            # already-initialized per-param key — no copy overhead
+            i, g = bucket[0]
+            self._kvstore.push(i, g)
+            self._kvstore.pull(i, g)
             self.last_allreduce_collectives += 1
-            parts = _split_fn(sig)(flat_nd._data)
-            for (_, g), arr in zip(bucket, parts):
-                g._rebind(arr)
+            return None
+        sig = tuple((g.shape, str(g._data.dtype)) for _, g in bucket)
+        flat = _flatten_fn()(*[g._data for _, g in bucket])
+        flat_nd = _nd.NDArray(flat, ctx=bucket[0][1]._ctx)
+        # the key encodes the bucket's FULL shape signature (digest):
+        # if the layout changes mid-run (a param frozen, the MB cap
+        # changed) a fresh key gets a fresh store buffer and a fresh
+        # compressor error-feedback residual — a stale key would push
+        # a differently-laid-out flat into old state. init() is a
+        # no-op when the key already exists; superseded keys linger in
+        # the store (bounded by layout changes, not steps).
+        import hashlib
+        digest = hashlib.md5(repr(sig).encode()).hexdigest()[:10]
+        key = (f"_gbkt{bid}:{sig[0][1]}:{int(flat.shape[0])}"
+               f":n{len(bucket)}:{digest}")
+        if key not in self._bucket_keys:
+            try:
+                # the flat wire buffer must NOT be row-sharded by the
+                # big-array bound — it is split back immediately
+                self._kvstore.init(key, flat_nd, shard=False)
+            except TypeError:  # user-supplied store without shard=
+                self._kvstore.init(key, flat_nd)
+            self._bucket_keys[key] = True
+        # retry/chaos hooks (TransientKVError backoff, kv_flake) wrap
+        # these calls per BUCKET key inside the kvstore, preserving
+        # the fault semantics of the per-key path
+        self._kvstore.push(key, flat_nd)
+        self._kvstore.pull(key, out=flat_nd)
+        self.last_allreduce_collectives += 1
+        return sig, flat_nd
+
+    @staticmethod
+    def _split_bucket(bucket, sig, flat_nd):
+        parts = _split_fn(sig)(flat_nd._data)
+        for (_, g), arr in zip(bucket, parts):
+            g._rebind(arr)
 
     def step(self, batch_size, ignore_stale_grad=False):
         """One optimization step: rescale by 1/batch_size, allreduce, update
@@ -318,6 +407,16 @@ class Trainer:
             # (FitLoop drives it itself and never calls step())
             plan.begin_step(self._chaos_step)
             self._chaos_step += 1
+            if self._overlap_state is not None and \
+                    plan.poisons_step(self._chaos_step - 1):
+                # late defense for a plan installed AFTER the scope was
+                # entered (overlap_scope() returns an inactive scope for
+                # steps it KNOWS will be poisoned): collectives already
+                # shipped the CLEAN grads during backward; consuming the
+                # state would let the deferred splits overwrite the
+                # poison injected below. Abandon it — allreduce re-runs
+                # on the poisoned buffers and the fault bites
+                self._overlap_state = None
             plan.poison_grads(self._params)
         self.allreduce_grads()
         self._update(ignore_stale_grad)
@@ -428,3 +527,158 @@ class Trainer:
     def load_states(self, fname):
         with open(fname, "rb") as f:
             self._updaters[0].set_states(f.read())
+
+
+class _OverlapScope:
+    """One backward pass's comm/backward overlap state.
+
+    Entering installs the autograd grad-ready hook; while backward runs,
+    each dense bucket whose constituent grads have ALL received their
+    final contribution is pushed/pulled immediately (the barrier path's
+    forward-order layout, launched in finalization order: later layers'
+    buckets finalize first and go out while backward still computes the
+    early layers). The flat-buffer splits are deferred to
+    :meth:`finalize` (called by the trainer's next ``allreduce_grads``),
+    so the collectives stay in flight behind the remaining backward
+    compute.
+
+    The bucket layout is built lazily at the first hook firing: deferred-
+    init parameters only materialize shapes during the first forward, and
+    the kvstore itself initializes lazily. A backward that announces no
+    grads (whole-graph CachedOp bypasses the tape) degrades gracefully:
+    finalize launches every bucket, which is exactly the barrier path.
+
+    Contract: each entered scope is paired with the following
+    ``allreduce_grads``/``step`` call, which consumes it. A scope whose
+    backward raised is abandoned on exit (its launched buckets hold a
+    partial step's grads); a scope abandoned any other way (the caller
+    skipped the update entirely) is superseded wholesale by the next
+    scope's entry — interleaving an un-consumed scope with a scopeless
+    ``allreduce_grads`` is caller error.
+    """
+
+    def __init__(self, trainer: Trainer, active: bool):
+        self._trainer = trainer
+        self.active = active
+        self._cm = None
+        self._buckets = None        # list of [(param_idx, grad_nd), ...]
+        self._sparse = None         # [(param_idx, grad_nd), ...]
+        self._owner: Dict[int, int] = {}   # id(grad) -> bucket index
+        self._pending: List[int] = []
+        self._launched: List = []   # per bucket: None | True | (sig, flat)
+        self._nostore = False
+
+    # -- context management ---------------------------------------------
+    def __enter__(self):
+        # any stale state from an aborted step is superseded wholesale —
+        # by INACTIVE entries too: a caller that skipped an update and
+        # then entered a poisoned-step/off scope must not leave the old
+        # scope's launched buckets for the next allreduce_grads to split
+        # over fresh gradients
+        self._trainer._overlap_state = None
+        if not self.active:
+            return self
+        from .. import autograd
+        self._cm = autograd.grad_ready_scope(self._on_ready)
+        self._cm.__enter__()
+        self._trainer._overlap_state = self
+        self._trainer.last_allreduce_collectives = 0
+        return self
+
+    def __exit__(self, *exc):
+        if self._cm is not None:
+            self._cm.__exit__(*exc)
+            self._cm = None
+        if exc and exc[0] is not None and \
+                self._trainer._overlap_state is self:
+            # backward died mid-pass: buckets already launched hold a
+            # partial step's grads. A later allreduce_grads (next step,
+            # or a caller that catches and continues) must NOT consume
+            # them — the deferred splits would overwrite fresh gradients
+            # with this aborted step's values. Abandon wholesale.
+            self._trainer._overlap_state = None
+        return False
+
+    # -- layout ---------------------------------------------------------
+    def _ensure_ready(self) -> bool:
+        """Lazy kvstore + bucket layout; returns False when there is no
+        store to communicate through (overlap degrades to a no-op and
+        allreduce_grads' normal no-store semantics)."""
+        if self._nostore:
+            return False
+        if self._buckets is not None:
+            return True
+        t = self._trainer
+        if not t._kv_initialized:
+            t._init_kvstore()
+        if t._kvstore is None:
+            self._nostore = True
+            return False
+        from ..ndarray import sparse as _sp
+        items, sparse = [], []
+        # the SAME forward-order layout as the barrier path: identical
+        # bucket contents and _gbkt keys whichever path runs (a store
+        # compressor's per-key error-feedback residual sees one layout,
+        # and toggling overlap mid-run — the tuner probes it — can't mint
+        # a parallel key set). Launch order still follows FINALIZATION
+        # order naturally: backward finalizes later layers' grads first,
+        # so the later buckets complete — and ship — while backward is
+        # still computing the early layers.
+        for i, p in enumerate(t._params):
+            if p.grad_req == "null" or p._grad is None:
+                continue
+            g = p.grad()
+            if isinstance(g, _sp.RowSparseNDArray):
+                sparse.append((i, g))
+                continue
+            items.append((i, g))
+        bucket_mb = t._bucket_mb()
+        if bucket_mb > 0:
+            self._buckets = t._grad_buckets(items, bucket_mb)
+        else:
+            # per-key scheduling: every grad launches the moment it is
+            # final — the reference engine's exact behavior
+            self._buckets = [[it] for it in items]
+        self._sparse = sparse
+        self._pending = [len(b) for b in self._buckets]
+        self._launched = [None] * len(self._buckets)
+        for b, bucket in enumerate(self._buckets):
+            for _, g in bucket:
+                self._owner[id(g)] = b
+        return True
+
+    # -- the grad-ready hook (runs on the backward thread) --------------
+    def _on_ready(self, gbuf) -> None:
+        if not self._ensure_ready():
+            return
+        b = self._owner.get(id(gbuf))
+        if b is None or self._launched[b] is not None:
+            return
+        self._pending[b] -= 1
+        if self._pending[b] > 0:
+            return
+        # the whole bucket is final: launch its collective NOW, while
+        # backward still runs. Exclusive time lands in 'comm_overlapped'
+        # (nested inside the loop owner's 'compute' segment).
+        with _bd_segment("comm_overlapped"):
+            self._launched[b] = \
+                self._trainer._launch_bucket(b, self._buckets[b]) or True
+
+    # -- completion (from Trainer.allreduce_grads) ----------------------
+    def finalize(self) -> None:
+        if not self._ensure_ready():
+            return
+        t = self._trainer
+        # stragglers: grads that never announced (tape bypassed, stale
+        # grads under ignore_stale_grad) ride the barrier path now
+        for b, bucket in enumerate(self._buckets):
+            if self._launched[b] is None:
+                self._launched[b] = t._launch_bucket(b, bucket) or True
+        for b, bucket in enumerate(self._buckets):
+            r = self._launched[b]
+            if r is not True:
+                t._split_bucket(bucket, *r)
+        for i, g in self._sparse:
+            t._allreduce_rowsparse(i, g)
+        if t.last_allreduce_collectives:
+            _allreduce_counter().inc(t.last_allreduce_collectives)

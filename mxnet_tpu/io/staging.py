@@ -52,6 +52,19 @@ class DeviceStagingIter(DataIter):
         self._exhausted = False
 
     @property
+    def depth(self) -> int:
+        """Staging depth: batches kept in flight ahead of consumption."""
+        return self._depth
+
+    def set_depth(self, depth: int) -> None:
+        """Retarget the staging depth mid-run (the autotuner's prefetch
+        knob). Deepening takes effect on the next ``next()`` (it stages
+        further ahead); shallowing drains naturally — already-staged
+        batches are served, never dropped."""
+        check(depth >= 1, "staging depth must be >= 1")
+        self._depth = int(depth)
+
+    @property
     def provide_data(self):
         return self._base.provide_data
 

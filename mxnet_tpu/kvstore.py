@@ -95,7 +95,12 @@ def _chaos_kv(op: str, key) -> None:
     from .contrib import chaos
     plan = chaos.active()
     if plan is not None:
+        # flake BEFORE the injected wire delay: a failed attempt should
+        # cost the retry loop backoff, not also the kv_slow sleep
         plan.kv_maybe_fail(op, key)
+        delay = plan.kv_delay_s()
+        if delay > 0.0:
+            time.sleep(delay)
 
 
 def _group(keys, values):

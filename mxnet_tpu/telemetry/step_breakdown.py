@@ -12,19 +12,27 @@ anything else that wants it) brackets each step's phases with
 
 Segments (the canonical set; producers may add their own names):
 
-===========  ==========================================================
-data_wait    blocked on the input pipeline (iterator next())
-h2d          host->device staging of batch arrays
-compute      forward + backward + device sync of the loss
-optimizer    parameter update (incl. the fused sentinel reduction)
-comm         gradient allreduce / kvstore push-pull
-checkpoint   checkpoint writes on the step path
-===========  ==========================================================
+===============  ======================================================
+data_wait        blocked on the input pipeline (iterator next())
+h2d              host->device staging of batch arrays
+compute          forward + backward + device sync of the loss
+optimizer        parameter update (incl. the fused sentinel reduction)
+comm             gradient allreduce / kvstore push-pull after backward
+comm_overlapped  collectives launched DURING backward by the overlap
+                 scheduler (``MXTPU_COMM_OVERLAP``) — nested inside
+                 ``compute``, charged exclusively here so overlapped
+                 communication is neither double-counted against compute
+                 nor silently vanished
+checkpoint       checkpoint writes on the step path
+===============  ======================================================
 
 The **input-bound / comm-bound detector**: at each step end, any
 non-compute segment whose share of wall-clock exceeds
 ``MXTPU_PROFILE_BOUND_FRAC`` (default 0.4) logs a one-line diagnosis
 naming the bound segment, its share, and the first lever to reach for.
+When a controller (the autotuner, :mod:`.autotune`) has already pulled
+that lever, :meth:`StepBreakdown.note_action` upgrades the line from
+diagnosis to "diagnosis → action taken".
 """
 from __future__ import annotations
 
@@ -42,15 +50,18 @@ __all__ = ["SEGMENTS", "StepBreakdown", "segment", "current_breakdown"]
 _LOG = get_logger("mxnet_tpu.telemetry")
 
 SEGMENTS = ("data_wait", "h2d", "compute", "optimizer", "comm",
-            "checkpoint")
+            "comm_overlapped", "checkpoint")
 
 #: remedy hint per over-threshold segment (the one-line diagnosis tail)
 _ADVICE = {
     "data_wait": "input-bound: add decode threads / PrefetchingIter "
                  "or stage with DeviceStagingIter",
     "h2d": "transfer-bound: overlap H2D with DeviceStagingIter(depth>1)",
-    "comm": "comm-bound: raise MXTPU_GRAD_BUCKET_MB or enable gradient "
-            "compression",
+    "comm": "comm-bound: enable MXTPU_COMM_OVERLAP / MXTPU_AUTOTUNE, "
+            "raise MXTPU_GRAD_BUCKET_MB or enable gradient compression",
+    "comm_overlapped": "comm-bound despite overlap: collectives outlast "
+                       "backward — raise MXTPU_GRAD_BUCKET_MB or enable "
+                       "gradient compression",
     "optimizer": "update-bound: raise MXTPU_OPTIMIZER_AGGREGATION",
     "checkpoint": "ckpt-bound: raise ckpt_every or use async_ckpt=True",
 }
@@ -157,6 +168,11 @@ class StepBreakdown:
         self._stack: List[_Segment] = []
         self.diagnoses: List[str] = []
         self._diag_counts: Dict[str, int] = defaultdict(int)
+        # segment -> description of the remedy a controller already
+        # applied (autotuner lock); upgrades the detector's line from
+        # diagnosis to "diagnosis → action taken"
+        self.actions: Dict[str, str] = {}
+        self._last_marked_step = object()  # sentinel: != any step id
 
     # -- thread binding -------------------------------------------------
     def install(self) -> "StepBreakdown":
@@ -168,10 +184,26 @@ class StepBreakdown:
             _tls.active = None
 
     # -- per-step lifecycle ---------------------------------------------
+    def note_action(self, segment_name: str, action: str) -> None:
+        """Record that a controller acted on ``segment_name``'s lever
+        (e.g. the autotuner locking a bigger gradient bucket). Subsequent
+        detector lines for that segment read "… → action taken: …"."""
+        self.actions[segment_name] = str(action)
+
     def begin_step(self, step: Optional[int] = None) -> None:
         self._cur = defaultdict(float)
         self._stack = []
         self._step_id = step
+        if _tracer.enabled and step != self._last_marked_step:
+            # step delimiter in the trace: offline tools
+            # (tools/trace_report.py) reconstruct per-step segment tables
+            # from these markers without needing the live StepBreakdown.
+            # Deduped by id: resume fast-forward replays begin_step with
+            # the step frozen at the checkpoint — one marker, not one per
+            # replayed batch (the replay's data_wait folds into that
+            # step's row, which is the true cost of resuming there)
+            self._last_marked_step = step
+            _tracer.instant(f"step:{step}", "step")
         self._step_t0 = time.perf_counter()
 
     def _charge(self, name: str, seconds: float) -> None:
@@ -209,6 +241,8 @@ class StepBreakdown:
                 msg = (f"step {self._step_id}: {name} is {frac:.0%} of "
                        f"step time ({s * 1e3:.1f}ms of {wall * 1e3:.1f}ms) "
                        f"— {_ADVICE.get(name, 'non-compute bound')}")
+                if name in self.actions:
+                    msg += f" → action taken: {self.actions[name]}"
                 if len(self.diagnoses) < self.MAX_DIAGNOSES:
                     self.diagnoses.append(msg)
                 # a persistently bound run must not warn once per step:
@@ -244,4 +278,5 @@ class StepBreakdown:
             "per_step": [{k: round(v, 6) for k, v in rec.items()}
                          for rec in self.steps],
             "diagnoses": list(self.diagnoses),
+            "actions": dict(self.actions),
         }

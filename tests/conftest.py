@@ -40,3 +40,8 @@ def pytest_configure(config):
         "markers", "telemetry: unified telemetry subsystem tests "
         "(mxnet_tpu/telemetry: tracer, chrome-trace export, metrics "
         "registry, step breakdown). Tier-1-safe: CPU, in-process.")
+    config.addinivalue_line(
+        "markers", "autotune: self-tuning runtime tests "
+        "(telemetry/autotune.py probe-then-lock controller, "
+        "comm/backward overlap, bench hygiene). Tier-1-safe: CPU, "
+        "in-process, deterministic kv_slow chaos for comm-heavy steps.")

@@ -1,0 +1,77 @@
+"""Bench hygiene (ROADMAP carry-item): the headline artifact must parse.
+
+BENCH r05 shipped rc:124 with an EMPTY artifact — the failure mode was
+only caught post-hoc, in the bench review. These subprocess tests pin the
+two structural guarantees in-repo:
+
+- a tiny ``MXTPU_BENCH_DEADLINE_S`` run (the ``smoke`` model: 2-layer
+  MLP, compiles in seconds on CPU) still emits a headline JSON line that
+  parses and carries the train + step_breakdown + autotune rows;
+- a deadline too small for ANY child still exits 0 with a parseable
+  error row, never silence.
+
+Marker ``autotune`` (this PR's subsystem marker; tier-1-safe).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.autotune
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_bench(deadline_s, timeout, extra_env=None):
+    env = {**os.environ,
+           "JAX_PLATFORMS": "cpu",
+           "MXTPU_BENCH_DEADLINE_S": str(deadline_s),
+           "MXTPU_BENCH_CONFIGS": "8x2",
+           "MXTPU_BENCH_MODEL": "smoke",
+           "MXTPU_BENCH_DTYPE": "float32",
+           "MXTPU_BENCH_INFERENCE": "0",
+           "MXTPU_BENCH_LOWBIT": "0",
+           **(extra_env or {})}
+    return subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py")],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=ROOT)
+
+
+def test_bench_tiny_deadline_emits_full_headline_json():
+    res = _run_bench(deadline_s=240, timeout=280)
+    assert res.returncode == 0, res.stderr[-1000:]
+    rows = [json.loads(l) for l in res.stdout.splitlines()
+            if l.startswith("{")]
+    assert rows, f"no JSON on stdout:\n{res.stdout}\n{res.stderr[-500:]}"
+    # incremental re-emission: the LAST line is the most complete payload
+    payload = rows[-1]
+    assert payload["metric"] == "resnet50_train_imgs_per_sec"
+    assert "error" not in payload, payload
+    assert payload["value"] > 0
+    # the r05 class of outage: rows present, not silently missing
+    bd = payload["step_breakdown"]
+    assert bd["steps"] > 0 and 0.8 <= bd["accounted_frac"] <= 1.0 + 1e-6
+    assert "compute" in bd["shares"]
+    at = payload["autotune"]
+    assert at["status"] == "locked"
+    assert at["probe_candidates"] >= 2
+    assert set(at["chosen"]) == set(at["baseline"]) != set()
+    # the tuner's needle on the comm-heavy probe config: exposed comm
+    # share shrinks, and the hidden time stays visible
+    assert at["comm_share_after"] < at["comm_share_before"]
+    assert at["comm_overlapped_share_after"] > 0
+
+
+def test_bench_exhausted_deadline_still_emits_parseable_row():
+    """Deadline too small for any child: bench must exit 0 with an error
+    row that parses — never rc:124 with an empty artifact."""
+    res = _run_bench(deadline_s=5, timeout=120)
+    assert res.returncode == 0, res.stderr[-500:]
+    rows = [json.loads(l) for l in res.stdout.splitlines()
+            if l.startswith("{")]
+    assert len(rows) == 1
+    assert rows[0]["metric"] == "resnet50_train_imgs_per_sec"
+    assert rows[0]["value"] == 0.0
+    assert "error" in rows[0]

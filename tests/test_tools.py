@@ -56,3 +56,151 @@ def test_bandwidth_model_shapes():
     mesh = make_mesh({"dp": 8})
     bw, mb = _measure_shapes(mesh, "dp", shapes[:4], iters=2)
     assert bw > 0 and mb > 0
+
+
+# ---------------------------------------------------------------------------
+# tools/trace_report.py — offline chrome-trace reader (autotune PR)
+# ---------------------------------------------------------------------------
+
+def _synthetic_trace():
+    """Two steps with nested spans + an autotune probe/decision, in
+    chrome-trace object format (ts/dur in us)."""
+    ev = []
+
+    def span(name, cat, ts, dur, tid=1):
+        ev.append({"name": name, "cat": cat, "ph": "X", "ts": ts,
+                   "dur": dur, "pid": 7, "tid": tid, "args": {}})
+
+    ev.append({"name": "step:0", "cat": "step", "ph": "i", "ts": 1000,
+               "pid": 7, "tid": 1, "s": "t", "args": {}})
+    span("fwd_bwd", "compute", 1000, 900)
+    span("bucket0", "comm_overlapped", 1400, 400)   # nested in compute
+    span("allreduce", "comm", 1950, 50)
+    ev.append({"name": "step:1", "cat": "step", "ph": "i", "ts": 2000,
+               "pid": 7, "tid": 1, "s": "t", "args": {}})
+    span("fwd_bwd", "compute", 2000, 800)
+    span("probe:overlap=1", "autotune", 2000, 900)
+    # a warmup probe span (measured=False): the tuner excluded it from
+    # its scores, the offline reader must too
+    ev.append({"name": "probe:overlap=1", "cat": "autotune", "ph": "X",
+               "ts": 900, "dur": 5000, "pid": 7, "tid": 1,
+               "args": {"measured": False}})
+    ev.append({"name": 'autotune:lock {"chosen": {"overlap": 1}}',
+               "cat": "autotune", "ph": "i", "ts": 2950, "pid": 7,
+               "tid": 1, "s": "t", "args": {}})
+    return {"traceEvents": ev}
+
+
+def _run_trace_report(tmp_path, payload, *args):
+    trace = tmp_path / "trace.json"
+    trace.write_text(json.dumps(payload))
+    return subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "trace_report.py"),
+         str(trace), *args], capture_output=True, text=True, timeout=60)
+
+
+def test_trace_report_exclusive_nesting_and_decision(tmp_path):
+    r = _run_trace_report(tmp_path, _synthetic_trace(), "--json")
+    assert r.returncode == 0, r.stderr
+    out = json.loads(r.stdout)
+    steps = {row["step"]: row for row in out["steps"]}
+    assert set(steps) == {"0", "1"}
+    # EXCLUSIVE accounting: the 400us comm_overlapped span nested inside
+    # the 900us compute span is charged once — compute keeps 500us
+    assert steps["0"]["segments"]["comm_overlapped"] == 400.0
+    assert steps["0"]["segments"]["compute"] == 500.0
+    assert steps["0"]["segments"]["comm"] == 50.0
+    # the tuner's footprint survives the round trip
+    assert out["autotune"]["probes"]["overlap=1"]["steps"] == 1
+    assert out["autotune"]["decision"] == {"chosen": {"overlap": 1}}
+
+
+def test_trace_report_kv_spans_under_overlap_charge_overlapped(tmp_path):
+    """kvstore wire spans (cat 'comm') nested inside a comm_overlapped
+    bracket are HIDDEN communication: live, the overlap scheduler charges
+    the whole launch to comm_overlapped and the kv tracer spans never
+    touch the breakdown — so the offline reconstruction must relabel
+    them, or the innermost-span rule would report hidden comm as exposed,
+    the exact inversion of what the run measured."""
+    ev = {"traceEvents": [
+        {"name": "step:0", "cat": "step", "ph": "i", "ts": 1000,
+         "pid": 7, "tid": 1, "s": "t", "args": {}},
+        {"name": "fwd_bwd", "cat": "compute", "ph": "X", "ts": 1000,
+         "dur": 900, "pid": 7, "tid": 1, "args": {}},
+        {"name": "bucket0", "cat": "comm_overlapped", "ph": "X",
+         "ts": 1200, "dur": 500, "pid": 7, "tid": 1, "args": {}},
+        {"name": "kv_push:_gbkt0", "cat": "comm", "ph": "X", "ts": 1210,
+         "dur": 240, "pid": 7, "tid": 1, "args": {}},
+        {"name": "kv_pull:_gbkt0", "cat": "comm", "ph": "X", "ts": 1455,
+         "dur": 230, "pid": 7, "tid": 1, "args": {}},
+        # an exposed straggler AFTER backward keeps its own category
+        {"name": "kv_push:3", "cat": "comm", "ph": "X", "ts": 1910,
+         "dur": 60, "pid": 7, "tid": 1, "args": {}},
+    ]}
+    r = _run_trace_report(tmp_path, ev, "--json")
+    assert r.returncode == 0, r.stderr
+    segs = json.loads(r.stdout)["steps"][0]["segments"]
+    # the whole 500us launch bracket (30us overhead + 470us wire) is
+    # overlapped; only the straggler stays exposed comm
+    assert segs["comm_overlapped"] == 500.0
+    assert segs["comm"] == 60.0
+    assert segs["compute"] == 400.0
+
+
+def test_trace_report_human_table(tmp_path):
+    r = _run_trace_report(tmp_path, _synthetic_trace())
+    assert r.returncode == 0, r.stderr
+    assert "comm_overlapped" in r.stdout and "share" in r.stdout
+    assert "autotune decision" in r.stdout
+    # bad input: clean error, distinct exit code, nothing on stdout
+    bad = tmp_path / "bad.json"
+    bad.write_text("not json")
+    r2 = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "trace_report.py"),
+         str(bad)], capture_output=True, text=True, timeout=60)
+    assert r2.returncode == 2 and "trace_report" in r2.stderr
+
+
+def test_trace_report_reads_live_fit_dump(tmp_path):
+    """End-to-end: a traced FitLoop run with the autotuner on dumps a
+    chrome trace that the offline tool reads back — per-step segment
+    rows, probe spans, and the lock decision."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, io as mxio, telemetry
+    from mxnet_tpu import kvstore as kv_mod
+    from mxnet_tpu.fit import FitLoop
+    from mxnet_tpu.telemetry import dump_chrome_trace
+
+    mx.random.seed(0)
+    rs = np.random.RandomState(0)
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    it = mxio.NDArrayIter(rs.randn(96, 16).astype(np.float32),
+                          rs.randint(0, 4, (96,)).astype(np.float32),
+                          batch_size=16)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.01},
+                            kvstore=kv_mod.create("device"))
+    os.environ["MXTPU_AUTOTUNE"] = "on,probe=1,warmup=0,knobs=overlap"
+    telemetry.enable()
+    try:
+        FitLoop(net, trainer, gluon.loss.SoftmaxCrossEntropyLoss(), it,
+                ckpt_dir=None).fit(epochs=1)
+        trace = tmp_path / "live.json"
+        dump_chrome_trace(str(trace))
+    finally:
+        telemetry.disable()
+        telemetry.tracer.clear()
+        os.environ.pop("MXTPU_AUTOTUNE", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "trace_report.py"),
+         str(trace), "--json"], capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    out = json.loads(r.stdout)
+    assert len(out["steps"]) >= 6
+    assert any("compute" in row["segments"] for row in out["steps"])
+    assert out["autotune"]["probes"], "probe spans missing from trace"
+    assert out["autotune"]["decision"] is not None
+    assert out["autotune"]["decision"]["chosen"]["overlap"] in (0, 1)

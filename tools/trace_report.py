@@ -1,0 +1,222 @@
+#!/usr/bin/env python
+"""Offline chrome-trace reader: per-step segment shares + tuner decisions.
+
+A trace dumped on a remote rank (``MXTPU_PROFILE=on,file=...`` or the
+kvstore remote profiler command channel) is a chrome-trace JSON blob; this
+tool turns it back into the operator-facing tables without Perfetto:
+
+- the per-step segment table ``StepBreakdown`` would have printed live,
+  reconstructed from the ``step:N`` instant markers (category ``step``)
+  that :meth:`StepBreakdown.begin_step` drops into the trace, with the
+  same EXCLUSIVE-time accounting (a span nested inside another on the
+  same thread is charged once, to the innermost bracket). One relabel
+  mirrors the live breakdown: kvstore wire spans (category ``comm``)
+  nested inside a ``comm_overlapped`` segment bracket are charged to
+  ``comm_overlapped`` — live, the overlap scheduler charges the whole
+  launch there and the kv spans never touch the breakdown, so charging
+  the innermost ``comm`` span would report hidden communication as
+  exposed, the exact inversion of what the run measured;
+- the autotuner's protocol (category ``autotune``): per-candidate probe
+  spans and the ``autotune:lock {...}`` decision event.
+
+Pure stdlib on purpose — it must run on a laptop with nothing installed::
+
+    python tools/trace_report.py /tmp/rank3.json
+    python tools/trace_report.py /tmp/rank3.json --steps 8 --json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+
+def load_events(path: str) -> List[dict]:
+    """Load trace events from object format ({"traceEvents": [...]}) or
+    the bare JSON-array format chrome://tracing also accepts."""
+    with open(path) as f:
+        payload = json.load(f)
+    if isinstance(payload, dict):
+        events = payload.get("traceEvents")
+        if not isinstance(events, list):
+            raise ValueError(f"{path}: no traceEvents array")
+        return events
+    if isinstance(payload, list):
+        return payload
+    raise ValueError(f"{path}: neither a trace object nor an event array")
+
+
+def _exclusive_durations(events: List[dict]) -> List[dict]:
+    """Annotate every complete ("X") span with its exclusive duration:
+    ``dur`` minus the time covered by spans nested inside it on the same
+    (pid, tid) track. The exporter guarantees per-thread spans form a
+    forest, so a sort + stack walk recovers the nesting."""
+    spans = [dict(e) for e in events if e.get("ph", "X") == "X"
+             and "dur" in e]
+    by_track: Dict[tuple, List[dict]] = defaultdict(list)
+    for s in spans:
+        s["_child"] = 0.0
+        by_track[(s.get("pid", 0), s.get("tid", 0))].append(s)
+    for track in by_track.values():
+        # parents first at equal start: longer span is the encloser
+        track.sort(key=lambda s: (float(s["ts"]), -float(s["dur"])))
+        stack: List[dict] = []
+        for s in track:
+            t0 = float(s["ts"])
+            while stack and float(stack[-1]["ts"]) + \
+                    float(stack[-1]["dur"]) <= t0:
+                stack.pop()
+            if stack:
+                stack[-1]["_child"] += float(s["dur"])
+                # kv wire spans under an overlap bracket: charge to
+                # comm_overlapped, like the live breakdown (see module
+                # docstring) — spans here are copies, safe to relabel
+                if s.get("cat") == "comm" and any(
+                        a.get("cat") == "comm_overlapped" for a in stack):
+                    s["cat"] = "comm_overlapped"
+            stack.append(s)
+    for s in spans:
+        s["excl"] = max(float(s["dur"]) - s["_child"], 0.0)
+    return spans
+
+
+def step_table(events: List[dict]) -> List[Dict[str, Any]]:
+    """Per-step {step, wall_us, segments: {cat: exclusive_us}} records,
+    delimited by the ``step:N`` markers. Without markers the whole trace
+    collapses into one row (step=None) so partial traces still read."""
+    marks = sorted((float(e["ts"]), e.get("name", ""))
+                   for e in events
+                   if e.get("ph") == "i" and e.get("cat") == "step")
+    spans = _exclusive_durations(events)
+    if not spans:
+        return []
+    end_ts = max(float(s["ts"]) + float(s["dur"]) for s in spans)
+    if not marks:
+        bounds = [(None, min(float(s["ts"]) for s in spans), end_ts)]
+    else:
+        bounds = []
+        for i, (ts, name) in enumerate(marks):
+            nxt = marks[i + 1][0] if i + 1 < len(marks) else end_ts
+            label = name.partition(":")[2] or name
+            bounds.append((label, ts, nxt))
+    # one sorted pass with a cursor, not a rescan per step: bounds are
+    # contiguous and ascending, so O(spans + steps) — a full 65536-span
+    # ring with thousands of step markers must not take minutes
+    spans.sort(key=lambda s: float(s["ts"]))
+    rows = []
+    si = 0
+    for label, t0, t1 in bounds:
+        while si < len(spans) and float(spans[si]["ts"]) < t0:
+            si += 1  # spans before the first marker are uncounted
+        segs: Dict[str, float] = defaultdict(float)
+        while si < len(spans) and float(spans[si]["ts"]) < t1:
+            segs[spans[si].get("cat", "default")] += spans[si]["excl"]
+            si += 1
+        rows.append({"step": label, "wall_us": round(t1 - t0, 1),
+                     "segments": {k: round(v, 1)
+                                  for k, v in sorted(segs.items())}})
+    return rows
+
+
+def autotune_report(events: List[dict]) -> Dict[str, Any]:
+    """The tuner's footprint in the trace: probe spans per candidate and
+    the lock decision (parsed back out of the ``autotune:lock`` event)."""
+    probes: Dict[str, List[float]] = defaultdict(list)
+    decision: Optional[dict] = None
+    for e in events:
+        if e.get("cat") != "autotune":
+            continue
+        name = e.get("name", "")
+        if e.get("ph", "X") == "X" and name.startswith("probe:"):
+            # warmup probe steps are stamped measured=False — the tuner
+            # excluded them from its scores, so exclude them here too or
+            # the offline numbers disagree with FitResult.tuning_report
+            if e.get("args", {}).get("measured", True):
+                probes[name[len("probe:"):]].append(
+                    float(e.get("dur", 0.0)))
+        elif name.startswith("autotune:lock"):
+            blob = name[len("autotune:lock"):].strip()
+            try:
+                decision = json.loads(blob)
+            except ValueError:
+                decision = {"raw": blob}
+    return {
+        "probes": {label: {"steps": len(durs),
+                           "mean_ms": round(sum(durs) / len(durs) / 1e3, 3)}
+                   for label, durs in sorted(probes.items())},
+        "decision": decision,
+    }
+
+
+def _fmt_table(rows: List[Dict[str, Any]], limit: int) -> List[str]:
+    cats = sorted({c for r in rows for c in r["segments"]})
+    if not cats:
+        return ["(no complete spans in trace)"]
+    shown = rows[-limit:] if limit else rows
+    head = f"{'step':>6} {'wall_ms':>9}" + "".join(
+        f" {c[:14]:>14}" for c in cats)
+    lines = [head, "-" * len(head)]
+    for r in shown:
+        wall = r["wall_us"]
+        cells = []
+        for c in cats:
+            us = r["segments"].get(c, 0.0)
+            share = us / wall if wall > 0 else 0.0
+            cells.append(f"{us / 1e3:>8.2f}({share:>4.0%})")
+        lines.append(f"{str(r['step']):>6} {wall / 1e3:>9.2f}" +
+                     "".join(f" {cell:>14}" for cell in cells))
+    if len(shown) < len(rows):
+        lines.append(f"... ({len(rows) - len(shown)} earlier steps "
+                     "elided; use --steps 0 for all)")
+    # aggregate share line (over ALL steps, not just the shown window)
+    wall_total = sum(r["wall_us"] for r in rows) or 1.0
+    agg = {c: sum(r["segments"].get(c, 0.0) for r in rows) / wall_total
+           for c in cats}
+    lines.append("share  " + "  ".join(
+        f"{c}={agg[c]:.1%}" for c in cats))
+    return lines
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Per-step segment-share table + autotuner decisions "
+                    "from a chrome-trace dump (no Perfetto needed).")
+    ap.add_argument("trace", help="chrome-trace JSON file")
+    ap.add_argument("--steps", type=int, default=32,
+                    help="show the last N steps (0 = all; default 32)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one machine-readable JSON object instead "
+                         "of tables")
+    args = ap.parse_args(argv)
+    try:
+        events = load_events(args.trace)
+    except (OSError, ValueError) as e:
+        print(f"trace_report: {e}", file=sys.stderr)
+        return 2
+    rows = step_table(events)
+    tuner = autotune_report(events)
+    if args.json:
+        print(json.dumps({"steps": rows, "autotune": tuner}, indent=1))
+        return 0
+    print(f"== {args.trace}: {len(rows)} step(s), "
+          f"{len(events)} events ==")
+    for line in _fmt_table(rows, args.steps):
+        print(line)
+    if tuner["probes"]:
+        print("\n== autotune probes ==")
+        for label, st in tuner["probes"].items():
+            print(f"  {label:<20} {st['steps']} step(s), "
+                  f"mean {st['mean_ms']:.3f} ms")
+    if tuner["decision"] is not None:
+        print("\n== autotune decision ==")
+        print(json.dumps(tuner["decision"], indent=1, sort_keys=True))
+    elif tuner["probes"]:
+        print("\n(no lock decision in trace — tuner still probing "
+              "or ring evicted it)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
