@@ -366,7 +366,8 @@ def run_serve():
     shape = (3, 32, 32)
     # batching knobs come from the declared MXTPU_SERVE_* env defaults —
     # one source of truth with a default-configured ModelServer
-    server = ModelServer(_serve_model(), bucket_shapes=[shape],
+    net = _serve_model()
+    server = ModelServer(net, bucket_shapes=[shape],
                          name="bench_cnn32")
     server.start()
     t0 = time.time()
@@ -401,7 +402,7 @@ def run_serve():
     top = points[-1]
     # the row ships BEFORE the drain: a wedged worker making stop() time
     # out must not throw away already-measured points
-    print(json.dumps({
+    payload = {
         "metric": "serve_p99_latency_ms",
         "value": top["p99_ms"],
         "unit": "ms",
@@ -410,11 +411,123 @@ def run_serve():
         "compiled_signatures": compiles,
         "max_batch": server.max_batch_size,
         "points": points,
-    }), flush=True)
+    }
+    print(json.dumps(payload), flush=True)
     try:
         server.stop(drain=True)
     except Exception as e:
         log(f"serve: drain after row emission failed: {e}")
+    if os.environ.get("MXTPU_BENCH_SERVE_COLD_START", "1") != "0":
+        # registry cold-start probe rides after the load sweep; the row
+        # above already shipped, so a probe failure costs nothing — a
+        # success re-emits the extended row (the incremental convention)
+        try:
+            extra = _serve_cold_start_probe(net, shape)
+            if extra:
+                payload.update(extra)
+                print(json.dumps(payload), flush=True)
+        except Exception as e:
+            log(f"serve cold-start probe abandoned: {e}")
+
+
+def run_serve_cold(registry_root, model):
+    """Child mode for the cold-start probe: fresh process, resolve the
+    model from the registry, warm (honoring MXTPU_COMPILE_CACHE), serve
+    ONE request. Emits 'SERVE_COLD {json}' with first_response_s plus the
+    telemetry compile counters — the zero-compile-cold-start evidence."""
+    t0 = time.perf_counter()
+    if not _init_backend():
+        return
+    import numpy as np
+    from mxnet_tpu.serving import FleetServer, ModelRegistry
+    from mxnet_tpu.telemetry import default_registry
+    default_registry()  # install XLA compile listeners BEFORE any compile
+    server = FleetServer(ModelRegistry(registry_root), model,
+                         workers=1).start()
+    shape = sorted(server._table.bucket_shapes)[0]
+    server.predict(np.zeros(shape, server.dtype), timeout=120)
+    first = time.perf_counter() - t0
+    j = default_registry().render_json()
+    print("SERVE_COLD " + json.dumps({
+        "first_response_s": round(first, 3),
+        "xla_compiles": j.get("mxtpu_xla_compile_total", 0),
+        "xla_compile_s": round(j.get("mxtpu_xla_compile_seconds_total",
+                                     0.0), 3),
+        "xla_cache_hits": j.get("mxtpu_xla_cache_hits_total", 0),
+    }), flush=True)
+    server.stop(drain=True)
+
+
+def _serve_cold_start_probe(net, shape):
+    """cold_start_s / warm_start_s for the serve row: publish the serve
+    model to a scratch registry, then cold-start it in two fresh
+    processes — first with an EMPTY persistent compile cache (pays the
+    full XLA bill and populates the cache), then against the populated
+    cache (the fleet's restart path: compiles become disk reads)."""
+    import shutil
+    import tempfile
+    tmp = tempfile.mkdtemp(prefix="bench_serve_registry_")
+    try:
+        return _serve_cold_start_children(net, shape, tmp)
+    finally:
+        # the scratch registry + populated compile cache are tens of MB;
+        # a long-lived bench host must not accumulate one per run
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _serve_cold_start_children(net, shape, tmp):
+    import subprocess
+    out = {}
+    from mxnet_tpu.serving import ModelRegistry
+    ModelRegistry(os.path.join(tmp, "registry")).publish(
+        "bench_cnn32", net=net,
+        signature={"bucket_shapes": [list(shape)], "dtype": "float32"})
+    cache_dir = os.path.join(tmp, "compile_cache")
+    # cold child = empty cache (full XLA bill; populates the cache on the
+    # way), warm child = same model against the populated cache — the
+    # replica-restart path. The delta IS the compile tax a registry-driven
+    # fleet stops paying.
+    for label, cache in (("cold_start", cache_dir),
+                         ("warm_start", cache_dir)):
+        budget = _budget_left() - 20
+        if budget < 30:
+            log(f"serve {label}: skipped ({_budget_left():.0f}s budget "
+                "left)")
+            break
+        env = dict(os.environ, MXTPU_COMPILE_CACHE=cache)
+        try:
+            res = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "serve-cold",
+                 os.path.join(tmp, "registry"), "bench_cnn32"],
+                capture_output=True, text=True, timeout=budget, env=env)
+        except subprocess.TimeoutExpired:
+            log(f"serve {label}: child timed out")
+            if label == "cold_start":
+                break  # a 'warm' run after a partial cold pass would
+            continue   # report cold compiles as the warm number
+        row = None
+        for line in (res.stdout or "").splitlines():
+            if line.startswith("SERVE_COLD "):
+                try:
+                    row = json.loads(line[len("SERVE_COLD "):])
+                except ValueError:
+                    pass
+        if row is None:
+            log(f"serve {label}: child rc={res.returncode}: "
+                f"{(res.stderr or '')[-300:]}")
+            if label == "cold_start":
+                break  # warm is only defined relative to a completed cold
+            continue
+        log(f"serve {label}: first response in {row['first_response_s']}s "
+            f"({row['xla_compiles']} compiles, {row['xla_compile_s']}s; "
+            f"{row['xla_cache_hits']} cache hits)")
+        if label == "cold_start":
+            out["cold_start_s"] = row["first_response_s"]
+            out["cold_start_compile_s"] = row["xla_compile_s"]
+        elif label == "warm_start":
+            out["warm_start_s"] = row["first_response_s"]
+            out["warm_start_compile_s"] = row["xla_compile_s"]
+    return out
 
 
 def _enable_compile_cache():
@@ -753,6 +866,12 @@ def main():
         _DEADLINE[0] = time.time() + float(
             os.environ.get("MXTPU_BENCH_DEADLINE_S", DEFAULT_DEADLINE_S))
         run_serve()
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "serve-cold":
+        # fresh-process cold-start child of the serve row's probe
+        _DEADLINE[0] = time.time() + float(
+            os.environ.get("MXTPU_BENCH_DEADLINE_S", DEFAULT_DEADLINE_S))
+        run_serve_cold(sys.argv[2], sys.argv[3])
         return
     if len(sys.argv) > 1 and sys.argv[1] in ("--inference-only",
                                              "--train-only"):

@@ -165,6 +165,24 @@ env.declare("MXTPU_SERVE_QUEUE_DEPTH", int, 256,
             "serving.ModelServer: bounded admission-queue depth; a full "
             "queue sheds load with a typed QueueFull rejection "
             "(backpressure) instead of buffering without bound.")
+env.declare("MXTPU_SERVE_REGISTRY", str, "",
+            "Root directory of the versioned model registry "
+            "(serving.ModelRegistry): registry/<model>/<version>/ holding "
+            "exported artifacts + SHA-256 manifests + an atomic CURRENT "
+            "pointer. Empty = <cwd>/registry.")
+env.declare("MXTPU_COMPILE_CACHE", str, "",
+            "Persistent on-disk XLA compilation cache directory. "
+            "serving.enable_compile_cache honors it on every backend "
+            "(namespaced by jaxlib/backend fingerprint) so a replica "
+            "restart recompiles nothing; util.enable_compile_cache "
+            "(bench/tools) skips CPU unless this is set explicitly. "
+            "'0'/'off' disables.")
+env.declare("MXTPU_SERVE_REPLAY", str, "",
+            "Signature-replay file: when set, ModelServer appends one "
+            "JSON line per DISTINCT dispatched (item shape, dtype, "
+            "padded batch) signature; new replicas prewarm from it "
+            "(serving.warm_from_replay / FleetServer deploy). Empty = "
+            "recording off.")
 env.declare("MXNET_HOME", str, "",
             "Root directory for datasets and model artifacts "
             "(default ~/.mxnet; ref: docs/faq/env_var.md MXNET_HOME).")

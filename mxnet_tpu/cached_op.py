@@ -21,6 +21,7 @@ compiled program stays cached.
 """
 from __future__ import annotations
 
+import os
 import threading
 from collections import OrderedDict, namedtuple
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -36,16 +37,42 @@ CacheInfo = namedtuple("CacheInfo",
 # Every live SignatureLRU (CachedOp signature caches, grouped-optimizer
 # program caches, serving signature caches) reports into the shared
 # telemetry registry as polled gauges — zero hot-path cost: the counters
-# are summed at export time, not on every lookup.
+# are summed at export time, not on every lookup. Counters of DEAD caches
+# are folded into a retired accumulator by a weakref.finalize, so the
+# exported totals are MONOTONE: a cyclic-GC pass collecting an old
+# hybridized net between two reads must never make hits/misses go down
+# (the exact mechanism behind the test_env_flags+test_telemetry
+# pair-order flake this replaces — the gauge used to sum live caches
+# only, so a cache dying mid-test subtracted its whole history).
 _all_caches: "weakref.WeakSet" = None  # type: ignore[assignment]
-_track_lock = threading.Lock()
+_retired_counts = {"hits": 0, "misses": 0, "evictions": 0}
+# RLock, not Lock: the retire callback runs from weakref.finalize, which
+# cyclic GC may fire synchronously on THIS thread while it already holds
+# the lock (list() below allocates, allocation can trigger collection of
+# a dead cycle holding a SignatureLRU) — a plain Lock would self-deadlock
+_track_lock = threading.RLock()
+
+
+def _retire_cache_counts(stats: dict) -> None:
+    with _track_lock:
+        for field in _retired_counts:
+            _retired_counts[field] += stats[field]
+
+
+def _tracked_cache_total(field: str) -> int:
+    """Monotone process-wide total for hits/misses/evictions; live-only
+    occupancy for currsize (a dead cache holds no entries)."""
+    with _track_lock:
+        live = list(_all_caches) if _all_caches is not None else []
+        base = _retired_counts.get(field, 0)
+    return base + sum(getattr(c.cache_info(), field) for c in live)
 
 
 def _track_cache(cache: "SignatureLRU") -> None:
     global _all_caches
+    import weakref
     with _track_lock:
         if _all_caches is None:
-            import weakref
             _all_caches = weakref.WeakSet()
             try:
                 from .telemetry import default_registry
@@ -53,14 +80,15 @@ def _track_cache(cache: "SignatureLRU") -> None:
                 for field in ("hits", "misses", "evictions", "currsize"):
                     reg.callback_gauge(
                         f"mxtpu_cachedop_cache_{field}",
-                        (lambda f=field: sum(
-                            getattr(c.cache_info(), f)
-                            for c in list(_all_caches))),
-                        f"Sum of signature-cache {field} over all live "
-                        "compiled-program caches.")
+                        (lambda f=field: _tracked_cache_total(f)),
+                        f"Signature-cache {field} over all compiled-"
+                        "program caches (monotone: retired caches keep "
+                        "their counts, except currsize which is live "
+                        "occupancy).")
             except Exception:
                 pass
         _all_caches.add(cache)
+    weakref.finalize(cache, _retire_cache_counts, cache._stats)
 
 
 class SignatureLRU:
@@ -74,9 +102,9 @@ class SignatureLRU:
         self._explicit_maxsize = maxsize
         self._cache: "OrderedDict[Any, Any]" = OrderedDict()
         self._lock = threading.Lock()
-        self._hits = 0
-        self._misses = 0
-        self._evictions = 0
+        # counters live in a plain dict so the telemetry finalizer can
+        # fold them into the retired accumulator after this cache dies
+        self._stats = {"hits": 0, "misses": 0, "evictions": 0}
         _track_cache(self)
 
     def _bound(self) -> int:
@@ -90,12 +118,12 @@ class SignatureLRU:
         with self._lock:
             val = self._cache.get(key)
             if val is not None:
-                self._hits += 1
+                self._stats["hits"] += 1
                 self._cache.move_to_end(key)
                 return val
         val = build()
         with self._lock:
-            self._misses += 1
+            self._stats["misses"] += 1
             self._cache[key] = val
             self._evict_locked()
         return val
@@ -108,10 +136,10 @@ class SignatureLRU:
         with self._lock:
             val = self._cache.get(key)
             if val is not None:
-                self._hits += 1
+                self._stats["hits"] += 1
                 self._cache.move_to_end(key)
                 return val
-            self._misses += 1
+            self._stats["misses"] += 1
             val = factory()
             self._cache[key] = val
             self._evict_locked()
@@ -122,12 +150,29 @@ class SignatureLRU:
         if bound > 0:
             while len(self._cache) > bound:
                 self._cache.popitem(last=False)
-                self._evictions += 1
+                self._stats["evictions"] += 1
 
     def cache_info(self) -> CacheInfo:
         bound = self._bound()
-        return CacheInfo(self._hits, self._misses, self._evictions,
-                         len(self._cache), bound if bound > 0 else None)
+        return CacheInfo(self._stats["hits"], self._stats["misses"],
+                         self._stats["evictions"], len(self._cache),
+                         bound if bound > 0 else None)
+
+    def insert(self, key, val) -> bool:
+        """Install a prebuilt value (AOT-loaded executables) without
+        counting a hit or a miss; returns False when the key was already
+        resident (the resident entry wins — it may already be warm)."""
+        with self._lock:
+            if key in self._cache:
+                return False
+            self._cache[key] = val
+            self._evict_locked()
+            return True
+
+    def snapshot_items(self):
+        """(key, value) pairs at this instant (export iteration)."""
+        with self._lock:
+            return list(self._cache.items())
 
     def __len__(self) -> int:
         # truthiness == occupancy, like the plain dict this replaced
@@ -137,7 +182,12 @@ class SignatureLRU:
     def clear(self) -> None:
         with self._lock:
             self._cache.clear()
-            self._hits = self._misses = self._evictions = 0
+            # retire, don't erase: the telemetry totals promise
+            # monotonicity, so a clear() folds this history into the
+            # retired accumulator exactly like cache death would
+            _retire_cache_counts(self._stats)
+            for k in self._stats:
+                self._stats[k] = 0
 
 
 def _jax():
@@ -302,6 +352,129 @@ class CachedOp:
         """Hit/miss/eviction counters + occupancy of the signature cache
         (shape of :func:`functools.lru_cache`'s ``cache_info``)."""
         return self._cache.cache_info()
+
+    # -- AOT executable slot -------------------------------------------
+    # A new replica of an already-published model should reach first byte
+    # with ZERO compiles and ZERO traces: aot_export serializes every warm
+    # signature's compiled XLA executable (jax.experimental.
+    # serialize_executable) next to its cache key; aot_load deserializes
+    # them into pre-warmed cache entries on a fingerprint-matched runtime.
+    AOT_FORMAT = 1
+
+    def aot_export(self, path: str) -> int:
+        """Serialize the warm, inference-facing signature entries to
+        ``path``. Returns the number of executables exported. Entries are
+        re-lowered from their recorded (shapes, dtypes) signature and
+        compiled — with the persistent compile cache enabled this is a
+        disk read, not a recompile. Backward programs (vjp) are not
+        exported: AOT bundles are a serving artifact."""
+        import pickle
+
+        import jax
+        from .ops.registry import _trace_time_flags
+        from .serving.aot import runtime_fingerprint
+        try:
+            from jax.experimental.serialize_executable import serialize
+        except ImportError as e:
+            raise MXNetError(f"AOT export unavailable on this jax: {e}")
+        import numpy as np
+        records = []
+
+        def sds(sig):
+            return tuple(jax.ShapeDtypeStruct(tuple(shape), np.dtype(dt))
+                         for shape, dt in sig)
+
+        probe_key = jax.random.PRNGKey(0)
+        key_aval = jax.ShapeDtypeStruct(probe_key.shape, probe_key.dtype)
+        for key_sig, entry in self._cache.snapshot_items():
+            if not entry.warm or not hasattr(entry.jitted, "lower"):
+                continue  # cold, or itself an AOT-loaded executable
+            in_sig, param_sig, in_treedef, training, flags = key_sig
+            if flags != _trace_time_flags():
+                continue  # stale entry from a different flag regime
+            # re-lowering retraces the pure fn, which temporarily swaps
+            # Parameter storage to tracers — same exclusivity as a cold
+            # trace (the treedef is restored per call by __call__; set it
+            # under the lock so the retrace can't see a concurrent
+            # caller's)
+            self._trace_rw.acquire_write()
+            try:
+                self._in_treedef = in_treedef
+                lowered = entry.jitted.lower(sds(param_sig), key_aval,
+                                             *sds(in_sig))
+            finally:
+                self._trace_rw.release_write()
+            payload, in_tree, out_tree = serialize(lowered.compile())
+            records.append({
+                "key": pickle.dumps(key_sig),
+                "payload": payload,
+                "in_tree": pickle.dumps(in_tree),
+                "out_tree": pickle.dumps(out_tree),
+                "mutated_idx": entry.mutated_idx,
+                "out_treedef": pickle.dumps(entry.out_treedef),
+                "n_outputs": entry.n_outputs,
+            })
+        bundle = {"format": self.AOT_FORMAT,
+                  "fingerprint": runtime_fingerprint(),
+                  "entries": records}
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            pickle.dump(bundle, f)
+        os.replace(tmp, path)
+        return len(records)
+
+    def aot_load(self, path: str) -> int:
+        """Install AOT-exported executables as warm cache entries; returns
+        how many were loaded. Zero (with a log line) when the bundle was
+        built on a different jaxlib/backend or fails to deserialize —
+        callers fall back to warmup through the persistent compile cache,
+        never crash the replica."""
+        import pickle
+
+        from .log import get_logger
+        from .serving.aot import runtime_fingerprint
+        log = get_logger("mxnet_tpu.cached_op")
+        try:
+            from jax.experimental.serialize_executable import \
+                deserialize_and_load
+        except ImportError:
+            log.warning("aot_load: serialize_executable unavailable")
+            return 0
+        try:
+            with open(path, "rb") as f:
+                bundle = pickle.load(f)
+        except Exception as e:
+            log.warning("aot_load: unreadable bundle %s: %s", path, e)
+            return 0
+        if bundle.get("format") != self.AOT_FORMAT:
+            log.warning("aot_load: bundle format %s != %s, skipping",
+                        bundle.get("format"), self.AOT_FORMAT)
+            return 0
+        fp = runtime_fingerprint()
+        if bundle.get("fingerprint") != fp:
+            log.warning("aot_load: fingerprint mismatch (bundle %s, "
+                        "runtime %s) — executables not portable, falling "
+                        "back to compile-cache warmup",
+                        bundle.get("fingerprint"), fp)
+            return 0
+        loaded = 0
+        for rec in bundle.get("entries", ()):
+            try:
+                key_sig = pickle.loads(rec["key"])
+                exe = deserialize_and_load(rec["payload"],
+                                           pickle.loads(rec["in_tree"]),
+                                           pickle.loads(rec["out_tree"]))
+                entry = _CacheEntry()
+                entry.jitted = exe
+                entry.mutated_idx = tuple(rec["mutated_idx"])
+                entry.out_treedef = pickle.loads(rec["out_treedef"])
+                entry.n_outputs = int(rec["n_outputs"])
+                entry.warm = True
+                if self._cache.insert(key_sig, entry):
+                    loaded += 1
+            except Exception as e:
+                log.warning("aot_load: skipping one entry: %s", e)
+        return loaded
 
     # -----------------------------------------------------------------
     def _params(self) -> List:

@@ -38,6 +38,11 @@ Grammar: comma-separated events, each ``kind[:prob][@target]``:
   simulating compute stragglers/compile stalls so deadline shedding and
   queue backpressure are testable (hook: ``serving.ModelServer`` worker,
   before the batch is padded and dispatched).
+- ``registry_corrupt@V`` — flip bytes inside the params artifact of model-
+  registry version ``V`` (``latest`` = the next published version) *after*
+  its DONE marker and manifest land: a forged-complete corrupt model,
+  exactly what ``ModelRegistry.resolve``'s verify + quarantine + fallback
+  must catch (hook: ``serving.registry.ModelRegistry.publish``).
 
 Step-scheduled events fire on the plan's step clock, advanced exactly once
 per training step by the loop owner (``FitLoop`` and ``Trainer.step`` both
@@ -82,7 +87,7 @@ class ChaosKilled(MXNetError):
 
 
 _KINDS = ("nan_grad", "inf_grad", "kill", "preempt", "ckpt_corrupt",
-          "kv_flake", "kv_slow", "serve_slow")
+          "kv_flake", "kv_slow", "serve_slow", "registry_corrupt")
 
 
 class ChaosPlan:
@@ -103,6 +108,8 @@ class ChaosPlan:
         self._step: Optional[int] = None
         self._at: Dict[str, Set[int]] = {k: set() for k in _KINDS}
         self._ckpt_latest = False
+        self._registry_targets: Set[str] = set()  # version names
+        self._registry_latest = False
         self.kv_flake_p = 0.0
         self.kv_slow_p = 0.0
         self.kv_slow_ms = 0.0
@@ -161,6 +168,16 @@ class ChaosPlan:
             return
         if prob is not None:
             raise MXNetError(f"chaos: {kind} takes no probability")
+        if kind == "registry_corrupt":
+            if target is None or not target.strip():
+                raise MXNetError("chaos: registry_corrupt needs a version "
+                                 "target, e.g. registry_corrupt@v2 or "
+                                 "registry_corrupt@latest")
+            if target.strip() == "latest":
+                self._registry_latest = True
+            else:
+                self._registry_targets.add(target.strip())
+            return
         if target is None:
             raise MXNetError(f"chaos: {kind} needs a step target, "
                              f"e.g. {kind}@12")
@@ -284,6 +301,23 @@ class ChaosPlan:
             return
         self.injected["ckpt_corrupt"] += 1
         corrupt_file(os.path.join(path, "params"))
+
+    def on_publish_complete(self, model: str, version: str,
+                            path: str) -> None:
+        """registry_corrupt — called by ``ModelRegistry.publish`` after
+        the version's DONE marker lands; corrupts the params artifact
+        while leaving DONE and both manifests intact (a forged-complete
+        model version)."""
+        if self._registry_latest:
+            self._registry_latest = False
+        elif version in self._registry_targets:
+            self._registry_targets.discard(version)
+        else:
+            return
+        self.injected["registry_corrupt"] += 1
+        _count_injection("registry_corrupt")
+        from ..serving.registry import ARTIFACT_PREFIX
+        corrupt_file(os.path.join(path, f"{ARTIFACT_PREFIX}-0000.params"))
 
 
 def corrupt_file(path: str, nbytes: int = 64) -> None:

@@ -29,16 +29,94 @@ from .log import get_logger
 from . import ndarray as nd
 
 __all__ = ["Heartbeat", "dead_nodes", "is_recovery", "CheckpointManager",
-           "CheckpointCorruptError"]
+           "CheckpointCorruptError", "write_manifest", "verify_manifest",
+           "ManifestError"]
 
 _LOG = get_logger("mxnet_tpu.fault")
 
 
-class CheckpointCorruptError(MXNetError):
+class ManifestError(MXNetError):
+    """A directory of artifacts failed content verification against its
+    SHA-256 manifest (hash mismatch, truncated file, missing file,
+    unreadable manifest). Base class shared by checkpoint restore and the
+    serving model registry — both quarantine on it."""
+
+
+class CheckpointCorruptError(ManifestError):
     """A checkpoint failed content verification (manifest hash mismatch,
     truncated/unreadable payload, missing file). ``restore_latest``
     quarantines such checkpoints and falls back to the newest one that
     verifies; a direct ``restore(step)`` surfaces it to the caller."""
+
+
+def write_manifest(dir_path: str, exclude: Tuple[str, ...] = (),
+                   name: str = "manifest.json") -> Dict[str, dict]:
+    """Write a per-file SHA-256 manifest over every regular file in
+    ``dir_path`` (non-recursive, ``exclude`` and the manifest itself
+    skipped). A completion marker alone proves the writer got to the end,
+    not that the bytes on disk are the bytes it wrote (torn write, forged
+    marker, bit rot) — the manifest is the content proof. Shared by
+    :class:`CheckpointManager` and ``serving.ModelRegistry``."""
+    manifest: Dict[str, dict] = {}
+    skip = set(exclude) | {name}
+    for fname in sorted(os.listdir(dir_path)):
+        fpath = os.path.join(dir_path, fname)
+        if fname in skip or not os.path.isfile(fpath):
+            continue
+        if ".tmp" in fname or fname.endswith(".stage"):
+            continue  # in-flight staging artifacts are not content
+        manifest[fname] = {"sha256": _sha256_file(fpath),
+                           "bytes": os.path.getsize(fpath)}
+    # tmp+rename: registry sidecar attachment rewrites the manifest of a
+    # LIVE published version — a concurrent resolve() catching an
+    # in-place truncation would quarantine a healthy version
+    path = os.path.join(dir_path, name)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+    os.replace(tmp, path)
+    return manifest
+
+
+def verify_manifest(dir_path: str, label: str = "",
+                    name: str = "manifest.json",
+                    error_cls: type = ManifestError,
+                    required: bool = False) -> Optional[Dict[str, dict]]:
+    """Verify every file listed in ``dir_path``'s manifest by size and
+    SHA-256; raises ``error_cls`` on any mismatch/missing file. Returns the
+    parsed manifest, or None when no manifest exists and ``required`` is
+    False (legacy layouts carry no content proof to check)."""
+    label = label or dir_path
+    man_path = os.path.join(dir_path, name)
+    if not os.path.exists(man_path):
+        if required:
+            raise error_cls(f"{label}: missing {name}")
+        return None
+    try:
+        with open(man_path) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        raise error_cls(f"{label}: unreadable manifest: {e}") from e
+    for fname, rec in manifest.items():
+        fpath = os.path.join(dir_path, fname)
+        if not os.path.exists(fpath):
+            raise error_cls(f"{label}: file {fname!r} listed in manifest "
+                            "is missing")
+        try:
+            ok = os.path.getsize(fpath) == rec["bytes"] and \
+                _sha256_file(fpath) == rec["sha256"]
+        except OSError as e:
+            # a concurrent quarantine (os.replace of the whole dir by
+            # another replica) can yank the file between the exists
+            # check and the hash — that is corruption-shaped for THIS
+            # reader, not a crash
+            raise error_cls(f"{label}: file {fname!r} unreadable during "
+                            f"verification: {e}") from e
+        if not ok:
+            raise error_cls(f"{label}: file {fname!r} fails content "
+                            "verification (size/sha256 mismatch with "
+                            "manifest)")
+    return manifest
 
 
 def _hb_path(dir_path: str, rank: int) -> str:
@@ -273,16 +351,9 @@ class CheckpointManager:
             meta.update(extra)
         with open(os.path.join(tmp, "meta.json"), "w") as f:
             json.dump(meta, f)
-        # per-file SHA-256 manifest, verified on restore: a DONE marker
-        # alone proves the writer got to the end, not that the bytes on
-        # disk are the bytes it wrote (torn write, forged DONE, bit rot)
-        manifest = {}
-        for name in sorted(os.listdir(tmp)):
-            fpath = os.path.join(tmp, name)
-            manifest[name] = {"sha256": _sha256_file(fpath),
-                              "bytes": os.path.getsize(fpath)}
-        with open(os.path.join(tmp, "manifest.json"), "w") as f:
-            json.dump(manifest, f)
+        # per-file SHA-256 manifest, verified on restore (shared helper
+        # with serving.ModelRegistry — one integrity discipline everywhere)
+        write_manifest(tmp)
         with open(os.path.join(tmp, "DONE"), "w") as f:
             f.write("ok")
         if os.path.isdir(path):
@@ -316,26 +387,9 @@ class CheckpointManager:
         if not os.path.exists(os.path.join(path, "DONE")):
             raise CheckpointCorruptError(
                 f"checkpoint {step} is missing or incomplete (no DONE)")
-        man_path = os.path.join(path, "manifest.json")
-        if not os.path.exists(man_path):
-            return  # legacy checkpoint: nothing to verify against
-        try:
-            with open(man_path) as f:
-                manifest = json.load(f)
-        except (OSError, ValueError) as e:
-            raise CheckpointCorruptError(
-                f"checkpoint {step}: unreadable manifest: {e}") from e
-        for name, rec in manifest.items():
-            fpath = os.path.join(path, name)
-            if not os.path.exists(fpath):
-                raise CheckpointCorruptError(
-                    f"checkpoint {step}: file {name!r} listed in manifest "
-                    "is missing")
-            if os.path.getsize(fpath) != rec["bytes"] or \
-                    _sha256_file(fpath) != rec["sha256"]:
-                raise CheckpointCorruptError(
-                    f"checkpoint {step}: file {name!r} fails content "
-                    "verification (size/sha256 mismatch with manifest)")
+        # legacy (manifest-less) checkpoints are accepted: required=False
+        verify_manifest(path, label=f"checkpoint {step}",
+                        error_cls=CheckpointCorruptError)
 
     def _quarantine(self, step: int, reason: str = "") -> str:
         """Rename a corrupt/incomplete checkpoint to ``ckpt-<step>.bad``

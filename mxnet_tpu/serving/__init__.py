@@ -17,6 +17,12 @@ Pieces (one module each):
   deadlines, SIGTERM drain with the resumable exit code.
 - :mod:`.metrics` — ``ServerMetrics``: latency/batch/queue histograms,
   Prometheus text + JSON export, profiler spans per dispatch.
+- :mod:`.registry` — ``ModelRegistry``: versioned on-disk artifact store
+  (SHA-256 manifests, atomic CURRENT pointer, quarantine + fallback).
+- :mod:`.fleet` — ``FleetServer``/``Fleet``: registry-driven replicas
+  with atomic hot-swap deploys and rolling fleet-wide rollouts.
+- :mod:`.aot` — zero-compile cold start: persistent compile cache, AOT
+  executable bundles, signature-replay warmers.
 
 Quick start::
 
@@ -25,14 +31,35 @@ Quick start::
     fut = server.submit(image)          # -> PredictionFuture
     probs = fut.result(timeout=1.0)
     print(server.metrics_text())        # Prometheus exposition
+
+Fleet quick start (registry-driven, hot-swappable)::
+
+    reg = serving.ModelRegistry()           # MXTPU_SERVE_REGISTRY
+    v1 = reg.publish("resnet", net=net,
+                     signature={"bucket_shapes": [[3, 224, 224]]})
+    server = serving.FleetServer(reg, "resnet").start()
+    ...
+    v2 = reg.publish("resnet", net=new_net, signature=...)
+    server.publish_aot(version=v2)          # vN+1 deploys compile-free
+    server.deploy(v2)                       # warm in bg, atomic flip
+    server.rollback()                       # one-call escape hatch
 """
+from .aot import (ReplayLog, enable_compile_cache,  # noqa: F401
+                  runtime_fingerprint, warm_from_replay)
 from .batcher import (Batch, BucketTable, DeadlineExceeded,  # noqa: F401
                       NoBucket, PredictionFuture, QueueFull, Request,
                       ServerClosed, ServingError, batch_buckets, pad_rows)
 from .cache import SignatureCache  # noqa: F401
+from .fleet import DeployReport, Fleet, FleetServer  # noqa: F401
 from .metrics import ServerMetrics  # noqa: F401
-from .server import ModelServer  # noqa: F401
+from .registry import (ModelRegistry, RegistryCorruptError,  # noqa: F401
+                       ResolvedVersion)
+from .server import ActiveModel, ModelServer  # noqa: F401
 
 __all__ = ["ModelServer", "SignatureCache", "ServerMetrics", "ServingError",
            "QueueFull", "DeadlineExceeded", "NoBucket", "ServerClosed",
-           "PredictionFuture", "BucketTable", "batch_buckets", "pad_rows"]
+           "PredictionFuture", "BucketTable", "batch_buckets", "pad_rows",
+           "ModelRegistry", "ResolvedVersion", "RegistryCorruptError",
+           "FleetServer", "Fleet", "DeployReport", "ActiveModel",
+           "ReplayLog", "enable_compile_cache", "runtime_fingerprint",
+           "warm_from_replay"]

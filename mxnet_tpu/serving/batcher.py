@@ -66,14 +66,22 @@ class ServerClosed(ServingError):
 
 
 class PredictionFuture:
-    """Write-once result slot handed back by ``ModelServer.submit``."""
+    """Write-once result slot handed back by ``ModelServer.submit``.
 
-    __slots__ = ("_event", "_result", "_error")
+    After the batch is dispatched, ``version`` carries the tag of the
+    model version that served it (None for registry-less servers) and
+    ``dispatch_seq`` the server-wide dispatch sequence number — the pair
+    is how hot-swap tests prove version flips are atomic (tags are
+    monotone in ``dispatch_seq`` order)."""
+
+    __slots__ = ("_event", "_result", "_error", "version", "dispatch_seq")
 
     def __init__(self):
         self._event = threading.Event()
         self._result = None
         self._error: Optional[BaseException] = None
+        self.version: Optional[str] = None
+        self.dispatch_seq: Optional[int] = None
 
     def set_result(self, value) -> None:
         self._result = value

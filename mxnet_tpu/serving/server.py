@@ -46,11 +46,49 @@ from .batcher import (Batch, BucketTable, DeadlineExceeded, NoBucket,
 from .cache import SignatureCache
 from .metrics import ServerMetrics
 
-__all__ = ["ModelServer"]
+__all__ = ["ModelServer", "ActiveModel"]
 
 _LOG = get_logger("mxnet_tpu.serving")
 
 _STOP = object()  # worker sentinel
+
+
+class ActiveModel:
+    """The unit of atomic hot-swap: ONE reference the workers read.
+
+    Everything that must change together when a new version takes over —
+    the warm :class:`SignatureCache` and the version tag stamped on every
+    response — lives behind a single attribute (``ModelServer._active``),
+    so the flip is one Python reference assignment: any batch observes
+    either the old model or the new one, never a mix. ``inflight`` counts
+    batches currently executing against THIS model so a deployer can
+    drain the old version after the flip.
+    """
+
+    __slots__ = ("cache", "version", "inflight", "_lock", "_idle")
+
+    def __init__(self, cache: SignatureCache, version: Optional[str] = None):
+        self.cache = cache
+        self.version = version
+        self.inflight = 0
+        self._lock = threading.Lock()
+        self._idle = threading.Event()
+        self._idle.set()
+
+    def enter(self) -> None:
+        with self._lock:
+            self.inflight += 1
+            self._idle.clear()
+
+    def exit(self) -> None:
+        with self._lock:
+            self.inflight -= 1
+            if self.inflight <= 0:
+                self._idle.set()
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Wait until no batch is executing against this model."""
+        return self._idle.wait(timeout)
 
 
 class ModelServer:
@@ -100,9 +138,19 @@ class ModelServer:
                                   bucket_shapes)
         self.queue_depth = int(queue_depth)
         self._default_deadline_ms = default_deadline_ms
-        self.cache = SignatureCache(model, cache_size=cache_size)
+        self._cache_size = cache_size
+        self._active = ActiveModel(
+            SignatureCache(model, cache_size=cache_size))
         self.metrics = ServerMetrics(name)
-        self.metrics.cache_info_fn = self.cache.cache_info
+        self.metrics.cache_info_fn = lambda: self._active.cache.cache_info()
+        # replay recorder (serving/aot.py): every dispatched signature is
+        # logged once so new replicas can prewarm from real traffic
+        self._replay = None
+        replay_path = env.get("MXTPU_SERVE_REPLAY")
+        if replay_path:
+            from .aot import ReplayLog
+            self._replay = ReplayLog(replay_path)
+        self._dispatch_seq = 0  # allocated under _cond with the version
         self._cond = threading.Condition()
         self._admit: "list[Request]" = []
         self._queued = 0            # admitted, not yet dispatched/rejected
@@ -116,6 +164,17 @@ class ModelServer:
         self._sig_event = threading.Event()
         self._signum: Optional[int] = None
         self._old_handlers: dict = {}
+
+    @property
+    def cache(self) -> SignatureCache:
+        """The ACTIVE model's signature cache (changes on hot-swap)."""
+        return self._active.cache
+
+    @property
+    def active_version(self) -> Optional[str]:
+        """Version tag of the model currently serving (None when the
+        server was built from a bare model instead of a registry)."""
+        return self._active.version
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> "ModelServer":
@@ -275,7 +334,7 @@ class ModelServer:
         """Swap in a fresh metrics plane (warm executables untouched) —
         lets an offered-load sweep isolate per-load-point statistics."""
         self.metrics = ServerMetrics(self.name)
-        self.metrics.cache_info_fn = self.cache.cache_info
+        self.metrics.cache_info_fn = lambda: self._active.cache.cache_info()
         return self.metrics
 
     def metrics_text(self) -> str:
@@ -396,16 +455,34 @@ class ModelServer:
             with self._cond:
                 self._queued -= len(live)
                 metrics.queue_depth.set(self._queued)
+                # capture the active model AND allocate the dispatch
+                # sequence number under the same lock a hot-swap flips
+                # under: the (seq, version) stream is linearizable, so a
+                # deploy's version tags are provably monotone in seq
+                # order even with concurrent workers. enter() must happen
+                # under the SAME lock: a deployer that flips and then
+                # drains the old model must see this batch as in-flight,
+                # not catch the gap between capture and enter
+                active = self._active
+                seq = self._dispatch_seq
+                self._dispatch_seq += 1
+                active.enter()
             metrics.inflight_batches.inc()
-            padded_to = self._table.pad_to(len(live))
             try:
+                padded_to = self._table.pad_to(len(live))
+                for r in live:
+                    r.future.version = active.version
+                    r.future.dispatch_seq = seq
+                if self._replay is not None:
+                    shape, dtype = batch.key
+                    self._replay.record(shape, dtype, padded_to)
                 plan = _chaos.active()
                 if plan is not None:
                     delay = plan.serve_delay_s()
                     if delay:
                         time.sleep(delay)
                 x = pad_rows([r.payload for r in live], padded_to)
-                out = self.cache(_nd.array(x))
+                out = active.cache(_nd.array(x))
                 outs = tuple(out) if isinstance(out, (list, tuple)) \
                     else (out,)
                 # asnumpy blocks until the device result is real — compute
@@ -424,7 +501,8 @@ class ModelServer:
                     f"serve_batch[{self.name}]", "serving", t_dispatch,
                     t_done, args={"bucket": str(batch.key),
                                   "rows": len(live),
-                                  "padded_to": padded_to})
+                                  "padded_to": padded_to,
+                                  "version": active.version or ""})
             except Exception as e:  # model error: fail the batch, not the
                 _LOG.exception("serving batch failed")        # server
                 for r in live:
@@ -433,3 +511,4 @@ class ModelServer:
                         r.future.set_exception(e)
             finally:
                 metrics.inflight_batches.dec()
+                active.exit()

@@ -318,30 +318,62 @@ def _install_runtime_hooks() -> None:
             return
         _runtime_hooks_installed = True
     compile_count = _default.counter(
-        "mxtpu_xla_compile_total", "XLA compilation events observed.")
+        "mxtpu_xla_compile_total", "XLA compilation events observed "
+        "(persistent-cache hits excluded — they are retrievals).")
     compile_secs = _default.counter(
         "mxtpu_xla_compile_seconds_total",
-        "Wall-clock seconds spent in XLA compilation.")
+        "Wall-clock seconds spent in XLA compilation (persistent-cache "
+        "hits excluded).")
+    cache_hits = _default.counter(
+        "mxtpu_xla_cache_hits_total",
+        "Compiles satisfied by the persistent compilation cache.")
+    cache_secs = _default.counter(
+        "mxtpu_xla_cache_retrieval_seconds_total",
+        "Wall-clock seconds spent retrieving executables from the "
+        "persistent compilation cache.")
     try:
         from jax import monitoring as _mon
 
+        # jax fires '/jax/compilation_cache/cache_hits' (or cache_misses)
+        # immediately before the corresponding backend_compile_duration
+        # event ON THE SAME THREAD; the thread-local carries that verdict
+        # across so a persistent-cache HIT is counted as a retrieval, not
+        # a compile — the zero-compile cold-start contract is measured on
+        # mxtpu_xla_compile_seconds_total staying ~0 (serving/aot.py)
+        _pending = threading.local()
+
+        def _on_event(event: str, **kw) -> None:
+            if event.endswith("compilation_cache/cache_hits"):
+                _pending.verdict = "hit"
+            elif event.endswith("compilation_cache/cache_misses"):
+                _pending.verdict = "miss"
+
         def _on_duration(event: str, duration: float, **kw) -> None:
             # '/jax/core/compile/backend_compile_duration' (+ variants)
-            # fire once per backend compile
+            # fire once per backend compile OR cache retrieval
             if "compile" not in event:
                 return
             if event.endswith("backend_compile_duration"):
-                compile_count.inc()
-                compile_secs.inc(max(float(duration), 0.0))
+                verdict = getattr(_pending, "verdict", None)
+                _pending.verdict = None
+                if verdict == "hit":
+                    cache_hits.inc()
+                    cache_secs.inc(max(float(duration), 0.0))
+                    span_name, span_cat = "xla_cache_hit", "compile"
+                else:
+                    compile_count.inc()
+                    compile_secs.inc(max(float(duration), 0.0))
+                    span_name, span_cat = "xla_compile", "compile"
                 from .tracer import tracer as _tr
                 if _tr.enabled:
                     import time as _t
                     now = _t.perf_counter()
                     # clamp to tracer birth: a compile that started
                     # before the tracer existed must not emit ts < 0
-                    _tr.record("xla_compile", "compile",
+                    _tr.record(span_name, span_cat,
                                max(now - duration, _tr._t0), now)
 
+        _mon.register_event_listener(_on_event)
         _mon.register_event_duration_secs_listener(_on_duration)
     except Exception:
         pass

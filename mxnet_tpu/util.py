@@ -119,10 +119,15 @@ def enable_compile_cache(cache_dir=None):
             # no explicit platform request to preserve — asking the
             # backend directly is safe and covers implicit-CPU hosts
             plat = jax.default_backend()
-        if plat.split(",")[0].strip() == "cpu":
+        explicit = cache_dir is not None or \
+            bool(os.environ.get("MXTPU_COMPILE_CACHE"))
+        if plat.split(",")[0].strip() == "cpu" and not explicit:
             # CPU compiles are fast, and reloading CPU AOT entries across
-            # differing host-feature detection risks SIGILL — cache only
-            # the slow tunnel/TPU compiles
+            # differing host-feature detection risks SIGILL — by default
+            # cache only the slow tunnel/TPU compiles. An EXPLICIT
+            # cache_dir / MXTPU_COMPILE_CACHE is honored anyway: the
+            # serving cold-start contract (zero compile seconds on
+            # replica restart) must be testable on CPU CI.
             return "skipped-cpu"  # truthy: intentional skip, not a failure
         if cache_dir is None:
             cache_dir = os.environ.get(
@@ -134,9 +139,13 @@ def enable_compile_cache(cache_dir=None):
             # remote relay host can SIGILL this machine; callers retry
             # crashed compiles with the cache off
             return "disabled"
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-        return True
+        # ONE wiring implementation (serving/aot.py): fingerprint-
+        # namespaced directory (a jaxlib upgrade starts fresh instead of
+        # colliding — the SIGILL class above), cache-everything
+        # thresholds, and the un-latch for caches configured after the
+        # process's first compile
+        from .serving.aot import enable_compile_cache as _wire
+        return bool(_wire(cache_dir))
     except Exception:
         return False
 

@@ -35,6 +35,20 @@ def test_ssd_example_runs():
     assert r.returncode == 0, r.stderr[-2000:]
 
 
+@pytest.mark.slow
+def test_fleet_demo_example_smoke():
+    """The fleet-serving walkthrough (examples/serving/fleet_demo.py):
+    publish v1, serve, publish v2 + AOT bundle, hot-swap under load with
+    a monotone version-tag timeline and zero errors, roll back. Slow
+    tier: every invariant it asserts is also covered in-process by
+    tests/test_serving_fleet.py (tier-1) — this run exercises the
+    example script itself."""
+    r = _run("examples/serving/fleet_demo.py",
+             ["--smoke", "--requests", "120"], timeout=300)
+    assert r.returncode == 0, (r.stdout + r.stderr)[-1500:]
+    assert "SMOKE OK" in r.stdout
+
+
 def test_tpu_fast_training_example(tmp_path):
     """The round-2 fast-training recipe (run_steps + DeviceStagingIter +
     async checkpoints + remat) runs end to end."""

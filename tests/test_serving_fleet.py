@@ -1,0 +1,600 @@
+"""Fleet serving subsystem: versioned registry, atomic hot-swap,
+zero-compile cold start.
+
+Tier-1-safe: CPU, in-process (the cold-start contract tests use
+subprocesses because "fresh replica" means a fresh process). The e2e
+acceptance tests:
+
+- publish v1 -> serve under concurrent load -> deploy v2: responses flip
+  atomically (version tags monotone in dispatch order, zero errors, no
+  request served by a half-warmed model),
+- a fresh-process restart of a published version records ~0 XLA compile
+  seconds in the telemetry registry (vs > 0 on first publish).
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.contrib import chaos
+from mxnet_tpu.serving import (Fleet, FleetServer, ModelRegistry,
+                               QueueFull, RegistryCorruptError, ReplayLog,
+                               warm_from_replay)
+from mxnet_tpu.serving.registry import ARTIFACT_PREFIX
+
+pytestmark = pytest.mark.serving
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _dense_net(seed=0, out=4, in_units=8):
+    mx.random.seed(seed)
+    net = gluon.nn.Dense(out, in_units=in_units)
+    net.initialize(mx.init.Xavier())
+    with mx.autograd.pause():
+        net(nd.ones((1, in_units)))
+    return net
+
+
+SIG = {"bucket_shapes": [[8]], "dtype": "float32", "batch_sizes": [1, 2]}
+
+
+def _registry(tmp_path, versions=1):
+    reg = ModelRegistry(str(tmp_path / "registry"))
+    for i in range(versions):
+        reg.publish("m", net=_dense_net(seed=i + 1), signature=SIG)
+    return reg
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_chaos():
+    yield
+    chaos.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# registry: publish / resolve / CURRENT / gc / rollback
+# ---------------------------------------------------------------------------
+
+def test_publish_layout_and_resolve(tmp_path):
+    reg = _registry(tmp_path)
+    assert reg.versions("m") == ["v1"] and reg.current("m") == "v1"
+    vdir = tmp_path / "registry" / "m" / "v1"
+    for name in (f"{ARTIFACT_PREFIX}-symbol.json",
+                 f"{ARTIFACT_PREFIX}-0000.params", "MANIFEST.json",
+                 "manifest.json", "DONE"):
+        assert (vdir / name).exists(), name
+    res = reg.resolve("m")
+    assert res.version == "v1" and res.signature == SIG
+    assert res.manifest["input_names"] == ["data"]
+    # the resolved prefix loads through the standard import path
+    from mxnet_tpu.gluon.block import SymbolBlock
+    net = SymbolBlock.imports(f"{res.prefix}-symbol.json", ["data"],
+                              f"{res.prefix}-0000.params")
+    net(nd.ones((2, 8)))
+
+
+def test_publish_versions_are_monotone_and_immutable(tmp_path):
+    reg = _registry(tmp_path, versions=2)
+    assert reg.versions("m") == ["v1", "v2"]
+    assert reg.current("m") == "v2"  # publish flips CURRENT by default
+    with pytest.raises(MXNetError, match="immutable"):
+        reg.publish("m", net=_dense_net(), version="v2")
+    # explicit versions must stay in the vN namespace: 'CURRENT' would
+    # squat the pointer file, 'v1.bad' the quarantine name
+    for bad in ("CURRENT", "v1.bad", "prod"):
+        with pytest.raises(MXNetError, match="must match v<N>"):
+            reg.publish("m", net=_dense_net(), version=bad)
+    v3 = reg.publish("m", net=_dense_net(), set_current=False)
+    assert v3 == "v3" and reg.current("m") == "v2"  # no flip on request
+
+
+def test_publish_from_prefix_artifacts(tmp_path):
+    net = _dense_net(seed=7)
+    prefix = str(tmp_path / "export" / "mynet")
+    os.makedirs(os.path.dirname(prefix))
+    net.export(prefix)
+    reg = ModelRegistry(str(tmp_path / "registry"))
+    v = reg.publish("m", prefix=prefix, signature=SIG)
+    res = reg.resolve("m", v)
+    from mxnet_tpu.gluon.block import SymbolBlock
+    loaded = SymbolBlock.imports(f"{res.prefix}-symbol.json", ["data"],
+                                 f"{res.prefix}-0000.params")
+    x = nd.ones((2, 8))
+    np.testing.assert_allclose(loaded(x).asnumpy(), net(x).asnumpy(),
+                               rtol=1e-6)
+
+
+def test_gc_keeps_current_and_newest(tmp_path):
+    reg = _registry(tmp_path, versions=4)
+    reg.set_current("m", "v2")  # current is OLD
+    deleted = reg.gc("m", keep=2)
+    assert deleted == ["v1"]  # v2 is old but CURRENT -> kept
+    assert reg.versions("m") == ["v2", "v3", "v4"]
+
+
+def test_rollback_default_and_pinned(tmp_path):
+    reg = _registry(tmp_path, versions=3)
+    assert reg.rollback("m") == "v2" and reg.current("m") == "v2"
+    assert reg.rollback("m", "v1") == "v1"
+    with pytest.raises(MXNetError, match="roll back"):
+        reg.rollback("m")  # nothing older than v1
+
+
+# ---------------------------------------------------------------------------
+# corruption: truncated artifact / forged hash / missing CURRENT
+# (mirrors tests/test_chaos.py ckpt_corrupt style)
+# ---------------------------------------------------------------------------
+
+def test_truncated_artifact_quarantines_and_falls_back(tmp_path):
+    reg = _registry(tmp_path, versions=2)
+    params = tmp_path / "registry" / "m" / "v2" / \
+        f"{ARTIFACT_PREFIX}-0000.params"
+    data = params.read_bytes()
+    params.write_bytes(data[:len(data) // 2])  # truncate
+    res = reg.resolve("m")  # CURRENT=v2 is corrupt
+    assert res.version == "v1"
+    assert reg.current("m") == "v1"  # pointer healed
+    assert (tmp_path / "registry" / "m" / "v2.bad").exists()
+    assert reg.versions("m") == ["v1"]
+
+
+def test_forged_manifest_hash_quarantines(tmp_path):
+    reg = _registry(tmp_path, versions=2)
+    # forge: edit MANIFEST.json (same length) without updating the sum
+    man = tmp_path / "registry" / "m" / "v2" / "MANIFEST.json"
+    body = man.read_bytes()
+    man.write_bytes(body.replace(b'"m"', b'"x"', 1))
+    assert len(man.read_bytes()) == len(body)  # only content verify sees it
+    res = reg.resolve("m")
+    assert res.version == "v1"
+    assert (tmp_path / "registry" / "m" / "v2.bad").exists()
+
+
+def test_missing_current_pointer_falls_back_to_newest_verified(tmp_path):
+    reg = _registry(tmp_path, versions=3)
+    os.remove(tmp_path / "registry" / "m" / "CURRENT")
+    # v3 (newest) is also corrupt: fallback must skip it too
+    chaos_target = tmp_path / "registry" / "m" / "v3" / \
+        f"{ARTIFACT_PREFIX}-0000.params"
+    chaos.corrupt_file(str(chaos_target))
+    res = reg.resolve("m")
+    assert res.version == "v2"
+    assert reg.current("m") == "v2"  # pointer restored
+    assert (tmp_path / "registry" / "m" / "v3.bad").exists()
+
+
+def test_pinned_resolve_of_corrupt_version_raises(tmp_path):
+    reg = _registry(tmp_path, versions=2)
+    chaos.corrupt_file(str(tmp_path / "registry" / "m" / "v1" /
+                           f"{ARTIFACT_PREFIX}-0000.params"))
+    with pytest.raises(RegistryCorruptError):
+        reg.resolve("m", "v1")  # the caller asked for those exact bytes
+    assert (tmp_path / "registry" / "m" / "v1.bad").exists()
+    assert reg.resolve("m").version == "v2"  # current path unaffected
+
+
+def test_all_versions_corrupt_raises_with_context(tmp_path):
+    reg = _registry(tmp_path, versions=1)
+    chaos.corrupt_file(str(tmp_path / "registry" / "m" / "v1" /
+                           f"{ARTIFACT_PREFIX}-0000.params"))
+    with pytest.raises(MXNetError, match="no verified version"):
+        reg.resolve("m")
+
+
+def test_chaos_registry_corrupt_grammar(tmp_path):
+    """registry_corrupt@<version> corrupts the params artifact AFTER the
+    DONE marker lands (forged-complete), and the grammar stays strict."""
+    with pytest.raises(MXNetError, match="version target"):
+        chaos.ChaosPlan("registry_corrupt")
+    with pytest.raises(MXNetError, match="unknown event kind"):
+        chaos.ChaosPlan("registry_corupt@v1")  # typo
+    plan = chaos.install("registry_corrupt@v2")
+    reg = _registry(tmp_path, versions=2)  # v2 publish fires the hook
+    assert plan.injected["registry_corrupt"] == 1
+    # forged-complete: DONE + manifests intact, content bad
+    assert (tmp_path / "registry" / "m" / "v2" / "DONE").exists()
+    assert reg.resolve("m").version == "v1"
+    assert (tmp_path / "registry" / "m" / "v2.bad").exists()
+
+
+def test_chaos_registry_corrupt_latest(tmp_path):
+    plan = chaos.install("registry_corrupt@latest")
+    reg = _registry(tmp_path, versions=1)  # the NEXT publish is hit
+    assert plan.injected["registry_corrupt"] == 1
+    reg.publish("m", net=_dense_net(seed=9), signature=SIG)  # untouched
+    assert plan.injected["registry_corrupt"] == 1  # consumed once
+    assert reg.resolve("m").version == "v2"
+    with pytest.raises(RegistryCorruptError):
+        reg.resolve("m", "v1")  # the corrupted publish, pinned
+    assert reg.versions("m") == ["v2"]  # v1 quarantined by the attempt
+
+
+# ---------------------------------------------------------------------------
+# FleetServer: deploy / hot-swap / rollback
+# ---------------------------------------------------------------------------
+
+def test_fleet_server_serves_current_and_tags_responses(tmp_path):
+    reg = _registry(tmp_path)
+    srv = FleetServer(reg, "m", max_batch_size=2,
+                      max_queue_latency_ms=2.0).start()
+    try:
+        assert srv.active_version == "v1"
+        # bucket_shapes came from the published signature set
+        assert srv._table.bucket_shapes == {(8,)}
+        fut = srv.submit(np.ones((8,), np.float32))
+        row = fut.result(timeout=10)
+        assert row.shape == (4,)
+        assert fut.version == "v1" and fut.dispatch_seq is not None
+    finally:
+        srv.stop()
+
+
+def test_deploy_hot_swap_under_load_is_atomic(tmp_path):
+    """THE e2e acceptance: publish v1 -> concurrent load -> deploy v2.
+    Zero errors/sheds, version tags monotone in dispatch-seq order, the
+    swap serves every request from exactly one fully-warm model."""
+    reg = _registry(tmp_path, versions=2)
+    reg.set_current("m", "v1")
+    v1_net_out = None
+    srv = FleetServer(reg, "m", version="v1", max_batch_size=4,
+                      max_queue_latency_ms=1.0, workers=2,
+                      queue_depth=512).start()
+    item = np.random.RandomState(0).rand(8).astype(np.float32)
+    tags, errors = [], []
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def client():
+        while not stop.is_set():
+            try:
+                fut = srv.submit(item)
+                out = fut.result(timeout=30)
+                with lock:
+                    tags.append((fut.dispatch_seq, fut.version,
+                                 float(out[0])))
+            except Exception as e:
+                with lock:
+                    errors.append(repr(e))
+
+    threads = [threading.Thread(target=client) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        time.sleep(0.15)
+        report = srv.deploy("v2")
+        time.sleep(0.15)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+        srv.stop()
+    assert not errors, errors[:3]
+    assert report["previous"] == "v1" and report["version"] == "v2"
+    tags.sort()
+    versions = [v for _, v, _ in tags]
+    assert "v1" in versions and "v2" in versions  # load spanned the swap
+    flip = versions.index("v2")
+    assert all(v == "v1" for v in versions[:flip])
+    assert all(v == "v2" for v in versions[flip:])  # monotone: no mixing
+    # and the MODEL actually changed at the tag flip: v1/v2 have
+    # different weights, so outputs differ across the boundary and are
+    # constant within each side (no half-warmed in-between model)
+    v1_outs = {round(o, 5) for _, v, o in tags if v == "v1"}
+    v2_outs = {round(o, 5) for _, v, o in tags if v == "v2"}
+    assert len(v1_outs) == 1 and len(v2_outs) == 1
+    assert v1_outs != v2_outs
+
+
+def test_deploy_same_version_is_noop_and_rollback_flips_back(tmp_path):
+    reg = _registry(tmp_path, versions=2)
+    srv = FleetServer(reg, "m", max_batch_size=2).start()
+    try:
+        assert srv.active_version == "v2"
+        rep = srv.deploy("v2")
+        assert rep["previous"] == "v2" and rep["warm_s"] == 0.0
+        back = srv.rollback()
+        assert back["version"] == "v1" and srv.active_version == "v1"
+        assert reg.current("m") == "v1"
+    finally:
+        srv.stop()
+
+
+def test_deploy_metrics_and_spans_recorded(tmp_path):
+    from mxnet_tpu.telemetry import default_registry
+    reg_t = default_registry()
+    before = reg_t.render_json().get("mxtpu_serve_deploys_total", {})
+    before_n = before.get("total", 0) if isinstance(before, dict) else before
+    reg = _registry(tmp_path, versions=2)
+    reg.set_current("m", "v1")
+    srv = FleetServer(reg, "m", max_batch_size=2).start()
+    try:
+        srv.deploy("v2")
+    finally:
+        srv.stop()
+    after = reg_t.render_json()
+    total = after["mxtpu_serve_deploys_total"]
+    total_n = total.get("total", total) if isinstance(total, dict) else total
+    assert total_n >= (before_n or 0) + 1
+    assert after["mxtpu_serve_warm_seconds"] > 0
+
+
+# ---------------------------------------------------------------------------
+# AOT bundles + replay warmers
+# ---------------------------------------------------------------------------
+
+def test_publish_aot_makes_deploy_zero_compile(tmp_path):
+    """The warm replica exports its executables for the NEXT version
+    (same architecture -> same programs); the deploy then loads them and
+    performs ZERO fresh compiles (cache misses)."""
+    reg = _registry(tmp_path, versions=1)
+    srv = FleetServer(reg, "m", max_batch_size=2).start()
+    try:
+        v2 = reg.publish("m", net=_dense_net(seed=5), signature=SIG)
+        n = srv.publish_aot(version=v2)
+        assert n > 0
+        assert reg.resolve("m", v2).aot_path is not None
+        report = srv.deploy(v2)
+        assert report["aot_loaded"] == n
+        assert report["compiles"] == 0  # the whole point
+        out = srv.predict(np.ones((8,), np.float32), timeout=10)
+        direct = _dense_net(seed=5)(nd.ones((1, 8))).asnumpy()[0]
+        np.testing.assert_allclose(out, direct, rtol=1e-5)
+    finally:
+        srv.stop()
+
+
+def test_aot_bundle_fingerprint_mismatch_falls_back(tmp_path):
+    import pickle
+    reg = _registry(tmp_path, versions=1)
+    srv = FleetServer(reg, "m", max_batch_size=2).start()
+    try:
+        v2 = reg.publish("m", net=_dense_net(seed=5), signature=SIG)
+        srv.publish_aot(version=v2)
+        # rewrite the bundle with a foreign fingerprint
+        aot = reg.resolve("m", v2).aot_path
+        with open(aot, "rb") as f:
+            bundle = pickle.load(f)
+        bundle["fingerprint"] = {"jax": "9.9", "jaxlib": "9.9",
+                                 "backend": "mars"}
+        with open(aot, "wb") as f:
+            pickle.dump(bundle, f)
+        reg.attach("m", v2, "aot.bin", aot)  # re-manifest the edit
+        report = srv.deploy(v2)
+        assert report["aot_loaded"] == 0     # rejected, not crashed
+        assert report["compiles"] > 0        # recompiled instead
+        srv.predict(np.ones((8,), np.float32), timeout=10)
+    finally:
+        srv.stop()
+
+
+def test_replay_log_roundtrip_and_dedup(tmp_path):
+    path = str(tmp_path / "replay.jsonl")
+    log = ReplayLog(path)
+    assert log.record((8,), "float32", 2) is True
+    assert log.record((8,), "float32", 2) is False  # dedup
+    assert log.record((8,), "float32", 4) is True
+    # a torn tail write must not break parsing
+    with open(path, "a") as f:
+        f.write('{"shape": [8], "dt')
+    assert ReplayLog.signatures(path) == [((8,), "float32", 2),
+                                          ((8,), "float32", 4)]
+    # resume: a new recorder over the same file keeps deduping
+    log2 = ReplayLog(path)
+    assert log2.record((8,), "float32", 4) is False
+
+
+def test_server_records_replay_and_warmer_prewarms(tmp_path, monkeypatch):
+    replay = str(tmp_path / "replay.jsonl")
+    monkeypatch.setenv("MXTPU_SERVE_REPLAY", replay)
+    from mxnet_tpu.serving import ModelServer
+    srv = ModelServer(_dense_net(), bucket_shapes=[(8,)], max_batch_size=2,
+                      max_queue_latency_ms=1.0).start()
+    try:
+        for _ in range(3):
+            srv.predict(np.ones((8,), np.float32), timeout=10)
+    finally:
+        srv.stop()
+    sigs = ReplayLog.signatures(replay)
+    assert ((8,), "float32", 1) in sigs  # recorded once, not 3 times
+    assert len(sigs) == len(set(sigs))
+    # a fresh server prewarms exactly the replayed signatures
+    monkeypatch.delenv("MXTPU_SERVE_REPLAY")
+    from mxnet_tpu.serving import SignatureCache
+    cache = SignatureCache(_dense_net(seed=2))
+    compiles = warm_from_replay(cache, replay)
+    assert compiles == len(sigs)
+    assert warm_from_replay(cache, replay) == 0  # second pass all hits
+
+
+def test_deploy_warms_from_published_replay(tmp_path):
+    reg = _registry(tmp_path, versions=1)
+    replay = tmp_path / "replay.jsonl"
+    log = ReplayLog(str(replay))
+    log.record((8,), "float32", 1)
+    log.record((8,), "float32", 2)
+    reg.attach("m", "v1", "replay.jsonl", str(replay))
+    res = reg.resolve("m")
+    assert res.replay_path is not None
+    srv = FleetServer(reg, "m", max_batch_size=2).start()
+    try:
+        # replayed signatures are already warm: first request replays
+        info = srv.cache.cache_info()
+        assert info.misses >= 2
+        srv.predict(np.ones((8,), np.float32), timeout=10)
+        assert srv.cache.cache_info().misses == info.misses
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Fleet: routing + rolling deploy
+# ---------------------------------------------------------------------------
+
+def test_fleet_round_robin_and_rolling_deploy(tmp_path):
+    reg = _registry(tmp_path, versions=2)
+    reg.set_current("m", "v1")
+    fleet = Fleet(reg, "m", replicas=2, version="v1", max_batch_size=2,
+                  max_queue_latency_ms=1.0).start()
+    try:
+        assert fleet.versions() == ["v1", "v1"]
+        futs = [fleet.submit(np.ones((8,), np.float32)) for _ in range(8)]
+        for f in futs:
+            f.result(timeout=10)
+        # both replicas saw traffic (round-robin)
+        for r in fleet.replicas:
+            assert r.metrics_json()["responses_total"] > 0
+        reports = fleet.deploy("v2")
+        assert [r["version"] for r in reports] == ["v2", "v2"]
+        assert fleet.versions() == ["v2", "v2"]
+    finally:
+        fleet.stop()
+
+
+def test_fleet_failover_on_saturated_replica(tmp_path):
+    reg = _registry(tmp_path, versions=1)
+    fleet = Fleet(reg, "m", replicas=2, max_batch_size=2,
+                  max_queue_latency_ms=50.0, queue_depth=1,
+                  workers=1).start()
+    try:
+        # saturate replica 0's admission (depth 1) so round-robin picks
+        # it but submit fails over to replica 1 instead of shedding
+        chaos.install("serve_slow@200")
+        futs = []
+        for _ in range(4):
+            try:
+                futs.append(fleet.submit(np.ones((8,), np.float32)))
+            except QueueFull:
+                pass  # both saturated: the client-visible contract
+        got = sum(1 for f in futs if f.result(timeout=30) is not None)
+        assert got == len(futs) and got >= 2
+    finally:
+        chaos.uninstall()
+        fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# zero-compile cold start (fresh processes)
+# ---------------------------------------------------------------------------
+
+_COLD_CHILD = r"""
+import json, os, sys
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.serving import (ModelRegistry, FleetServer,
+                               enable_compile_cache)
+from mxnet_tpu.telemetry import default_registry
+
+default_registry()      # install the XLA compile listeners FIRST
+enable_compile_cache()  # and the persistent cache BEFORE any compile
+root, mode = sys.argv[1], sys.argv[2]
+reg = ModelRegistry(root)
+if mode == "publish":
+    mx.random.seed(0)
+    net = gluon.nn.Dense(4, in_units=8)
+    net.initialize(mx.init.Xavier())
+    with mx.autograd.pause():
+        net(nd.ones((1, 8)))
+    reg.publish("m", net=net, signature={"bucket_shapes": [[8]],
+                                         "dtype": "float32",
+                                         "batch_sizes": [1, 2]})
+srv = FleetServer(reg, "m", max_batch_size=2).start()
+out = srv.predict(np.ones((8,), np.float32), timeout=60)
+assert out.shape == (4,)
+srv.stop()
+j = default_registry().render_json()
+print("STATS " + json.dumps({
+    "compiles": j.get("mxtpu_xla_compile_total", 0),
+    "compile_s": j.get("mxtpu_xla_compile_seconds_total", 0.0),
+    "cache_hits": j.get("mxtpu_xla_cache_hits_total", 0),
+}))
+"""
+
+
+def _run_cold_child(tmp_path, mode):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               MXTPU_COMPILE_CACHE=str(tmp_path / "compile_cache"))
+    res = subprocess.run(
+        [sys.executable, "-c", _COLD_CHILD,
+         str(tmp_path / "registry"), mode],
+        capture_output=True, text=True, timeout=240, env=env, cwd=ROOT)
+    assert res.returncode == 0, res.stderr[-1500:]
+    stats = [l for l in res.stdout.splitlines() if l.startswith("STATS ")]
+    assert stats, res.stdout
+    return json.loads(stats[-1][len("STATS "):])
+
+
+def test_second_cold_start_records_zero_compile_seconds(tmp_path):
+    """THE cold-start acceptance: first publish+serve of a version pays
+    real XLA compile seconds; a FRESH PROCESS restarting the same
+    version against the persistent compile cache records ~0 compile
+    seconds in the telemetry registry — every compile becomes a cache
+    retrieval (counted separately)."""
+    first = _run_cold_child(tmp_path, "publish")
+    assert first["compiles"] > 0 and first["compile_s"] > 0, first
+    second = _run_cold_child(tmp_path, "serve")
+    assert second["compiles"] == 0, second       # zero fresh compiles
+    assert second["compile_s"] == 0, second      # ~0 enforced exactly
+    assert second["cache_hits"] > 0, second      # work became retrievals
+
+
+def test_registry_ctl_smoke_and_layout_compat(tmp_path):
+    """tools/registry_ctl.py --smoke passes, and a version it publishes
+    (pure stdlib) resolves + serves through the framework registry."""
+    res = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "registry_ctl.py"),
+         "--smoke"], capture_output=True, text=True, timeout=60)
+    assert res.returncode == 0, res.stderr[-800:]
+    assert "SMOKE OK" in res.stdout
+    # cross-compat: CLI publish -> framework resolve/serve
+    net = _dense_net(seed=3)
+    prefix = str(tmp_path / "art")
+    net.export(prefix)
+    res = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "registry_ctl.py"),
+         "publish", str(tmp_path / "registry"), "m", prefix,
+         "--signature", json.dumps(SIG)],
+        capture_output=True, text=True, timeout=60)
+    assert res.returncode == 0, res.stderr[-800:]
+    reg = ModelRegistry(str(tmp_path / "registry"))
+    assert reg.resolve("m").version == "v1"
+    srv = FleetServer(reg, "m", max_batch_size=2).start()
+    try:
+        out = srv.predict(np.ones((8,), np.float32), timeout=10)
+        np.testing.assert_allclose(out, net(nd.ones((1, 8))).asnumpy()[0],
+                                   rtol=1e-5)
+    finally:
+        srv.stop()
+
+
+def test_model_server_load_still_serves_unregistered_prefixes(tmp_path):
+    """The pre-registry entry point is unchanged: ModelServer.load on a
+    bare export prefix (no registry, no manifest) keeps working."""
+    from mxnet_tpu.serving import ModelServer
+    net = _dense_net(seed=11)
+    prefix = str(tmp_path / "bare")
+    net.export(prefix)
+    srv = ModelServer.load(prefix, bucket_shapes=[(8,)], max_batch_size=2,
+                           max_queue_latency_ms=1.0)
+    try:
+        srv.start()
+        fut = srv.submit(np.ones((8,), np.float32))
+        out = fut.result(timeout=10)
+        np.testing.assert_allclose(out, net(nd.ones((1, 8))).asnumpy()[0],
+                                   rtol=1e-6)
+        assert fut.version is None  # registry-less servers are untagged
+    finally:
+        srv.stop()

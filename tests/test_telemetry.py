@@ -235,6 +235,11 @@ def test_registry_callback_gauge_polls_at_export():
 
 
 def test_default_registry_absorbs_cachedop_cache_traffic():
+    """Order-independent since the retired-counts fix: the exported
+    totals are MONOTONE (a dying cache folds its counters into a retired
+    accumulator), so a cyclic-GC pass collecting earlier tests' caches
+    between the two reads can no longer make the sum go DOWN — the exact
+    mechanism of the test_env_flags+test_telemetry pair-order flake."""
     reg = telemetry.default_registry()
     before = reg.render_json().get("mxtpu_cachedop_cache_misses", 0)
     net = gluon.nn.Dense(4)
@@ -245,6 +250,40 @@ def test_default_registry_absorbs_cachedop_cache_traffic():
     after = reg.render_json()
     assert after["mxtpu_cachedop_cache_misses"] > before
     assert after["mxtpu_cachedop_cache_hits"] >= 1
+
+
+def test_cachedop_cache_gauges_survive_cache_death():
+    """Regression for the pair-order flake: deleting a cache (and
+    forcing the cyclic GC that used to subtract its whole history from
+    the live-sum gauge) must keep hits/misses monotone; only currsize —
+    true occupancy — may drop."""
+    import gc
+
+    from mxnet_tpu.cached_op import SignatureLRU
+    reg = telemetry.default_registry()
+    cache = SignatureLRU(maxsize=8)
+    for i in range(5):
+        cache.get_or_build(("k", i), lambda: object())
+    cache.get_or_build(("k", 0), lambda: object())  # a hit
+    j1 = reg.render_json()
+    del cache
+    gc.collect()
+    j2 = reg.render_json()
+    for field in ("mxtpu_cachedop_cache_misses",
+                  "mxtpu_cachedop_cache_hits"):
+        assert j2[field] >= j1[field], (field, j1[field], j2[field])
+    assert j2["mxtpu_cachedop_cache_currsize"] <= \
+        j1["mxtpu_cachedop_cache_currsize"]
+    # clear() must retire, not erase: an explicit cache reset is the
+    # other path that used to subtract history from the live sum
+    cache2 = SignatureLRU(maxsize=8)
+    cache2.get_or_build(("c2", 1), lambda: object())
+    j3 = reg.render_json()
+    cache2.clear()
+    j4 = reg.render_json()
+    assert j4["mxtpu_cachedop_cache_misses"] >= \
+        j3["mxtpu_cachedop_cache_misses"]
+    assert cache2.cache_info().misses == 0  # per-cache view does reset
 
 
 def test_default_registry_absorbs_trainer_dispatch_counts():

@@ -1,0 +1,335 @@
+#!/usr/bin/env python
+"""registry_ctl: operate a serving model registry from CI/cron — stdlib only.
+
+The on-disk registry (``mxnet_tpu.serving.ModelRegistry``) is a plain
+directory contract, so fleet plumbing (publish from a CI artifact, list
+what is live, roll back a bad deploy, prune old versions) must not need
+the framework — or jax — installed. This tool speaks the same layout with
+nothing but the standard library:
+
+    registry/<model>/CURRENT                  one-line version pointer
+    registry/<model>/<vN>/model-symbol.json   HybridBlock.export artifacts
+    registry/<model>/<vN>/model-0000.params
+    registry/<model>/<vN>/MANIFEST.json       signature set + metadata
+    registry/<model>/<vN>/manifest.json       per-file SHA-256 + bytes
+    registry/<model>/<vN>/DONE                completion marker (last)
+
+Commands::
+
+    registry_ctl.py publish  <root> <model> <prefix> [--version vN]
+                             [--signature JSON] [--input-names a,b]
+                             [--metadata JSON] [--no-current]
+    registry_ctl.py list     <root> [model] [--json]
+    registry_ctl.py rollback <root> <model> [--to vN]
+    registry_ctl.py gc       <root> <model> --keep N [--dry-run]
+    registry_ctl.py --smoke          # self-test in a temp dir (CI)
+
+``publish`` copies an exported artifact pair (``<prefix>-symbol.json`` +
+``<prefix>-0000.params``) into the next version slot with the same
+atomicity rules as the in-framework publisher: staged in ``<vN>.tmp``,
+SHA-256 manifest written, ``DONE`` last, one ``os.replace`` into place,
+then the ``CURRENT`` pointer flip. ``list`` verifies every version's
+manifest and marks corrupt ones. ``gc`` never deletes the CURRENT target.
+"""
+import argparse
+import hashlib
+import json
+import os
+import re
+import shutil
+import sys
+import time
+
+ARTIFACT_PREFIX = "model"
+MANIFEST_NAME = "MANIFEST.json"
+SUM_NAME = "manifest.json"
+CURRENT_NAME = "CURRENT"
+DONE_NAME = "DONE"
+_VERSION_RE = re.compile(r"^v(\d+)$")
+
+
+def _sha256_file(path, chunk=1 << 20):
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                return h.hexdigest()
+            h.update(block)
+
+
+def _write_sums(vdir):
+    sums = {}
+    for name in sorted(os.listdir(vdir)):
+        fpath = os.path.join(vdir, name)
+        if name in (SUM_NAME, DONE_NAME) or not os.path.isfile(fpath):
+            continue
+        sums[name] = {"sha256": _sha256_file(fpath),
+                      "bytes": os.path.getsize(fpath)}
+    with open(os.path.join(vdir, SUM_NAME), "w") as f:
+        json.dump(sums, f)
+
+
+def _verify(vdir):
+    """Returns None when the version verifies, else a reason string."""
+    if not os.path.exists(os.path.join(vdir, DONE_NAME)):
+        return "incomplete (no DONE)"
+    sum_path = os.path.join(vdir, SUM_NAME)
+    if not os.path.exists(sum_path):
+        return "missing manifest.json"
+    try:
+        with open(sum_path) as f:
+            sums = json.load(f)
+    except (OSError, ValueError) as e:
+        return f"unreadable manifest: {e}"
+    for name, rec in sums.items():
+        fpath = os.path.join(vdir, name)
+        if not os.path.exists(fpath):
+            return f"missing file {name}"
+        if os.path.getsize(fpath) != rec["bytes"] or \
+                _sha256_file(fpath) != rec["sha256"]:
+            return f"hash mismatch on {name}"
+    return None
+
+
+def _versions(mdir):
+    out = []
+    if os.path.isdir(mdir):
+        for name in os.listdir(mdir):
+            m = _VERSION_RE.match(name)
+            if m and os.path.exists(os.path.join(mdir, name, DONE_NAME)):
+                out.append((int(m.group(1)), name))
+    return [n for _, n in sorted(out)]
+
+
+def _current(mdir):
+    try:
+        with open(os.path.join(mdir, CURRENT_NAME)) as f:
+            return f.read().strip() or None
+    except OSError:
+        return None
+
+
+def _set_current(mdir, version):
+    path = os.path.join(mdir, CURRENT_NAME)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(version + "\n")
+    os.replace(tmp, path)
+
+
+def cmd_publish(args):
+    mdir = os.path.join(args.root, args.model)
+    os.makedirs(mdir, exist_ok=True)
+    version = args.version
+    if version is None:
+        top = 0
+        for name in os.listdir(mdir):
+            m = _VERSION_RE.match(name.split(".", 1)[0])
+            if m:
+                top = max(top, int(m.group(1)))
+        version = f"v{top + 1}"
+    elif not _VERSION_RE.match(version):
+        sys.exit(f"error: version must match v<N> (got {version!r}); "
+                 "vN names keep clear of the CURRENT/quarantine namespaces")
+    vdir = os.path.join(mdir, version)
+    if os.path.exists(vdir):
+        sys.exit(f"error: {args.model}/{version} already exists "
+                 "(versions are immutable)")
+    tmp = f"{vdir}.tmp"
+    if os.path.isdir(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    try:
+        for suffix in ("-symbol.json", "-0000.params"):
+            src = f"{args.prefix}{suffix}"
+            if not os.path.exists(src):
+                sys.exit(f"error: artifact {src} not found (need the "
+                         "HybridBlock.export layout)")
+            shutil.copyfile(src, os.path.join(tmp,
+                                              f"{ARTIFACT_PREFIX}{suffix}"))
+        manifest = {
+            "model": args.model,
+            "version": version,
+            "created": time.time(),
+            "input_names": [s for s in args.input_names.split(",") if s],
+            "signature": json.loads(args.signature),
+            "metadata": json.loads(args.metadata),
+            "fingerprint": {"tool": "registry_ctl"},
+        }
+        with open(os.path.join(tmp, MANIFEST_NAME), "w") as f:
+            json.dump(manifest, f, indent=1)
+        _write_sums(tmp)
+        with open(os.path.join(tmp, DONE_NAME), "w") as f:
+            f.write("ok")
+        os.replace(tmp, vdir)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    if not args.no_current:
+        _set_current(mdir, version)
+    print(f"published {args.model}/{version}"
+          + ("" if args.no_current else " (current)"))
+
+
+def cmd_list(args):
+    models = ([args.model] if args.model else
+              sorted(n for n in os.listdir(args.root)
+                     if os.path.isdir(os.path.join(args.root, n)))
+              if os.path.isdir(args.root) else [])
+    out = {}
+    for model in models:
+        mdir = os.path.join(args.root, model)
+        cur = _current(mdir)
+        rows = []
+        for v in _versions(mdir):
+            vdir = os.path.join(mdir, v)
+            bad = _verify(vdir)
+            meta = {}
+            try:
+                with open(os.path.join(vdir, MANIFEST_NAME)) as f:
+                    meta = json.load(f)
+            except (OSError, ValueError):
+                pass
+            rows.append({"version": v, "current": v == cur,
+                         "status": bad or "ok",
+                         "created": meta.get("created"),
+                         "aot": os.path.exists(os.path.join(vdir,
+                                                            "aot.bin"))})
+        out[model] = {"current": cur, "versions": rows}
+    if args.json:
+        print(json.dumps(out, indent=1))
+        return
+    for model, info in out.items():
+        print(f"{model} (current: {info['current']})")
+        for row in info["versions"]:
+            mark = "*" if row["current"] else " "
+            aot = " +aot" if row["aot"] else ""
+            print(f"  {mark} {row['version']:8s} {row['status']}{aot}")
+
+
+def _vnum(version):
+    m = _VERSION_RE.match(version or "")
+    return int(m.group(1)) if m else -1
+
+
+def cmd_rollback(args):
+    mdir = os.path.join(args.root, args.model)
+    cur = _current(mdir)
+    target = args.to
+    if target is None:
+        # a corrupted/hand-edited CURRENT compares as -1: every real
+        # version is "newer", so nothing qualifies and we exit cleanly
+        older = [v for v in _versions(mdir)
+                 if cur is None or _vnum(v) < _vnum(cur)]
+        if not older:
+            sys.exit(f"error: nothing to roll back to (current={cur})")
+        target = older[-1]
+    vdir = os.path.join(mdir, target)
+    bad = _verify(vdir)
+    if bad:
+        sys.exit(f"error: refusing to roll back onto {target}: {bad}")
+    _set_current(mdir, target)
+    print(f"rolled back {args.model}: {cur} -> {target}")
+
+
+def cmd_gc(args):
+    if args.keep < 1:
+        sys.exit("error: --keep must be >= 1")
+    mdir = os.path.join(args.root, args.model)
+    cur = _current(mdir)
+    versions = _versions(mdir)
+    doomed = [v for v in (versions[:-args.keep]
+                          if args.keep < len(versions) else [])
+              if v != cur]
+    for v in doomed:
+        if args.dry_run:
+            print(f"would delete {args.model}/{v}")
+        else:
+            shutil.rmtree(os.path.join(mdir, v), ignore_errors=True)
+            print(f"deleted {args.model}/{v}")
+    if not doomed:
+        print("nothing to delete")
+
+
+def smoke():
+    """Self-contained exercise of every command in a temp dir (the CI
+    smoke path — no framework, no jax, just the layout contract)."""
+    import tempfile
+    tmp = tempfile.mkdtemp(prefix="registry_ctl_smoke_")
+    root = os.path.join(tmp, "registry")
+    prefix = os.path.join(tmp, "artifact")
+    with open(f"{prefix}-symbol.json", "w") as f:
+        json.dump({"nodes": []}, f)
+    with open(f"{prefix}-0000.params", "wb") as f:
+        f.write(os.urandom(256))
+
+    def run(argv):
+        main(argv)
+
+    run(["publish", root, "toy", prefix,
+         "--signature", '{"bucket_shapes": [[8]]}'])
+    run(["publish", root, "toy", prefix])
+    mdir = os.path.join(root, "toy")
+    assert _current(mdir) == "v2", _current(mdir)
+    assert _versions(mdir) == ["v1", "v2"]
+    assert _verify(os.path.join(mdir, "v2")) is None
+    run(["list", root, "toy", "--json"])
+    run(["rollback", root, "toy"])
+    assert _current(mdir) == "v1"
+    run(["publish", root, "toy", prefix])          # v3 (current)
+    run(["gc", root, "toy", "--keep", "1"])        # v1 is old but... v3 cur
+    left = _versions(mdir)
+    assert left == ["v3"], left                    # v1+v2 pruned, cur kept
+    # corrupt v3's params and confirm list flags it
+    with open(os.path.join(mdir, "v3",
+                           f"{ARTIFACT_PREFIX}-0000.params"), "r+b") as f:
+        f.seek(16)
+        f.write(b"\x00" * 8)
+    assert _verify(os.path.join(mdir, "v3")) is not None
+    shutil.rmtree(tmp, ignore_errors=True)
+    print("SMOKE OK")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--smoke", action="store_true",
+                   help="self-test every command in a temp dir and exit")
+    sub = p.add_subparsers(dest="cmd")
+    pp = sub.add_parser("publish", help="copy exported artifacts into the "
+                                        "next version slot")
+    pp.add_argument("root"), pp.add_argument("model")
+    pp.add_argument("prefix", help="artifact prefix (prefix-symbol.json + "
+                                   "prefix-0000.params)")
+    pp.add_argument("--version", default=None)
+    pp.add_argument("--signature", default="{}",
+                    help='JSON, e.g. \'{"bucket_shapes": [[3,224,224]]}\'')
+    pp.add_argument("--metadata", default="{}")
+    pp.add_argument("--input-names", default="data")
+    pp.add_argument("--no-current", action="store_true")
+    pp.set_defaults(fn=cmd_publish)
+    pl = sub.add_parser("list", help="models/versions with verify status")
+    pl.add_argument("root"), pl.add_argument("model", nargs="?")
+    pl.add_argument("--json", action="store_true")
+    pl.set_defaults(fn=cmd_list)
+    pr = sub.add_parser("rollback", help="repoint CURRENT (prev by default)")
+    pr.add_argument("root"), pr.add_argument("model")
+    pr.add_argument("--to", default=None)
+    pr.set_defaults(fn=cmd_rollback)
+    pg = sub.add_parser("gc", help="prune old versions (never CURRENT)")
+    pg.add_argument("root"), pg.add_argument("model")
+    pg.add_argument("--keep", type=int, required=True)
+    pg.add_argument("--dry-run", action="store_true")
+    pg.set_defaults(fn=cmd_gc)
+    args = p.parse_args(argv)
+    if args.smoke:
+        smoke()
+        return
+    if not args.cmd:
+        p.print_help()
+        sys.exit(2)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()
