@@ -228,6 +228,19 @@ env.declare("MXTPU_PROFILE", str, "",
             "tokens 'on'|'off'|'ring=N'|'cat=a|b'|'file=PATH' (see "
             "telemetry.tracer). Empty = tracing off (near-zero overhead: "
             "one flag check per span site).")
+env.declare("MXTPU_MEM_BUDGET", int, 0,
+            "Device-memory budget in bytes for the live-byte ledger "
+            "(telemetry/memory.py). When > 0, fit.FitLoop checks the "
+            "per-step ledger watermark against it and writes a ranked "
+            "memory-forensics dump (categories, top owners, per-program "
+            "temp bytes, recent trace window) on the first step that "
+            "exceeds it. 0 (default) disables the budget check; the "
+            "RESOURCE_EXHAUSTED and mem_pressure chaos triggers stay "
+            "active regardless.")
+env.declare("MXTPU_MEM_DUMP_DIR", str, "",
+            "Directory memory-forensics dumps are written to "
+            "(mem_forensics_<pid>_<n>.json). Empty (default) = the "
+            "current working directory.")
 env.declare("MXTPU_PROFILE_BOUND_FRAC", float, 0.4,
             "Step-breakdown detector threshold: any non-compute segment "
             "(data_wait/h2d/comm/optimizer/checkpoint) whose share of "

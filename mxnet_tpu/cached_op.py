@@ -197,7 +197,7 @@ def _jax():
 
 class _CacheEntry:
     __slots__ = ("jitted", "mutated_idx", "out_treedef", "vjp_jitted",
-                 "n_outputs", "warm")
+                 "n_outputs", "warm", "mem_stats", "__weakref__")
 
     def __init__(self):
         self.jitted = None
@@ -205,6 +205,9 @@ class _CacheEntry:
         self.out_treedef = None
         self.vjp_jitted = None
         self.n_outputs = 0
+        # static memory_analysis of the compiled program, filled lazily
+        # by CachedOp.memory_analysis()
+        self.mem_stats: Optional[dict] = None
         # False until the first execution (which runs the python trace)
         # has completed — concurrent callers must treat a cold entry like
         # a miss and take the exclusive trace path
@@ -353,6 +356,67 @@ class CachedOp:
         (shape of :func:`functools.lru_cache`'s ``cache_info``)."""
         return self._cache.cache_info()
 
+    def memory_analysis(self, refresh: bool = False) -> Dict[str, dict]:
+        """Static per-program memory attribution, keyed by signature
+        digest: each warm entry's compiled ``memory_analysis()``
+        (argument/output/temp/alias bytes — the activation/workspace
+        footprint the live ledger cannot see). Re-lowers from the
+        recorded abstract signature like :meth:`aot_export` (one trace;
+        with the persistent compile cache this is a disk read, not a
+        recompile) and caches the result on the entry until ``refresh``.
+        Results are also recorded in the telemetry program registry
+        (kind ``cached_op``) for the registry gauges and OOM forensics."""
+        import hashlib
+
+        import jax
+        import numpy as np
+
+        from .ops.registry import _trace_time_flags
+        from .telemetry import memory as _memory
+
+        def sds(sig):
+            return tuple(jax.ShapeDtypeStruct(tuple(shape), np.dtype(dt))
+                         for shape, dt in sig)
+
+        probe_key = jax.random.PRNGKey(0)
+        key_aval = jax.ShapeDtypeStruct(probe_key.shape, probe_key.dtype)
+        label_base = type(self.block).__name__
+        out: Dict[str, dict] = {}
+        for key_sig, entry in self._cache.snapshot_items():
+            if not entry.warm:
+                continue
+            digest = hashlib.md5(repr(key_sig).encode()).hexdigest()[:12]
+            if entry.mem_stats is not None and not refresh:
+                out[digest] = entry.mem_stats
+                continue
+            stats = None
+            if hasattr(entry.jitted, "lower"):
+                in_sig, param_sig, in_treedef, _training, flags = key_sig
+                if flags != _trace_time_flags():
+                    continue  # stale entry from a different flag regime
+                # re-lowering retraces the pure fn (Parameter storage is
+                # swapped to tracers for the duration): same exclusivity
+                # as a cold trace, same discipline as aot_export
+                self._trace_rw.acquire_write()
+                try:
+                    self._in_treedef = in_treedef
+                    compiled = entry.jitted.lower(
+                        sds(param_sig), key_aval, *sds(in_sig)).compile()
+                finally:
+                    self._trace_rw.release_write()
+                stats = _memory.compiled_memory_stats(compiled)
+            else:
+                # AOT-loaded executable: already a Compiled stage
+                stats = _memory.compiled_memory_stats(entry.jitted)
+            if stats is None:
+                continue
+            stats = dict(stats, signature=digest)
+            entry.mem_stats = stats
+            _memory.record_program("cached_op",
+                                   f"{label_base}:{digest}", stats)
+            out[digest] = stats
+        return out
+
     # -- AOT executable slot -------------------------------------------
     # A new replica of an already-published model should reach first byte
     # with ZERO compiles and ZERO traces: aot_export serializes every warm
@@ -472,6 +536,13 @@ class CachedOp:
                 entry.warm = True
                 if self._cache.insert(key_sig, entry):
                     loaded += 1
+                    # ledger the deserialized executable under
+                    # 'aot_bundles' (serialized-payload bytes as the
+                    # footprint proxy), freed when the entry dies
+                    from .telemetry import memory as _memory
+                    _memory.ledger().attach(
+                        "aot_bundles", len(rec["payload"]),
+                        f"aot:{os.path.basename(path)}", entry)
             except Exception as e:
                 log.warning("aot_load: skipping one entry: %s", e)
         return loaded
@@ -543,7 +614,6 @@ class CachedOp:
 
         flat_in, in_treedef = jax.tree_util.tree_flatten(
             args, is_leaf=lambda x: isinstance(x, NDArray))
-        self._in_treedef = in_treedef
         in_arrays = [x._data for x in flat_in]
 
         # nested trace (this CachedOp called inside another jit trace):
@@ -572,6 +642,13 @@ class CachedOp:
         mode = "read"
         self._trace_rw.acquire_read()
         try:
+            # treedef is read by the pure fn at TRACE time only (traces
+            # hold the write lock); assigning inside the lock — and
+            # re-asserting under write exclusivity below — keeps a
+            # concurrent caller's different input structure (or a
+            # memory_analysis/aot_export re-lower) from being traced
+            # against the wrong treedef
+            self._in_treedef = in_treedef
             param_arrays = tuple(p._data._data for p in params)
             key_sig = (tuple((tuple(a.shape), str(a.dtype))
                              for a in in_arrays),
@@ -598,6 +675,7 @@ class CachedOp:
                 mode = None
                 self._trace_rw.acquire_write()
                 mode = "write"
+                self._in_treedef = in_treedef  # no clobber possible now
                 param_arrays = tuple(p._data._data for p in params)
             out_arrays, state = entry.jitted(param_arrays, rng_key,
                                              *in_arrays)

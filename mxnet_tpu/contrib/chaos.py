@@ -38,6 +38,12 @@ Grammar: comma-separated events, each ``kind[:prob][@target]``:
   simulating compute stragglers/compile stalls so deadline shedding and
   queue backpressure are testable (hook: ``serving.ModelServer`` worker,
   before the batch is padded and dispatched).
+- ``mem_pressure@N[:BYTES]`` — synthetic device-memory budget shrink at
+  step ``N``: the memory monitor treats ``BYTES`` (default 0) as the
+  budget for that step, so the live-byte watermark exceeds it and the
+  OOM forensics dump fires deterministically — the black-box recording
+  path is testable on CPU without a real allocation failure (hook:
+  ``fit.FitLoop`` per-step ``telemetry.memory.check_pressure``).
 - ``registry_corrupt@V`` — flip bytes inside the params artifact of model-
   registry version ``V`` (``latest`` = the next published version) *after*
   its DONE marker and manifest land: a forged-complete corrupt model,
@@ -87,7 +93,8 @@ class ChaosKilled(MXNetError):
 
 
 _KINDS = ("nan_grad", "inf_grad", "kill", "preempt", "ckpt_corrupt",
-          "kv_flake", "kv_slow", "serve_slow", "registry_corrupt")
+          "kv_flake", "kv_slow", "serve_slow", "registry_corrupt",
+          "mem_pressure")
 
 
 class ChaosPlan:
@@ -115,6 +122,7 @@ class ChaosPlan:
         self.kv_slow_ms = 0.0
         self.serve_slow_p = 0.0
         self.serve_slow_ms = 0.0
+        self._mem_pressure: Dict[int, int] = {}  # step -> budget bytes
         # observability: how many of each fault actually fired
         self.injected: Dict[str, int] = {k: 0 for k in _KINDS}
         for tok in (spec or "").split(","):
@@ -165,6 +173,30 @@ class ChaosPlan:
             else:
                 self.serve_slow_p = p
                 self.serve_slow_ms = ms
+            return
+        if kind == "mem_pressure":
+            # mem_pressure@N[:BYTES] — synthetic budget shrink at step N:
+            # the memory monitor treats BYTES (default 0, i.e. "any live
+            # byte is over budget") as the budget for that one step and
+            # dumps forensics, making the OOM black-box path
+            # deterministic and testable on CPU
+            if prob is not None:
+                raise MXNetError("chaos: mem_pressure takes no probability")
+            if target is None:
+                raise MXNetError("chaos: mem_pressure needs a step target, "
+                                 "e.g. mem_pressure@3 or "
+                                 "mem_pressure@3:1048576")
+            step_s, _, bytes_s = target.partition(":")
+            try:
+                step = int(step_s)
+                budget = int(bytes_s) if bytes_s else 0
+            except ValueError:
+                raise MXNetError(
+                    f"chaos: bad mem_pressure target {target!r} "
+                    "(expected STEP or STEP:BYTES)")
+            if budget < 0:
+                raise MXNetError(f"chaos: mem_pressure budget {budget} < 0")
+            self._mem_pressure[step] = budget
             return
         if prob is not None:
             raise MXNetError(f"chaos: {kind} takes no probability")
@@ -247,6 +279,19 @@ class ChaosPlan:
         fault the chaos test exists to exercise."""
         return (int(step) in self._at["nan_grad"] or
                 int(step) in self._at["inf_grad"])
+
+    def mem_pressure_bytes(self) -> Optional[int]:
+        """mem_pressure@N[:BYTES] — the synthetic memory budget for the
+        current step, or None when none is scheduled. Consumed on read
+        (fires once); the memory monitor (``telemetry.memory
+        .check_pressure``) compares the step's ledger watermark against
+        it and dumps forensics when exceeded."""
+        if self._step is None or self._step not in self._mem_pressure:
+            return None
+        budget = self._mem_pressure.pop(self._step)
+        self.injected["mem_pressure"] += 1
+        _count_injection("mem_pressure")
+        return budget
 
     def kv_delay_s(self) -> float:
         """kv_slow:P@MS — seconds of injected wire delay for this kvstore

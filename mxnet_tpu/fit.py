@@ -38,6 +38,7 @@ from .log import get_logger
 from . import fault
 from .contrib import chaos as _chaos
 from .telemetry import autotune as _autotune
+from .telemetry import memory as _memory
 from .telemetry.step_breakdown import StepBreakdown, segment as _segment
 
 __all__ = ["FitLoop", "FitResult", "resumable_exit_code"]
@@ -65,6 +66,7 @@ class FitResult:
     resumed_from: Optional[int] = None  # checkpoint step, None = fresh
     step_breakdown: Optional[dict] = None  # telemetry summary (shares)
     tuning_report: Optional[dict] = None  # autotune protocol (MXTPU_AUTOTUNE)
+    memory: Optional[dict] = None  # live-byte ledger summary + step peaks
 
 
 class FitLoop:
@@ -213,6 +215,11 @@ class FitLoop:
         pos_epoch, pos_batch = start_epoch, skip_batches
         steps_before = result.step
         plan = _chaos.active()
+        # memory axis: re-arm the budget-watermark edge detector (one
+        # forensics dump per run per breach) and open a fresh ledger
+        # window so a stale watermark from an earlier run can't fire it
+        _memory.reset_pressure_state()
+        _memory.ledger().begin_window()
         good_streak = 0
         hb = None
         if self._heartbeat and self._ckpt_dir is not None:
@@ -376,6 +383,17 @@ class FitLoop:
                                 # now that the tuner is quiescent
                                 bd.uninstall()
                                 bd = None
+                    # memory pressure: the deterministic mem_pressure
+                    # chaos event and the MXTPU_MEM_BUDGET watermark both
+                    # fire a ranked forensics dump (result.step already
+                    # incremented — report the step that RAN). A dump
+                    # failure (disk full at OOM time) must not take down
+                    # the training step that still works
+                    try:
+                        _memory.check_pressure(step=result.step - 1,
+                                               plan=plan)
+                    except Exception as e:
+                        _LOG.warning("memory pressure check failed: %s", e)
                 skip_batches = 0
                 result.epoch = epoch + 1
                 pos_epoch, pos_batch = epoch + 1, 0
@@ -386,6 +404,12 @@ class FitLoop:
                 self._save(cm, result.step, pos_epoch, pos_batch)
             if cm is not None:
                 cm.wait()
+        except Exception as e:
+            # allocation failure: write the memory black box while the
+            # evidence (ledger, programs, trace window) is still live,
+            # then let the error propagate unchanged
+            _memory.maybe_dump_oom(e, step=result.step)
+            raise
         finally:
             if tuner is not None:
                 # the decision persists in the report; the env mutation
@@ -401,6 +425,12 @@ class FitLoop:
             # a probe-only breakdown (collect_breakdown=False, run ended
             # mid-probe) is not published either — the caller opted out
             result.step_breakdown = bd.summary()
+        # memory summary: ledger category snapshot + per-step watermarks
+        # (the per-step peaks are byte-identical to the breakdown's
+        # device_memory_peak trace counters)
+        result.memory = _memory.ledger().summary()
+        if bd is not None and bd.mem_steps:
+            result.memory.update(bd.memory_summary())
         if tuner is not None:
             result.tuning_report = tuner.report()
         return result

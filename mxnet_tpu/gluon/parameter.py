@@ -21,6 +21,7 @@ from ..base import MXNetError, check
 from ..context import Context, current_context, cpu
 from .. import initializer as init_mod
 from ..ndarray import ndarray as _nd
+from ..telemetry import memory as _memory
 
 __all__ = ["Parameter", "Constant", "ParameterDict", "DeferredInitializationError"]
 
@@ -65,6 +66,7 @@ class Parameter:
             if req == "null":
                 self._grad = None
                 self._data._tape_entry = None
+                _memory.drop_param_grad(self)
             else:
                 self._attach()
 
@@ -112,6 +114,7 @@ class Parameter:
         initializer(init_mod.InitDesc(self.name), data)
         self._data = data
         self._deferred_init = None
+        _memory.track_param_data(self)
         if self._grad_req != "null":
             self._attach()
 
@@ -126,6 +129,7 @@ class Parameter:
             grad = _nd.zeros(self.shape, ctx=self._data.context,
                              dtype=self._data._data.dtype)
         self._grad = grad
+        _memory.track_param_grad(self)
         autograd.mark_variables([self._data], [grad], self._grad_req)
 
     def _finish_deferred_init(self, in_shape_hint=None) -> None:
@@ -177,12 +181,14 @@ class Parameter:
         if self._data is None:
             self.shape = data.shape
             self._data = data
+            _memory.track_param_data(self)
             if self._grad_req != "null":
                 self._attach()
         else:
             self._data._rebind(data.astype(self._data._data.dtype)._data
                                if data._data.dtype != self._data._data.dtype
                                else data._data)
+            _memory.track_param_data(self)
 
     def zero_grad(self) -> None:
         self._fresh_grad = False
@@ -193,6 +199,7 @@ class Parameter:
             empty = _sp.zeros("row_sparse", self._grad.shape,
                               dtype=self._grad._data.dtype)
             self._grad._update(empty._data, empty._indices)
+            _memory.track_param_grad(self)  # sparse buffers shrank
             return
         self._grad._rebind(_nd.zeros(self._grad.shape,
                                      ctx=self._grad.context,
@@ -206,8 +213,10 @@ class Parameter:
         self.dtype = dtype
         if self._data is not None:
             self._data._rebind(self._data.astype(dtype)._data)
+            _memory.track_param_data(self)
             if self._grad is not None:
                 self._grad._rebind(self._grad.astype(dtype)._data)
+                _memory.track_param_grad(self)
                 from .. import autograd
                 autograd.mark_variables([self._data], [self._grad],
                                         self._grad_req)
@@ -235,6 +244,7 @@ class Constant(Parameter):
         ctx = ctx if ctx is not None else current_context()
         self._data = _nd.array(self.value, ctx=ctx)
         self._deferred_init = None
+        _memory.track_param_data(self)
 
 
 class ParameterDict:

@@ -42,6 +42,7 @@ from typing import Dict, List, Optional
 from ..base import MXNetError, check, env
 from .. import optimizer as opt_mod
 from ..optimizer import grouped as _grouped
+from ..telemetry import memory as _memory
 from ..telemetry.step_breakdown import segment as _bd_segment
 from .parameter import Parameter, ParameterDict
 
@@ -361,6 +362,12 @@ class Trainer:
         sig = tuple((g.shape, str(g._data.dtype)) for _, g in bucket)
         flat = _flatten_fn()(*[g._data for _, g in bucket])
         flat_nd = _nd.NDArray(flat, ctx=bucket[0][1]._ctx)
+        # memory ledger: the transient flat wire buffer is live from here
+        # until the split rebinds the per-param grads and it dies (the
+        # store keeps its own copy, ledgered by kvstore.init); freed by
+        # the NDArray's death, so donation/free accounting is automatic
+        _memory.track_ndarray("grad_buckets", flat_nd,
+                              owner=f"_gbkt{bid}:wire")
         # the key encodes the bucket's FULL shape signature (digest):
         # if the layout changes mid-run (a param frozen, the MB cap
         # changed) a fresh key gets a fresh store buffer and a fresh
@@ -446,6 +453,10 @@ class Trainer:
         for i in self._last_fused_created:
             self._updaters[0].states.pop(i, None)
             self._updaters[0].states_synced.pop(i, None)
+            # the state objects die with the pop: release their ledger
+            # bytes too, or a skipped first step would leak phantom
+            # optimizer/masters accounting forever
+            _memory.drop_optimizer_state(self._updaters[0], i)
         self._last_fused_indices = []
         self._last_fused_created = []
 

@@ -15,11 +15,25 @@ from __future__ import annotations
 
 from typing import Optional
 
+import itertools
+
 from ..base import check
+from ..telemetry import memory as _memory
 from ..telemetry.step_breakdown import segment as _segment
 from .io import DataBatch, DataIter
 
 __all__ = ["DeviceStagingIter"]
+
+_STAGE_KEYS = itertools.count(1)
+
+
+def _drop_keys(keys):
+    try:
+        led = _memory.ledger()
+        for key in keys:
+            led.drop("staging", key)
+    except Exception:
+        pass  # interpreter shutdown
 
 
 class DeviceStagingIter(DataIter):
@@ -49,7 +63,11 @@ class DeviceStagingIter(DataIter):
         else:
             self._ctx = tpu(self._device.id)
         self._staged: list = []
+        self._staged_keys: list = []  # parallel memory-ledger keys
         self._exhausted = False
+        # an iterator abandoned mid-epoch must not leak its staged bytes
+        import weakref
+        weakref.finalize(self, _drop_keys, self._staged_keys)
 
     @property
     def depth(self) -> int:
@@ -75,7 +93,14 @@ class DeviceStagingIter(DataIter):
     def reset(self):
         self._base.reset()
         self._staged.clear()
+        self._drop_staged_keys()
         self._exhausted = False
+
+    def _drop_staged_keys(self):
+        led = _memory.ledger()
+        for key in self._staged_keys:
+            led.drop("staging", key)
+        self._staged_keys.clear()
 
     def _stage_one(self) -> bool:
         """Kick off the async H2D transfer of the next host batch."""
@@ -93,11 +118,23 @@ class DeviceStagingIter(DataIter):
                            ctx=self._ctx)
 
         with _segment("h2d"):
-            self._staged.append(DataBatch(
+            staged = DataBatch(
                 [put(d) for d in (batch.data or [])],
                 [put(l) for l in (batch.label or [])],
                 pad=batch.pad, index=getattr(batch, "index", None),
-                bucket_key=getattr(batch, "bucket_key", None)))
+                bucket_key=getattr(batch, "bucket_key", None))
+            self._staged.append(staged)
+            # ledger the staged-ahead device bytes (category 'staging'):
+            # live from the device_put here until the consumer pops the
+            # batch — the prefetch depth is visible memory, and the one
+            # knob (set_depth) the autotuner moves it with
+            key = ("stage", next(_STAGE_KEYS))
+            self._staged_keys.append(key)
+            _memory.ledger().set(
+                "staging", key,
+                sum(_memory.nd_bytes(a) for a in
+                    (staged.data or []) + (staged.label or [])),
+                owner=f"staging:{type(self._base).__name__}")
         return True
 
     def next(self) -> DataBatch:
@@ -107,6 +144,8 @@ class DeviceStagingIter(DataIter):
         if not self._staged:
             raise StopIteration
         out = self._staged.pop(0)
+        if self._staged_keys:
+            _memory.ledger().drop("staging", self._staged_keys.pop(0))
         # refill the pipeline: start the next transfer before returning
         if not self._exhausted and len(self._staged) <= self._depth \
                 and not self._stage_one():

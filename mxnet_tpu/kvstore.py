@@ -155,7 +155,17 @@ class KVStoreBase:
                 continue
             v = vals[0]
             v = v.copy() if isinstance(v, _nd.NDArray) else _nd.array(v)
-            self._store[k] = self._maybe_shard(v) if shard else v
+            v = self._maybe_shard(v) if shard else v
+            self._store[k] = v
+            # memory ledger: init COPIES the value, so the store owns a
+            # real resident device buffer per key (a flat _gbkt bucket
+            # buffer, or a full copy of each parameter) — attributed
+            # here, freed when the stored NDArray dies. push/pull rebind
+            # the same object, so one entry covers the key's lifetime.
+            from .telemetry import memory as _memory
+            _memory.track_ndarray(
+                "grad_buckets" if str(k).startswith("_gbkt")
+                else "kvstore", v, owner=f"kv:{k}")
 
     def _maybe_shard(self, v: _nd.NDArray) -> _nd.NDArray:
         """Row-shard big tables across this process's local devices (ref:

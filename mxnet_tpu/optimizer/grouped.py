@@ -33,10 +33,11 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as _np
 
 from ..base import MXNetError, check, env
+from ..telemetry import memory as _memory
 
 __all__ = ["aggregation_size", "eligible", "grouped_update",
            "global_finite_flag", "rollback_counts", "cache_info",
-           "clear_cache"]
+           "clear_cache", "program_memory"]
 
 
 def _jnp():
@@ -268,6 +269,59 @@ def clear_cache():
     _cache().clear()
 
 
+def program_memory(refresh: bool = False) -> Dict[str, dict]:
+    """Static memory attribution of every cached bucket program:
+    ``{signature_digest: {argument_bytes, output_bytes, temp_bytes, ...}}``
+    from ``compiled.memory_analysis()``. The abstract argument signature
+    is reconstructed from the cache key, so this re-lowers (one trace; a
+    disk read, not a recompile, under a persistent compile cache) — the
+    CachedOp discipline ``spmd.program_stats`` established. Results are
+    recorded in the telemetry program registry (kind ``optimizer``) and
+    cached until ``refresh``."""
+    import hashlib
+
+    import jax
+    import numpy as _np2
+    out: Dict[str, dict] = {}
+    f32 = _np2.dtype("float32")
+    for sig, fn in _cache().snapshot_items():
+        try:
+            rule_name, _statics, sentinel, donated_sig, grads_sig = sig
+        except (TypeError, ValueError):
+            continue  # foreign cache entry (shared LRU discipline)
+        digest = hashlib.md5(repr(sig).encode()).hexdigest()[:12]
+        label = f"{rule_name}:{digest}"
+        cached = _memory.get_program("optimizer", label)
+        if cached is not None and not refresh:
+            out[digest] = cached
+            continue
+        n = len(donated_sig)
+        vec = jax.ShapeDtypeStruct((n,), f32)
+        scalar = jax.ShapeDtypeStruct((), f32)
+        try:
+            donated = tuple(
+                tuple(jax.ShapeDtypeStruct(tuple(s), _np2.dtype(dt))
+                      for s, dt in bundle) for bundle in donated_sig)
+            grads = tuple(jax.ShapeDtypeStruct(tuple(s), _np2.dtype(dt))
+                          for s, dt in grads_sig)
+            if sentinel:
+                ok = jax.ShapeDtypeStruct((), _np2.dtype(bool))
+                compiled = fn.lower(vec, vec, scalar, ok, donated,
+                                    grads).compile()
+            else:
+                compiled = fn.lower(vec, vec, scalar, donated,
+                                    grads).compile()
+        except Exception:
+            continue  # un-lowerable entry must not break the report
+        stats = _memory.compiled_memory_stats(compiled)
+        if stats is None:
+            continue
+        stats = dict(stats, signature=digest, params=n)
+        _memory.record_program("optimizer", label, stats)
+        out[digest] = stats
+    return out
+
+
 def _build_bucket_fn(kernels, guarded: bool):
     """One jitted program stepping a whole bucket.
 
@@ -394,6 +448,8 @@ def grouped_update(updater, items, agg_size: int, sentinel: bool = False,
         if i not in updater.states:
             updater.states[i] = opt.create_state_multi_precision(i, p.data())
             created.append(i)
+            _memory.track_optimizer_state(updater, i, updater.states[i],
+                                          param=p)
         opt._update_count(i)
 
     prepared = []

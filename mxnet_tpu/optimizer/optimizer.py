@@ -602,6 +602,12 @@ class Updater:
         if index not in self.states:
             self.states[index] = \
                 self.optimizer.create_state_multi_precision(index, weight)
+            # pass the weight: param_dict is empty on kvstore updaters
+            # (the optimizer pickle round-trip drops it) and the masters
+            # split needs the weight dtype
+            from ..telemetry import memory as _memory
+            _memory.track_optimizer_state(self, index, self.states[index],
+                                          weight=weight)
         self.optimizer.update_multi_precision(index, weight, grad,
                                               self.states[index])
 
@@ -610,12 +616,27 @@ class Updater:
                             if dump_optimizer else self.states)
 
     def set_states(self, states):
+        # the pre-replacement optimizer's param_dict is the only weight-
+        # dtype source once dump_optimizer=True swaps in an unpickled
+        # optimizer (whose param_dict pickles away to {})
+        prev_params = dict(getattr(self.optimizer, "param_dict", None)
+                           or {})
         states = pickle.loads(states)
         if isinstance(states, tuple) and len(states) == 2 and \
                 isinstance(states[1], Optimizer):
             self.states, self.optimizer = states
         else:
             self.states = states
+        # checkpoint restore replaces the state dict wholesale: drop the
+        # OLD dict's entries first (an index absent from the restored
+        # dict must not keep phantom bytes), then re-ledger every
+        # restored state so optimizer/masters stay exact
+        from ..telemetry import memory as _memory
+        _memory.drop_updater_states(self)
+        for index, state in self.states.items():
+            param = getattr(self.optimizer, "param_dict", {}).get(index) \
+                or prev_params.get(index)
+            _memory.track_optimizer_state(self, index, state, param=param)
 
 
 def get_updater(optimizer: Optimizer) -> Updater:

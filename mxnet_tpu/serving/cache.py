@@ -19,6 +19,7 @@ then track signatures seen rather than compiles.
 """
 from __future__ import annotations
 
+import itertools
 import threading
 from collections import OrderedDict
 from typing import Callable, Optional, Sequence, Tuple
@@ -27,8 +28,11 @@ import numpy as np
 
 from ..base import MXNetError
 from ..cached_op import CachedOp, CacheInfo
+from ..telemetry import memory as _memory
 
 __all__ = ["SignatureCache"]
+
+_MEM_OWNERS = itertools.count(1)
 
 
 class SignatureCache:
@@ -36,6 +40,10 @@ class SignatureCache:
 
     def __init__(self, model, cache_size: Optional[int] = None):
         self._lock = threading.Lock()
+        # memory-ledger owner tag: every ledgered byte of this cache's
+        # compiled programs carries it, so per-model bytes are queryable
+        # (ServerMetrics polls it) and die with the cache
+        self.mem_owner = f"sigcache{next(_MEM_OWNERS)}"
         self._is_block = hasattr(model, "collect_params")
         if self._is_block:
             self._op: Optional[CachedOp] = CachedOp(model,
@@ -90,3 +98,23 @@ class SignatureCache:
         with self._lock:
             return CacheInfo(self._plain_hits, self._plain_misses, 0,
                              len(self._seen), None)
+
+    def program_memory(self, refresh: bool = False) -> dict:
+        """Static memory footprint of every warm compiled signature
+        (``CachedOp.memory_analysis``), registered in the live-byte
+        ledger under ``serving_cache`` with this cache's owner tag —
+        the per-model bytes ``ServerMetrics`` exposes. Bytes rise as
+        signatures warm and fall when the cache is drained/undeployed
+        (the ledger entries die with the CachedOp). Plain-callable
+        models own no compiled programs and report {}."""
+        if self._op is None:
+            return {}
+        stats = self._op.memory_analysis(refresh=refresh)
+        _memory.register_cache_programs(self.mem_owner, self._op, stats)
+        return stats
+
+    def memory_bytes(self) -> int:
+        """Ledgered bytes of this cache's recorded programs (0 until
+        :meth:`program_memory` has run)."""
+        return _memory.ledger().live_bytes(
+            "serving_cache", owner_prefix=self.mem_owner + ":")

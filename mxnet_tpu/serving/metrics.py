@@ -63,6 +63,10 @@ class ServerMetrics:
         self.total_ms = LatencyHistogram()
         self.batch_size = LatencyHistogram(buckets=self.BATCH_SIZE_BUCKETS)
         self.cache_info_fn: Optional[Callable] = None
+        # per-model device bytes (set by the server): polls the memory
+        # ledger's serving_cache bytes for the ACTIVE model's signature
+        # cache, so a deploy/drain shows up as a rise/fall here
+        self.memory_fn: Optional[Callable] = None
 
     # -- recording helpers (one call site each in the server) ------------
     def record_request(self) -> None:
@@ -94,6 +98,14 @@ class ServerMetrics:
         return {"hits": info.hits, "misses": info.misses,
                 "evictions": info.evictions, "entries": info.currsize,
                 "max_entries": info.maxsize}
+
+    def _model_bytes(self) -> int:
+        if self.memory_fn is None:
+            return 0
+        try:
+            return int(self.memory_fn())
+        except Exception:
+            return 0
 
     def render_prometheus(self, prefix: str = "mxtpu_serve") -> str:
         up = time.time() - self.started
@@ -147,6 +159,12 @@ class ServerMetrics:
                       "Resident compiled signatures.",
                       f"# TYPE {prefix}_cache_entries gauge",
                       f"{prefix}_cache_entries {cache['entries']}"]
+        if self.memory_fn is not None:
+            lines += [f"# HELP {prefix}_model_bytes Device bytes "
+                      "attributed to the active model's compiled "
+                      "signatures (memory ledger, serving_cache).",
+                      f"# TYPE {prefix}_model_bytes gauge",
+                      f"{prefix}_model_bytes {self._model_bytes()}"]
         lines += [f"# HELP {prefix}_uptime_seconds Server uptime.",
                   f"# TYPE {prefix}_uptime_seconds gauge",
                   f"{prefix}_uptime_seconds {_fmt(round(up, 3))}"]
@@ -174,6 +192,7 @@ class ServerMetrics:
             },
             "batch_size": self.batch_size.snapshot(),
             "cache": self._cache_counts(),
+            "model_bytes": self._model_bytes(),
         }
 
     def render_json_text(self) -> str:

@@ -143,6 +143,7 @@ class ModelServer:
             SignatureCache(model, cache_size=cache_size))
         self.metrics = ServerMetrics(name)
         self.metrics.cache_info_fn = lambda: self._active.cache.cache_info()
+        self.metrics.memory_fn = lambda: self._active.cache.memory_bytes()
         # replay recorder (serving/aot.py): every dispatched signature is
         # logged once so new replicas can prewarm from real traffic
         self._replay = None
@@ -335,6 +336,7 @@ class ModelServer:
         lets an offered-load sweep isolate per-load-point statistics."""
         self.metrics = ServerMetrics(self.name)
         self.metrics.cache_info_fn = lambda: self._active.cache.cache_info()
+        self.metrics.memory_fn = lambda: self._active.cache.memory_bytes()
         return self.metrics
 
     def metrics_text(self) -> str:

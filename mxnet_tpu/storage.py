@@ -16,6 +16,11 @@ of reimplementing a pool:
   peak, pool reserved — the pooled-storage-manager introspection)
 - :func:`empty_cache` — drop cached/donated buffers where the backend
   supports it (`MXStorageEmptyCache` analog)
+- :func:`memory_summary` — the framework's own live-byte ledger
+  (:mod:`mxnet_tpu.telemetry.memory`) next to the backend counters: the
+  per-category attribution (params/grads/optimizer/masters/staging/...)
+  that stays exact on backends reporting no ``memory_stats`` at all,
+  cross-checked against the allocator watermarks where they exist
 - host->device staging lives in :class:`mxnet_tpu.io.DeviceStagingIter`
   (the pinned-memory transfer lane analog)
 """
@@ -25,7 +30,7 @@ from typing import Dict, Tuple
 
 from .base import check
 
-__all__ = ["memory_info", "memory_stats", "empty_cache"]
+__all__ = ["memory_info", "memory_stats", "empty_cache", "memory_summary"]
 
 
 def _device_of(ctx=None):
@@ -63,6 +68,21 @@ def memory_info(ctx=None) -> Tuple[int, int]:
     check(total is not None and used is not None,
           "device reports no memory accounting (host backend?)")
     return int(total) - int(used), int(total)
+
+
+def memory_summary(ctx=None) -> Dict[str, object]:
+    """Framework-attributed device memory next to the backend counters:
+    ``{"ledger": {live_bytes, peak_bytes, by_category, budget_bytes},
+    "backend": memory_stats(), "reconcile": {...}}``. The ledger half is
+    exact by construction for the tracked categories (every owner
+    registers its allocations) and therefore meaningful on host-CPU
+    backends where ``memory_stats`` is empty; on backends with real
+    counters ``reconcile`` flags a ledger total that exceeds the
+    allocator's ``bytes_in_use`` (a double-count bug)."""
+    from .telemetry import memory as _memory
+    return {"ledger": _memory.ledger().summary(),
+            "backend": memory_stats(ctx),
+            "reconcile": _memory.reconcile(ctx)}
 
 
 def empty_cache(ctx=None) -> None:

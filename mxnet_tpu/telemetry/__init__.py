@@ -22,6 +22,13 @@ is the TPU-native generalization; the whole stack reports into it:
   compute / optimizer / comm / checkpoint) with the input-bound / comm-bound
   detector. ``fit.FitLoop`` drives it; ``bench.py`` ships the segment shares
   as the ``step_breakdown`` headline row.
+- :mod:`.memory` — the memory axis: a live-byte ledger attributing device
+  bytes by owner (params / grads / optimizer / masters / staging /
+  buckets / serving caches; exact by construction on CPU), static
+  per-program ``memory_analysis`` attribution, per-step watermarks in the
+  step breakdown + a Perfetto counter track, and ranked OOM-forensics
+  dumps (``RESOURCE_EXHAUSTED`` / ``MXTPU_MEM_BUDGET`` / ``mem_pressure``
+  chaos).
 
 ``mxnet_tpu.profiler`` remains the MXNet-compatible facade over this
 package, and the kvstore remote profiler command channel
@@ -38,6 +45,8 @@ from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
                        default_registry)
 from .step_breakdown import (StepBreakdown, segment, current_breakdown,
                              SEGMENTS)
+from . import memory
+from .memory import (MemoryLedger, ledger as memory_ledger, dump_forensics)
 
 __all__ = [
     "Tracer", "tracer", "span", "instant", "counter_event", "enabled",
@@ -45,4 +54,5 @@ __all__ = [
     "chrome_trace_events", "dump_chrome_trace", "validate_chrome_trace",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "default_registry",
     "StepBreakdown", "segment", "current_breakdown", "SEGMENTS",
+    "memory", "MemoryLedger", "memory_ledger", "dump_forensics",
 ]

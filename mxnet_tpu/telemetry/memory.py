@@ -1,0 +1,690 @@
+"""Device-memory observability: live-byte ledger, per-program attribution,
+pressure forensics.
+
+The time axis of the measurement plane (tracer / step breakdown / registry)
+landed in earlier subsystems; this module is the *memory* axis. Three
+layers, coarsest first:
+
+**Live-byte ledger** (:class:`MemoryLedger`): every framework-owned device
+allocation is registered by its OWNER at the moment it happens —
+``gluon.Parameter`` data/grad buffers, optimizer state and f32 masters
+(per-param ``Updater`` path and the grouped/donated fast path),
+``Trainer``'s flat ``_gbkt`` gradient-bucket wire buffers,
+``DeviceStagingIter``'s staged-ahead batches, serving signature caches and
+AOT bundles — keyed by category, with the byte count derived from the
+array's shape/dtype. That makes the ledger *exact by construction* for the
+tracked categories on every backend including CPU (where PJRT reports no
+``memory_stats`` and the polled gauges used to read 0), so tier-1 can
+enforce it. On backends that do report ``memory_stats`` the ledger is a
+lower bound of ``bytes_in_use`` (XLA temps/activations are not live
+framework objects); :func:`reconcile` cross-checks the two.
+
+**Static per-program attribution**: the one category the ledger cannot see
+live — activation/workspace memory inside compiled programs — is accounted
+statically. Every ``CachedOp`` / grouped-optimizer signature can report
+its compiled ``memory_analysis()`` (argument/output/temp/alias bytes),
+recorded here per program (:func:`record_program`) and summed into
+registry gauges, so "how much workspace does this program need" is a
+queryable number per signature instead of an OOM stack trace.
+
+**Pressure forensics**: :func:`dump_forensics` writes the black-box
+recording — ranked ledger categories, top live buffers with owners,
+per-program temp bytes, backend memory_stats and the recent trace window —
+to a JSON file. It fires on allocation failure (``RESOURCE_EXHAUSTED``,
+via :func:`oom_guard`), on the live watermark exceeding
+``MXTPU_MEM_BUDGET`` (checked per step by ``fit.FitLoop``), and on the
+deterministic ``mem_pressure@N[:BYTES]`` chaos event, so the dump path is
+testable on CPU.
+
+Ledger mutations are rare (allocation-time, not per-op) and O(1); nothing
+here touches the hot dispatch path.
+"""
+from __future__ import annotations
+
+import contextlib
+import itertools
+import json
+import os
+import threading
+import time
+import weakref
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..base import env
+
+__all__ = ["CATEGORIES", "MemoryLedger", "ledger", "nd_bytes",
+           "compiled_memory_stats", "record_program", "get_program",
+           "program_report", "dump_forensics", "check_pressure",
+           "oom_guard", "maybe_dump_oom", "is_oom", "budget_bytes",
+           "reset_pressure_state", "reconcile"]
+
+#: ledger categories, in the order forensics ranks ties
+CATEGORIES = ("params", "grads", "grad_buckets", "optimizer", "masters",
+              "staging", "kvstore", "serving_cache", "aot_bundles", "other")
+
+_KEYS = itertools.count(1)
+
+
+def nd_bytes(x) -> int:
+    """Device bytes of an NDArray / jax array / numpy array, derived from
+    shape x itemsize (exact for dense buffers; a row_sparse NDArray counts
+    its value and index buffers)."""
+    try:
+        indices = getattr(x, "_indices", None)
+        arr = getattr(x, "_data", x)
+        n = int(arr.size) * int(arr.dtype.itemsize)
+        if indices is not None:
+            idx = getattr(indices, "_data", indices)
+            n += int(idx.size) * int(idx.dtype.itemsize)
+        return n
+    except Exception:
+        return 0
+
+
+class MemoryLedger:
+    """Thread-safe category/owner-keyed byte ledger with watermarks.
+
+    Entries are ``(category, key) -> (nbytes, owner)``; :meth:`set`
+    replaces in place (re-allocation, dtype cast), :meth:`drop` frees.
+    Owners that cannot call drop deterministically attach a
+    ``weakref.finalize`` via :meth:`attach` so the entry dies with the
+    buffer's owning object. Besides the live totals the ledger keeps a
+    process-lifetime peak and a resettable *window* peak — ``fit.FitLoop``
+    opens a window per step, giving per-step ``peak_bytes``/``delta_bytes``.
+    """
+
+    def __init__(self):
+        # RLock, not Lock: drops run from weakref.finalize, which cyclic
+        # GC may fire synchronously on THIS thread while it already holds
+        # the lock (a dict insert in set() allocates, allocation can
+        # collect a dead cycle owning a Parameter whose finalizer calls
+        # drop) — a plain Lock would self-deadlock. Same reasoning as
+        # cached_op._track_lock.
+        self._lock = threading.RLock()
+        self._entries: Dict[Tuple[str, Any], Tuple[int, str]] = {}
+        self._by_cat: Dict[str, int] = {}
+        self._total = 0
+        self._peak = 0
+        self._win_base = 0
+        self._win_peak = 0
+
+    # -- mutation -------------------------------------------------------
+    def _bump(self, category: str, delta: int) -> None:
+        # caller holds the lock
+        self._by_cat[category] = self._by_cat.get(category, 0) + delta
+        self._total += delta
+        if self._total > self._peak:
+            self._peak = self._total
+        if self._total > self._win_peak:
+            self._win_peak = self._total
+
+    def set(self, category: str, key, nbytes: int, owner: str = "") -> None:
+        """Register (or resize) one live allocation."""
+        nbytes = int(nbytes)
+        with self._lock:
+            old = self._entries.get((category, key))
+            self._entries[(category, key)] = (nbytes, owner)
+            self._bump(category, nbytes - (old[0] if old else 0))
+
+    def drop(self, category: str, key) -> None:
+        with self._lock:
+            old = self._entries.pop((category, key), None)
+            if old is not None:
+                self._bump(category, -old[0])
+
+    def drop_owner(self, category: str, owner_prefix: str) -> None:
+        """Free every entry in ``category`` whose owner starts with
+        ``owner_prefix`` (cache-granular cleanup)."""
+        self.drop_matching(lambda cat, _key, own:
+                           cat == category and own.startswith(owner_prefix))
+
+    def drop_matching(self, predicate: Callable[[str, Any, str], bool]
+                      ) -> None:
+        """Free every entry for which ``predicate(category, key, owner)``
+        is true — the one place bulk cleanup mutates the accounting."""
+        with self._lock:
+            doomed = [k for k, (_, own) in self._entries.items()
+                      if predicate(k[0], k[1], own)]
+            for k in doomed:
+                nbytes, _ = self._entries.pop(k)
+                self._bump(k[0], -nbytes)
+
+    def attach(self, category: str, nbytes: int, owner: str, obj,
+               key=None):
+        """Register an allocation and free it automatically when ``obj``
+        is garbage-collected. Returns the entry key."""
+        if key is None:
+            key = ("auto", next(_KEYS))
+        self.set(category, key, nbytes, owner)
+        try:
+            weakref.finalize(obj, self.drop, category, key)
+        except TypeError:
+            pass  # un-weakref-able owner: entry lives for the process
+        return key
+
+    # -- inspection -----------------------------------------------------
+    def live_bytes(self, category: Optional[str] = None,
+                   owner_prefix: Optional[str] = None) -> int:
+        with self._lock:
+            if category is None:
+                return self._total
+            if owner_prefix is None:
+                return self._by_cat.get(category, 0)
+            return sum(n for (cat, _), (n, own) in self._entries.items()
+                       if cat == category and own.startswith(owner_prefix))
+
+    def snapshot(self) -> Dict[str, int]:
+        """Live bytes per category (only categories with bytes)."""
+        with self._lock:
+            return {c: n for c, n in sorted(self._by_cat.items()) if n}
+
+    @property
+    def peak_bytes(self) -> int:
+        return self._peak
+
+    def begin_window(self) -> int:
+        """Open a watermark window (one per step); returns live bytes."""
+        with self._lock:
+            self._win_base = self._total
+            self._win_peak = self._total
+            return self._total
+
+    def window_stats(self) -> Tuple[int, int]:
+        """(peak, delta) bytes since :meth:`begin_window`."""
+        with self._lock:
+            return self._win_peak, self._total - self._win_base
+
+    def top(self, n: int = 20) -> List[Dict[str, Any]]:
+        """The ``n`` largest live allocations, ranked."""
+        with self._lock:
+            items = [{"category": cat, "owner": own, "bytes": size}
+                     for (cat, _), (size, own) in self._entries.items()]
+        items.sort(key=lambda e: -e["bytes"])
+        return items[:n]
+
+    def summary(self) -> Dict[str, Any]:
+        with self._lock:
+            total, peak = self._total, self._peak
+            by_cat = {c: n for c, n in sorted(self._by_cat.items()) if n}
+        return {"live_bytes": total, "peak_bytes": peak,
+                "by_category": by_cat,
+                "budget_bytes": budget_bytes() or None}
+
+
+_LEDGER = MemoryLedger()
+_metrics_installed = False
+_install_lock = threading.Lock()
+
+
+def ledger() -> MemoryLedger:
+    """The process-wide ledger (installs registry gauges on first use)."""
+    _install_metrics()
+    return _LEDGER
+
+
+def _install_metrics() -> None:
+    global _metrics_installed
+    with _install_lock:
+        if _metrics_installed:
+            return
+        _metrics_installed = True
+    try:
+        from .registry import default_registry
+        reg = default_registry()
+        reg.callback_gauge(
+            "mxtpu_mem_live_bytes", _LEDGER.live_bytes,
+            "Live framework-attributed device bytes (memory ledger).")
+        reg.callback_gauge(
+            "mxtpu_mem_peak_bytes", lambda: _LEDGER.peak_bytes,
+            "Process-lifetime peak of the memory-ledger total.")
+        for cat in CATEGORIES:
+            reg.callback_gauge(
+                f"mxtpu_mem_{cat}_bytes",
+                (lambda c=cat: _LEDGER.live_bytes(c)),
+                f"Live device bytes attributed to category '{cat}'.")
+        reg.callback_gauge(
+            "mxtpu_program_temp_bytes", lambda: _program_total("temp_bytes"),
+            "XLA temp (workspace/activation) bytes over recorded compiled "
+            "programs (static memory_analysis attribution).")
+        reg.callback_gauge(
+            "mxtpu_program_argument_bytes",
+            lambda: _program_total("argument_bytes"),
+            "XLA argument bytes over recorded compiled programs.")
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Owner hooks. Never raise: observability must not take down training.
+# ---------------------------------------------------------------------------
+
+def _param_key(p) -> Optional[int]:
+    key = getattr(p, "_mem_key", None)
+    if key is None:
+        key = next(_KEYS)
+        try:
+            p._mem_key = key
+        except Exception:
+            return None
+        weakref.finalize(p, _drop_param_entries, key)
+    return key
+
+
+def _drop_param_entries(key: int) -> None:
+    try:
+        _LEDGER.drop("params", ("p", key))
+        _LEDGER.drop("grads", ("g", key))
+    except Exception:
+        pass  # interpreter shutdown
+
+
+def track_param_data(p) -> None:
+    """Register (or resize) a Parameter's data buffer."""
+    try:
+        if p._data is None:
+            return
+        key = _param_key(p)
+        if key is not None:
+            _LEDGER.set("params", ("p", key), nd_bytes(p._data),
+                        owner=p.name)
+    except Exception:
+        pass
+
+
+def track_param_grad(p) -> None:
+    try:
+        if p._grad is None:
+            return
+        key = _param_key(p)
+        if key is not None:
+            _LEDGER.set("grads", ("g", key), nd_bytes(p._grad),
+                        owner=p.name)
+    except Exception:
+        pass
+
+
+def drop_param_grad(p) -> None:
+    try:
+        key = getattr(p, "_mem_key", None)
+        if key is not None:
+            _LEDGER.drop("grads", ("g", key))
+    except Exception:
+        pass
+
+
+def _updater_key(updater) -> Optional[int]:
+    key = getattr(updater, "_mem_key", None)
+    if key is None:
+        key = next(_KEYS)
+        try:
+            updater._mem_key = key
+        except Exception:
+            return None
+        weakref.finalize(updater, _drop_updater_entries, key)
+    return key
+
+
+def _drop_updater_entries(utok: int) -> None:
+    try:
+        _LEDGER.drop_matching(
+            lambda _cat, key, _own: isinstance(key, tuple) and
+            len(key) == 2 and key[0] == utok)
+    except Exception:
+        pass
+
+
+def drop_updater_states(updater) -> None:
+    """Free every optimizer/masters entry of this updater (checkpoint
+    restore replaces the state dict wholesale — stale indices the new
+    dict lacks must not keep their bytes)."""
+    utok = getattr(updater, "_mem_key", None)
+    if utok is not None:
+        _drop_updater_entries(utok)
+
+
+def _state_arrays(state) -> List:
+    out = []
+    if state is None:
+        return out
+    if isinstance(state, (tuple, list)):
+        for s in state:
+            out.extend(_state_arrays(s))
+    elif hasattr(state, "_data"):
+        out.append(state)
+    return out
+
+
+def track_optimizer_state(updater, index, state, param=None,
+                          weight=None) -> None:
+    """Register one parameter's optimizer state; the f32 master copy of a
+    multi-precision state (the ``(inner, w32)`` convention of
+    ``create_state_multi_precision``) is split into the ``masters``
+    category. The split needs the WEIGHT dtype (mp wraps only non-f32
+    weights, and Adam's plain ``(m, v)`` is structurally identical to
+    ``(inner, w32)``): resolved from ``param``, the ``weight`` NDArray
+    (the kvstore-updater call path, where ``param_dict`` is empty after
+    the optimizer pickle round-trip), or ``opt.param_dict``. With no
+    dtype source the state lands wholly in ``optimizer`` — the total
+    stays exact, only the split degrades."""
+    try:
+        utok = _updater_key(updater)
+        if utok is None:
+            return
+        opt = updater.optimizer
+        if param is None:
+            param = getattr(opt, "param_dict", {}).get(index)
+        name = getattr(param, "name", str(index))
+        wdt = None
+        if param is not None and getattr(param, "_data", None) is not None:
+            wdt = str(param._data._data.dtype)
+        elif weight is not None:
+            wdt = str(getattr(weight, "_data", weight).dtype)
+        master = None
+        inner = state
+        if bool(getattr(opt, "multi_precision", False)) and \
+                isinstance(state, tuple) and len(state) == 2 and \
+                hasattr(state[1], "_data") and \
+                wdt is not None and wdt != "float32":
+            inner, master = state
+        inner_bytes = sum(nd_bytes(a) for a in _state_arrays(inner))
+        _LEDGER.set("optimizer", (utok, index), inner_bytes,
+                    owner=f"state:{name}")
+        if master is not None:
+            _LEDGER.set("masters", (utok, index), nd_bytes(master),
+                        owner=f"master:{name}")
+        else:
+            _LEDGER.drop("masters", (utok, index))
+    except Exception:
+        pass
+
+
+def drop_optimizer_state(updater, index) -> None:
+    """Free one state's entries (sentinel-skipped step rollback)."""
+    try:
+        utok = getattr(updater, "_mem_key", None)
+        if utok is not None:
+            _LEDGER.drop("optimizer", (utok, index))
+            _LEDGER.drop("masters", (utok, index))
+    except Exception:
+        pass
+
+
+def track_ndarray(category: str, nd, owner: str = "") -> None:
+    """Register a transient buffer, freed when the NDArray dies (the flat
+    ``_gbkt`` gradient-bucket wire buffers)."""
+    try:
+        _LEDGER.attach(category, nd_bytes(nd), owner, nd)
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Static per-program attribution
+# ---------------------------------------------------------------------------
+
+_PROGRAMS: Dict[Tuple[str, str], Dict[str, Any]] = {}
+_prog_lock = threading.Lock()
+
+
+def compiled_memory_stats(compiled) -> Optional[Dict[str, int]]:
+    """Extract ``memory_analysis()`` from a jax Compiled object into a
+    plain int dict; None when the backend reports no analysis."""
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:
+        return None
+    if mem is None:
+        return None
+
+    def g(name):
+        try:
+            return int(getattr(mem, name, 0) or 0)
+        except Exception:
+            return 0
+
+    stats = {"argument_bytes": g("argument_size_in_bytes"),
+             "output_bytes": g("output_size_in_bytes"),
+             "temp_bytes": g("temp_size_in_bytes"),
+             "alias_bytes": g("alias_size_in_bytes"),
+             "generated_code_bytes": g("generated_code_size_in_bytes")}
+    if not any(stats.values()) and not hasattr(mem, "temp_size_in_bytes"):
+        return None
+    return stats
+
+
+def record_program(kind: str, label: str, stats: Dict[str, Any]) -> None:
+    """Record one compiled program's static memory footprint, keyed by
+    (kind, label) — e.g. ("cached_op", "ResNet:ab12...")."""
+    with _prog_lock:
+        _PROGRAMS[(kind, label)] = dict(stats)
+
+
+def get_program(kind: str, label: str) -> Optional[Dict[str, Any]]:
+    with _prog_lock:
+        hit = _PROGRAMS.get((kind, label))
+    return dict(hit) if hit is not None else None
+
+
+def program_report(limit: Optional[int] = None) -> List[Dict[str, Any]]:
+    """Recorded programs ranked by temp (workspace) bytes."""
+    with _prog_lock:
+        rows = [{"kind": k, "label": lbl, **st}
+                for (k, lbl), st in _PROGRAMS.items()]
+    rows.sort(key=lambda r: -int(r.get("temp_bytes", 0)))
+    return rows[:limit] if limit else rows
+
+
+def _program_total(field: str) -> int:
+    with _prog_lock:
+        return sum(int(st.get(field, 0)) for st in _PROGRAMS.values())
+
+
+def register_cache_programs(owner: str, op, stats: Dict[str, dict]) -> None:
+    """Ledger the static footprint (temp + output bytes) of a signature
+    cache's compiled programs under ``serving_cache``, freed when the
+    owning CachedOp dies (model drained/undeployed) and refreshed
+    wholesale on each call (evicted signatures drop out)."""
+    try:
+        # trailing ':' keeps prefix matching exact — owner 'sigcache3'
+        # must not also claim 'sigcache30' entries
+        _LEDGER.drop_owner("serving_cache", owner + ":")
+        for digest, st in stats.items():
+            _LEDGER.set("serving_cache", (owner, digest),
+                        int(st.get("temp_bytes", 0)) +
+                        int(st.get("output_bytes", 0)),
+                        owner=f"{owner}:{digest}")
+        if not getattr(op, "_mem_finalized", False):
+            try:
+                op._mem_finalized = True
+                weakref.finalize(op, _LEDGER.drop_owner,
+                                 "serving_cache", owner + ":")
+            except Exception:
+                pass
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Pressure monitoring + forensics
+# ---------------------------------------------------------------------------
+
+_dump_seq = itertools.count(1)
+_budget_exceeded = [False]  # rising-edge latch; re-armed per fit() run
+
+
+def budget_bytes() -> int:
+    """MXTPU_MEM_BUDGET in bytes (0 = no budget)."""
+    try:
+        return int(env.get("MXTPU_MEM_BUDGET"))
+    except (TypeError, ValueError):
+        return 0
+
+
+def reset_pressure_state() -> None:
+    """Re-arm the budget-exceeded edge detector (one dump per run)."""
+    _budget_exceeded[0] = False
+
+
+def is_oom(exc: BaseException) -> bool:
+    """Does this exception look like a device allocation failure?"""
+    text = f"{type(exc).__name__}: {exc}"
+    return ("RESOURCE_EXHAUSTED" in text or "Out of memory" in text or
+            "out of memory" in text)
+
+
+def maybe_dump_oom(exc: BaseException, step: Optional[int] = None) -> bool:
+    """If ``exc`` is an allocation failure, write the forensics dump
+    (best-effort — a failed dump must not mask the OOM) and return True.
+    The ONE implementation of the dump-on-OOM protocol: ``oom_guard``
+    and ``fit.FitLoop``'s exception path both route here."""
+    if not (isinstance(exc, Exception) and is_oom(exc)):
+        return False
+    try:
+        dump_forensics("resource_exhausted", step=step,
+                       error=f"{type(exc).__name__}: {exc}")
+    except Exception:
+        pass
+    return True
+
+
+@contextlib.contextmanager
+def oom_guard(step_fn: Optional[Callable[[], Optional[int]]] = None):
+    """Re-raises everything, but an allocation failure
+    (``RESOURCE_EXHAUSTED``) first triggers a forensics dump — the
+    black-box recording written while the evidence is still live."""
+    try:
+        yield
+    except BaseException as e:  # noqa: B902 — inspect, always re-raise
+        try:
+            step = step_fn() if step_fn is not None else None
+        except Exception:
+            step = None
+        maybe_dump_oom(e, step=step)
+        raise
+
+
+def _dump_path() -> str:
+    d = str(env.get("MXTPU_MEM_DUMP_DIR") or "") or "."
+    try:
+        os.makedirs(d, exist_ok=True)
+    except OSError:
+        d = "."
+    return os.path.join(
+        d, f"mem_forensics_{os.getpid()}_{next(_dump_seq)}.json")
+
+
+def dump_forensics(reason: str, budget: Optional[int] = None,
+                   step: Optional[int] = None, path: Optional[str] = None,
+                   error: Optional[str] = None) -> str:
+    """Write the ranked memory diagnosis to a JSON file and return its
+    path: ledger categories and top live buffers (with owners),
+    per-program temp bytes, backend ``memory_stats`` and the recent trace
+    window — everything needed to name the allocation owners after an
+    OOM, without a debugger attached to the dead process."""
+    total = _LEDGER.live_bytes()
+    by_cat = _LEDGER.snapshot()
+    cats = [{"category": c, "bytes": n,
+             "share": round(n / total, 4) if total else 0.0}
+            for c, n in sorted(by_cat.items(), key=lambda kv: -kv[1])]
+    backend = {}
+    try:
+        from ..storage import memory_stats
+        backend = memory_stats() or {}
+    except Exception:
+        pass
+    trace_window: List[dict] = []
+    try:
+        from .tracer import tracer as _tr
+        trace_window = _tr.events()[-200:]
+    except Exception:
+        pass
+    payload = {
+        "reason": reason,
+        "error": error,
+        "time_unix": time.time(),
+        "pid": os.getpid(),
+        "step": step,
+        "budget_bytes": budget if budget is not None else
+        (budget_bytes() or None),
+        "live_bytes": total,
+        "peak_bytes": _LEDGER.peak_bytes,
+        "categories": cats,
+        "top_buffers": _LEDGER.top(20),
+        "programs": program_report(limit=20),
+        "backend_memory_stats": backend,
+        "trace_window": trace_window,
+    }
+    if path is None:
+        path = _dump_path()
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        # default=str: span args are producer-defined objects; a
+        # non-serializable one must degrade to its repr, not lose the dump
+        json.dump(payload, f, indent=1, default=str)
+    os.replace(tmp, path)
+    try:
+        from .registry import default_registry
+        default_registry().counter(
+            "mxtpu_mem_forensics_dumps_total",
+            "Memory forensics dumps written, by trigger.",
+            label="reason").inc(label_value=reason)
+    except Exception:
+        pass
+    try:
+        from ..log import get_logger
+        get_logger("mxnet_tpu.telemetry").warning(
+            "memory forensics (%s): live %d bytes, peak %d — dumped to %s",
+            reason, total, _LEDGER.peak_bytes, path)
+    except Exception:
+        pass
+    return path
+
+
+def check_pressure(step: Optional[int] = None, plan=None) -> Optional[str]:
+    """Per-step watermark check (called by ``fit.FitLoop`` at each step
+    end): fires a forensics dump when the deterministic ``mem_pressure``
+    chaos event is scheduled at this step, or — on the rising edge only —
+    when the step's ledger watermark exceeds ``MXTPU_MEM_BUDGET``.
+    Returns the dump path, or None."""
+    peak, _ = _LEDGER.window_stats()
+    peak = max(peak, _LEDGER.live_bytes())
+    dumped = None
+    if plan is not None:
+        b = None
+        try:
+            b = plan.mem_pressure_bytes()
+        except AttributeError:
+            pass
+        if b is not None and peak > b:
+            dumped = dump_forensics("chaos_mem_pressure", budget=b,
+                                    step=step)
+    budget = budget_bytes()
+    if budget > 0:
+        if peak > budget and not _budget_exceeded[0]:
+            _budget_exceeded[0] = True
+            dumped = dump_forensics("budget_exceeded", budget=budget,
+                                    step=step)
+        elif peak <= budget:
+            _budget_exceeded[0] = False
+    return dumped
+
+
+def reconcile(ctx=None) -> Dict[str, Any]:
+    """Cross-check the ledger against the backend allocator where one
+    reports (``storage.memory_stats``): the ledger is a lower bound of
+    ``bytes_in_use`` (XLA-internal temps are not framework objects).
+    Returns {"ledger_bytes", "backend_bytes_in_use", "backend_peak",
+    "consistent"}; backend fields are None on host-CPU backends."""
+    from ..storage import memory_stats
+    stats = memory_stats(ctx)
+    in_use = stats.get("bytes_in_use")
+    peak = stats.get("peak_bytes_in_use")
+    led = _LEDGER.live_bytes()
+    consistent = None
+    if in_use is not None:
+        consistent = led <= int(in_use) * 1.02 + (1 << 20)
+    return {"ledger_bytes": led,
+            "backend_bytes_in_use": int(in_use) if in_use is not None
+            else None,
+            "backend_peak": int(peak) if peak is not None else None,
+            "consistent": consistent}

@@ -405,8 +405,22 @@ def _device_stats_value(key_candidates: Tuple[str, ...]) -> float:
         return 0.0
 
 
+def _ledger_value(attr: str) -> float:
+    try:
+        from . import memory as _memory
+        led = _memory.ledger()
+        return float(led.live_bytes() if attr == "live" else led.peak_bytes)
+    except Exception:
+        return 0.0
+
+
 def _device_bytes_in_use() -> float:
     v = _device_stats_value(("bytes_in_use", "bytes_in_use_total"))
+    if v <= 0:
+        # host-CPU backends report no memory_stats: fall back to the
+        # framework's own live-byte ledger (exact for tracked categories)
+        # so these gauges stop reading 0 where tier-1 runs
+        v = _ledger_value("live")
     global _mem_peak
     if v > _mem_peak:
         _mem_peak = v
@@ -415,6 +429,8 @@ def _device_bytes_in_use() -> float:
 
 def device_memory_watermark() -> float:
     """Peak device bytes seen by any poll (backend-reported peak when
-    available, else the max over our own samples)."""
+    available, else the max over our own samples and the memory ledger's
+    process-lifetime peak)."""
     reported = _device_stats_value(("peak_bytes_in_use",))
-    return max(reported, _mem_peak, _device_bytes_in_use())
+    return max(reported, _mem_peak, _ledger_value("peak"),
+               _device_bytes_in_use())

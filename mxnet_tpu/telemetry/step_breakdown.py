@@ -43,6 +43,7 @@ from typing import Dict, List, Optional
 
 from ..base import env
 from ..log import get_logger
+from . import memory as _memory
 from .tracer import tracer as _tracer
 
 __all__ = ["SEGMENTS", "StepBreakdown", "segment", "current_breakdown"]
@@ -162,6 +163,11 @@ class StepBreakdown:
         self._totals: Dict[str, float] = defaultdict(float)
         self._wall_total = 0.0
         self._n_steps = 0
+        # per-step memory watermarks (parallel to `steps`, NOT folded into
+        # the segment records — those are second counts that sum against
+        # wall-clock; a byte count in there would break that contract)
+        self.mem_steps: deque = deque(maxlen=self.RECENT_STEPS)
+        self._mem_peak_run = 0
         self._cur: Dict[str, float] = defaultdict(float)
         self._step_t0: Optional[float] = None
         self._step_id: Optional[int] = None
@@ -204,6 +210,7 @@ class StepBreakdown:
             # step's row, which is the true cost of resuming there)
             self._last_marked_step = step
             _tracer.instant(f"step:{step}", "step")
+        _memory.ledger().begin_window()
         self._step_t0 = time.perf_counter()
 
     def _charge(self, name: str, seconds: float) -> None:
@@ -226,6 +233,24 @@ class StepBreakdown:
             for name, s in rec.items():
                 if name != "wall":
                     _tracer.counter_event(f"step_share:{name}", s / wall)
+        # memory axis: the ledger window opened in begin_step closes here.
+        # Kept OUT of the segment record (bytes vs seconds); the counter
+        # events give Perfetto a per-category memory track aligned with
+        # the step markers, and `device_memory_peak` is byte-identical to
+        # the per-step record FitResult publishes (test-enforced).
+        led = _memory.ledger()
+        mem_peak, mem_delta = led.window_stats()
+        if mem_peak > self._mem_peak_run:
+            self._mem_peak_run = mem_peak
+        self.mem_steps.append({"step": self._step_id,
+                               "peak_bytes": int(mem_peak),
+                               "delta_bytes": int(mem_delta),
+                               "live_bytes": int(led.live_bytes())})
+        if self._emit_counters and _tracer.enabled:
+            _tracer.counter_event("device_memory", led.snapshot(),
+                                  category="memory")
+            _tracer.counter_event("device_memory_peak", mem_peak,
+                                  category="memory")
         self._detect(rec, wall)
         self._step_t0 = None
         return rec
@@ -255,6 +280,12 @@ class StepBreakdown:
                     _LOG.warning(msg)
 
     # -- aggregate ------------------------------------------------------
+    def memory_summary(self) -> Dict[str, object]:
+        """Per-step memory watermarks (bounded recent window) + the run
+        peak, from the ledger windows opened/closed around each step."""
+        return {"peak_bytes": int(self._mem_peak_run),
+                "per_step": [dict(r) for r in self.mem_steps]}
+
     def summary(self) -> Dict[str, object]:
         """Aggregate over ALL recorded steps (running totals — not just
         the bounded recent window): total seconds and wall-clock shares
@@ -279,4 +310,5 @@ class StepBreakdown:
                          for rec in self.steps],
             "diagnoses": list(self.diagnoses),
             "actions": dict(self.actions),
+            "memory": self.memory_summary(),
         }

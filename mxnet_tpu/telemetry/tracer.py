@@ -209,15 +209,24 @@ class Tracer:
                 self._dropped += 1
             self._spans.append(ev)
 
-    def counter_event(self, name: str, value: float,
+    def counter_event(self, name: str, value,
                       category: str = "counter") -> None:
-        """Counter sample (chrome 'C' phase -> stacked area in Perfetto)."""
+        """Counter sample (chrome 'C' phase -> stacked area in Perfetto).
+
+        ``value`` may be a single number ({"value": v}) or a mapping of
+        series name -> number — Perfetto renders a multi-key args object
+        as one stacked counter track (the memory ledger's per-category
+        track uses this)."""
         if not self.wants(category):
             return
+        if isinstance(value, dict):
+            args = {str(k): float(v) for k, v in value.items()}
+        else:
+            args = {"value": float(value)}
         ev = {"name": name, "cat": category, "ph": "C",
               "ts": (time.perf_counter() - self._t0) * 1e6,
               "pid": self.rank, "tid": self._tid(),
-              "args": {"value": float(value)}}
+              "args": args}
         with self._lock:
             if len(self._spans) == self._spans.maxlen:
                 self._dropped += 1
@@ -339,7 +348,7 @@ def instant(name: str, category: str = "marker") -> None:
     tracer.instant(name, category)
 
 
-def counter_event(name: str, value: float,
+def counter_event(name: str, value,
                   category: str = "counter") -> None:
     tracer.counter_event(name, value, category)
 

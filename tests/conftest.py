@@ -45,3 +45,8 @@ def pytest_configure(config):
         "(telemetry/autotune.py probe-then-lock controller, "
         "comm/backward overlap, bench hygiene). Tier-1-safe: CPU, "
         "in-process, deterministic kv_slow chaos for comm-heavy steps.")
+    config.addinivalue_line(
+        "markers", "memory: device-memory observability tests "
+        "(telemetry/memory.py live-byte ledger, per-program "
+        "attribution, trace memory track, OOM forensics). Tier-1-safe: "
+        "CPU — the ledger is exact by construction there.")

@@ -62,6 +62,14 @@ def test_bench_tiny_deadline_emits_full_headline_json():
     # share shrinks, and the hidden time stays visible
     assert at["comm_share_after"] < at["comm_share_before"]
     assert at["comm_overlapped_share_after"] > 0
+    # the memory row: the device-byte attribution ZeRO-1 will be graded
+    # on must ship with the headline, not as a separate artifact
+    mrow = payload["memory"]
+    assert mrow["params_bytes"] > 0 and mrow["grads_bytes"] > 0
+    assert mrow["optimizer_bytes"] > 0 and mrow["masters_bytes"] > 0
+    assert mrow["grad_bucket_bytes"] > 0
+    assert mrow["step_peak_bytes"] >= mrow["params_bytes"]
+    assert mrow["programs"] > 0
 
 
 def test_bench_exhausted_deadline_still_emits_parseable_row():

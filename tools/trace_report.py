@@ -17,7 +17,13 @@ tool turns it back into the operator-facing tables without Perfetto:
   the innermost ``comm`` span would report hidden communication as
   exposed, the exact inversion of what the run measured;
 - the autotuner's protocol (category ``autotune``): per-candidate probe
-  spans and the ``autotune:lock {...}`` decision event.
+  spans and the ``autotune:lock {...}`` decision event;
+- the memory counter track: per-step ``peak``/``delta``/``live`` bytes
+  reconstructed from the ``device_memory`` / ``device_memory_peak``
+  counter ("C") events the step breakdown drops at each step end —
+  peak/live match ``FitResult.memory`` exactly; deltas are sample-to-
+  sample, so the first sampled step (no earlier baseline in the trace)
+  reports no delta rather than a fabricated 0.
 
 Pure stdlib on purpose — it must run on a laptop with nothing installed::
 
@@ -93,6 +99,12 @@ def step_table(events: List[dict]) -> List[Dict[str, Any]]:
     if not spans:
         return []
     end_ts = max(float(s["ts"]) + float(s["dur"]) for s in spans)
+    # the final step's memory counters are emitted at step END — after
+    # its last span closes — so the trace tail must stay inside the last
+    # step's bounds or the row loses its memory column
+    counter_ts = [float(e["ts"]) for e in events if e.get("ph") == "C"]
+    if counter_ts:
+        end_ts = max(end_ts, max(counter_ts) + 1.0)
     if not marks:
         bounds = [(None, min(float(s["ts"]) for s in spans), end_ts)]
     else:
@@ -105,8 +117,19 @@ def step_table(events: List[dict]) -> List[Dict[str, Any]]:
     # contiguous and ascending, so O(spans + steps) — a full 65536-span
     # ring with thousands of step markers must not take minutes
     spans.sort(key=lambda s: float(s["ts"]))
+    # the memory counter track: live samples (per-category args) and the
+    # per-step peak events the breakdown emits at each step end
+    mem_live = sorted(
+        (float(e["ts"]), sum(float(v) for v in e.get("args", {}).values()))
+        for e in events
+        if e.get("ph") == "C" and e.get("name") == "device_memory")
+    mem_peak = sorted(
+        (float(e["ts"]), float(e.get("args", {}).get("value", 0.0)))
+        for e in events
+        if e.get("ph") == "C" and e.get("name") == "device_memory_peak")
     rows = []
-    si = 0
+    si = mi = pi = 0
+    prev_live = None  # last live sample of the previous step (for delta)
     for label, t0, t1 in bounds:
         while si < len(spans) and float(spans[si]["ts"]) < t0:
             si += 1  # spans before the first marker are uncounted
@@ -114,9 +137,38 @@ def step_table(events: List[dict]) -> List[Dict[str, Any]]:
         while si < len(spans) and float(spans[si]["ts"]) < t1:
             segs[spans[si].get("cat", "default")] += spans[si]["excl"]
             si += 1
-        rows.append({"step": label, "wall_us": round(t1 - t0, 1),
-                     "segments": {k: round(v, 1)
-                                  for k, v in sorted(segs.items())}})
+        row = {"step": label, "wall_us": round(t1 - t0, 1),
+               "segments": {k: round(v, 1)
+                            for k, v in sorted(segs.items())}}
+        while mi < len(mem_live) and mem_live[mi][0] < t0:
+            mi += 1
+        first = last = None
+        while mi < len(mem_live) and mem_live[mi][0] < t1:
+            last = mem_live[mi][1]
+            if first is None:
+                first = last
+            mi += 1
+        while pi < len(mem_peak) and mem_peak[pi][0] < t0:
+            pi += 1
+        peak = None
+        while pi < len(mem_peak) and mem_peak[pi][0] < t1:
+            peak = max(peak or 0.0, mem_peak[pi][1])
+            pi += 1
+        if peak is not None or last is not None:
+            row["mem_peak_bytes"] = int(peak if peak is not None else last)
+        if last is not None:
+            # live/delta need live samples; a window holding only a peak
+            # event (ring-buffer drop boundary) reports peak alone rather
+            # than a fabricated live=0 and its bogus negative delta. The
+            # FIRST sampled window has no pre-step baseline either (one
+            # sample per step): its delta is unknowable offline and is
+            # omitted, not reported as 0
+            if prev_live is not None or last != first:
+                base = prev_live if prev_live is not None else first
+                row["mem_delta_bytes"] = int(last - base)
+            row["mem_live_bytes"] = int(last)
+            prev_live = last
+        rows.append(row)
     return rows
 
 
@@ -154,9 +206,12 @@ def _fmt_table(rows: List[Dict[str, Any]], limit: int) -> List[str]:
     cats = sorted({c for r in rows for c in r["segments"]})
     if not cats:
         return ["(no complete spans in trace)"]
+    has_mem = any("mem_peak_bytes" in r for r in rows)
     shown = rows[-limit:] if limit else rows
     head = f"{'step':>6} {'wall_ms':>9}" + "".join(
         f" {c[:14]:>14}" for c in cats)
+    if has_mem:
+        head += f" {'mem_peak_MB':>12} {'mem_Δ_MB':>10}"
     lines = [head, "-" * len(head)]
     for r in shown:
         wall = r["wall_us"]
@@ -165,8 +220,18 @@ def _fmt_table(rows: List[Dict[str, Any]], limit: int) -> List[str]:
             us = r["segments"].get(c, 0.0)
             share = us / wall if wall > 0 else 0.0
             cells.append(f"{us / 1e3:>8.2f}({share:>4.0%})")
-        lines.append(f"{str(r['step']):>6} {wall / 1e3:>9.2f}" +
-                     "".join(f" {cell:>14}" for cell in cells))
+        line = (f"{str(r['step']):>6} {wall / 1e3:>9.2f}" +
+                "".join(f" {cell:>14}" for cell in cells))
+        if has_mem:
+            if "mem_peak_bytes" in r:
+                line += f" {r['mem_peak_bytes'] / 2**20:>12.2f}"
+                if "mem_delta_bytes" in r:
+                    line += f" {r['mem_delta_bytes'] / 2**20:>+10.2f}"
+                else:
+                    line += f" {'-':>10}"
+            else:
+                line += f" {'-':>12} {'-':>10}"
+        lines.append(line)
     if len(shown) < len(rows):
         lines.append(f"... ({len(rows) - len(shown)} earlier steps "
                      "elided; use --steps 0 for all)")
