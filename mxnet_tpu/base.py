@@ -241,6 +241,28 @@ env.declare("MXTPU_MEM_DUMP_DIR", str, "",
             "Directory memory-forensics dumps are written to "
             "(mem_forensics_<pid>_<n>.json). Empty (default) = the "
             "current working directory.")
+env.declare("MXTPU_ZERO", str, "off",
+            "ZeRO-1 sharded optimizer state (parallel/zero.py): 'on' "
+            "replaces the bucketed gradient allreduce with a per-bucket "
+            "reduce-scatter (same _gbkt flat layout), steps only this "
+            "rank's parameter shard through the grouped donated-buffer "
+            "update (optimizer state + f32 multi_precision masters "
+            "materialize ~1/N per rank), and allgathers the updated "
+            "weights back per bucket. The fused finiteness sentinel is "
+            "AND-reduced across ranks before any shard applies; "
+            "checkpoints gather-on-save into the ordinary unsharded "
+            "format (topology-portable). Requires a kvstore and the "
+            "grouped update path (dense params, grouped-capable "
+            "optimizer, MXTPU_OPTIMIZER_AGGREGATION > 0) — anything else "
+            "raises rather than silently training unsharded. Unknown "
+            "values raise.")
+env.declare("MXTPU_ZERO_WORLD", int, 0,
+            "Simulated ZeRO-1 world size for single-worker runs: this "
+            "process plays all N ranks in sequence (same partition, "
+            "shard-aware ledger attribution, collective call pattern and "
+            "trajectory as a real N-rank group), so the parity/memory/"
+            "chaos suites run the N-rank protocol on one CPU process. "
+            "0/1 = no simulation; ignored when kvstore.num_workers > 1.")
 env.declare("MXTPU_PROFILE_BOUND_FRAC", float, 0.4,
             "Step-breakdown detector threshold: any non-compute segment "
             "(data_wait/h2d/comm/optimizer/checkpoint) whose share of "

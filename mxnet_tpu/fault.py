@@ -315,8 +315,13 @@ class CheckpointManager:
         trainer_states = None
         if trainer is not None:
             try:
-                trainer_states = trainer._updaters[0].get_states(
-                    dump_optimizer=False)
+                # prefer the trainer's topology-portable serialization: a
+                # ZeRO-1 run gathers its shards back into the ordinary
+                # unsharded dict here (gather-on-save), so every
+                # checkpoint restores at any world size
+                to_bytes = getattr(trainer, "get_states_bytes", None)
+                trainer_states = to_bytes() if to_bytes is not None else \
+                    trainer._updaters[0].get_states(dump_optimizer=False)
             except Exception:
                 # no in-memory snapshot API: synchronous write instead
                 self._write(step, host_params, trainer, extra)

@@ -67,6 +67,7 @@ class FitResult:
     step_breakdown: Optional[dict] = None  # telemetry summary (shares)
     tuning_report: Optional[dict] = None  # autotune protocol (MXTPU_AUTOTUNE)
     memory: Optional[dict] = None  # live-byte ledger summary + step peaks
+    zero: Optional[dict] = None  # ZeRO-1 plane summary (MXTPU_ZERO=1)
 
 
 class FitLoop:
@@ -433,6 +434,15 @@ class FitLoop:
             result.memory.update(bd.memory_summary())
         if tuner is not None:
             result.tuning_report = tuner.report()
+        plane = getattr(self._trainer, "_zero", None)
+        if plane:
+            # ZeRO-1 plane summary (world/ranks/shard size) next to the
+            # memory numbers it exists to shrink
+            result.zero = plane.describe()
+            _LOG.info("ZeRO-1: optimizer state sharded across %d rank(s) "
+                      "(this process: %s, %d/%d params)",
+                      result.zero["world"], result.zero["ranks"],
+                      result.zero["shard_params"], result.zero["params"])
         return result
 
     def _final_exit(self, cm, result: FitResult, epoch: int,

@@ -17,6 +17,16 @@ per key; the kvstore's retry/chaos hooks wrap each bucketed call, so fault
 semantics are preserved per bucket. Sparse (row_sparse) parameters and
 gradients always take the original per-key/per-param paths.
 
+ZeRO-1 sharded optimizer state (``MXTPU_ZERO=1``, parallel/zero.py): the
+same ``_gbkt`` flat buckets are **reduce-scattered** instead of
+allreduced, the grouped donated-buffer update steps only this rank's
+parameter shard (optimizer state + f32 masters materialize 1/N per
+rank), and the updated weights ride a per-bucket **allgather** back.
+The fused finiteness sentinel is AND-reduced across ranks before any
+shard applies, so a NaN anywhere skips the step everywhere and
+``rollback_step`` stays shard-local. See the plane's module docstring
+for partition/portability invariants.
+
 Comm/backward overlap (ref: the dependency engine scheduling each key's
 push as soon as its write dependency resolves — PAPER.md §engine,
 §KVStore): with ``MXTPU_COMM_OVERLAP=on`` the loop owner brackets
@@ -157,6 +167,16 @@ class Trainer:
         # dispatch-count regression test read these)
         self.last_update_dispatches = 0
         self.last_allreduce_collectives = 0
+        self.last_reduce_scatter_collectives = 0
+        self.last_allgather_collectives = 0
+        # ZeRO-1 plane: None = not yet resolved, False = off, else the
+        # live parallel.zero.ZeroPlane; _zero_step carries the plane from
+        # allreduce_grads (reduce-scatter ran) to the following _update;
+        # _zero_declined marks a sentinel decline whose classic fallback
+        # update() is the ONE sanctioned unsharded update under ZeRO
+        self._zero = None
+        self._zero_step = None
+        self._zero_declined = False
         self._last_fused_indices: List[int] = []
         self._last_fused_created: List[int] = []
         # bucket keys already init'ed on the kvstore (keyed by the full
@@ -251,6 +271,13 @@ class Trainer:
         # the typo silently train with the barrier path)
         active = _overlap_requested() and bool(self._kvstore_arg)
         if active:
+            from ..parallel import zero as _zero
+            if _zero.zero_requested():
+                # ZeRO-1 owns the comm plane: its reduce-scatter is a
+                # barrier op today, and an overlapped push/pull would
+                # ship a second (unsharded) copy of every bucket
+                active = False
+        if active:
             from ..contrib import chaos
             plan = chaos.active()
             if plan is not None and plan.poisons_step(
@@ -279,6 +306,20 @@ class Trainer:
         if not self._kv_initialized:
             self._init_kvstore()
         self.last_allreduce_collectives = 0
+        self.last_reduce_scatter_collectives = 0
+        self._zero_step = None
+        # a fresh comm round supersedes a stale un-consumed decline (the
+        # caller skipped that step's update): without this, the stale
+        # flag would sanction one later bare unsharded update()
+        self._zero_declined = False
+        plane = self._zero_plane()
+        if plane is not None:
+            # ZeRO-1: reduce-scatter the same buckets instead of
+            # allreduce; the following _update consumes the plane (shard
+            # update + weight allgather)
+            plane.reduce_scatter_grads(self)
+            self._zero_step = plane
+            return
         if self._kvstore is None:
             return
         from ..ndarray import sparse as _sp
@@ -301,6 +342,22 @@ class Trainer:
             self._allreduce_bucketed(flat_items, bucket_mb)
         if self.last_allreduce_collectives:
             _allreduce_counter().inc(self.last_allreduce_collectives)
+
+    def _zero_plane(self):
+        """The live ZeRO-1 plane, or None. Resolved once: ``MXTPU_ZERO``
+        is parsed strictly (typos raise), and a non-composable
+        configuration (no store, compression, ungrouped optimizer,
+        sparse params, aggregation off) raises at first use instead of
+        silently training unsharded."""
+        if self._zero is None:
+            from ..parallel import zero as _zero
+            if not _zero.zero_requested():
+                self._zero = False
+            else:
+                if not self._kv_initialized:
+                    self._init_kvstore()
+                self._zero = _zero.ZeroPlane(self)
+        return self._zero or None
 
     def _allreduce_rowsparse(self, i, g):
         """Cross-worker reduce of one row_sparse gradient. Single-process
@@ -350,7 +407,6 @@ class Trainer:
         returns ``(sig, flat_nd)`` with the split DEFERRED to the caller
         (overlap launches split after backward finishes); a singleton
         rides its per-param key, pulled in place, and returns None."""
-        from ..ndarray import ndarray as _nd
         if len(bucket) == 1:
             # a lone grad (or one larger than the cap) rides its own
             # already-initialized per-param key — no copy overhead
@@ -359,26 +415,8 @@ class Trainer:
             self._kvstore.pull(i, g)
             self.last_allreduce_collectives += 1
             return None
-        sig = tuple((g.shape, str(g._data.dtype)) for _, g in bucket)
-        flat = _flatten_fn()(*[g._data for _, g in bucket])
-        flat_nd = _nd.NDArray(flat, ctx=bucket[0][1]._ctx)
-        # memory ledger: the transient flat wire buffer is live from here
-        # until the split rebinds the per-param grads and it dies (the
-        # store keeps its own copy, ledgered by kvstore.init); freed by
-        # the NDArray's death, so donation/free accounting is automatic
-        _memory.track_ndarray("grad_buckets", flat_nd,
-                              owner=f"_gbkt{bid}:wire")
-        # the key encodes the bucket's FULL shape signature (digest):
-        # if the layout changes mid-run (a param frozen, the MB cap
-        # changed) a fresh key gets a fresh store buffer and a fresh
-        # compressor error-feedback residual — a stale key would push
-        # a differently-laid-out flat into old state. init() is a
-        # no-op when the key already exists; superseded keys linger in
-        # the store (bounded by layout changes, not steps).
-        import hashlib
-        digest = hashlib.md5(repr(sig).encode()).hexdigest()[:10]
-        key = (f"_gbkt{bid}:{sig[0][1]}:{int(flat.shape[0])}"
-               f":n{len(bucket)}:{digest}")
+        sig, key = self._bucket_sig_key(bid, bucket)
+        flat_nd = self._bucket_wire(key, bucket)
         if key not in self._bucket_keys:
             try:
                 # the flat wire buffer must NOT be row-sharded by the
@@ -394,6 +432,39 @@ class Trainer:
         self._kvstore.pull(key, out=flat_nd)
         self.last_allreduce_collectives += 1
         return sig, flat_nd
+
+    @staticmethod
+    def _bucket_sig_key(bid, bucket):
+        """(signature, stable store key) of one dense gradient bucket.
+        The key encodes the bucket's FULL shape signature (digest): if
+        the layout changes mid-run (a param frozen, the MB cap changed) a
+        fresh key gets a fresh store buffer and a fresh compressor
+        error-feedback residual — a stale key would push a
+        differently-laid-out flat into old state. Shared by the allreduce
+        path and the ZeRO-1 reduce-scatter/allgather plane, so BOTH comm
+        modes see one ``_gbkt*`` layout per step."""
+        import hashlib
+        sig = tuple((g.shape, str(g._data.dtype)) for _, g in bucket)
+        total = sum(int(g.size) for _, g in bucket)
+        digest = hashlib.md5(repr(sig).encode()).hexdigest()[:10]
+        return sig, (f"_gbkt{bid}:{sig[0][1]}:{total}"
+                     f":n{len(bucket)}:{digest}")
+
+    @staticmethod
+    def _bucket_wire(key, bucket):
+        """Flatten one dense bucket into its transient flat wire buffer.
+        The NDArray is ledgered under ``grad_buckets`` and lives until
+        the split (or reduce-scatter slicing) rebinds the per-param grads
+        and it dies — freed by the NDArray's death, so donation/free
+        accounting is automatic. Shared by the allreduce push path and
+        the ZeRO-1 reduce-scatter, so both comm modes' memory attribution
+        stays identical."""
+        from ..ndarray import ndarray as _nd
+        flat = _flatten_fn()(*[g._data for _, g in bucket])
+        flat_nd = _nd.NDArray(flat, ctx=bucket[0][1]._ctx)
+        _memory.track_ndarray("grad_buckets", flat_nd,
+                              owner=f"{key.split(':')[0]}:wire")
+        return flat_nd
 
     @staticmethod
     def _split_bucket(bucket, sig, flat_nd):
@@ -461,6 +532,25 @@ class Trainer:
         self._last_fused_created = []
 
     def _update(self, ignore_stale_grad=False, sentinel=False):
+        plane = self._zero_step
+        self._zero_step = None
+        if plane is not None:
+            return self._update_zero(plane, ignore_stale_grad, sentinel)
+        declined = self._zero_declined
+        self._zero_declined = False
+        if not declined and self._zero_plane() is not None:
+            # MXTPU_ZERO=1 but no reduce-scatter preceded this update:
+            # stepping every parameter here would silently materialize
+            # FULL optimizer state (and, in a worker group, consume
+            # unreduced local gradients) — the exact degradation the
+            # plane's strictness contract forbids. The one sanctioned
+            # classic fallback is the sentinel's simulated-world decline,
+            # flagged above.
+            raise MXNetError(
+                "MXTPU_ZERO=1: update() without a preceding "
+                "allreduce_grads() reduce-scatter would apply an "
+                "unsharded update. Call step(), or allreduce_grads() "
+                "before update(), or unset MXTPU_ZERO.")
         updater = self._updaters[0]
         live = [(i, p) for i, p in enumerate(self._params)
                 if p.grad_req != "null"]
@@ -531,13 +621,109 @@ class Trainer:
             _update_dispatch_counter().inc(self.last_update_dispatches)
         return flag
 
+    def _update_zero(self, plane, ignore_stale_grad, sentinel):
+        """ZeRO-1 back half (the reduce-scatter already ran in
+        allreduce_grads): shard-local grouped update guarded by the
+        GLOBAL finiteness verdict, then the per-bucket weight allgather.
+        Only this rank's parameters touch optimizer state; everyone
+        else's updated weights arrive through the allgather."""
+        import jax
+        updater = self._updaters[0]
+        self.last_update_dispatches = 0
+        self.last_allgather_collectives = 0
+        self._last_fused_indices = []
+        self._last_fused_created = []
+        live = [(i, p) for i, p in enumerate(self._params)
+                if p.grad_req != "null"]
+        stale = [] if ignore_stale_grad else \
+            [p.name for _, p in live if not p._fresh_grad]
+        if stale and sentinel and not plane.distributed:
+            # decline exactly like the unsharded fused path
+            # (Trainer._update's stale pre-scan): the caller's classic
+            # fallback host-checks the locally-complete reduced grads
+            # and reproduces the old skip-before-stale-raise ordering
+            self._zero_declined = True
+            return None
+        flag = plane.global_finite_flag(live) if sentinel else None
+        if stale:
+            if sentinel:
+                # distributed: the flag is already global — reproduce the
+                # classic ordering (a non-finite step skips silently, a
+                # finite one surfaces the stale error on every rank)
+                if not bool(jax.device_get(flag)):
+                    return flag
+            raise MXNetError(
+                f"gradient of parameter(s) {stale[:4]} is stale (not "
+                "updated by backward since the last step). This "
+                "usually means the parameter was unused in the loss, "
+                "or step() ran twice per backward. Call backward "
+                "first, or pass ignore_stale_grad=True to skip stale "
+                "parameters. No update was applied.")
+        todo = [(i, p) for i, p in live if p._fresh_grad]
+        if not todo:
+            if sentinel and not plane.distributed:
+                # decline: the caller's classic fallback is sanctioned —
+                # ONLY here; arming the flag on a non-sentinel call would
+                # hand a later buggy bare update() an unsharded bypass
+                self._zero_declined = True
+                return None
+            return flag
+        agg = max(1, _grouped.aggregation_size())
+        handled, created, n_disp = [], [], 0
+        for r in plane.my_ranks:
+            items = [(i, p) for i, p in todo if plane.owner(i) == r]
+            if not items:
+                continue
+            idxs, n, _f, cr = _grouped.grouped_update(
+                updater, items, agg, sentinel=sentinel,
+                sentinel_flag=flag)
+            handled += idxs
+            created += cr
+            n_disp += n
+        if sentinel:
+            n_disp += 1  # the fused finite reduction
+            self._last_fused_indices = handled
+            self._last_fused_created = created
+        # allgather of the (where-guarded) updated weights: wire time is
+        # charged to 'comm' so StepBreakdown/trace_report attribute it,
+        # even though the call runs inside the optimizer phase
+        with _bd_segment("comm"):
+            plane.allgather_weights(self)
+        for _i, p in todo:
+            p._fresh_grad = False
+        self.last_update_dispatches = n_disp
+        if n_disp:
+            _update_dispatch_counter().inc(n_disp)
+        return flag
+
+    def get_states_bytes(self) -> bytes:
+        """Serialized optimizer state in the TOPOLOGY-PORTABLE unsharded
+        format: under ZeRO-1 the shards are gathered back into one full
+        state dict (gather-on-save), so the bytes restore into any world
+        size — including an unsharded run. CheckpointManager routes
+        through here."""
+        plane = self._zero_plane()
+        if plane is not None:
+            return plane.gather_states_bytes(self._updaters[0])
+        return self._updaters[0].get_states(dump_optimizer=False)
+
+    def set_states_bytes(self, data: bytes) -> None:
+        """Restore from the unsharded format; under distributed ZeRO-1
+        the local shard view is re-derived (non-local slots pruned before
+        they ever touch device memory or the ledger)."""
+        plane = self._zero_plane()
+        keep = None
+        if plane is not None and plane.distributed:
+            keep = plane.local_indices()
+        self._updaters[0].set_states(data, keep=keep)
+
     def save_states(self, fname):
         with open(fname, "wb") as f:
-            f.write(self._updaters[0].get_states(dump_optimizer=False))
+            f.write(self.get_states_bytes())
 
     def load_states(self, fname):
         with open(fname, "rb") as f:
-            self._updaters[0].set_states(f.read())
+            self.set_states_bytes(f.read())
 
 
 class _OverlapScope:

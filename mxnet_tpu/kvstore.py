@@ -369,6 +369,59 @@ class KVStoreBase:
             else:
                 o._rebind(rows._data)
 
+    # -- ZeRO-1 plane ops (parallel/zero.py) ---------------------------
+    # Same per-key discipline as push/pull: one _traced_retry (comm span
+    # + TransientKVError backoff) and one _chaos_kv entry per bucket key,
+    # so kv_flake/kv_slow exercise the sharded collectives identically.
+    # All three are PURE reads of their inputs — no store mutation — so a
+    # retried flake can never double-apply a shard update.
+
+    def zero_reduce_scatter(self, key, value, parts):
+        """Reduce the flat ``_gbkt`` wire buffer ``value`` across workers
+        and return the reduced ``[lo, hi)`` slices named by ``parts``
+        (this rank's parameter-aligned shard segments) as NDArrays.
+        Single-worker stores: the local gradient already IS the group sum
+        (the merge ran at flatten time), so the reduce is identity and
+        only the slicing remains — the simulated-world semantics."""
+        out: List[_nd.NDArray] = []
+
+        def run():
+            out.clear()
+            _chaos_kv("reduce_scatter", key)
+            out.extend(self._zero_reduce_scatter_impl(key, value, parts))
+        _traced_retry("reduce_scatter", key, run)
+        return out
+
+    def _zero_reduce_scatter_impl(self, key, value, parts):
+        data = value._data
+        return [_nd.NDArray(data[lo:hi], ctx=value._ctx)
+                for lo, hi in parts]
+
+    def zero_allgather(self, key, payloads):
+        """Allgather the per-rank updated-weight segments of one bucket:
+        ``payloads`` maps rank -> flat NDArray (a real group contributes
+        exactly its own rank; a simulated world contributes every rank's).
+        Returns rank -> array for ALL ranks. Single-worker stores echo
+        the payloads back — a chaos/retry-covered identity, so the
+        simulated protocol exercises the same fault surface."""
+        out: Dict[int, Any] = {}
+
+        def run():
+            out.clear()
+            _chaos_kv("allgather", key)
+            out.update(self._zero_allgather_impl(key, payloads))
+        _traced_retry("allgather", key, run)
+        return out
+
+    def _zero_allgather_impl(self, key, payloads):
+        return {r: v._data for r, v in payloads.items()}
+
+    def zero_all_finite(self, ok: bool) -> bool:
+        """AND-reduce the shard-local all-grads-finite verdict across the
+        worker group (single worker: identity). Runs BEFORE any shard
+        applies its update, so a NaN on one rank skips the step on all."""
+        return bool(ok)
+
     # -- optimizer / updater -------------------------------------------
     def set_updater(self, updater) -> None:
         self._updater = updater
@@ -509,6 +562,36 @@ class KVStoreDistTPU(KVStoreBase):
         out = cross_process_allreduce(merged.asnumpy(), self._mesh,
                                       axis="hosts")
         return _nd.array(out, ctx=merged._ctx)
+
+    def _zero_reduce_scatter_impl(self, key, value, parts):
+        if self._mesh is None:
+            return super()._zero_reduce_scatter_impl(key, value, parts)
+        from .parallel.collectives import cross_process_reduce_scatter
+        slices = cross_process_reduce_scatter(value.asnumpy(), self._mesh,
+                                              parts, axis="hosts")
+        return [_nd.array(s, ctx=value._ctx) for s in slices]
+
+    def _zero_allgather_impl(self, key, payloads):
+        if self._mesh is None:
+            return super()._zero_allgather_impl(key, payloads)
+        check(len(payloads) == 1,
+              "distributed zero_allgather takes exactly this rank's "
+              "payload")
+        import numpy as _np
+        from .parallel.collectives import cross_process_allgather_object
+        ((_r, v),) = payloads.items()
+        outs = cross_process_allgather_object(_np.asarray(v._data), "zag")
+        return dict(enumerate(outs))
+
+    def zero_all_finite(self, ok: bool) -> bool:
+        if self._mesh is None:
+            return bool(ok)
+        import numpy as _np
+        from .parallel.collectives import cross_process_allreduce
+        total = cross_process_allreduce(
+            _np.asarray([1.0 if ok else 0.0], _np.float32), self._mesh,
+            axis="hosts")
+        return float(_np.asarray(total)[0]) >= self._nproc - 0.5
 
     def barrier(self) -> None:
         from .parallel.collectives import barrier as _barrier

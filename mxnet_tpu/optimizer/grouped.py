@@ -408,7 +408,7 @@ def _devices_key(arr) -> Tuple:
 
 
 def grouped_update(updater, items, agg_size: int, sentinel: bool = False,
-                   sentinel_grads=None):
+                   sentinel_grads=None, sentinel_flag=None):
     """Apply one aggregated optimizer step to ``items`` ([(index, Parameter)]
     with fresh dense gradients).
 
@@ -417,6 +417,11 @@ def grouped_update(updater, items, agg_size: int, sentinel: bool = False,
     stale param skipped under ``ignore_stale_grad`` still poisons the
     classic host check, so it must poison the fused flag identically).
     Defaults to the items' own grads.
+
+    ``sentinel_flag``: a precomputed all-finite verdict that REPLACES the
+    local fused reduction — the ZeRO-1 path passes the cross-rank
+    AND-reduced global flag here, so every rank's shard update is guarded
+    by the same verdict (a NaN anywhere skips the step everywhere).
 
     Returns ``(handled_indices, n_dispatches, finite_flag, created)``
     where ``finite_flag`` is a device scalar when ``sentinel`` and None
@@ -477,9 +482,12 @@ def grouped_update(updater, items, agg_size: int, sentinel: bool = False,
 
     flag = None
     if sentinel:
-        if sentinel_grads is None:
-            sentinel_grads = tuple(p._grad._data for _, p in items)
-        flag = global_finite_flag(tuple(sentinel_grads))
+        if sentinel_flag is not None:
+            flag = jnp.asarray(sentinel_flag)
+        else:
+            if sentinel_grads is None:
+                sentinel_grads = tuple(p._grad._data for _, p in items)
+            flag = global_finite_flag(tuple(sentinel_grads))
 
     rescale = jnp.asarray(float(opt.rescale_grad), dtype=jnp.float32)
     statics_key = rule.statics(opt)

@@ -611,11 +611,16 @@ class Updater:
         self.optimizer.update_multi_precision(index, weight, grad,
                                               self.states[index])
 
-    def get_states(self, dump_optimizer=False):
-        return pickle.dumps((self.states, self.optimizer)
-                            if dump_optimizer else self.states)
+    def get_states(self, dump_optimizer=False, indices=None):
+        """``indices``: restrict the pickle to a subset of state slots —
+        a ZeRO-1 rank ships only its shard into the gather-on-save
+        merge. None (default) pickles everything this updater holds."""
+        states = self.states if indices is None else \
+            {i: s for i, s in self.states.items() if i in indices}
+        return pickle.dumps((states, self.optimizer)
+                            if dump_optimizer else states)
 
-    def set_states(self, states):
+    def set_states(self, states, keep=None):
         # the pre-replacement optimizer's param_dict is the only weight-
         # dtype source once dump_optimizer=True swaps in an unpickled
         # optimizer (whose param_dict pickles away to {})
@@ -627,6 +632,12 @@ class Updater:
             self.states, self.optimizer = states
         else:
             self.states = states
+        if keep is not None:
+            # shard view re-derived on restore: a ZeRO-1 rank loads the
+            # full topology-portable dict, then keeps only its own slots
+            # (the dropped ones never touch the ledger below)
+            self.states = {i: s for i, s in self.states.items()
+                           if i in keep}
         # checkpoint restore replaces the state dict wholesale: drop the
         # OLD dict's entries first (an index absent from the restored
         # dict must not keep phantom bytes), then re-ledger every

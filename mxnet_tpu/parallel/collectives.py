@@ -17,7 +17,8 @@ from ..base import MXNetError, check
 
 __all__ = ["allreduce", "allgather", "reduce_scatter", "broadcast",
            "ppermute_ring", "all_to_all", "barrier", "device_allreduce",
-           "measure_allreduce_bandwidth"]
+           "measure_allreduce_bandwidth", "cross_process_reduce_scatter",
+           "cross_process_exchange_bytes", "cross_process_allgather_object"]
 
 
 def _jax():
@@ -217,6 +218,78 @@ def _cross_process_gather_fn(mesh, axis, ndim):
     return jax.jit(shard_map(f, mesh=mesh, in_specs=(P(axis),),
                              out_specs=P(*([None] * (ndim + 1))),
                              check_vma=False))
+
+
+def cross_process_reduce_scatter(local, mesh, parts, axis: str = "hosts",
+                                 op: str = "sum"):
+    """Reduce per-PROCESS flat buffers element-wise and return only the
+    ``[lo, hi)`` slices named by ``parts`` — the ZeRO-1 gradient plane:
+    each rank keeps exactly the reduced segments its optimizer shard
+    consumes. All ranks must call per the usual SPMD collective contract
+    (same buffer shape, each with its own ``parts``).
+
+    Coord fallback (multiprocess CPU): exchange + host reduce + slice —
+    functional parity on the transport every CPU-backend collective
+    already rides. XLA path: psum + slice (parts are parameter-granular
+    and ragged; a true tiled ``psum_scatter`` needs equal tiles, so the
+    bandwidth-optimal form is future work on real meshes)."""
+    import jax
+    import numpy as np
+    nproc = mesh.devices.size
+    check(nproc == jax.process_count(),
+          f"cross_process_reduce_scatter needs a one-device-per-process "
+          f"mesh (make_host_mesh); got {nproc} devices for "
+          f"{jax.process_count()} processes")
+    check(op == "sum", f"unsupported reduce-scatter op {op!r}")
+    local = np.asarray(local)
+    if _use_coord_fallback():
+        bufs = _coord_exchange(local, f"rs{next(_coord_seq)}")
+        total = bufs[0].copy()
+        for b in bufs[1:]:
+            total = total + b
+        return [total[lo:hi] for lo, hi in parts]
+    full = cross_process_allreduce(local, mesh, axis=axis, op=op)
+    return [np.asarray(full[lo:hi]) for lo, hi in parts]
+
+
+def cross_process_exchange_bytes(payload: bytes, tag: str):
+    """Publish this rank's byte payload under ``tag`` and fetch every
+    rank's (rank-indexed list). Rides the jax.distributed coordination-
+    service KV store — the transport for RAGGED payloads (pickled
+    optimizer-state shards, per-rank weight segments) that the
+    fixed-shape array collectives cannot carry. Same contract as
+    :func:`_coord_exchange`: all ranks call with the same tag sequence."""
+    import jax
+    client = _coord_client()
+    rank, nproc = jax.process_index(), jax.process_count()
+    prefix = f"mxtpu_coll/{tag}"
+    client.key_value_set_bytes(f"{prefix}/{rank}", payload)
+    outs = []
+    for r in range(nproc):
+        if r == rank:
+            outs.append(payload)
+            continue
+        outs.append(bytes(client.blocking_key_value_get_bytes(
+            f"{prefix}/{r}", _COORD_TIMEOUT_MS)))
+    client.wait_at_barrier(f"{prefix}/done", _COORD_TIMEOUT_MS)
+    if rank == 0:
+        for r in range(nproc):
+            try:
+                client.key_value_delete(f"{prefix}/{r}")
+            except Exception:
+                pass
+    return outs
+
+
+def cross_process_allgather_object(obj, tag_prefix: str = "obj"):
+    """Ragged allgather of one picklable object per rank (rank-indexed
+    list) over the coordination-service byte channel — the ZeRO-1 weight
+    allgather hop (per-rank segment sizes differ, so the tiled XLA
+    all_gather cannot carry them)."""
+    import pickle
+    blobs = cross_process_exchange_bytes(
+        pickle.dumps(obj), f"{tag_prefix}{next(_coord_seq)}")
+    return [pickle.loads(b) for b in blobs]
 
 
 def device_allreduce(arrays, mesh, axis: str = "dp", op: str = "sum"):

@@ -386,12 +386,23 @@ def track_optimizer_state(updater, index, state, param=None,
                 hasattr(state[1], "_data") and \
                 wdt is not None and wdt != "float32":
             inner, master = state
+        # shard-aware owners: a ZeRO-1 plane stamps the updater with its
+        # partition map (parallel/zero.py), and every state entry carries
+        # the owning rank — per-rank optimizer/masters bytes become a
+        # queryable prefix ('state:zr<r>/<N>:') the 1/N claim is
+        # test-enforced against
+        shard = ""
+        zs = getattr(updater, "_zero_shard", None)
+        if zs:
+            tag = zs.get(index)
+            if tag is not None:
+                shard = f"zr{tag}:"
         inner_bytes = sum(nd_bytes(a) for a in _state_arrays(inner))
         _LEDGER.set("optimizer", (utok, index), inner_bytes,
-                    owner=f"state:{name}")
+                    owner=f"state:{shard}{name}")
         if master is not None:
             _LEDGER.set("masters", (utok, index), nd_bytes(master),
-                        owner=f"master:{name}")
+                        owner=f"master:{shard}{name}")
         else:
             _LEDGER.drop("masters", (utok, index))
     except Exception:

@@ -46,6 +46,12 @@ def pytest_configure(config):
         "comm/backward overlap, bench hygiene). Tier-1-safe: CPU, "
         "in-process, deterministic kv_slow chaos for comm-heavy steps.")
     config.addinivalue_line(
+        "markers", "zero: ZeRO-1 sharded-optimizer-state tests "
+        "(parallel/zero.py reduce-scatter / shard-update / allgather "
+        "plane, global sentinel, topology-portable checkpoints). "
+        "Tier-1-safe: CPU, simulated worlds in-process plus one "
+        "2-process coordination-service subprocess test.")
+    config.addinivalue_line(
         "markers", "memory: device-memory observability tests "
         "(telemetry/memory.py live-byte ledger, per-program "
         "attribution, trace memory track, OOM forensics). Tier-1-safe: "

@@ -70,6 +70,18 @@ def test_bench_tiny_deadline_emits_full_headline_json():
     assert mrow["grad_bucket_bytes"] > 0
     assert mrow["step_peak_bytes"] >= mrow["params_bytes"]
     assert mrow["programs"] > 0
+    # the zero row: per-rank optimizer+masters bytes must land at 1/world
+    # of the unsharded mp-Adam baseline (equal-sized params, ledger-exact)
+    zrow = payload["zero"]
+    assert zrow["world"] == 4
+    assert zrow["unsharded_opt_masters_bytes"] > 0
+    assert zrow["zero_rank0_opt_masters_bytes"] == \
+        zrow["unsharded_opt_masters_bytes"] // zrow["world"]
+    assert zrow["zero_total_opt_masters_bytes"] == \
+        zrow["unsharded_opt_masters_bytes"]
+    assert abs(zrow["rank0_share"] - 1.0 / zrow["world"]) < 0.01
+    assert zrow["step_ms_zero"] > 0 and zrow["step_ms_unsharded"] > 0
+    assert zrow["zero_collectives_per_step"] >= 2  # rs + ag per bucket
 
 
 def test_bench_exhausted_deadline_still_emits_parseable_row():
