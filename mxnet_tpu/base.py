@@ -73,6 +73,18 @@ class EnvRegistry:
         raw = os.environ.get(name)
         return raw if raw is not None else default
 
+    def raw(self, name: str) -> Optional[str]:
+        """Uncoerced read: the raw environment string, or None when unset.
+
+        For save/restore plumbing (the autotuner snapshots knobs it is
+        about to vary), error messages that must echo the un-parseable
+        original, and third-party variables (``JAX_PLATFORMS``) that are
+        not ours to declare or coerce. This — not ``os.environ`` — is the
+        sanctioned escape hatch: graftcheck's env-discipline rule flags
+        every direct environ read outside this module.
+        """
+        return os.environ.get(name)
+
     def items(self):
         for name, (typ, default, doc) in sorted(self._defaults.items()):
             yield name, typ, self.get(name), doc
@@ -263,6 +275,39 @@ env.declare("MXTPU_ZERO_WORLD", int, 0,
             "trajectory as a real N-rank group), so the parity/memory/"
             "chaos suites run the N-rank protocol on one CPU process. "
             "0/1 = no simulation; ignored when kvstore.num_workers > 1.")
+env.declare("MXTPU_COORDINATOR", str, "",
+            "host:port of the jax.distributed coordinator; set per worker "
+            "by tools/launch.py. Empty = single-process run "
+            "(kvstore_server.init_distributed is a no-op).")
+env.declare("MXTPU_NUM_WORKERS", int, 1,
+            "Process count of the distributed group (tools/launch.py).")
+env.declare("MXTPU_WORKER_ID", int, 0,
+            "This process's rank in the distributed group "
+            "(tools/launch.py); also stamps telemetry trace events.")
+env.declare("MXTPU_WORKER_HOSTS", str, "",
+            "Comma-separated worker hostnames in rank order "
+            "(tools/launch.py placement); resolves each rank's "
+            "command-channel endpoint. Empty = loopback.")
+env.declare("MXTPU_CMD_PORT_BASE", int, 0,
+            "Base TCP port of the per-worker command channel (port = "
+            "base + rank). 0 = derive from the coordinator port + 100.")
+env.declare("MXTPU_CMD_TOKEN", str, "",
+            "Shared job token every worker command must carry "
+            "(tools/launch.py generates one per job). Empty = command "
+            "endpoints bind loopback only.")
+env.declare("MXTPU_LIBRARY_PATH", str, "",
+            "Explicit path to the native engine shared library "
+            "(libinfo.find_lib_path); empty = search the package dirs.")
+env.declare("MXNET_ENGINE_BULK_SIZE", int, 15,
+            "Engine bulk-execution window size (ref: the reference's "
+            "MXNET_ENGINE_BULK_SIZE); read/written through the C API "
+            "bridge's MXEngineSetBulkSize.")
+env.declare("DMLC_ROLE", str, "worker",
+            "Launcher-assigned process role (worker|server|scheduler), "
+            "reference ps-lite parity; read by the C API role queries.")
+env.declare("DMLC_RANK", int, 0,
+            "Launcher-assigned rank (reference ps-lite parity); used to "
+            "tag per-rank checkpoint state in mx.fault.")
 env.declare("MXTPU_PROFILE_BOUND_FRAC", float, 0.4,
             "Step-breakdown detector threshold: any non-compute segment "
             "(data_wait/h2d/comm/optimizer/checkpoint) whose share of "

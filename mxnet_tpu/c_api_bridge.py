@@ -970,8 +970,8 @@ def kvstore_set_updater_str(kv, cb_addr: int, cb_ctx: int = 0) -> None:
 
 
 def kvstore_role_flags():
-    import os
-    role = os.environ.get("DMLC_ROLE", "worker")
+    from .base import env
+    role = env.get("DMLC_ROLE")
     return (int(role == "worker"), int(role == "server"),
             int(role == "scheduler"))
 
@@ -1215,7 +1215,9 @@ def set_num_omp_threads(n: int) -> None:
 
 def engine_set_bulk_size(size: int) -> int:
     import os
-    prev = int(os.environ.get("MXNET_ENGINE_BULK_SIZE", "15"))
+
+    from .base import env
+    prev = int(env.get("MXNET_ENGINE_BULK_SIZE"))
     os.environ["MXNET_ENGINE_BULK_SIZE"] = str(int(size))
     return prev
 

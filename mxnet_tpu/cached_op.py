@@ -279,9 +279,11 @@ class _CachedOpGrad:
         import jax
         entry = self.entry
         if entry.vjp_jitted is None:
-            from .util import apply_mirror
+            from .util import mirror_wrapper
             fn = self.op._make_pure_fn(self.training, entry)
-            mirror = self.op.mirror
+            # remat decision resolved HERE (host side, once per compiled
+            # backward), not inside the traced run() (graftcheck GC-T03)
+            mirror = mirror_wrapper(self.op.mirror)
 
             def run(params, key, ins, cots):
                 def outputs_only(params_, *ins_):
@@ -290,7 +292,7 @@ class _CachedOpGrad:
 
                 # mirror/remat: store only the inputs across fwd->bwd and
                 # recompute activations inside the backward program
-                outputs_only = apply_mirror(outputs_only, mirror)
+                outputs_only = mirror(outputs_only)
                 _, vjp = jax.vjp(outputs_only, params, *ins)
                 return vjp(tuple(cots))
 

@@ -408,7 +408,7 @@ def active() -> Optional[ChaosPlan]:
     (keeps monkeypatched tests honest); a programmatic plan sticks until
     :func:`uninstall`."""
     global _plan
-    spec = os.environ.get("MXTPU_CHAOS") or None
+    spec = env.get("MXTPU_CHAOS") or None
     if _plan is not None:
         if _plan._env_spec is not None and spec != _plan._env_spec:
             _plan = ChaosPlan(spec, _env_spec=spec) if spec else None

@@ -24,7 +24,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from .base import MXNetError, check
+from .base import MXNetError, check, env
 from .log import get_logger
 from . import ndarray as nd
 
@@ -132,7 +132,7 @@ class Heartbeat:
                  interval: float = 5.0):
         self._dir = dir_path
         if rank is None:
-            rank = int(os.environ.get("DMLC_RANK", "0"))
+            rank = int(env.get("DMLC_RANK"))
         self._rank = int(rank)
         self._interval = float(interval)
         self._stop = threading.Event()
@@ -220,7 +220,10 @@ def is_recovery() -> bool:
     """Rejoin-after-failure flag (ref: ps::Postoffice::is_recovery, set on
     relaunched nodes; here via the MXNET_IS_RECOVERY env the relauncher
     sets)."""
-    return os.environ.get("MXNET_IS_RECOVERY", "0") not in ("0", "", "false")
+    # routed through the declared registry: bool coercion treats "0",
+    # "", "false" AND "False" as unset — the direct read this replaces
+    # counted "False" as truthy (graftcheck GC-E01 surfaced it)
+    return bool(env.get("MXNET_IS_RECOVERY"))
 
 
 class CheckpointManager:

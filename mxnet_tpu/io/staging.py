@@ -27,9 +27,15 @@ __all__ = ["DeviceStagingIter"]
 _STAGE_KEYS = itertools.count(1)
 
 
-def _drop_keys(keys):
+def _drop_keys(led, keys):
+    # ``led`` is the MemoryLedger captured at construction — NOT fetched
+    # via _memory.ledger() here: this runs from weakref.finalize, which
+    # cyclic GC can fire synchronously on a thread mid-way through
+    # ledger()'s first-use metrics installation (plain _install_lock and
+    # the registry locks held) — calling back into that path from the
+    # finalizer would self-deadlock. MemoryLedger.drop itself is
+    # finalizer-safe by contract (RLock). Surfaced by graftcheck GC-L03.
     try:
-        led = _memory.ledger()
         for key in keys:
             led.drop("staging", key)
     except Exception:
@@ -66,8 +72,10 @@ class DeviceStagingIter(DataIter):
         self._staged_keys: list = []  # parallel memory-ledger keys
         self._exhausted = False
         # an iterator abandoned mid-epoch must not leak its staged bytes
+        # (ledger resolved NOW, outside any finalizer context)
+        self._ledger = _memory.ledger()
         import weakref
-        weakref.finalize(self, _drop_keys, self._staged_keys)
+        weakref.finalize(self, _drop_keys, self._ledger, self._staged_keys)
 
     @property
     def depth(self) -> int:

@@ -21,10 +21,11 @@ C API's MXKVStoreSendCommmandToServers ride on it.
 from __future__ import annotations
 
 import json
-import os
 import socket
 import struct
 import threading
+
+from .base import env
 
 __all__ = ["init_distributed", "KVStoreServer", "_init_kvstore_server_module",
            "start_command_server", "send_command", "worker_command_address"]
@@ -35,10 +36,10 @@ def init_distributed() -> bool:
 
     Returns True if a multi-process group was joined.
     """
-    coord = os.environ.get("MXTPU_COORDINATOR")
-    nproc = int(os.environ.get("MXTPU_NUM_WORKERS", "1"))
-    rank = int(os.environ.get("MXTPU_WORKER_ID", "0"))
-    if coord is None or nproc <= 1:
+    coord = env.get("MXTPU_COORDINATOR")
+    nproc = int(env.get("MXTPU_NUM_WORKERS"))
+    rank = int(env.get("MXTPU_WORKER_ID"))
+    if not coord or nproc <= 1:
         return False
     import jax
     # a JAX_PLATFORMS request must win over any sitecustomize-forced
@@ -61,9 +62,9 @@ _cmd_lock = threading.Lock()
 
 
 def _cmd_port(rank: int) -> int:
-    base = int(os.environ.get("MXTPU_CMD_PORT_BASE", "0"))
+    base = int(env.get("MXTPU_CMD_PORT_BASE"))
     if base <= 0:
-        coord = os.environ.get("MXTPU_COORDINATOR", "")
+        coord = env.get("MXTPU_COORDINATOR")
         if ":" not in coord:
             return 0
         base = int(coord.rsplit(":", 1)[1]) + 100
@@ -74,7 +75,7 @@ def worker_command_address(rank: int):
     """(host, port) of worker `rank`'s command endpoint, from the
     launcher's MXTPU_WORKER_HOSTS placement (single-host jobs default to
     loopback)."""
-    hosts = [h for h in os.environ.get("MXTPU_WORKER_HOSTS", "").split(",")
+    hosts = [h for h in env.get("MXTPU_WORKER_HOSTS").split(",")
              if h]
     host = hosts[rank] if rank < len(hosts) else "127.0.0.1"
     if host in ("localhost",):
@@ -160,7 +161,7 @@ def _cmd_token() -> str:
     only — an unauthenticated 0.0.0.0 listener whose set_config can point
     the dump at an arbitrary path would hand remote control to any
     network peer."""
-    return os.environ.get("MXTPU_CMD_TOKEN", "")
+    return env.get("MXTPU_CMD_TOKEN")
 
 
 def start_command_server():
@@ -170,7 +171,7 @@ def start_command_server():
     with _cmd_lock:
         if _cmd_server is not None:
             return _cmd_server[1]
-        rank = int(os.environ.get("MXTPU_WORKER_ID", "0"))
+        rank = int(env.get("MXTPU_WORKER_ID"))
         port = _cmd_port(rank)
         if port <= 0:
             return None

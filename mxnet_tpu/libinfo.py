@@ -24,9 +24,10 @@ def lib_dirs():
         os.path.join(_PKG_DIR, "_native"),
         os.path.join(os.path.dirname(_PKG_DIR), "src"),
     ]
-    env = os.environ.get("MXTPU_LIBRARY_PATH")
-    if env:
-        dirs.insert(0, env)
+    from .base import env as _env
+    override = _env.get("MXTPU_LIBRARY_PATH")
+    if override:
+        dirs.insert(0, override)
     return dirs
 
 

@@ -611,14 +611,30 @@ class Updater:
         self.optimizer.update_multi_precision(index, weight, grad,
                                               self.states[index])
 
+    #: reserved keys smuggling the optimizer's host-side step counters
+    #: through the plain state-dict pickle (dump_optimizer=False — the
+    #: path every Trainer/fault/ZeRO checkpoint takes). Without them
+    #: Adam's bias-correction counter ``t`` restarted at 0 on resume, so
+    #: a kill/resume run diverged from an uninterrupted one (the first
+    #: post-resume steps re-applied the large t~=1 correction). String
+    #: keys cannot collide with integer state indices.
+    COUNTS_KEY = "__index_update_counts__"
+    NUM_UPDATE_KEY = "__num_update__"
+
     def get_states(self, dump_optimizer=False, indices=None):
         """``indices``: restrict the pickle to a subset of state slots —
         a ZeRO-1 rank ships only its shard into the gather-on-save
         merge. None (default) pickles everything this updater holds."""
         states = self.states if indices is None else \
             {i: s for i, s in self.states.items() if i in indices}
-        return pickle.dumps((states, self.optimizer)
-                            if dump_optimizer else states)
+        counts = self.optimizer._index_update_count
+        if indices is not None:
+            counts = {i: c for i, c in counts.items() if i in indices}
+        payload = dict(states)
+        payload[self.COUNTS_KEY] = dict(counts)
+        payload[self.NUM_UPDATE_KEY] = int(self.optimizer.num_update)
+        return pickle.dumps((payload, self.optimizer)
+                            if dump_optimizer else payload)
 
     def set_states(self, states, keep=None):
         # the pre-replacement optimizer's param_dict is the only weight-
@@ -632,6 +648,21 @@ class Updater:
             self.states, self.optimizer = states
         else:
             self.states = states
+        counts = num_update = None
+        if isinstance(self.states, dict):
+            # step counters ride in reserved keys (absent from pre-fix
+            # checkpoints — those restore exactly as before); pop them
+            # before the keep-filter/ledger loops see the dict
+            self.states = dict(self.states)
+            counts = self.states.pop(self.COUNTS_KEY, None)
+            num_update = self.states.pop(self.NUM_UPDATE_KEY, None)
+        if counts is not None:
+            # full replacement, like the state dict itself: Adam's t must
+            # resume exactly (bias correction), and num_update feeds any
+            # lr scheduler
+            self.optimizer._index_update_count = dict(counts)
+            self.optimizer.num_update = max(
+                int(num_update or 0), self.optimizer.begin_num_update)
         if keep is not None:
             # shard view re-derived on restore: a ZeRO-1 rank loads the
             # full topology-portable dict, then keeps only its own slots

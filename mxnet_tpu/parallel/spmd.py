@@ -154,6 +154,9 @@ class SPMDTrainer:
         optimizer = self.optimizer
         beta1, beta2, eps = self.beta1, self.beta2, self.epsilon
         compute_dtype = self._compute_dtype
+        # remat knob read at build time, not trace time (graftcheck GC-T03)
+        from ..util import mirror_wrapper
+        mirror = mirror_wrapper(self.remat)
 
         def step(train_arrays, aux_arrays, opt_state, key, t, data, label):
             # per-step stream derived on-device from the trainer's base key:
@@ -198,9 +201,8 @@ class SPMDTrainer:
                     for p, o in zip(aux, aux_orig):
                         p._data._data = o
 
-            from ..util import apply_mirror
             (loss, new_aux), grads = jax.value_and_grad(
-                apply_mirror(loss_of, self.remat),
+                mirror(loss_of),
                 has_aux=True)(tuple(train_arrays))
 
             new_params = []

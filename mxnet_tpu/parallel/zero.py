@@ -107,10 +107,9 @@ def simulated_world() -> int:
     except TypeError:  # absent -> default 0
         n = 0
     except ValueError as e:  # declared int: env.get coerces and raises
-        import os
         raise MXNetError(
             f"MXTPU_ZERO_WORLD: not an integer: "
-            f"{os.environ.get('MXTPU_ZERO_WORLD')!r}") from e
+            f"{env.raw('MXTPU_ZERO_WORLD')!r}") from e
     if n < 0:
         raise MXNetError(f"MXTPU_ZERO_WORLD must be >= 0, got {n}")
     return n
@@ -395,7 +394,20 @@ class ZeroPlane:
                                    indices=self.local_indices())
         blobs = cross_process_exchange_bytes(local,
                                              f"zsv{next(_save_seq)}")
+        from ..optimizer.optimizer import Updater
         merged: Dict = {}
+        counts: Dict = {}
+        num_update = 0
         for b in blobs:
-            merged.update(pickle.loads(b))
+            d = pickle.loads(b)
+            # each rank's blob carries step counters for ITS indices in
+            # the reserved keys — merge them like the state slots, or
+            # the last rank's counters would clobber everyone else's and
+            # Adam's bias correction would diverge on resume
+            counts.update(d.pop(Updater.COUNTS_KEY, {}))
+            num_update = max(num_update,
+                             int(d.pop(Updater.NUM_UPDATE_KEY, 0)))
+            merged.update(d)
+        merged[Updater.COUNTS_KEY] = counts
+        merged[Updater.NUM_UPDATE_KEY] = num_update
         return pickle.dumps(merged)

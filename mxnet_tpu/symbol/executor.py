@@ -398,6 +398,11 @@ class Executor:
         arg_names = tuple(self._arg_names)
         aux_names = tuple(self._aux_names)
         grad_names = tuple(self._grad_names)
+        # MXNET_BACKWARD_DO_MIRROR resolved at program-BUILD time, not
+        # inside the trace (graftcheck GC-T03): the knob's value is
+        # pinned when this executor compiles, never silently baked in
+        from ..util import mirror_wrapper
+        mirror = mirror_wrapper()
 
         def fwd_bwd(arg_arrays, aux_arrays, key, out_grads):
             import jax.numpy as jnp
@@ -425,8 +430,7 @@ class Executor:
             # MXNET_BACKWARD_DO_MIRROR: rematerialize activations in the
             # backward half of the fused program instead of storing them
             # (ref: src/nnvm/gradient.cc:271 mirror_fun)
-            from ..util import apply_mirror
-            f = apply_mirror(f)
+            f = mirror(f)
             (outs, new_aux), vjp = jax.vjp(f, diff_args)
             aux_cots = tuple(jnp.zeros_like(a) for a in new_aux)
             grads = vjp((tuple(out_grads), aux_cots))[0]

@@ -275,7 +275,7 @@ class AutoTuner:
             return
         name = _KNOB_ENV[knob]
         if name not in self._saved_env:
-            self._saved_env[name] = os.environ.get(name)
+            self._saved_env[name] = env.raw(name)
         if knob == "overlap":
             os.environ[name] = "on" if int(value) else "off"
         elif knob == "bucket_mb":

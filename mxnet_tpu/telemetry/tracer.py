@@ -107,7 +107,7 @@ class Tracer:
     @property
     def rank(self) -> int:
         if self._rank is None:
-            self._rank = int(os.environ.get("MXTPU_WORKER_ID", "0"))
+            self._rank = int(env.get("MXTPU_WORKER_ID"))
         return self._rank
 
     @property

@@ -54,6 +54,35 @@ def mirror_enabled(explicit=None) -> bool:
     return bool(env.get("MXNET_BACKWARD_DO_MIRROR"))
 
 
+def mirror_wrapper(explicit=None):
+    """Resolve the mirror decision NOW and return the wrapper to apply.
+
+    Program builders must call THIS on the host side (outside the traced
+    function) and apply the returned wrapper inside the trace: the
+    MXNET_BACKWARD_DO_MIRROR / MXNET_BACKWARD_MIRROR_POLICY knobs are
+    then read at program-BUILD time — a defined, observable moment —
+    instead of being baked invisibly into the first trace (graftcheck
+    GC-T03; the MXNET_SAFE_ACCUMULATION cache-key discipline's sibling).
+    """
+    if not mirror_enabled(explicit):
+        return lambda fn: fn
+    import jax
+    from .base import env
+    policy_name = env.get("MXNET_BACKWARD_MIRROR_POLICY") or "full"
+    policy = None
+    if policy_name == "dots":
+        policy = jax.checkpoint_policies.checkpoint_dots
+    elif policy_name == "convs":
+        def policy(prim, *_args, **_params):
+            return prim.name in ("conv_general_dilated", "dot_general")
+    elif policy_name not in ("full", ""):
+        from .base import MXNetError
+        raise MXNetError(
+            f"unknown MXNET_BACKWARD_MIRROR_POLICY {policy_name!r} "
+            "(expected 'full', 'dots' or 'convs')")
+    return lambda fn: jax.checkpoint(fn, policy=policy)
+
+
 def apply_mirror(fn, explicit=None):
     """Wrap a traceable function in jax.checkpoint when mirroring is on.
 
@@ -70,28 +99,16 @@ def apply_mirror(fn, explicit=None):
                        conv output, instead of conv output + post-BN/ReLU
                        activation — at the cost of re-running the cheap
                        normalize/activation chain inside backward)
+    Eager convenience over :func:`mirror_wrapper` — fine host-side (the
+    remat tests, one-shot wraps); code that BUILDS jitted programs must
+    resolve ``mirror_wrapper()`` outside the trace instead.
     """
-    if not mirror_enabled(explicit):
-        return fn
-    import jax
-    from .base import env
-    policy_name = env.get("MXNET_BACKWARD_MIRROR_POLICY") or "full"
-    policy = None
-    if policy_name == "dots":
-        policy = jax.checkpoint_policies.checkpoint_dots
-    elif policy_name == "convs":
-        def policy(prim, *_args, **_params):
-            return prim.name in ("conv_general_dilated", "dot_general")
-    elif policy_name not in ("full", ""):
-        from .base import MXNetError
-        raise MXNetError(
-            f"unknown MXNET_BACKWARD_MIRROR_POLICY {policy_name!r} "
-            "(expected 'full', 'dots' or 'convs')")
-    return jax.checkpoint(fn, policy=policy)
+    return mirror_wrapper(explicit)(fn)
 
 
 def getenv(name):
-    return os.environ.get(name)
+    from .base import env
+    return env.raw(name)
 
 
 def setenv(name, value):
@@ -114,13 +131,14 @@ def enable_compile_cache(cache_dir=None):
             plat = jax.config.jax_platforms
         except Exception:
             pass
-        plat = plat or os.environ.get("JAX_PLATFORMS") or ""
+        from .base import env
+        plat = plat or env.raw("JAX_PLATFORMS") or ""
         if not plat:
             # no explicit platform request to preserve — asking the
             # backend directly is safe and covers implicit-CPU hosts
             plat = jax.default_backend()
         explicit = cache_dir is not None or \
-            bool(os.environ.get("MXTPU_COMPILE_CACHE"))
+            bool(env.get("MXTPU_COMPILE_CACHE"))
         if plat.split(",")[0].strip() == "cpu" and not explicit:
             # CPU compiles are fast, and reloading CPU AOT entries across
             # differing host-feature detection risks SIGILL — by default
@@ -130,7 +148,7 @@ def enable_compile_cache(cache_dir=None):
             # replica restart) must be testable on CPU CI.
             return "skipped-cpu"  # truthy: intentional skip, not a failure
         if cache_dir is None:
-            cache_dir = os.environ.get(
+            cache_dir = env.get(
                 "MXTPU_COMPILE_CACHE",
                 os.path.join(os.path.dirname(os.path.dirname(
                     os.path.abspath(__file__))), ".jax_cache"))
@@ -155,7 +173,8 @@ def honor_platform_env():
     platform. Must run before the first backend initialization; a no-op
     afterwards. Shared by __graft_entry__, tools/bandwidth.py, and
     kvstore_server.init_distributed."""
-    want = os.environ.get("JAX_PLATFORMS")
+    from .base import env
+    want = env.raw("JAX_PLATFORMS")
     if not want:
         return
     try:

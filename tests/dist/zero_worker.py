@@ -78,9 +78,15 @@ def main():
     assert 0 < len(held) < n_params, (rank, held)
 
     # -- gather-on-save: the serialized form is the FULL unsharded dict
+    # (plus the reserved optimizer-counter keys, merged across ranks so
+    # Adam's bias-correction t survives kill/resume at any world size)
+    from mxnet_tpu.optimizer.optimizer import Updater
     blob = tr.get_states_bytes()
     full = pickle.loads(blob)
+    counts = full.pop(Updater.COUNTS_KEY)
+    full.pop(Updater.NUM_UPDATE_KEY)
     assert set(full) == set(range(n_params)), (rank, set(full))
+    assert set(counts) == set(range(n_params)), (rank, set(counts))
     # ...and restoring it re-derives the shard view (non-local pruned)
     tr.set_states_bytes(blob)
     assert set(tr._updaters[0].states) == local

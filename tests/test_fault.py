@@ -61,6 +61,16 @@ def test_is_recovery_env(monkeypatch):
     assert fault.is_recovery()
 
 
+@pytest.mark.parametrize("raw", ["0", "", "false", "False"])
+def test_is_recovery_falsy_spellings(monkeypatch, raw):
+    """Routed through the declared bool registry (graftcheck GC-E01): the
+    old direct read treated the literal string "False" as TRUTHY, so a
+    relauncher exporting MXNET_IS_RECOVERY=False sent a fresh node down
+    the restore-from-checkpoint path."""
+    monkeypatch.setenv("MXNET_IS_RECOVERY", raw)
+    assert not fault.is_recovery(), f"raw={raw!r} must read as falsy"
+
+
 def _make_net():
     net = gluon.nn.Dense(2, use_bias=False)
     net.initialize(mx.init.Constant(1.0))

@@ -1,0 +1,484 @@
+"""graftcheck analyzer unit tests: one known-bad fixture per rule
+asserting exact finding ids/lines, one known-clean fixture asserting zero
+false positives, plus baseline/key mechanics."""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from tools.graftcheck import (Baseline, BaselineError, SuiteConfig,  # noqa: E402
+                              run_suite)
+
+
+def _run(tmp_path, sources, analyzers=None, ledger_modules=(),
+         env_allowed=("mxnet_tpu/base.py",)):
+    """Write {relpath: source} under tmp_path and run the suite on it."""
+    for rel, src in sources.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    cfg = SuiteConfig(root=str(tmp_path), paths=list(sources),
+                      analyzers=analyzers or
+                      ("lock-order", "trace-purity", "donation",
+                       "env-discipline", "ledger-discipline"),
+                      ledger_modules=tuple(ledger_modules),
+                      env_allowed_suffixes=tuple(env_allowed))
+    return run_suite(cfg)
+
+
+def _rules_at(result):
+    return sorted((f.rule, f.path, f.line) for f in result.unsuppressed)
+
+
+# ---------------------------------------------------------------------------
+# lock-order
+# ---------------------------------------------------------------------------
+
+def test_lock_cycle_detected(tmp_path):
+    res = _run(tmp_path, {"m.py": """\
+        import threading
+
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+
+        def path_one():
+            with lock_a:
+                with lock_b:
+                    pass
+
+        def path_two():
+            with lock_b:
+                with lock_a:
+                    pass
+        """}, analyzers=("lock-order",))
+    rules = [f.rule for f in res.unsuppressed]
+    assert rules == ["GC-L01"], _rules_at(res)
+    assert "lock_a" in res.unsuppressed[0].message
+    assert "lock_b" in res.unsuppressed[0].message
+
+
+def test_lock_cycle_interprocedural(tmp_path):
+    """A cycle through a call chain: f holds A and calls g which takes B;
+    h holds B and calls k which takes A."""
+    res = _run(tmp_path, {"m.py": """\
+        import threading
+
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+
+        def take_b():
+            with lock_b:
+                pass
+
+        def take_a():
+            with lock_a:
+                pass
+
+        def f():
+            with lock_a:
+                take_b()
+
+        def h():
+            with lock_b:
+                take_a()
+        """}, analyzers=("lock-order",))
+    assert [f.rule for f in res.unsuppressed] == ["GC-L01"]
+
+
+def test_bare_acquire_flagged_and_guarded_is_clean(tmp_path):
+    res = _run(tmp_path, {"m.py": """\
+        import threading
+
+        _lk = threading.Lock()
+
+        def bad():
+            _lk.acquire()
+            do_work()
+
+        def good():
+            _lk.acquire()
+            try:
+                do_work()
+            finally:
+                _lk.release()
+
+        def do_work():
+            pass
+        """}, analyzers=("lock-order",))
+    assert _rules_at(res) == [("GC-L02", "m.py", 6)]
+
+
+def test_finalizer_plain_lock_flagged_rlock_clean(tmp_path):
+    res = _run(tmp_path, {"m.py": """\
+        import threading
+        import weakref
+
+        _plain = threading.Lock()
+        _rentrant = threading.RLock()
+
+        def _cb_bad(key):
+            with _plain:
+                pass
+
+        def _cb_ok(key):
+            with _rentrant:
+                pass
+
+        def register(obj):
+            weakref.finalize(obj, _cb_bad, 1)
+            weakref.finalize(obj, _cb_ok, 2)
+
+        class Holder:
+            def __del__(self):
+                with _plain:
+                    pass
+        """}, analyzers=("lock-order",))
+    got = _rules_at(res)
+    # line 16: the finalize(obj, _cb_bad) registration; line 20: __del__
+    assert got == [("GC-L03", "m.py", 16), ("GC-L03", "m.py", 20)], got
+
+
+# ---------------------------------------------------------------------------
+# trace-purity
+# ---------------------------------------------------------------------------
+
+def test_trace_purity_flags_all_four_classes(tmp_path):
+    res = _run(tmp_path, {"m.py": """\
+        import os
+        import time
+        import random
+        import jax
+
+        _CACHE = {}
+
+        def helper():
+            return time.time()
+
+        def build():
+            def traced(x):
+                t = helper()
+                r = random.random()
+                flag = os.environ.get("MXTPU_FOO")
+                _CACHE["k"] = x
+                return x * t * r
+            return jax.jit(traced)
+        """}, analyzers=("trace-purity",))
+    got = _rules_at(res)
+    assert got == [("GC-T01", "m.py", 9),    # time.time in helper
+                   ("GC-T02", "m.py", 14),   # random.random
+                   ("GC-T03", "m.py", 15),   # os.environ.get
+                   ("GC-T04", "m.py", 16)], got  # module-global store
+
+
+def test_trace_purity_ignores_host_side_code(tmp_path):
+    res = _run(tmp_path, {"m.py": """\
+        import os
+        import time
+        import jax
+
+        def host_only():
+            # impure but never traced: not a finding for trace-purity
+            return time.time(), os.environ.get("X")
+
+        def build():
+            def traced(x):
+                return x + 1
+            return jax.jit(traced)
+        """}, analyzers=("trace-purity",))
+    assert res.unsuppressed == []
+
+
+# ---------------------------------------------------------------------------
+# donation
+# ---------------------------------------------------------------------------
+
+def test_use_after_donate_flagged(tmp_path):
+    res = _run(tmp_path, {"m.py": """\
+        import jax
+
+        def f(w, g):
+            return w - g
+
+        def run(w, g):
+            step = jax.jit(f, donate_argnums=(0,))
+            out = step(w, g)
+            return w.sum() + out
+        """}, analyzers=("donation",))
+    assert _rules_at(res) == [("GC-D01", "m.py", 9)]
+    assert "'w'" in res.unsuppressed[0].message
+
+
+def test_donate_rebind_and_nondonated_are_clean(tmp_path):
+    res = _run(tmp_path, {"m.py": """\
+        import jax
+
+        def f(w, g):
+            return w - g
+
+        def run(w, g):
+            step = jax.jit(f, donate_argnums=(0,))
+            w = step(w, g)      # rebinding idiom: fine
+            w = step(w, g)
+            return w + g.sum()  # g was never donated: fine
+        """}, analyzers=("donation",))
+    assert res.unsuppressed == []
+
+
+def test_use_after_donate_through_factory(tmp_path):
+    res = _run(tmp_path, {"m.py": """\
+        import jax
+
+        def make_step():
+            def f(w, g):
+                return w - g
+            return jax.jit(f, donate_argnums=(0,))
+
+        def run(w, g):
+            step = make_step()
+            out = step(w, g)
+            return w * 2
+        """}, analyzers=("donation",))
+    assert _rules_at(res) == [("GC-D01", "m.py", 11)]
+
+
+# ---------------------------------------------------------------------------
+# env-discipline
+# ---------------------------------------------------------------------------
+
+def test_env_read_flagged_write_and_base_allowed(tmp_path):
+    res = _run(tmp_path, {
+        "pkg/other.py": """\
+            import os
+
+            def read_knob():
+                return os.getenv("MXTPU_SOMETHING")
+
+            def set_knob():
+                os.environ["MXTPU_SOMETHING"] = "1"   # write: allowed
+            """,
+        "mxnet_tpu/base.py": """\
+            import os
+
+            def get(name):
+                return os.environ.get(name)           # registry: allowed
+            """,
+    }, analyzers=("env-discipline",))
+    assert _rules_at(res) == [("GC-E01", "pkg/other.py", 4)]
+    assert "MXTPU_SOMETHING" in res.unsuppressed[0].message
+
+
+# ---------------------------------------------------------------------------
+# ledger-discipline
+# ---------------------------------------------------------------------------
+
+def test_unledgered_persistent_alloc_flagged(tmp_path):
+    res = _run(tmp_path, {"pkg/staging.py": """\
+        from ..telemetry import memory as _memory
+        import jax.numpy as jnp
+
+        class Stager:
+            def stage_bad(self, shape):
+                buf = jnp.zeros(shape)
+                self._buf = buf           # persisted, never ledgered
+
+        class Tracked:
+            def stage_good(self, shape):
+                buf = jnp.zeros(shape)
+                self._buf = buf
+                _memory.track_ndarray("staging", buf, owner="s")
+        """}, analyzers=("ledger-discipline",),
+        ledger_modules=("pkg/staging.py",))
+    assert _rules_at(res) == [("GC-M01", "pkg/staging.py", 7)]
+
+
+def test_local_temp_alloc_not_flagged(tmp_path):
+    res = _run(tmp_path, {"pkg/staging.py": """\
+        import jax.numpy as jnp
+
+        def warmup(shape):
+            x = jnp.zeros(shape)      # local temp: dies with the call
+            return float(x.sum())
+        """}, analyzers=("ledger-discipline",),
+        ledger_modules=("pkg/staging.py",))
+    assert res.unsuppressed == []
+
+
+# ---------------------------------------------------------------------------
+# clean fixture across ALL analyzers: zero false positives
+# ---------------------------------------------------------------------------
+
+CLEAN = """\
+    import threading
+    import os
+    import jax
+    import jax.numpy as jnp
+
+    _lock = threading.RLock()
+    _stats = {"hits": 0}
+
+    def bump():
+        with _lock:
+            _stats["hits"] += 1
+
+    def build_step():
+        def step(w, g):
+            return w - 0.1 * g
+        return jax.jit(step, donate_argnums=(0,))
+
+    def train(w, g, steps):
+        step = build_step()
+        for _ in range(steps):
+            w = step(w, g)
+        return w
+
+    def configure():
+        os.environ["MXTPU_FLAG"] = "1"   # write, not read
+        return None
+    """
+
+
+def test_clean_fixture_has_zero_findings(tmp_path):
+    res = _run(tmp_path, {"clean.py": CLEAN})
+    assert res.unsuppressed == [], _rules_at(res)
+
+
+# ---------------------------------------------------------------------------
+# baseline + key mechanics
+# ---------------------------------------------------------------------------
+
+def test_baseline_suppresses_by_stable_key(tmp_path):
+    src = {"m.py": """\
+        import os
+
+        def read():
+            return os.getenv("MXTPU_X")
+        """}
+    res = _run(tmp_path, src, analyzers=("env-discipline",))
+    (finding,) = res.unsuppressed
+    bl = Baseline({finding.key: "tested"})
+    cfg = SuiteConfig(root=str(tmp_path), paths=["m.py"],
+                      analyzers=("env-discipline",), baseline=bl)
+    res2 = run_suite(cfg)
+    assert res2.unsuppressed == [] and len(res2.suppressed) == 1
+    # stale entries are reported
+    bl2 = Baseline({finding.key: "tested", "GC-E01:gone.py:X@f": "old"})
+    cfg.baseline = bl2
+    res3 = run_suite(cfg)
+    assert res3.stale_baseline == ["GC-E01:gone.py:X@f"]
+
+
+def test_baseline_requires_justification(tmp_path):
+    bad = tmp_path / "bl.json"
+    bad.write_text(json.dumps(
+        {"version": 1, "findings": [{"key": "GC-E01:x.py:Y@f",
+                                     "justification": "  "}]}))
+    with pytest.raises(BaselineError, match="justification"):
+        Baseline.load(str(bad))
+    worse = tmp_path / "bl2.json"
+    worse.write_text(json.dumps({"version": 2, "findings": []}))
+    with pytest.raises(BaselineError, match="version"):
+        Baseline.load(str(worse))
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    res = _run(tmp_path, {"broken.py": "def f(:\n    pass\n"})
+    assert [f.rule for f in res.unsuppressed] == ["GC-X01"]
+
+
+# ---------------------------------------------------------------------------
+# CLI surface
+# ---------------------------------------------------------------------------
+
+def _cli(args, cwd):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.graftcheck", *args],
+        capture_output=True, text=True, cwd=cwd, timeout=300,
+        env={**os.environ, "PYTHONPATH": ROOT})
+
+
+def test_cli_exit_codes_and_json(tmp_path):
+    (tmp_path / "dirty.py").write_text(
+        "import os\n\ndef f():\n    return os.getenv('A')\n")
+    (tmp_path / "clean.py").write_text("def f():\n    return 1\n")
+    r = _cli(["--json", "--no-baseline", "--root", str(tmp_path),
+              "dirty.py"], cwd=ROOT)
+    assert r.returncode == 1, r.stderr
+    payload = json.loads(r.stdout)
+    assert payload["version"] == 1 and payload["tool"] == "graftcheck"
+    (f,) = payload["findings"]
+    assert set(f) == {"analyzer", "rule", "path", "line", "message",
+                      "hint", "key"}
+    assert f["rule"] == "GC-E01" and f["line"] == 4
+    assert payload["counts"] == {"GC-E01": 1}
+    r2 = _cli(["--no-baseline", "--root", str(tmp_path), "clean.py"],
+              cwd=ROOT)
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    r3 = _cli(["--baseline", "/nonexistent.json", "--root", str(tmp_path),
+               "clean.py"], cwd=ROOT)
+    assert r3.returncode == 2
+
+
+def test_bare_acquire_cross_module_points_at_acquiring_file(tmp_path):
+    """A bare acquire on a lock imported from another module must be
+    reported at the ACQUIRING file:line, not (defining file, acquiring
+    line) — that composite points at a location that may not exist."""
+    res = _run(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/locks.py": "import threading\n_shared = threading.Lock()\n",
+        "pkg/user.py": """\
+            from .locks import _shared
+
+            def f():
+                _shared.acquire()
+                work()
+
+            def work():
+                pass
+            """,
+    }, analyzers=("lock-order",))
+    assert _rules_at(res) == [("GC-L02", "pkg/user.py", 4)]
+
+
+def test_donation_deferred_lambda_is_not_a_use(tmp_path):
+    """A donated name captured by a lambda is deferred execution — by the
+    time the lambda runs the name may be rebound; charging it as an
+    immediate read is a false positive."""
+    res = _run(tmp_path, {"m.py": """\
+        import jax
+
+        def f(w, g):
+            return w - g
+
+        def run(x, g):
+            step = jax.jit(f, donate_argnums=(0,))
+            out = step(x, g)
+            thunk = lambda: x + 1
+            x = out
+            return x, thunk
+        """}, analyzers=("donation",))
+    assert res.unsuppressed == [], _rules_at(res)
+
+
+def test_cli_derives_root_and_baseline_from_path_argument(tmp_path):
+    """`python -m tools.graftcheck /abs/repo/sub` from an unrelated cwd
+    must find /abs/repo/graftcheck_baseline.json by walking up from the
+    path argument (and key relpaths against that root)."""
+    sub = tmp_path / "repo" / "sub"
+    sub.mkdir(parents=True)
+    (sub / "m.py").write_text(
+        "import os\n\ndef f():\n    return os.getenv('A')\n")
+    r_dirty = _cli(["--no-baseline", str(sub)], cwd=str(tmp_path))
+    assert r_dirty.returncode == 1
+    key = "GC-E01:sub/m.py:A@f"
+    (tmp_path / "repo" / "graftcheck_baseline.json").write_text(json.dumps(
+        {"version": 1,
+         "findings": [{"key": key, "justification": "test fixture"}]}))
+    r = _cli([str(sub)], cwd=str(tmp_path))  # cwd has NO baseline
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "1 suppressed" in r.stdout

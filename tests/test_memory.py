@@ -293,6 +293,33 @@ def test_staging_bytes_rise_and_fall():
     assert LED.live_bytes("staging") == base["staging"]
 
 
+def test_staging_finalizer_never_reenters_ledger_accessor(monkeypatch):
+    """The abandoned-iterator finalizer must run against the MemoryLedger
+    captured at construction, NOT re-resolve it through memory.ledger():
+    that accessor runs first-use metrics installation under plain
+    (non-reentrant) locks, and weakref.finalize can fire synchronously on
+    a thread holding them — self-deadlock (graftcheck GC-L03, the PR 8
+    ledger-bug class generalized). Simulated here by making the accessor
+    explosive after construction: the finalizer must still free the
+    staged bytes without ever calling it."""
+    base = _flush()
+    rs = np.random.RandomState(5)
+    data = rs.randn(4 * 4, 8).astype(np.float32)
+    label = rs.randint(0, 2, (4 * 4,)).astype(np.float32)
+    it = DeviceStagingIter(mxio.NDArrayIter(data, label, batch_size=4),
+                           depth=1)
+    it.next()
+    assert LED.live_bytes("staging") > base["staging"]
+
+    def boom():
+        raise AssertionError("finalizer re-entered memory.ledger()")
+
+    monkeypatch.setattr(mem, "ledger", boom)
+    del it
+    gc.collect()
+    assert LED.live_bytes("staging") == base["staging"]
+
+
 # ---------------------------------------------------------------------------
 # FitResult + trace counter track (the acceptance criterion)
 # ---------------------------------------------------------------------------

@@ -432,3 +432,62 @@ def test_fused_sentinel_through_fitloop(monkeypatch):
     np.testing.assert_allclose(net_a.weight.data().asnumpy(),
                                net_b.weight.data().asnumpy(), rtol=1e-6)
     np.testing.assert_allclose(res.losses, res_b.losses, rtol=1e-6)
+
+
+@pytest.mark.parametrize("agg", [4, 0])
+def test_adam_resume_bitwise_matches_uninterrupted(monkeypatch, tmp_path,
+                                                   agg):
+    """Kill/resume parity for Adam (graftcheck-adjacent state audit, PR 9
+    note): the bias-correction counter ``t`` rides the state pickle via
+    Updater.COUNTS_KEY, so a restore continues the t sequence. Pre-fix,
+    t restarted at 1 after load_states and the resumed trajectory
+    diverged from the uninterrupted one on the very first step."""
+    kw = {"learning_rate": 0.01, "wd": 0.001}
+    steps_total, steps_before = 6, 3
+
+    # uninterrupted reference: 6 steps, one trainer
+    params_a, _ = _run_steps("adam", kw, agg, monkeypatch,
+                             steps=steps_total, seed=7)
+
+    # interrupted: 3 steps, save, then a FRESH trainer (fresh optimizer,
+    # fresh updater — the process-restart stand-in) restores and resumes
+    # on the same gradient stream
+    monkeypatch.setenv("MXTPU_OPTIMIZER_AGGREGATION", str(agg))
+    rs = np.random.RandomState(7)
+    params_b = _make_params(rs, n=6)
+    tr = gluon.Trainer(params_b, "adam", dict(kw), kvstore=None)
+    for _ in range(steps_before):
+        _set_grads(params_b, rs)
+        tr.step(4)
+    fname = str(tmp_path / "trainer.states")
+    tr.save_states(fname)
+    saved_weights = [p.data().asnumpy().copy() for p in params_b]
+
+    params_c = _make_params(np.random.RandomState(7), n=6)
+    for p, w in zip(params_c, saved_weights):
+        p.set_data(nd.array(w))
+    tr2 = gluon.Trainer(params_c, "adam", dict(kw), kvstore=None)
+    tr2.load_states(fname)
+    # the counter must have resumed, not reset
+    assert tr2._updaters[0].optimizer._index_update_count
+    assert all(c == steps_before for c in
+               tr2._updaters[0].optimizer._index_update_count.values())
+    for _ in range(steps_total - steps_before):
+        _set_grads(params_c, rs)
+        tr2.step(4)
+
+    for pa, pc in zip(params_a, params_c):
+        assert np.array_equal(pa.data().asnumpy(), pc.data().asnumpy()), \
+            f"{pa.name}: resumed Adam trajectory diverged (t not restored)"
+
+
+def test_updater_states_roundtrip_accepts_legacy_pickle():
+    """A pre-fix checkpoint (no reserved counter keys) must still load:
+    counters then stay at their defaults exactly as before the fix."""
+    import pickle
+    from mxnet_tpu import optimizer as opt_mod
+    up = opt_mod.get_updater(opt_mod.create("adam"))
+    legacy = pickle.dumps({0: None, 1: None})
+    up.set_states(legacy)
+    assert set(up.states) == {0, 1}
+    assert up.optimizer._index_update_count == {}

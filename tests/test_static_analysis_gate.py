@@ -1,0 +1,80 @@
+"""Tier-1 static-analysis gate: graftcheck over mxnet_tpu/ + tools/ with
+the checked-in baseline must report ZERO unsuppressed findings.
+
+This is the mechanical replacement for the review passes PRs 5-9 burned
+on the same bug families (RLock-under-GC-finalize, trace-impure code,
+use-after-donate, silently-dead env typos, unledgered buffers): a PR that
+reintroduces one fails here with the exact file:line and a fix hint.
+
+To suppress a finding instead of fixing it, add its key to
+``graftcheck_baseline.json`` WITH a written justification — unjustified
+entries fail the baseline loader itself. See docs/static_analysis.md.
+"""
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from tools.graftcheck import Baseline, SuiteConfig, run_suite  # noqa: E402
+from tools.graftcheck.findings import RULES  # noqa: E402
+
+BASELINE = os.path.join(ROOT, "graftcheck_baseline.json")
+
+
+_MEMO = []
+
+
+def _gate_result():
+    if not _MEMO:  # one analysis run shared by the assertion tests
+        baseline = Baseline.load(BASELINE)
+        _MEMO.append((run_suite(
+            SuiteConfig(root=ROOT, paths=["mxnet_tpu", "tools"],
+                        baseline=baseline)), baseline))
+    return _MEMO[0]
+
+
+def test_gate_zero_unsuppressed_findings():
+    result, _ = _gate_result()
+    msg = "\n".join(f.render() for f in result.unsuppressed)
+    assert not result.unsuppressed, (
+        f"graftcheck found NEW unsuppressed findings:\n{msg}\n\n"
+        "Fix them (preferred), or baseline with a written justification "
+        "in graftcheck_baseline.json (docs/static_analysis.md).")
+
+
+def test_gate_baseline_entries_all_fire_and_are_justified():
+    """Every baseline entry must (a) carry a non-empty justification —
+    enforced by the loader — and (b) still match a real finding: stale
+    entries mean the hazard was fixed and the suppression must go."""
+    result, baseline = _gate_result()
+    assert all(j.strip() for j in baseline.entries.values())
+    assert not result.stale_baseline, (
+        f"stale baseline entries (fixed hazards — delete them): "
+        f"{result.stale_baseline}")
+
+
+def test_gate_known_rules_only():
+    result, _ = _gate_result()
+    for f in result.suppressed:
+        assert f.rule in RULES
+
+
+def test_cli_json_schema_and_exit_code_on_repo():
+    """The CLI contract scripts outside pytest rely on: --json output is
+    schema-stable and the exit code is 0 on a clean (baselined) tree."""
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.graftcheck", "--json",
+         "mxnet_tpu", "tools"],
+        capture_output=True, text=True, cwd=ROOT, timeout=600,
+        env={**os.environ, "PYTHONPATH": ROOT})
+    assert r.returncode == 0, r.stdout + r.stderr
+    payload = json.loads(r.stdout)
+    assert payload["version"] == 1
+    assert payload["tool"] == "graftcheck"
+    assert payload["findings"] == []
+    assert isinstance(payload["counts"], dict)
+    assert payload["suppressed"] >= 1          # the justified baseline
+    assert payload["stale_baseline"] == []

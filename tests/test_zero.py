@@ -289,9 +289,18 @@ def test_zero_checkpoint_topology_portable(monkeypatch, tmp_path):
         tz.step(4)
     f_zero = str(tmp_path / "zero_states")
     tz.save_states(f_zero)
-    # the on-disk format IS the ordinary unsharded dict
+    # the on-disk format IS the ordinary unsharded dict (state slots for
+    # every param, plus the reserved optimizer-counter keys every
+    # checkpoint now carries so Adam's t survives kill/resume)
+    from mxnet_tpu.optimizer.optimizer import Updater
     with open(f_zero, "rb") as f:
-        assert set(pickle.loads(f.read())) == set(range(6))
+        blob = pickle.loads(f.read())
+    counts = blob.pop(Updater.COUNTS_KEY)
+    blob.pop(Updater.NUM_UPDATE_KEY)
+    assert set(blob) == set(range(6))
+    # gather merged every rank's counters, not just the last rank's
+    assert set(counts) == set(range(6))
+    assert all(c == 2 for c in counts.values())
 
     # same 2 steps unsharded, save
     pu, tu, gu = build(0)

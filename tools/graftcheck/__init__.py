@@ -1,0 +1,22 @@
+"""graftcheck: framework-aware static analysis for the TPU-native port.
+
+Five analyzers over pure ``ast`` (stdlib-only, never imports the
+analyzed code):
+
+- ``lock-order``   — GC-L01 cycle, GC-L02 bare acquire, GC-L03
+  non-reentrant lock in a finalizer (the PR 8 ledger bug, generalized)
+- ``trace-purity`` — GC-T01 clock / GC-T02 RNG / GC-T03 env read /
+  GC-T04 global mutation inside jit/pallas-traced code
+- ``donation``     — GC-D01 use-after-donate on donate_argnums programs
+- ``env-discipline``   — GC-E01 os.environ reads outside base.py
+- ``ledger-discipline`` — GC-M01 persistent device buffers without a
+  telemetry.memory registration
+
+CLI: ``python -m tools.graftcheck [--json] [--baseline FILE] paths…``
+Docs: ``docs/static_analysis.md``. Gate: ``tests/test_static_analysis_gate.py``.
+"""
+from .findings import Baseline, BaselineError, Finding, RULES
+from .runner import ANALYZERS, SuiteConfig, SuiteResult, run_suite
+
+__all__ = ["Baseline", "BaselineError", "Finding", "RULES", "ANALYZERS",
+           "SuiteConfig", "SuiteResult", "run_suite"]

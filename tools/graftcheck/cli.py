@@ -1,0 +1,122 @@
+"""CLI: ``python -m tools.graftcheck [--json] [--baseline FILE] paths…``
+
+Exit codes: 0 clean, 1 unsuppressed findings, 2 usage/baseline error.
+
+Root resolution (matters for both relpath keys and the default
+baseline): ``--root`` wins; otherwise, if the cwd holds no
+``graftcheck_baseline.json``, the first path argument's ancestors are
+searched for one and the directory holding it becomes the root — so
+``python -m tools.graftcheck /abs/repo/mxnet_tpu`` works from anywhere;
+otherwise the cwd. ``--no-baseline`` disables suppression entirely.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from .findings import Baseline, BaselineError, to_json_payload
+from .runner import ANALYZERS, SuiteConfig, run_suite
+
+__all__ = ["main"]
+
+BASELINE_NAME = "graftcheck_baseline.json"
+
+
+def _find_default_baseline(root: str) -> Optional[str]:
+    cand = os.path.join(root, BASELINE_NAME)
+    return cand if os.path.isfile(cand) else None
+
+
+def _derive_root(paths) -> Optional[str]:
+    """Nearest ancestor of the first path argument holding a baseline
+    file — lets the tool run against an absolute repo path from any cwd
+    with the repo's own baseline (and repo-relative finding keys)."""
+    first = os.path.abspath(paths[0])
+    d = first if os.path.isdir(first) else os.path.dirname(first)
+    while True:
+        if os.path.isfile(os.path.join(d, BASELINE_NAME)):
+            return d
+        parent = os.path.dirname(d)
+        if parent == d:
+            return None
+        d = parent
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="graftcheck",
+        description="Framework-aware static analysis: lock-order, "
+                    "trace-purity, donation, env & ledger discipline.")
+    p.add_argument("paths", nargs="+",
+                   help="files or directories to analyze")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-parseable JSON on stdout (schema v1)")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help=f"baseline file (default: {BASELINE_NAME} in the "
+                        "root, if present)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline; report everything")
+    p.add_argument("--root", default=None,
+                   help="repo root paths are relative to (default: cwd)")
+    p.add_argument("--rules", default=None, metavar="A1,A2",
+                   help="comma-separated analyzer subset: "
+                        + ",".join(ANALYZERS))
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    root = args.root
+    if root is None:
+        root = os.getcwd()
+        if not os.path.isfile(os.path.join(root, BASELINE_NAME)):
+            root = _derive_root(args.paths) or root
+    root = os.path.abspath(root)
+    analyzers = list(ANALYZERS)
+    if args.rules:
+        analyzers = [a.strip() for a in args.rules.split(",") if a.strip()]
+        unknown = [a for a in analyzers if a not in ANALYZERS]
+        if unknown:
+            print(f"graftcheck: unknown analyzer(s) {unknown}; "
+                  f"valid: {', '.join(ANALYZERS)}", file=sys.stderr)
+            return 2
+
+    baseline = None
+    if not args.no_baseline:
+        path = args.baseline or _find_default_baseline(root)
+        if args.baseline and not os.path.isfile(args.baseline):
+            print(f"graftcheck: baseline {args.baseline!r} not found",
+                  file=sys.stderr)
+            return 2
+        if path is not None:
+            try:
+                baseline = Baseline.load(path)
+            except BaselineError as e:
+                print(f"graftcheck: {e}", file=sys.stderr)
+                return 2
+
+    result = run_suite(SuiteConfig(root=root, paths=args.paths,
+                                   baseline=baseline,
+                                   analyzers=analyzers))
+    if args.as_json:
+        payload = to_json_payload(result.unsuppressed, result.suppressed,
+                                  result.stale_baseline)
+        json.dump(payload, sys.stdout, indent=1)
+        sys.stdout.write("\n")
+    else:
+        for f in result.unsuppressed:
+            print(f.render())
+        n = len(result.unsuppressed)
+        print(f"graftcheck: {n} finding{'s' if n != 1 else ''}, "
+              f"{len(result.suppressed)} suppressed by baseline")
+        for key in result.stale_baseline:
+            print(f"graftcheck: warning: stale baseline entry (no longer "
+                  f"fires): {key}", file=sys.stderr)
+    return result.exit_code
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

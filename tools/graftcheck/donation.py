@@ -1,0 +1,327 @@
+"""Donation-discipline analyzer (GC-D01).
+
+``jax.jit(fn, donate_argnums=(k,))`` hands argument ``k``'s buffer to XLA
+for in-place reuse: after the call the donated array is INVALID, and any
+later read is undefined behavior (jax raises on some backends, silently
+reads garbage on others). This analyzer tracks, within each function
+body:
+
+1. local names bound to donated programs — directly
+   (``step = jax.jit(f, donate_argnums=(0,))``) or through a *factory*:
+   a project function/method whose every return statement is a
+   ``jax.jit(..., donate_argnums=...)`` expression with one consistent
+   argnums tuple (``self._jit_step()``-style builders). Factories with
+   conflicting argnums across returns are skipped — guessing would flag
+   the wrong positions.
+2. calls through those names: the bare-Name arguments at donated
+   positions become *consumed*;
+3. any later read of a consumed name (before reassignment) is a finding.
+
+The walk is structured: ``if/else`` branches are analyzed separately and
+their consumed-sets unioned; loop bodies are walked twice so a
+cross-iteration use-after-donate (consume at the bottom, read at the top)
+is caught while a reassign-at-top loop stays clean. ``x = step(x, g)``
+rebinds ``x`` at the same statement and is NOT a finding — that is the
+intended donation idiom.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .findings import Finding
+from .project import FunctionInfo, Module, Project
+
+__all__ = ["analyze"]
+
+
+def _donate_argnums(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """Literal donate_argnums of a ``*.jit(...)`` call, else None."""
+    f = call.func
+    is_jit = (isinstance(f, ast.Attribute) and f.attr == "jit") or \
+        isinstance(f, ast.Name) and f.id == "jit"
+    if not is_jit:
+        return None
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out = []
+            for elt in v.elts:
+                if isinstance(elt, ast.Constant) and \
+                        isinstance(elt.value, int):
+                    out.append(elt.value)
+                else:
+                    return None
+            return tuple(out)
+        return None
+    return None
+
+
+def _own_returns(fn_node: ast.AST):
+    """Return statements of THIS function only — a nested def's returns
+    (the jitted kernel's own `return w - g`) must not disqualify the
+    enclosing factory."""
+    todo: List[ast.AST] = [fn_node]
+    while todo:
+        node = todo.pop()
+        if isinstance(node, ast.Return):
+            yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            todo.append(child)
+
+
+def _factory_index(project: Project) -> Dict[str, Tuple[int, ...]]:
+    """qualname -> argnums for functions whose every return is a donating
+    jit expression (directly, or a call to an already-known factory).
+    Fixpoint over one level of indirection per iteration."""
+    out: Dict[str, Tuple[int, ...]] = {}
+    changed = True
+    while changed:
+        changed = False
+        for mod in project.modules.values():
+            for fn in mod.functions.values():
+                if fn.qualname in out:
+                    continue
+                argnums = _returns_argnums(project, mod, fn, out)
+                if argnums is not None:
+                    out[fn.qualname] = argnums
+                    changed = True
+    return out
+
+
+def _returns_argnums(project: Project, mod: Module, fn: FunctionInfo,
+                     known: Dict[str, Tuple[int, ...]]
+                     ) -> Optional[Tuple[int, ...]]:
+    rets: List[Tuple[int, ...]] = []
+    found_any = False
+    for node in _own_returns(fn.node):
+        if node.value is None:
+            continue
+        found_any = True
+        v = node.value
+        if isinstance(v, ast.Call):
+            a = _donate_argnums(v)
+            if a is None:
+                callee = project.resolve_call(mod, fn, v.func)
+                a = known.get(callee.qualname) if callee else None
+            if a is not None:
+                rets.append(a)
+                continue
+        return None  # some return is not a donating program
+    if not found_any or not rets:
+        return None
+    return rets[0] if all(r == rets[0] for r in rets) else None
+
+
+class _State:
+    """Linear-scan state: name -> argnums for donated programs; name ->
+    (line, program) for consumed buffers."""
+
+    def __init__(self):
+        self.programs: Dict[str, Tuple[int, ...]] = {}
+        self.consumed: Dict[str, Tuple[int, str]] = {}
+
+    def copy(self) -> "_State":
+        s = _State()
+        s.programs = dict(self.programs)
+        s.consumed = dict(self.consumed)
+        return s
+
+    def merge(self, other: "_State") -> None:
+        self.programs.update(other.programs)
+        self.consumed.update(other.consumed)
+
+
+def _call_donation(project: Project, mod: Module, fn: FunctionInfo,
+                   call: ast.Call, state: _State,
+                   factories: Dict[str, Tuple[int, ...]]
+                   ) -> Optional[Tuple[Tuple[int, ...], List[str]]]:
+    """If ``call`` invokes a donated program, (argnums, donated bare-Name
+    args)."""
+    argnums: Optional[Tuple[int, ...]] = None
+    if isinstance(call.func, ast.Name) and call.func.id in state.programs:
+        argnums = state.programs[call.func.id]
+    elif isinstance(call.func, ast.Call):
+        # immediate call: jax.jit(f, donate_argnums=(0,))(x)
+        argnums = _donate_argnums(call.func)
+        if argnums is None:
+            callee = project.resolve_call(mod, fn, call.func.func)
+            if callee is not None:
+                argnums = factories.get(callee.qualname)
+    if argnums is None:
+        return None
+    names = []
+    for pos in argnums:
+        if pos < len(call.args):
+            a = call.args[pos]
+            if isinstance(a, ast.Name):
+                names.append(a.id)
+    return argnums, names
+
+
+def _assigned_names(stmt: ast.stmt) -> Set[str]:
+    out: Set[str] = set()
+    targets: List[ast.expr] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    for t in targets:
+        for node in ast.walk(t):
+            if isinstance(node, ast.Name):
+                out.add(node.id)
+    return out
+
+
+def _analyze_function(project: Project, mod: Module, fn: FunctionInfo,
+                      factories: Dict[str, Tuple[int, ...]],
+                      findings: List[Finding]) -> None:
+    reported: Set[Tuple[str, int]] = set()
+
+    def flag(name: str, use_line: int, donate_line: int, prog: str) -> None:
+        if (name, use_line) in reported:
+            return
+        reported.add((name, use_line))
+        findings.append(Finding(
+            rule="GC-D01", path=mod.relpath, line=use_line,
+            message=f"{name!r} used after being donated to {prog} "
+                    f"(donated at line {donate_line}) in {fn.qualname}",
+            hint="donated buffers are dead after the call — reorder the "
+                 "read before it, rebind the name from the program's "
+                 "output, or drop it from donate_argnums",
+            symbol=f"{fn.qualname}:{name}"))
+
+    def walk_eager(expr: ast.expr):
+        """Descendants that evaluate WITH this expression — lambdas are
+        deferred (they run later, often after a rebind), so their bodies
+        must not be charged as immediate reads or donated calls. A plain
+        ast.walk + continue would still yield the lambda's descendants;
+        this stack-walk actually prunes the subtree."""
+        todo: List[ast.AST] = [expr]
+        while todo:
+            node = todo.pop()
+            if isinstance(node, ast.Lambda):
+                continue
+            yield node
+            todo.extend(ast.iter_child_nodes(node))
+
+    def scan_expr(expr: ast.expr, state: _State) -> None:
+        """Process reads + donated calls inside one expression, in AST
+        order (approximates evaluation order well enough)."""
+        for node in walk_eager(expr):
+            if isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, ast.Load) and \
+                    node.id in state.consumed:
+                line, prog = state.consumed[node.id]
+                flag(node.id, node.lineno, line, prog)
+        for node in walk_eager(expr):
+            if isinstance(node, ast.Call):
+                don = _call_donation(project, mod, fn, node, state,
+                                     factories)
+                if don is not None:
+                    _argnums, names = don
+                    prog = ast.unparse(node.func) if hasattr(
+                        ast, "unparse") else "<donated program>"
+                    for nm in names:
+                        state.consumed[nm] = (node.lineno, prog)
+
+    def track_assign(stmt: ast.stmt, state: _State) -> None:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name):
+            name = stmt.targets[0].id
+            v = stmt.value
+            argnums = None
+            if isinstance(v, ast.Call):
+                argnums = _donate_argnums(v)
+                if argnums is None:
+                    callee = project.resolve_call(mod, fn, v.func)
+                    if callee is not None:
+                        argnums = factories.get(callee.qualname)
+            if argnums is not None:
+                state.programs[name] = argnums
+            else:
+                state.programs.pop(name, None)
+        for nm in _assigned_names(stmt):
+            state.consumed.pop(nm, None)
+
+    def walk_body(body: List[ast.stmt], state: _State) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, ast.If):
+                scan_expr(stmt.test, state)
+                s1, s2 = state.copy(), state.copy()
+                walk_body(stmt.body, s1)
+                walk_body(stmt.orelse, s2)
+                state.merge(s1)
+                state.merge(s2)
+                continue
+            if isinstance(stmt, (ast.For, ast.While, ast.AsyncFor)):
+                if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                    scan_expr(stmt.iter, state)
+                    for nm in _loop_targets(stmt.target):
+                        state.consumed.pop(nm, None)
+                else:
+                    scan_expr(stmt.test, state)
+                # two passes: catches cross-iteration use-after-donate
+                walk_body(stmt.body, state)
+                if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                    for nm in _loop_targets(stmt.target):
+                        state.consumed.pop(nm, None)
+                walk_body(stmt.body, state)
+                walk_body(stmt.orelse, state)
+                continue
+            if isinstance(stmt, ast.Try):
+                s1 = state.copy()
+                walk_body(stmt.body, s1)
+                state.merge(s1)
+                for h in stmt.handlers:
+                    sh = state.copy()
+                    walk_body(h.body, sh)
+                    state.merge(sh)
+                walk_body(stmt.orelse, state)
+                walk_body(stmt.finalbody, state)
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    scan_expr(item.context_expr, state)
+                walk_body(stmt.body, state)
+                continue
+            if isinstance(stmt, ast.Return) and stmt.value is not None:
+                scan_expr(stmt.value, state)
+                continue
+            # plain statement: evaluate RHS reads/calls, then apply the
+            # assignment (so `x = step(x)` rebinds rather than flags)
+            for field, value in ast.iter_fields(stmt):
+                if field in ("targets", "target"):
+                    continue
+                if isinstance(value, ast.expr):
+                    scan_expr(value, state)
+                elif isinstance(value, list):
+                    for v in value:
+                        if isinstance(v, ast.expr):
+                            scan_expr(v, state)
+            track_assign(stmt, state)
+
+    walk_body(fn.node.body, _State())
+
+
+def _loop_targets(target: ast.expr) -> Set[str]:
+    return {n.id for n in ast.walk(target) if isinstance(n, ast.Name)}
+
+
+def analyze(project: Project) -> List[Finding]:
+    factories = _factory_index(project)
+    findings: List[Finding] = []
+    for mod in project.modules.values():
+        for fn in mod.functions.values():
+            _analyze_function(project, mod, fn, factories, findings)
+    return findings

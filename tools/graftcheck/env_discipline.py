@@ -1,0 +1,112 @@
+"""Env-var discipline analyzer (GC-E01).
+
+Every ``MXNET_*``/``MXTPU_*`` knob must be declared once in
+``mxnet_tpu/base.py``'s :class:`EnvRegistry` and read through it
+(``env.get``/``env.raw``) — that is what lets ``mx.runtime`` enumerate
+knobs, ``docs/env_vars.md`` stay complete, and ``test_env_flags`` audit
+that no declared flag is a silent no-op. A direct ``os.environ`` /
+``os.getenv`` read anywhere else bypasses all three: a typo'd name
+becomes a silently-dead knob (the PR 5-9 review classes this rule
+mechanizes).
+
+Flagged (reads): ``os.environ.get``, ``os.environ[...]`` loads,
+``os.getenv``, ``X in os.environ``. Not flagged (writes / lifecycle):
+``os.environ[k] = v``, ``del``, ``.pop``, ``.setdefault`` — setting env
+to drive a child process or restore a saved value is process plumbing,
+not a knob read.
+
+Allowed files: ``mxnet_tpu/base.py`` (the registry itself) and any path
+whose basename matches ``allowed_basenames`` in the config.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .findings import Finding
+from .project import Module, Project
+
+__all__ = ["analyze"]
+
+#: repo-relative suffixes where direct environ access is the POINT
+_ALLOWED_SUFFIXES = ("mxnet_tpu/base.py",)
+
+
+def _is_environ(mod: Module, project: Project, expr: ast.expr) -> bool:
+    return project.dotted_of(mod, expr) == "os.environ"
+
+
+def _env_name(call_or_sub) -> str:
+    """Best-effort knob name for the finding symbol."""
+    arg = None
+    if isinstance(call_or_sub, ast.Call) and call_or_sub.args:
+        arg = call_or_sub.args[0]
+    elif isinstance(call_or_sub, ast.Subscript):
+        arg = call_or_sub.slice
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    return "<dynamic>"
+
+
+def analyze(project: Project,
+            allowed_suffixes=_ALLOWED_SUFFIXES) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in project.modules.values():
+        rp = mod.relpath.replace("\\", "/")
+        if any(rp.endswith(sfx) for sfx in allowed_suffixes):
+            continue
+        for node in ast.walk(mod.tree):
+            hit = None
+            if isinstance(node, ast.Call):
+                f = node.func
+                dotted = project.dotted_of(mod, f)
+                if dotted == "os.getenv":
+                    hit = ("os.getenv", _env_name(node))
+                elif isinstance(f, ast.Attribute) and \
+                        f.attr in ("get",) and \
+                        _is_environ(mod, project, f.value):
+                    hit = ("os.environ.get", _env_name(node))
+                elif isinstance(f, ast.Attribute) and \
+                        f.attr in ("keys", "items", "values", "copy") and \
+                        _is_environ(mod, project, f.value):
+                    hit = (f"os.environ.{f.attr}", "<iteration>")
+            elif isinstance(node, ast.Subscript) and \
+                    isinstance(node.ctx, ast.Load) and \
+                    _is_environ(mod, project, node.value):
+                hit = ("os.environ[...]", _env_name(node))
+            elif isinstance(node, ast.Compare) and \
+                    any(isinstance(op, (ast.In, ast.NotIn))
+                        for op in node.ops) and \
+                    any(_is_environ(mod, project, c)
+                        for c in node.comparators):
+                name = node.left.value \
+                    if isinstance(node.left, ast.Constant) and \
+                    isinstance(node.left.value, str) else "<dynamic>"
+                hit = ("in os.environ", name)
+            if hit is None:
+                continue
+            form, name = hit
+            findings.append(Finding(
+                rule="GC-E01", path=mod.relpath, line=node.lineno,
+                message=f"direct {form} read of {name!r} outside the "
+                        "declared-knob registry",
+                hint="declare the knob in mxnet_tpu/base.py and read it "
+                     "via env.get(name) (env.raw(name) for raw strings)",
+                symbol=f"{name}@{_enclosing(mod, node)}"))
+    return findings
+
+
+def _enclosing(mod: Module, node: ast.AST) -> str:
+    """Name of the function containing ``node`` (for stable keys)."""
+    best = "<module>"
+    best_span = None
+    for suffix, fn in mod.functions.items():
+        n = fn.node
+        end = getattr(n, "end_lineno", None)
+        if end is None:
+            continue
+        if n.lineno <= node.lineno <= end:
+            span = end - n.lineno
+            if best_span is None or span < best_span:
+                best, best_span = suffix, span
+    return best

@@ -1,0 +1,149 @@
+"""Finding model, baseline suppression, JSON output schema.
+
+A finding's ``key`` is its *stable identity* for baselining: rule id +
+file + the nearest named symbol (function qualname, lock id, env var…),
+NOT the line number — line numbers drift on every edit and a baseline
+keyed on them would rot immediately. Two findings of the same rule on the
+same symbol share a key; baselining one baselines both (acceptable: a
+justification is written per hazard, not per occurrence).
+
+JSON schema (``--json``), version 1::
+
+    {"version": 1,
+     "tool": "graftcheck",
+     "findings": [{"analyzer": str, "rule": str, "path": str,
+                   "line": int, "message": str, "hint": str,
+                   "key": str}, ...],          # unsuppressed only
+     "counts": {rule: int, ...},
+     "suppressed": int,
+     "stale_baseline": [key, ...]}
+
+Baseline file schema::
+
+    {"version": 1,
+     "findings": [{"key": str, "justification": str}, ...]}
+
+Every entry MUST carry a non-empty ``justification`` — an unjustified
+suppression is a configuration error (exit 2), not a suppression.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Tuple
+
+__all__ = ["Finding", "Baseline", "BaselineError", "to_json_payload",
+           "RULES"]
+
+#: rule id -> (analyzer, one-line description)
+RULES: Dict[str, Tuple[str, str]] = {
+    "GC-L01": ("lock-order", "cyclic lock-acquisition order"),
+    "GC-L02": ("lock-order", "bare .acquire() without try/finally release"),
+    "GC-L03": ("lock-order", "non-reentrant lock reachable from a "
+                             "weakref.finalize/__del__ callback"),
+    "GC-T01": ("trace-purity", "host clock read inside traced code"),
+    "GC-T02": ("trace-purity", "host RNG inside traced code"),
+    "GC-T03": ("trace-purity", "environment read inside traced code"),
+    "GC-T04": ("trace-purity", "module-global mutation inside traced code"),
+    "GC-D01": ("donation", "use of a buffer after it was donated"),
+    "GC-E01": ("env-discipline", "direct os.environ read outside base.py"),
+    "GC-M01": ("ledger-discipline", "persistent device allocation without "
+                                    "a telemetry.memory registration"),
+    "GC-X01": ("core", "file failed to parse"),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str          # repo-relative, forward slashes
+    line: int
+    message: str
+    hint: str
+    symbol: str        # stable context symbol for the baseline key
+
+    @property
+    def analyzer(self) -> str:
+        return RULES[self.rule][0]
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}:{self.path}:{self.symbol}"
+
+    def as_dict(self) -> Dict:
+        return {"analyzer": self.analyzer, "rule": self.rule,
+                "path": self.path, "line": self.line,
+                "message": self.message, "hint": self.hint,
+                "key": self.key}
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: {self.rule} [{self.analyzer}] "
+                f"{self.message} (hint: {self.hint})")
+
+
+class BaselineError(ValueError):
+    """Malformed baseline file (schema violation / missing justification)."""
+
+
+class Baseline:
+    def __init__(self, entries: Dict[str, str]):
+        self.entries = entries          # key -> justification
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                raw = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            raise BaselineError(f"cannot read baseline {path!r}: {e}")
+        if not isinstance(raw, dict) or raw.get("version") != 1 or \
+                not isinstance(raw.get("findings"), list):
+            raise BaselineError(
+                f"baseline {path!r}: expected "
+                "{'version': 1, 'findings': [...]}")
+        entries: Dict[str, str] = {}
+        for i, ent in enumerate(raw["findings"]):
+            if not isinstance(ent, dict) or \
+                    not isinstance(ent.get("key"), str):
+                raise BaselineError(f"baseline {path!r}: entry {i} has no "
+                                    "string 'key'")
+            just = ent.get("justification")
+            if not isinstance(just, str) or not just.strip():
+                raise BaselineError(
+                    f"baseline {path!r}: entry {ent['key']!r} has no "
+                    "justification — every grandfathered finding must say "
+                    "WHY it is acceptable")
+            entries[ent["key"]] = just
+        return cls(entries)
+
+    @classmethod
+    def empty(cls) -> "Baseline":
+        return cls({})
+
+    def split(self, findings: List[Finding]
+              ) -> Tuple[List[Finding], List[Finding], List[str]]:
+        """(unsuppressed, suppressed, stale_baseline_keys)."""
+        live, dead = [], []
+        seen = set()
+        for f in findings:
+            seen.add(f.key)
+            (dead if f.key in self.entries else live).append(f)
+        stale = sorted(k for k in self.entries if k not in seen)
+        return live, dead, stale
+
+
+def to_json_payload(unsuppressed: List[Finding], suppressed: List[Finding],
+                    stale: List[str]) -> Dict:
+    counts: Dict[str, int] = {}
+    for f in unsuppressed:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    return {"version": 1, "tool": "graftcheck",
+            "findings": [f.as_dict() for f in unsuppressed],
+            "counts": counts,
+            "suppressed": len(suppressed),
+            "stale_baseline": stale}
+
+
+def sort_findings(findings: List[Finding]) -> List[Finding]:
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule,
+                                           f.message))

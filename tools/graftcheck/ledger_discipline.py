@@ -1,0 +1,189 @@
+"""Ledger-discipline analyzer (GC-M01).
+
+The memory ledger (``telemetry/memory.py``) is *exact by construction*
+only because every module that owns persistent device buffers registers
+them at allocation time. A new allocation path in one of those modules
+that skips registration silently degrades the ledger from "exact" to
+"lower bound minus an unknown" — and the OOM forensics dump stops naming
+the true owner. This analyzer enforces the convention structurally:
+
+In each **ledger-owning module** (trainer buckets, staging, serving
+caches/AOT, optimizer state, ZeRO shards — configurable), any function
+that *persists* a freshly allocated device buffer — the allocation call's
+result (or the local it was bound to) is stored into a ``self.*``
+attribute, a ``self.*`` container, or an ``updater.states[...]``-style
+state dict — must ALSO contain a ``telemetry.memory`` registration call
+(``track_*`` / ``drop_*`` / ``ledger().set/attach`` /
+``register_cache_programs``) in the same function. Purely local buffers
+(warmup dummies, wire temps that are returned for the caller to ledger)
+are not flagged.
+
+Granularity is the enclosing function: the convention in this codebase
+is allocate-then-register within one scope (``Trainer._bucket_wire``,
+``grouped_update``, ``DeviceStagingIter._stage_one`` are the models).
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Sequence, Set
+
+from .findings import Finding
+from .project import FunctionInfo, Module, Project
+
+__all__ = ["analyze", "DEFAULT_LEDGER_MODULES"]
+
+#: repo-relative path suffixes of modules that own ledgered categories
+DEFAULT_LEDGER_MODULES = (
+    "mxnet_tpu/gluon/trainer.py",
+    "mxnet_tpu/io/staging.py",
+    "mxnet_tpu/serving/cache.py",
+    "mxnet_tpu/serving/aot.py",
+    "mxnet_tpu/optimizer/optimizer.py",
+    "mxnet_tpu/optimizer/grouped.py",
+    "mxnet_tpu/parallel/zero.py",
+)
+
+#: allocator call names (module attr or bare) that create device buffers
+_ALLOC_NAMES = {"zeros", "ones", "empty", "full", "zeros_like",
+                "ones_like", "full_like", "empty_like", "array",
+                "arange", "device_put", "NDArray", "from_jax"}
+
+#: memory-registration API surface (telemetry.memory attrs + ledger methods)
+_REGISTER_NAMES = {"track_ndarray", "track_param_data", "track_param_grad",
+                   "track_optimizer_state", "drop_optimizer_state",
+                   "drop_updater_states", "register_cache_programs",
+                   "attach", "set", "drop", "drop_owner", "drop_matching"}
+
+
+def _is_alloc_call(node: ast.Call) -> Optional[str]:
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in _ALLOC_NAMES:
+        return f.attr
+    if isinstance(f, ast.Name) and f.id in _ALLOC_NAMES:
+        return f.id
+    return None
+
+
+def _is_register_call(mod: Module, node: ast.Call) -> bool:
+    f = node.func
+    if not isinstance(f, ast.Attribute) or f.attr not in _REGISTER_NAMES:
+        return False
+    base = f.value
+    # _memory.track_x(...) / memory.track_x(...)
+    if isinstance(base, ast.Name):
+        alias = mod.imports.get(base.id) or \
+            (".".join(mod.from_objects[base.id])
+             if base.id in mod.from_objects else "")
+        if alias.endswith("telemetry.memory") or base.id in ("_memory",
+                                                            "memory"):
+            return True
+        # led = ledger(); led.set(...) — accept any receiver for the
+        # ledger-method names that are unambiguous
+        if f.attr in ("attach", "drop_owner", "drop_matching",
+                      "track_ndarray"):
+            return True
+        return False
+    # ledger().set(...) / _memory.ledger().attach(...)
+    if isinstance(base, ast.Call):
+        bf = base.func
+        if isinstance(bf, ast.Name) and bf.id == "ledger":
+            return True
+        if isinstance(bf, ast.Attribute) and bf.attr == "ledger":
+            return True
+    return False
+
+
+def _walk_own(root: ast.AST):
+    # breadth-first with FIFO order so sibling statements are visited in
+    # SOURCE order — the alloc-local tracking below is order-sensitive
+    # (`buf = zeros(...)` must be seen before `self._buf = buf`)
+    todo = [root]
+    while todo:
+        node = todo.pop(0)
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            todo.append(child)
+
+
+def _persistent_target(t: ast.expr) -> bool:
+    """self.<attr> / self.<attr>[...] / <name>.states[...] — stores that
+    outlive the function."""
+    if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) and \
+            t.value.id == "self":
+        return True
+    if isinstance(t, ast.Subscript):
+        v = t.value
+        if isinstance(v, ast.Attribute) and isinstance(v.value, ast.Name) \
+                and (v.value.id == "self" or v.attr == "states"):
+            return True
+    return False
+
+
+def _check_function(mod: Module, fn: FunctionInfo,
+                    findings: List[Finding]) -> None:
+    has_register = False
+    alloc_locals: Set[str] = set()          # locals bound to fresh buffers
+    persisted_alloc_line: Optional[int] = None
+    persisted_what: str = ""
+
+    for node in _walk_own(fn.node):
+        if isinstance(node, ast.Call) and _is_register_call(mod, node):
+            has_register = True
+
+    for node in _walk_own(fn.node):
+        if not isinstance(node, ast.Assign):
+            continue
+        is_alloc = isinstance(node.value, ast.Call) and \
+            _is_alloc_call(node.value) is not None
+        value_is_tracked_local = isinstance(node.value, ast.Name) and \
+            node.value.id in alloc_locals
+        for t in node.targets:
+            if is_alloc and isinstance(t, ast.Name):
+                alloc_locals.add(t.id)
+            if (is_alloc or value_is_tracked_local) and \
+                    _persistent_target(t) and persisted_alloc_line is None:
+                persisted_alloc_line = node.lineno
+                what = _is_alloc_call(node.value) \
+                    if is_alloc else node.value.id
+                persisted_what = str(what)
+        # appends into self containers: self._staged.append(alloc_or_local)
+    for node in _walk_own(fn.node):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "append" and node.args:
+            recv = node.func.value
+            if isinstance(recv, ast.Attribute) and \
+                    isinstance(recv.value, ast.Name) and \
+                    recv.value.id == "self":
+                a = node.args[0]
+                if (isinstance(a, ast.Call) and _is_alloc_call(a)) or \
+                        (isinstance(a, ast.Name) and a.id in alloc_locals):
+                    if persisted_alloc_line is None:
+                        persisted_alloc_line = node.lineno
+                        persisted_what = f"append to self.{recv.attr}"
+
+    if persisted_alloc_line is not None and not has_register:
+        findings.append(Finding(
+            rule="GC-M01", path=mod.relpath, line=persisted_alloc_line,
+            message=f"{fn.qualname} persists a fresh device buffer "
+                    f"({persisted_what}) without a telemetry.memory "
+                    "registration in the same scope",
+            hint="register it (memory.track_ndarray / ledger().attach / "
+                 "track_optimizer_state) so the live-byte ledger stays "
+                 "exact and OOM forensics can name the owner",
+            symbol=fn.qualname))
+
+
+def analyze(project: Project,
+            ledger_modules: Sequence[str] = DEFAULT_LEDGER_MODULES
+            ) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in project.modules.values():
+        rp = mod.relpath.replace("\\", "/")
+        if not any(rp.endswith(sfx) for sfx in ledger_modules):
+            continue
+        for fn in mod.functions.values():
+            _check_function(mod, fn, findings)
+    return findings

@@ -1,0 +1,408 @@
+"""Lock-order analyzer (GC-L01/L02/L03).
+
+Builds the interprocedural lock-acquisition graph over every
+``threading.Lock``/``RLock`` the project defines — module-level globals
+(``_track_lock = threading.Lock()``) and instance attributes
+(``self._lock = threading.RLock()`` in any method) — from ``with``
+statements and bare ``.acquire()`` calls, then checks three properties:
+
+- **GC-L01 (cycle)**: the acquisition graph has a cycle: thread 1 takes
+  A then B while thread 2 takes B then A -> deadlock. A self-edge on a
+  non-reentrant Lock (a function that acquires a lock it already holds,
+  possibly through calls) is a cycle of length 1 and self-deadlocks with
+  no second thread needed.
+- **GC-L02 (bare acquire)**: ``lock.acquire()`` not immediately followed
+  by ``try: ... finally: lock.release()`` — an exception between acquire
+  and release leaks the lock forever. Prefer ``with lock:``.
+- **GC-L03 (finalizer lock)**: a lock acquired (transitively) from a
+  ``weakref.finalize`` callback or a ``__del__`` method must be an RLock:
+  cyclic GC can fire the callback synchronously on the thread that
+  already holds the lock (any allocation can trigger collection), so a
+  plain Lock self-deadlocks. This is the PR 8 ledger bug, generalized.
+
+Interprocedural edges are computed from the project call graph: holding A
+while calling f() adds an edge A -> every lock f acquires transitively.
+Unresolvable calls (dynamic dispatch, foreign libraries) contribute
+nothing — conservative by design.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .findings import Finding
+from .project import FunctionInfo, Project
+
+__all__ = ["analyze"]
+
+
+class _LockRef:
+    __slots__ = ("lock_id", "kind")
+
+    def __init__(self, lock_id: str, kind: str):
+        self.lock_id = lock_id   # "<modname>:<name>" or "<modname>:<Class>.<attr>"
+        self.kind = kind         # "Lock" | "RLock"
+
+
+def _lock_index(project: Project) -> Dict[str, str]:
+    """All known locks: id -> kind."""
+    out: Dict[str, str] = {}
+    for mod in project.modules.values():
+        for name, kind in mod.global_locks.items():
+            out[f"{mod.modname}:{name}"] = kind
+        for cls in mod.classes.values():
+            for attr, kind in cls.attr_locks.items():
+                out[f"{mod.modname}:{cls.name}.{attr}"] = kind
+    return out
+
+
+def _resolve_lock(project: Project, fn: FunctionInfo,
+                  expr: ast.expr) -> Optional[str]:
+    """Lock id an expression refers to, or None."""
+    mod = fn.module
+    if isinstance(expr, ast.Name):
+        if expr.id in mod.global_locks:
+            return f"{mod.modname}:{expr.id}"
+        if expr.id in mod.from_objects:
+            m, orig = mod.from_objects[expr.id]
+            target = project.modules.get(m)
+            if target is not None and orig in target.global_locks:
+                return f"{m}:{orig}"
+        return None
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+        base = expr.value.id
+        if base in ("self", "cls") and fn.class_name:
+            cls = mod.classes.get(fn.class_name)
+            if cls is not None:
+                for c in project.class_mro(cls):
+                    if expr.attr in c.attr_locks:
+                        return f"{c.module.modname}:{c.name}.{expr.attr}"
+            return None
+        dotted = mod.module_alias(base, project)
+        if dotted is not None:
+            target = project.modules.get(dotted)
+            if target is not None and expr.attr in target.global_locks:
+                return f"{dotted}:{expr.attr}"
+            return None
+        inst = project.instance_class(mod, base)
+        if inst is not None:
+            for c in project.class_mro(inst):
+                if expr.attr in c.attr_locks:
+                    return f"{c.module.modname}:{c.name}.{expr.attr}"
+    return None
+
+
+class _FnFacts:
+    """Per-function lock facts gathered in one AST pass."""
+
+    __slots__ = ("direct", "nest_edges", "calls", "bare_acquires")
+
+    def __init__(self):
+        self.direct: Set[str] = set()
+        #: (held_lock, acquired_lock, line) from syntactic with-nesting
+        self.nest_edges: List[Tuple[str, str, int]] = []
+        #: (callee FunctionInfo, frozenset(held), line)
+        self.calls: List[Tuple[FunctionInfo, frozenset, int]] = []
+        #: (lock_id, line) for .acquire() without try/finally release
+        self.bare_acquires: List[Tuple[str, int]] = []
+
+
+def _release_target(stmt: ast.stmt) -> Optional[ast.expr]:
+    if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call) and \
+            isinstance(stmt.value.func, ast.Attribute) and \
+            stmt.value.func.attr == "release":
+        return stmt.value.func.value
+    return None
+
+
+def _acquire_guarded(body: List[ast.stmt], idx: int,
+                     lock_expr: ast.expr) -> bool:
+    """Is statement ``body[idx]`` (an acquire) followed by a try whose
+    finally releases the same lock expression?"""
+    if idx + 1 >= len(body):
+        return False
+    nxt = body[idx + 1]
+    if not isinstance(nxt, ast.Try) or not nxt.finalbody:
+        return False
+    want = ast.dump(lock_expr)
+    for stmt in nxt.finalbody:
+        rel = _release_target(stmt)
+        if rel is not None and ast.dump(rel) == want:
+            return True
+    return False
+
+
+def _gather(project: Project, fn: FunctionInfo) -> _FnFacts:
+    facts = _FnFacts()
+
+    def stmt_acquire_call(stmt: ast.stmt) -> Optional[ast.Call]:
+        val = None
+        if isinstance(stmt, ast.Expr):
+            val = stmt.value
+        elif isinstance(stmt, ast.Assign):
+            val = stmt.value
+        if isinstance(val, ast.Call) and \
+                isinstance(val.func, ast.Attribute) and \
+                val.func.attr == "acquire":
+            return val
+        return None
+
+    def walk_body(body: List[ast.stmt], held: Tuple[str, ...]) -> None:
+        for idx, stmt in enumerate(body):
+            acq = stmt_acquire_call(stmt)
+            if acq is not None:
+                lock = _resolve_lock(project, fn, acq.func.value)
+                if lock is not None:
+                    facts.direct.add(lock)
+                    for h in held:
+                        facts.nest_edges.append((h, lock, stmt.lineno))
+                    if not _acquire_guarded(body, idx, acq.func.value):
+                        facts.bare_acquires.append((lock, stmt.lineno))
+            walk_stmt(stmt, held)
+
+    def walk_stmt(stmt: ast.stmt, held: Tuple[str, ...]) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs analyzed as their own functions
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            new_held = held
+            for item in stmt.items:
+                lock = _resolve_lock(project, fn, item.context_expr)
+                if lock is not None:
+                    facts.direct.add(lock)
+                    for h in new_held:
+                        facts.nest_edges.append((h, lock, stmt.lineno))
+                    new_held = new_held + (lock,)
+                else:
+                    scan_calls(item.context_expr, held)
+            walk_body(stmt.body, new_held)
+            return
+        # record calls in this statement's expressions, then recurse into
+        # sub-blocks with the same held set
+        for field, value in ast.iter_fields(stmt):
+            if isinstance(value, list):
+                if all(isinstance(v, ast.stmt) for v in value) and value:
+                    walk_body(value, held)
+                else:
+                    for v in value:
+                        if isinstance(v, ast.expr):
+                            scan_calls(v, held)
+                        elif isinstance(v, ast.excepthandler):
+                            walk_body(v.body, held)
+            elif isinstance(value, ast.expr):
+                scan_calls(value, held)
+
+    def scan_calls(expr: ast.expr, held: Tuple[str, ...]) -> None:
+        # manual walk that does NOT descend into lambdas: a lambda body
+        # executes later (often after the lock is released), so charging
+        # its calls to the current held-set would fabricate edges
+        todo: List[ast.AST] = [expr]
+        while todo:
+            node = todo.pop()
+            if isinstance(node, ast.Lambda):
+                continue
+            if isinstance(node, ast.Call):
+                callee = project.resolve_call(fn.module, fn, node.func)
+                if callee is not None:
+                    facts.calls.append((callee, frozenset(held),
+                                        node.lineno))
+            todo.extend(ast.iter_child_nodes(node))
+
+    walk_body(fn.node.body, ())
+    return facts
+
+
+def _closure(all_facts: Dict[str, _FnFacts], qualname: str,
+             memo: Dict[str, Set[str]],
+             visiting: Set[str]) -> Set[str]:
+    """Locks acquired by calling ``qualname``, transitively."""
+    if qualname in memo:
+        return memo[qualname]
+    if qualname in visiting:
+        return set()  # recursion: contributes nothing new on this path
+    visiting.add(qualname)
+    facts = all_facts.get(qualname)
+    out: Set[str] = set()
+    if facts is not None:
+        out |= facts.direct
+        for callee, _held, _line in facts.calls:
+            out |= _closure(all_facts, callee.qualname, memo, visiting)
+    visiting.discard(qualname)
+    memo[qualname] = out
+    return out
+
+
+def _finalize_callbacks(project: Project
+                        ) -> List[Tuple[FunctionInfo, FunctionInfo, int]]:
+    """(registering_fn, callback_fn, line) for each weakref.finalize."""
+    out = []
+    for mod in project.modules.values():
+        for fn in mod.functions.values():
+            for node in ast.walk(fn.node):
+                if not isinstance(node, ast.Call) or len(node.args) < 2:
+                    continue
+                dotted = project.dotted_of(mod, node.func)
+                is_fin = dotted == "weakref.finalize" or (
+                    isinstance(node.func, ast.Name) and
+                    mod.from_objects.get(node.func.id) ==
+                    ("weakref", "finalize"))
+                if not is_fin:
+                    continue
+                cb = project.resolve_call(mod, fn, node.args[1]) \
+                    if isinstance(node.args[1],
+                                  (ast.Name, ast.Attribute)) else None
+                if cb is not None:
+                    out.append((fn, cb, node.lineno))
+    return out
+
+
+def _cycles(graph: Dict[str, Set[str]]) -> List[List[str]]:
+    """Strongly connected components of size > 1 (Tarjan, iterative-ish)."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    counter = [0]
+    sccs: List[List[str]] = []
+
+    def strongconnect(v: str) -> None:
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        for w in sorted(graph.get(v, ())):
+            if w not in index:
+                strongconnect(w)
+                low[v] = min(low[v], low[w])
+            elif w in on_stack:
+                low[v] = min(low[v], index[w])
+        if low[v] == index[v]:
+            comp = []
+            while True:
+                w = stack.pop()
+                on_stack.discard(w)
+                comp.append(w)
+                if w == v:
+                    break
+            if len(comp) > 1:
+                sccs.append(sorted(comp))
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+    return sccs
+
+
+def _short(lock_id: str) -> str:
+    mod, name = lock_id.split(":", 1)
+    return f"{mod.rsplit('.', 1)[-1]}.{name}"
+
+
+def analyze(project: Project) -> List[Finding]:
+    locks = _lock_index(project)
+    if not locks:
+        return []
+    all_facts: Dict[str, _FnFacts] = {}
+    for mod in project.modules.values():
+        for fn in mod.functions.values():
+            all_facts[fn.qualname] = _gather(project, fn)
+
+    memo: Dict[str, Set[str]] = {}
+    findings: List[Finding] = []
+
+    # -- GC-L02: bare acquires ------------------------------------------
+    for mod in project.modules.values():
+        for fn in mod.functions.values():
+            for lock, line in all_facts[fn.qualname].bare_acquires:
+                findings.append(Finding(
+                    # the ACQUIRING module owns the site — the lock may
+                    # be defined in another file entirely
+                    rule="GC-L02", path=mod.relpath,
+                    line=line,
+                    message=f"{_short(lock)}.acquire() in {fn.qualname} "
+                            "has no try/finally release",
+                    hint="use 'with lock:' or follow the acquire with "
+                         "try/finally releasing it",
+                    symbol=f"{fn.qualname}:{_short(lock)}"))
+
+    # -- acquisition graph: edges with provenance -----------------------
+    graph: Dict[str, Set[str]] = {lid: set() for lid in locks}
+    edge_sites: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    for mod in project.modules.values():
+        for fn in mod.functions.values():
+            facts = all_facts[fn.qualname]
+            for a, b, line in facts.nest_edges:
+                if a != b or locks.get(a) == "Lock":
+                    graph.setdefault(a, set()).add(b)
+                    edge_sites.setdefault((a, b),
+                                          (mod.relpath, line))
+            for callee, held, line in facts.calls:
+                if not held:
+                    continue
+                reach = _closure(all_facts, callee.qualname, memo, set())
+                for a in held:
+                    for b in reach:
+                        if a == b and locks.get(a) == "RLock":
+                            continue  # reentrant re-acquire is legal
+                        graph.setdefault(a, set()).add(b)
+                        edge_sites.setdefault(
+                            (a, b), (mod.relpath, line))
+
+    # -- GC-L01: cycles (incl. non-reentrant self-edges) ----------------
+    for comp in _cycles(graph):
+        chain = " -> ".join(_short(x) for x in comp + [comp[0]])
+        path, line = edge_sites.get((comp[0], comp[1 % len(comp)]),
+                                    ("", 0))
+        findings.append(Finding(
+            rule="GC-L01", path=path or _relpath(project, comp[0], None),
+            line=line,
+            message=f"cyclic lock acquisition order: {chain}",
+            hint="impose a fixed acquisition order (or merge the locks); "
+                 "a cycle deadlocks under concurrency",
+            symbol="|".join(comp)))
+    for lid, kind in sorted(locks.items()):
+        if kind == "Lock" and lid in graph.get(lid, set()):
+            path, line = edge_sites.get((lid, lid), ("", 0))
+            findings.append(Finding(
+                rule="GC-L01",
+                path=path or _relpath(project, lid, None), line=line,
+                message=f"non-reentrant {_short(lid)} re-acquired while "
+                        "already held (self-deadlock)",
+                hint="make it an RLock, or restructure so the inner "
+                     "path does not re-acquire",
+                symbol=lid))
+
+    # -- GC-L03: plain Lock reachable from finalizer/__del__ ------------
+    def check_callback(cb: FunctionInfo, site_path: str, line: int,
+                       what: str) -> None:
+        reach = _closure(all_facts, cb.qualname, memo, set())
+        for lid in sorted(reach):
+            if locks.get(lid) != "Lock":
+                continue
+            findings.append(Finding(
+                rule="GC-L03", path=site_path, line=line,
+                message=f"{what} reaches non-reentrant {_short(lid)} "
+                        f"(via {cb.qualname}); GC can run it on a thread "
+                        "already holding the lock",
+                hint="make the lock an RLock (see cached_op._track_lock "
+                     "for the pattern), or defer the work off-thread",
+                symbol=f"{cb.qualname}:{lid}"))
+
+    for reg_fn, cb, line in _finalize_callbacks(project):
+        check_callback(cb, reg_fn.module.relpath, line,
+                       "weakref.finalize callback")
+    for mod in project.modules.values():
+        for cls in mod.classes.values():
+            dtor = cls.methods.get("__del__")
+            if dtor is not None:
+                check_callback(dtor, mod.relpath, dtor.node.lineno,
+                               f"{cls.name}.__del__")
+    return findings
+
+
+def _relpath(project: Project, lock_id: str, fallback) -> str:
+    mod = project.modules.get(lock_id.split(":", 1)[0])
+    if mod is not None:
+        return mod.relpath
+    if fallback is not None:
+        return fallback.relpath
+    return "<unknown>"

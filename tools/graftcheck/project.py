@@ -1,0 +1,407 @@
+"""Project model for graftcheck: parsed modules, name resolution, call graph.
+
+Everything is stdlib ``ast`` — no imports of the analyzed code ever happen
+(the suite must run in a bare interpreter and must not trigger jax/TPU
+initialization). Resolution is deliberately conservative: when a name
+cannot be resolved confidently it resolves to ``None`` and the analyzers
+stay silent, because a framework gate that cries wolf gets baselined into
+uselessness.
+
+Naming conventions used throughout:
+
+- *modname*: dotted module path derived from the file path relative to the
+  repo root (``mxnet_tpu/telemetry/memory.py`` -> ``mxnet_tpu.telemetry.memory``).
+- *qualname*: ``<modname>:<Class>.<method>`` / ``<modname>:<func>`` /
+  ``<modname>:<outer>.<locals>.<inner>`` for nested defs.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["Project", "Module", "FunctionInfo", "ClassInfo", "load_project"]
+
+
+class FunctionInfo:
+    """One function/method/nested def (or lambda wrapped as a pseudo-def)."""
+
+    __slots__ = ("qualname", "node", "module", "class_name", "parent")
+
+    def __init__(self, qualname: str, node: ast.AST, module: "Module",
+                 class_name: Optional[str], parent: Optional["FunctionInfo"]):
+        self.qualname = qualname
+        self.node = node
+        self.module = module
+        self.class_name = class_name
+        self.parent = parent
+
+    @property
+    def body(self) -> List[ast.stmt]:
+        return self.node.body
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"<fn {self.qualname}>"
+
+
+class ClassInfo:
+    __slots__ = ("name", "node", "module", "bases", "methods", "attr_locks")
+
+    def __init__(self, name: str, node: ast.ClassDef, module: "Module"):
+        self.name = name
+        self.node = node
+        self.module = module
+        # base-class *names* as written (resolved lazily via the module)
+        self.bases: List[str] = []
+        for b in node.bases:
+            if isinstance(b, ast.Name):
+                self.bases.append(b.id)
+            elif isinstance(b, ast.Attribute):
+                self.bases.append(b.attr)
+        self.methods: Dict[str, FunctionInfo] = {}
+        # attr name -> "Lock" | "RLock" for self.<attr> = threading.Lock()
+        self.attr_locks: Dict[str, str] = {}
+
+
+class Module:
+    def __init__(self, path: str, relpath: str, modname: str,
+                 tree: ast.Module):
+        self.path = path
+        self.relpath = relpath
+        self.modname = modname
+        self.tree = tree
+        self.package = modname.rsplit(".", 1)[0] if "." in modname else ""
+        if os.path.basename(relpath) == "__init__.py":
+            self.package = modname
+        #: local alias -> dotted module ("np" -> "numpy")
+        self.imports: Dict[str, str] = {}
+        #: local name -> (dotted module, original name) for from-imports
+        self.from_objects: Dict[str, Tuple[str, str]] = {}
+        #: top-level functions + methods + nested defs, by qual suffix
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        #: module-level ``NAME = <expr>`` (last assignment wins)
+        self.global_assigns: Dict[str, ast.expr] = {}
+        #: module-level lock name -> "Lock" | "RLock"
+        self.global_locks: Dict[str, str] = {}
+
+    # -- import handling -------------------------------------------------
+    def _resolve_relative(self, node: ast.ImportFrom) -> Optional[str]:
+        if node.level == 0:
+            return node.module
+        # level=1 strips nothing below the current package, level=2 one
+        # package, etc. (self.package already excludes the module name)
+        parts = self.package.split(".") if self.package else []
+        up = node.level - 1
+        if up > len(parts):
+            return None
+        base = parts[:len(parts) - up]
+        if node.module:
+            base = base + node.module.split(".")
+        return ".".join(base) if base else None
+
+    def add_import(self, node: ast.stmt) -> None:
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                local = alias.asname or alias.name.split(".")[0]
+                target = alias.name if alias.asname else alias.name.split(".")[0]
+                self.imports[local] = target
+        elif isinstance(node, ast.ImportFrom):
+            mod = self._resolve_relative(node)
+            if mod is None:
+                return
+            for alias in node.names:
+                local = alias.asname or alias.name
+                self.from_objects[local] = (mod, alias.name)
+
+    def module_alias(self, name: str, project: "Project") -> Optional[str]:
+        """Dotted module a bare local name refers to, if any (covers both
+        ``import x as name`` and ``from pkg import submod as name``)."""
+        if name in self.imports:
+            return self.imports[name]
+        if name in self.from_objects:
+            mod, orig = self.from_objects[name]
+            cand = f"{mod}.{orig}"
+            if cand in project.modules or project.is_external_module(cand):
+                return cand
+        return None
+
+
+class Project:
+    def __init__(self, root: str):
+        self.root = root
+        self.modules: Dict[str, Module] = {}
+        self.by_relpath: Dict[str, Module] = {}
+        #: dotted names treated as modules even though not scanned
+        self._external = {"threading", "os", "time", "random", "weakref",
+                          "numpy", "numpy.random", "jax", "jax.numpy",
+                          "jax.random", "functools", "pickle", "json"}
+
+    def is_external_module(self, dotted: str) -> bool:
+        return dotted in self._external or dotted.split(".")[0] in {
+            "jax", "numpy"}
+
+    def add(self, mod: Module) -> None:
+        self.modules[mod.modname] = mod
+        self.by_relpath[mod.relpath] = mod
+
+    # -- class / function lookup ----------------------------------------
+    def find_class(self, module: Module, name: str) -> Optional[ClassInfo]:
+        if name in module.classes:
+            return module.classes[name]
+        if name in module.from_objects:
+            m, orig = module.from_objects[name]
+            target = self.modules.get(m)
+            if target is not None:
+                return target.classes.get(orig)
+        return None
+
+    def class_mro(self, cls: ClassInfo) -> List[ClassInfo]:
+        """Best-effort MRO over project-local classes (linear, no C3)."""
+        out, seen, todo = [], set(), [cls]
+        while todo:
+            c = todo.pop(0)
+            if id(c) in seen:
+                continue
+            seen.add(id(c))
+            out.append(c)
+            for b in c.bases:
+                parent = self.find_class(c.module, b)
+                if parent is not None:
+                    todo.append(parent)
+        return out
+
+    def find_method(self, cls: ClassInfo, name: str) -> Optional[FunctionInfo]:
+        for c in self.class_mro(cls):
+            if name in c.methods:
+                return c.methods[name]
+        return None
+
+    def instance_class(self, module: Module, name: str) -> Optional[ClassInfo]:
+        """Class of a module-level ``NAME = ClassName(...)`` singleton."""
+        val = module.global_assigns.get(name)
+        if isinstance(val, ast.Call) and isinstance(val.func, ast.Name):
+            return self.find_class(module, val.func.id)
+        if name in module.from_objects:
+            m, orig = module.from_objects[name]
+            target = self.modules.get(m)
+            if target is not None and orig in target.global_assigns:
+                return self.instance_class(target, orig)
+        return None
+
+    def _local_function(self, module: Module, scope: Optional[FunctionInfo],
+                        name: str) -> Optional[FunctionInfo]:
+        # nested defs shadow module-level names, innermost first
+        fn = scope
+        while fn is not None:
+            key = f"{_suffix(fn.qualname)}.<locals>.{name}"
+            if key in module.functions:
+                return module.functions[key]
+            fn = fn.parent
+        if name in module.functions:
+            return module.functions[name]
+        if name in module.from_objects:
+            m, orig = module.from_objects[name]
+            target = self.modules.get(m)
+            if target is not None:
+                return target.functions.get(orig)
+        return None
+
+    def resolve_call(self, module: Module, scope: Optional[FunctionInfo],
+                     func: ast.expr) -> Optional[FunctionInfo]:
+        """Resolve a call's target function, conservatively."""
+        if isinstance(func, ast.Name):
+            return self._local_function(module, scope, func.id)
+        if isinstance(func, ast.Attribute):
+            base = func.value
+            if isinstance(base, ast.Name):
+                # self.meth() / cls.meth()
+                if base.id in ("self", "cls") and scope is not None and \
+                        scope.class_name:
+                    cls = module.classes.get(scope.class_name)
+                    if cls is not None:
+                        return self.find_method(cls, func.attr)
+                    return None
+                # Module alias: mod.func()
+                dotted = module.module_alias(base.id, self)
+                if dotted is not None:
+                    target = self.modules.get(dotted)
+                    return target.functions.get(func.attr) if target else None
+                # ClassName.method()
+                cls = self.find_class(module, base.id)
+                if cls is not None:
+                    return self.find_method(cls, func.attr)
+                # module-level singleton instance: _LEDGER.drop()
+                inst = self.instance_class(module, base.id)
+                if inst is not None:
+                    return self.find_method(inst, func.attr)
+            elif isinstance(base, ast.Call):
+                # accessor().method(): resolve the accessor's return value
+                inner = self.resolve_call(module, scope, base.func)
+                if inner is not None:
+                    ret = _sole_returned_name(inner.node)
+                    if ret is not None:
+                        inst = self.instance_class(inner.module, ret)
+                        if inst is not None:
+                            return self.find_method(inst, func.attr)
+        return None
+
+    def dotted_of(self, module: Module, expr: ast.expr) -> Optional[str]:
+        """Dotted path of an attribute/name chain rooted at an imported
+        module (``np.random`` -> ``numpy.random``), else None."""
+        parts: List[str] = []
+        node = expr
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        root = module.module_alias(node.id, self)
+        if root is None:
+            return None
+        return ".".join([root] + list(reversed(parts)))
+
+
+def _suffix(qualname: str) -> str:
+    return qualname.split(":", 1)[1] if ":" in qualname else qualname
+
+
+def _sole_returned_name(fn_node: ast.AST) -> Optional[str]:
+    """If every return statement of ``fn_node`` returns the same bare
+    Name, that name — the 'accessor' pattern (``def ledger(): ...;
+    return _LEDGER``)."""
+    names = set()
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Return) and node.value is not None:
+            if isinstance(node.value, ast.Name):
+                names.add(node.value.id)
+            else:
+                return None
+    return names.pop() if len(names) == 1 else None
+
+
+def _is_lock_ctor(module: Module, call: ast.expr) -> Optional[str]:
+    """'Lock'/'RLock' when ``call`` constructs a threading lock."""
+    if not isinstance(call, ast.Call):
+        return None
+    f = call.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        if module.imports.get(f.value.id) == "threading" and \
+                f.attr in ("Lock", "RLock"):
+            return f.attr
+    if isinstance(f, ast.Name) and f.id in module.from_objects:
+        mod, orig = module.from_objects[f.id]
+        if mod == "threading" and orig in ("Lock", "RLock"):
+            return orig
+    return None
+
+
+def _index_functions(module: Module) -> None:
+    def visit_body(body: Sequence[ast.stmt], class_name: Optional[str],
+                   parent: Optional[FunctionInfo], prefix: str) -> None:
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                suffix = f"{prefix}{node.name}"
+                info = FunctionInfo(f"{module.modname}:{suffix}", node,
+                                    module, class_name, parent)
+                module.functions[suffix] = info
+                if class_name is not None and prefix.count(".") == 1:
+                    module.classes[class_name].methods[node.name] = info
+                visit_body(node.body, class_name, info,
+                           f"{suffix}.<locals>.")
+            elif isinstance(node, ast.ClassDef):
+                cls = ClassInfo(node.name, node, module)
+                module.classes[node.name] = cls
+                visit_body(node.body, node.name, None, f"{node.name}.")
+            elif isinstance(node, (ast.If, ast.Try, ast.With, ast.For,
+                                   ast.While)):
+                # defs under module-level guards (TYPE_CHECKING, try) —
+                # index them at the same scope
+                inner: List[ast.stmt] = []
+                for field in ("body", "orelse", "finalbody"):
+                    inner.extend(getattr(node, field, []) or [])
+                for h in getattr(node, "handlers", []) or []:
+                    inner.extend(h.body)
+                visit_body(inner, class_name, parent, prefix)
+
+    visit_body(module.tree.body, None, None, "")
+
+
+def _index_globals_and_locks(module: Module) -> None:
+    # imports are indexed from the WHOLE tree: the lazy function-local
+    # `import os` / `import jax` idiom is pervasive in this codebase, and
+    # module-granular alias maps are accurate enough for analysis (nobody
+    # rebinds `os` to something else in another scope)
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            module.add_import(node)
+    for node in module.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            module.global_assigns[name] = node.value
+            kind = _is_lock_ctor(module, node.value)
+            if kind is not None:
+                module.global_locks[name] = kind
+        elif isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name) and node.value is not None:
+            module.global_assigns[node.target.id] = node.value
+    # instance locks: self.<attr> = threading.Lock() in any method
+    for cls in module.classes.values():
+        for meth in cls.methods.values():
+            for sub in ast.walk(meth.node):
+                if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                    t = sub.targets[0]
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == "self":
+                        kind = _is_lock_ctor(module, sub.value)
+                        if kind is not None:
+                            cls.attr_locks[t.attr] = kind
+
+
+def _modname_for(relpath: str) -> str:
+    parts = relpath[:-3].split(os.sep)  # strip .py
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def collect_files(root: str, paths: Sequence[str]) -> List[str]:
+    """Expand CLI path arguments into sorted .py file lists."""
+    out: List[str] = []
+    for p in paths:
+        ap = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(ap) and ap.endswith(".py"):
+            out.append(ap)
+        elif os.path.isdir(ap):
+            for dirpath, dirnames, filenames in os.walk(ap):
+                dirnames[:] = [d for d in sorted(dirnames)
+                               if d not in ("__pycache__", ".git")]
+                for f in sorted(filenames):
+                    if f.endswith(".py"):
+                        out.append(os.path.join(dirpath, f))
+    return sorted(set(out))
+
+
+def load_project(root: str, paths: Sequence[str]) -> Tuple["Project", List]:
+    """Parse every .py under ``paths`` into a Project. Returns the project
+    plus a list of (relpath, lineno, error) parse failures — a file the
+    suite cannot parse is itself reported as a finding by the runner."""
+    project = Project(root)
+    errors: List[Tuple[str, int, str]] = []
+    for path in collect_files(root, paths):
+        relpath = os.path.relpath(path, root)
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                src = f.read()
+            tree = ast.parse(src, filename=relpath)
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            lineno = getattr(e, "lineno", 0) or 0
+            errors.append((relpath, lineno, f"{type(e).__name__}: {e}"))
+            continue
+        mod = Module(path, relpath, _modname_for(relpath), tree)
+        _index_functions(mod)
+        _index_globals_and_locks(mod)
+        project.add(mod)
+    return project, errors

@@ -1,0 +1,67 @@
+"""Suite runner: load the project once, run every analyzer, apply the
+baseline."""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+from . import (donation, env_discipline, ledger_discipline, lock_order,
+               trace_purity)
+from .findings import Baseline, Finding, sort_findings
+from .ledger_discipline import DEFAULT_LEDGER_MODULES
+from .project import load_project
+
+__all__ = ["SuiteConfig", "SuiteResult", "run_suite", "ANALYZERS"]
+
+#: analyzer name -> callable(project, config) -> findings
+ANALYZERS = ("lock-order", "trace-purity", "donation", "env-discipline",
+             "ledger-discipline")
+
+
+@dataclasses.dataclass
+class SuiteConfig:
+    root: str
+    paths: Sequence[str]
+    baseline: Optional[Baseline] = None
+    analyzers: Sequence[str] = ANALYZERS
+    ledger_modules: Sequence[str] = DEFAULT_LEDGER_MODULES
+    env_allowed_suffixes: Sequence[str] = ("mxnet_tpu/base.py",)
+
+
+@dataclasses.dataclass
+class SuiteResult:
+    unsuppressed: List[Finding]
+    suppressed: List[Finding]
+    stale_baseline: List[str]
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.unsuppressed else 0
+
+
+def run_suite(config: SuiteConfig) -> SuiteResult:
+    project, parse_errors = load_project(config.root, config.paths)
+    findings: List[Finding] = []
+    for relpath, lineno, err in parse_errors:
+        findings.append(Finding(
+            rule="GC-X01", path=relpath, line=lineno,
+            message=f"file failed to parse: {err}",
+            hint="fix the syntax error; unparseable files are invisible "
+                 "to every analyzer", symbol="parse"))
+    if "lock-order" in config.analyzers:
+        findings.extend(lock_order.analyze(project))
+    if "trace-purity" in config.analyzers:
+        findings.extend(trace_purity.analyze(project))
+    if "donation" in config.analyzers:
+        findings.extend(donation.analyze(project))
+    if "env-discipline" in config.analyzers:
+        findings.extend(env_discipline.analyze(
+            project, allowed_suffixes=tuple(config.env_allowed_suffixes)))
+    if "ledger-discipline" in config.analyzers:
+        findings.extend(ledger_discipline.analyze(
+            project, ledger_modules=tuple(config.ledger_modules)))
+    findings = sort_findings(findings)
+    baseline = config.baseline or Baseline.empty()
+    live, dead, stale = baseline.split(findings)
+    return SuiteResult(unsuppressed=live, suppressed=dead,
+                       stale_baseline=stale)

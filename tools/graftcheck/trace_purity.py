@@ -1,0 +1,337 @@
+"""Trace-purity analyzer (GC-T01..T04).
+
+A function handed to ``jax.jit`` / ``pl.pallas_call`` executes ONCE per
+signature at trace time, then never again — any host-side value it reads
+is baked into the compiled program as a constant, and any host-side
+mutation it performs silently stops happening on cache hits. The four
+impurity classes this flags, inside trace-reachable code:
+
+- **GC-T01**: host clock reads (``time.time()``, ``time.perf_counter()``…)
+  — the traced program forever reports the timestamp of its first trace.
+- **GC-T02**: host RNG (``random.*``, ``np.random.*``) — the "random"
+  value is a compile-time constant; every step reuses the same draw. Use
+  ``jax.random`` with explicit keys instead (not flagged).
+- **GC-T03**: environment reads (``os.environ``/``os.getenv`` or the
+  ``base.env`` registry) — the knob's value at first trace wins forever,
+  UNLESS the read value is also part of the program's cache key (the
+  ``MXTPU_FUSED_EPILOGUE`` discipline); sites doing that legitimately
+  belong in the baseline with that justification.
+- **GC-T04**: mutation of module globals (``global X`` assignment, or
+  subscript/attribute stores into a module-level object) — happens at
+  trace time only, so counters/caches silently stop updating once the
+  program is cached.
+
+Entry points are discovered structurally: any function object passed to
+``*.jit(...)`` (covers ``jax.jit`` and the ``_jax().jit`` lazy-import
+idiom), ``@jit``-style decorators, ``functools.partial(jax.jit, ...)``,
+and ``pl.pallas_call(kernel, ...)``. Lambdas are scanned in place.
+Reachability then follows the project call graph (conservative: dynamic
+calls contribute nothing).
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set, Tuple
+
+from .findings import Finding
+from .project import FunctionInfo, Module, Project
+
+__all__ = ["analyze"]
+
+
+def _is_jit_call(mod: Module, project: Project, call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr == "jit":
+        return True
+    if isinstance(f, ast.Name):
+        if mod.from_objects.get(f.id, ("", ""))[0] == "jax" and \
+                mod.from_objects[f.id][1] == "jit":
+            return True
+    return False
+
+
+def _is_pallas_call(mod: Module, project: Project, call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr == "pallas_call":
+        return True
+    if isinstance(f, ast.Name) and \
+            mod.from_objects.get(f.id, ("", ""))[1] == "pallas_call":
+        return True
+    return False
+
+
+def _is_partial(mod: Module, project: Project, call: ast.Call) -> bool:
+    dotted = project.dotted_of(mod, call.func)
+    if dotted == "functools.partial":
+        return True
+    return isinstance(call.func, ast.Name) and \
+        mod.from_objects.get(call.func.id) == ("functools", "partial")
+
+
+def _resolve_traced_arg(project: Project, mod: Module,
+                        scope: Optional[FunctionInfo], expr: ast.expr,
+                        depth: int = 0) -> List[ast.AST]:
+    """Function-like AST nodes an expression may evaluate to: the thing
+    being jitted. Handles names, lambdas, partial(f, ...), shard_map(f, …)
+    wrappers, and one level of 'builder method returning a nested def'."""
+    if depth > 4:
+        return []
+    if isinstance(expr, ast.Lambda):
+        return [expr]
+    if isinstance(expr, (ast.Name, ast.Attribute)):
+        fn = project.resolve_call(mod, scope, expr)
+        return [fn.node] if fn is not None else []
+    if isinstance(expr, ast.Call):
+        # wrapper(f, ...) where the first positional arg is the callable
+        # (shard_map, checkpoint, partial, named_call, ...)
+        if expr.args:
+            inner = _resolve_traced_arg(project, mod, scope, expr.args[0],
+                                        depth + 1)
+            if inner:
+                return inner
+        # builder(): a project function whose returns are nested defs or
+        # jit expressions — follow the returned name
+        built = project.resolve_call(mod, scope, expr.func)
+        if built is not None:
+            out: List[ast.AST] = []
+            for node in ast.walk(built.node):
+                if isinstance(node, ast.Return) and node.value is not None:
+                    rscope = built
+                    out.extend(_resolve_traced_arg(
+                        project, built.module, rscope, node.value,
+                        depth + 1))
+            return out
+    return []
+
+
+def _entry_nodes(project: Project) -> List[Tuple[Module, FunctionInfo,
+                                                 ast.AST]]:
+    """(module, enclosing_scope, traced function node) for every jit /
+    pallas_call site."""
+    out = []
+    for mod in project.modules.values():
+        for fn in mod.functions.values():
+            for node in ast.walk(fn.node):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                if _is_jit_call(mod, project, node) or \
+                        _is_pallas_call(mod, project, node):
+                    for t in _resolve_traced_arg(project, mod, fn,
+                                                 node.args[0]):
+                        out.append((mod, fn, t))
+                elif _is_partial(mod, project, node) and len(node.args) >= 2:
+                    first = node.args[0]
+                    if isinstance(first, (ast.Name, ast.Attribute)) and \
+                            project.dotted_of(mod, first) == "jax.jit":
+                        for t in _resolve_traced_arg(project, mod, fn,
+                                                     node.args[1]):
+                            out.append((mod, fn, t))
+            # decorators on this function itself
+            for dec in getattr(fn.node, "decorator_list", []):
+                if _is_decorator_jit(project, mod, dec):
+                    out.append((mod, fn.parent, fn.node))
+    return out
+
+
+def _is_decorator_jit(project: Project, mod: Module,
+                      dec: ast.expr) -> bool:
+    if isinstance(dec, (ast.Name, ast.Attribute)):
+        dotted = project.dotted_of(mod, dec)
+        if dotted == "jax.jit":
+            return True
+        return isinstance(dec, ast.Attribute) and dec.attr == "jit"
+    if isinstance(dec, ast.Call):
+        # @partial(jax.jit, ...) / @jax.jit(...)
+        if _is_partial(mod, project, dec) and dec.args:
+            inner = dec.args[0]
+            if isinstance(inner, (ast.Name, ast.Attribute)):
+                d = project.dotted_of(mod, inner)
+                if d == "jax.jit":
+                    return True
+                return isinstance(inner, ast.Attribute) and \
+                    inner.attr == "jit"
+        return _is_jit_call(mod, project, dec)
+    return False
+
+
+class _Impurity:
+    __slots__ = ("rule", "line", "detail")
+
+    def __init__(self, rule: str, line: int, detail: str):
+        self.rule = rule
+        self.line = line
+        self.detail = detail
+
+
+def _walk_own(root: ast.AST):
+    """Yield ``root`` and descendants, NOT descending into nested function
+    definitions (their bodies execute only if called — the call graph
+    brings them in as their own units)."""
+    todo: List[ast.AST] = [root]
+    while todo:
+        node = todo.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            todo.append(child)
+
+
+def _is_registry_env_get(mod: Module, call: ast.Call) -> bool:
+    """``env.get(...)`` where ``env`` is the base.EnvRegistry import —
+    still an os.environ read under the hood, just routed."""
+    f = call.func
+    return (isinstance(f, ast.Attribute) and f.attr in ("get", "raw") and
+            isinstance(f.value, ast.Name) and
+            mod.from_objects.get(f.value.id, ("", ""))[1] == "env")
+
+
+def _scan_impurities(project: Project, mod: Module,
+                     scope: Optional[FunctionInfo],
+                     fn_node: ast.AST) -> List[_Impurity]:
+    out: List[_Impurity] = []
+    module_globals = set(mod.global_assigns) | set(mod.global_locks) | \
+        set(mod.functions) | set(mod.classes)
+
+    declared_global: Set[str] = set()
+    for node in _walk_own(fn_node):
+        if isinstance(node, ast.Global):
+            declared_global.update(node.names)
+
+    for node in _walk_own(fn_node):
+        if isinstance(node, ast.Call):
+            if _is_registry_env_get(mod, node):
+                out.append(_Impurity("GC-T03", node.lineno,
+                                     "base.env registry read"))
+                continue
+            dotted = project.dotted_of(mod, node.func)
+            if dotted is None:
+                continue
+            if dotted.startswith("time."):
+                out.append(_Impurity("GC-T01", node.lineno, dotted))
+            elif dotted.startswith("random.") or \
+                    dotted.startswith("numpy.random."):
+                out.append(_Impurity("GC-T02", node.lineno, dotted))
+            elif dotted in ("os.getenv", "os.environ.get"):
+                out.append(_Impurity("GC-T03", node.lineno, dotted))
+        elif isinstance(node, ast.Attribute) and node.attr == "environ":
+            if project.dotted_of(mod, node) == "os.environ":
+                out.append(_Impurity("GC-T03", node.lineno, "os.environ"))
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Name) and t.id in declared_global:
+                    out.append(_Impurity("GC-T04", node.lineno,
+                                         f"global {t.id}"))
+                elif isinstance(t, ast.Subscript) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id in module_globals and \
+                        t.value.id not in _local_names(fn_node):
+                    out.append(_Impurity(
+                        "GC-T04", node.lineno,
+                        f"store into module-global {t.value.id!r}"))
+    # de-dup GC-T03: an `os.environ.get(...)` call reports once, not
+    # also as the bare-attribute form
+    seen: Set[Tuple[str, int]] = set()
+    uniq = []
+    for imp in out:
+        k = (imp.rule, imp.line)
+        if k not in seen:
+            seen.add(k)
+            uniq.append(imp)
+    return uniq
+
+
+def _local_names(fn_node: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    args = getattr(fn_node, "args", None)
+    if args is not None:
+        for a in (args.posonlyargs + args.args + args.kwonlyargs +
+                  ([args.vararg] if args.vararg else []) +
+                  ([args.kwarg] if args.kwarg else [])):
+            out.add(a.arg)
+    for node in _walk_own(fn_node):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+        elif isinstance(node, (ast.For, ast.comprehension)):
+            t = node.target
+            if isinstance(t, ast.Name):
+                out.add(t.id)
+    return out
+
+
+_HINTS = {
+    "GC-T01": "hoist the clock read to the caller and pass the value in "
+              "(or keep timing host-side around the jitted call)",
+    "GC-T02": "use jax.random with an explicit key argument; host RNG "
+              "draws become compile-time constants",
+    "GC-T03": "read the knob outside the trace and pass it in, or fold "
+              "its value into the program's cache key",
+    "GC-T04": "return the value and mutate at the call site; trace-time "
+              "mutation stops happening once the program is cached",
+}
+
+
+def analyze(project: Project) -> List[Finding]:
+    entries = _entry_nodes(project)
+    # reachable set: (module, scope, node). Use node identity to de-dup.
+    findings: List[Finding] = []
+    visited: Set[int] = set()
+    reported: Set[Tuple[str, str, int]] = set()
+    queue: List[Tuple[Module, Optional[FunctionInfo], ast.AST, str]] = []
+    for mod, scope, node in entries:
+        name = getattr(node, "name", "<lambda>")
+        queue.append((mod, scope, node, name))
+
+    while queue:
+        mod, scope, node, entry_name = queue.pop()
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        # which FunctionInfo does this node correspond to (for scoping)?
+        fn_info = _info_for_node(mod, node, scope)
+        # the EnvRegistry's own internals are the sanctioned environ read
+        # point; when traced code reaches it, the finding belongs at the
+        # env.get/env.raw CALL site (reported in the caller), not here
+        in_registry = mod.relpath.replace("\\", "/").endswith(
+            "mxnet_tpu/base.py")
+        for imp in _scan_impurities(project, mod, fn_info or scope, node):
+            if in_registry and imp.rule == "GC-T03":
+                continue
+            fname = getattr(node, "name", "<lambda>")
+            key = (imp.rule, mod.relpath, imp.line)
+            if key in reported:
+                continue
+            reported.add(key)
+            findings.append(Finding(
+                rule=imp.rule, path=mod.relpath, line=imp.line,
+                message=f"{imp.detail} inside trace-reachable "
+                        f"{fname!r} (traced via {entry_name!r})",
+                hint=_HINTS[imp.rule],
+                symbol=f"{_sym(mod, fn_info, fname)}:{imp.detail}"))
+        # follow calls
+        for sub in _walk_own(node):
+            if isinstance(sub, ast.Call):
+                callee = project.resolve_call(mod, fn_info or scope,
+                                              sub.func)
+                if callee is not None and id(callee.node) not in visited:
+                    queue.append((callee.module, callee.parent,
+                                  callee.node, entry_name))
+    return findings
+
+
+def _info_for_node(mod: Module, node: ast.AST,
+                   scope: Optional[FunctionInfo]) -> Optional[FunctionInfo]:
+    for fi in mod.functions.values():
+        if fi.node is node:
+            return fi
+    return scope
+
+
+def _sym(mod: Module, fn_info: Optional[FunctionInfo], fname: str) -> str:
+    if fn_info is not None:
+        return fn_info.qualname
+    return f"{mod.modname}:{fname}"
