@@ -874,6 +874,63 @@ def _zero_probe(steps=3, width=64, n_params=8, world=4):
     }
 
 
+def _comm_health_probe(steps=3, width=32, n_params=8, world=4):
+    """The `comm_health` row: the collective-observability plane over a
+    simulated N-rank ZeRO run — ledger depth, max cross-rank collective
+    skew (0 in simulation: one process plays every rank on one clock)
+    and the watchdog count, which MUST be 0 on a clean run (a fired
+    watchdog here means the plane false-positives on healthy traffic)."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd
+    from mxnet_tpu import kvstore as kvs
+    from mxnet_tpu.telemetry import collective as coll
+
+    saved = {k: os.environ.get(k) for k in
+             ("MXTPU_ZERO", "MXTPU_ZERO_WORLD", "MXTPU_COLL_HEALTH",
+              "MXTPU_COLL_TIMEOUT_S")}
+    os.environ["MXTPU_ZERO"] = "1"
+    os.environ["MXTPU_ZERO_WORLD"] = str(world)
+    os.environ["MXTPU_COLL_HEALTH"] = "1"
+    # arm the watchdog with a generous timeout: the row proves clean
+    # traffic fires ZERO flight records WITH the watchdog running
+    os.environ["MXTPU_COLL_TIMEOUT_S"] = "30"
+    fired_before = coll.ledger.watchdog_fired
+    depth_before = coll.ledger.depth()
+    try:
+        rs = np.random.RandomState(0)
+        params = []
+        for i in range(n_params):
+            p = gluon.Parameter(f"ch{i}", shape=(width, width))
+            p.initialize(mx.init.One())
+            params.append(p)
+        tr = gluon.Trainer(params, "adam", {"learning_rate": 1e-3},
+                           kvstore=kvs.create("device"))
+        for _ in range(steps):
+            for p in params:
+                g = nd.array(rs.randn(width, width).astype(np.float32))
+                p._grad._rebind(g._data)
+                p._fresh_grad = True
+            tr.step(4)
+        health = coll.health_check(tr._kvstore)
+        collectives = (tr.last_reduce_scatter_collectives +
+                       tr.last_allgather_collectives)
+    finally:
+        for k, v in saved.items():
+            os.environ.pop(k, None)
+            if v is not None:
+                os.environ[k] = v
+    return {
+        "world": world,
+        "max_coll_skew_ms": round(float(health["max_skew_ms"]), 3),
+        "straggler_rank": health["straggler_rank"],
+        "desync": health["desync"],
+        "ledger_depth": coll.ledger.depth() - depth_before,
+        "watchdog_fired": coll.ledger.watchdog_fired - fired_before,
+        "collectives_per_step": collectives,
+    }
+
+
 def _run_child(mode, args_rest):
     if not _init_backend():
         os._exit(1)
@@ -920,6 +977,13 @@ def _run_child(mode, args_rest):
                       flush=True)
             except Exception as e:
                 log(f"zero probe failed: {e}")
+        if os.environ.get("MXTPU_BENCH_COMM_HEALTH", "1") != "0":
+            try:
+                crow = _comm_health_probe()
+                print("EXTRA_ROW " + json.dumps({"comm_health": crow}),
+                      flush=True)
+            except Exception as e:
+                log(f"comm health probe failed: {e}")
 
 
 # global wall-clock budget: the driver kills the whole bench at some
@@ -1130,6 +1194,11 @@ def main():
                 # vs the unsharded baseline (mp-Adam at simulated N
                 # ranks) and the step-time cost of the sharded plane
                 payload["zero"] = _EXTRAS["zero"]
+            if "comm_health" in _EXTRAS:
+                # the comm-observability evidence: collective-ledger
+                # depth, cross-rank skew and a zero watchdog count on a
+                # clean simulated N-rank ZeRO run
+                payload["comm_health"] = _EXTRAS["comm_health"]
             # the train number is safe on stdout NOW; each optional row
             # that lands re-emits the extended line immediately, so a
             # truncated run keeps everything measured so far
@@ -1172,7 +1241,8 @@ def main():
                                    "MXTPU_BENCH_STEP_BREAKDOWN": "0",
                                    "MXTPU_BENCH_AUTOTUNE": "0",
                                    "MXTPU_BENCH_MEMORY": "0",
-                                   "MXTPU_BENCH_ZERO": "0"})
+                                   "MXTPU_BENCH_ZERO": "0",
+                                   "MXTPU_BENCH_COMM_HEALTH": "0"})
                     if t8:
                         payload["train_int8_imgs_per_sec"] = round(t8, 2)
                         print(json.dumps(payload), flush=True)

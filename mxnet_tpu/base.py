@@ -308,6 +308,35 @@ env.declare("DMLC_ROLE", str, "worker",
 env.declare("DMLC_RANK", int, 0,
             "Launcher-assigned rank (reference ps-lite parity); used to "
             "tag per-rank checkpoint state in mx.fault.")
+env.declare("MXTPU_COLL_TIMEOUT_S", float, 0.0,
+            "Hung-collective watchdog (telemetry/collective.py): when "
+            "> 0, a watchdog thread is armed at every collective entry "
+            "(kvstore push/pull, ZeRO reduce-scatter/allgather/"
+            "all-finite, coordination-service exchange/barrier); a "
+            "collective still in flight past this many seconds dumps a "
+            "flight record — the collective ledger ring, the hung "
+            "(kind, key, seq), the peer rank the transport is blocked "
+            "on, and all-thread stacks — to MXTPU_MEM_DUMP_DIR "
+            "(tmp+rename). 0 (default) disarms; arming also turns the "
+            "collective ledger on. Unparseable values raise.")
+env.declare("MXTPU_COLL_RING", int, 4096,
+            "Collective-ledger ring capacity (telemetry/collective.py): "
+            "bounded per-process ring of (seq, kind, key, bytes, rank, "
+            "t_enter, t_exit) records, one per collective; evictions "
+            "are counted, never silent. Must be >= 1.")
+env.declare("MXTPU_COLL_HEALTH", int, 0,
+            "Cross-rank comm-health cadence (telemetry/collective.py): "
+            "when N > 0, fit.FitLoop exchanges each rank's recent "
+            "collective-ledger digest over the coordination-service "
+            "byte channel every N steps, diagnoses desynced collective "
+            "order (mxtpu_coll_desync_total), attributes per-rank "
+            "entry-time skew (mxtpu_coll_skew_ms / "
+            "mxtpu_coll_straggler_rank, FitResult.comm_health, the "
+            "step-breakdown straggler-bound diagnosis), and the "
+            "collective ledger records every collective. Distributed "
+            "runs: the exchange is itself a collective — every rank "
+            "must run the same cadence. 0 (default) = off; unparseable "
+            "values raise.")
 env.declare("MXTPU_PROFILE_BOUND_FRAC", float, 0.4,
             "Step-breakdown detector threshold: any non-compute segment "
             "(data_wait/h2d/comm/optimizer/checkpoint) whose share of "

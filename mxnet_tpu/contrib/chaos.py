@@ -33,6 +33,16 @@ Grammar: comma-separated events, each ``kind[:prob][@target]``:
   a laptop: the step-breakdown comm-bound detector, the comm/backward
   overlap path and the autotuner are all testable against it (hook:
   ``kvstore.KVStoreBase.push/pull``, same entry point as ``kv_flake``).
+- ``kv_hang:<rank>@N[:MS]`` — the named rank delays/withholds its next
+  kvstore exchange at step ``N``: its push/pull/reduce-scatter/allgather
+  entry sleeps ``MS`` milliseconds (default 60000 — long enough to be a
+  withhold for any sane ``MXTPU_COLL_TIMEOUT_S``) before touching the
+  wire, so every OTHER rank blocks inside the collective waiting for it.
+  Consume-once and deterministic; the hung-collective watchdog
+  (``telemetry/collective.py``) is testable on CPU against it: surviving
+  ranks' flight records must name the hung ``(kind, key, seq)`` and the
+  absent rank (hook: ``kvstore.KVStoreBase`` push/pull and the ZeRO
+  collective entry points, same entry as ``kv_flake``/``kv_slow``).
 - ``serve_slow:P@MS`` — each serving batch dispatch sleeps ``MS``
   milliseconds with probability ``P`` (``serve_slow@MS`` = always),
   simulating compute stragglers/compile stalls so deadline shedding and
@@ -93,8 +103,8 @@ class ChaosKilled(MXNetError):
 
 
 _KINDS = ("nan_grad", "inf_grad", "kill", "preempt", "ckpt_corrupt",
-          "kv_flake", "kv_slow", "serve_slow", "registry_corrupt",
-          "mem_pressure")
+          "kv_flake", "kv_slow", "kv_hang", "serve_slow",
+          "registry_corrupt", "mem_pressure")
 
 
 class ChaosPlan:
@@ -122,6 +132,7 @@ class ChaosPlan:
         self.kv_slow_ms = 0.0
         self.serve_slow_p = 0.0
         self.serve_slow_ms = 0.0
+        self._kv_hang: Dict[int, tuple] = {}  # step -> (rank, delay_ms)
         self._mem_pressure: Dict[int, int] = {}  # step -> budget bytes
         # observability: how many of each fault actually fired
         self.injected: Dict[str, int] = {k: 0 for k in _KINDS}
@@ -173,6 +184,35 @@ class ChaosPlan:
             else:
                 self.serve_slow_p = p
                 self.serve_slow_ms = ms
+            return
+        if kind == "kv_hang":
+            # kv_hang:<rank>@N[:MS] — the ':' slot carries the RANK (not
+            # a probability: which rank withholds is never random), the
+            # '@' target the step and optional delay
+            if prob is None:
+                raise MXNetError("chaos: kv_hang needs a rank, e.g. "
+                                 "kv_hang:1@3 or kv_hang:1@3:500")
+            if target is None:
+                raise MXNetError("chaos: kv_hang needs a step target, "
+                                 "e.g. kv_hang:1@3")
+            try:
+                rank = int(prob)
+            except ValueError:
+                raise MXNetError(
+                    f"chaos: bad kv_hang rank {prob!r} (expected an int)")
+            if rank < 0:
+                raise MXNetError(f"chaos: kv_hang rank {rank} < 0")
+            step_s, _, ms_s = target.partition(":")
+            try:
+                step = int(step_s)
+                ms = float(ms_s) if ms_s else 60000.0
+            except ValueError:
+                raise MXNetError(
+                    f"chaos: bad kv_hang target {target!r} "
+                    "(expected STEP or STEP:MS)")
+            if ms < 0:
+                raise MXNetError(f"chaos: kv_hang delay {ms} < 0")
+            self._kv_hang[step] = (rank, ms)
             return
         if kind == "mem_pressure":
             # mem_pressure@N[:BYTES] — synthetic budget shrink at step N:
@@ -307,6 +347,22 @@ class ChaosPlan:
             self.injected["kv_slow"] += 1
         _count_injection("kv_slow")
         return self.kv_slow_ms / 1000.0
+
+    def kv_hang_delay_s(self, rank: int) -> float:
+        """kv_hang:<rank>@N[:MS] — seconds THIS rank must withhold its
+        kvstore exchange at the current step (0.0 otherwise). Consumed on
+        the first matching exchange of the step, so exactly one
+        collective hangs; every other rank's watchdog then has one hung
+        ``(kind, key, seq)`` to name."""
+        if self._step is None or self._step not in self._kv_hang:
+            return 0.0
+        hang_rank, ms = self._kv_hang[self._step]
+        if int(rank) != hang_rank:
+            return 0.0
+        del self._kv_hang[self._step]
+        self.injected["kv_hang"] += 1
+        _count_injection("kv_hang")
+        return ms / 1000.0
 
     def kv_maybe_fail(self, op: str, key) -> None:
         """kv_flake:P — raise TransientKVError with probability P on each

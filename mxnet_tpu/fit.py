@@ -38,6 +38,7 @@ from .log import get_logger
 from . import fault
 from .contrib import chaos as _chaos
 from .telemetry import autotune as _autotune
+from .telemetry import collective as _collective
 from .telemetry import memory as _memory
 from .telemetry.step_breakdown import StepBreakdown, segment as _segment
 
@@ -68,6 +69,8 @@ class FitResult:
     tuning_report: Optional[dict] = None  # autotune protocol (MXTPU_AUTOTUNE)
     memory: Optional[dict] = None  # live-byte ledger summary + step peaks
     zero: Optional[dict] = None  # ZeRO-1 plane summary (MXTPU_ZERO=1)
+    comm_health: Optional[dict] = None  # collective skew/desync/watchdog
+    # summary (MXTPU_COLL_HEALTH / MXTPU_COLL_TIMEOUT_S)
 
 
 class FitLoop:
@@ -242,6 +245,43 @@ class FitLoop:
         # brackets backward so gradient collectives launch during the
         # reverse pass; inactive scopes are free
         overlap_scope = getattr(self._trainer, "overlap_scope", None)
+        # comm-health cadence (MXTPU_COLL_HEALTH): a strict parse raises
+        # HERE, before any step runs; on a real worker group the clock
+        # handshake anchors every rank's ledger/trace onto rank 0's
+        # clock before the first skew comparison
+        coll_every = _collective.health_interval()
+        # comm_health must describe THIS fit: drop the previous run's
+        # comparison/counters (the same re-arm discipline as
+        # reset_pressure_state above)
+        _collective.reset_health()
+        # the clock handshake anchors ledger digests AND the chrome
+        # trace: any armed comm plane OR an enabled tracer (whose dump
+        # may be fleet-merged) needs it — not just the health cadence.
+        # The handshake is a collective, so this gate must evaluate the
+        # same on every rank: at fit start both inputs are env-driven
+        # (MXTPU_COLL_*/MXTPU_PROFILE, launcher-forwarded fleet-wide)
+        from .telemetry.tracer import tracer as _tr
+        if _collective.enabled() or _tr.enabled:
+            # the trainer's store is init-lazy (first allreduce); force
+            # it now — a string arg ('dist_sync') carries no group size,
+            # and skipping the handshake on a real group would report
+            # raw cross-host clock drift as collective skew
+            kv = getattr(self._trainer, "_kvstore", None)
+            if kv is None and getattr(self._trainer, "_kvstore_arg",
+                                      None) is not None:
+                try:
+                    self._trainer._init_kvstore()
+                except Exception as e:
+                    # the first allreduce will raise the real error in
+                    # context; the handshake just can't run early
+                    _LOG.warning("comm-health: kvstore init for the "
+                                 "clock handshake failed: %s", e)
+                kv = getattr(self._trainer, "_kvstore", None)
+            if int(getattr(kv, "num_workers", 1) or 1) > 1:
+                try:
+                    _collective.sync_clocks()
+                except Exception as e:
+                    _LOG.warning("comm-health clock sync failed: %s", e)
         try:
             for epoch in range(start_epoch, epochs):
                 self._position_iter(epoch)
@@ -395,6 +435,17 @@ class FitLoop:
                                                plan=plan)
                     except Exception as e:
                         _LOG.warning("memory pressure check failed: %s", e)
+                    # comm health: every rank runs the SAME cadence (the
+                    # digest exchange is itself a collective); a failed
+                    # check is diagnosed, never fatal to the step loop
+                    if coll_every > 0 and \
+                            result.step % coll_every == 0:
+                        try:
+                            _collective.health_check(
+                                getattr(self._trainer, "_kvstore", None),
+                                breakdown=bd)
+                        except Exception as e:
+                            _LOG.warning("comm health check failed: %s", e)
                 skip_batches = 0
                 result.epoch = epoch + 1
                 pos_epoch, pos_batch = epoch + 1, 0
@@ -434,6 +485,10 @@ class FitLoop:
             result.memory.update(bd.memory_summary())
         if tuner is not None:
             result.tuning_report = tuner.report()
+        if coll_every > 0 or _collective.enabled():
+            # the comm axis next to the time and memory axes: last skew
+            # comparison + ledger depth + watchdog firings
+            result.comm_health = _collective.health_summary()
         plane = getattr(self._trainer, "_zero", None)
         if plane:
             # ZeRO-1 plane summary (world/ranks/shard size) next to the

@@ -35,6 +35,7 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 
 from .base import MXNetError, check, env
 from .ndarray import ndarray as _nd
+from .telemetry import collective as _coll
 from .telemetry.tracer import tracer as _tracer
 
 __all__ = ["KVStore", "KVStoreLocal", "KVStoreDistTPU", "TransientKVError",
@@ -80,25 +81,33 @@ def _retry_op(what: str, fn):
             time.sleep(base * (2 ** (attempt - 1)))
 
 
-def _traced_retry(what: str, k, fn):
-    """One kvstore op under retry, with a per-key comm span when traced.
-    Tracing-off contract: no span-name formatting unless the tracer will
-    actually record it."""
-    if _tracer.wants("comm"):
-        with _tracer.span(f"kv_{what}:{k}", "comm"):
+def _traced_retry(what: str, k, fn, nbytes: int = 0, rank: int = 0):
+    """One kvstore op under retry, with a per-key comm span when traced
+    and a collective-ledger record when the comm-observability plane is
+    on (off contract for both: no formatting, no clock reads beyond one
+    flag check). The ledger entry brackets the WHOLE op including
+    retries/backoff — the cross-rank identity is the op, not the
+    attempt — and arms the hung-collective watchdog while in flight."""
+    tok = _coll.enter(what, k, nbytes, rank) if _coll.enabled() else None
+    try:
+        if _tracer.wants("comm"):
+            with _tracer.span(f"kv_{what}:{k}", "comm"):
+                _retry_op(what, fn)
+        else:
             _retry_op(what, fn)
-    else:
-        _retry_op(what, fn)
+    finally:
+        if tok is not None:
+            _coll.exit_(tok)
 
 
-def _chaos_kv(op: str, key) -> None:
+def _chaos_kv(op: str, key, rank: int = 0) -> None:
     from .contrib import chaos
     plan = chaos.active()
     if plan is not None:
         # flake BEFORE the injected wire delay: a failed attempt should
         # cost the retry loop backoff, not also the kv_slow sleep
         plan.kv_maybe_fail(op, key)
-        delay = plan.kv_delay_s()
+        delay = plan.kv_delay_s() + plan.kv_hang_delay_s(rank)
         if delay > 0.0:
             time.sleep(delay)
 
@@ -256,12 +265,15 @@ class KVStoreBase:
         # (chaos entry, the _reduce_global wire hop) precede that key's
         # store mutation, so a retry never re-applies an updater — and a
         # failure on key N never re-runs keys < N that already applied
+        ledger_on = _coll.enabled()
         for k, vals in _group(key, value):
+            nb = sum(_coll_bytes(v) for v in vals) if ledger_on else 0
             _traced_retry("push", k,
-                          lambda k=k, vals=vals: self._push_one(k, vals))
+                          lambda k=k, vals=vals: self._push_one(k, vals),
+                          nbytes=nb, rank=self.rank)
 
     def _push_one(self, k, vals) -> None:
-        _chaos_kv("push", k)
+        _chaos_kv("push", k, self.rank)
         from .ndarray import sparse as _sp
         check(k in self._store, f"kvstore key {k} not initialized")
         if any(isinstance(v, _sp.BaseSparseNDArray) for v in vals):
@@ -308,12 +320,15 @@ class KVStoreBase:
     def pull(self, key, out=None, priority: int = 0,
              ignore_sparse: bool = True) -> None:
         check(out is not None, "pull requires out=")
+        ledger_on = _coll.enabled()
         for k, outs in _group(key, out):
+            nb = sum(_coll_bytes(o) for o in outs) if ledger_on else 0
             _traced_retry("pull", k,
-                          lambda k=k, outs=outs: self._pull_one(k, outs))
+                          lambda k=k, outs=outs: self._pull_one(k, outs),
+                          nbytes=nb, rank=self.rank)
 
     def _pull_one(self, k, outs) -> None:
-        _chaos_kv("pull", k)
+        _chaos_kv("pull", k, self.rank)
         check(k in self._store, f"kvstore key {k} not initialized")
         src = self._store[k]
         data = src._data
@@ -387,9 +402,11 @@ class KVStoreBase:
 
         def run():
             out.clear()
-            _chaos_kv("reduce_scatter", key)
+            _chaos_kv("reduce_scatter", key, self.rank)
             out.extend(self._zero_reduce_scatter_impl(key, value, parts))
-        _traced_retry("reduce_scatter", key, run)
+        _traced_retry("reduce_scatter", key, run,
+                      nbytes=_coll_bytes(value) if _coll.enabled() else 0,
+                      rank=self.rank)
         return out
 
     def _zero_reduce_scatter_impl(self, key, value, parts):
@@ -408,9 +425,11 @@ class KVStoreBase:
 
         def run():
             out.clear()
-            _chaos_kv("allgather", key)
+            _chaos_kv("allgather", key, self.rank)
             out.update(self._zero_allgather_impl(key, payloads))
-        _traced_retry("allgather", key, run)
+        nb = sum(_coll_bytes(v) for v in payloads.values()) \
+            if _coll.enabled() else 0
+        _traced_retry("allgather", key, run, nbytes=nb, rank=self.rank)
         return out
 
     def _zero_allgather_impl(self, key, payloads):
@@ -419,7 +438,19 @@ class KVStoreBase:
     def zero_all_finite(self, ok: bool) -> bool:
         """AND-reduce the shard-local all-grads-finite verdict across the
         worker group (single worker: identity). Runs BEFORE any shard
-        applies its update, so a NaN on one rank skips the step on all."""
+        applies its update, so a NaN on one rank skips the step on all.
+        The flag collective records into the comm-observability ledger
+        like every other entry point — a rank hung HERE while its peers
+        block is exactly the failure the flight recorder exists for."""
+        tok = _coll.enter("all_finite", "_sentinel", 4, self.rank) \
+            if _coll.enabled() else None
+        try:
+            return self._zero_all_finite_impl(ok)
+        finally:
+            if tok is not None:
+                _coll.exit_(tok)
+
+    def _zero_all_finite_impl(self, ok: bool) -> bool:
         return bool(ok)
 
     # -- optimizer / updater -------------------------------------------
@@ -477,6 +508,14 @@ def _key_int(k):
         return int(k)
     except (TypeError, ValueError):
         return k
+
+
+def _coll_bytes(v) -> int:
+    """Payload bytes of one pushed/pulled value for the collective
+    ledger (shape × itemsize, sparse index buffers included) — computed
+    only when the plane is on."""
+    from .telemetry.memory import nd_bytes
+    return nd_bytes(v)
 
 
 class KVStoreLocal(KVStoreBase):
@@ -583,7 +622,7 @@ class KVStoreDistTPU(KVStoreBase):
         outs = cross_process_allgather_object(_np.asarray(v._data), "zag")
         return dict(enumerate(outs))
 
-    def zero_all_finite(self, ok: bool) -> bool:
+    def _zero_all_finite_impl(self, ok: bool) -> bool:
         if self._mesh is None:
             return bool(ok)
         import numpy as _np

@@ -58,32 +58,52 @@ def _use_coord_fallback() -> bool:
 def _coord_exchange(arr, tag: str):
     """Publish this rank's array under ``tag`` and fetch every rank's;
     returns the list indexed by rank. All ranks must call with the SAME
-    tag sequence (the usual SPMD collective contract)."""
+    tag sequence (the usual SPMD collective contract).
+
+    Comm observability: the whole exchange is one collective-ledger
+    record, and the peer rank each blocking get is waiting on is stamped
+    into it (``note_waiting``) — when a peer never publishes, the hung-
+    collective flight recorder names that rank as the absent one."""
     import jax
     import numpy as np
+    from ..telemetry import collective as _coll
     client = _coord_client()
     rank, nproc = jax.process_index(), jax.process_count()
     prefix = f"mxtpu_coll/{tag}"
     arr = np.ascontiguousarray(arr)
-    client.key_value_set_bytes(f"{prefix}/{rank}", arr.tobytes())
-    parts = []
-    for r in range(nproc):
-        if r == rank:
-            parts.append(arr)
-            continue
-        buf = client.blocking_key_value_get_bytes(f"{prefix}/{r}",
-                                                  _COORD_TIMEOUT_MS)
-        parts.append(np.frombuffer(bytearray(buf),
-                                   arr.dtype).reshape(arr.shape))
-    # everyone has read everything before rank 0 garbage-collects the keys
-    client.wait_at_barrier(f"{prefix}/done", _COORD_TIMEOUT_MS)
-    if rank == 0:
+    tok = _coll.enter("exchange", tag, arr.nbytes, rank) \
+        if _coll.enabled() else None
+    try:
+        client.key_value_set_bytes(f"{prefix}/{rank}", arr.tobytes())
+        parts = []
         for r in range(nproc):
-            try:
-                client.key_value_delete(f"{prefix}/{r}")
-            except Exception:
-                pass
-    return parts
+            if r == rank:
+                parts.append(arr)
+                continue
+            if tok is not None:
+                _coll.note_waiting(tok, r)
+            buf = client.blocking_key_value_get_bytes(f"{prefix}/{r}",
+                                                      _COORD_TIMEOUT_MS)
+            parts.append(np.frombuffer(bytearray(buf),
+                                       arr.dtype).reshape(arr.shape))
+        if tok is not None:
+            # still a hang point: a peer that dies between publishing
+            # and the done-barrier strands us HERE — keep the record
+            # truthful instead of clearing the waiting stamp
+            _coll.note_waiting(tok, "barrier")
+        # everyone has read everything before rank 0 garbage-collects
+        # the keys
+        client.wait_at_barrier(f"{prefix}/done", _COORD_TIMEOUT_MS)
+        if rank == 0:
+            for r in range(nproc):
+                try:
+                    client.key_value_delete(f"{prefix}/{r}")
+                except Exception:
+                    pass
+        return parts
+    finally:
+        if tok is not None:
+            _coll.exit_(tok)
 
 
 def allreduce(x, mesh, axis: str = "dp", op: str = "sum"):
@@ -258,27 +278,41 @@ def cross_process_exchange_bytes(payload: bytes, tag: str):
     service KV store — the transport for RAGGED payloads (pickled
     optimizer-state shards, per-rank weight segments) that the
     fixed-shape array collectives cannot carry. Same contract as
-    :func:`_coord_exchange`: all ranks call with the same tag sequence."""
+    :func:`_coord_exchange`: all ranks call with the same tag sequence.
+    Records into the collective ledger with per-peer waiting notes, like
+    ``_coord_exchange`` — this hop is where a surviving rank blocks when
+    a peer dies, so the flight recorder must see it."""
     import jax
+    from ..telemetry import collective as _coll
     client = _coord_client()
     rank, nproc = jax.process_index(), jax.process_count()
     prefix = f"mxtpu_coll/{tag}"
-    client.key_value_set_bytes(f"{prefix}/{rank}", payload)
-    outs = []
-    for r in range(nproc):
-        if r == rank:
-            outs.append(payload)
-            continue
-        outs.append(bytes(client.blocking_key_value_get_bytes(
-            f"{prefix}/{r}", _COORD_TIMEOUT_MS)))
-    client.wait_at_barrier(f"{prefix}/done", _COORD_TIMEOUT_MS)
-    if rank == 0:
+    tok = _coll.enter("exchange_bytes", tag, len(payload), rank) \
+        if _coll.enabled() else None
+    try:
+        client.key_value_set_bytes(f"{prefix}/{rank}", payload)
+        outs = []
         for r in range(nproc):
-            try:
-                client.key_value_delete(f"{prefix}/{r}")
-            except Exception:
-                pass
-    return outs
+            if r == rank:
+                outs.append(payload)
+                continue
+            if tok is not None:
+                _coll.note_waiting(tok, r)
+            outs.append(bytes(client.blocking_key_value_get_bytes(
+                f"{prefix}/{r}", _COORD_TIMEOUT_MS)))
+        if tok is not None:
+            _coll.note_waiting(tok, "barrier")  # see _coord_exchange
+        client.wait_at_barrier(f"{prefix}/done", _COORD_TIMEOUT_MS)
+        if rank == 0:
+            for r in range(nproc):
+                try:
+                    client.key_value_delete(f"{prefix}/{r}")
+                except Exception:
+                    pass
+        return outs
+    finally:
+        if tok is not None:
+            _coll.exit_(tok)
 
 
 def cross_process_allgather_object(obj, tag_prefix: str = "obj"):
@@ -400,8 +434,18 @@ def barrier(mesh=None) -> None:
         return
     if jax.process_count() > 1:
         if _use_coord_fallback():
-            _coord_client().wait_at_barrier(
-                f"mxtpu_coll/bar{next(_coord_seq)}", _COORD_TIMEOUT_MS)
+            from ..telemetry import collective as _coll
+            tag = f"bar{next(_coord_seq)}"
+            tok = _coll.enter("barrier", tag, 0, jax.process_index()) \
+                if _coll.enabled() else None
+            try:
+                if tok is not None:
+                    _coll.note_waiting(tok, "all")
+                _coord_client().wait_at_barrier(
+                    f"mxtpu_coll/{tag}", _COORD_TIMEOUT_MS)
+            finally:
+                if tok is not None:
+                    _coll.exit_(tok)
             return
         import numpy as np
         # the collective itself is the rendezvous

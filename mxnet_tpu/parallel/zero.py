@@ -68,6 +68,14 @@ is fully reduced locally there):
   call ``save_states``/``CheckpointManager.save`` at the same step —
   ``fit.FitLoop`` already does; a rank-0-only save stalls waiting for
   shards that never arrive.
+
+Observability: all three plane collectives (reduce-scatter, allgather,
+the all-finite flag) record into the cross-rank collective ledger
+(``telemetry/collective.py``) through the same kvstore entry points the
+chaos/retry hooks ride — so ``MXTPU_COLL_HEALTH`` skew/desync detection
+covers the sharded comm plane, a rank hung in ``zero_all_finite`` while
+its peers block is named by the ``MXTPU_COLL_TIMEOUT_S`` flight
+recorder, and the ``kv_hang`` chaos event drives both on CPU.
 """
 from __future__ import annotations
 

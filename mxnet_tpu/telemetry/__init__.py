@@ -30,6 +30,15 @@ is the TPU-native generalization; the whole stack reports into it:
   dumps (``RESOURCE_EXHAUSTED`` / ``MXTPU_MEM_BUDGET`` / ``mem_pressure``
   chaos).
 
+- :mod:`.collective` — the cross-rank comm axis: a bounded collective
+  ledger at every kvstore/ZeRO/byte-channel entry point, the
+  desync/straggler health exchange (``MXTPU_COLL_HEALTH``,
+  ``mxtpu_coll_skew_ms``/``mxtpu_coll_straggler_rank``), and the
+  hung-collective flight recorder (``MXTPU_COLL_TIMEOUT_S``) that names
+  the hung ``(kind, key, seq)`` and the absent rank on every surviving
+  rank. ``tools/fleet_trace.py`` merges per-rank chrome traces onto one
+  clock via the tracer's wall-clock anchor + offset handshake.
+
 ``mxnet_tpu.profiler`` remains the MXNet-compatible facade over this
 package, and the kvstore remote profiler command channel
 (``KVStore.send_profiler_command``) is served by it, so the controller can
@@ -47,6 +56,9 @@ from .step_breakdown import (StepBreakdown, segment, current_breakdown,
                              SEGMENTS)
 from . import memory
 from .memory import (MemoryLedger, ledger as memory_ledger, dump_forensics)
+from . import collective
+from .collective import (CollectiveLedger,
+                         ledger as collective_ledger)
 
 __all__ = [
     "Tracer", "tracer", "span", "instant", "counter_event", "enabled",
@@ -55,4 +67,5 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "default_registry",
     "StepBreakdown", "segment", "current_breakdown", "SEGMENTS",
     "memory", "MemoryLedger", "memory_ledger", "dump_forensics",
+    "collective", "CollectiveLedger", "collective_ledger",
 ]

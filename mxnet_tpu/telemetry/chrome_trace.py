@@ -38,6 +38,15 @@ def chrome_trace_events(tr: Optional[Tracer] = None) -> List[Dict[str, Any]]:
     events.append({"name": "process_name", "ph": "M", "ts": 0.0,
                    "pid": rank, "tid": 0,
                    "args": {"name": f"rank{rank}"}})
+    # wall-clock anchor: trace ts 0 == epoch second epoch_t0_s on this
+    # rank's clock, which runs clock_offset_ms ahead of rank 0's
+    # (telemetry.collective.sync_clocks). tools/fleet_trace.py uses this
+    # pair to merge N per-rank traces onto one aligned clock.
+    events.append({"name": "clock_sync", "ph": "M", "ts": 0.0,
+                   "pid": rank, "tid": 0,
+                   "args": {"epoch_t0_s": float(tr.epoch_anchor),
+                            "clock_offset_ms":
+                            float(getattr(tr, "clock_offset_ms", 0.0))}})
     for tid, tname in sorted(tr.thread_names().items()):
         events.append({"name": "thread_name", "ph": "M", "ts": 0.0,
                        "pid": rank, "tid": tid,

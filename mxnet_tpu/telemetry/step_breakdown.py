@@ -32,7 +32,13 @@ non-compute segment whose share of wall-clock exceeds
 naming the bound segment, its share, and the first lever to reach for.
 When a controller (the autotuner, :mod:`.autotune`) has already pulled
 that lever, :meth:`StepBreakdown.note_action` upgrades the line from
-diagnosis to "diagnosis → action taken".
+diagnosis to "diagnosis → action taken". When the comm-health plane
+(:mod:`.collective`, ``MXTPU_COLL_HEALTH``) has attributed collective
+entry-time skew to a straggler rank (:meth:`StepBreakdown
+.note_comm_health`), a comm-bound diagnosis upgrades to the
+**straggler-bound** variant: the time is not wire bandwidth but one
+rank arriving late at every collective, and the lever is that rank's
+input pipeline / host, not the comm knobs.
 """
 from __future__ import annotations
 
@@ -178,6 +184,10 @@ class StepBreakdown:
         # applied (autotuner lock); upgrades the detector's line from
         # diagnosis to "diagnosis → action taken"
         self.actions: Dict[str, str] = {}
+        # last comm-health comparison (telemetry.collective.health_check
+        # feeds it): a known straggler turns a comm-bound diagnosis into
+        # the straggler-bound variant
+        self._comm_health: Optional[Dict[str, object]] = None
         self._last_marked_step = object()  # sentinel: != any step id
 
     # -- thread binding -------------------------------------------------
@@ -195,6 +205,13 @@ class StepBreakdown:
         (e.g. the autotuner locking a bigger gradient bucket). Subsequent
         detector lines for that segment read "… → action taken: …"."""
         self.actions[segment_name] = str(action)
+
+    def note_comm_health(self, info) -> None:
+        """Record the latest cross-rank comm-health comparison
+        (``telemetry.collective.health_check`` calls this when handed a
+        breakdown). A non-None straggler rank re-aims subsequent
+        comm-bound diagnoses at that rank instead of the comm knobs."""
+        self._comm_health = dict(info) if info else None
 
     def begin_step(self, step: Optional[int] = None) -> None:
         self._cur = defaultdict(float)
@@ -263,9 +280,12 @@ class StepBreakdown:
                 continue
             frac = s / wall
             if frac >= self.bound_frac:
+                advice = _ADVICE.get(name, "non-compute bound")
+                if name in ("comm", "comm_overlapped"):
+                    advice = self._straggler_advice() or advice
                 msg = (f"step {self._step_id}: {name} is {frac:.0%} of "
                        f"step time ({s * 1e3:.1f}ms of {wall * 1e3:.1f}ms) "
-                       f"— {_ADVICE.get(name, 'non-compute bound')}")
+                       f"— {advice}")
                 if name in self.actions:
                     msg += f" → action taken: {self.actions[name]}"
                 if len(self.diagnoses) < self.MAX_DIAGNOSES:
@@ -278,6 +298,22 @@ class StepBreakdown:
                     if n > 3:
                         msg += f" [{n} occurrences]"
                     _LOG.warning(msg)
+
+    def _straggler_advice(self) -> Optional[str]:
+        """The straggler-bound diagnosis tail, when the comm-health plane
+        has attributed the comm time to one rank entering collectives
+        late — the comm knobs cannot fix a straggler."""
+        ch = self._comm_health
+        if not ch:
+            return None
+        rank = ch.get("straggler_rank")
+        skew = float(ch.get("max_skew_ms") or 0.0)
+        if rank is None or skew <= 0:
+            return None
+        return (f"straggler-bound: rank {rank} enters collectives up to "
+                f"{skew:.1f}ms late (mxtpu_coll_skew_ms) — check that "
+                "rank's input pipeline / host load before touching comm "
+                "knobs")
 
     # -- aggregate ------------------------------------------------------
     def memory_summary(self) -> Dict[str, object]:

@@ -87,7 +87,17 @@ class Tracer:
         self._categories: Optional[set] = None   # None = all
         self._ring = int(ring)
         self._spans: deque = deque(maxlen=self._ring)
+        # perf_counter <-> epoch wall-clock anchor, captured at ONE
+        # instant: trace ts 0 corresponds to epoch second _epoch0. The
+        # chrome exporter ships it as process metadata ("clock_sync"),
+        # which is what lets tools/fleet_trace.py align N per-rank
+        # traces onto one clock.
         self._t0 = time.perf_counter()
+        self._epoch0 = time.time()
+        #: this rank's wall clock minus rank 0's, in ms (set by
+        #: telemetry.collective.sync_clocks after the median-of-K
+        #: round-trip handshake; 0.0 = unmeasured / reference rank)
+        self.clock_offset_ms = 0.0
         self._rank = rank
         self._tids: Dict[int, int] = {}
         self._tid_counter = itertools.count()
@@ -109,6 +119,11 @@ class Tracer:
         if self._rank is None:
             self._rank = int(env.get("MXTPU_WORKER_ID"))
         return self._rank
+
+    @property
+    def epoch_anchor(self) -> float:
+        """Epoch seconds at trace ts 0 (the wall-clock anchor)."""
+        return self._epoch0
 
     @property
     def ring_capacity(self) -> int:

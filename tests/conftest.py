@@ -52,6 +52,12 @@ def pytest_configure(config):
         "Tier-1-safe: CPU, simulated worlds in-process plus one "
         "2-process coordination-service subprocess test.")
     config.addinivalue_line(
+        "markers", "comm_health: fleet-wide comm observability tests "
+        "(telemetry/collective.py collective ledger, desync/straggler "
+        "detection, hung-collective flight recorder, fleet trace "
+        "merge). Tier-1-safe: CPU, in-process simulated worlds plus "
+        "one 2-process kv_hang subprocess test.")
+    config.addinivalue_line(
         "markers", "memory: device-memory observability tests "
         "(telemetry/memory.py live-byte ledger, per-program "
         "attribution, trace memory track, OOM forensics). Tier-1-safe: "

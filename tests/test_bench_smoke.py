@@ -82,6 +82,16 @@ def test_bench_tiny_deadline_emits_full_headline_json():
     assert abs(zrow["rank0_share"] - 1.0 / zrow["world"]) < 0.01
     assert zrow["step_ms_zero"] > 0 and zrow["step_ms_unsharded"] > 0
     assert zrow["zero_collectives_per_step"] >= 2  # rs + ag per bucket
+    # the comm_health row: the collective-observability plane over a
+    # clean simulated ZeRO run — ledger populated, no skew (one process,
+    # one clock), and ZERO watchdog firings with the watchdog armed
+    crow = payload["comm_health"]
+    assert crow["world"] == 4
+    assert crow["ledger_depth"] > 0
+    assert crow["watchdog_fired"] == 0
+    assert crow["max_coll_skew_ms"] == 0.0
+    assert crow["desync"] is None
+    assert crow["collectives_per_step"] >= 2
 
 
 def test_bench_exhausted_deadline_still_emits_parseable_row():

@@ -25,10 +25,17 @@ tool turns it back into the operator-facing tables without Perfetto:
   sample, so the first sampled step (no earlier baseline in the trace)
   reports no delta rather than a fabricated 0.
 
+A MERGED multi-rank trace (``tools/fleet_trace.py`` output — events from
+more than one pid) reports per rank: the same tables, one section per
+pid, and ``--json`` nests them under ``{"ranks": {"<pid>": {...}}}``.
+Single-rank traces keep the exact single-rank output (byte-identical —
+the multi-rank path only engages when a second pid actually appears).
+
 Pure stdlib on purpose — it must run on a laptop with nothing installed::
 
     python tools/trace_report.py /tmp/rank3.json
     python tools/trace_report.py /tmp/rank3.json --steps 8 --json
+    python tools/trace_report.py /tmp/merged.json   # per-rank sections
 """
 from __future__ import annotations
 
@@ -244,6 +251,22 @@ def _fmt_table(rows: List[Dict[str, Any]], limit: int) -> List[str]:
     return lines
 
 
+def _print_autotune(tuner: Dict[str, Any], prefix: str = "") -> None:
+    """The tuner sections of the text report; with an empty prefix this
+    is byte-identical to the historical single-rank output."""
+    if tuner["probes"]:
+        print(f"\n== {prefix}autotune probes ==")
+        for label, st in tuner["probes"].items():
+            print(f"  {label:<20} {st['steps']} step(s), "
+                  f"mean {st['mean_ms']:.3f} ms")
+    if tuner["decision"] is not None:
+        print(f"\n== {prefix}autotune decision ==")
+        print(json.dumps(tuner["decision"], indent=1, sort_keys=True))
+    elif tuner["probes"]:
+        print("\n(no lock decision in trace — tuner still probing "
+              "or ring evicted it)")
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         description="Per-step segment-share table + autotuner decisions "
@@ -260,6 +283,32 @@ def main(argv: Optional[List[str]] = None) -> int:
     except (OSError, ValueError) as e:
         print(f"trace_report: {e}", file=sys.stderr)
         return 2
+    pids = sorted({int(e["pid"]) for e in events
+                   if e.get("ph") != "M" and "pid" in e})
+    if len(pids) > 1:
+        # merged fleet trace: one report per rank, keyed by pid. The
+        # single-rank path below stays byte-identical — this branch only
+        # engages when a second pid actually appears.
+        per_rank = {}
+        for pid in pids:
+            # events with no pid belong to NO rank (legal chrome JSON):
+            # defaulting them in would duplicate them into every section
+            sub = [e for e in events
+                   if e.get("pid") is not None and int(e["pid"]) == pid]
+            per_rank[str(pid)] = {"steps": step_table(sub),
+                                  "autotune": autotune_report(sub)}
+        if args.json:
+            print(json.dumps({"ranks": per_rank}, indent=1))
+            return 0
+        print(f"== {args.trace}: merged trace, {len(pids)} rank(s), "
+              f"{len(events)} events ==")
+        for pid in pids:
+            rep = per_rank[str(pid)]
+            print(f"\n== rank {pid}: {len(rep['steps'])} step(s) ==")
+            for line in _fmt_table(rep["steps"], args.steps):
+                print(line)
+            _print_autotune(rep["autotune"], f"rank {pid} ")
+        return 0
     rows = step_table(events)
     tuner = autotune_report(events)
     if args.json:
@@ -269,17 +318,7 @@ def main(argv: Optional[List[str]] = None) -> int:
           f"{len(events)} events ==")
     for line in _fmt_table(rows, args.steps):
         print(line)
-    if tuner["probes"]:
-        print("\n== autotune probes ==")
-        for label, st in tuner["probes"].items():
-            print(f"  {label:<20} {st['steps']} step(s), "
-                  f"mean {st['mean_ms']:.3f} ms")
-    if tuner["decision"] is not None:
-        print("\n== autotune decision ==")
-        print(json.dumps(tuner["decision"], indent=1, sort_keys=True))
-    elif tuner["probes"]:
-        print("\n(no lock decision in trace — tuner still probing "
-              "or ring evicted it)")
+    _print_autotune(tuner)
     return 0
 
 
