@@ -931,6 +931,86 @@ def _comm_health_probe(steps=3, width=32, n_params=8, world=4):
     }
 
 
+def _numerics_probe(steps=6, batch=32, width=64):
+    """The `numerics` row: the in-graph tensor-stats plane over a short
+    instrumented FitLoop — the global gradient norm and update ratio a
+    transformer recipe is graded on, the sampled-step overhead vs the
+    plane off (stats are extra outputs of the same bucket programs, so
+    this should be noise), and the provenance drill: an injected
+    nan_grad step must fire the non-finite forensics dump EXACTLY once
+    and name the poisoned parameter."""
+    import tempfile
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, io as mxio
+    from mxnet_tpu import kvstore as kvs
+    from mxnet_tpu.contrib import chaos
+    from mxnet_tpu.fit import FitLoop
+
+    dump_dir = tempfile.mkdtemp(prefix="bench_numerics_")
+    saved = {k: os.environ.get(k) for k in
+             ("MXTPU_NUMERICS", "MXTPU_MEM_DUMP_DIR", "MXTPU_CHAOS")}
+    for k in saved:
+        os.environ.pop(k, None)
+    os.environ["MXTPU_MEM_DUMP_DIR"] = dump_dir
+
+    def run(spec, chaos_spec=None):
+        os.environ.pop("MXTPU_NUMERICS", None)
+        if spec:
+            os.environ["MXTPU_NUMERICS"] = spec
+        if chaos_spec:
+            chaos.install(chaos_spec)
+        try:
+            mx.random.seed(0)
+            rs = np.random.RandomState(0)
+            net = gluon.nn.Sequential()
+            net.add(gluon.nn.Dense(width, activation="relu"),
+                    gluon.nn.Dense(8))
+            net.initialize(mx.init.Xavier())
+            data = rs.randn(steps * batch, width).astype(np.float32)
+            label = rs.randint(0, 8, (steps * batch,)).astype(np.float32)
+            it = mxio.NDArrayIter(data, label, batch_size=batch)
+            tr = gluon.Trainer(net.collect_params(), "adam",
+                               {"learning_rate": 1e-3},
+                               kvstore=kvs.create("device"))
+            loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+            loop = FitLoop(net, tr, loss_fn, it, ckpt_dir=None,
+                           collect_breakdown=False)
+            t0 = time.perf_counter()
+            result = loop.fit(epochs=1)
+            return result, (time.perf_counter() - t0) / steps * 1e3
+        finally:
+            if chaos_spec:
+                chaos.uninstall()
+
+    try:
+        run(None)                      # warm the stats-free programs
+        _, off_ms = run(None)
+        run("on")                      # warm the stats-emitting variants
+        res_on, on_ms = run("on")
+        res_chaos, _ = run("on", chaos_spec="nan_grad@2")
+    finally:
+        for k, v in saved.items():
+            os.environ.pop(k, None)
+            if v is not None:
+                os.environ[k] = v
+    num = res_on.numerics or {}
+    chaos_num = res_chaos.numerics or {}
+    overhead = ((on_ms - off_ms) / off_ms * 100.0) if off_ms > 0 else 0.0
+    return {
+        "grad_norm": round(float(num.get("grad_norm", 0.0)), 6),
+        "update_ratio": round(float(num.get("update_ratio", 0.0)), 8),
+        "samples": int(num.get("samples", 0)),
+        "step_ms_off": round(off_ms, 2),
+        "step_ms_on": round(on_ms, 2),
+        "sampled_overhead_pct": round(overhead, 1),
+        "provenance_dumps": len(chaos_num.get("dumps", [])),
+        "culprit": (chaos_num.get("culprits") or [None])[0],
+        "nonfinite_steps": chaos_num.get("nonfinite_steps", []),
+        "loss_scale_events": len(chaos_num.get("loss_scale_events", [])),
+    }
+
+
 def _run_child(mode, args_rest):
     if not _init_backend():
         os._exit(1)
@@ -984,6 +1064,13 @@ def _run_child(mode, args_rest):
                       flush=True)
             except Exception as e:
                 log(f"comm health probe failed: {e}")
+        if os.environ.get("MXTPU_BENCH_NUMERICS", "1") != "0":
+            try:
+                nrow = _numerics_probe()
+                print("EXTRA_ROW " + json.dumps({"numerics": nrow}),
+                      flush=True)
+            except Exception as e:
+                log(f"numerics probe failed: {e}")
 
 
 # global wall-clock budget: the driver kills the whole bench at some
@@ -1199,6 +1286,12 @@ def main():
                 # depth, cross-rank skew and a zero watchdog count on a
                 # clean simulated N-rank ZeRO run
                 payload["comm_health"] = _EXTRAS["comm_health"]
+            if "numerics" in _EXTRAS:
+                # the numerics-plane evidence: global grad norm + update
+                # ratio from the in-graph stats, sampled-step overhead
+                # vs the plane off, and the provenance drill firing
+                # exactly once under an injected nan_grad
+                payload["numerics"] = _EXTRAS["numerics"]
             # the train number is safe on stdout NOW; each optional row
             # that lands re-emits the extended line immediately, so a
             # truncated run keeps everything measured so far
@@ -1242,7 +1335,8 @@ def main():
                                    "MXTPU_BENCH_AUTOTUNE": "0",
                                    "MXTPU_BENCH_MEMORY": "0",
                                    "MXTPU_BENCH_ZERO": "0",
-                                   "MXTPU_BENCH_COMM_HEALTH": "0"})
+                                   "MXTPU_BENCH_COMM_HEALTH": "0",
+                                   "MXTPU_BENCH_NUMERICS": "0"})
                     if t8:
                         payload["train_int8_imgs_per_sec"] = round(t8, 2)
                         print(json.dumps(payload), flush=True)

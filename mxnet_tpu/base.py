@@ -337,6 +337,25 @@ env.declare("MXTPU_COLL_HEALTH", int, 0,
             "runs: the exchange is itself a collective — every rank "
             "must run the same cadence. 0 (default) = off; unparseable "
             "values raise.")
+env.declare("MXTPU_NUMERICS", str, "",
+            "In-graph numerics observability plane (telemetry/"
+            "numerics.py): 'on[,every=N][,stats=l2|absmax|mean|nonfinite|"
+            "update_ratio][,pattern=RE]' makes every Nth (default every "
+            "1) grouped optimizer update emit per-parameter tensor "
+            "statistics — grad/weight L2, abs-max, mean, non-finite "
+            "counts, update/weight ratio — as extra outputs of the SAME "
+            "compiled bucket programs (zero extra dispatches; the stats "
+            "ride fit.FitLoop's existing flag+loss transfer). A sentinel-"
+            "skipped step additionally runs a non-finite provenance pass "
+            "naming the first offending parameter in an ERROR log and a "
+            "numerics_<pid>_<n>.json forensics dump (MXTPU_MEM_DUMP_DIR). "
+            "Surfaces: FitResult.numerics, mxtpu_numerics_* gauges, "
+            "Perfetto 'C' counters (category 'numerics'), "
+            "tools/trace_report.py columns, Monitor.install_numerics. "
+            "Numerically inert (bitwise on-vs-off parity); 'pattern' "
+            "filters which parameters get per-param records (no commas "
+            "in the regex). Empty/off (default) = one cached flag check "
+            "per step; unknown tokens raise.")
 env.declare("MXTPU_PROFILE_BOUND_FRAC", float, 0.4,
             "Step-breakdown detector threshold: any non-compute segment "
             "(data_wait/h2d/comm/optimizer/checkpoint) whose share of "

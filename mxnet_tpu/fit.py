@@ -40,6 +40,7 @@ from .contrib import chaos as _chaos
 from .telemetry import autotune as _autotune
 from .telemetry import collective as _collective
 from .telemetry import memory as _memory
+from .telemetry import numerics as _numerics
 from .telemetry.step_breakdown import StepBreakdown, segment as _segment
 
 __all__ = ["FitLoop", "FitResult", "resumable_exit_code"]
@@ -71,6 +72,9 @@ class FitResult:
     zero: Optional[dict] = None  # ZeRO-1 plane summary (MXTPU_ZERO=1)
     comm_health: Optional[dict] = None  # collective skew/desync/watchdog
     # summary (MXTPU_COLL_HEALTH / MXTPU_COLL_TIMEOUT_S)
+    numerics: Optional[dict] = None  # tensor-stat window + loss-scale
+    # timeline + non-finite provenance (MXTPU_NUMERICS; the loss-scale
+    # timeline is recorded even with the plane off)
 
 
 class FitLoop:
@@ -173,6 +177,31 @@ class FitLoop:
             checks.append(jnp.isfinite(p.grad()._data).all())
         return jnp.stack(checks).all() if checks else jnp.asarray(True)
 
+    def _record_late_numerics(self, step: int, finite: bool) -> None:
+        """Publish sampled stats a CLASSIC (non-sentinel) update produced
+        after the step's main transfer already happened — the
+        ``skip_nonfinite=False`` path, where no single-transfer contract
+        constrains us to ride the flag fetch."""
+        nstats = getattr(self._trainer, "last_numerics_stats", None)
+        if not nstats:
+            # per-param classic update (aggregation off / ineligible
+            # optimizer): the grouped collector never ran and nothing
+            # consumed this step's sample — an armed plane must not
+            # silently measure nothing, so fall back here (grad/weight
+            # stats; the update already applied, so no update_ratio)
+            nstats = _numerics.fallback_collect(self._trainer)
+        if not nstats:
+            return
+        import jax
+        try:
+            nvals = jax.device_get([m for _, m in nstats])
+            _numerics.record_step(
+                step, [(names, v) for (names, _), v in zip(nstats, nvals)],
+                loss_scale=self._loss_scale, finite=finite,
+                trainer=self._trainer)
+        except Exception as e:
+            _LOG.warning("numerics record failed: %s", e)
+
     def _position_iter(self, epoch: int) -> None:
         set_epoch = getattr(self._iter, "set_epoch", None)
         if set_epoch is not None:
@@ -224,6 +253,13 @@ class FitLoop:
         # window so a stale watermark from an earlier run can't fire it
         _memory.reset_pressure_state()
         _memory.ledger().begin_window()
+        # numerics plane (MXTPU_NUMERICS): strict parse raises HERE —
+        # before any step runs AND before the signal handlers install
+        # below (a raise after installation would leak this loop's
+        # handler into the caller's process); recent window / loss-scale
+        # timeline / provenance dumps re-arm per fit like the planes
+        # above
+        _numerics.reset_run()
         good_streak = 0
         hb = None
         if self._heartbeat and self._ckpt_dir is not None:
@@ -304,6 +340,8 @@ class FitLoop:
                     if plan is not None:
                         plan.begin_step(result.step)
                         plan.maybe_kill()  # ChaosKilled propagates (abrupt)
+                    # numerics sampling clock (one cached flag check off)
+                    _numerics.mark_step(result.step)
                     if self._preempted is not None:
                         self._final_exit(cm, result, epoch, consumed)
                     if tuner is not None:
@@ -353,29 +391,82 @@ class FitLoop:
                                 bs * self._loss_scale,
                                 ignore_stale_grad=self._ignore_stale_grad)
                     # the blocking fetch realizes the whole async step
-                    # (forward/backward dominate): charged to compute
+                    # (forward/backward dominate): charged to compute.
+                    # Sampled numerics stats (MXTPU_NUMERICS) ride the
+                    # SAME transfer — the single-sync contract holds
+                    # with the plane on
+                    nstats = getattr(self._trainer,
+                                     "last_numerics_stats", None)
+                    nvals = None
                     if fused_flag is not None:
                         with _segment("compute"):
-                            ok, lval = jax.device_get((fused_flag, loss_dev))
+                            if nstats:
+                                ok, lval, nvals = jax.device_get(
+                                    (fused_flag, loss_dev,
+                                     [m for _, m in nstats]))
+                            else:
+                                ok, lval = jax.device_get(
+                                    (fused_flag, loss_dev))
+                                # an EMPTY parked list (distributed ZeRO
+                                # rank owning zero params on a sampled
+                                # step) must still reach record_step —
+                                # its stats merge is a collective
+                                nvals = [] if nstats is not None else None
                         finite, loss_val = bool(ok), float(lval)
                         if not finite:
                             self._trainer.rollback_step()
                     elif self._skip_nonfinite:
+                        # fused path declined: per-param fallback stats
+                        # (one small extra dispatch, still one transfer)
+                        nstats = _numerics.fallback_collect(self._trainer)
                         with _segment("compute"):
-                            ok, lval = jax.device_get(
-                                (self._grads_finite_flag(), loss_dev))
+                            if nstats:
+                                ok, lval, nvals = jax.device_get(
+                                    (self._grads_finite_flag(), loss_dev,
+                                     [m for _, m in nstats]))
+                            else:
+                                ok, lval = jax.device_get(
+                                    (self._grads_finite_flag(), loss_dev))
                         finite, loss_val = bool(ok), float(lval)
                     else:
                         finite = True
+                        nstats = None
                         with _segment("compute"):
                             loss_val = float(jax.device_get(loss_dev))
+                    if nvals is not None:
+                        try:
+                            _numerics.record_step(
+                                result.step,
+                                [(names, v) for (names, _), v
+                                 in zip(nstats, nvals)],
+                                loss_scale=self._loss_scale,
+                                finite=finite, trainer=self._trainer)
+                        except Exception as e:
+                            _LOG.warning("numerics record failed: %s", e)
                     if not finite:
                         # sentinel: skip the update entirely — params and
                         # optimizer state stay at the pre-step values —
                         # and back off the loss scale
                         result.skipped_steps.append(result.step)
+                        # provenance BEFORE the grads are zeroed below:
+                        # the plane names the first parameter that went
+                        # non-finite and writes the forensics record —
+                        # the extra syncs land only on this already-lost
+                        # step, never on a clean one
+                        if _numerics.enabled():
+                            try:
+                                _numerics.nonfinite_step(
+                                    result.step, self._trainer,
+                                    loss_scale=self._loss_scale)
+                            except Exception as e:
+                                _LOG.warning(
+                                    "numerics provenance failed: %s", e)
+                        old_scale = self._loss_scale
                         self._loss_scale = max(
                             self._loss_scale * self._scale_backoff, 2e-5)
+                        _numerics.note_loss_scale(
+                            result.step, old_scale, self._loss_scale,
+                            "backoff")
                         good_streak = 0
                         # zero (not just mark stale) the grad buffers: a
                         # grad_req='add' buffer would otherwise accumulate
@@ -393,12 +484,17 @@ class FitLoop:
                                 self._trainer.update(
                                     bs * self._loss_scale,
                                     ignore_stale_grad=self._ignore_stale_grad)
+                            self._record_late_numerics(result.step, finite)
                         good_streak += 1
                         if self._scale_growth and \
                                 good_streak % self._scale_growth == 0 and \
                                 self._loss_scale < self._max_scale:
+                            old_scale = self._loss_scale
                             self._loss_scale = min(self._loss_scale * 2.0,
                                                    self._max_scale)
+                            _numerics.note_loss_scale(
+                                result.step, old_scale, self._loss_scale,
+                                "growth")
                     result.losses.append(loss_val)
                     consumed += 1
                     result.step += 1
@@ -489,6 +585,10 @@ class FitLoop:
             # the comm axis next to the time and memory axes: last skew
             # comparison + ledger depth + watchdog firings
             result.comm_health = _collective.health_summary()
+        # the numbers axis: sampled-stat window, loss-scale timeline,
+        # non-finite provenance (None when the plane is off and no
+        # loss-scale event fired)
+        result.numerics = _numerics.summary()
         plane = getattr(self._trainer, "_zero", None)
         if plane:
             # ZeRO-1 plane summary (world/ranks/shard size) next to the

@@ -53,6 +53,7 @@ from ..base import MXNetError, check, env
 from .. import optimizer as opt_mod
 from ..optimizer import grouped as _grouped
 from ..telemetry import memory as _memory
+from ..telemetry import numerics as _numerics
 from ..telemetry.step_breakdown import segment as _bd_segment
 from .parameter import Parameter, ParameterDict
 
@@ -169,6 +170,10 @@ class Trainer:
         self.last_allreduce_collectives = 0
         self.last_reduce_scatter_collectives = 0
         self.last_allgather_collectives = 0
+        # numerics plane (MXTPU_NUMERICS): device stat arrays of the last
+        # sampled update — [(param_names, (n,6) matrix)] per bucket, left
+        # UN-fetched so FitLoop rides them on its flag+loss transfer
+        self.last_numerics_stats = None
         # ZeRO-1 plane: None = not yet resolved, False = off, else the
         # live parallel.zero.ZeroPlane; _zero_step carries the plane from
         # allreduce_grads (reduce-scatter ran) to the following _update;
@@ -532,6 +537,9 @@ class Trainer:
         self._last_fused_created = []
 
     def _update(self, ignore_stale_grad=False, sentinel=False):
+        # stale sampled stats must not outlive their step: FitLoop reads
+        # this attribute right after the update call
+        self.last_numerics_stats = None
         plane = self._zero_step
         self._zero_step = None
         if plane is not None:
@@ -587,8 +595,17 @@ class Trainer:
             # caller falls back to check-then-update
             return None
         handled, flag = set(), None
+        stats_out = None
         if agg > 0 and todo:
             if sentinel:
+                # numerics plane: one consume-once sampling decision per
+                # step; when sampled the bucket programs emit the extra
+                # stats output (same dispatch count — cost is outputs,
+                # not launches). One cached flag check when off. Consumed
+                # only when a grouped call actually runs — a per-param
+                # step leaves the sample for the caller's fallback.
+                nspec = _numerics.collect_spec()
+                stats_out = [] if nspec is not None else None
                 # the flag must cover EVERY live grad — including stale
                 # ones skipped under ignore_stale_grad — exactly like the
                 # classic host check (FitLoop._grads_finite_flag), or the
@@ -597,7 +614,7 @@ class Trainer:
                                        if p._grad is not None)
                 idxs, n, flag, created = _grouped.grouped_update(
                     updater, todo, agg, sentinel=True,
-                    sentinel_grads=sentinel_grads)
+                    sentinel_grads=sentinel_grads, stats_out=stats_out)
                 handled = set(idxs)
                 self._last_fused_indices = idxs
                 self._last_fused_created = created
@@ -606,10 +623,20 @@ class Trainer:
                 dense = [(i, p) for i, p in todo
                          if _grouped.eligible(updater, [(i, p)])]
                 if dense:
-                    idxs, n, _, _ = _grouped.grouped_update(updater, dense,
-                                                            agg)
+                    # collect only when the grouped call covers EVERY
+                    # live param — a mixed dense/ineligible set would
+                    # publish a silently under-counted "global" grad
+                    # norm; leaving the sample unconsumed lets the
+                    # caller's fallback cover the full set instead
+                    if len(dense) == len(todo):
+                        nspec = _numerics.collect_spec()
+                        stats_out = [] if nspec is not None else None
+                    idxs, n, _, _ = _grouped.grouped_update(
+                        updater, dense, agg, stats_out=stats_out)
                     handled = set(idxs)
                     self.last_update_dispatches += n
+        if stats_out:
+            self.last_numerics_stats = stats_out
         for i, p in todo:
             if i in handled:
                 p._fresh_grad = False
@@ -669,6 +696,12 @@ class Trainer:
                 return None
             return flag
         agg = max(1, _grouped.aggregation_size())
+        # numerics plane: one sampling decision covers every shard's
+        # grouped call this step (simulated worlds step all ranks here,
+        # so the stats matrix spans the full parameter set; a real group
+        # merges shard-local stats over the byte channel at record time)
+        nspec = _numerics.collect_spec()
+        stats_out = [] if nspec is not None else None
         handled, created, n_disp = [], [], 0
         for r in plane.my_ranks:
             items = [(i, p) for i, p in todo if plane.owner(i) == r]
@@ -676,10 +709,16 @@ class Trainer:
                 continue
             idxs, n, _f, cr = _grouped.grouped_update(
                 updater, items, agg, sentinel=sentinel,
-                sentinel_flag=flag)
+                sentinel_flag=flag, stats_out=stats_out)
             handled += idxs
             created += cr
             n_disp += n
+        if stats_out is not None:
+            # park even an EMPTY list (a distributed rank owning zero
+            # params this step): record_step's cross-rank stats merge is
+            # a collective, and a rank that silently skipped it would
+            # deadlock every peer on the first sampled step
+            self.last_numerics_stats = stats_out
         if sentinel:
             n_disp += 1  # the fused finite reduction
             self._last_fused_indices = handled

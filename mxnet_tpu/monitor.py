@@ -6,6 +6,15 @@ TPU-native: whole-graph compilation means there are no per-op engine
 callbacks to hook; instead the Monitor evaluates the executor's internal
 outputs on demand (get_internals-style) or wraps eager dispatch. `tic/toc`
 semantics match the reference surface.
+
+Jit-native feed (:meth:`Monitor.install_numerics`): the in-graph numerics
+plane (``telemetry/numerics.py``, ``MXTPU_NUMERICS``) pushes each sampled
+step's per-parameter statistics — grad L2 / abs-max / mean / non-finite
+count / update-weight ratio, computed INSIDE the compiled update programs
+— into this Monitor's queue, pattern- and activation-gated exactly like
+the executor path. The legacy ``tic``/``toc``/``toc_print`` surface is
+unchanged; the entries simply come from the plane instead of a host
+callback, so they see inside whole-graph jitted programs.
 """
 from __future__ import annotations
 
@@ -42,6 +51,18 @@ class Monitor:
         """(ref: monitor.py install_to_executor)"""
         self._exes.append(exe)
         exe.set_monitor_callback(self._stat_helper, self.monitor_all)
+
+    def install_numerics(self) -> "Monitor":
+        """Feed this Monitor from the in-graph numerics plane
+        (``MXTPU_NUMERICS``): each sampled step's per-parameter stats are
+        appended to the ``tic``/``toc`` queue as ``(step,
+        "<param>:<stat>", value)`` entries while the Monitor is activated
+        and the name matches ``pattern`` — the reference Monitor
+        contract, now sourced from inside the compiled update programs.
+        Returns self for chaining."""
+        from .telemetry import numerics as _numerics
+        _numerics.attach_monitor(self)
+        return self
 
     def _stat_helper(self, name, value) -> None:
         if not self.activated or not self.re_prog.match(str(name)):

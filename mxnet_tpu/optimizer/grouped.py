@@ -286,7 +286,12 @@ def program_memory(refresh: bool = False) -> Dict[str, dict]:
     f32 = _np2.dtype("float32")
     for sig, fn in _cache().snapshot_items():
         try:
-            rule_name, _statics, sentinel, donated_sig, grads_sig = sig
+            if len(sig) == 6:
+                # stats-emitting variant (MXTPU_NUMERICS sampled steps)
+                rule_name, _statics, sentinel, _stats, donated_sig, \
+                    grads_sig = sig
+            else:
+                rule_name, _statics, sentinel, donated_sig, grads_sig = sig
         except (TypeError, ValueError):
             continue  # foreign cache entry (shared LRU discipline)
         digest = hashlib.md5(repr(sig).encode()).hexdigest()[:12]
@@ -322,26 +327,51 @@ def program_memory(refresh: bool = False) -> Dict[str, dict]:
     return out
 
 
-def _build_bucket_fn(kernels, guarded: bool):
+def _build_bucket_fn(kernels, guarded: bool, stats: bool = False):
     """One jitted program stepping a whole bucket.
 
     Arguments: (lrs, wds, rescale[, ok], donated, grads) where ``donated``
     is a tuple of per-param (weight, *state_arrays) tuples — donated to the
     program so XLA writes updates into the same buffers — and ``grads`` is
     the matching tuple of gradient arrays (NOT donated).
+
+    With ``stats`` (a numerics-plane sampled step, ``MXTPU_NUMERICS``),
+    the program additionally returns one ``(n_params, 6)`` f32 matrix of
+    per-parameter tensor statistics in :data:`telemetry.numerics
+    .RAW_FIELDS` order — computed from the SAME traced values the update
+    consumes (grads pre-guard, weights pre-update, the would-be update
+    delta), so a sampled step costs extra outputs, not extra dispatches,
+    and the update math itself is untouched (bitwise-parity pinned).
     """
     import jax
     jnp = _jnp()
 
     def step(lrs, wds, rescale, ok, donated, grads):
-        outs = []
+        outs, stat_rows = [], []
         for i, (bundle, g) in enumerate(zip(donated, grads)):
             w, states = bundle[0], tuple(bundle[1:])
             nw, ns = kernels[i](w, g, states, lrs[i], wds[i], rescale)
+            if stats:
+                gf = g.astype(jnp.float32)
+                wf = w.astype(jnp.float32)
+                dwf = nw.astype(jnp.float32) - wf
+                zero = jnp.zeros((), jnp.float32)
+                stat_rows.append(jnp.stack([
+                    jnp.sum(gf * gf),
+                    jnp.sum(wf * wf),
+                    jnp.sum(dwf * dwf),
+                    # guard the empty-array reductions (a 0-dim shape):
+                    # max raises and mean NaNs on zero elements
+                    jnp.max(jnp.abs(gf)) if g.size else zero,
+                    jnp.mean(gf) if g.size else zero,
+                    jnp.sum(~jnp.isfinite(g)).astype(jnp.float32),
+                ]))
             if ok is not None:
                 nw = jnp.where(ok, nw, w)
                 ns = tuple(jnp.where(ok, a, b) for a, b in zip(ns, states))
             outs.append((nw,) + tuple(ns))
+        if stats:
+            return tuple(outs), jnp.stack(stat_rows)
         return tuple(outs)
 
     if guarded:
@@ -408,7 +438,8 @@ def _devices_key(arr) -> Tuple:
 
 
 def grouped_update(updater, items, agg_size: int, sentinel: bool = False,
-                   sentinel_grads=None, sentinel_flag=None):
+                   sentinel_grads=None, sentinel_flag=None,
+                   stats_out=None):
     """Apply one aggregated optimizer step to ``items`` ([(index, Parameter)]
     with fresh dense gradients).
 
@@ -422,6 +453,14 @@ def grouped_update(updater, items, agg_size: int, sentinel: bool = False,
     local fused reduction — the ZeRO-1 path passes the cross-rank
     AND-reduced global flag here, so every rank's shard update is guarded
     by the same verdict (a NaN anywhere skips the step everywhere).
+
+    ``stats_out``: a list to collect per-bucket numerics stats into (the
+    MXTPU_NUMERICS sampled-step hook): each bucket program then emits one
+    extra ``(n_params, 6)`` f32 matrix (``telemetry.numerics.RAW_FIELDS``
+    order) and ``(param_names, device_matrix)`` is appended per bucket —
+    device arrays, NOT fetched here: the caller rides them on its
+    existing flag+loss transfer. None (default) = the stats-free
+    programs, bit-for-bit the historical behavior.
 
     Returns ``(handled_indices, n_dispatches, finite_flag, created)``
     where ``finite_flag`` is a device scalar when ``sentinel`` and None
@@ -491,6 +530,7 @@ def grouped_update(updater, items, agg_size: int, sentinel: bool = False,
 
     rescale = jnp.asarray(float(opt.rescale_grad), dtype=jnp.float32)
     statics_key = rule.statics(opt)
+    collect = stats_out is not None
     n_dispatch = 0
     handled = []
     for chunk in chunks:
@@ -503,12 +543,16 @@ def grouped_update(updater, items, agg_size: int, sentinel: bool = False,
             grads.append(p._grad._data)
         donated = tuple(donated)
         grads = tuple(grads)
-        sig = (rule.name, statics_key, bool(sentinel),
-               tuple(tuple((tuple(a.shape), str(a.dtype)) for a in bundle)
-                     for bundle in donated),
-               tuple((tuple(g.shape), str(g.dtype)) for g in grads))
+        # the stats variant inserts one True element; the stats-free
+        # signature stays the historical 5-tuple, so warm caches (and
+        # program_memory consumers) are untouched
+        sig = ((rule.name, statics_key, bool(sentinel)) +
+               ((True,) if collect else ()) +
+               (tuple(tuple((tuple(a.shape), str(a.dtype))
+                            for a in bundle) for bundle in donated),
+                tuple((tuple(g.shape), str(g.dtype)) for g in grads)))
 
-        def _build(chunk=chunk, s=sentinel):
+        def _build(chunk=chunk, s=sentinel, c=collect):
             # kernel closures are built ONLY on a signature-cache miss —
             # the warm path (every step after the first) pays a key
             # lookup, not O(params) closure allocations
@@ -519,13 +563,17 @@ def grouped_update(updater, items, agg_size: int, sentinel: bool = False,
                 if mp2:
                     k = _wrap_mp(k)
                 kernels.append(_with_cast(k, mp2))
-            return _build_bucket_fn(tuple(kernels), s)
+            return _build_bucket_fn(tuple(kernels), s, stats=c)
 
         fn = _cache().get_or_build(sig, _build)
         if sentinel:
             outs = fn(lrs, wds, rescale, flag, donated, grads)
         else:
             outs = fn(lrs, wds, rescale, donated, grads)
+        if collect:
+            outs, srows = outs
+            stats_out.append(
+                (tuple(e[1].name for e in chunk), srows))
         n_dispatch += 1
         for (i, p, handles, _mp, _lr, _wd), bundle_out in zip(chunk, outs):
             p._data._rebind(bundle_out[0])

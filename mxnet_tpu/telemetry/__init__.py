@@ -39,6 +39,16 @@ is the TPU-native generalization; the whole stack reports into it:
   rank. ``tools/fleet_trace.py`` merges per-rank chrome traces onto one
   clock via the tracer's wall-clock anchor + offset handshake.
 
+- :mod:`.numerics` — the numbers axis: in-graph per-parameter tensor
+  statistics emitted by the grouped-update bucket programs themselves
+  (``MXTPU_NUMERICS``; zero extra dispatches, stats ride the step's
+  existing flag+loss transfer), non-finite provenance naming the exact
+  parameter a sentinel-skipped step blew up in (ERROR log +
+  ``numerics_<pid>_<n>.json`` forensics), and the dynamic loss-scale
+  timeline (``FitResult.numerics["loss_scale_events"]``,
+  ``mxtpu_loss_scale``). The legacy ``mxnet_tpu.monitor.Monitor`` is a
+  facade over it (``Monitor.install_numerics``).
+
 ``mxnet_tpu.profiler`` remains the MXNet-compatible facade over this
 package, and the kvstore remote profiler command channel
 (``KVStore.send_profiler_command``) is served by it, so the controller can
@@ -59,6 +69,8 @@ from .memory import (MemoryLedger, ledger as memory_ledger, dump_forensics)
 from . import collective
 from .collective import (CollectiveLedger,
                          ledger as collective_ledger)
+from . import numerics
+from .numerics import NumericsPlane, plane as numerics_plane
 
 __all__ = [
     "Tracer", "tracer", "span", "instant", "counter_event", "enabled",
@@ -68,4 +80,5 @@ __all__ = [
     "StepBreakdown", "segment", "current_breakdown", "SEGMENTS",
     "memory", "MemoryLedger", "memory_ledger", "dump_forensics",
     "collective", "CollectiveLedger", "collective_ledger",
+    "numerics", "NumericsPlane", "numerics_plane",
 ]

@@ -1,0 +1,771 @@
+"""In-graph numerics observability: tensor stats, non-finite provenance,
+loss-scale timeline.
+
+The measurement plane covers time (tracer / step breakdown), memory
+(live-byte ledger) and cross-rank comm (collective ledger); this module is
+the axis that decides whether training *works* — the numbers themselves.
+The finiteness sentinel (``Trainer.update_with_sentinel``) can skip a bad
+step but cannot name which parameter went non-finite, and the legacy
+``Monitor`` surface (``mxnet_tpu/monitor.py``, the reference's
+``MXExecutorSetMonitorCallback``) is a host-side callback that cannot see
+inside whole-graph jitted programs. Three layers:
+
+**In-graph tensor stats** (``MXTPU_NUMERICS=on[,every=N][,stats=...]
+[,pattern=RE]``): on a sampled step the grouped-update bucket programs
+(``optimizer/grouped.py``) emit one extra ``(n_params, 6)`` f32 output per
+bucket — per-parameter grad/weight sum-of-squares, update sum-of-squares,
+grad abs-max, grad mean and non-finite element count, computed from the
+SAME traced values the update consumes. A sampled step therefore costs
+O(buckets) extra program *outputs* and **zero extra dispatches**; the
+device arrays ride the step's existing single flag+loss transfer
+(``fit.FitLoop`` fetches them together). An unsampled step costs nothing
+on device, and with the plane off the whole hook is one cached flag check
+(the tracer discipline). The classic per-parameter fallback path computes
+the same matrix with one small dedicated program
+(:func:`fallback_collect`) — stats coverage survives a sentinel decline.
+
+**Non-finite provenance**: when a sentinel-skipped step fires with the
+plane armed, :func:`nonfinite_step` answers the question the sentinel
+can't — *which parameter*: a per-bucket non-finite count pass (one
+dispatch) locates the guilty bucket(s), a per-parameter pass inside the
+first guilty bucket (one more dispatch) names the first offending
+parameter, and a forensics record (``numerics_<pid>_<n>.json``,
+tmp+rename, the memory-dump anatomy) lands in ``MXTPU_MEM_DUMP_DIR`` with
+the offenders, their recent stats history, the loss-scale timeline and
+the last trace window; the culprit is named in an ERROR log. Extra host
+syncs happen only on the (already-lost) skipped step — clean steps keep
+the sentinel+loss single-transfer contract. Under distributed ZeRO the
+shard-local offender lists and stats ride the existing byte channel
+(``cross_process_allgather_object`` — recorded in the collective
+ledger), so every rank reports the same global verdict.
+
+**Loss-scale timeline**: ``fit.FitLoop`` records every backoff/regrowth
+event (step, old→new scale, trigger) through :func:`note_loss_scale` —
+recorded even with the plane off, because the trajectory was previously
+unobservable (only the final scale was checkpointed). Lands in
+``FitResult.numerics["loss_scale_events"]`` and the ``mxtpu_loss_scale``
+gauge.
+
+Everything surfaces where the other planes surface: ``FitResult.numerics``
+(per-stat recent window + timeline + dumps), ``mxtpu_numerics_*`` registry
+gauges, Perfetto ``"C"`` counters (``grad_norm`` / ``update_ratio`` /
+``loss_scale``, category ``numerics``) per sampled step,
+``tools/trace_report.py`` columns, and the rewired :class:`~mxnet_tpu
+.monitor.Monitor` facade (``Monitor.install_numerics``) whose legacy
+``tic``/``toc`` queue is fed from here — jit-native, same API.
+
+The plane is numerically inert: stats are additional pure outputs of the
+same traced update math — training trajectories are bitwise identical
+with it on or off (test-pinned, the PR 6/9/12 discipline).
+"""
+from __future__ import annotations
+
+import functools
+import itertools
+import json
+import math
+import os
+import re
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..base import MXNetError, env
+
+__all__ = ["NumericsSpec", "NumericsPlane", "plane", "spec", "enabled",
+           "mark_step", "collect_spec", "fallback_collect", "record_step",
+           "note_loss_scale", "nonfinite_step", "summary", "reset_run",
+           "attach_monitor", "RAW_FIELDS", "STATS"]
+
+#: columns of the raw per-parameter stat matrix the bucket programs emit,
+#: in order — grouped.py builds rows in exactly this layout
+RAW_FIELDS = ("grad_sumsq", "weight_sumsq", "update_sumsq",
+              "absmax", "mean", "nonfinite")
+
+#: publishable derived stats (the ``stats=`` grammar tokens)
+STATS = ("l2", "absmax", "mean", "nonfinite", "update_ratio")
+
+#: recent sampled-step records retained (the FitResult window)
+RECENT = 64
+#: per-parameter stat history depth (what a provenance dump replays)
+HIST = 16
+#: provenance bisect bucket width (params per stage-1 bucket)
+PROV_BUCKET = 16
+
+_dump_seq = itertools.count(1)
+_xchg_seq = itertools.count(1)
+
+
+class NumericsSpec:
+    """Parsed ``MXTPU_NUMERICS`` grammar: cadence, stat subset, name
+    filter. Immutable; identity-compared by the env cache."""
+    __slots__ = ("every", "stats", "pattern", "raw")
+
+    def __init__(self, every: int, stats: Tuple[str, ...],
+                 pattern: Optional["re.Pattern"], raw: str):
+        self.every = every
+        self.stats = stats
+        self.pattern = pattern
+        self.raw = raw
+
+    def sampled(self, step: int) -> bool:
+        return step % self.every == 0
+
+    def wants(self, name: str) -> bool:
+        return self.pattern is None or \
+            self.pattern.match(str(name)) is not None
+
+
+def _parse(raw: Optional[str]) -> Optional[NumericsSpec]:
+    """Strict ``MXTPU_NUMERICS`` parse — a typo'd request to measure must
+    not silently measure nothing (the MXTPU_PROFILE discipline). A spec
+    made only of modifiers (``every=``, ``stats=``, ``pattern=``) implies
+    ``on``. The pattern must not contain commas (they delimit tokens)."""
+    s = (raw or "").strip()
+    if not s:
+        return None
+    want_on = None
+    saw_modifier = False
+    every, stats, pattern = 1, tuple(STATS), None
+    for tok in s.split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        low = tok.lower()
+        if low in ("on", "1", "true", "all"):
+            want_on = True
+        elif low in ("off", "0", "false"):
+            want_on = False
+        elif "=" in tok:
+            saw_modifier = True
+            key, _, val = tok.partition("=")
+            key, val = key.strip().lower(), val.strip()
+            if key == "every":
+                try:
+                    every = int(val)
+                except ValueError:
+                    raise MXNetError(
+                        f"MXTPU_NUMERICS: every={val!r} is not an int")
+                if every < 1:
+                    raise MXNetError(
+                        f"MXTPU_NUMERICS: every must be >= 1, got {every}")
+            elif key == "stats":
+                names = tuple(t.strip() for t in val.split("|") if t.strip())
+                if not names:
+                    raise MXNetError(
+                        "MXTPU_NUMERICS: stats= needs at least one stat, "
+                        "e.g. stats=l2|update_ratio")
+                bad = [n for n in names if n not in STATS]
+                if bad:
+                    raise MXNetError(
+                        f"MXTPU_NUMERICS: unknown stat(s) {bad} "
+                        f"(known: {', '.join(STATS)})")
+                stats = names
+            elif key == "pattern":
+                if not val:
+                    raise MXNetError(
+                        "MXTPU_NUMERICS: pattern= needs a regex")
+                try:
+                    pattern = re.compile(val)
+                except re.error as e:
+                    raise MXNetError(
+                        f"MXTPU_NUMERICS: bad pattern {val!r}: {e}")
+            else:
+                raise MXNetError(
+                    f"MXTPU_NUMERICS: unknown key {key!r} "
+                    "(known: every, stats, pattern)")
+        else:
+            raise MXNetError(
+                f"MXTPU_NUMERICS: unknown token {tok!r} (known: on, off, "
+                "every=N, stats=a|b, pattern=RE)")
+    if want_on is False or (want_on is None and not saw_modifier):
+        return None
+    return NumericsSpec(every, stats, pattern, s)
+
+
+# raw env string -> parsed spec, cached: the off path is one environ
+# lookup + a string compare per call (the collective-ledger discipline);
+# strict-parse errors still raise on every call with a bad value
+_cache_lock = threading.Lock()
+_cached: Optional[Tuple[Optional[str], Optional[NumericsSpec]]] = None
+
+
+def spec() -> Optional[NumericsSpec]:
+    """The active plane spec, or None when off. Cached against the raw
+    env string so tests may monkeypatch ``MXTPU_NUMERICS`` mid-process."""
+    global _cached
+    raw = env.raw("MXTPU_NUMERICS")
+    c = _cached
+    if c is not None and c[0] == raw:
+        return c[1]
+    parsed = _parse(raw)
+    with _cache_lock:
+        _cached = (raw, parsed)
+    return parsed
+
+
+def enabled() -> bool:
+    return spec() is not None
+
+
+def _log():
+    from ..log import get_logger
+    return get_logger("mxnet_tpu.telemetry")
+
+
+class NumericsPlane:
+    """Per-process numerics state: the sampling clock, the recent-record
+    window, per-parameter stat history, the loss-scale timeline, attached
+    Monitor facades, and the provenance dump bookkeeping. ``reset_run``
+    re-arms it per fit (the ``reset_pressure_state`` discipline)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.records: deque = deque(maxlen=RECENT)
+        self.loss_scale_events: deque = deque(maxlen=512)
+        self.nonfinite_steps: List[int] = []
+        self.culprits: List[str] = []
+        self.dump_paths: List[str] = []
+        self.samples = 0
+        self._hist: Dict[str, deque] = {}
+        self._monitors: List[weakref.ref] = []
+        # sampling clock: FitLoop marks the real step; bare Trainer loops
+        # fall back to an internal counter. Consume-once per step so a
+        # fused decline + classic fallback can't double-sample one step.
+        self._ext_step: Optional[int] = None
+        self._ext_consumed = True
+        self._auto_step = -1
+        self.last_step: Optional[int] = None
+
+    # -- clock ----------------------------------------------------------
+    def mark(self, step: int) -> None:
+        with self._lock:
+            self._ext_step = int(step)
+            self._ext_consumed = False
+
+    def consume(self, s: NumericsSpec) -> Optional[NumericsSpec]:
+        """One sampling decision per step: the first collector (the
+        grouped update, or the FitLoop fallback after a decline) takes
+        it; later calls within the same marked step get None."""
+        with self._lock:
+            if self._ext_step is not None:
+                if self._ext_consumed:
+                    return None
+                self._ext_consumed = True
+                step = self._ext_step
+            else:
+                self._auto_step += 1
+                step = self._auto_step
+            self.last_step = step
+        return s if s.sampled(step) else None
+
+    # -- listeners ------------------------------------------------------
+    def attach_monitor(self, mon) -> None:
+        with self._lock:
+            self._monitors = [r for r in self._monitors
+                              if r() is not None and r() is not mon]
+            self._monitors.append(weakref.ref(mon))
+
+    def _feed_monitors(self, per_param: Dict[str, Dict[str, Any]]) -> None:
+        with self._lock:
+            refs = list(self._monitors)
+        for ref in refs:
+            mon = ref()
+            if mon is None:
+                with self._lock:
+                    try:
+                        self._monitors.remove(ref)
+                    except ValueError:
+                        pass
+                continue
+            if not getattr(mon, "activated", False):
+                continue
+            try:
+                for name, d in per_param.items():
+                    if mon.re_prog.match(name):
+                        for stat, val in d.items():
+                            mon.queue.append(
+                                (mon.step, f"{name}:{stat}", val))
+            except Exception:
+                pass  # a broken listener must not take down training
+
+    # -- run lifecycle --------------------------------------------------
+    def reset_run(self) -> None:
+        with self._lock:
+            self.records.clear()
+            self.loss_scale_events.clear()
+            self.nonfinite_steps = []
+            self.culprits = []
+            self.dump_paths = []
+            self.samples = 0
+            self._hist.clear()
+            self._ext_step = None
+            self._ext_consumed = True
+            self._auto_step = -1
+            self.last_step = None
+
+    def history(self, name: str) -> List[dict]:
+        with self._lock:
+            return [dict(r) for r in self._hist.get(name, ())]
+
+
+_PLANE = NumericsPlane()
+
+
+def plane() -> NumericsPlane:
+    return _PLANE
+
+
+def reset_run() -> None:
+    """Re-arm the plane for a fresh run (``fit.FitLoop`` calls this at
+    fit start, like ``memory.reset_pressure_state`` /
+    ``collective.reset_health``). Also the strict-parse checkpoint: a
+    typo'd ``MXTPU_NUMERICS`` raises HERE, before any step runs."""
+    spec()
+    _PLANE.reset_run()
+
+
+def mark_step(step: int) -> None:
+    """Pin the plane's sampling clock to the loop owner's step counter
+    (``fit.FitLoop`` calls this each step). One cached flag check when
+    the plane is off."""
+    if spec() is None:
+        return
+    _PLANE.mark(step)
+
+
+def collect_spec() -> Optional[NumericsSpec]:
+    """The Trainer's hook, called once per update: the active spec when
+    THIS step is sampled (consume-once), else None. With the plane off
+    this is one cached flag check — no clock reads, no device work."""
+    s = spec()
+    if s is None:
+        return None
+    return _PLANE.consume(s)
+
+
+def attach_monitor(mon) -> None:
+    """Register a legacy :class:`~mxnet_tpu.monitor.Monitor` as a plane
+    listener: sampled-step per-parameter stats are pushed into its
+    ``tic``/``toc`` queue (pattern- and activation-gated), so the
+    reference Monitor API keeps working against whole-graph jitted
+    programs."""
+    _PLANE.attach_monitor(mon)
+
+
+# ---------------------------------------------------------------------------
+# Per-parameter fallback stats (the classic non-grouped update path)
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=64)
+def _fallback_fn(n: int):
+    """One jitted program computing the RAW_FIELDS matrix over ``n``
+    (weight, grad) pairs — the fallback when the grouped bucket programs
+    (which embed the same stats for free) declined. ``update_sumsq`` is 0
+    here: the update has not been computed yet on this path."""
+    import jax
+    import jax.numpy as jnp
+
+    def fn(pairs):
+        rows = []
+        for w, g in pairs:
+            gf = g.astype(jnp.float32)
+            wf = w.astype(jnp.float32)
+            rows.append(jnp.stack([
+                jnp.sum(gf * gf),
+                jnp.sum(wf * wf),
+                jnp.zeros((), jnp.float32),
+                jnp.max(jnp.abs(gf)) if g.size else
+                jnp.zeros((), jnp.float32),
+                jnp.mean(gf) if g.size else jnp.zeros((), jnp.float32),
+                jnp.sum(~jnp.isfinite(g)).astype(jnp.float32),
+            ]))
+        return jnp.stack(rows)
+    return jax.jit(fn)
+
+
+def fallback_collect(trainer) -> Optional[list]:
+    """Sampled-step stats for the per-parameter update path: one small
+    dedicated dispatch over every live (weight, grad) pair, parked on
+    ``trainer.last_numerics_stats`` so the caller fetches the device
+    arrays together with the flag+loss transfer. Returns the parked list
+    or None (off / unsampled / nothing live)."""
+    s = collect_spec()
+    if s is None:
+        return None
+    names, pairs = [], []
+    for p in getattr(trainer, "_params", ()):
+        if getattr(p, "grad_req", "null") == "null" or p._grad is None:
+            continue
+        names.append(p.name)
+        pairs.append((p._data._data, p._grad._data))
+    if not pairs:
+        return None
+    mat = _fallback_fn(len(pairs))(tuple(pairs))
+    out = [(tuple(names), mat)]
+    trainer.last_numerics_stats = out
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Publication
+# ---------------------------------------------------------------------------
+
+def _gauges():
+    from .registry import default_registry
+    reg = default_registry()
+    return (
+        reg.gauge("mxtpu_numerics_grad_norm",
+                  "Global gradient L2 norm at the last sampled numerics "
+                  "step (MXTPU_NUMERICS)."),
+        reg.gauge("mxtpu_numerics_update_ratio",
+                  "Global update/weight L2 ratio at the last sampled "
+                  "numerics step."),
+    )
+
+
+def _loss_scale_gauge():
+    from .registry import default_registry
+    return default_registry().gauge(
+        "mxtpu_loss_scale",
+        "Current dynamic loss scale (fit.FitLoop; every backoff/regrowth "
+        "event lands in FitResult.numerics['loss_scale_events']).")
+
+
+def _derive(row, stats: Sequence[str]) -> Dict[str, Any]:
+    """One parameter's published stat dict from its raw matrix row."""
+    g2, w2, u2, amax, mean, nf = (float(v) for v in row)
+    d: Dict[str, Any] = {}
+    if "l2" in stats:
+        d["l2"] = math.sqrt(g2) if g2 >= 0 else float("nan")
+    if "absmax" in stats:
+        d["absmax"] = amax
+    if "mean" in stats:
+        d["mean"] = mean
+    if "nonfinite" in stats:
+        d["nonfinite"] = int(nf)
+    if "update_ratio" in stats and u2 > 0 and w2 > 0:
+        d["update_ratio"] = math.sqrt(u2 / w2)
+    return d
+
+
+def record_step(step: int, items, loss_scale: Optional[float] = None,
+                finite: bool = True, trainer=None) -> Optional[dict]:
+    """Publish one sampled step's host-fetched stats: ``items`` is a list
+    of ``(param_names, matrix)`` pairs (the matrix rows follow
+    ``RAW_FIELDS``). Computes the global grad norm / update ratio, the
+    pattern-filtered per-parameter stat dicts, feeds the gauges, Perfetto
+    counters, stat history and attached Monitors, and appends the record
+    to the recent window. Under a distributed ZeRO plane the shard-local
+    stats are allgathered over the byte channel first (a collective,
+    recorded in the collective ledger) so every rank publishes the same
+    global numbers."""
+    import numpy as _np
+    s = spec()
+    if s is None:
+        return None
+    zp = getattr(trainer, "_zero", None) if trainer is not None else None
+    distributed = bool(zp and getattr(zp, "distributed", False))
+    if not items and not distributed:
+        return None
+    if distributed:
+        from ..parallel.collectives import cross_process_allgather_object
+        shipped = [(list(n), _np.asarray(m, dtype=_np.float64).tolist())
+                   for n, m in items]
+        gathered = cross_process_allgather_object(
+            shipped, f"numst{next(_xchg_seq)}_")
+        items = [(tuple(n), m) for part in gathered for n, m in part]
+        if not items:
+            return None  # every shard empty this step: nothing to record
+    g2 = w2 = u2 = 0.0
+    nonfinite_params = 0
+    per_param: Dict[str, Dict[str, Any]] = {}
+    for names, mat in items:
+        mat = _np.asarray(mat, dtype=_np.float64)
+        for j, name in enumerate(names):
+            row = mat[j]
+            g2 += float(row[0])
+            w2 += float(row[1])
+            u2 += float(row[2])
+            if int(row[5]) > 0:
+                nonfinite_params += 1
+            if s.wants(name):
+                per_param[str(name)] = _derive(row, s.stats)
+    grad_norm = math.sqrt(g2) if g2 >= 0 else float("nan")
+    # the fallback path cannot know the would-be update (it runs before
+    # the per-param step): u2 == 0 there, and a fabricated 0.0 ratio
+    # would read as "updates stopped" — publish None instead
+    update_ratio = math.sqrt(u2 / w2) if (u2 > 0 and w2 > 0) else None
+    rec = {"step": int(step), "grad_norm": grad_norm,
+           "update_ratio": update_ratio, "finite": bool(finite),
+           "nonfinite_params": int(nonfinite_params),
+           "per_param": per_param}
+    if loss_scale is not None:
+        rec["loss_scale"] = float(loss_scale)
+    with _PLANE._lock:
+        _PLANE.records.append(rec)
+        _PLANE.samples += 1
+        for name, d in per_param.items():
+            h = _PLANE._hist.get(name)
+            if h is None:
+                h = _PLANE._hist[name] = deque(maxlen=HIST)
+            h.append(dict(d, step=int(step)))
+    try:
+        gn, ur = _gauges()
+        gn.set(grad_norm if math.isfinite(grad_norm) else -1.0)
+        if update_ratio is not None:
+            ur.set(update_ratio)
+        if loss_scale is not None:
+            _loss_scale_gauge().set(float(loss_scale))
+    except Exception:
+        pass
+    try:
+        from .tracer import tracer as _tr
+        if _tr.enabled and math.isfinite(grad_norm):
+            _tr.counter_event("grad_norm", grad_norm, category="numerics")
+            if update_ratio is not None:
+                _tr.counter_event("update_ratio", update_ratio,
+                                  category="numerics")
+        if _tr.enabled and loss_scale is not None:
+            _tr.counter_event("loss_scale", float(loss_scale),
+                              category="numerics")
+    except Exception:
+        pass
+    _PLANE._feed_monitors(per_param)
+    return rec
+
+
+def note_loss_scale(step: int, old: float, new: float,
+                    trigger: str) -> None:
+    """Record one dynamic-loss-scale transition (``fit.FitLoop`` calls on
+    every backoff and regrowth). Recorded with the plane off too — the
+    timeline is how a mixed-precision run is graded, and it costs one
+    list append."""
+    ev = {"step": int(step), "old": float(old), "new": float(new),
+          "trigger": str(trigger)}
+    with _PLANE._lock:
+        _PLANE.loss_scale_events.append(ev)
+    try:
+        _loss_scale_gauge().set(float(new))
+    except Exception:
+        pass
+    try:
+        from .tracer import tracer as _tr
+        # counter gated on the PLANE, not just the tracer: a plane-off
+        # trace must stay byte-identical to pre-plane output (the
+        # trace_report omission contract); the timeline/gauge above are
+        # the plane-off surfaces
+        if _tr.enabled and spec() is not None:
+            _tr.counter_event("loss_scale", float(new),
+                              category="numerics")
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Non-finite provenance
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=64)
+def _bucket_count_fn(layout: Tuple[int, ...]):
+    """Stage 1: per-BUCKET non-finite element totals over a flat grad
+    list chunked by ``layout``, in ONE dispatch."""
+    import jax
+    import jax.numpy as jnp
+
+    def fn(*gs):
+        out, off = [], 0
+        for n in layout:
+            tot = jnp.zeros((), jnp.int32)
+            for g in gs[off:off + n]:
+                tot = tot + jnp.sum(~jnp.isfinite(g)).astype(jnp.int32)
+            out.append(tot)
+            off += n
+        return jnp.stack(out)
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=64)
+def _param_count_fn(n: int):
+    """Stage 2: per-PARAMETER non-finite counts inside one guilty
+    bucket."""
+    import jax
+    import jax.numpy as jnp
+
+    def fn(*gs):
+        return jnp.stack([jnp.sum(~jnp.isfinite(g)).astype(jnp.int32)
+                          for g in gs])
+    return jax.jit(fn)
+
+
+def _provenance_scan(named_grads) -> Tuple[Optional[dict], List[dict],
+                                           List[int]]:
+    """The bisect: per-bucket counts → per-param counts inside each
+    guilty bucket. Returns (culprit, offenders, bucket_counts) where the
+    culprit is the first (parameter-order) offender."""
+    import jax
+    if not named_grads:
+        return None, [], []
+    buckets = [named_grads[i:i + PROV_BUCKET]
+               for i in range(0, len(named_grads), PROV_BUCKET)]
+    layout = tuple(len(b) for b in buckets)
+    flat = [g for b in buckets for (_i, _n, g) in b]
+    bcounts = [int(c) for c in jax.device_get(
+        _bucket_count_fn(layout)(*flat))]
+    offenders: List[dict] = []
+    for b, bucket in enumerate(buckets):
+        if bcounts[b] == 0:
+            continue
+        pcounts = jax.device_get(
+            _param_count_fn(len(bucket))(*[g for _i, _n, g in bucket]))
+        for (idx, name, g), c in zip(bucket, pcounts):
+            if int(c) > 0:
+                offenders.append({"index": int(idx), "name": str(name),
+                                  "nonfinite": int(c),
+                                  "size": int(g.size)})
+    offenders.sort(key=lambda o: o["index"])
+    culprit = offenders[0] if offenders else None
+    return culprit, offenders, bcounts
+
+
+def _dump_path() -> str:
+    d = str(env.get("MXTPU_MEM_DUMP_DIR") or "") or "."
+    try:
+        os.makedirs(d, exist_ok=True)
+    except OSError:
+        d = "."
+    return os.path.join(
+        d, f"numerics_{os.getpid()}_{next(_dump_seq)}.json")
+
+
+def nonfinite_step(step: int, trainer,
+                   loss_scale: Optional[float] = None) -> Optional[str]:
+    """The provenance pass for one sentinel-skipped step: localize the
+    first offending parameter (per-bucket counts → per-param bisect),
+    write the forensics record and name the culprit in an ERROR log.
+    Returns the dump path (None when the plane is off). Runs AFTER the
+    skip verdict is host-known, so its extra syncs cost nothing a clean
+    step pays. Under distributed ZeRO each rank scans its reduced shard
+    and the offender lists are merged over the byte channel, so every
+    rank names the same global culprit."""
+    s = spec()
+    if s is None:
+        return None
+    zp = getattr(trainer, "_zero", None)
+    distributed = bool(zp and getattr(zp, "distributed", False))
+    named = []
+    for i, p in enumerate(getattr(trainer, "_params", ())):
+        if getattr(p, "grad_req", "null") == "null" or p._grad is None:
+            continue
+        if distributed and i not in zp.local_indices():
+            # non-local grads are unreduced between reduce-scatter and
+            # update — only the local shard carries the global sums
+            continue
+        named.append((i, p.name, p._grad._data))
+    culprit, offenders, bcounts = _provenance_scan(named)
+    if distributed:
+        from ..parallel.collectives import cross_process_allgather_object
+        merged = cross_process_allgather_object(
+            offenders, f"numprov{next(_xchg_seq)}_")
+        offenders = sorted((o for part in merged for o in part),
+                           key=lambda o: o["index"])
+        culprit = offenders[0] if offenders else culprit
+    try:
+        from .registry import default_registry
+        default_registry().counter(
+            "mxtpu_numerics_nonfinite_steps_total",
+            "Training steps the sentinel skipped that the numerics plane "
+            "ran a provenance pass on.").inc()
+    except Exception:
+        pass
+    trace_window: List[dict] = []
+    try:
+        from .tracer import tracer as _tr
+        trace_window = _tr.events()[-200:]
+    except Exception:
+        pass
+    with _PLANE._lock:
+        _PLANE.nonfinite_steps.append(int(step))
+        if culprit is not None:
+            _PLANE.culprits.append(culprit["name"])
+        recent = [dict(r) for r in _PLANE.records]
+        ls_events = [dict(e) for e in _PLANE.loss_scale_events]
+    payload = {
+        "reason": "nonfinite_gradients",
+        "time_unix": time.time(),
+        "pid": os.getpid(),
+        "step": int(step),
+        "loss_scale": loss_scale,
+        "culprit": culprit,
+        "offending_params": [
+            dict(o, history=_PLANE.history(o["name"]))
+            for o in offenders[:20]],
+        "bucket_nonfinite_counts": bcounts,
+        "recent_records": recent,
+        "loss_scale_events": ls_events,
+        "trace_window": trace_window,
+    }
+    path = _dump_path()
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=1, default=str)
+        os.replace(tmp, path)
+        with _PLANE._lock:
+            _PLANE.dump_paths.append(path)
+    except Exception as e:
+        path = None
+        try:
+            _log().error("numerics: forensics dump failed (%s)", e)
+        except Exception:
+            pass
+    try:
+        if culprit is not None:
+            _log().error(
+                "numerics: non-finite gradients at step %d — first "
+                "offending parameter %r (%d/%d non-finite elements)%s",
+                step, culprit["name"], culprit["nonfinite"],
+                culprit["size"],
+                f" — forensics dump {path}" if path else "")
+        else:
+            _log().error(
+                "numerics: step %d skipped as non-finite but no offending "
+                "gradient found on this rank%s", step,
+                " (another rank's shard carries the poison)"
+                if distributed else "")
+    except Exception:
+        pass
+    return path
+
+
+# ---------------------------------------------------------------------------
+# Summary (FitResult.numerics)
+# ---------------------------------------------------------------------------
+
+def summary() -> Optional[dict]:
+    """The ``FitResult.numerics`` payload: recent sampled records, the
+    loss-scale timeline, non-finite provenance results. None when the
+    plane is off AND no loss-scale event fired (nothing to report)."""
+    s = spec()
+    with _PLANE._lock:
+        events = [dict(e) for e in _PLANE.loss_scale_events]
+        if s is None and not events:
+            return None
+        recent = [dict(r) for r in _PLANE.records]
+        out = {
+            "enabled": s is not None,
+            "every": s.every if s is not None else None,
+            "stats": list(s.stats) if s is not None else [],
+            "samples": _PLANE.samples,
+            "recent": recent,
+            "loss_scale_events": events,
+            "nonfinite_steps": list(_PLANE.nonfinite_steps),
+            "culprits": list(_PLANE.culprits),
+            "dumps": list(_PLANE.dump_paths),
+        }
+    if recent:
+        out["grad_norm"] = recent[-1]["grad_norm"]
+        out["update_ratio"] = recent[-1]["update_ratio"]
+    return out

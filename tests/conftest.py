@@ -62,3 +62,9 @@ def pytest_configure(config):
         "(telemetry/memory.py live-byte ledger, per-program "
         "attribution, trace memory track, OOM forensics). Tier-1-safe: "
         "CPU — the ledger is exact by construction there.")
+    config.addinivalue_line(
+        "markers", "numerics: in-graph numerics observability tests "
+        "(telemetry/numerics.py tensor-stat plane riding the grouped "
+        "bucket programs, non-finite provenance, loss-scale timeline, "
+        "Monitor facade). Tier-1-safe: CPU, in-process, bitwise "
+        "on-vs-off parity pinned.")

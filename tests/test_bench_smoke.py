@@ -92,6 +92,18 @@ def test_bench_tiny_deadline_emits_full_headline_json():
     assert crow["max_coll_skew_ms"] == 0.0
     assert crow["desync"] is None
     assert crow["collectives_per_step"] >= 2
+    # the numerics row: in-graph grad norm from a clean instrumented
+    # FitLoop, and the provenance drill firing EXACTLY once under an
+    # injected nan_grad — naming the poisoned parameter
+    nrow = payload["numerics"]
+    assert nrow["samples"] > 0
+    assert nrow["grad_norm"] > 0
+    assert nrow["update_ratio"] > 0
+    assert "sampled_overhead_pct" in nrow
+    assert nrow["provenance_dumps"] == 1
+    assert nrow["nonfinite_steps"] == [2]
+    assert nrow["culprit"]
+    assert nrow["loss_scale_events"] == 1
 
 
 def test_bench_exhausted_deadline_still_emits_parseable_row():

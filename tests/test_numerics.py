@@ -1,0 +1,623 @@
+"""In-graph numerics observability plane (telemetry/numerics.py,
+MXTPU_NUMERICS): stat correctness vs hand-computed NumPy, cadence gating,
+pattern filtering, BITWISE on-vs-off trajectory parity (grouped + ZeRO
+simulated world), non-finite provenance bisect, chaos provenance on both
+the grouped and per-param fallback paths, dispatch-count invariance,
+off-path cost, the loss-scale timeline and the Monitor facade round-trip.
+
+Tier-1-safe: tiny models, CPU, in-process, seeded everything.
+"""
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+import mxnet_tpu as mx
+from mxnet_tpu import fit, gluon, io, nd
+from mxnet_tpu import kvstore as kvs
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.contrib import chaos
+from mxnet_tpu.optimizer import grouped as grouped_mod
+from mxnet_tpu.telemetry import numerics as num
+
+pytestmark = pytest.mark.numerics
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane(monkeypatch, tmp_path):
+    monkeypatch.delenv("MXTPU_NUMERICS", raising=False)
+    # every provenance dump in this suite lands in tmp, never the cwd
+    monkeypatch.setenv("MXTPU_MEM_DUMP_DIR", str(tmp_path))
+    chaos.uninstall()
+    num.reset_run()
+    yield
+    chaos.uninstall()
+    # this fixture tears down BEFORE monkeypatch undoes the env, so a
+    # typo-grammar test's bad value must be cleared before the re-parse
+    monkeypatch.delenv("MXTPU_NUMERICS", raising=False)
+    num.reset_run()
+
+
+def _make_params(rs, n=6, dtype="float32", shapes=None, prefix="p"):
+    params = []
+    for j in range(n):
+        shape = shapes[j] if shapes else (3, j + 2)
+        p = gluon.Parameter(f"{prefix}{j}", shape=shape, dtype=dtype)
+        p.initialize(mx.init.Constant(0.0))
+        p.set_data(nd.array(rs.randn(*shape).astype(np.float32)))
+        params.append(p)
+    return params
+
+
+def _set_grads(params, rs, poison_at=None, fill=np.nan):
+    for k, p in enumerate(params):
+        g = rs.randn(*p.shape).astype(np.float32)
+        if poison_at is not None and k == poison_at:
+            g[0, 0] = fill
+        garr = nd.array(g)
+        if str(p.data().dtype) != "float32":
+            garr = garr.astype(p.data().dtype)
+        p._grad._rebind(garr._data)
+        p._fresh_grad = True
+
+
+def _fetch_record(tr, step=0, **kw):
+    """device_get the trainer's parked stats and publish one record —
+    exactly what FitLoop does on its flag+loss transfer."""
+    nstats = tr.last_numerics_stats
+    assert nstats, "no sampled stats parked on the trainer"
+    vals = jax.device_get([m for _, m in nstats])
+    return num.record_step(step, [(names, v) for (names, _), v
+                                  in zip(nstats, vals)], trainer=tr, **kw)
+
+
+# ------------------------------------------------------------- grammar
+
+def test_grammar_parses():
+    s = num._parse("on,every=4,stats=l2|update_ratio,pattern=.*weight")
+    assert s.every == 4
+    assert s.stats == ("l2", "update_ratio")
+    assert s.wants("dense0_weight") and not s.wants("dense0_bias")
+    assert s.sampled(0) and not s.sampled(3) and s.sampled(8)
+    # modifiers alone imply on (the MXTPU_PROFILE discipline)
+    assert num._parse("every=2") is not None
+    for off in ("", "off", "0", "false"):
+        assert num._parse(off) is None
+
+
+@pytest.mark.parametrize("bad", ["bogus", "on,frequency=3", "on,every=x",
+                                 "on,every=0", "on,stats=", "on,stats=foo",
+                                 "on,pattern=", "on,pattern=["])
+def test_grammar_rejects(bad):
+    with pytest.raises(MXNetError):
+        num._parse(bad)
+
+
+def test_typo_raises_at_fit_start(monkeypatch):
+    monkeypatch.setenv("MXTPU_NUMERICS", "bogus")
+    net = gluon.nn.Dense(1, in_units=2)
+    net.initialize(mx.init.One())
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1}, kvstore=None)
+    it = io.NDArrayIter(np.zeros((4, 2), np.float32),
+                        np.zeros((4, 1), np.float32), batch_size=2)
+    loop = fit.FitLoop(net, tr, lambda p, y: ((p - y) ** 2).mean(), it,
+                       ckpt_dir=None)
+    with pytest.raises(MXNetError, match="MXTPU_NUMERICS"):
+        loop.fit(epochs=1)
+
+
+# -------------------------------------------------- stat correctness
+
+def test_stats_match_hand_computed_numpy(monkeypatch):
+    """Acceptance: the in-graph stats equal hand-computed NumPy on known
+    tensors — grad L2 / absmax / mean / nonfinite and the SGD
+    update/weight ratio (delta = -lr * grad / batch for plain SGD)."""
+    monkeypatch.setenv("MXTPU_NUMERICS", "on")
+    monkeypatch.setenv("MXTPU_OPTIMIZER_AGGREGATION", "4")
+    rs = np.random.RandomState(7)
+    params = _make_params(rs, n=3)
+    w0 = {p.name: p.data().asnumpy().copy() for p in params}
+    tr = gluon.Trainer(params, "sgd", {"learning_rate": 0.1},
+                       kvstore=None)
+    grads = {}
+    for p in params:
+        g = rs.randn(*p.shape).astype(np.float32)
+        grads[p.name] = g
+        p._grad._rebind(nd.array(g)._data)
+        p._fresh_grad = True
+    flag = tr.update_with_sentinel(4)
+    assert bool(jax.device_get(flag))
+    rec = _fetch_record(tr, step=0)
+    assert rec["finite"] and rec["nonfinite_params"] == 0
+    exp_g2 = 0.0
+    for name, g in grads.items():
+        d = rec["per_param"][name]
+        assert d["l2"] == pytest.approx(float(np.linalg.norm(
+            g.astype(np.float64))), rel=1e-5)
+        assert d["absmax"] == pytest.approx(float(np.abs(g).max()),
+                                            rel=1e-6)
+        assert d["mean"] == pytest.approx(float(g.mean()), abs=1e-6)
+        assert d["nonfinite"] == 0
+        # plain SGD, wd=0: delta = -lr * g / batch
+        delta = 0.1 * g / 4.0
+        exp_ratio = float(np.linalg.norm(delta) /
+                          np.linalg.norm(w0[name]))
+        assert d["update_ratio"] == pytest.approx(exp_ratio, rel=1e-4)
+        exp_g2 += float((g.astype(np.float64) ** 2).sum())
+    assert rec["grad_norm"] == pytest.approx(math.sqrt(exp_g2), rel=1e-5)
+
+
+def test_nonfinite_counts_in_stats(monkeypatch):
+    monkeypatch.setenv("MXTPU_NUMERICS", "on")
+    monkeypatch.setenv("MXTPU_OPTIMIZER_AGGREGATION", "4")
+    rs = np.random.RandomState(0)
+    params = _make_params(rs, n=3)
+    tr = gluon.Trainer(params, "sgd", {"learning_rate": 0.1},
+                       kvstore=None)
+    _set_grads(params, rs, poison_at=1)
+    flag = tr.update_with_sentinel(4)
+    assert not bool(jax.device_get(flag))
+    rec = _fetch_record(tr, step=0, finite=False)
+    assert rec["nonfinite_params"] == 1
+    assert rec["per_param"][params[1].name]["nonfinite"] == 1
+    assert rec["per_param"][params[0].name]["nonfinite"] == 0
+    tr.rollback_step()
+
+
+# ------------------------------------------- cadence + pattern gating
+
+def _fitloop(monkeypatch, steps=6, loss_scale=1.0, opt="adam",
+             scale_growth=200, kvstore=None):
+    mx.random.seed(0)
+    np.random.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8, activation="relu"), gluon.nn.Dense(1))
+    net.initialize(mx.init.Xavier())
+    with mx.autograd.pause():
+        net(nd.ones((1, 4)))
+    tr = gluon.Trainer(net.collect_params(), opt,
+                       {"learning_rate": 0.01}, kvstore=kvstore)
+    rs = np.random.RandomState(42)
+    X = rs.randn(steps * 8, 4).astype(np.float32)
+    Y = rs.randn(steps * 8, 1).astype(np.float32)
+    it = io.NDArrayIter(X, Y, batch_size=8)
+    loop = fit.FitLoop(net, tr, lambda p, y: ((p - y) ** 2).mean(), it,
+                       ckpt_dir=None, loss_scale=loss_scale,
+                       scale_growth_interval=scale_growth)
+    return net, tr, loop
+
+
+def test_cadence_gating(monkeypatch):
+    monkeypatch.setenv("MXTPU_NUMERICS", "on,every=2")
+    _, _, loop = _fitloop(monkeypatch, steps=6)
+    res = loop.fit(epochs=1)
+    assert res.step == 6
+    sampled = [r["step"] for r in res.numerics["recent"]]
+    assert sampled == [0, 2, 4]
+    assert res.numerics["samples"] == 3
+
+
+def test_pattern_filtering(monkeypatch):
+    monkeypatch.setenv("MXTPU_NUMERICS", "on,pattern=.*weight")
+    _, _, loop = _fitloop(monkeypatch, steps=2)
+    res = loop.fit(epochs=1)
+    names = set()
+    for r in res.numerics["recent"]:
+        names |= set(r["per_param"])
+    assert names and all(n.endswith("weight") for n in names)
+    # global norms still cover EVERY live grad, not just the filtered set
+    assert res.numerics["grad_norm"] > 0
+
+
+def test_stats_subset(monkeypatch):
+    monkeypatch.setenv("MXTPU_NUMERICS", "on,stats=l2|nonfinite")
+    _, _, loop = _fitloop(monkeypatch, steps=1)
+    res = loop.fit(epochs=1)
+    d = next(iter(res.numerics["recent"][0]["per_param"].values()))
+    assert set(d) == {"l2", "nonfinite"}
+
+
+# ------------------------------------------------------ bitwise parity
+
+OPTS = [
+    ("sgd", {"learning_rate": 0.1, "wd": 0.01}),
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9, "wd": 0.01}),
+    ("nag", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("adam", {"learning_rate": 0.01, "wd": 0.001}),
+    ("rmsprop", {"learning_rate": 0.01}),
+    ("rmsprop", {"learning_rate": 0.01, "centered": True}),
+]
+
+
+def _run_steps(opt, kw, numerics_on, monkeypatch, steps=3, world=0,
+               seed=0):
+    if numerics_on:
+        monkeypatch.setenv("MXTPU_NUMERICS", "on")
+    else:
+        monkeypatch.delenv("MXTPU_NUMERICS", raising=False)
+    if world:
+        monkeypatch.setenv("MXTPU_ZERO", "1")
+        monkeypatch.setenv("MXTPU_ZERO_WORLD", str(world))
+    else:
+        monkeypatch.delenv("MXTPU_ZERO", raising=False)
+        monkeypatch.delenv("MXTPU_ZERO_WORLD", raising=False)
+    monkeypatch.setenv("MXTPU_OPTIMIZER_AGGREGATION", "4")
+    num.reset_run()
+    rs = np.random.RandomState(seed)
+    params = _make_params(rs, n=6)
+    tr = gluon.Trainer(params, opt, dict(kw),
+                       kvstore=kvs.create("device") if world else None)
+    for _ in range(steps):
+        _set_grads(params, rs)
+        tr.step(4)
+    return params, tr
+
+
+@pytest.mark.parametrize("opt,kw", OPTS,
+                         ids=[f"{o}-{'-'.join(k)}" for o, k in
+                              [(o, list(kw)) for o, kw in OPTS]])
+def test_bitwise_parity_grouped(opt, kw, monkeypatch):
+    """Tentpole acceptance: the plane is numerically inert — 3 steps with
+    stats emitted are BITWISE the 3 steps without, for all 6 grouped
+    optimizer configs (weights and optimizer state)."""
+    ref, tr_ref = _run_steps(opt, kw, False, monkeypatch)
+    got, tr_got = _run_steps(opt, kw, True, monkeypatch)
+    assert tr_got.last_numerics_stats, "plane never sampled"
+    for pr, pg in zip(ref, got):
+        np.testing.assert_array_equal(pr.data().asnumpy(),
+                                      pg.data().asnumpy())
+    for i in tr_ref._updaters[0].states:
+        fr = grouped_mod._flatten_inner(tr_ref._updaters[0].states[i])
+        fg = grouped_mod._flatten_inner(tr_got._updaters[0].states[i])
+        for a, b in zip(fr, fg):
+            np.testing.assert_array_equal(a.asnumpy(), b.asnumpy())
+
+
+def test_bitwise_parity_zero_world(monkeypatch):
+    """Same inertness under the ZeRO-1 simulated N-rank protocol: the
+    sharded update with stats emitted bitwise-matches without."""
+    ref, _ = _run_steps("adam", {"learning_rate": 0.01}, False,
+                        monkeypatch, world=2)
+    got, tr = _run_steps("adam", {"learning_rate": 0.01}, True,
+                         monkeypatch, world=2)
+    assert tr.last_numerics_stats, "plane never sampled under ZeRO"
+    names = {n for bucket, _ in tr.last_numerics_stats for n in bucket}
+    assert names == {p.name for p in got}, \
+        "simulated-world stats must cover the full parameter set"
+    for pr, pg in zip(ref, got):
+        np.testing.assert_array_equal(pr.data().asnumpy(),
+                                      pg.data().asnumpy())
+
+
+def test_fitloop_trajectory_parity_fused_and_classic(monkeypatch):
+    """End-to-end FitLoop parity incl. a chaos-skipped step, on the fused
+    sentinel path AND the classic fallback (aggregation off)."""
+    for agg in ("8", "0"):
+        monkeypatch.setenv("MXTPU_OPTIMIZER_AGGREGATION", agg)
+        losses = {}
+        for on in (False, True):
+            if on:
+                monkeypatch.setenv("MXTPU_NUMERICS", "on")
+            else:
+                monkeypatch.delenv("MXTPU_NUMERICS", raising=False)
+            num.reset_run()
+            chaos.install("nan_grad@1")
+            net, _, loop = _fitloop(monkeypatch, steps=4,
+                                    loss_scale=128.0, opt="sgd")
+            res = loop.fit(epochs=1)
+            chaos.uninstall()
+            assert res.skipped_steps == [1]
+            losses[on] = (res.losses,
+                          net[0].weight.data().asnumpy().copy())
+        assert losses[False][0] == losses[True][0]
+        np.testing.assert_array_equal(losses[False][1], losses[True][1])
+
+
+# ------------------------------------------------- dispatch invariance
+
+def test_sampled_step_adds_no_dispatches(monkeypatch):
+    """Acceptance: stat computation rides the SAME bucket programs —
+    launch counts unchanged vs plane-off, and a warm sampled step is
+    all cache hits (the stats variant compiles once)."""
+    monkeypatch.setenv("MXTPU_OPTIMIZER_AGGREGATION", "4")
+    rs = np.random.RandomState(0)
+    params = _make_params(rs, n=6)
+    tr = gluon.Trainer(params, "adam", {"learning_rate": 0.01},
+                       kvstore=None)
+    _set_grads(params, rs)
+    tr.update_with_sentinel(4)
+    off_disp = tr.last_update_dispatches
+    monkeypatch.setenv("MXTPU_NUMERICS", "on")
+    _set_grads(params, rs)
+    tr.update_with_sentinel(4)   # first sampled step: compiles variants
+    assert tr.last_update_dispatches == off_disp
+    assert tr.last_numerics_stats
+    before = grouped_mod.cache_info()
+    _set_grads(params, rs)
+    tr.update_with_sentinel(4)   # warm sampled step: zero misses
+    after = grouped_mod.cache_info()
+    assert tr.last_update_dispatches == off_disp
+    assert after.misses == before.misses, \
+        "warm sampled step must not compile"
+
+
+def test_classic_no_sentinel_still_samples(monkeypatch):
+    """An armed plane must not silently measure nothing on ANY path:
+    skip_nonfinite=False with aggregation off (pure per-param classic
+    updates) still records sampled grad stats — update_ratio is honestly
+    absent (None, never a fabricated 0), since the fallback runs outside
+    the update."""
+    monkeypatch.setenv("MXTPU_NUMERICS", "on")
+    monkeypatch.setenv("MXTPU_OPTIMIZER_AGGREGATION", "0")
+    mx.random.seed(0)
+    np.random.seed(0)
+    net = gluon.nn.Dense(2, in_units=3)
+    net.initialize(mx.init.Constant(0.5))
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05}, kvstore=None)
+    rs = np.random.RandomState(0)
+    it = io.NDArrayIter(rs.rand(16, 3).astype(np.float32),
+                        rs.rand(16, 2).astype(np.float32), batch_size=4)
+    loop = fit.FitLoop(net, tr, lambda p, y: ((p - y) ** 2).mean(), it,
+                       ckpt_dir=None, skip_nonfinite=False)
+    res = loop.fit(epochs=1)
+    assert res.numerics["samples"] == 4
+    rec = res.numerics["recent"][-1]
+    assert rec["grad_norm"] > 0
+    assert rec["update_ratio"] is None
+    assert "update_ratio" not in next(iter(rec["per_param"].values()))
+
+
+def test_mixed_ineligible_set_leaves_sample_for_fallback(monkeypatch):
+    """A mixed dense/row-sparse parameter set must NOT publish a
+    dense-only "global" grad norm: the grouped collector declines (the
+    sample stays unconsumed) so the caller's fallback covers EVERY
+    parameter instead."""
+    monkeypatch.setenv("MXTPU_NUMERICS", "on")
+    monkeypatch.setenv("MXTPU_OPTIMIZER_AGGREGATION", "4")
+    rs = np.random.RandomState(0)
+    dense = _make_params(rs, n=3)
+    emb = gluon.Parameter("emb", shape=(10, 3), grad_stype="row_sparse")
+    emb.initialize(mx.init.Constant(0.0))
+    emb.set_data(nd.array(rs.randn(10, 3).astype(np.float32)))
+    params = dense + [emb]
+    tr = gluon.Trainer(params, "sgd", {"learning_rate": 0.1},
+                       kvstore=None)
+    _set_grads(dense, rs)
+    rows = np.array([1, 4], dtype=np.int32)
+    vals = rs.randn(2, 3).astype(np.float32)
+    emb._grad._update(nd.array(vals)._data, nd.array(rows)._data)
+    emb._fresh_grad = True
+    tr.update(2)
+    assert tr.last_numerics_stats is None, \
+        "partial-coverage stats must not be published as global"
+    out = num.fallback_collect(tr)
+    assert out is not None, "the step's sample must survive the decline"
+    assert set(out[0][0]) == {p.name for p in params}
+
+
+def test_off_path_is_inert(monkeypatch):
+    """Plane off: collect_spec is one cached flag check — no stats, no
+    sampling clock movement, no new compiled programs."""
+    monkeypatch.delenv("MXTPU_NUMERICS", raising=False)
+    assert num.collect_spec() is None
+    assert num.plane().last_step is None, "off path must not tick"
+    monkeypatch.setenv("MXTPU_OPTIMIZER_AGGREGATION", "4")
+    rs = np.random.RandomState(0)
+    params = _make_params(rs, n=4)
+    tr = gluon.Trainer(params, "sgd", {"learning_rate": 0.1},
+                       kvstore=None)
+    _set_grads(params, rs)
+    tr.step(4)
+    assert tr.last_numerics_stats is None
+    assert num.summary() is None, \
+        "off + no loss-scale events -> nothing to report"
+
+
+# ---------------------------------------------------------- provenance
+
+def test_provenance_bisect_names_exact_param(monkeypatch, tmp_path):
+    """The two-stage bisect: per-bucket counts locate the guilty bucket
+    (past the first), the per-param pass names the exact offender."""
+    monkeypatch.setenv("MXTPU_NUMERICS", "on")
+    monkeypatch.setenv("MXTPU_MEM_DUMP_DIR", str(tmp_path))
+    rs = np.random.RandomState(0)
+    n = num.PROV_BUCKET + 4            # offender beyond bucket 0
+    params = _make_params(rs, n=n, shapes=[(2, 3)] * n)
+    tr = gluon.Trainer(params, "sgd", {"learning_rate": 0.1},
+                       kvstore=None)
+    k = num.PROV_BUCKET + 1
+    _set_grads(params, rs, poison_at=k, fill=np.inf)
+    path = num.nonfinite_step(3, tr)
+    assert path and os.path.isfile(path)
+    with open(path) as f:
+        dump = json.load(f)
+    assert dump["reason"] == "nonfinite_gradients"
+    assert dump["step"] == 3
+    assert dump["culprit"]["name"] == params[k].name
+    assert dump["culprit"]["nonfinite"] == 1
+    assert dump["bucket_nonfinite_counts"][0] == 0
+    assert dump["bucket_nonfinite_counts"][1] == 1
+    assert num.plane().culprits == [params[k].name]
+
+
+@pytest.mark.parametrize("kind", ["nan_grad", "inf_grad"])
+@pytest.mark.parametrize("agg", ["8", "0"])
+def test_chaos_provenance_names_poisoned_param(monkeypatch, tmp_path,
+                                               caplog, kind, agg):
+    """Chaos provenance proof, grouped AND per-param fallback paths: an
+    armed nan_grad/inf_grad run names the exact poisoned parameter in
+    the forensics dump and the ERROR log, exactly once."""
+    import logging
+    monkeypatch.setenv("MXTPU_NUMERICS", "on")
+    monkeypatch.setenv("MXTPU_MEM_DUMP_DIR", str(tmp_path))
+    monkeypatch.setenv("MXTPU_OPTIMIZER_AGGREGATION", agg)
+    chaos.install(f"{kind}@1")
+    net, tr, loop = _fitloop(monkeypatch, steps=4, loss_scale=64.0)
+    with caplog.at_level(logging.ERROR, logger="mxnet_tpu.telemetry"):
+        res = loop.fit(epochs=1)
+    chaos.uninstall()
+    assert res.skipped_steps == [1]
+    # chaos poisons the FIRST trainable parameter's gradient
+    poisoned = tr._params[0].name
+    assert res.numerics["nonfinite_steps"] == [1]
+    assert res.numerics["culprits"] == [poisoned]
+    assert len(res.numerics["dumps"]) == 1
+    with open(res.numerics["dumps"][0]) as f:
+        dump = json.load(f)
+    assert dump["culprit"]["name"] == poisoned
+    assert dump["loss_scale_events"] == []  # dump precedes the backoff
+    errors = [r.message for r in caplog.records
+              if r.levelname == "ERROR"]
+    assert any(poisoned in m and "non-finite" in m for m in errors)
+
+
+def test_clean_armed_run_fires_nothing(monkeypatch, tmp_path):
+    monkeypatch.setenv("MXTPU_NUMERICS", "on")
+    monkeypatch.setenv("MXTPU_MEM_DUMP_DIR", str(tmp_path))
+    _, _, loop = _fitloop(monkeypatch, steps=4)
+    res = loop.fit(epochs=1)
+    assert not res.skipped_steps
+    assert res.numerics["nonfinite_steps"] == []
+    assert res.numerics["dumps"] == []
+    assert res.numerics["samples"] == 4
+
+
+# -------------------------------------------------- loss-scale timeline
+
+def test_loss_scale_timeline(monkeypatch):
+    """Every backoff/regrowth lands in the timeline with old->new and
+    trigger — with the plane OFF too (the previously-unobservable
+    trajectory is the satellite's whole point)."""
+    monkeypatch.delenv("MXTPU_NUMERICS", raising=False)
+    chaos.install("nan_grad@1")
+    _, _, loop = _fitloop(monkeypatch, steps=6, loss_scale=128.0,
+                          scale_growth=2)
+    res = loop.fit(epochs=1)
+    chaos.uninstall()
+    evs = res.numerics["loss_scale_events"]
+    assert evs[0] == {"step": 1, "old": 128.0, "new": 64.0,
+                      "trigger": "backoff"}
+    growth = [e for e in evs if e["trigger"] == "growth"]
+    assert growth and growth[0]["old"] == 64.0 \
+        and growth[0]["new"] == 128.0
+    assert res.loss_scale == res.numerics["loss_scale_events"][-1]["new"]
+    from mxnet_tpu.telemetry import default_registry
+    g = default_registry().get("mxtpu_loss_scale")
+    assert g is not None and g.value == res.loss_scale
+
+
+# ------------------------------------------------------ monitor facade
+
+def test_monitor_facade_roundtrip(monkeypatch):
+    """Legacy Monitor API fed from the plane: tic/toc round-trips the
+    sampled per-param stats, pattern- and interval-gated."""
+    from mxnet_tpu.monitor import Monitor
+    monkeypatch.setenv("MXTPU_NUMERICS", "on")
+    monkeypatch.setenv("MXTPU_OPTIMIZER_AGGREGATION", "4")
+    mon = Monitor(interval=1, pattern=".*p1").install_numerics()
+    rs = np.random.RandomState(0)
+    params = _make_params(rs, n=3)
+    tr = gluon.Trainer(params, "sgd", {"learning_rate": 0.1},
+                       kvstore=None)
+    mon.tic()
+    _set_grads(params, rs)
+    tr.update_with_sentinel(4)
+    _fetch_record(tr, step=0)
+    rows = mon.toc()
+    assert rows, "activated monitor saw no entries"
+    names = {k for _n, k, _v in rows}
+    assert names == {f"p1:{s}" for s in
+                     ("l2", "absmax", "mean", "nonfinite",
+                      "update_ratio")}
+    # deactivated (interval miss) -> the plane feeds nothing
+    mon2 = Monitor(interval=100, pattern=".*").install_numerics()
+    mon2.step = 1
+    mon2.tic()
+    _set_grads(params, rs)
+    tr.update_with_sentinel(4)
+    _fetch_record(tr, step=1)
+    assert mon2.toc() == []
+
+
+# ------------------------------------------------- trace_report columns
+
+def test_trace_report_numerics_columns(monkeypatch, tmp_path):
+    """Round-trip vs a live dump: grad_norm/loss_scale columns in text
+    and --json, omitted cleanly when the plane is off."""
+    import subprocess
+    import sys
+    from mxnet_tpu.telemetry import chrome_trace
+    from mxnet_tpu.telemetry.tracer import tracer
+    monkeypatch.setenv("MXTPU_NUMERICS", "on")
+    tracer.enable()
+    try:
+        tracer.clear()
+        _, _, loop = _fitloop(monkeypatch, steps=3, loss_scale=8.0)
+        loop.fit(epochs=1)
+        path = str(tmp_path / "trace.json")
+        chrome_trace.dump_chrome_trace(path)
+    finally:
+        tracer.disable()
+        tracer.clear()
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "trace_report.py"),
+         path, "--json"], capture_output=True, text=True)
+    rows = json.loads(out.stdout)["steps"]
+    with_gn = [r for r in rows if "grad_norm" in r]
+    assert len(with_gn) >= 3
+    assert all(r["grad_norm"] > 0 for r in with_gn)
+    assert all(r["loss_scale"] == 8.0 for r in rows
+               if "loss_scale" in r)
+    text = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "trace_report.py"),
+         path], capture_output=True, text=True).stdout
+    assert "grad_norm" in text and "loss_scale" in text
+    # plane-off trace: columns omitted entirely — even when a loss-scale
+    # BACKOFF fires (the timeline records it, but the category-numerics
+    # counter must not grow a column on a plane-off trace)
+    monkeypatch.delenv("MXTPU_NUMERICS")
+    tracer.enable()
+    try:
+        tracer.clear()
+        chaos.install("nan_grad@1")
+        _, _, loop = _fitloop(monkeypatch, steps=2, loss_scale=64.0)
+        res_off = loop.fit(epochs=1)
+        chaos.uninstall()
+        assert res_off.numerics["loss_scale_events"], \
+            "the timeline itself must still record plane-off"
+        path2 = str(tmp_path / "trace_off.json")
+        chrome_trace.dump_chrome_trace(path2)
+    finally:
+        tracer.disable()
+        tracer.clear()
+    out2 = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "trace_report.py"),
+         path2, "--json"], capture_output=True, text=True)
+    rows2 = json.loads(out2.stdout)["steps"]
+    assert all("grad_norm" not in r and "loss_scale" not in r
+               for r in rows2)
+    text2 = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "trace_report.py"),
+         path2], capture_output=True, text=True).stdout
+    assert "grad_norm" not in text2 and "loss_scale" not in text2
+
+
+# ----------------------------------------------------- registry gauges
+
+def test_registry_gauges(monkeypatch):
+    monkeypatch.setenv("MXTPU_NUMERICS", "on")
+    _, _, loop = _fitloop(monkeypatch, steps=2)
+    res = loop.fit(epochs=1)
+    from mxnet_tpu.telemetry import default_registry
+    reg = default_registry()
+    assert reg.get("mxtpu_numerics_grad_norm").value == \
+        pytest.approx(res.numerics["grad_norm"])
+    assert reg.get("mxtpu_numerics_update_ratio").value == \
+        pytest.approx(res.numerics["update_ratio"])

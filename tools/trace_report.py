@@ -23,7 +23,12 @@ tool turns it back into the operator-facing tables without Perfetto:
   counter ("C") events the step breakdown drops at each step end —
   peak/live match ``FitResult.memory`` exactly; deltas are sample-to-
   sample, so the first sampled step (no earlier baseline in the trace)
-  reports no delta rather than a fabricated 0.
+  reports no delta rather than a fabricated 0;
+- the numerics counter track (``MXTPU_NUMERICS``): per-step ``grad_norm``
+  and ``loss_scale`` columns from the category-``numerics`` counter
+  events the plane drops at each sampled step — omitted cleanly (no
+  column, no key) when the plane was off, so plane-off traces render
+  byte-identical to before the plane existed.
 
 A MERGED multi-rank trace (``tools/fleet_trace.py`` output — events from
 more than one pid) reports per rank: the same tables, one section per
@@ -134,8 +139,20 @@ def step_table(events: List[dict]) -> List[Dict[str, Any]]:
         (float(e["ts"]), float(e.get("args", {}).get("value", 0.0)))
         for e in events
         if e.get("ph") == "C" and e.get("name") == "device_memory_peak")
+    # the numerics counter track (category 'numerics'): one grad_norm /
+    # loss_scale sample per sampled step
+    num_gn = sorted(
+        (float(e["ts"]), float(e.get("args", {}).get("value", 0.0)))
+        for e in events
+        if e.get("ph") == "C" and e.get("cat") == "numerics"
+        and e.get("name") == "grad_norm")
+    num_ls = sorted(
+        (float(e["ts"]), float(e.get("args", {}).get("value", 0.0)))
+        for e in events
+        if e.get("ph") == "C" and e.get("cat") == "numerics"
+        and e.get("name") == "loss_scale")
     rows = []
-    si = mi = pi = 0
+    si = mi = pi = gi = li = 0
     prev_live = None  # last live sample of the previous step (for delta)
     for label, t0, t1 in bounds:
         while si < len(spans) and float(spans[si]["ts"]) < t0:
@@ -175,6 +192,25 @@ def step_table(events: List[dict]) -> List[Dict[str, Any]]:
                 row["mem_delta_bytes"] = int(last - base)
             row["mem_live_bytes"] = int(last)
             prev_live = last
+        # numerics columns: the LAST sample inside the step window (the
+        # plane emits one per sampled step; an unsampled step carries no
+        # key, and a plane-off trace adds no column at all)
+        while gi < len(num_gn) and num_gn[gi][0] < t0:
+            gi += 1
+        gval = None
+        while gi < len(num_gn) and num_gn[gi][0] < t1:
+            gval = num_gn[gi][1]
+            gi += 1
+        if gval is not None:
+            row["grad_norm"] = gval
+        while li < len(num_ls) and num_ls[li][0] < t0:
+            li += 1
+        lsval = None
+        while li < len(num_ls) and num_ls[li][0] < t1:
+            lsval = num_ls[li][1]
+            li += 1
+        if lsval is not None:
+            row["loss_scale"] = lsval
         rows.append(row)
     return rows
 
@@ -214,11 +250,14 @@ def _fmt_table(rows: List[Dict[str, Any]], limit: int) -> List[str]:
     if not cats:
         return ["(no complete spans in trace)"]
     has_mem = any("mem_peak_bytes" in r for r in rows)
+    has_num = any("grad_norm" in r or "loss_scale" in r for r in rows)
     shown = rows[-limit:] if limit else rows
     head = f"{'step':>6} {'wall_ms':>9}" + "".join(
         f" {c[:14]:>14}" for c in cats)
     if has_mem:
         head += f" {'mem_peak_MB':>12} {'mem_Δ_MB':>10}"
+    if has_num:
+        head += f" {'grad_norm':>11} {'loss_scale':>10}"
     lines = [head, "-" * len(head)]
     for r in shown:
         wall = r["wall_us"]
@@ -238,6 +277,11 @@ def _fmt_table(rows: List[Dict[str, Any]], limit: int) -> List[str]:
                     line += f" {'-':>10}"
             else:
                 line += f" {'-':>12} {'-':>10}"
+        if has_num:
+            line += (f" {r['grad_norm']:>11.4g}"
+                     if "grad_norm" in r else f" {'-':>11}")
+            line += (f" {r['loss_scale']:>10.4g}"
+                     if "loss_scale" in r else f" {'-':>10}")
         lines.append(line)
     if len(shown) < len(rows):
         lines.append(f"... ({len(rows) - len(shown)} earlier steps "
