@@ -85,6 +85,13 @@ class EnvRegistry:
         """
         return os.environ.get(name)
 
+    def default_for(self, name: str) -> Any:
+        """The DECLARED default of a registered variable (None when the
+        name is undeclared) — lets consumers tell 'set to the default'
+        from 'overridden' (the run-report fingerprint)."""
+        decl = self._defaults.get(name)
+        return decl[1] if decl is not None else None
+
     def items(self):
         for name, (typ, default, doc) in sorted(self._defaults.items()):
             yield name, typ, self.get(name), doc
@@ -356,6 +363,36 @@ env.declare("MXTPU_NUMERICS", str, "",
             "filters which parameters get per-param records (no commas "
             "in the regex). Empty/off (default) = one cached flag check "
             "per step; unknown tokens raise.")
+env.declare("MXTPU_EFFICIENCY", str, "",
+            "Efficiency/goodput plane (telemetry/efficiency.py): 'on' "
+            "makes fit.FitLoop sum the XLA cost-model FLOPs/bytes of "
+            "the compiled programs dispatched each step (warm CachedOp "
+            "forward + backward, grouped optimizer buckets, the fused "
+            "finiteness reduction; costs re-lowered once per signature "
+            "under the trace write-lock, cached) and divide by the "
+            "measured step wall and the MXTPU_DEVICE_PEAK table into "
+            "live MFU, achieved FLOP/s / bytes/s, roofline position "
+            "and samples/s (+ tokens/s via FitLoop's tokens_per_sample "
+            "knob). Surfaces: FitResult.efficiency, mxtpu_mfu / "
+            "mxtpu_goodput_samples gauges, Perfetto counters (category "
+            "'efficiency'), the trace_report mfu column. Numerically "
+            "inert (bitwise on-vs-off parity); off (default) costs one "
+            "cached env check per hook. Unknown tokens raise.")
+env.declare("MXTPU_DEVICE_PEAK", str, "",
+            "Device peak table for the efficiency plane: "
+            "'flops=<FLOP/s>,bw=<bytes/s>' (e.g. flops=73e12,bw=9e11). "
+            "Strict parse — typos/partial tables raise at fit() start. "
+            "Empty = per-backend defaults, with every result marked "
+            "'estimate' on CPU (no meaningful host peak exists).")
+env.declare("MXTPU_RUN_REPORT_DIR", str, "",
+            "Directory fit.FitLoop writes one persistent run report "
+            "into at fit end (run_<pid>_<ts>.json, tmp+rename, shared "
+            "SHA-256 manifest via fault.write_manifest): config/env "
+            "fingerprint, step-time distribution, loss-trajectory "
+            "digest and every measurement-plane axis summary. "
+            "tools/run_compare.py diffs two reports into per-metric "
+            "regression verdicts (CI exit codes). Empty (default) = "
+            "no report.")
 env.declare("MXTPU_PROFILE_BOUND_FRAC", float, 0.4,
             "Step-breakdown detector threshold: any non-compute segment "
             "(data_wait/h2d/comm/optimizer/checkpoint) whose share of "

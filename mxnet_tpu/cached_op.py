@@ -195,9 +195,24 @@ def _jax():
     return jax
 
 
+_EFFICIENCY_MOD = None
+
+
+def _eff():
+    """Lazy module accessor for the efficiency plane (one global check
+    per call after the first import — the off path stays one cached env
+    check inside ``efficiency.enabled``)."""
+    global _EFFICIENCY_MOD
+    if _EFFICIENCY_MOD is None:
+        from .telemetry import efficiency
+        _EFFICIENCY_MOD = efficiency
+    return _EFFICIENCY_MOD
+
+
 class _CacheEntry:
     __slots__ = ("jitted", "mutated_idx", "out_treedef", "vjp_jitted",
-                 "n_outputs", "warm", "mem_stats", "__weakref__")
+                 "n_outputs", "warm", "mem_stats", "cost_stats",
+                 "vjp_abstract", "vjp_cost_stats", "__weakref__")
 
     def __init__(self):
         self.jitted = None
@@ -208,6 +223,15 @@ class _CacheEntry:
         # static memory_analysis of the compiled program, filled lazily
         # by CachedOp.memory_analysis()
         self.mem_stats: Optional[dict] = None
+        # cost_analysis (flops / bytes accessed) of the forward program,
+        # filled lazily by entry_cost_stats ({} = resolution failed, so
+        # the efficiency plane does not retry every step)
+        self.cost_stats: Optional[dict] = None
+        # abstract (treedef, params, key, ins, cots) signature of the
+        # backward program, captured at its first dispatch under the
+        # efficiency plane so entry_vjp_cost_stats can re-lower it
+        self.vjp_abstract: Optional[tuple] = None
+        self.vjp_cost_stats: Optional[dict] = None
         # False until the first execution (which runs the python trace)
         # has completed — concurrent callers must treat a cold entry like
         # a miss and take the exclusive trace path
@@ -267,17 +291,49 @@ class _CachedOpGrad:
     (ref: CachedOp::Backward, src/imperative/cached_op.cc:1112)."""
 
     def __init__(self, op: "CachedOp", entry: _CacheEntry, key,
-                 param_arrays, in_arrays, training: bool):
+                 param_arrays, in_arrays, training: bool,
+                 in_treedef=None):
         self.op = op
         self.entry = entry
         self.key = key
         self.param_arrays = param_arrays
         self.in_arrays = in_arrays
         self.training = training
+        # the input treedef the forward was keyed under: the backward's
+        # pure fn reads op._in_treedef at trace time, so a later
+        # re-lower (efficiency-plane cost resolution) must restore it
+        self.in_treedef = in_treedef
+
+    def _note_efficiency(self, cotangents) -> None:
+        """Efficiency-plane hook: capture the backward program's abstract
+        signature once per entry and note this launch (callers gate on
+        ``enabled()`` — plane-off steps never reach here)."""
+        entry = self.entry
+        try:
+            if entry.vjp_abstract is None and self.in_treedef is not None:
+                import jax
+
+                def sds(arrs):
+                    return tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                                 for a in arrs)
+                k = self.key
+                entry.vjp_abstract = (
+                    self.in_treedef, sds(self.param_arrays),
+                    jax.ShapeDtypeStruct(k.shape, k.dtype),
+                    sds(self.in_arrays), sds(cotangents))
+            op = self.op
+            _eff().note_dispatch(
+                ("co_bwd", id(entry)), "cached_op",
+                f"{type(op.block).__name__}:bwd",
+                lambda op=op, e=entry: op.entry_vjp_cost_stats(e))
+        except Exception:
+            pass  # observability must not take down the backward
 
     def _run_backward(self, cotangents):
         import jax
         entry = self.entry
+        if _eff().enabled():
+            self._note_efficiency(cotangents)
         if entry.vjp_jitted is None:
             from .util import mirror_wrapper
             fn = self.op._make_pure_fn(self.training, entry)
@@ -358,6 +414,46 @@ class CachedOp:
         (shape of :func:`functools.lru_cache`'s ``cache_info``)."""
         return self._cache.cache_info()
 
+    @staticmethod
+    def _entry_digest(key_sig) -> str:
+        import hashlib
+        return hashlib.md5(repr(key_sig).encode()).hexdigest()[:12]
+
+    def _lower_signature(self, key_sig, entry: _CacheEntry):
+        """Re-lower one warm entry's forward program from its recorded
+        abstract signature to a jax ``Compiled`` (AOT-loaded entries ARE
+        executables and are returned as-is; cold or stale-flag-regime
+        entries return None). Re-lowering retraces the pure fn —
+        Parameter storage is swapped to tracers for the duration — so it
+        runs under the trace write lock, the aot_export discipline. The
+        one lowering site behind :meth:`memory_analysis` AND the
+        efficiency plane's cost resolution."""
+        if not entry.warm:
+            return None
+        if not hasattr(entry.jitted, "lower"):
+            return entry.jitted  # AOT-loaded: already a Compiled stage
+        import jax
+        import numpy as np
+
+        from .ops.registry import _trace_time_flags
+        in_sig, param_sig, in_treedef, _training, flags = key_sig
+        if flags != _trace_time_flags():
+            return None  # stale entry from a different flag regime
+
+        def sds(sig):
+            return tuple(jax.ShapeDtypeStruct(tuple(shape), np.dtype(dt))
+                         for shape, dt in sig)
+
+        probe_key = jax.random.PRNGKey(0)
+        key_aval = jax.ShapeDtypeStruct(probe_key.shape, probe_key.dtype)
+        self._trace_rw.acquire_write()
+        try:
+            self._in_treedef = in_treedef
+            return entry.jitted.lower(
+                sds(param_sig), key_aval, *sds(in_sig)).compile()
+        finally:
+            self._trace_rw.release_write()
+
     def memory_analysis(self, refresh: bool = False) -> Dict[str, dict]:
         """Static per-program memory attribution, keyed by signature
         digest: each warm entry's compiled ``memory_analysis()``
@@ -368,56 +464,110 @@ class CachedOp:
         recompile) and caches the result on the entry until ``refresh``.
         Results are also recorded in the telemetry program registry
         (kind ``cached_op``) for the registry gauges and OOM forensics."""
-        import hashlib
-
-        import jax
-        import numpy as np
-
-        from .ops.registry import _trace_time_flags
         from .telemetry import memory as _memory
 
-        def sds(sig):
-            return tuple(jax.ShapeDtypeStruct(tuple(shape), np.dtype(dt))
-                         for shape, dt in sig)
-
-        probe_key = jax.random.PRNGKey(0)
-        key_aval = jax.ShapeDtypeStruct(probe_key.shape, probe_key.dtype)
         label_base = type(self.block).__name__
         out: Dict[str, dict] = {}
         for key_sig, entry in self._cache.snapshot_items():
             if not entry.warm:
                 continue
-            digest = hashlib.md5(repr(key_sig).encode()).hexdigest()[:12]
+            digest = self._entry_digest(key_sig)
             if entry.mem_stats is not None and not refresh:
                 out[digest] = entry.mem_stats
                 continue
-            stats = None
-            if hasattr(entry.jitted, "lower"):
-                in_sig, param_sig, in_treedef, _training, flags = key_sig
-                if flags != _trace_time_flags():
-                    continue  # stale entry from a different flag regime
-                # re-lowering retraces the pure fn (Parameter storage is
-                # swapped to tracers for the duration): same exclusivity
-                # as a cold trace, same discipline as aot_export
-                self._trace_rw.acquire_write()
-                try:
-                    self._in_treedef = in_treedef
-                    compiled = entry.jitted.lower(
-                        sds(param_sig), key_aval, *sds(in_sig)).compile()
-                finally:
-                    self._trace_rw.release_write()
-                stats = _memory.compiled_memory_stats(compiled)
-            else:
-                # AOT-loaded executable: already a Compiled stage
-                stats = _memory.compiled_memory_stats(entry.jitted)
+            compiled = self._lower_signature(key_sig, entry)
+            if compiled is None:
+                continue
+            stats = _memory.compiled_memory_stats(compiled)
             if stats is None:
                 continue
             stats = dict(stats, signature=digest)
             entry.mem_stats = stats
-            _memory.record_program("cached_op",
-                                   f"{label_base}:{digest}", stats)
+            self._record_program(f"{label_base}:{digest}", stats)
             out[digest] = stats
         return out
+
+    @staticmethod
+    def _record_program(label: str, stats: dict) -> None:
+        """Merge one program's stats into the telemetry registry record
+        (memory and cost halves may resolve at different times on
+        different threads — the merge is atomic under the registry
+        lock, so neither clobbers the other's fields)."""
+        from .telemetry import memory as _memory
+        _memory.merge_program("cached_op", label, stats)
+
+    def entry_cost_stats(self, key_sig, entry: _CacheEntry
+                         ) -> Optional[dict]:
+        """Cost-model stats (flops / bytes accessed) of one warm entry's
+        forward program — the efficiency plane's resolver. Re-lowers
+        once under the trace write lock (the :meth:`memory_analysis`
+        discipline), caches on the entry (a failed resolution caches an
+        empty dict so the plane never retries every step), and records
+        the combined cost+memory stats in the program registry."""
+        cached = entry.cost_stats
+        if cached is not None:
+            return cached or None
+        from .telemetry.efficiency import (COST_FIELDS, MEMORY_FIELDS,
+                                           compiled_program_stats)
+        try:
+            stats = compiled_program_stats(
+                self._lower_signature(key_sig, entry))
+        except Exception:
+            stats = None
+        if not stats or "flops" not in stats:
+            entry.cost_stats = {}
+            return None
+        digest = self._entry_digest(key_sig)
+        cost = {k: stats[k] for k in COST_FIELDS if k in stats}
+        entry.cost_stats = cost
+        if entry.mem_stats is None and "argument_bytes" in stats:
+            entry.mem_stats = dict(
+                {k: stats[k] for k in MEMORY_FIELDS}, signature=digest)
+        self._record_program(f"{type(self.block).__name__}:{digest}",
+                             dict(stats, signature=digest))
+        return cost
+
+    def entry_vjp_cost_stats(self, entry: _CacheEntry) -> Optional[dict]:
+        """Cost-model stats of one entry's backward (vjp) program, from
+        the abstract signature captured at its first dispatch. Same
+        re-lower/cache discipline as :meth:`entry_cost_stats`."""
+        cached = entry.vjp_cost_stats
+        if cached is not None:
+            return cached or None
+        ab = entry.vjp_abstract
+        if ab is None or entry.vjp_jitted is None or \
+                not hasattr(entry.vjp_jitted, "lower"):
+            return None
+        from .telemetry.efficiency import (COST_FIELDS,
+                                           compiled_program_stats)
+        in_treedef, params_sds, key_sds, ins_sds, cots_sds = ab
+        try:
+            # the vjp trace replays the pure fn (Parameter storage
+            # swapped to tracers) and reads _in_treedef: write lock +
+            # treedef restore, exactly like the forward re-lower
+            self._trace_rw.acquire_write()
+            try:
+                self._in_treedef = in_treedef
+                compiled = entry.vjp_jitted.lower(
+                    params_sds, key_sds, ins_sds, cots_sds).compile()
+            finally:
+                self._trace_rw.release_write()
+            stats = compiled_program_stats(compiled)
+        except Exception:
+            stats = None
+        if not stats or "flops" not in stats:
+            entry.vjp_cost_stats = {}
+            return None
+        cost = {k: stats[k] for k in COST_FIELDS if k in stats}
+        entry.vjp_cost_stats = cost
+        import hashlib
+        digest = hashlib.md5(
+            repr((params_sds, ins_sds, cots_sds)).encode()
+        ).hexdigest()[:12]
+        self._record_program(
+            f"{type(self.block).__name__}:bwd:{digest}",
+            dict(stats, signature=digest))
+        return cost
 
     # -- AOT executable slot -------------------------------------------
     # A new replica of an already-published model should reach first byte
@@ -699,12 +849,24 @@ class CachedOp:
             finally:
                 self._trace_rw.release_write()
 
+        # efficiency plane (MXTPU_EFFICIENCY): one launch of this warm
+        # program into the current step window — a list append; the cost
+        # itself resolves lazily (entry_cost_stats) at step end. One
+        # cached env check when the plane is off.
+        if _eff().enabled():
+            _eff().note_dispatch(
+                ("co_fwd", id(entry)), "cached_op",
+                f"{type(self.block).__name__}:fwd",
+                lambda op=self, k=key_sig, e=entry:
+                op.entry_cost_stats(k, e))
+
         ctx = flat_in[0]._ctx if flat_in else params[0]._data._ctx
         out_nds = [NDArray(a, ctx=ctx) for a in out_arrays]
 
         if autograd.is_recording():
             grad_fn = _CachedOpGrad(self, entry, rng_key, param_arrays,
-                                    in_arrays, training)
+                                    in_arrays, training,
+                                    in_treedef=in_treedef)
             nd_inputs = [p._data for p in params] + list(flat_in)
             autograd._record_custom(grad_fn, nd_inputs, tuple(out_nds))
 

@@ -39,8 +39,10 @@ from . import fault
 from .contrib import chaos as _chaos
 from .telemetry import autotune as _autotune
 from .telemetry import collective as _collective
+from .telemetry import efficiency as _efficiency
 from .telemetry import memory as _memory
 from .telemetry import numerics as _numerics
+from .telemetry import run_report as _run_report
 from .telemetry.step_breakdown import StepBreakdown, segment as _segment
 
 __all__ = ["FitLoop", "FitResult", "resumable_exit_code"]
@@ -75,6 +77,11 @@ class FitResult:
     numerics: Optional[dict] = None  # tensor-stat window + loss-scale
     # timeline + non-finite provenance (MXTPU_NUMERICS; the loss-scale
     # timeline is recorded even with the plane off)
+    efficiency: Optional[dict] = None  # MFU/goodput rollup: attributed
+    # program FLOPs/bytes vs wall and the device peak table
+    # (MXTPU_EFFICIENCY / MXTPU_DEVICE_PEAK)
+    run_report: Optional[str] = None  # path of the persistent run
+    # report written at fit end (MXTPU_RUN_REPORT_DIR; None = off)
 
 
 class FitLoop:
@@ -105,7 +112,8 @@ class FitLoop:
                  max_loss_scale: float = 2.0 ** 16,
                  skip_nonfinite: bool = True, seed: Optional[int] = None,
                  ignore_stale_grad: bool = False,
-                 collect_breakdown: bool = True):
+                 collect_breakdown: bool = True,
+                 tokens_per_sample: Optional[float] = None):
         check(ckpt_every >= 1, "ckpt_every must be >= 1")
         self._net = net
         self._trainer = trainer
@@ -131,6 +139,10 @@ class FitLoop:
         # lands in FitResult.step_breakdown. A dozen clock reads per step
         # — leave on unless the step loop is sub-millisecond.
         self._collect_breakdown = collect_breakdown
+        # tokens per training sample (sequence length x packing), for
+        # the efficiency plane's tokens/s goodput — the number a
+        # transformer recipe is graded on. None = samples/s only.
+        self._tokens_per_sample = tokens_per_sample
         self._preempted: Optional[int] = None  # signum once trapped
         self._old_handlers = {}
 
@@ -260,6 +272,11 @@ class FitLoop:
         # timeline / provenance dumps re-arm per fit like the planes
         # above
         _numerics.reset_run()
+        # efficiency plane (MXTPU_EFFICIENCY): per-run rollup re-arm —
+        # and the strict-parse checkpoint for the plane spec AND the
+        # MXTPU_DEVICE_PEAK table (a typo'd peak raises here, before
+        # step 0, never silently grades MFU against garbage)
+        _efficiency.reset_run()
         good_streak = 0
         hb = None
         if self._heartbeat and self._ckpt_dir is not None:
@@ -326,6 +343,12 @@ class FitLoop:
                 while True:
                     if bd is not None:
                         bd.begin_step(result.step)
+                    # efficiency window: opened the way the breakdown
+                    # opens its ledger window — dispatch sites note the
+                    # step's programs, end_step divides their FLOPs by
+                    # wall and peak. One cached env check when off; a
+                    # fast-forwarded replay batch simply re-opens it.
+                    _efficiency.begin_step()
                     # data_wait: blocked on the input pipeline (staging
                     # iterators emit nested h2d spans; exclusive-time
                     # accounting charges each second once)
@@ -502,6 +525,14 @@ class FitLoop:
                             result.step % self._ckpt_every == 0:
                         with _segment("checkpoint"):
                             self._save(cm, result.step, epoch, consumed)
+                    # close the efficiency window (result.step already
+                    # incremented — report the step that RAN). Goodput:
+                    # a sentinel-skipped step moved no model forward, so
+                    # its samples are not useful ones
+                    _efficiency.end_step(
+                        step=result.step - 1, samples=int(bs),
+                        useful=finite,
+                        tokens_per_sample=self._tokens_per_sample)
                     if bd is not None:
                         rec = bd.end_step()
                         if tuner is not None:
@@ -589,6 +620,10 @@ class FitLoop:
         # non-finite provenance (None when the plane is off and no
         # loss-scale event fired)
         result.numerics = _numerics.summary()
+        # the efficiency axis: MFU / roofline / goodput rollup (None
+        # when MXTPU_EFFICIENCY is off)
+        result.efficiency = _efficiency.summary(
+            tokens_per_sample=self._tokens_per_sample)
         plane = getattr(self._trainer, "_zero", None)
         if plane:
             # ZeRO-1 plane summary (world/ranks/shard size) next to the
@@ -598,6 +633,16 @@ class FitLoop:
                       "(this process: %s, %d/%d params)",
                       result.zero["world"], result.zero["ranks"],
                       result.zero["shard_params"], result.zero["params"])
+        # persistent run report (MXTPU_RUN_REPORT_DIR): the cross-run
+        # regression artifact, written LAST so it captures every axis
+        # summary assembled above. A failed write is diagnosed, never
+        # fatal — the training result must survive a full disk.
+        if _run_report.report_dir() is not None:
+            try:
+                result.run_report = _run_report.write_run_report(result)
+                _LOG.info("run report: %s", result.run_report)
+            except Exception as e:
+                _LOG.warning("run report failed: %s", e)
         return result
 
     def _final_exit(self, cm, result: FitResult, epoch: int,

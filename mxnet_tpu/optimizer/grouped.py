@@ -33,6 +33,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as _np
 
 from ..base import MXNetError, check, env
+from ..telemetry import efficiency as _efficiency
 from ..telemetry import memory as _memory
 
 __all__ = ["aggregation_size", "eligible", "grouped_update",
@@ -269,6 +270,84 @@ def clear_cache():
     _cache().clear()
 
 
+def _sig_fields(sig) -> Optional[Tuple]:
+    """(rule_name, sentinel, donated_sig, grads_sig) of one cache key, or
+    None for a foreign entry (the shared-LRU discipline)."""
+    try:
+        if len(sig) == 6:
+            # stats-emitting variant (MXTPU_NUMERICS sampled steps)
+            rule_name, _statics, sentinel, _stats, donated_sig, \
+                grads_sig = sig
+        else:
+            rule_name, _statics, sentinel, donated_sig, grads_sig = sig
+        return rule_name, sentinel, donated_sig, grads_sig
+    except (TypeError, ValueError):
+        return None
+
+
+def _lower_sig(sig, fn):
+    """Re-lower one cached bucket program from its signature-key's
+    abstract arguments to a jax ``Compiled`` (one trace; a disk read,
+    not a recompile, under a persistent compile cache) — the CachedOp
+    discipline ``spmd.program_stats`` established. None for foreign or
+    un-lowerable entries."""
+    import jax
+    import numpy as _np2
+    fields = _sig_fields(sig)
+    if fields is None:
+        return None
+    _rule_name, sentinel, donated_sig, grads_sig = fields
+    f32 = _np2.dtype("float32")
+    n = len(donated_sig)
+    vec = jax.ShapeDtypeStruct((n,), f32)
+    scalar = jax.ShapeDtypeStruct((), f32)
+    try:
+        donated = tuple(
+            tuple(jax.ShapeDtypeStruct(tuple(s), _np2.dtype(dt))
+                  for s, dt in bundle) for bundle in donated_sig)
+        grads = tuple(jax.ShapeDtypeStruct(tuple(s), _np2.dtype(dt))
+                      for s, dt in grads_sig)
+        if sentinel:
+            ok = jax.ShapeDtypeStruct((), _np2.dtype(bool))
+            return fn.lower(vec, vec, scalar, ok, donated,
+                            grads).compile()
+        return fn.lower(vec, vec, scalar, donated, grads).compile()
+    except Exception:
+        return None  # un-lowerable entry must not break the report
+
+
+def _analyze_sig(sig, fn, refresh: bool = False,
+                 need_cost: bool = False) -> Optional[dict]:
+    """Combined cost+memory analysis of one cached bucket program, via
+    the ONE shared extraction helper, recorded in the telemetry program
+    registry (kind ``optimizer``) and cached there until ``refresh`` (or
+    until ``need_cost`` finds a memory-only record to upgrade). A
+    FAILED resolution is cached too (``unavailable``/``cost_unavailable``
+    markers): a backend whose analyses are missing must cost one lower,
+    not one per step — ``refresh=True`` is the retry path."""
+    import hashlib
+    fields = _sig_fields(sig)
+    if fields is None:
+        return None
+    rule_name = fields[0]
+    digest = hashlib.md5(repr(sig).encode()).hexdigest()[:12]
+    label = f"{rule_name}:{digest}"
+    cached = _memory.get_program("optimizer", label)
+    if cached is not None and not refresh and \
+            (not need_cost or "flops" in cached or
+             cached.get("unavailable") or cached.get("cost_unavailable")):
+        return cached
+    compiled = _lower_sig(sig, fn)
+    stats = _efficiency.compiled_program_stats(compiled)
+    if stats is None:
+        stats = {"unavailable": True}
+    stats = dict(stats, signature=digest, params=len(fields[2]))
+    if "flops" not in stats:
+        stats["cost_unavailable"] = True
+    _memory.record_program("optimizer", label, stats)
+    return stats
+
+
 def program_memory(refresh: bool = False) -> Dict[str, dict]:
     """Static memory attribution of every cached bucket program:
     ``{signature_digest: {argument_bytes, output_bytes, temp_bytes, ...}}``
@@ -277,53 +356,15 @@ def program_memory(refresh: bool = False) -> Dict[str, dict]:
     disk read, not a recompile, under a persistent compile cache) — the
     CachedOp discipline ``spmd.program_stats`` established. Results are
     recorded in the telemetry program registry (kind ``optimizer``) and
-    cached until ``refresh``."""
-    import hashlib
-
-    import jax
-    import numpy as _np2
+    cached until ``refresh``. Records may additionally carry the
+    cost-model fields (``flops`` / ``bytes_accessed``) when the
+    efficiency plane resolved this program."""
     out: Dict[str, dict] = {}
-    f32 = _np2.dtype("float32")
     for sig, fn in _cache().snapshot_items():
-        try:
-            if len(sig) == 6:
-                # stats-emitting variant (MXTPU_NUMERICS sampled steps)
-                rule_name, _statics, sentinel, _stats, donated_sig, \
-                    grads_sig = sig
-            else:
-                rule_name, _statics, sentinel, donated_sig, grads_sig = sig
-        except (TypeError, ValueError):
-            continue  # foreign cache entry (shared LRU discipline)
-        digest = hashlib.md5(repr(sig).encode()).hexdigest()[:12]
-        label = f"{rule_name}:{digest}"
-        cached = _memory.get_program("optimizer", label)
-        if cached is not None and not refresh:
-            out[digest] = cached
+        stats = _analyze_sig(sig, fn, refresh=refresh)
+        if stats is None or "argument_bytes" not in stats:
             continue
-        n = len(donated_sig)
-        vec = jax.ShapeDtypeStruct((n,), f32)
-        scalar = jax.ShapeDtypeStruct((), f32)
-        try:
-            donated = tuple(
-                tuple(jax.ShapeDtypeStruct(tuple(s), _np2.dtype(dt))
-                      for s, dt in bundle) for bundle in donated_sig)
-            grads = tuple(jax.ShapeDtypeStruct(tuple(s), _np2.dtype(dt))
-                          for s, dt in grads_sig)
-            if sentinel:
-                ok = jax.ShapeDtypeStruct((), _np2.dtype(bool))
-                compiled = fn.lower(vec, vec, scalar, ok, donated,
-                                    grads).compile()
-            else:
-                compiled = fn.lower(vec, vec, scalar, donated,
-                                    grads).compile()
-        except Exception:
-            continue  # un-lowerable entry must not break the report
-        stats = _memory.compiled_memory_stats(compiled)
-        if stats is None:
-            continue
-        stats = dict(stats, signature=digest, params=n)
-        _memory.record_program("optimizer", label, stats)
-        out[digest] = stats
+        out[stats["signature"]] = stats
     return out
 
 
@@ -397,10 +438,45 @@ def _finite_fn(n: int):
     return jax.jit(fn)
 
 
+def _finite_cost(n: int, sig) -> Optional[dict]:
+    """Efficiency-plane resolver for the fused finiteness reduction.
+    Failed resolutions are cached (``cost_unavailable``) like
+    ``_analyze_sig`` — one lower per signature, never one per step."""
+    import hashlib
+
+    import jax
+    import numpy as _np2
+    label = f"finite_flag:{n}:" + hashlib.md5(
+        repr(sig).encode()).hexdigest()[:12]
+    cached = _memory.get_program("optimizer", label)
+    if cached is not None and ("flops" in cached or
+                               cached.get("cost_unavailable")):
+        return cached
+    try:
+        avals = tuple(jax.ShapeDtypeStruct(tuple(s), _np2.dtype(dt))
+                      for s, dt in sig)
+        compiled = _finite_fn(n).lower(*avals).compile()
+        stats = _efficiency.compiled_program_stats(compiled)
+    except Exception:
+        stats = None
+    if stats is None:
+        stats = {"unavailable": True}
+    if "flops" not in stats:
+        stats = dict(stats, cost_unavailable=True)
+    _memory.record_program("optimizer", label, dict(stats))
+    return stats
+
+
 def global_finite_flag(grads):
     """Device-resident all-finite scalar over raw jax arrays (no host
     sync; the caller fetches it together with the loss)."""
-    return _finite_fn(len(grads))(*grads)
+    fn = _finite_fn(len(grads))
+    if _efficiency.enabled():
+        sig = tuple((tuple(g.shape), str(g.dtype)) for g in grads)
+        _efficiency.note_dispatch(
+            ("finite", sig), "optimizer", f"finite_flag:{len(grads)}",
+            functools.partial(_finite_cost, len(grads), sig))
+    return fn(*grads)
 
 
 # ---------------------------------------------------------------------------
@@ -566,6 +642,15 @@ def grouped_update(updater, items, agg_size: int, sentinel: bool = False,
             return _build_bucket_fn(tuple(kernels), s, stats=c)
 
         fn = _cache().get_or_build(sig, _build)
+        # efficiency plane (MXTPU_EFFICIENCY): one launch of this bucket
+        # program into the current step window — the cost resolves
+        # lazily at step end through the SAME registry record
+        # program_memory fills. One cached env check when off.
+        if _efficiency.enabled():
+            _efficiency.note_dispatch(
+                ("opt", sig), "optimizer",
+                f"{rule.name}:bucket{len(chunk)}",
+                functools.partial(_analyze_sig, sig, fn, need_cost=True))
         if sentinel:
             outs = fn(lrs, wds, rescale, flag, donated, grads)
         else:

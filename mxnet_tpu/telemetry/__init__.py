@@ -49,6 +49,24 @@ is the TPU-native generalization; the whole stack reports into it:
   ``mxtpu_loss_scale``). The legacy ``mxnet_tpu.monitor.Monitor`` is a
   facade over it (``Monitor.install_numerics``).
 
+- :mod:`.efficiency` — the efficiency axis: the ONE shared
+  ``cost_analysis``/``memory_analysis`` extraction helper behind
+  ``spmd.program_stats`` / ``CachedOp.memory_analysis`` /
+  ``grouped.program_memory``, a per-program FLOP/byte cost registry
+  (recorded alongside the program-memory registry,
+  ``mxtpu_program_{flops,bytes_accessed}``), and the live MFU/goodput
+  rollup (``MXTPU_EFFICIENCY``, ``MXTPU_DEVICE_PEAK`` peak table):
+  ``FitResult.efficiency``, ``mxtpu_mfu``/``mxtpu_goodput_samples``,
+  Perfetto counters (category ``efficiency``), the ``mfu`` column of
+  ``tools/trace_report.py``.
+
+- :mod:`.run_report` — the persistent per-run verdict: a versioned
+  ``run_<pid>_<ts>.json`` artifact written at fit end
+  (``MXTPU_RUN_REPORT_DIR``, tmp+rename + shared ``fault.write_manifest``)
+  capturing the config fingerprint, step-time distribution and every
+  axis's summary; ``tools/run_compare.py`` diffs two of them into
+  per-metric regression verdicts with CI exit codes.
+
 ``mxnet_tpu.profiler`` remains the MXNet-compatible facade over this
 package, and the kvstore remote profiler command channel
 (``KVStore.send_profiler_command``) is served by it, so the controller can
@@ -71,6 +89,11 @@ from .collective import (CollectiveLedger,
                          ledger as collective_ledger)
 from . import numerics
 from .numerics import NumericsPlane, plane as numerics_plane
+from . import efficiency
+from .efficiency import (EfficiencyRollup, compiled_program_stats,
+                         rollup as efficiency_rollup)
+from . import run_report
+from .run_report import write_run_report, load_run_report
 
 __all__ = [
     "Tracer", "tracer", "span", "instant", "counter_event", "enabled",
@@ -81,4 +104,7 @@ __all__ = [
     "memory", "MemoryLedger", "memory_ledger", "dump_forensics",
     "collective", "CollectiveLedger", "collective_ledger",
     "numerics", "NumericsPlane", "numerics_plane",
+    "efficiency", "EfficiencyRollup", "compiled_program_stats",
+    "efficiency_rollup",
+    "run_report", "write_run_report", "load_run_report",
 ]

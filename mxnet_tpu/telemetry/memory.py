@@ -53,10 +53,11 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from ..base import env
 
 __all__ = ["CATEGORIES", "MemoryLedger", "ledger", "nd_bytes",
-           "compiled_memory_stats", "record_program", "get_program",
-           "program_report", "dump_forensics", "check_pressure",
-           "oom_guard", "maybe_dump_oom", "is_oom", "budget_bytes",
-           "reset_pressure_state", "reconcile"]
+           "compiled_memory_stats", "record_program", "merge_program",
+           "get_program",
+           "program_report", "program_total", "dump_forensics",
+           "check_pressure", "oom_guard", "maybe_dump_oom", "is_oom",
+           "budget_bytes", "reset_pressure_state", "reconcile"]
 
 #: ledger categories, in the order forensics ranks ties
 CATEGORIES = ("params", "grads", "grad_buckets", "optimizer", "masters",
@@ -439,28 +440,16 @@ _prog_lock = threading.Lock()
 
 def compiled_memory_stats(compiled) -> Optional[Dict[str, int]]:
     """Extract ``memory_analysis()`` from a jax Compiled object into a
-    plain int dict; None when the backend reports no analysis."""
-    try:
-        mem = compiled.memory_analysis()
-    except Exception:
+    plain int dict; None when the backend reports no analysis. Thin
+    memory-fields view over the ONE shared extraction helper
+    (``efficiency.compiled_program_stats`` — the cost half lands in the
+    same registry records); output byte-identical to the historical
+    hand-rolled extraction (regression-pinned)."""
+    from .efficiency import MEMORY_FIELDS, compiled_program_stats
+    stats = compiled_program_stats(compiled)
+    if stats is None or "argument_bytes" not in stats:
         return None
-    if mem is None:
-        return None
-
-    def g(name):
-        try:
-            return int(getattr(mem, name, 0) or 0)
-        except Exception:
-            return 0
-
-    stats = {"argument_bytes": g("argument_size_in_bytes"),
-             "output_bytes": g("output_size_in_bytes"),
-             "temp_bytes": g("temp_size_in_bytes"),
-             "alias_bytes": g("alias_size_in_bytes"),
-             "generated_code_bytes": g("generated_code_size_in_bytes")}
-    if not any(stats.values()) and not hasattr(mem, "temp_size_in_bytes"):
-        return None
-    return stats
+    return {k: stats[k] for k in MEMORY_FIELDS}
 
 
 def record_program(kind: str, label: str, stats: Dict[str, Any]) -> None:
@@ -468,6 +457,19 @@ def record_program(kind: str, label: str, stats: Dict[str, Any]) -> None:
     (kind, label) — e.g. ("cached_op", "ResNet:ab12...")."""
     with _prog_lock:
         _PROGRAMS[(kind, label)] = dict(stats)
+
+
+def merge_program(kind: str, label: str, stats: Dict[str, Any]) -> None:
+    """Merge fields into one program's record ATOMICALLY (under the
+    registry lock). The memory and cost halves of a record may resolve
+    at different times on different threads (``memory_analysis`` on a
+    monitoring thread, the efficiency resolver at step end) — a
+    read-modify-write outside the lock would let one half clobber the
+    other's freshly-added fields."""
+    with _prog_lock:
+        rec = dict(_PROGRAMS.get((kind, label)) or {})
+        rec.update(stats)
+        _PROGRAMS[(kind, label)] = rec
 
 
 def get_program(kind: str, label: str) -> Optional[Dict[str, Any]]:
@@ -487,7 +489,14 @@ def program_report(limit: Optional[int] = None) -> List[Dict[str, Any]]:
 
 def _program_total(field: str) -> int:
     with _prog_lock:
-        return sum(int(st.get(field, 0)) for st in _PROGRAMS.values())
+        return sum(int(st.get(field, 0) or 0) for st in _PROGRAMS.values())
+
+
+def program_total(field: str) -> int:
+    """Sum of one numeric field over every recorded program (the
+    ``mxtpu_program_*`` gauges — memory fields here, cost fields via
+    ``efficiency``'s gauges)."""
+    return _program_total(field)
 
 
 def register_cache_programs(owner: str, op, stats: Dict[str, dict]) -> None:

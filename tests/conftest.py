@@ -68,3 +68,10 @@ def pytest_configure(config):
         "bucket programs, non-finite provenance, loss-scale timeline, "
         "Monitor facade). Tier-1-safe: CPU, in-process, bitwise "
         "on-vs-off parity pinned.")
+    config.addinivalue_line(
+        "markers", "efficiency: efficiency/goodput plane tests "
+        "(telemetry/efficiency.py per-program FLOP/byte cost registry "
+        "+ live MFU/roofline rollup, telemetry/run_report.py run "
+        "reports, tools/run_compare.py regression diff). Tier-1-safe: "
+        "CPU — the XLA cost model is exact there, so hand-computed "
+        "matmul FLOPs pin the numbers.")

@@ -104,6 +104,21 @@ def test_bench_tiny_deadline_emits_full_headline_json():
     assert nrow["nonfinite_steps"] == [2]
     assert nrow["culprit"]
     assert nrow["loss_scale_events"] == 1
+    # the efficiency row: nonzero MFU from the cost-model FLOPs of the
+    # dispatched programs, full attribution on the hybridized smoke MLP,
+    # and the persistent run-report round-trip (parse + manifest verify)
+    # — the carried hygiene item: the first artifact reflecting
+    # PRs 6-14 parses with every plane's row present
+    erow = payload["efficiency"]
+    assert erow["mfu"] > 0
+    assert erow["samples_per_s"] > 0
+    assert erow["flops_per_step"] > 0
+    assert erow["unattributed_dispatches"] == 0
+    assert 1 <= len(erow["top_programs"]) <= 3
+    assert all(f > 0 for _lbl, f in erow["top_programs"])
+    assert erow["estimate"] is True  # CPU child, defaulted peak table
+    assert erow["report_ok"] is True
+    assert erow["report_steps"] > 0
 
 
 def test_bench_exhausted_deadline_still_emits_parseable_row():

@@ -22,8 +22,16 @@ from):
    Pallas BN(+add)+ReLU path (ops/pallas_kernels.py) — the committed
    answer to "which bytes can fusion remove, and which are irreducible".
 
+3. **From a run report** (``--from-report PATH``, runs anywhere): the
+   live efficiency plane (``MXTPU_EFFICIENCY`` + ``MXTPU_RUN_REPORT_DIR``,
+   telemetry/efficiency.py) already measured the run's per-step FLOPs,
+   bytes and samples/s — a mode row is stamped straight from that
+   artifact (same JSON schema, provenance names the report) instead of
+   requiring a live re-measure on the TPU.
+
 Run on the axon TPU:  python tools/roofline_ledger.py --measure
 Anywhere (per-op only): python tools/roofline_ledger.py --per-op --skip-stream --modes ''
+From a run report:      python tools/roofline_ledger.py --modes '' --from-report runs/run_123_456.json
 """
 import argparse
 import datetime
@@ -333,9 +341,22 @@ def main():
                     help="skip the HBM stream-bandwidth probe")
     ap.add_argument("--per-op", action="store_true",
                     help="emit the analytic per-op byte ledger")
+    ap.add_argument("--from-report", default=None, metavar="PATH",
+                    help="stamp a mode row from a persistent run report "
+                         "(MXTPU_RUN_REPORT_DIR artifact with the "
+                         "efficiency plane on) instead of a live "
+                         "re-measure; combine with --modes '' to skip "
+                         "lowering entirely")
+    ap.add_argument("--report-mode", default="bf16",
+                    help="which mode row --from-report stamps "
+                         "(default bf16)")
+    ap.add_argument("--out", default=None, metavar="PATH",
+                    help="ledger file to update (default "
+                         "docs/ROOFLINE.json; tests point this at a "
+                         "scratch file)")
     args = ap.parse_args()
 
-    path = os.path.join(ROOT, "docs", "ROOFLINE.json")
+    path = args.out or os.path.join(ROOT, "docs", "ROOFLINE.json")
     out = {}
     if os.path.exists(path):
         with open(path) as f:
@@ -409,6 +430,65 @@ def main():
             "measured_imgs_per_sec_source":
                 "file predates provenance stamping",
         })
+
+    if args.from_report:
+        # mode row straight from the run report's efficiency rollup —
+        # the live plane already measured flops/bytes per step and
+        # samples/s, so no accelerator (and no lowering) is needed
+        try:
+            with open(args.from_report) as f:
+                rep = json.load(f)
+        except (OSError, ValueError) as e:
+            raise SystemExit(f"--from-report: {e}")
+        if rep.get("kind") != "mxtpu_run_report":
+            raise SystemExit(
+                f"--from-report: {args.from_report} is not a run report "
+                f"(kind={rep.get('kind')!r})")
+        # same format guard as telemetry.run_report.load_run_report /
+        # tools/run_compare.py (duplicated — this path stays
+        # framework-import-free): a NEWER report with moved fields must
+        # fail loudly, not stamp a row of nulls into the ledger
+        try:
+            fmt = int(rep.get("format", -1))
+        except (TypeError, ValueError):
+            fmt = -1
+        if fmt > 1:
+            raise SystemExit(
+                f"--from-report: report format {rep.get('format')} is "
+                "newer than this reader (1) — update the tool")
+        eff = rep.get("efficiency") or {}
+        if not eff:
+            raise SystemExit(
+                "--from-report: report has no efficiency rollup — run "
+                "with MXTPU_EFFICIENCY=on to capture one")
+        st = rep.get("step_time") or {}
+        sps = eff.get("samples_per_s")
+        row = {
+            "imgs_per_sec_measured": round(sps, 2) if sps else None,
+            "program_flops_per_step": eff.get("flops_per_step"),
+            "program_bytes_per_step": eff.get("bytes_per_step"),
+        }
+        if st.get("p50_s"):
+            row["ms_per_step"] = round(1e3 * float(st["p50_s"]), 2)
+        if eff.get("achieved_flops_per_s"):
+            row["achieved_tflops"] = round(
+                float(eff["achieved_flops_per_s"]) / 1e12, 3)
+        if eff.get("achieved_bytes_per_s"):
+            row["achieved_hbm_gbs"] = round(
+                float(eff["achieved_bytes_per_s"]) / 1e9, 1)
+        if eff.get("mfu") is not None:
+            row["mfu"] = round(float(eff["mfu"]), 5)
+            row["mfu_estimate"] = bool(eff.get("estimate"))
+        merged = dict(out.get("modes", {}))
+        merged[args.report_mode] = row
+        stamp = provenance(f"run report {args.from_report} "
+                           "(efficiency plane samples_per_s)")
+        stamp["regenerated_modes"] = [args.report_mode]
+        out["modes"] = merged
+        out["modes_provenance"] = stamp
+        log(f"mode {args.report_mode}: stamped from {args.from_report} "
+            f"({sps and round(sps, 1)} samples/s, "
+            f"mfu={eff.get('mfu')})")
 
     if args.per_op:
         out["per_op_ledger"] = per_op_ledger()

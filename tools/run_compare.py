@@ -1,0 +1,234 @@
+#!/usr/bin/env python
+"""Cross-run regression diff over two persistent run reports.
+
+``fit.FitLoop`` writes one ``run_<pid>_<ts>.json`` per run when
+``MXTPU_RUN_REPORT_DIR`` is set (telemetry/run_report.py); this tool
+turns two of them into a per-metric regression verdict a CI gate can act
+on::
+
+    python tools/run_compare.py baseline.json candidate.json
+    python tools/run_compare.py A.json B.json --fence 10 --json
+
+Exit codes (the CI contract):
+
+- ``0`` — no metric regressed beyond the noise fence
+- ``1`` — at least one metric regressed (each is named on stderr/stdout)
+- ``2`` — usage / unreadable / non-report input
+
+Each metric has a direction (step time down is good, MFU up is good);
+``--fence PCT`` (default 5%) is the relative noise fence — a change
+within it is reported but never fails the gate. Metrics absent from
+either report (plane off for that run) are reported ``missing`` and
+never regress; count-like metrics with a zero baseline regress on ANY
+increase (there is no relative change from zero). Reports whose env
+fingerprints differ are flagged in the output — "slower" and
+"configured differently" are different verdicts.
+
+Pure stdlib on purpose — it must run on a laptop (or a CI box) with
+nothing installed.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+#: (name, json path, direction, kind)
+#: direction: "lower" = smaller is better, "higher" = bigger is better
+#: kind: "rate" = relative fence applies; "count" = zero-baseline
+#: increases regress outright (no relative change from zero exists)
+METRICS: List[Tuple[str, Tuple[str, ...], str, str]] = [
+    ("step_time_p50_s", ("step_time", "p50_s"), "lower", "rate"),
+    ("step_time_p95_s", ("step_time", "p95_s"), "lower", "rate"),
+    ("mfu", ("efficiency", "mfu"), "higher", "rate"),
+    ("samples_per_s", ("efficiency", "samples_per_s"), "higher", "rate"),
+    ("tokens_per_s", ("efficiency", "tokens_per_s"), "higher", "rate"),
+    ("achieved_flops_per_s", ("efficiency", "achieved_flops_per_s"),
+     "higher", "rate"),
+    ("mem_peak_bytes", ("memory", "peak_bytes"), "lower", "rate"),
+    ("comm_max_skew_ms", ("comm_health", "max_skew_ms"), "lower", "rate"),
+    ("skipped_steps", ("run", "skipped_steps"), "lower", "count"),
+    ("nonfinite_steps", ("numerics", "nonfinite_steps"), "lower",
+     "count"),
+    ("watchdog_fired", ("comm_health", "watchdog_fired"), "lower",
+     "count"),
+    ("loss_last", ("loss", "last"), "lower", "rate"),
+]
+
+
+#: newest report format this reader understands (telemetry/run_report.py
+#: REPORT_FORMAT) — a NEWER report must be rejected, not silently read
+#: as all-'missing' metrics that can never fail the gate
+KNOWN_FORMAT = 1
+
+
+def load_report(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        payload = json.load(f)
+    if payload.get("kind") != "mxtpu_run_report":
+        raise ValueError(
+            f"{path}: not a run report (kind={payload.get('kind')!r})")
+    try:
+        fmt = int(payload.get("format", -1))
+    except (TypeError, ValueError):
+        fmt = -1
+    if fmt > KNOWN_FORMAT:
+        raise ValueError(
+            f"{path}: report format {payload.get('format')} is newer "
+            f"than this reader ({KNOWN_FORMAT}) — update the tool; "
+            "reading it would degrade every metric to 'missing' and "
+            "pass the gate blind")
+    return payload
+
+
+def _lookup(report: Dict[str, Any],
+            path: Tuple[str, ...]) -> Optional[float]:
+    node: Any = report
+    for key in path:
+        if not isinstance(node, dict) or key not in node:
+            return None
+        node = node[key]
+    if node is None:
+        return None
+    try:
+        return float(node)
+    except (TypeError, ValueError):
+        return None
+
+
+def compare_metric(name: str, a: Optional[float], b: Optional[float],
+                   direction: str, kind: str,
+                   fence_pct: float) -> Dict[str, Any]:
+    """One metric's verdict: ok | improved | regressed | missing."""
+    row: Dict[str, Any] = {"metric": name, "baseline": a, "candidate": b,
+                           "direction": direction}
+    if a is None or b is None:
+        row["verdict"] = "missing"
+        return row
+    # non-finite values never compare True, so without this a
+    # NaN-diverged candidate would verdict 'ok' and pass the gate blind
+    if not math.isfinite(b):
+        row["change_pct"] = None
+        row["verdict"] = "regressed" if math.isfinite(a) else "ok"
+        return row
+    if not math.isfinite(a):
+        row["change_pct"] = None
+        row["verdict"] = "improved"  # baseline was broken, candidate isn't
+        return row
+    worse = (b - a) if direction == "lower" else (a - b)
+    if a != 0:
+        change_pct = (b - a) / abs(a) * 100.0
+        row["change_pct"] = round(change_pct, 3)
+        beyond = abs(change_pct) > fence_pct
+    else:
+        # no relative change from zero: counts regress on any increase,
+        # rates only on a material absolute one
+        row["change_pct"] = None
+        beyond = (b != 0) if kind == "count" else abs(b) > 1e-12
+    if worse > 0 and beyond:
+        row["verdict"] = "regressed"
+    elif worse < 0 and beyond:
+        row["verdict"] = "improved"
+    else:
+        row["verdict"] = "ok"
+    return row
+
+
+def compare(a: Dict[str, Any], b: Dict[str, Any],
+            fence_pct: float) -> Dict[str, Any]:
+    rows = [compare_metric(name, _lookup(a, path), _lookup(b, path),
+                           direction, kind, fence_pct)
+            for name, path, direction, kind in METRICS]
+    regressed = [r["metric"] for r in rows if r["verdict"] == "regressed"]
+    fp_a = (a.get("fingerprint") or {}).get("env_overrides") or {}
+    fp_b = (b.get("fingerprint") or {}).get("env_overrides") or {}
+    fp_diff = sorted(k for k in set(fp_a) | set(fp_b)
+                     if fp_a.get(k) != fp_b.get(k))
+    eff = (a.get("efficiency") or {})
+    return {
+        "fence_pct": fence_pct,
+        "baseline_steps": _lookup(a, ("run", "steps")),
+        "candidate_steps": _lookup(b, ("run", "steps")),
+        "metrics": rows,
+        "regressed": regressed,
+        "improved": [r["metric"] for r in rows
+                     if r["verdict"] == "improved"],
+        "fingerprint_diff": fp_diff,
+        "estimate": bool(eff.get("estimate")) or
+        bool((b.get("efficiency") or {}).get("estimate")),
+        "verdict": "regression" if regressed else "ok",
+    }
+
+
+def _fmt(v: Optional[float]) -> str:
+    if v is None:
+        return "-"
+    if not math.isfinite(v):
+        return str(v)  # nan/inf: int() would crash the text report
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return f"{v:.6g}"
+
+
+def print_text(result: Dict[str, Any], path_a: str, path_b: str) -> None:
+    print(f"== run_compare: {path_a} (baseline) vs {path_b} (candidate), "
+          f"fence ±{result['fence_pct']:g}% ==")
+    head = (f"{'metric':<22} {'baseline':>14} {'candidate':>14} "
+            f"{'Δ%':>9}  verdict")
+    print(head)
+    print("-" * len(head))
+    for r in result["metrics"]:
+        pct = "-" if r.get("change_pct") is None \
+            else f"{r['change_pct']:+.2f}"
+        mark = {"regressed": " <-- REGRESSED",
+                "improved": " (improved)"}.get(r["verdict"], "")
+        print(f"{r['metric']:<22} {_fmt(r['baseline']):>14} "
+              f"{_fmt(r['candidate']):>14} {pct:>9}  "
+              f"{r['verdict']}{mark}")
+    if result["fingerprint_diff"]:
+        print(f"\nNOTE: env fingerprints differ on "
+              f"{', '.join(result['fingerprint_diff'])} — the runs may "
+              "not be configured identically")
+    if result["estimate"]:
+        print("NOTE: MFU graded against a defaulted device peak "
+              "(estimate) — set MXTPU_DEVICE_PEAK for honest numbers")
+    if result["regressed"]:
+        print(f"\nREGRESSION: {', '.join(result['regressed'])}")
+    else:
+        print("\nno regression beyond the fence")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Diff two run reports (MXTPU_RUN_REPORT_DIR "
+                    "artifacts) into per-metric regression verdicts. "
+                    "Exit 0 = ok, 1 = regression, 2 = bad input.")
+    ap.add_argument("baseline", help="baseline run_<pid>_<ts>.json")
+    ap.add_argument("candidate", help="candidate run_<pid>_<ts>.json")
+    ap.add_argument("--fence", type=float, default=5.0, metavar="PCT",
+                    help="relative noise fence in percent (default 5): "
+                         "changes within it never fail the gate")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one machine-readable JSON object")
+    args = ap.parse_args(argv)
+    if args.fence < 0:
+        print("run_compare: --fence must be >= 0", file=sys.stderr)
+        return 2
+    try:
+        a = load_report(args.baseline)
+        b = load_report(args.candidate)
+    except (OSError, ValueError) as e:
+        print(f"run_compare: {e}", file=sys.stderr)
+        return 2
+    result = compare(a, b, args.fence)
+    if args.json:
+        print(json.dumps(result, indent=1))
+    else:
+        print_text(result, args.baseline, args.candidate)
+    return 1 if result["regressed"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,7 +28,11 @@ tool turns it back into the operator-facing tables without Perfetto:
   and ``loss_scale`` columns from the category-``numerics`` counter
   events the plane drops at each sampled step — omitted cleanly (no
   column, no key) when the plane was off, so plane-off traces render
-  byte-identical to before the plane existed.
+  byte-identical to before the plane existed;
+- the efficiency counter track (``MXTPU_EFFICIENCY``): a per-step
+  ``mfu`` column from the category-``efficiency`` counter events the
+  rollup drops at each step end — same clean-omission contract when
+  the plane was off.
 
 A MERGED multi-rank trace (``tools/fleet_trace.py`` output — events from
 more than one pid) reports per rank: the same tables, one section per
@@ -151,8 +155,15 @@ def step_table(events: List[dict]) -> List[Dict[str, Any]]:
         for e in events
         if e.get("ph") == "C" and e.get("cat") == "numerics"
         and e.get("name") == "loss_scale")
+    # the efficiency counter track (category 'efficiency'): one mfu
+    # sample per step when the MXTPU_EFFICIENCY plane was on
+    eff_mfu = sorted(
+        (float(e["ts"]), float(e.get("args", {}).get("value", 0.0)))
+        for e in events
+        if e.get("ph") == "C" and e.get("cat") == "efficiency"
+        and e.get("name") == "mfu")
     rows = []
-    si = mi = pi = gi = li = 0
+    si = mi = pi = gi = li = ei = 0
     prev_live = None  # last live sample of the previous step (for delta)
     for label, t0, t1 in bounds:
         while si < len(spans) and float(spans[si]["ts"]) < t0:
@@ -211,6 +222,17 @@ def step_table(events: List[dict]) -> List[Dict[str, Any]]:
             li += 1
         if lsval is not None:
             row["loss_scale"] = lsval
+        # efficiency column: the LAST mfu sample inside the step window
+        # (one per step with the plane on; a plane-off trace adds no
+        # column at all — the numerics omission contract)
+        while ei < len(eff_mfu) and eff_mfu[ei][0] < t0:
+            ei += 1
+        mval = None
+        while ei < len(eff_mfu) and eff_mfu[ei][0] < t1:
+            mval = eff_mfu[ei][1]
+            ei += 1
+        if mval is not None:
+            row["mfu"] = mval
         rows.append(row)
     return rows
 
@@ -251,6 +273,7 @@ def _fmt_table(rows: List[Dict[str, Any]], limit: int) -> List[str]:
         return ["(no complete spans in trace)"]
     has_mem = any("mem_peak_bytes" in r for r in rows)
     has_num = any("grad_norm" in r or "loss_scale" in r for r in rows)
+    has_eff = any("mfu" in r for r in rows)
     shown = rows[-limit:] if limit else rows
     head = f"{'step':>6} {'wall_ms':>9}" + "".join(
         f" {c[:14]:>14}" for c in cats)
@@ -258,6 +281,8 @@ def _fmt_table(rows: List[Dict[str, Any]], limit: int) -> List[str]:
         head += f" {'mem_peak_MB':>12} {'mem_Δ_MB':>10}"
     if has_num:
         head += f" {'grad_norm':>11} {'loss_scale':>10}"
+    if has_eff:
+        head += f" {'mfu':>9}"
     lines = [head, "-" * len(head)]
     for r in shown:
         wall = r["wall_us"]
@@ -282,6 +307,9 @@ def _fmt_table(rows: List[Dict[str, Any]], limit: int) -> List[str]:
                      if "grad_norm" in r else f" {'-':>11}")
             line += (f" {r['loss_scale']:>10.4g}"
                      if "loss_scale" in r else f" {'-':>10}")
+        if has_eff:
+            line += (f" {r['mfu']:>9.4g}"
+                     if "mfu" in r else f" {'-':>9}")
         lines.append(line)
     if len(shown) < len(rows):
         lines.append(f"... ({len(rows) - len(shown)} earlier steps "
