@@ -1011,6 +1011,90 @@ def _numerics_probe(steps=6, batch=32, width=64):
     }
 
 
+def _elastic_probe(resize_at=3, from_world=2, to_world=3):
+    """The `elastic` row: simulated resize mid-run (parallel/elastic.py)
+    — a world-``from_world`` ZeRO run is killed by chaos
+    ``resize@K:to_world`` (final verified checkpoint + resumable exit,
+    asserted), resumed at world ``to_world`` under MXTPU_ELASTIC=on, and
+    graded on the resume wall seconds plus a post-resize
+    trajectory-match verdict against an always-at-``to_world`` run —
+    the ROADMAP acceptance bar, re-measured with every artifact."""
+    import shutil
+    import tempfile
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import fit as fit_mod, gluon, io as mxio
+    from mxnet_tpu import kvstore as kvs
+    from mxnet_tpu.contrib import chaos
+
+    saved = {k: os.environ.get(k) for k in
+             ("MXTPU_ZERO", "MXTPU_ZERO_WORLD", "MXTPU_ELASTIC",
+              "MXTPU_OPTIMIZER_AGGREGATION", "MXTPU_CHAOS")}
+    for k in saved:
+        os.environ.pop(k, None)
+    tmp = tempfile.mkdtemp(prefix="bench_elastic_")
+
+    def build(world, ck, elastic_on=False):
+        os.environ["MXTPU_OPTIMIZER_AGGREGATION"] = "8"
+        os.environ["MXTPU_ZERO"] = "1"
+        os.environ["MXTPU_ZERO_WORLD"] = str(world)
+        os.environ.pop("MXTPU_ELASTIC", None)
+        if elastic_on:
+            os.environ["MXTPU_ELASTIC"] = "on"
+        mx.random.seed(0)
+        net = gluon.nn.Dense(2, in_units=3)
+        net.initialize(mx.init.Constant(0.5))
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.05, "momentum": 0.9},
+                           kvstore=kvs.create("local"))
+        rs = np.random.RandomState(0)
+        it = mxio.NDArrayIter(rs.rand(24, 3).astype(np.float32),
+                              rs.rand(24, 2).astype(np.float32),
+                              batch_size=4, shuffle=True, seed=7)
+        loss = lambda o, y: ((o - y) ** 2).mean()
+        return net, fit_mod.FitLoop(net, tr, loss, it, ckpt_dir=ck,
+                                    ckpt_every=100, async_ckpt=False,
+                                    heartbeat=False, seed=7)
+
+    try:
+        _, ref = build(to_world, os.path.join(tmp, "ref"))
+        res_ref = ref.fit(epochs=2)
+        ck = os.path.join(tmp, "ck")
+        chaos.install(f"resize@{resize_at}:{to_world}")
+        _, killed = build(from_world, ck)
+        resumable = False
+        try:
+            killed.fit(epochs=2)
+        except SystemExit as e:
+            resumable = (e.code == fit_mod.resumable_exit_code())
+        chaos.uninstall()
+        t0 = time.perf_counter()
+        _, resumed = build(to_world, ck, elastic_on=True)
+        res_b = resumed.fit(epochs=2)
+        resume_s = time.perf_counter() - t0
+        match = bool(
+            res_b.resumed_from == resize_at and
+            len(res_b.losses) == len(res_ref.losses) - resize_at and
+            np.allclose(res_b.losses, res_ref.losses[resize_at:],
+                        rtol=1e-6))
+    finally:
+        chaos.uninstall()
+        for k, v in saved.items():
+            os.environ.pop(k, None)
+            if v is not None:
+                os.environ[k] = v
+        shutil.rmtree(tmp, ignore_errors=True)
+    return {
+        "from_world": from_world,
+        "to_world": to_world,
+        "resize_step": resize_at,
+        "resumable_exit": resumable,
+        "resume_s": round(resume_s, 3),
+        "post_resize_steps": int(res_b.step - resize_at),
+        "trajectory_match": match,
+    }
+
+
 def _efficiency_probe(steps=6, batch=32, width=64):
     """The `efficiency` row: the MFU/goodput plane over a warmed
     smoke-MLP FitLoop — nonzero MFU from the XLA cost-model FLOPs of the
@@ -1155,6 +1239,13 @@ def _run_child(mode, args_rest):
                       flush=True)
             except Exception as e:
                 log(f"efficiency probe failed: {e}")
+        if os.environ.get("MXTPU_BENCH_ELASTIC", "1") != "0":
+            try:
+                elrow = _elastic_probe()
+                print("EXTRA_ROW " + json.dumps({"elastic": elrow}),
+                      flush=True)
+            except Exception as e:
+                log(f"elastic probe failed: {e}")
 
 
 # global wall-clock budget: the driver kills the whole bench at some
@@ -1382,6 +1473,12 @@ def main():
                 # programs, the top per-program movers, and the run
                 # report round-trip (the run_compare regression artifact)
                 payload["efficiency"] = _EXTRAS["efficiency"]
+            if "elastic" in _EXTRAS:
+                # the elastic-training evidence: a simulated mid-run
+                # resize (chaos resize@K, resumable exit) resumed at a
+                # different world — resume wall seconds and the
+                # post-resize trajectory-match verdict
+                payload["elastic"] = _EXTRAS["elastic"]
             # the train number is safe on stdout NOW; each optional row
             # that lands re-emits the extended line immediately, so a
             # truncated run keeps everything measured so far
@@ -1427,7 +1524,8 @@ def main():
                                    "MXTPU_BENCH_ZERO": "0",
                                    "MXTPU_BENCH_COMM_HEALTH": "0",
                                    "MXTPU_BENCH_NUMERICS": "0",
-                                   "MXTPU_BENCH_EFFICIENCY": "0"})
+                                   "MXTPU_BENCH_EFFICIENCY": "0",
+                                   "MXTPU_BENCH_ELASTIC": "0"})
                     if t8:
                         payload["train_int8_imgs_per_sec"] = round(t8, 2)
                         print(json.dumps(payload), flush=True)

@@ -282,6 +282,23 @@ env.declare("MXTPU_ZERO_WORLD", int, 0,
             "trajectory as a real N-rank group), so the parity/memory/"
             "chaos suites run the N-rank protocol on one CPU process. "
             "0/1 = no simulation; ignored when kvstore.num_workers > 1.")
+env.declare("MXTPU_ELASTIC", str, "off",
+            "Elastic world-size training (parallel/elastic.py): 'on' "
+            "lets fit.FitLoop resume a checkpoint whose recorded "
+            "topology names a DIFFERENT world size — the collective "
+            "group is re-formed through the coordination-service KV "
+            "store, the ZeRO-1 partition map is re-derived at the new "
+            "world (zero.partition is a pure function of order/shapes/"
+            "world), the seeded data-iterator position is re-split "
+            "across the new rank count from the checkpoint's global "
+            "sample position (no duplicated, no dropped sample), and "
+            "the per-fit comm-health/clock-sync state is reset so skew "
+            "tables never blend topologies. 'off' (default) makes a "
+            "cross-world resume raise elastic.TopologyMismatchError "
+            "instead of silently resuming mis-split; checkpoints whose "
+            "trainer states are NOT in the gather-on-save portable "
+            "format always raise across a world change. Unknown values "
+            "raise. Chaos 'resize@N[:M]' drives the kill half.")
 env.declare("MXTPU_COORDINATOR", str, "",
             "host:port of the jax.distributed coordinator; set per worker "
             "by tools/launch.py. Empty = single-process run "

@@ -18,6 +18,11 @@ Grammar: comma-separated events, each ``kind[:prob][@target]``:
   :class:`ChaosKilled` with nothing flushed (hook: ``fit.FitLoop``).
 - ``preempt@S`` — simulated TPU preemption at step ``S``: delivers SIGTERM
   to this process, exercising the graceful final-checkpoint exit path.
+- ``resize@S[:M]`` — elastic fleet resize at step ``S``: ``fit.FitLoop``
+  writes a final verified checkpoint whose topology record carries
+  ``resize_to: M`` (when given) and exits with the resumable code — the
+  relaunch harness resumes the run at world ``M`` through the elastic
+  path (``parallel/elastic.py``, ``MXTPU_ELASTIC=on``).
 - ``ckpt_corrupt@latest`` / ``ckpt_corrupt@S`` — flip bytes inside the
   ``params`` file of the next completed checkpoint (/ of checkpoint ``S``)
   *after* its DONE marker lands: a forged-complete corrupt checkpoint,
@@ -102,8 +107,8 @@ class ChaosKilled(MXNetError):
         self.step = step
 
 
-_KINDS = ("nan_grad", "inf_grad", "kill", "preempt", "ckpt_corrupt",
-          "kv_flake", "kv_slow", "kv_hang", "serve_slow",
+_KINDS = ("nan_grad", "inf_grad", "kill", "preempt", "resize",
+          "ckpt_corrupt", "kv_flake", "kv_slow", "kv_hang", "serve_slow",
           "registry_corrupt", "mem_pressure")
 
 
@@ -134,6 +139,7 @@ class ChaosPlan:
         self.serve_slow_ms = 0.0
         self._kv_hang: Dict[int, tuple] = {}  # step -> (rank, delay_ms)
         self._mem_pressure: Dict[int, int] = {}  # step -> budget bytes
+        self._resize: Dict[int, Optional[int]] = {}  # step -> world|None
         # observability: how many of each fault actually fired
         self.injected: Dict[str, int] = {k: 0 for k in _KINDS}
         for tok in (spec or "").split(","):
@@ -213,6 +219,27 @@ class ChaosPlan:
             if ms < 0:
                 raise MXNetError(f"chaos: kv_hang delay {ms} < 0")
             self._kv_hang[step] = (rank, ms)
+            return
+        if kind == "resize":
+            # resize@S[:M] — kill-with-resumable-exit at step S; the
+            # optional M stamps the target world into the checkpoint's
+            # topology record for the relaunch harness
+            if prob is not None:
+                raise MXNetError("chaos: resize takes no probability")
+            if target is None:
+                raise MXNetError("chaos: resize needs a step target, "
+                                 "e.g. resize@5 or resize@5:3")
+            step_s, _, world_s = target.partition(":")
+            try:
+                step = int(step_s)
+                world = int(world_s) if world_s else None
+            except ValueError:
+                raise MXNetError(
+                    f"chaos: bad resize target {target!r} "
+                    "(expected STEP or STEP:WORLD)")
+            if world is not None and world < 1:
+                raise MXNetError(f"chaos: resize world {world} < 1")
+            self._resize[step] = world
             return
         if kind == "mem_pressure":
             # mem_pressure@N[:BYTES] — synthetic budget shrink at step N:
@@ -319,6 +346,18 @@ class ChaosPlan:
         fault the chaos test exists to exercise."""
         return (int(step) in self._at["nan_grad"] or
                 int(step) in self._at["inf_grad"])
+
+    def resize_target(self) -> Optional[Dict[str, Optional[int]]]:
+        """resize@S[:M] — ``{"world": M or None}`` when a resize is
+        scheduled at the current step, else None. Consumed on read
+        (fires once); ``fit.FitLoop`` writes the final checkpoint with
+        ``resize_to`` in its topology record and exits resumable."""
+        if self._step is None or self._step not in self._resize:
+            return None
+        world = self._resize.pop(self._step)
+        self.injected["resize"] += 1
+        _count_injection("resize")
+        return {"world": world}
 
     def mem_pressure_bytes(self) -> Optional[int]:
         """mem_pressure@N[:BYTES] — the synthetic memory budget for the

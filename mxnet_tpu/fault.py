@@ -415,7 +415,7 @@ class CheckpointManager:
         return bad
 
     def restore(self, step: int, net=None, trainer=None,
-                allow_missing: bool = False
+                allow_missing: bool = False, meta_check=None
                 ) -> Tuple[int, Dict[str, "nd.NDArray"], dict]:
         """Load checkpoint ``step`` (content-verified against its
         manifest); when ``net``/``trainer`` are given, their
@@ -424,7 +424,16 @@ class CheckpointManager:
         The net restore is strict in BOTH directions: checkpoint keys
         missing from the net raise, and net parameters absent from the
         checkpoint raise too (they would silently keep their current
-        values) — pass ``allow_missing=True` to opt out of the latter."""
+        values) — pass ``allow_missing=True` to opt out of the latter.
+
+        ``meta_check``: optional callable run on the parsed ``meta.json``
+        BEFORE any parameter or optimizer state touches the net/trainer —
+        the elastic-resume topology gate (``parallel/elastic.py``): a
+        checkpoint whose recorded world is incompatible with this
+        process must raise here, never load as the wrong shard. Its
+        exceptions propagate verbatim (an incompatible checkpoint is not
+        a corrupt one — ``restore_latest`` quarantines only the
+        latter)."""
         self.wait()  # fence pending async writes
         path = self._ckpt_dir(step)
         self.verify(step)  # typed CheckpointCorruptError on missing/bad
@@ -439,6 +448,8 @@ class CheckpointManager:
             # surface it as corruption so restore_latest quarantines it
             raise CheckpointCorruptError(
                 f"checkpoint {step}: payload unreadable: {e}") from e
+        if meta_check is not None:
+            meta_check(meta)
         if net is not None:
             # structural names first (instance-independent, the save(net=)
             # format), falling back to collect_params naming; unmatched
@@ -475,19 +486,23 @@ class CheckpointManager:
         return int(meta["step"]), params, meta
 
     def restore_latest(self, net=None, trainer=None,
-                       allow_missing: bool = False
+                       allow_missing: bool = False, meta_check=None
                        ) -> Optional[Tuple[int, Dict, dict]]:
         """Resume point for restart-based recovery: returns None on a
         fresh start, else (step, params, meta) of the newest checkpoint
         that passes content verification (optionally loading net/trainer
         in place). Corrupt/incomplete checkpoints are quarantined
         (renamed ``ckpt-<step>.bad``) and the next-newest is tried —
-        a truncated latest never takes down recovery."""
+        a truncated latest never takes down recovery. A ``meta_check``
+        raise (topology-incompatible, see :meth:`restore`) propagates —
+        an intact checkpoint this process must not load is an operator
+        decision, not a fall-back-and-quarantine."""
         self.wait()
         for step in reversed(self._steps_nowait()):
             try:
                 return self.restore(step, net=net, trainer=trainer,
-                                    allow_missing=allow_missing)
+                                    allow_missing=allow_missing,
+                                    meta_check=meta_check)
             except CheckpointCorruptError as e:
                 self._quarantine(step, str(e))
         return None

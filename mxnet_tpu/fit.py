@@ -37,6 +37,7 @@ from .base import MXNetError, check, env
 from .log import get_logger
 from . import fault
 from .contrib import chaos as _chaos
+from .parallel import elastic as _elastic
 from .telemetry import autotune as _autotune
 from .telemetry import collective as _collective
 from .telemetry import efficiency as _efficiency
@@ -82,6 +83,9 @@ class FitResult:
     # (MXTPU_EFFICIENCY / MXTPU_DEVICE_PEAK)
     run_report: Optional[str] = None  # path of the persistent run
     # report written at fit end (MXTPU_RUN_REPORT_DIR; None = off)
+    elastic: Optional[dict] = None  # elastic-resume summary when this
+    # run resumed across a world-size change (MXTPU_ELASTIC=on):
+    # from_world/world/rank/members and the checkpoint's resize_to
 
 
 class FitLoop:
@@ -171,11 +175,19 @@ class FitLoop:
 
     # -- checkpoint helpers ---------------------------------------------
     def _save(self, cm: "fault.CheckpointManager", step: int, epoch: int,
-              batches_in_epoch: int) -> None:
+              batches_in_epoch: int,
+              resize_to: Optional[int] = None) -> None:
         extra = {"data_state": {"epoch": int(epoch),
                                 "batch": int(batches_in_epoch),
                                 "seed": self._seed},
-                 "loss_scale": self._loss_scale}
+                 "loss_scale": self._loss_scale,
+                 # topology record (parallel/elastic.py): world/rank,
+                 # data-shard layout and the world-independent global
+                 # sample position — what a resume at a DIFFERENT world
+                 # size re-splits from
+                 "topology": _elastic.topology_record(
+                     self._trainer, self._iter,
+                     batches=batches_in_epoch, resize_to=resize_to)}
         cm.save(step, net=self._net, trainer=self._trainer, extra=extra)
 
     def _grads_finite_flag(self):
@@ -214,12 +226,25 @@ class FitLoop:
         except Exception as e:
             _LOG.warning("numerics record failed: %s", e)
 
-    def _position_iter(self, epoch: int) -> None:
+    def _position_iter(self, epoch: int, skip_batches: int = 0) -> int:
+        """Position the iterator at (epoch, skip_batches). Iterators
+        with ``set_position`` (NDArrayIter) land there in O(1) — the
+        elastic-resume fast-forward — and the count is returned as
+        already-consumed; others are set to the epoch start and the
+        caller fetch-replays the skip (return 0)."""
+        setpos = getattr(self._iter, "set_position", None)
+        if skip_batches and setpos is not None:
+            stride = int(getattr(self._iter, "num_parts", 1) or 1) * \
+                int(getattr(self._iter, "batch_size", 0) or 0)
+            if stride > 0:
+                setpos(epoch, skip_batches * stride)
+                return int(skip_batches)
         set_epoch = getattr(self._iter, "set_epoch", None)
         if set_epoch is not None:
             set_epoch(epoch)
         else:
             self._iter.reset()
+        return 0
 
     # -- the loop -------------------------------------------------------
     def fit(self, epochs: int, batch_size: Optional[int] = None,
@@ -238,8 +263,35 @@ class FitLoop:
                            loss_scale=self._loss_scale)
         start_epoch, skip_batches = 0, 0
         if cm is not None and resume:
+            # the topology gate runs INSIDE restore, before any state is
+            # loaded: an incompatible checkpoint (non-portable shards at
+            # a new world, or a world change without MXTPU_ELASTIC=on)
+            # raises TopologyMismatchError instead of silently loading
+            # the wrong shard (parallel/elastic.py)
+            gate: dict = {}
+
+            def _topo_gate(meta):
+                topo = meta.get("topology")
+                if not topo:
+                    gate.clear()  # legacy checkpoint: nothing to compare
+                    return
+                cur = _elastic.current_topology(self._trainer,
+                                                self._iter)
+                resized = _elastic.check_restore(topo, cur)
+                # validate the data re-split HERE too — a position that
+                # cannot split over the new layout must raise before any
+                # parameter/optimizer state loads (and before the resize
+                # re-forms the group or resets the comm planes)
+                skip = _elastic.resplit_batches(
+                    topo, cur,
+                    int((meta.get("data_state") or {}).get("batch", 0)))
+                # restore_latest may fall back across checkpoints: the
+                # surviving (last) call's verdict is the one acted on
+                gate.update(topo=topo, cur=cur, resized=resized,
+                            skip=skip)
             restored = cm.restore_latest(net=self._net,
-                                         trainer=self._trainer)
+                                         trainer=self._trainer,
+                                         meta_check=_topo_gate)
             if restored is not None:
                 step, _, meta = restored
                 result.step = step
@@ -249,6 +301,28 @@ class FitLoop:
                 skip_batches = int(ds.get("batch", 0))
                 self._loss_scale = float(
                     meta.get("loss_scale", self._loss_scale))
+                if gate:
+                    # the re-split the gate validated: the recorded
+                    # GLOBAL sample position over the CURRENT layout —
+                    # a layout-only change (same world, new num_parts /
+                    # per-rank batch size) repositions too; unchanged
+                    # layouts pass the restored count straight through
+                    skip_batches = int(gate["skip"])
+                if gate.get("resized"):
+                    # elastic resume: re-form the group and reset the
+                    # comm planes (skew tables must not blend
+                    # topologies) — the trainer states already restored
+                    # through the topology-portable format, and
+                    # zero.partition re-derives the new shard map for
+                    # free at the first allreduce
+                    topo, cur = gate["topo"], gate["cur"]
+                    result.elastic = _elastic.begin_resize(topo, cur)
+                    _LOG.warning(
+                        "elastic resume: world %s -> %s (rank %d): "
+                        "group re-formed, data re-split to %d local "
+                        "batches at epoch %d",
+                        topo.get("world"), cur["world"], cur["rank"],
+                        skip_batches, start_epoch)
                 _LOG.warning("resuming from checkpoint step %d "
                              "(epoch %d, %d batches consumed)",
                              step, start_epoch, skip_batches)
@@ -337,8 +411,10 @@ class FitLoop:
                     _LOG.warning("comm-health clock sync failed: %s", e)
         try:
             for epoch in range(start_epoch, epochs):
-                self._position_iter(epoch)
-                consumed = 0
+                # direct positioning consumes the skip in O(1) when the
+                # iterator supports it; otherwise consumed starts at 0
+                # and the loop below fetch-replays skip_batches batches
+                consumed = self._position_iter(epoch, skip_batches)
                 data_it = iter(self._iter)
                 while True:
                     if bd is not None:
@@ -363,6 +439,14 @@ class FitLoop:
                     if plan is not None:
                         plan.begin_step(result.step)
                         plan.maybe_kill()  # ChaosKilled propagates (abrupt)
+                        rz = plan.resize_target()
+                        if rz is not None:
+                            # resize@N[:M]: graceful kill with a
+                            # resumable exit — the final checkpoint's
+                            # topology record carries the target world
+                            # for the relaunch harness
+                            self._final_resize(cm, result, epoch,
+                                               consumed, rz["world"])
                     # numerics sampling clock (one cached flag check off)
                     _numerics.mark_step(result.step)
                     if self._preempted is not None:
@@ -644,6 +728,25 @@ class FitLoop:
             except Exception as e:
                 _LOG.warning("run report failed: %s", e)
         return result
+
+    def _final_resize(self, cm, result: FitResult, epoch: int,
+                      consumed: int, to_world: Optional[int]) -> None:
+        """Chaos ``resize@N[:M]`` path: final verified checkpoint whose
+        topology record names the target world, then the resumable exit
+        — the same contract as preemption, but the relauncher is TOLD to
+        come back at a different size. Under a real group every rank's
+        plan fires at the same step, so the (collective) gather-on-save
+        checkpoint stays in lockstep."""
+        check(cm is not None,
+              "chaos resize@ needs a checkpoint dir: with ckpt_dir=None "
+              "there is nothing for the resized relaunch to resume")
+        self._restore_handlers()
+        self._save(cm, result.step, epoch, consumed, resize_to=to_world)
+        cm.wait()  # the final write must hit disk before we die
+        _LOG.warning("resize: wrote final checkpoint at step %d "
+                     "(resize_to=%s), exiting resumable",
+                     result.step, to_world)
+        sys.exit(resumable_exit_code())
 
     def _final_exit(self, cm, result: FitResult, epoch: int,
                     consumed: int) -> None:

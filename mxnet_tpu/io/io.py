@@ -122,11 +122,21 @@ def _init_data(data, allow_empty, default_name):
 
 
 class NDArrayIter(DataIter):
-    """In-memory iterator (ref: python/mxnet/io.py NDArrayIter)."""
+    """In-memory iterator (ref: python/mxnet/io.py NDArrayIter).
+
+    ``num_parts``/``part_index`` shard the stream across a worker group
+    (the reference's ImageRecordIter partition knobs): with ``P`` parts,
+    rank ``r``'s local batch ``t`` is GLOBAL batch ``t*P + r`` of the one
+    seeded (seed, epoch) order, so the union of all ranks' streams is
+    exactly the unsharded stream — the invariant elastic resume
+    (``parallel/elastic.py``) re-splits across a new rank count. Sharded
+    epochs keep every rank's batch count equal by discarding the ragged
+    tail that cannot fill a whole ``P``-batch group."""
 
     def __init__(self, data, label=None, batch_size=1, shuffle=False,
                  last_batch_handle="pad", data_name="data",
-                 label_name="softmax_label", seed=None):
+                 label_name="softmax_label", seed=None,
+                 num_parts=1, part_index=0):
         super().__init__(batch_size)
         self.data = _init_data(data, False, data_name)
         self.label = _init_data(label, True, label_name)
@@ -134,6 +144,11 @@ class NDArrayIter(DataIter):
         self.shuffle = shuffle
         self.last_batch_handle = last_batch_handle
         self.cursor = -batch_size
+        check(num_parts >= 1, "num_parts must be >= 1")
+        check(0 <= part_index < num_parts,
+              f"part_index {part_index} outside [0, {num_parts})")
+        self.num_parts = int(num_parts)
+        self.part_index = int(part_index)
         # seed makes the shuffle order a pure function of (seed, epoch):
         # a killed-and-resumed run (fit.FitLoop) replays the exact batch
         # sequence instead of reshuffling from the global RNG's new state
@@ -146,7 +161,11 @@ class NDArrayIter(DataIter):
                     self.num_data)
             else:
                 _np.random.shuffle(self._order)
-        if last_batch_handle == "discard":
+        if self.num_parts > 1:
+            # whole global groups only: every rank sees the same local
+            # count (a collective step loop must never desync on data)
+            self.num_batches = self.num_data // (batch_size * self.num_parts)
+        elif last_batch_handle == "discard":
             self.num_batches = self.num_data // batch_size
         else:
             self.num_batches = (self.num_data + batch_size - 1) // batch_size
@@ -185,16 +204,44 @@ class NDArrayIter(DataIter):
             self._order = _np.random.RandomState(
                 self._seed + self._epoch).permutation(self.num_data)
 
+    def set_position(self, epoch, global_samples):
+        """Deterministically position THIS shard at the global sample
+        offset ``global_samples`` of ``epoch``'s seeded order — the
+        elastic-resume fast-forward: a run killed at world N re-splits
+        its recorded global position across M new ranks, each landing on
+        its own slice with no overlap and no gap. The offset must fall
+        on a global batch-group boundary (``num_parts * batch_size``)."""
+        stride = self.num_parts * self.batch_size
+        check(int(global_samples) % stride == 0,
+              f"set_position: global sample offset {global_samples} is "
+              f"not a multiple of num_parts*batch_size = {stride} — a "
+              "mid-group position cannot be split without duplicating "
+              "or dropping samples")
+        self.set_epoch(epoch)
+        self.cursor += (int(global_samples) // stride) * self.batch_size
+
     def iter_next(self):
         self.cursor += self.batch_size
+        if self.num_parts > 1:
+            # local batch t is valid only while its WHOLE global group
+            # [t*P, (t+1)*P) of batches fits — the ragged tail is
+            # discarded uniformly so every rank steps the same count
+            t = self.cursor // self.batch_size
+            return (t + 1) * self.num_parts * self.batch_size \
+                <= self.num_data
         if self.last_batch_handle == "discard":
             return self.cursor + self.batch_size <= self.num_data
         return self.cursor < self.num_data
 
     def _slice(self, arrays):
+        start = self.cursor
+        if self.num_parts > 1:
+            # global batch index of this shard's local batch t
+            t = self.cursor // self.batch_size
+            start = (t * self.num_parts + self.part_index) * self.batch_size
         out = []
         for k, v in arrays:
-            idx = self._order[self.cursor:self.cursor + self.batch_size]
+            idx = self._order[start:start + self.batch_size]
             part = v[idx]
             if part.shape[0] < self.batch_size:  # pad with wraparound
                 extra = self.batch_size - part.shape[0]
@@ -210,6 +257,8 @@ class NDArrayIter(DataIter):
         return self._slice(self.label)
 
     def getpad(self):
+        if self.num_parts > 1:
+            return 0  # sharded epochs discard the ragged tail, never pad
         if self.last_batch_handle == "pad" and \
                 self.cursor + self.batch_size > self.num_data:
             return self.cursor + self.batch_size - self.num_data

@@ -18,7 +18,8 @@ from ..base import MXNetError, check
 __all__ = ["allreduce", "allgather", "reduce_scatter", "broadcast",
            "ppermute_ring", "all_to_all", "barrier", "device_allreduce",
            "measure_allreduce_bandwidth", "cross_process_reduce_scatter",
-           "cross_process_exchange_bytes", "cross_process_allgather_object"]
+           "cross_process_exchange_bytes", "cross_process_allgather_object",
+           "cross_process_reform"]
 
 
 def _jax():
@@ -324,6 +325,34 @@ def cross_process_allgather_object(obj, tag_prefix: str = "obj"):
     blobs = cross_process_exchange_bytes(
         pickle.dumps(obj), f"{tag_prefix}{next(_coord_seq)}")
     return [pickle.loads(b) for b in blobs]
+
+
+def cross_process_reform(tag: str, expect: Optional[int] = None):
+    """Membership rendezvous for elastic resume (``parallel/elastic.py``):
+    every process publishes a ``{rank, pid, host}`` record through the
+    jax.distributed coordination-service KV store and reads the full
+    roster back — the exchange's barrier IS the group re-formation, the
+    same KV-store path every CPU-backend collective already rides (and
+    the ps-lite elastic-membership analog, PAPER.md §KVStore). Returns
+    the roster sorted by rank. A member that never launched blocks the
+    exchange until its bounded get times out — that is the transport's
+    own failure mode, and ranks are ``jax.process_index()`` over
+    ``process_count()``, so a completed exchange is contiguous by
+    construction. What this call ADDS is the ``expect`` validation: a
+    group re-formed at the wrong size (checkpoint world vs live process
+    count drift) must fail loudly at resume, not at the first training
+    collective."""
+    import os
+    import socket
+    import jax
+    rec = {"rank": int(jax.process_index()), "pid": os.getpid(),
+           "host": socket.gethostname()}
+    roster = cross_process_allgather_object(rec, tag_prefix=f"rf_{tag}_")
+    if expect is not None:
+        check(len(roster) == int(expect),
+              f"cross_process_reform: {len(roster)} member(s) joined but "
+              f"the resume expects world {expect}")
+    return sorted(roster, key=lambda m: int(m["rank"]))
 
 
 def device_allreduce(arrays, mesh, axis: str = "dp", op: str = "sum"):

@@ -165,6 +165,14 @@ def _env_fingerprint() -> Dict[str, Any]:
         fp["device_count"] = jax.device_count()
     except Exception:
         pass
+    # topology identity: the (real or simulated) world size this run
+    # trained at — run_compare flags a cross-topology comparison instead
+    # of silently diffing an N-rank run against an M-rank one
+    try:
+        from ..parallel import elastic as _elastic
+        fp["world_size"] = _elastic.world_for_fingerprint()
+    except Exception:
+        pass
     return fp
 
 

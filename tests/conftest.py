@@ -69,6 +69,14 @@ def pytest_configure(config):
         "Monitor facade). Tier-1-safe: CPU, in-process, bitwise "
         "on-vs-off parity pinned.")
     config.addinivalue_line(
+        "markers", "elastic: elastic world-size training tests "
+        "(parallel/elastic.py topology records, resize@N[:M] chaos, "
+        "cross-world resume with re-formed group + re-split data, "
+        "NDArrayIter num_parts sharding union proofs). Tier-1-safe: "
+        "CPU, simulated worlds in-process; the real 2->3-process drill "
+        "is a subprocess on the coordination-service fallback, same "
+        "harness as test_dist_kvstore.")
+    config.addinivalue_line(
         "markers", "efficiency: efficiency/goodput plane tests "
         "(telemetry/efficiency.py per-program FLOP/byte cost registry "
         "+ live MFU/roofline rollup, telemetry/run_report.py run "

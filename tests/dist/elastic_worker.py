@@ -1,0 +1,132 @@
+"""Multi-process elastic-resize worker (run via tools/launch.py local,
+driven 2->3 by tests/test_elastic.py):
+
+Phase ``pre`` (ELASTIC_PHASE=pre, world 2): each rank trains a sharded
+seeded stream (``NDArrayIter(num_parts=2, part_index=rank)``) under
+ZeRO-1 + dist_sync until the chaos plan ``resize@K:3`` fires — the final
+verified checkpoint (topology record + ``resize_to=3``) lands and
+FitLoop exits with the resumable code, which this harness asserts and
+converts to a clean exit after printing the rank's consumed sample ids.
+
+Phase ``post`` (ELASTIC_PHASE=post, world 3, MXTPU_ELASTIC=on): the
+relaunched ranks (one brand new) each resume from the checkpoint — the
+collective group re-forms through the coordination-service KV store, the
+ZeRO partition re-derives at world 3, and the recorded global sample
+position re-splits across 3 ranks. Each rank prints its post-resize
+losses (local sum-loss: the controller sums ranks per step and compares
+against an in-process never-resized reference), final weights, and
+consumed ids — the controller proves union-equals-no-resize-stream with
+zero duplicated and zero dropped samples.
+
+The per-rank batch is ``G/world`` with the GLOBAL batch ``G`` fixed and
+a sum-reduction loss, so the update is ``(1/G)·Σ∇`` at any world — the
+trajectory is world-independent (allclose across regroupings)."""
+import json
+import os
+import shutil
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+N, G, SEED, RESIZE_AT, EPOCHS = 48, 12, 7, 3, 2
+
+
+def make_data():
+    """Deterministic, id-traceable: feature column 0 IS sample_id/N."""
+    rs = np.random.RandomState(42)
+    X = rs.rand(N, 3).astype(np.float32)
+    X[:, 0] = np.arange(N, dtype=np.float32) / N
+    Y = rs.rand(N, 1).astype(np.float32)
+    return X, Y
+
+
+def batch_ids(arr):
+    return [int(round(float(v) * N)) for v in arr[:, 0]]
+
+
+def main():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "..", ".."))
+    from mxnet_tpu.kvstore_server import init_distributed
+    assert init_distributed(), "MXTPU_* env missing (run via tools/launch.py)"
+    import mxnet_tpu as mx
+    from mxnet_tpu import fit, gluon, io
+    from mxnet_tpu import kvstore as kvs
+
+    phase = os.environ["ELASTIC_PHASE"]
+    out_dir = os.environ["ELASTIC_OUT_DIR"]
+    kv = kvs.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+    b = G // nw
+
+    ck = os.path.join(out_dir, f"ckpt_r{rank}")
+    if phase == "post" and not os.path.isdir(ck):
+        # the relaunch harness seeds a brand-new rank's checkpoint dir
+        # from rank 0's — every rank's checkpoint is identical (params
+        # replicated, trainer states gathered-on-save)
+        shutil.copytree(os.path.join(out_dir, "ckpt_r0"), ck)
+
+    X, Y = make_data()
+    seen = []
+
+    class RecordingIter(io.NDArrayIter):
+        def getdata(self):
+            out = super().getdata()
+            seen.append(batch_ids(out[0].asnumpy()))
+            return out
+
+    it = RecordingIter(X, Y, batch_size=b, shuffle=True, seed=SEED,
+                       num_parts=nw, part_index=rank)
+    mx.random.seed(0)
+    net = gluon.nn.Dense(1, in_units=3)
+    net.initialize(mx.init.Constant(0.25))
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05, "momentum": 0.9},
+                       kvstore=kv)
+    loss = lambda out, y: ((out - y) ** 2).sum()
+    loop = fit.FitLoop(net, tr, loss, it, ckpt_dir=ck, ckpt_every=100,
+                       async_ckpt=False, heartbeat=False, seed=SEED)
+
+    if phase == "pre":
+        try:
+            loop.fit(epochs=EPOCHS, batch_size=G)
+            raise AssertionError("resize chaos never fired")
+        except SystemExit as e:
+            assert e.code == fit.resumable_exit_code() == 75, e.code
+        # trained ids = the RESIZE_AT fully-trained local batches (the
+        # final fetched batch was never trained; the resume refetches it)
+        print("ELASTIC_PRE " + json.dumps(
+            {"rank": rank, "world": nw,
+             "trained_ids": seen[:RESIZE_AT]}), flush=True)
+        sys.stdout.flush()
+        os._exit(0)
+
+    assert phase == "post", phase
+    res = loop.fit(epochs=EPOCHS, batch_size=G)
+    assert res.resumed_from == RESIZE_AT, res.resumed_from
+    assert res.elastic is not None, "elastic resume not detected"
+    assert res.elastic["from_world"] == 2 and res.elastic["world"] == nw
+    assert res.elastic["members"] == list(range(nw))
+    assert res.elastic["resize_to"] == nw
+    assert res.zero and res.zero["world"] == nw
+    # the ZeRO partition re-derived at world 3: this rank holds exactly
+    # its new shard's optimizer state (1/N residency after the resize)
+    plane = tr._zero
+    assert set(tr._updaters[0].states) == plane.local_indices(), \
+        (rank, set(tr._updaters[0].states), plane.local_indices())
+    # re-split fast-forward is O(1) (NDArrayIter.set_position): every
+    # fetched batch after the resume is a trained one
+    print("ELASTIC_POST " + json.dumps(
+        {"rank": rank, "world": nw, "step": res.step,
+         "losses": res.losses,
+         "trained_ids": seen,
+         "weight": net.weight.data().asnumpy().ravel().tolist(),
+         "elastic": res.elastic}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -119,6 +119,16 @@ def test_bench_tiny_deadline_emits_full_headline_json():
     assert erow["estimate"] is True  # CPU child, defaulted peak table
     assert erow["report_ok"] is True
     assert erow["report_steps"] > 0
+    # the elastic row: a simulated mid-run resize (chaos resize@K,
+    # resumable exit 75) resumed at a different world must reproduce the
+    # always-at-new-size trajectory — the ROADMAP acceptance bar,
+    # re-measured with every artifact
+    elrow = payload["elastic"]
+    assert elrow["from_world"] == 2 and elrow["to_world"] == 3
+    assert elrow["resumable_exit"] is True
+    assert elrow["resume_s"] > 0
+    assert elrow["post_resize_steps"] > 0
+    assert elrow["trajectory_match"] is True
 
 
 def test_bench_exhausted_deadline_still_emits_parseable_row():

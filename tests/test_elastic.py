@@ -1,0 +1,547 @@
+"""Elastic world-size training (parallel/elastic.py): topology records
+in every checkpoint, resize@N[:M] chaos, cross-world resume (re-formed
+group, re-derived ZeRO partition, re-split seeded data stream, reset
+comm state), named-error raise paths, and the NDArrayIter shard-union
+proofs — no duplicated, no dropped sample across 1→2, 2→3 and 4→2.
+
+Marker ``elastic`` (tier-1-safe: CPU, simulated worlds in-process; the
+real 2↔3-process drill lives in tests/dist/elastic_worker.py)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import fault, fit, gluon, io, nd
+from mxnet_tpu import kvstore as kvs
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.contrib import chaos
+from mxnet_tpu.parallel import elastic
+
+pytestmark = pytest.mark.elastic
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ----------------------------------------------------------- env parsing
+
+def test_elastic_flag_strict_parse(monkeypatch):
+    monkeypatch.delenv("MXTPU_ELASTIC", raising=False)
+    assert elastic.elastic_enabled() is False
+    for v in ("on", "1", "true"):
+        monkeypatch.setenv("MXTPU_ELASTIC", v)
+        assert elastic.elastic_enabled() is True
+    for v in ("off", "0", "false", ""):
+        monkeypatch.setenv("MXTPU_ELASTIC", v)
+        assert elastic.elastic_enabled() is False
+    monkeypatch.setenv("MXTPU_ELASTIC", "yolo")
+    with pytest.raises(MXNetError, match="MXTPU_ELASTIC"):
+        elastic.elastic_enabled()
+
+
+def test_resize_grammar():
+    plan = chaos.ChaosPlan("resize@5:3")
+    assert plan._resize == {5: 3}
+    plan = chaos.ChaosPlan("resize@7")
+    assert plan._resize == {7: None}
+    for bad in ("resize", "resize@x", "resize@5:0", "resize@5:x",
+                "resize:0.5@5"):
+        with pytest.raises(MXNetError):
+            chaos.ChaosPlan(bad)
+
+
+def test_resize_target_consume_once():
+    plan = chaos.ChaosPlan("resize@2:4")
+    plan.begin_step(1)
+    assert plan.resize_target() is None
+    plan.begin_step(2)
+    assert plan.resize_target() == {"world": 4}
+    assert plan.resize_target() is None  # consumed
+    assert plan.injected["resize"] == 1
+
+
+# ------------------------------------------------- NDArrayIter sharding
+
+def _id_data(n):
+    """Feature value IS the sample id — batches become traceable."""
+    return np.arange(n, dtype=np.float32).reshape(n, 1)
+
+
+def _ids(batch):
+    return batch.data[0].asnumpy().ravel().astype(int).tolist()
+
+
+def test_ndarrayiter_shard_basics():
+    n, G, P = 48, 12, 3
+    b = G // P
+    its = [io.NDArrayIter(_id_data(n), batch_size=b, shuffle=True,
+                          seed=9, num_parts=P, part_index=r)
+           for r in range(P)]
+    ref = io.NDArrayIter(_id_data(n), batch_size=G, shuffle=True, seed=9)
+    ref_steps = [_ids(bt) for bt in ref]
+    streams = [[_ids(bt) for bt in it] for it in its]
+    # every rank steps the same count (no desync on data), and the
+    # rank-order concatenation of each local step IS the unsharded
+    # global batch, elementwise
+    assert len({len(s) for s in streams}) == 1
+    assert len(streams[0]) == len(ref_steps) == n // G
+    for t, window in enumerate(ref_steps):
+        got = sum((streams[r][t] for r in range(P)), [])
+        assert got == window
+    for it in its:
+        assert it.getpad() == 0
+    with pytest.raises(MXNetError):
+        io.NDArrayIter(_id_data(8), batch_size=2, num_parts=2,
+                       part_index=2)
+
+
+def test_ndarrayiter_world1_unchanged():
+    """num_parts=1 must be byte-identical to the historical iterator."""
+    a = io.NDArrayIter(_id_data(10), batch_size=4, shuffle=True, seed=3)
+    b = io.NDArrayIter(_id_data(10), batch_size=4, shuffle=True, seed=3,
+                       num_parts=1, part_index=0)
+    sa = [( _ids(x), x.pad) for x in a]
+    sb = [( _ids(x), x.pad) for x in b]
+    assert sa == sb and sa[-1][1] == 2  # wraparound pad preserved
+
+
+def test_set_position_rejects_midgroup_offset():
+    it = io.NDArrayIter(_id_data(48), batch_size=4, shuffle=True, seed=1,
+                        num_parts=3, part_index=0)
+    with pytest.raises(MXNetError, match="set_position"):
+        it.set_position(0, 10)  # not a multiple of 12
+    it.set_position(0, 24)  # group boundary: fine
+    assert _ids(next(it)) == _ids_of_order(48, 1, 0)[24:28]
+
+
+def _ids_of_order(n, seed, epoch):
+    return np.random.RandomState(seed + epoch).permutation(n).tolist()
+
+
+@pytest.mark.parametrize("w_from,w_to", [(1, 2), (2, 3), (4, 2)])
+def test_iter_resplit_union_exact(w_from, w_to):
+    """THE re-split proof: k global steps at world N, then the recorded
+    global position re-split across world M — the union of every rank's
+    stream (pre + post) equals the never-resized stream exactly: zero
+    duplicated, zero dropped samples."""
+    n, G, k, seed = 48, 12, 2, 11
+    order = _ids_of_order(n, seed, 0)
+    pre, post = [], []
+    for r in range(w_from):
+        it = io.NDArrayIter(_id_data(n), batch_size=G // w_from,
+                            shuffle=True, seed=seed,
+                            num_parts=w_from, part_index=r)
+        for _t in range(k):
+            pre.append(_ids(next(it)))
+    for r in range(w_to):
+        it = io.NDArrayIter(_id_data(n), batch_size=G // w_to,
+                            shuffle=True, seed=seed,
+                            num_parts=w_to, part_index=r)
+        it.set_position(0, k * G)  # the checkpointed global position
+        post.append([_ids(bt) for bt in it])
+    consumed = sum(pre, []) + sum((sum(s, []) for s in post), [])
+    # multiset equality with the full no-resize stream: exact coverage
+    assert sorted(consumed) == sorted(order)
+    assert len(consumed) == len(set(consumed)) == n
+    # and the pre-resize half is exactly the stream's first k*G samples
+    assert sorted(sum(pre, [])) == sorted(order[:k * G])
+
+
+def test_resplit_batches_math():
+    topo = {"num_parts": 2, "batch_size": 6, "global_samples": 24}
+    cur = {"num_parts": 3, "batch_size": 4}
+    assert elastic.resplit_batches(topo, cur, restored_batches=2) == 2
+    # unchanged layout: the restored local count passes through
+    same = {"num_parts": 2, "batch_size": 6, "global_samples": 24}
+    assert elastic.resplit_batches(
+        same, {"num_parts": 2, "batch_size": 6}, 2) == 2
+    # a position that does not split over the new stride raises
+    bad = {"num_parts": 2, "batch_size": 5, "global_samples": 10}
+    with pytest.raises(elastic.TopologyMismatchError, match="split"):
+        elastic.resplit_batches(bad, {"num_parts": 3, "batch_size": 4}, 1)
+    with pytest.raises(elastic.TopologyMismatchError, match="no global"):
+        elastic.resplit_batches({"num_parts": 2, "batch_size": 6},
+                                {"num_parts": 3, "batch_size": 4}, 1)
+
+
+# ------------------------------------------------------ fit-chain pieces
+
+def _zero_env(monkeypatch, world, elastic_on=False):
+    monkeypatch.setenv("MXTPU_OPTIMIZER_AGGREGATION", "8")
+    if world:
+        monkeypatch.setenv("MXTPU_ZERO", "1")
+        monkeypatch.setenv("MXTPU_ZERO_WORLD", str(world))
+    else:
+        monkeypatch.delenv("MXTPU_ZERO", raising=False)
+        monkeypatch.delenv("MXTPU_ZERO_WORLD", raising=False)
+    if elastic_on:
+        monkeypatch.setenv("MXTPU_ELASTIC", "on")
+    else:
+        monkeypatch.delenv("MXTPU_ELASTIC", raising=False)
+
+
+def _build(monkeypatch, world, ckpt_dir, elastic_on=False):
+    """Deterministic momentum-SGD FitLoop under simulated-world ZeRO
+    (the test_zero kill/resume recipe, grown a world knob)."""
+    _zero_env(monkeypatch, world, elastic_on)
+    mx.random.seed(0)
+    net = gluon.nn.Dense(2, in_units=3)
+    net.initialize(mx.init.Constant(0.5))
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05, "momentum": 0.9},
+                       kvstore=kvs.create("local"))
+    rs = np.random.RandomState(0)
+    it = io.NDArrayIter(rs.rand(24, 3).astype(np.float32),
+                        rs.rand(24, 2).astype(np.float32), batch_size=4,
+                        shuffle=True, seed=7)
+    loss = lambda out, y: ((out - y) ** 2).mean()
+    return net, fit.FitLoop(net, tr, loss, it, ckpt_dir=ckpt_dir,
+                            ckpt_every=2, async_ckpt=False,
+                            heartbeat=False, seed=7)
+
+
+def test_topology_record_in_meta(monkeypatch, tmp_path):
+    """Every checkpoint's meta.json carries the topology record: world,
+    shard layout, the world-independent global sample position, and the
+    portable-states marker."""
+    ck = str(tmp_path / "ck")
+    _, loop = _build(monkeypatch, 2, ck)
+    loop.fit(epochs=1)
+    with open(os.path.join(ck, "ckpt-4", "meta.json")) as f:
+        meta = json.load(f)
+    topo = meta["topology"]
+    assert topo["world"] == 2 and topo["rank"] == 0
+    assert topo["distributed"] is False
+    assert topo["num_parts"] == 1 and topo["part_index"] == 0
+    assert topo["batch_size"] == 4
+    assert topo["global_samples"] == topo["batches"] * 4
+    assert topo["portable_states"] is True
+    assert "resize_to" not in topo
+
+
+def test_simulated_resize_e2e(monkeypatch, tmp_path):
+    """THE acceptance chain, in-process: a world-2 run hit by
+    resize@3:3 writes a final verified checkpoint (resize_to=3) and
+    exits resumable; the world-3 relaunch re-forms the (simulated)
+    group, re-derives the ZeRO partition at world 3 and reproduces the
+    always-at-world-3 run's loss trajectory from the resize point
+    BITWISE — the ZeRO parity discipline across worlds."""
+    # always-at-new-size reference
+    net_ref, loop_ref = _build(monkeypatch, 3, str(tmp_path / "ref"))
+    res_ref = loop_ref.fit(epochs=2)
+    assert res_ref.step == 12 and res_ref.elastic is None
+
+    ck = str(tmp_path / "ck")
+    chaos.install("resize@3:3")
+    _, loop_a = _build(monkeypatch, 2, ck)
+    with pytest.raises(SystemExit) as ei:
+        loop_a.fit(epochs=2)
+    assert ei.value.code == fit.resumable_exit_code() == 75
+    assert chaos.active().injected["resize"] == 1
+    chaos.uninstall()
+
+    cm = fault.CheckpointManager(ck)
+    assert cm.latest() == 3, "final checkpoint at the resize step"
+    cm.verify(3)
+    with open(os.path.join(ck, "ckpt-3", "meta.json")) as f:
+        topo = json.load(f)["topology"]
+    assert topo["world"] == 2 and topo["resize_to"] == 3
+
+    # the relaunch harness honors resize_to: come back at world 3
+    net_b, loop_b = _build(monkeypatch, 3, ck, elastic_on=True)
+    res_b = loop_b.fit(epochs=2)
+    assert res_b.resumed_from == 3 and res_b.step == 12
+    assert res_b.elastic == {"from_world": 2, "world": 3, "rank": 0,
+                             "members": [0, 1, 2], "resize_to": 3}
+    assert res_b.zero and res_b.zero["world"] == 3
+    # post-resize trajectory == the always-at-3 run's, bitwise
+    np.testing.assert_array_equal(res_b.losses, res_ref.losses[3:])
+    np.testing.assert_array_equal(net_b.weight.data().asnumpy(),
+                                  net_ref.weight.data().asnumpy())
+
+
+def test_same_world_batch_change_resplits(monkeypatch, tmp_path):
+    """Review regression: a SAME-world resume whose data layout changed
+    (here per-rank batch size 4 -> 6) must re-split from the recorded
+    global sample position — replaying the raw local batch count would
+    duplicate samples — and a position that does not divide the new
+    stride raises instead of mis-positioning."""
+    _zero_env(monkeypatch, 0)
+    n = 24
+    X = _id_data(n)
+    Y = np.zeros((n, 1), np.float32)
+
+    seen = []
+
+    def build(bs, record=False):
+        mx.random.seed(0)
+        net = gluon.nn.Dense(1, in_units=1)
+        net.initialize(mx.init.Constant(0.5))
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.01}, kvstore=None)
+
+        class Rec(io.NDArrayIter):
+            def getdata(self):
+                out = super().getdata()
+                if record:
+                    seen.append(out[0].asnumpy().ravel().astype(int)
+                                .tolist())
+                return out
+        it = Rec(X, Y, batch_size=bs, shuffle=True, seed=5,
+                 last_batch_handle="discard")
+        loss = lambda o, y: ((o - y) ** 2).mean()
+        return fit.FitLoop(net, tr, loss, it,
+                           ckpt_dir=str(tmp_path / "ck"), ckpt_every=100,
+                           async_ckpt=False, heartbeat=False, seed=5)
+
+    chaos.install("kill@3")
+    with pytest.raises(chaos.ChaosKilled):
+        build(4).fit(epochs=1)  # ckpt? none yet — kill leaves nothing
+    chaos.uninstall()
+    # write the checkpoint via resize instead (graceful, ckpt at step 3)
+    chaos.install("resize@3")
+    with pytest.raises(SystemExit):
+        build(4).fit(epochs=1)
+    chaos.uninstall()
+
+    # batch 8: 12 % 8 != 0 -> named error, never a silent mis-split
+    # (checked FIRST: the successful resume below writes a newer
+    # end-of-epoch checkpoint whose position trivially divides)
+    with pytest.raises(elastic.TopologyMismatchError, match="split"):
+        build(8).fit(epochs=1)
+
+    # batch 6: global position 3*4=12 divides the new stride -> the
+    # resume fast-forwards 2 local batches and trains order[12:] once
+    res = build(6, record=True).fit(epochs=1)
+    assert res.resumed_from == 3 and res.step == 3 + 2
+    order = _ids_of_order(n, 5, 0)
+    # set_position fast-forward is O(1): NO replay fetches — every
+    # fetched batch is a trained one, and they are exactly order[12:]
+    trained = sum(seen, [])
+    assert trained == order[12:]
+
+
+def test_cross_world_resume_requires_elastic_on(monkeypatch, tmp_path):
+    """A world change without MXTPU_ELASTIC=on raises the named error
+    (never a silent mis-split resume), and the intact checkpoint is NOT
+    quarantined — an operator decision, not corruption."""
+    ck = str(tmp_path / "ck")
+    _, loop_a = _build(monkeypatch, 2, ck)
+    loop_a.fit(epochs=1)
+    _, loop_b = _build(monkeypatch, 3, ck, elastic_on=False)
+    with pytest.raises(elastic.TopologyMismatchError,
+                       match="MXTPU_ELASTIC"):
+        loop_b.fit(epochs=2)
+    assert os.path.isdir(os.path.join(ck, "ckpt-6"))
+    assert not os.path.isdir(os.path.join(ck, "ckpt-6.bad"))
+    # same world: resumes exactly as before, no elastic summary
+    _, loop_c = _build(monkeypatch, 2, ck)
+    res_c = loop_c.fit(epochs=2)
+    assert res_c.resumed_from == 6 and res_c.elastic is None
+
+
+def test_nonportable_sharded_artifact_rejected(monkeypatch, tmp_path):
+    """Satellite acceptance: a checkpoint whose trainer states are NOT
+    in the gather-on-save portable format must raise across a world
+    change — even with MXTPU_ELASTIC=on — before any state loads."""
+    monkeypatch.setenv("MXTPU_ELASTIC", "on")
+    cm = fault.CheckpointManager(str(tmp_path / "ck"))
+    cm.save(2, params={"w": nd.ones((2, 2))},
+            extra={"topology": {"world": 2, "rank": 0, "num_parts": 1,
+                                "part_index": 0, "batch_size": 4,
+                                "global_samples": 8,
+                                "portable_states": False}})
+    cur = {"world": 3, "rank": 0, "distributed": False, "num_parts": 1,
+           "part_index": 0, "batch_size": 4}
+    guard = lambda meta: elastic.check_restore(meta.get("topology"), cur)
+    with pytest.raises(elastic.TopologyMismatchError,
+                       match="NON-portable"):
+        cm.restore(2, meta_check=guard)
+    with pytest.raises(elastic.TopologyMismatchError):
+        cm.restore_latest(meta_check=guard)
+    # rejected, not quarantined — and same-world restore still works
+    assert cm.latest() == 2
+    same = dict(cur, world=2)
+    step, params, _meta = cm.restore(
+        2, meta_check=lambda m: elastic.check_restore(
+            m.get("topology"), same))
+    assert step == 2 and "w" in params
+
+
+def test_resize_without_ckpt_dir_raises(monkeypatch):
+    chaos.install("resize@0:2")
+    _, loop = _build(monkeypatch, 0, None)
+    with pytest.raises(MXNetError, match="checkpoint dir"):
+        loop.fit(epochs=1)
+    chaos.uninstall()
+
+
+def test_comm_state_reset_on_resize():
+    from mxnet_tpu.telemetry import collective as coll
+    from mxnet_tpu.telemetry.tracer import tracer as tr
+    coll.ledger.clock_offset_ms = 123.0
+    tr.clock_offset_ms = 123.0
+    elastic.reset_comm_state()
+    assert coll.ledger.clock_offset_ms == 0.0
+    assert tr.clock_offset_ms == 0.0
+    assert coll.health_summary().get("checks", 0) in (0, None)
+
+
+def test_reform_group_simulated(monkeypatch):
+    monkeypatch.setenv("MXTPU_ZERO_WORLD", "4")
+    cur = elastic.current_topology()
+    assert cur["world"] == 4 and not cur["distributed"]
+    out = elastic.reform_group(cur)
+    assert out == {"reformed": True, "members": [0, 1, 2, 3]}
+
+
+# ----------------------------------------------- the 2->3-process drill
+
+def test_elastic_two_to_three_process_drill(monkeypatch, tmp_path):
+    """Acceptance, real process groups: a 2-rank dist_sync + ZeRO run is
+    resized at step 3 by chaos ``resize@3:3`` (final checkpoint, exit
+    75), relaunched as a 3-rank group that re-forms through the
+    coordination service and re-splits the seeded stream — the summed
+    post-resize loss trajectory matches an in-process never-resized
+    reference (fixed global batch G, sum loss: the update is (1/G)·Σ∇
+    at any world), the final weights agree, and the union of every
+    rank's consumed samples across the resize equals the no-resize
+    stream exactly (zero duplicated, zero dropped)."""
+    import importlib.util
+    import subprocess
+    import sys
+    spec = importlib.util.spec_from_file_location(
+        "elastic_worker",
+        os.path.join(ROOT, "tests", "dist", "elastic_worker.py"))
+    worker = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(worker)
+    make_data, EPOCHS, G, N, RESIZE_AT, SEED = (
+        worker.make_data, worker.EPOCHS, worker.G, worker.N,
+        worker.RESIZE_AT, worker.SEED)
+
+    out = str(tmp_path)
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # one cpu device per process
+    env.update({"JAX_PLATFORMS": "cpu",
+                "ELASTIC_OUT_DIR": out,
+                "MXTPU_ZERO": "1",
+                "MXTPU_OPTIMIZER_AGGREGATION": "8"})
+
+    def launch(n, port, phase_env):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+             "-n", str(n), "--launcher", "local",
+             "--coordinator", f"127.0.0.1:{port}",
+             sys.executable,
+             os.path.join(ROOT, "tests", "dist", "elastic_worker.py")],
+            capture_output=True, text=True, timeout=300,
+            env={**env, **phase_env}, cwd=ROOT)
+        assert proc.returncode == 0, \
+            (proc.stdout + proc.stderr)[-3000:]
+        return proc.stdout + proc.stderr
+
+    def markers(text, marker):
+        # ranks share one stdout pipe: a peer's line can land between a
+        # print's text and its newline, so parse each marker's JSON with
+        # raw_decode (stops at the object end) instead of by line
+        dec = json.JSONDecoder()
+        return [dec.raw_decode(chunk.lstrip())[0]
+                for chunk in text.split(marker + " ")[1:]]
+
+    out_pre = launch(2, 12483, {"ELASTIC_PHASE": "pre",
+                                "MXTPU_CHAOS": f"resize@{RESIZE_AT}:3"})
+    pre = markers(out_pre, "ELASTIC_PRE")
+    assert sorted(p["rank"] for p in pre) == [0, 1], out_pre[-2000:]
+
+    out_post = launch(3, 12484, {"ELASTIC_PHASE": "post",
+                                 "MXTPU_ELASTIC": "on"})
+    post = markers(out_post, "ELASTIC_POST")
+    assert sorted(p["rank"] for p in post) == [0, 1, 2], out_post[-2000:]
+    for p in post:
+        assert p["elastic"]["from_world"] == 2
+        assert p["elastic"]["world"] == 3
+        assert p["step"] == (N // G) * EPOCHS
+
+    # in-process never-resized reference: world 1, full stream, same
+    # fixed global batch and sum loss
+    for k in ("MXTPU_ZERO", "MXTPU_ZERO_WORLD", "MXTPU_ELASTIC"):
+        monkeypatch.delenv(k, raising=False)
+    X, Y = make_data()
+    mx.random.seed(0)
+    net = gluon.nn.Dense(1, in_units=3)
+    net.initialize(mx.init.Constant(0.25))
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05, "momentum": 0.9},
+                       kvstore=None)
+    it = io.NDArrayIter(X, Y, batch_size=G, shuffle=True, seed=SEED)
+    loop = fit.FitLoop(net, tr, lambda o, y: ((o - y) ** 2).sum(), it,
+                       ckpt_dir=None, heartbeat=False, seed=SEED)
+    ref = loop.fit(epochs=EPOCHS, batch_size=G)
+    assert ref.step == (N // G) * EPOCHS
+
+    # post-resize loss trajectory: sum of the 3 ranks' local sum-losses
+    # per step == the reference's full-batch loss from the resize point
+    summed = np.sum([p["losses"] for p in sorted(post,
+                                                 key=lambda p: p["rank"])],
+                    axis=0)
+    np.testing.assert_allclose(summed, ref.losses[RESIZE_AT:],
+                               rtol=1e-4, atol=1e-6)
+    for p in post:  # weights replicated: every rank must agree with ref
+        np.testing.assert_allclose(
+            np.asarray(p["weight"]),
+            net.weight.data().asnumpy().ravel(), rtol=1e-5, atol=1e-7)
+
+    # union proof across the resize: trained samples (2-rank prefix +
+    # 3-rank suffix) == the no-resize stream, zero dup / zero dropped
+    ref_stream = []
+    rit = io.NDArrayIter(X, Y, batch_size=G, shuffle=True, seed=SEED)
+    for ep in range(EPOCHS):
+        rit.set_epoch(ep)
+        for bt in rit:
+            ref_stream += [int(round(float(v) * N))
+                           for v in bt.data[0].asnumpy()[:, 0]]
+    consumed = []
+    for p in pre + post:
+        for ids in p["trained_ids"]:
+            consumed += ids
+    assert sorted(consumed) == sorted(ref_stream)
+    assert len(consumed) == len(ref_stream) == N * EPOCHS
+
+
+# --------------------------------------- run-report topology fingerprint
+
+def test_run_report_world_fingerprint(monkeypatch, tmp_path):
+    from mxnet_tpu.telemetry import run_report as rr
+    monkeypatch.delenv("MXTPU_ZERO_WORLD", raising=False)
+    res = fit.FitResult(status="done", step=2, epoch=1,
+                        losses=[1.0, 0.5])
+    p1 = rr.build_payload(res)
+    assert p1["fingerprint"]["world_size"] == 1
+    monkeypatch.setenv("MXTPU_ZERO_WORLD", "3")
+    p3 = rr.build_payload(res)
+    assert p3["fingerprint"]["world_size"] == 3
+
+    from tools import run_compare as rc
+    out = rc.compare(p1, p3, fence_pct=5.0)
+    assert out["topology_diff"] == {"baseline_world": 1,
+                                    "candidate_world": 3}
+    out_same = rc.compare(p3, p3, fence_pct=5.0)
+    assert out_same["topology_diff"] is None
+
+
+def test_run_compare_flags_cross_topology_text(monkeypatch, tmp_path,
+                                               capsys):
+    from mxnet_tpu.telemetry import run_report as rr
+    from tools import run_compare as rc
+    res = fit.FitResult(status="done", step=2, epoch=1, losses=[1.0, 0.5])
+    monkeypatch.setenv("MXTPU_RUN_REPORT_DIR", str(tmp_path))
+    monkeypatch.delenv("MXTPU_ZERO_WORLD", raising=False)
+    a = rr.write_run_report(res)
+    monkeypatch.setenv("MXTPU_ZERO_WORLD", "2")
+    b = rr.write_run_report(res)
+    rcode = rc.main([a, b])
+    out = capsys.readouterr().out
+    assert rcode == 0  # flagged, not failed: same metrics
+    assert "CROSS-TOPOLOGY" in out
+    assert "world 1" in out and "world 2" in out

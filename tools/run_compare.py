@@ -146,6 +146,14 @@ def compare(a: Dict[str, Any], b: Dict[str, Any],
     fp_b = (b.get("fingerprint") or {}).get("env_overrides") or {}
     fp_diff = sorted(k for k in set(fp_a) | set(fp_b)
                      if fp_a.get(k) != fp_b.get(k))
+    # cross-topology guard: an N-rank run diffed against an M-rank run
+    # is a topology comparison, not a regression signal — per-rank
+    # memory, skew and step time all scale with world size
+    ws_a = (a.get("fingerprint") or {}).get("world_size")
+    ws_b = (b.get("fingerprint") or {}).get("world_size")
+    topo_diff = None
+    if ws_a is not None and ws_b is not None and ws_a != ws_b:
+        topo_diff = {"baseline_world": ws_a, "candidate_world": ws_b}
     eff = (a.get("efficiency") or {})
     return {
         "fence_pct": fence_pct,
@@ -156,6 +164,7 @@ def compare(a: Dict[str, Any], b: Dict[str, Any],
         "improved": [r["metric"] for r in rows
                      if r["verdict"] == "improved"],
         "fingerprint_diff": fp_diff,
+        "topology_diff": topo_diff,
         "estimate": bool(eff.get("estimate")) or
         bool((b.get("efficiency") or {}).get("estimate")),
         "verdict": "regression" if regressed else "ok",
@@ -191,6 +200,12 @@ def print_text(result: Dict[str, Any], path_a: str, path_b: str) -> None:
         print(f"\nNOTE: env fingerprints differ on "
               f"{', '.join(result['fingerprint_diff'])} — the runs may "
               "not be configured identically")
+    if result.get("topology_diff"):
+        td = result["topology_diff"]
+        print(f"WARNING: CROSS-TOPOLOGY comparison — baseline ran at "
+              f"world {td['baseline_world']}, candidate at world "
+              f"{td['candidate_world']}; per-rank metrics are not "
+              "comparable across world sizes")
     if result["estimate"]:
         print("NOTE: MFU graded against a defaulted device peak "
               "(estimate) — set MXTPU_DEVICE_PEAK for honest numbers")
