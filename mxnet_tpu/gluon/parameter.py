@@ -31,6 +31,13 @@ class DeferredInitializationError(MXNetError):
 
 
 class Parameter:
+    #: set by the ZeRO-1 overlapped weight allgather (parallel/zero.py)
+    #: on non-local params whose updated value is still in flight: a
+    #: zero-arg closure that completes the whole bucket's rebinds, then
+    #: clears itself. Class-level default keeps the hot data() path to
+    #: one attribute test for every parameter that never prefetches.
+    _pending_fetch = None
+
     def __init__(self, name, grad_req="write", shape=None, dtype="float32",
                  lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
                  differentiable=True, stype="default", grad_stype="default"):
@@ -151,6 +158,11 @@ class Parameter:
 
     # -- access ---------------------------------------------------------
     def data(self, ctx=None) -> _nd.NDArray:
+        if self._pending_fetch is not None:
+            # overlapped ZeRO allgather: this weight's updated value is
+            # still in flight from its owner rank — complete the bucket
+            # on first read (the closure clears every hook it covers)
+            self._pending_fetch()
         if self._data is None:
             if self._deferred_init is not None:
                 raise DeferredInitializationError(

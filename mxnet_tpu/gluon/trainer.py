@@ -42,6 +42,16 @@ buffers back. Numerically identical to the barrier path — the same
 buckets, the same sums, launched earlier. Overlapped communication is charged to
 the step-breakdown segment ``comm_overlapped`` (exclusive time, nested
 inside ``compute``).
+
+The overlap composes with ZeRO-1: under ``MXTPU_ZERO=1`` the same
+grad-ready hook launches each bucket's **reduce-scatter** at grad
+finality (rebinds deferred to finalization — autograd may still read
+the live buffers), and the update path launches each bucket's weight
+**allgather** as soon as that bucket's shard updates land, while the
+tail buckets are still updating. Same buckets, same sums, same
+collective count as the barrier plane; only the launch points move,
+into ``comm_overlapped``. See parallel/zero.py for the prefetch
+completion contract on distributed groups.
 """
 from __future__ import annotations
 
@@ -258,9 +268,10 @@ class Trainer:
         """Context manager for one backward pass that overlaps gradient
         communication with the reverse pass (``MXTPU_COMM_OVERLAP=on``):
         the autograd grad-ready hook launches each dense bucket's kvstore
-        push/pull as soon as its constituent grads are final, and the
-        following :meth:`allreduce_grads` call only flushes stragglers +
-        splits the flat buffers. Returns an inactive no-op scope when
+        push/pull — or, under ``MXTPU_ZERO=1``, its reduce-scatter — as
+        soon as its constituent grads are final, and the following
+        :meth:`allreduce_grads` call only flushes stragglers + completes
+        the deferred rebinds. Returns an inactive no-op scope when
         overlap is off or there is no kvstore argument — the caller can
         always write ``with trainer.overlap_scope(): loss.backward()``.
 
@@ -275,13 +286,6 @@ class Trainer:
         # there is no store (short-circuiting the parse away would let
         # the typo silently train with the barrier path)
         active = _overlap_requested() and bool(self._kvstore_arg)
-        if active:
-            from ..parallel import zero as _zero
-            if _zero.zero_requested():
-                # ZeRO-1 owns the comm plane: its reduce-scatter is a
-                # barrier op today, and an overlapped push/pull would
-                # ship a second (unsharded) copy of every bucket
-                active = False
         if active:
             from ..contrib import chaos
             plan = chaos.active()
@@ -651,7 +655,10 @@ class Trainer:
     def _update_zero(self, plane, ignore_stale_grad, sentinel):
         """ZeRO-1 back half (the reduce-scatter already ran in
         allreduce_grads): shard-local grouped update guarded by the
-        GLOBAL finiteness verdict, then the per-bucket weight allgather.
+        GLOBAL finiteness verdict, then the per-bucket weight allgather
+        — as one barrier after all updates, or, with
+        ``MXTPU_COMM_OVERLAP=on``, launched per bucket the moment that
+        bucket's shard updates land (charged to ``comm_overlapped``).
         Only this rank's parameters touch optimizer state; everyone
         else's updated weights arrive through the allgather."""
         import jax
@@ -703,8 +710,46 @@ class Trainer:
         nspec = _numerics.collect_spec()
         stats_out = [] if nspec is not None else None
         handled, created, n_disp = [], [], 0
+        overlap = plane.overlap_active(self)
+        if overlap:
+            # overlapped allgather: walk the comm round's buckets in
+            # layout order, update each bucket's shards, and launch that
+            # bucket's weight allgather IMMEDIATELY — in flight while the
+            # tail buckets still update (the DeviceStagingIter staging
+            # idiom applied to weights). Per-param update math is
+            # grouping-independent (grouped.py advances per-index
+            # counters), so splitting the per-rank grouped calls per
+            # bucket is bitwise-neutral vs the barrier plane.
+            layout = plane.take_step_layout(self)
+            todo_idx = dict(todo)
+            seen = set()
+            for key, bucket in layout:
+                bitems = [(i, todo_idx[i]) for i, _g in bucket
+                          if i in todo_idx]
+                seen.update(i for i, _p in bitems)
+                for r in plane.my_ranks:
+                    items = [(i, p) for i, p in bitems
+                             if plane.owner(i) == r]
+                    if not items:
+                        continue
+                    idxs, n, _f, cr = _grouped.grouped_update(
+                        updater, items, agg, sentinel=sentinel,
+                        sentinel_flag=flag, stats_out=stats_out)
+                    handled += idxs
+                    created += cr
+                    n_disp += n
+                with _bd_segment("comm_overlapped"):
+                    plane.launch_allgather_bucket(self, key, bucket)
+            plane.seal_allgather(self)
+            # safety net: a fresh grad outside the round's layout cannot
+            # exist (the layout covers every grad), but if one ever did
+            # its update must not be dropped — it just misses the wire,
+            # exactly like a stale-declined param
+            leftovers = [(i, p) for i, p in todo if i not in seen]
+        else:
+            leftovers = todo
         for r in plane.my_ranks:
-            items = [(i, p) for i, p in todo if plane.owner(i) == r]
+            items = [(i, p) for i, p in leftovers if plane.owner(i) == r]
             if not items:
                 continue
             idxs, n, _f, cr = _grouped.grouped_update(
@@ -723,11 +768,13 @@ class Trainer:
             n_disp += 1  # the fused finite reduction
             self._last_fused_indices = handled
             self._last_fused_created = created
-        # allgather of the (where-guarded) updated weights: wire time is
-        # charged to 'comm' so StepBreakdown/trace_report attribute it,
-        # even though the call runs inside the optimizer phase
-        with _bd_segment("comm"):
-            plane.allgather_weights(self)
+        if not overlap:
+            # barrier allgather of the (where-guarded) updated weights:
+            # wire time is charged to 'comm' so StepBreakdown/
+            # trace_report attribute it, even though the call runs
+            # inside the optimizer phase
+            with _bd_segment("comm"):
+                plane.allgather_weights(self)
         for _i, p in todo:
             p._fresh_grad = False
         self.last_update_dispatches = n_disp
@@ -784,6 +831,16 @@ class _OverlapScope:
     grads (whole-graph CachedOp bypasses the tape) degrades gracefully:
     finalize launches every bucket, which is exactly the barrier path.
 
+    Under ``MXTPU_ZERO=1`` the scope drives the plane's reduce-scatter
+    instead of push/pull: the same buckets launch at grad finality
+    through ``ZeroPlane.launch_bucket_rs`` (the collective is pure; only
+    the launch moves), and the grad-onto-reduced-slice rebinds are
+    deferred to :meth:`finalize` exactly like the dense splits — autograd
+    may still read the live grad buffers mid-backward. finalize then
+    hands the round's layout to the plane and arms ``_zero_step``, so
+    the following update consumes the plane as if the barrier
+    ``reduce_scatter_grads`` had run.
+
     Contract: each entered scope is paired with the following
     ``allreduce_grads``/``step`` call, which consumes it. A scope whose
     backward raised is abandoned on exit (its launched buckets hold a
@@ -803,6 +860,7 @@ class _OverlapScope:
         self._pending: List[int] = []
         self._launched: List = []   # per bucket: None | True | (sig, flat)
         self._nostore = False
+        self._zplane = None         # ZeroPlane when MXTPU_ZERO=1
 
     # -- context management ---------------------------------------------
     def __enter__(self):
@@ -819,6 +877,7 @@ class _OverlapScope:
         self._cm.__enter__()
         self._trainer._overlap_state = self
         self._trainer.last_allreduce_collectives = 0
+        self._trainer.last_reduce_scatter_collectives = 0
         return self
 
     def __exit__(self, *exc):
@@ -850,6 +909,15 @@ class _OverlapScope:
         if t._kvstore is None:
             self._nostore = True
             return False
+        plane = t._zero_plane()
+        if plane is not None:
+            # ZeRO mode: drive the plane's reduce-scatter from the hook.
+            # Same per-round checks and pending-allgather drain the
+            # barrier reduce_scatter_grads runs, then the SAME bucket
+            # layout below (the plane guarantees dense params only)
+            plane.check_comm_round()
+            plane.flush_pending()
+            self._zplane = plane
         from ..ndarray import sparse as _sp
         items, sparse = [], []
         # the SAME forward-order layout as the barrier path: identical
@@ -897,14 +965,38 @@ class _OverlapScope:
         # backward still runs. Exclusive time lands in 'comm_overlapped'
         # (nested inside the loop owner's 'compute' segment).
         with _bd_segment("comm_overlapped"):
-            self._launched[b] = \
-                self._trainer._launch_bucket(b, self._buckets[b]) or True
+            if self._zplane is not None:
+                self._launched[b] = self._launch_zero_bucket(b)
+            else:
+                self._launched[b] = \
+                    self._trainer._launch_bucket(b, self._buckets[b]) or True
+
+    def _launch_zero_bucket(self, b):
+        """Reduce-scatter one finalized bucket from the backward thread:
+        the same ``_gbkt`` key and wire layout as the barrier plane,
+        launched at grad finality. Grad rebinds wait for finalize()."""
+        t = self._trainer
+        bucket = self._buckets[b]
+        key = t._bucket_sig_key(b, bucket)[1]
+        parts, slices = self._zplane.launch_bucket_rs(t, key, bucket)
+        t.last_reduce_scatter_collectives += 1
+        return parts, slices
 
     # -- completion (from Trainer.allreduce_grads) ----------------------
     def finalize(self) -> None:
         if not self._ensure_ready():
+            from ..parallel import zero as _zero
+            if not self._nostore or not _zero.zero_requested():
+                return
+            # no-store semantics diverge under ZeRO: the barrier path
+            # raises the plane's no-kvstore error rather than silently
+            # training unsharded — reproduce it, don't swallow it
+            self._trainer._zero_plane()
             return
         t = self._trainer
+        if self._zplane is not None:
+            self._finalize_zero()
+            return
         # stragglers: grads that never announced (tape bypassed, stale
         # grads under ignore_stale_grad) ride the barrier path now
         for b, bucket in enumerate(self._buckets):
@@ -918,3 +1010,29 @@ class _OverlapScope:
             t._allreduce_rowsparse(i, g)
         if t.last_allreduce_collectives:
             _allreduce_counter().inc(t.last_allreduce_collectives)
+
+    def _finalize_zero(self) -> None:
+        """Complete the overlapped ZeRO comm round: reduce-scatter the
+        stragglers (grads that never announced ride the barrier path —
+        inside the caller's exposed 'comm' segment, truthfully), rebind
+        this rank's grads onto the reduced slices, and hand the round's
+        (key, bucket) layout to the plane so the allgather half sees the
+        identical layout. Arms ``_zero_step`` like the barrier
+        ``allreduce_grads`` branch does."""
+        t = self._trainer
+        plane = self._zplane
+        # a fresh comm round supersedes a stale un-consumed decline (the
+        # same contract as the barrier allreduce_grads entry)
+        t._zero_declined = False
+        for b in range(len(self._buckets)):
+            if self._launched[b] is None:
+                self._launched[b] = self._launch_zero_bucket(b)
+        for parts, slices in self._launched:
+            plane.finish_bucket_rs(parts, slices)
+        plane._step_layout = [
+            (t._bucket_sig_key(b, bucket)[1], bucket)
+            for b, bucket in enumerate(self._buckets)]
+        t._zero_step = plane
+        if t.last_reduce_scatter_collectives:
+            from ..parallel.zero import _rs_counter
+            _rs_counter().inc(t.last_reduce_scatter_collectives)

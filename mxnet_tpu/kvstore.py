@@ -391,25 +391,30 @@ class KVStoreBase:
     # All three are PURE reads of their inputs — no store mutation — so a
     # retried flake can never double-apply a shard update.
 
-    def zero_reduce_scatter(self, key, value, parts):
+    def zero_reduce_scatter(self, key, value, parts, all_parts=None):
         """Reduce the flat ``_gbkt`` wire buffer ``value`` across workers
         and return the reduced ``[lo, hi)`` slices named by ``parts``
         (this rank's parameter-aligned shard segments) as NDArrays.
-        Single-worker stores: the local gradient already IS the group sum
-        (the merge ran at flatten time), so the reduce is identity and
-        only the slicing remains — the simulated-world semantics."""
+        ``all_parts`` — every rank's segments, identical on all callers —
+        lets the distributed transport run a true tiled reduce-scatter
+        instead of allreduce+slice (parallel/collectives.py documents the
+        padding rule); single-worker stores ignore it. Single-worker
+        stores: the local gradient already IS the group sum (the merge
+        ran at flatten time), so the reduce is identity and only the
+        slicing remains — the simulated-world semantics."""
         out: List[_nd.NDArray] = []
 
         def run():
             out.clear()
             _chaos_kv("reduce_scatter", key, self.rank)
-            out.extend(self._zero_reduce_scatter_impl(key, value, parts))
+            out.extend(self._zero_reduce_scatter_impl(key, value, parts,
+                                                      all_parts))
         _traced_retry("reduce_scatter", key, run,
                       nbytes=_coll_bytes(value) if _coll.enabled() else 0,
                       rank=self.rank)
         return out
 
-    def _zero_reduce_scatter_impl(self, key, value, parts):
+    def _zero_reduce_scatter_impl(self, key, value, parts, all_parts=None):
         data = value._data
         return [_nd.NDArray(data[lo:hi], ctx=value._ctx)
                 for lo, hi in parts]
@@ -602,12 +607,14 @@ class KVStoreDistTPU(KVStoreBase):
                                       axis="hosts")
         return _nd.array(out, ctx=merged._ctx)
 
-    def _zero_reduce_scatter_impl(self, key, value, parts):
+    def _zero_reduce_scatter_impl(self, key, value, parts, all_parts=None):
         if self._mesh is None:
-            return super()._zero_reduce_scatter_impl(key, value, parts)
+            return super()._zero_reduce_scatter_impl(key, value, parts,
+                                                     all_parts)
         from .parallel.collectives import cross_process_reduce_scatter
         slices = cross_process_reduce_scatter(value.asnumpy(), self._mesh,
-                                              parts, axis="hosts")
+                                              parts, axis="hosts",
+                                              all_parts=all_parts)
         return [_nd.array(s, ctx=value._ctx) for s in slices]
 
     def _zero_allgather_impl(self, key, payloads):

@@ -241,19 +241,136 @@ def _cross_process_gather_fn(mesh, axis, ndim):
                              check_vma=False))
 
 
+def _tile_layout(all_parts, n: int):
+    """Rank-major tiled permutation for a ragged reduce-scatter.
+
+    ``all_parts[r]`` is rank r's ``[lo, hi)`` segments of an ``n``-element
+    flat buffer (parameter-granular, so per-rank totals differ). A tiled
+    ``psum_scatter`` needs EQUAL tiles, so: tile size ``T`` is the max
+    per-rank element count, and output slot ``r*T + k`` holds the k-th
+    element of rank r's concatenated segments — pad slots point at index
+    ``n``, a zero appended by the caller. Returns ``(counts, T, perm)``
+    with ``perm`` an int64 index vector of length ``world*T``.
+
+    The padding rule callers gate on: tiled wire cost is ``world*T``
+    elements vs the allreduce fallback's ``~2n``; take the tiled path
+    only when ``world*T < 2n`` (a single rank owning nearly everything
+    would otherwise pad every other rank's tile up to its size and ship
+    more bytes than the allreduce it replaces)."""
+    import numpy as np
+    counts = [sum(hi - lo for lo, hi in ap) for ap in all_parts]
+    T = max(counts) if counts else 0
+    perm = np.full(len(all_parts) * T, n, dtype=np.int64)
+    for r, ap in enumerate(all_parts):
+        off = r * T
+        for lo, hi in ap:
+            perm[off:off + (hi - lo)] = np.arange(lo, hi, dtype=np.int64)
+            off += hi - lo
+    return counts, T, perm
+
+
+@functools.lru_cache(maxsize=None)
+def _rs_tile_fn(mesh, axis):
+    """Compiled tiled ``psum_scatter`` over the hosts mesh: every process
+    contributes its rank-major padded wire buffer and keeps ONLY its own
+    reduced tile. The input is DONATED — the padded wire buffer is
+    transient by construction and dies inside the collective instead of
+    living on until the caller's slicing (the buffer-lifetime discipline
+    the one-program megastep will inherit)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from .compat import shard_map
+
+    def f(v):
+        return jax.lax.psum_scatter(v[0], axis, scatter_dimension=0,
+                                    tiled=True)
+
+    return jax.jit(shard_map(f, mesh=mesh, in_specs=(P(axis),),
+                             out_specs=P(axis), check_vma=False),
+                   donate_argnums=(0,))
+
+
+def _coord_segment_reduce(local, all_parts, tag: str):
+    """Coordination-service reduce-scatter: each rank publishes, per
+    PEER, only the segments that peer owns (one ``{src}to{dst}`` blob per
+    pair), then sums the ``{peer}to{me}`` blobs with its own contribution
+    — ``~n`` elements cross the wire per rank instead of the full-buffer
+    exchange's ``world*n``. Ledger kind is ``reduce_scatter`` (this IS
+    one, unlike the allreduce-shaped ``exchange``), with the same
+    per-peer waiting stamps and done-barrier as ``_coord_exchange``.
+    Returns rank's reduced segments in ``all_parts[rank]`` order."""
+    import jax
+    import numpy as np
+    from ..telemetry import collective as _coll
+    client = _coord_client()
+    rank, nproc = jax.process_index(), jax.process_count()
+    prefix = f"mxtpu_coll/{tag}"
+    local = np.ascontiguousarray(local)
+    blobs = {d: np.concatenate(
+        [local[lo:hi] for lo, hi in all_parts[d]] or
+        [local[:0]]) for d in range(nproc)}
+    sent = sum(b.nbytes for d, b in blobs.items() if d != rank)
+    tok = _coll.enter("reduce_scatter", tag, sent, rank) \
+        if _coll.enabled() else None
+    try:
+        # a rank that owns NOTHING in this bucket has zero-length blobs
+        # in both directions — never ship those: a zero-length value
+        # through the coordination-service KV hard-crashes the client
+        # (observed SIGSEGV in blocking get), and there is nothing to
+        # sum anyway. The done-barrier below still syncs every rank.
+        for d in range(nproc):
+            if d != rank and blobs[d].size:
+                client.key_value_set_bytes(f"{prefix}/{rank}to{d}",
+                                           blobs[d].tobytes())
+        total = blobs[rank].copy()
+        if total.size:
+            for s in range(nproc):
+                if s == rank:
+                    continue
+                if tok is not None:
+                    _coll.note_waiting(tok, s)
+                buf = client.blocking_key_value_get_bytes(
+                    f"{prefix}/{s}to{rank}", _COORD_TIMEOUT_MS)
+                total = total + np.frombuffer(bytearray(buf), local.dtype)
+        if tok is not None:
+            _coll.note_waiting(tok, "barrier")  # see _coord_exchange
+        client.wait_at_barrier(f"{prefix}/done", _COORD_TIMEOUT_MS)
+        if rank == 0:
+            for s in range(nproc):
+                for d in range(nproc):
+                    if s != d and blobs[d].size:
+                        try:
+                            client.key_value_delete(f"{prefix}/{s}to{d}")
+                        except Exception:
+                            pass
+        out, off = [], 0
+        for lo, hi in all_parts[rank]:
+            out.append(total[off:off + (hi - lo)])
+            off += hi - lo
+        return out
+    finally:
+        if tok is not None:
+            _coll.exit_(tok)
+
+
 def cross_process_reduce_scatter(local, mesh, parts, axis: str = "hosts",
-                                 op: str = "sum"):
+                                 op: str = "sum", all_parts=None):
     """Reduce per-PROCESS flat buffers element-wise and return only the
     ``[lo, hi)`` slices named by ``parts`` — the ZeRO-1 gradient plane:
     each rank keeps exactly the reduced segments its optimizer shard
     consumes. All ranks must call per the usual SPMD collective contract
     (same buffer shape, each with its own ``parts``).
 
-    Coord fallback (multiprocess CPU): exchange + host reduce + slice —
-    functional parity on the transport every CPU-backend collective
-    already rides. XLA path: psum + slice (parts are parameter-granular
-    and ragged; a true tiled ``psum_scatter`` needs equal tiles, so the
-    bandwidth-optimal form is future work on real meshes)."""
+    ``all_parts`` (rank-indexed list of every rank's segments, identical
+    on all callers) unlocks the true reduce-scatter wire cost: the XLA
+    path pads each rank's ragged segments to equal ``T``-element tiles
+    (rank-major permutation, :func:`_tile_layout`) and runs one tiled
+    ``psum_scatter`` whenever ``world*T < 2n`` — below that the padding
+    would out-ship the psum+slice fallback, which then still applies.
+    The coord fallback (multiprocess CPU) sends each peer only the
+    segments it owns (:func:`_coord_segment_reduce`). Without
+    ``all_parts`` both paths degrade to the full-buffer form:
+    exchange+sum+slice on CPU, psum+slice on XLA."""
     import jax
     import numpy as np
     nproc = mesh.devices.size
@@ -263,12 +380,40 @@ def cross_process_reduce_scatter(local, mesh, parts, axis: str = "hosts",
           f"{jax.process_count()} processes")
     check(op == "sum", f"unsupported reduce-scatter op {op!r}")
     local = np.asarray(local)
+    n = int(local.size)
+    if all_parts is not None:
+        check(len(all_parts) == nproc,
+              f"all_parts covers {len(all_parts)} ranks, world is {nproc}")
+        rank = jax.process_index()
+        check([tuple(p) for p in parts] ==
+              [tuple(p) for p in all_parts[rank]],
+              "cross_process_reduce_scatter: parts != all_parts[rank] — "
+              "the caller's own segments must match the shared layout")
     if _use_coord_fallback():
+        if all_parts is not None:
+            return _coord_segment_reduce(local, all_parts,
+                                         f"rs{next(_coord_seq)}")
         bufs = _coord_exchange(local, f"rs{next(_coord_seq)}")
         total = bufs[0].copy()
         for b in bufs[1:]:
             total = total + b
         return [total[lo:hi] for lo, hi in parts]
+    if all_parts is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        counts, T, perm = _tile_layout(all_parts, n)
+        if T > 0 and nproc * T < 2 * n:
+            padded = np.concatenate([local, np.zeros(1, local.dtype)])
+            wire = np.ascontiguousarray(padded[perm])[None]
+            garr = jax.make_array_from_process_local_data(
+                NamedSharding(mesh, P(axis)), wire, (nproc, nproc * T))
+            out = _rs_tile_fn(mesh, axis)(garr)
+            tile = np.asarray(out.addressable_shards[0].data)
+            rank = jax.process_index()
+            res, off = [], 0
+            for lo, hi in parts:
+                res.append(tile[off:off + (hi - lo)])
+                off += hi - lo
+            return res
     full = cross_process_allreduce(local, mesh, axis=axis, op=op)
     return [np.asarray(full[lo:hi]) for lo, hi in parts]
 

@@ -53,8 +53,38 @@ compression (per-key error-feedback residuals assume the allreduce
 layout; checked at plane creation AND per comm round), non-grouped
 optimizers and sparse parameters (the shard update IS the grouped
 path), aggregation off, and a bare ``update()`` with no preceding
-reduce-scatter. ``MXTPU_COMM_OVERLAP`` is superseded for the run — the
-reduce-scatter is a barrier op today.
+reduce-scatter.
+
+**Comm/compute overlap** (``MXTPU_COMM_OVERLAP=on``) composes with the
+plane instead of being superseded: the backward half launches each
+bucket's reduce-scatter at grad finality through the same autograd
+callback the dense overlap scheduler uses (``Trainer.overlap_scope``;
+grad rebinds deferred to finalize — autograd may still read the live
+buffers), and the update half launches each bucket's weight allgather
+the moment that bucket's shard updates land, while the tail buckets are
+still updating (the ``DeviceStagingIter`` staging idiom applied to
+weights). Same buckets, same sums, same collective count — only the
+launch points move, and the moved time is charged to the
+``comm_overlapped`` step-breakdown segment instead of exposed ``comm``.
+Distributed runs defer the non-local weight rebinds of a prefetched
+allgather: each in-flight parameter carries a pending-fetch hook that
+the next ``Parameter.data()`` read completes (first touch completes the
+whole bucket), with ``flush_pending`` as the barrier of last resort
+before the next comm round.
+
+**Tiled reduce-scatter padding rule** (the XLA transport,
+``parallel/collectives.py``): buckets are parameter-granular and ragged
+— per-rank segment totals differ — while ``psum_scatter`` needs equal
+tiles. So the wire buffer is permuted rank-major and each rank's
+segments padded to ``T = max`` per-rank element count; one tiled
+``psum_scatter`` then delivers each rank exactly its (padded) tile, and
+the pad tail is sliced off. The tiled path is taken only when
+``world*T < 2n`` (n = bucket elements): beyond that the padding would
+out-ship the allreduce+slice fallback it replaces — a bucket whose
+bytes all belong to one rank pads every other rank's tile up to its
+size. The multiprocess-CPU coord fallback sends each peer only the
+segments it owns instead (per-pair blobs, ledger kind
+``reduce_scatter``), never the full-buffer exchange.
 
 Distributed-group contracts (simulated worlds are exempt — every grad
 is fully reduced locally there):
@@ -216,6 +246,10 @@ class ZeroPlane:
         # two halves can never disagree on layout, and the hot path pays
         # the bucket walk + key digest once per step
         self._step_layout = None
+        # prefetched-allgather completions still owed (distributed runs
+        # only; simulation finishes inline) — drained by flush_pending
+        # and lazily by Parameter.data()
+        self._pending_ag: List = []
         # shard-aware ledger attribution: telemetry/memory tags this
         # updater's optimizer/masters entries with the owning rank
         # (owner 'state:zr<r>/<N>:<param>'), so per-rank bytes are a
@@ -266,7 +300,69 @@ class ZeroPlane:
         return [(trainer._bucket_sig_key(bid, b)[1], b)
                 for bid, b in enumerate(buckets)]
 
+    def check_comm_round(self) -> None:
+        """Per-round composability re-check: compression can be enabled
+        after the plane came up, and must fail the round loudly."""
+        check(getattr(self._kv, "_compressor", None) is None,
+              "MXTPU_ZERO=1 does not compose with gradient compression "
+              "(enabled after the first step): per-key error-feedback "
+              "residuals assume the allreduce wire layout")
+
+    def overlap_active(self, trainer) -> bool:
+        """Whether this step's comm should overlap compute — re-read from
+        the env per step, like every trainer comm gate, so the autotuner
+        can probe the knob live."""
+        from ..gluon.trainer import _overlap_requested
+        return _overlap_requested() and bool(trainer._kvstore_arg)
+
+    def take_step_layout(self, trainer):
+        """Consume the (key, bucket) layout the reduce-scatter half of
+        this comm round computed (recompute if none — e.g. a restored
+        step), so both halves always agree on layout."""
+        layout = self._step_layout
+        self._step_layout = None
+        if layout is None:
+            layout = self._bucket_layout(trainer)
+        return layout
+
     # -- 1) per-bucket gradient reduce-scatter ---------------------------
+    def _bucket_parts(self, bucket):
+        """One bucket's segment map: ``parts`` — the LOCAL (i, grad, lo,
+        hi) segments this process consumes — plus ``all_parts``, every
+        rank's [lo, hi) list in bucket order (a pure function of the
+        shared partition, identical on all callers: what lets the
+        transport run a true tiled reduce-scatter)."""
+        segs, off = [], 0
+        for i, g in bucket:
+            n = int(g.size)
+            segs.append((i, g, off, off + n))
+            off += n
+        parts = [s for s in segs if s[0] in self._my_set]
+        all_parts = [[(lo, hi) for i, _g, lo, hi in segs
+                      if self.owners[i] == r] for r in range(self.world)]
+        return parts, all_parts
+
+    def launch_bucket_rs(self, trainer, key, bucket):
+        """Issue ONE bucket's reduce-scatter collective (flatten + the
+        kvstore call) and return ``(parts, slices)``, leaving the grad
+        rebinds to :meth:`finish_bucket_rs`. The overlap scheduler calls
+        this from the backward thread at grad finality, where autograd
+        may still read the live grad buffers — the collective is pure,
+        only the rebind must wait."""
+        flat_nd = trainer._bucket_wire(key, bucket)
+        parts, all_parts = self._bucket_parts(bucket)
+        slices = self._kv.zero_reduce_scatter(
+            key, flat_nd, [(lo, hi) for _, _, lo, hi in parts],
+            all_parts=all_parts)
+        return parts, slices
+
+    @staticmethod
+    def finish_bucket_rs(parts, slices) -> None:
+        """Rebind the local params' grad buffers onto the reduced
+        parameter-aligned slices a :meth:`launch_bucket_rs` returned."""
+        for (i, g, _lo, _hi), arr in zip(parts, slices):
+            g._rebind(arr._data.reshape(g.shape))
+
     def reduce_scatter_grads(self, trainer) -> None:
         """Reduce-scatter every dense gradient bucket: flatten with the
         stable ``_gbkt*`` layout (identical keys/contents to the
@@ -277,10 +373,8 @@ class ZeroPlane:
         back through the weight allgather (distributed runs: DON'T read
         or rescale the full grad set between this and the update; see
         the module docstring)."""
-        check(getattr(self._kv, "_compressor", None) is None,
-              "MXTPU_ZERO=1 does not compose with gradient compression "
-              "(enabled after the first step): per-key error-feedback "
-              "residuals assume the allreduce wire layout")
+        self.check_comm_round()
+        self.flush_pending()
         layout = self._bucket_layout(trainer)
         self._step_layout = layout
         if not layout:
@@ -288,17 +382,8 @@ class ZeroPlane:
             return
         n_coll = 0
         for key, bucket in layout:
-            flat_nd = trainer._bucket_wire(key, bucket)
-            parts, off = [], 0
-            for i, g in bucket:
-                n = int(g.size)
-                if i in self._my_set:
-                    parts.append((i, g, off, off + n))
-                off += n
-            slices = self._kv.zero_reduce_scatter(
-                key, flat_nd, [(lo, hi) for _, _, lo, hi in parts])
-            for (i, g, _lo, _hi), arr in zip(parts, slices):
-                g._rebind(arr._data.reshape(g.shape))
+            parts, slices = self.launch_bucket_rs(trainer, key, bucket)
+            self.finish_bucket_rs(parts, slices)
             n_coll += 1
         trainer.last_reduce_scatter_collectives = n_coll
         if n_coll:
@@ -325,6 +410,94 @@ class ZeroPlane:
         return flag
 
     # -- 3) per-bucket weight allgather ----------------------------------
+    def _launch_bucket_ag(self, trainer, key, bucket):
+        """Issue ONE bucket's weight allgather (payload build + the
+        kvstore call) and return the rank -> array result; the non-local
+        rebinds are :meth:`_finish_bucket_ag`'s."""
+        from ..ndarray import ndarray as _nd
+        from ..gluon.trainer import _flatten_fn
+        import jax.numpy as jnp
+        payloads = {}
+        for r in self.my_ranks:
+            segs = [trainer._params[i]._data._data.ravel()
+                    for i, _ in bucket if self.owners[i] == r]
+            if len(segs) > 1:
+                payloads[r] = _nd.NDArray(_flatten_fn()(*segs),
+                                          ctx=bucket[0][1]._ctx)
+            elif segs:
+                payloads[r] = _nd.NDArray(segs[0],
+                                          ctx=bucket[0][1]._ctx)
+            else:
+                # the collective contract: every rank contributes,
+                # owner of zero params in this bucket included
+                payloads[r] = _nd.NDArray(
+                    jnp.zeros((0,), bucket[0][1]._data.dtype),
+                    ctx=bucket[0][1]._ctx)
+        return self._kv.zero_allgather(key, payloads)
+
+    def _finish_bucket_ag(self, trainer, bucket, got) -> None:
+        """Rebind every non-local parameter in ``bucket`` from its owner
+        rank's payload (simulation: all params are local — no rebinds)."""
+        import jax.numpy as jnp
+        my = set(self.my_ranks)
+        for r in range(self.world):
+            if r in my:
+                continue  # local shard already updated in place
+            payload = jnp.asarray(got[r])
+            off = 0
+            for i, _g in bucket:
+                if self.owners[i] != r:
+                    continue
+                w = trainer._params[i]._data
+                n = int(w.size)
+                w._rebind(payload[off:off + n].reshape(w.shape))
+                off += n
+
+    def launch_allgather_bucket(self, trainer, key, bucket) -> None:
+        """Overlap mode: launch one bucket's weight allgather the moment
+        its shard updates land — while the tail buckets still update
+        (the ``DeviceStagingIter`` staging idiom applied to weights). In
+        simulation every rank's update already ran in-process, so
+        completion is immediate; a real group defers the non-local
+        rebinds — every in-flight parameter carries a pending-fetch hook
+        the next ``Parameter.data()`` read completes (first touch
+        completes the whole bucket), with :meth:`flush_pending` as the
+        barrier of last resort before the next comm round."""
+        got = self._launch_bucket_ag(trainer, key, bucket)
+        trainer.last_allgather_collectives += 1
+        if not self.distributed:
+            self._finish_bucket_ag(trainer, bucket, got)
+            return
+        done = [False]
+
+        def finish():
+            if done[0]:
+                return
+            done[0] = True
+            for i, _g in bucket:
+                trainer._params[i]._pending_fetch = None
+            self._finish_bucket_ag(trainer, bucket, got)
+
+        self._pending_ag.append(finish)
+        my = set(self.my_ranks)
+        for i, _g in bucket:
+            if self.owners[i] not in my:
+                trainer._params[i]._pending_fetch = finish
+
+    def seal_allgather(self, trainer) -> None:
+        """Close the overlapped allgather round: registry counter over
+        the launches this step made."""
+        if trainer.last_allgather_collectives:
+            _ag_counter().inc(trainer.last_allgather_collectives)
+
+    def flush_pending(self) -> None:
+        """Complete every deferred allgather rebind (distributed runs;
+        simulation never defers). Runs before the next comm round and
+        lazily from ``Parameter.data()``."""
+        pend, self._pending_ag = self._pending_ag, []
+        for fin in pend:
+            fin()
+
     def allgather_weights(self, trainer) -> None:
         """Ship this rank's updated weight segments per bucket (the same
         deterministic ``_gbkt`` layout) and rebind every non-local
@@ -332,50 +505,16 @@ class ZeroPlane:
         update already ran in-process, so the call is a chaos/retry-
         covered identity echo and no rebinds happen — the collective
         count and fault surface still match the N-rank protocol."""
-        from ..ndarray import ndarray as _nd
         # consume the layout the reduce-scatter half computed this round
-        layout = self._step_layout
-        self._step_layout = None
-        if layout is None:
-            layout = self._bucket_layout(trainer)
+        layout = self.take_step_layout(trainer)
         if not layout:
             trainer.last_allgather_collectives = 0
             return
-        from ..gluon.trainer import _flatten_fn
-        import jax.numpy as jnp
-        my = set(self.my_ranks)
         n_coll = 0
         for key, bucket in layout:
-            payloads = {}
-            for r in self.my_ranks:
-                segs = [trainer._params[i]._data._data.ravel()
-                        for i, _ in bucket if self.owners[i] == r]
-                if len(segs) > 1:
-                    payloads[r] = _nd.NDArray(_flatten_fn()(*segs),
-                                              ctx=bucket[0][1]._ctx)
-                elif segs:
-                    payloads[r] = _nd.NDArray(segs[0],
-                                              ctx=bucket[0][1]._ctx)
-                else:
-                    # the collective contract: every rank contributes,
-                    # owner of zero params in this bucket included
-                    payloads[r] = _nd.NDArray(
-                        jnp.zeros((0,), bucket[0][1]._data.dtype),
-                        ctx=bucket[0][1]._ctx)
-            got = self._kv.zero_allgather(key, payloads)
+            got = self._launch_bucket_ag(trainer, key, bucket)
+            self._finish_bucket_ag(trainer, bucket, got)
             n_coll += 1
-            for r in range(self.world):
-                if r in my:
-                    continue  # local shard already updated in place
-                payload = jnp.asarray(got[r])
-                off = 0
-                for i, _g in bucket:
-                    if self.owners[i] != r:
-                        continue
-                    w = trainer._params[i]._data
-                    n = int(w.size)
-                    w._rebind(payload[off:off + n].reshape(w.shape))
-                    off += n
         trainer.last_allgather_collectives = n_coll
         if n_coll:
             _ag_counter().inc(n_coll)

@@ -47,6 +47,12 @@ drops by more than ``MIN_GAIN`` — hiding communication under compute is
 what the knob is for, the breakdown measures exactly that, and a few
 probe steps cannot resolve wall-clock at the fence's resolution anyway
 (the reference engine overlaps unconditionally for the same reason).
+The same rule drives the knob under ``MXTPU_ZERO=1``: overlap there
+moves the plane's reduce-scatter launches into backward and the weight
+allgathers in between the shard updates — identical collectives, so the
+exposed-``comm``-share signal is again the only honest one, and
+``bucket_mb`` keeps its ordinary wall-clock rule (it sizes the
+reduce-scatter/allgather buckets exactly as it sizes allreduce ones).
 Probing mutates process env vars (the knobs' existing read points pick
 the values up per step); the FitLoop restores the operator's environment
 when fit() returns — the *decision* persists in the report, the env
@@ -519,11 +525,19 @@ class AutoTuner:
         cands = self._cands or []
         base = cands[0] if cands else None
         base_score = base.score() if base and base.walls else None
+        try:
+            from ..parallel.zero import zero_requested
+            zero_on = zero_requested()
+        except Exception:
+            zero_on = False
         out: Dict[str, object] = {
             "status": "locked" if self.locked else "probing",
             "probe_steps": self.probe,
             "warmup_steps": self.warmup,
             "min_gain_frac": MIN_GAIN,
+            # which comm plane the knobs steered: overlap/bucket_mb tune
+            # the ZeRO reduce-scatter+allgather round when the plane is on
+            "zero": zero_on,
             "locked_at_step": self.locked_at_step,
             "baseline": dict(self._baseline),
             "chosen": dict(self.chosen),

@@ -82,6 +82,22 @@ def test_bench_tiny_deadline_emits_full_headline_json():
     assert abs(zrow["rank0_share"] - 1.0 / zrow["world"]) < 0.01
     assert zrow["step_ms_zero"] > 0 and zrow["step_ms_unsharded"] > 0
     assert zrow["zero_collectives_per_step"] >= 2  # rs + ag per bucket
+    # the zero_overlap row: with MXTPU_COMM_OVERLAP=on the grad-finality
+    # reduce-scatter + allgather prefetch move the launches under
+    # comm_overlapped, so the EXPOSED comm share strictly drops vs the
+    # barrier plane on the same workload, with MFU held (loose fence:
+    # CPU child, absolute MFU is noise — the attribution move is the pin)
+    zorow = payload["zero_overlap"]
+    assert zorow["world"] == 2
+    assert zorow["step_ms_barrier"] > 0 and zorow["step_ms_overlap"] > 0
+    assert zorow["comm_overlapped_share"] > 0
+    assert zorow["exposed_comm_share_overlap"] < \
+        zorow["exposed_comm_share_barrier"]
+    assert zorow["total_comm_share_overlap"] >= \
+        zorow["comm_overlapped_share"]
+    assert zorow["mfu_barrier"] > 0
+    assert zorow["mfu_overlap"] >= 0.5 * zorow["mfu_barrier"]
+    assert zorow["collectives_per_step"] >= 2  # rs + ag per bucket
     # the comm_health row: the collective-observability plane over a
     # clean simulated ZeRO run — ledger populated, no skew (one process,
     # one clock), and ZERO watchdog firings with the watchdog armed

@@ -517,21 +517,34 @@ def test_zero_bare_update_refused(monkeypatch):
     assert tr.last_reduce_scatter_collectives >= 1
 
 
-def test_zero_supersedes_overlap(monkeypatch):
-    """MXTPU_COMM_OVERLAP=on + MXTPU_ZERO=1: the overlap scope goes
-    inactive (ZeRO owns the comm plane) and the step still lands on the
-    unsharded trajectory."""
+def test_zero_composes_with_overlap(monkeypatch):
+    """MXTPU_COMM_OVERLAP=on + MXTPU_ZERO=1: the overlap scope stays
+    ACTIVE and drives the plane's reduce-scatter (grad-finality launch,
+    rebinds at finalize) — and the step lands on the exact barrier-ZeRO
+    trajectory. Raw grad injection never fires the autograd hook, so
+    every bucket rides the finalize straggler path here; the real
+    during-backward launches are covered by tests/test_zero_overlap.py."""
     _zero_env(monkeypatch, 2)
-    monkeypatch.setenv("MXTPU_COMM_OVERLAP", "on")
-    rs = np.random.RandomState(0)
-    params = _make_params(rs, n=4)
-    tr = gluon.Trainer(params, "sgd", {"learning_rate": 0.1},
-                       kvstore=kvs.create("local"))
-    with tr.overlap_scope() as scope:
-        assert not scope.active
-    _set_grads(params, rs)
-    tr.step(4)
-    assert tr.last_reduce_scatter_collectives >= 1
+
+    def run(overlap):
+        monkeypatch.setenv("MXTPU_COMM_OVERLAP", "on" if overlap else "off")
+        rs = np.random.RandomState(0)
+        params = _make_params(rs, n=4)
+        tr = gluon.Trainer(params, "sgd", {"learning_rate": 0.1},
+                           kvstore=kvs.create("local"))
+        for _ in range(2):
+            with tr.overlap_scope() as scope:
+                assert scope.active == overlap
+            _set_grads(params, rs)
+            tr.step(4)
+            assert tr.last_reduce_scatter_collectives >= 1
+            assert tr._zero_step is None  # consumed by the update
+        return [p.data().asnumpy().copy() for p in params]
+
+    barrier = run(False)
+    overlapped = run(True)
+    for a, b in zip(barrier, overlapped):
+        np.testing.assert_array_equal(a, b)
 
 
 def test_zero_stale_grad_declines_like_unsharded(monkeypatch):

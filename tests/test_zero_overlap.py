@@ -1,0 +1,269 @@
+"""Overlapped ZeRO-1 (gluon/trainer.py + parallel/zero.py): the
+grad-finality reduce-scatter and the per-bucket allgather prefetch must
+reproduce the barrier plane's trajectory BITWISE for every grouped
+optimizer — including a sentinel-declined (non-finite) step and a
+kv_hang chaos step — while actually moving the collective launches into
+the ``comm_overlapped`` breakdown segment, with the before/after run
+reports grading in the improving direction through tools/run_compare.py.
+
+Marker ``zero`` (tier-1-safe: CPU, simulated worlds in-process)."""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd, gluon
+from mxnet_tpu import kvstore as kvs
+from mxnet_tpu import fit as fit_mod
+from mxnet_tpu.io import NDArrayIter
+from mxnet_tpu.contrib import chaos
+
+from test_zero import OPTS, _zero_env
+
+pytestmark = pytest.mark.zero
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _overlap_env(monkeypatch, world, overlap, bucket_mb="0.001"):
+    _zero_env(monkeypatch, world)
+    monkeypatch.setenv("MXTPU_COMM_OVERLAP", "on" if overlap else "off")
+    monkeypatch.setenv("MXTPU_GRAD_BUCKET_MB", bucket_mb)
+    monkeypatch.setenv("MXTPU_OPTIMIZER_AGGREGATION", "8")
+
+
+def _build_net(width=16, out=4):
+    mx.random.seed(0)
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(width, activation="relu"),
+            gluon.nn.Dense(width, activation="relu"),
+            gluon.nn.Dense(out))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def _train(opt, kw, overlap, monkeypatch, world=2, steps=4,
+           chaos_spec=None):
+    """Real-backward training loop (the autograd grad-ready hook fires
+    per grad, so overlap launches during the reverse pass) returning the
+    final weights + optimizer state for bitwise comparison."""
+    _overlap_env(monkeypatch, world, overlap)
+    net = _build_net()
+    tr = gluon.Trainer(net.collect_params(), opt, dict(kw),
+                       kvstore=kvs.create("local"))
+    rs = np.random.RandomState(0)
+    plan = None
+    if chaos_spec:
+        chaos.install(chaos_spec)
+    try:
+        for _ in range(steps):
+            x = nd.array(rs.randn(8, 16).astype(np.float32))
+            y = nd.array(rs.randn(8, 4).astype(np.float32))
+            with autograd.record():
+                loss = ((net(x) - y) ** 2).mean()
+            with tr.overlap_scope() as scope:
+                loss.backward()
+            if overlap and scope.active:
+                # the tentpole: collectives launched DURING backward,
+                # before allreduce_grads/step ever ran
+                assert tr.last_reduce_scatter_collectives >= 1
+            tr.step(8)
+        plan = chaos.active()
+    finally:
+        chaos.install("")
+    weights = [p.data().asnumpy().copy()
+               for p in net.collect_params().values()]
+
+    def flat(sts):  # None (plain sgd) | array | tuple of arrays
+        if sts is None:
+            return []
+        if isinstance(sts, (tuple, list)):
+            return [np.asarray(s).copy() for s in sts]
+        return [np.asarray(sts).copy()]
+
+    states = {i: flat(sts)
+              for i, sts in sorted(tr._updaters[0].states.items())}
+    return weights, states, plan
+
+
+@pytest.mark.parametrize("opt,kw", OPTS)
+def test_zero_overlap_bitwise_parity(opt, kw, monkeypatch):
+    """Overlapped ZeRO == barrier ZeRO, bitwise, for all six grouped
+    optimizer configs: same buckets, same sums, same per-param counters
+    — only the launch points move."""
+    bw, bs, _ = _train(opt, kw, False, monkeypatch)
+    ow, os_, _ = _train(opt, kw, True, monkeypatch)
+    for a, b in zip(bw, ow):
+        np.testing.assert_array_equal(a, b)
+    assert sorted(bs) == sorted(os_)
+    for i in bs:
+        for a, b in zip(bs[i], os_[i]):
+            np.testing.assert_array_equal(a, b)
+
+
+def test_zero_overlap_kv_hang_chaos_parity(monkeypatch):
+    """A kv_hang chaos step (rank 0 delays its collective mid-round)
+    must not change the trajectory in either mode — the overlap launches
+    ride the same chaos-wrapped kvstore entry points."""
+    spec = "kv_hang:0@1:50"
+    bw, _, plan_b = _train("adam", {"learning_rate": 0.01}, False,
+                           monkeypatch, chaos_spec=spec)
+    ow, _, plan_o = _train("adam", {"learning_rate": 0.01}, True,
+                           monkeypatch, chaos_spec=spec)
+    assert plan_b.injected["kv_hang"] >= 1
+    assert plan_o.injected["kv_hang"] >= 1
+    for a, b in zip(bw, ow):
+        np.testing.assert_array_equal(a, b)
+
+
+def _fit(monkeypatch, overlap, tmpdir=None, chaos_spec=None, steps=8,
+         loss_scale=1.0, autotune=None):
+    """One FitLoop run under simulated-world ZeRO; returns the
+    FitResult (breakdown collection is on by default)."""
+    _overlap_env(monkeypatch, 2, overlap)
+    if tmpdir is not None:
+        monkeypatch.setenv("MXTPU_RUN_REPORT_DIR", str(tmpdir))
+    else:
+        monkeypatch.delenv("MXTPU_RUN_REPORT_DIR", raising=False)
+    if autotune is not None:
+        monkeypatch.setenv("MXTPU_AUTOTUNE", autotune)
+    else:
+        monkeypatch.delenv("MXTPU_AUTOTUNE", raising=False)
+    net = _build_net()
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 1e-3}, kvstore=kvs.create("local"))
+    rs = np.random.RandomState(0)
+    it = NDArrayIter(rs.rand(steps * 4, 16).astype(np.float32),
+                     rs.rand(steps * 4, 4).astype(np.float32),
+                     batch_size=4)
+    loss = lambda out, y: ((out - y) ** 2).mean()
+    loop = fit_mod.FitLoop(net, tr, loss, it, ckpt_dir=None,
+                           loss_scale=loss_scale)
+    if chaos_spec:
+        chaos.install(chaos_spec)
+    try:
+        res = loop.fit(epochs=1)
+    finally:
+        chaos.install("")
+    res._weights = [p.data().asnumpy().copy()
+                    for p in net.collect_params().values()]
+    return res
+
+
+def test_zero_overlap_nonfinite_step_parity(monkeypatch):
+    """A chaos-poisoned (sentinel-declined) step under overlapped ZeRO:
+    the poisoned step gets an inactive scope (no clean grads ship early),
+    the global sentinel still skips it with loss-scale backoff, and the
+    whole loss/weight trajectory equals the barrier plane's bitwise."""
+    res_b = _fit(monkeypatch, False, chaos_spec="nan_grad@1",
+                 loss_scale=128.0)
+    res_o = _fit(monkeypatch, True, chaos_spec="nan_grad@1",
+                 loss_scale=128.0)
+    assert res_b.skipped_steps == [1]
+    assert res_o.skipped_steps == [1]
+    assert res_b.loss_scale == res_o.loss_scale == 64.0
+    np.testing.assert_array_equal(res_b.losses, res_o.losses)
+    for a, b in zip(res_b._weights, res_o._weights):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_zero_overlap_moves_comm_share(monkeypatch):
+    """The measured claim behind the knob: with overlap on, the exposed
+    'comm' share of step time strictly drops vs barrier ZeRO and the
+    moved time shows up in 'comm_overlapped' (total comm is attribution-
+    conserved, not deleted)."""
+    res_b = _fit(monkeypatch, False)
+    res_o = _fit(monkeypatch, True)
+    shares_b = res_b.step_breakdown["shares"]
+    shares_o = res_o.step_breakdown["shares"]
+    assert shares_o.get("comm_overlapped", 0.0) > 0.0
+    assert shares_o.get("comm", 0.0) < shares_b.get("comm", 0.0)
+    # trajectory unchanged while the attribution moved
+    np.testing.assert_array_equal(res_b.losses, res_o.losses)
+
+
+def test_zero_overlap_run_compare_direction(monkeypatch, tmp_path):
+    """The CI hook: a barrier/overlap run-report pair diffs in the
+    improving direction (comm_exposed_share shrinks, exit 0) and the
+    reversed pair FAILS the gate naming comm_exposed_share — wired
+    through tools/run_compare.py's real main()/exit codes."""
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import run_compare
+    finally:
+        sys.path.pop(0)
+    # warm the compile caches so neither measured leg pays first-compile
+    _fit(monkeypatch, False)
+    _fit(monkeypatch, True)
+    res_b = _fit(monkeypatch, False, tmpdir=tmp_path)
+    res_o = _fit(monkeypatch, True, tmpdir=tmp_path)
+    assert res_b.run_report and res_o.run_report
+    a = run_compare.load_report(res_b.run_report)
+    b = run_compare.load_report(res_o.run_report)
+    verdict = run_compare.compare(a, b, fence_pct=50.0)
+    assert "comm_exposed_share" in verdict["improved"]
+    assert "comm_exposed_share" not in verdict["regressed"]
+    # forward direction passes the gate on the comm metric; a huge fence
+    # keeps unrelated step-time noise from muddying the exit code
+    row = [r for r in verdict["metrics"]
+           if r["metric"] == "comm_exposed_share"][0]
+    assert row["verdict"] == "improved"
+    # reversed pair: the regression must be caught and NAMED
+    rc = run_compare.main([res_o.run_report, res_b.run_report,
+                           "--fence", "50", "--json"])
+    assert rc == 1
+    reverse = run_compare.compare(b, a, fence_pct=50.0)
+    assert "comm_exposed_share" in reverse["regressed"]
+
+
+def test_zero_overlap_autotune_probes_knob(monkeypatch):
+    """MXTPU_AUTOTUNE drives the overlap knob under ZeRO: the overlap
+    candidate is probed (applicable — the plane no longer supersedes the
+    knob), its exposed-comm share is recorded, the tuner locks, and the
+    report says which comm plane it steered."""
+    res = _fit(monkeypatch, False, steps=10,
+               autotune="on,probe=2,warmup=1,knobs=overlap")
+    rep = res.tuning_report
+    assert rep is not None and rep["status"] == "locked"
+    assert rep["zero"] is True
+    assert "overlap" in rep["baseline"]
+    cands = {c["label"]: c for c in rep["candidates"]}
+    assert "overlap=1" in cands
+    assert cands["overlap=1"]["comm_exposed_share"] is not None
+    assert rep["chosen"]["overlap"] in (0, 1)
+
+
+def test_zero_overlap_tile_layout():
+    """The tiled psum_scatter padding rule (parallel/collectives.py):
+    rank-major permutation, pad slots at index n, per-rank counts — and
+    a host-side gather through the perm reproduces each rank's
+    concatenated segments exactly (ragged, non-world-divisible parts)."""
+    from mxnet_tpu.parallel.collectives import _tile_layout
+    n = 11
+    all_parts = [[(0, 3), (7, 9)],   # rank 0: 5 elements
+                 [(3, 7)],           # rank 1: 4 elements
+                 [(9, 11)]]          # rank 2: 2 elements
+    counts, T, perm = _tile_layout(all_parts, n)
+    assert counts == [5, 4, 2]
+    assert T == 5
+    assert perm.shape == (15,)
+    local = np.arange(n, dtype=np.float64) * 10
+    padded = np.concatenate([local, np.zeros(1)])
+    wire = padded[perm]
+    for r, ap in enumerate(all_parts):
+        want = np.concatenate([local[lo:hi] for lo, hi in ap])
+        got = wire[r * T:r * T + counts[r]]
+        np.testing.assert_array_equal(got, want)
+        # pad tail is zeros (the appended slot)
+        np.testing.assert_array_equal(wire[r * T + counts[r]:(r + 1) * T],
+                                      0.0)
+    # the gate: wire cost world*T=15 vs allreduce ~2n=22 -> tiled wins
+    assert len(all_parts) * T < 2 * n
+    # degenerate ownership: one rank owns everything -> padding would
+    # out-ship the allreduce, the gate must refuse
+    counts1, T1, _ = _tile_layout([[(0, n)], [], []], n)
+    assert counts1 == [n, 0, 0] and T1 == n
+    assert not (3 * T1 < 2 * n)

@@ -49,6 +49,12 @@ METRICS: List[Tuple[str, Tuple[str, ...], str, str]] = [
      "higher", "rate"),
     ("mem_peak_bytes", ("memory", "peak_bytes"), "lower", "rate"),
     ("comm_max_skew_ms", ("comm_health", "max_skew_ms"), "lower", "rate"),
+    # exposed communication share of step time (step-breakdown 'comm';
+    # overlapped comm lives in 'comm_overlapped' and is deliberately NOT
+    # counted — hiding comm under compute is the improvement this metric
+    # exists to grade, e.g. overlapped vs barrier ZeRO
+    ("comm_exposed_share", ("breakdown", "shares", "comm"), "lower",
+     "rate"),
     ("skipped_steps", ("run", "skipped_steps"), "lower", "count"),
     ("nonfinite_steps", ("numerics", "nonfinite_steps"), "lower",
      "count"),
