@@ -148,6 +148,27 @@ env.declare("MXTPU_RESUMABLE_EXIT_CODE", int, 75,
             "Exit code FitLoop uses after a SIGTERM/SIGINT-triggered final "
             "checkpoint (default 75 = EX_TEMPFAIL), so the relauncher can "
             "tell 'resume me' from a real failure.")
+env.declare("MXTPU_SUPERVISE_MAX_RESTARTS", int, 8,
+            "Fleet supervisor (parallel/supervisor.py, launch.py "
+            "--supervise) restart budget: failure-driven relaunches "
+            "(shrink/resume after a crash, hang, or resumable exit) "
+            "beyond this fail the job loudly with a forensic bundle. "
+            "Capacity-driven grow relaunches do not count.")
+env.declare("MXTPU_SUPERVISE_CRASH_WINDOW_S", float, 300.0,
+            "Fleet supervisor crash-loop window: crashes of the SAME "
+            "rank slot within this many seconds count toward "
+            "MXTPU_SUPERVISE_CRASH_LIMIT.")
+env.declare("MXTPU_SUPERVISE_CRASH_LIMIT", int, 3,
+            "Fleet supervisor crash-loop threshold: this many "
+            "crash/signal deaths of the same rank slot within the "
+            "window exclude the slot (the fleet continues smaller) "
+            "instead of another same-size relaunch.")
+env.declare("MXTPU_COORD_TIMEOUT_MS", int, 120000,
+            "Bound on each blocking coordination-service KV get/barrier "
+            "hop (parallel/collectives.py CPU-backend transport). A rank "
+            "whose peer died blocks at most this long before the hop "
+            "raises — the self-healing fleet wants survivors to fail "
+            "fast, not hang for the scheduler's whole grace period.")
 env.declare("MXNET_STORAGE_FALLBACK_LOG_VERBOSE", bool, True,
             "Warn when an op without a sparse kernel densifies its inputs "
             "(storage fallback).")

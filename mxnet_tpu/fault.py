@@ -30,7 +30,7 @@ from . import ndarray as nd
 
 __all__ = ["Heartbeat", "dead_nodes", "is_recovery", "CheckpointManager",
            "CheckpointCorruptError", "write_manifest", "verify_manifest",
-           "ManifestError"]
+           "ManifestError", "latest_checkpoint_meta"]
 
 _LOG = get_logger("mxnet_tpu.fault")
 
@@ -214,6 +214,39 @@ def dead_nodes(dir_path: str, timeout: float = 60.0,
         if now - last > timeout + margin:
             out.append(rank)
     return sorted(out)
+
+
+def latest_checkpoint_meta(dir_path: str
+                           ) -> Optional[Tuple[int, Dict]]:
+    """Read the newest complete checkpoint's ``meta.json`` WITHOUT
+    constructing a :class:`CheckpointManager` — the fleet supervisor's
+    view into a worker checkpoint directory it does not own (e.g. to
+    honor the ``resize_to`` a chaos ``resize@N:M`` stamped into the
+    final checkpoint's topology record). Returns ``(step, meta)`` of the
+    newest ``DONE``-marked checkpoint whose meta parses, or None when
+    the directory holds none. Read-only: an unreadable meta is skipped
+    (the worker's own restore path owns quarantine), never raised —
+    the supervisor treats 'no readable meta' as 'no resize request'."""
+    if not os.path.isdir(dir_path):
+        return None
+    steps = []
+    for name in os.listdir(dir_path):
+        if not name.startswith("ckpt-") or "." in name:
+            continue
+        try:
+            step = int(name.split("-", 1)[1])
+        except ValueError:
+            continue
+        if os.path.exists(os.path.join(dir_path, name, "DONE")):
+            steps.append(step)
+    for step in sorted(steps, reverse=True):
+        try:
+            with open(os.path.join(dir_path, f"ckpt-{step}",
+                                   "meta.json")) as f:
+                return step, json.load(f)
+        except (OSError, ValueError):
+            continue
+    return None
 
 
 def is_recovery() -> bool:

@@ -100,6 +100,8 @@ class FitLoop:
     ckpt_dir : checkpoint/heartbeat directory; None disables persistence
         (and therefore resume + preemption checkpointing)
     ckpt_every : periodic checkpoint cadence in steps
+    on_step_end : optional ``f(step, loss)`` called after each step fully
+        completes (after its periodic checkpoint, when due, is on disk)
     loss_scale / scale_backoff / scale_growth_interval : dynamic loss
         scaling — scale multiplies the loss before backward, updates are
         un-scaled via the step batch size; a non-finite step multiplies the
@@ -117,7 +119,8 @@ class FitLoop:
                  skip_nonfinite: bool = True, seed: Optional[int] = None,
                  ignore_stale_grad: bool = False,
                  collect_breakdown: bool = True,
-                 tokens_per_sample: Optional[float] = None):
+                 tokens_per_sample: Optional[float] = None,
+                 on_step_end: Optional[Callable] = None):
         check(ckpt_every >= 1, "ckpt_every must be >= 1")
         self._net = net
         self._trainer = trainer
@@ -147,6 +150,15 @@ class FitLoop:
         # the efficiency plane's tokens/s goodput — the number a
         # transformer recipe is graded on. None = samples/s only.
         self._tokens_per_sample = tokens_per_sample
+        # on_step_end(step, loss): invoked after a step fully completes —
+        # AFTER its periodic checkpoint (if due) lands, so anything the
+        # callback records about step N is backed by durable state at
+        # least that fresh. This is the hook the self-healing soak logs
+        # per-step sample ids through: a line for step N implies a
+        # checkpoint covering N, so a kill can never leave the log ahead
+        # of what a resume will re-train. Exceptions propagate (it is
+        # caller code, not telemetry).
+        self._on_step_end = on_step_end
         self._preempted: Optional[int] = None  # signum once trapped
         self._old_handlers = {}
 
@@ -609,6 +621,8 @@ class FitLoop:
                             result.step % self._ckpt_every == 0:
                         with _segment("checkpoint"):
                             self._save(cm, result.step, epoch, consumed)
+                    if self._on_step_end is not None:
+                        self._on_step_end(result.step - 1, loss_val)
                     # close the efficiency window (result.step already
                     # incremented — report the step that RAN). Goodput:
                     # a sentinel-skipped step moved no model forward, so

@@ -40,7 +40,24 @@ def _jax():
 import itertools as _itertools
 
 _coord_seq = _itertools.count()
-_COORD_TIMEOUT_MS = 120_000
+
+
+def _coord_timeout_ms() -> int:
+    """``MXTPU_COORD_TIMEOUT_MS``: bound on each blocking coordination-
+    service get/barrier hop. A rank whose peer died blocks at most this
+    long before the hop raises — under the fleet supervisor this is what
+    turns "survivor wedged behind a dead peer" into a bounded, visible
+    failure it can act on. Strict parse: an unparseable bound must not
+    silently become an unbounded wait."""
+    from ..base import env
+    try:
+        t = int(env.get("MXTPU_COORD_TIMEOUT_MS"))
+    except (TypeError, ValueError) as e:
+        raise MXNetError(
+            f"MXTPU_COORD_TIMEOUT_MS: not an integer: "
+            f"{env.raw('MXTPU_COORD_TIMEOUT_MS')!r}") from e
+    check(t > 0, f"MXTPU_COORD_TIMEOUT_MS must be > 0, got {t}")
+    return t
 
 
 def _coord_client():
@@ -84,7 +101,7 @@ def _coord_exchange(arr, tag: str):
             if tok is not None:
                 _coll.note_waiting(tok, r)
             buf = client.blocking_key_value_get_bytes(f"{prefix}/{r}",
-                                                      _COORD_TIMEOUT_MS)
+                                                      _coord_timeout_ms())
             parts.append(np.frombuffer(bytearray(buf),
                                        arr.dtype).reshape(arr.shape))
         if tok is not None:
@@ -94,7 +111,7 @@ def _coord_exchange(arr, tag: str):
             _coll.note_waiting(tok, "barrier")
         # everyone has read everything before rank 0 garbage-collects
         # the keys
-        client.wait_at_barrier(f"{prefix}/done", _COORD_TIMEOUT_MS)
+        client.wait_at_barrier(f"{prefix}/done", _coord_timeout_ms())
         if rank == 0:
             for r in range(nproc):
                 try:
@@ -330,11 +347,11 @@ def _coord_segment_reduce(local, all_parts, tag: str):
                 if tok is not None:
                     _coll.note_waiting(tok, s)
                 buf = client.blocking_key_value_get_bytes(
-                    f"{prefix}/{s}to{rank}", _COORD_TIMEOUT_MS)
+                    f"{prefix}/{s}to{rank}", _coord_timeout_ms())
                 total = total + np.frombuffer(bytearray(buf), local.dtype)
         if tok is not None:
             _coll.note_waiting(tok, "barrier")  # see _coord_exchange
-        client.wait_at_barrier(f"{prefix}/done", _COORD_TIMEOUT_MS)
+        client.wait_at_barrier(f"{prefix}/done", _coord_timeout_ms())
         if rank == 0:
             for s in range(nproc):
                 for d in range(nproc):
@@ -445,10 +462,10 @@ def cross_process_exchange_bytes(payload: bytes, tag: str):
             if tok is not None:
                 _coll.note_waiting(tok, r)
             outs.append(bytes(client.blocking_key_value_get_bytes(
-                f"{prefix}/{r}", _COORD_TIMEOUT_MS)))
+                f"{prefix}/{r}", _coord_timeout_ms())))
         if tok is not None:
             _coll.note_waiting(tok, "barrier")  # see _coord_exchange
-        client.wait_at_barrier(f"{prefix}/done", _COORD_TIMEOUT_MS)
+        client.wait_at_barrier(f"{prefix}/done", _coord_timeout_ms())
         if rank == 0:
             for r in range(nproc):
                 try:
@@ -616,7 +633,7 @@ def barrier(mesh=None) -> None:
                 if tok is not None:
                     _coll.note_waiting(tok, "all")
                 _coord_client().wait_at_barrier(
-                    f"mxtpu_coll/{tag}", _COORD_TIMEOUT_MS)
+                    f"mxtpu_coll/{tag}", _coord_timeout_ms())
             finally:
                 if tok is not None:
                     _coll.exit_(tok)

@@ -59,7 +59,8 @@ from ..base import MXNetError, env
 
 __all__ = ["TopologyMismatchError", "elastic_enabled", "current_topology",
            "topology_record", "check_restore", "resplit_batches",
-           "reform_group", "reset_comm_state", "world_for_fingerprint"]
+           "reform_group", "reset_comm_state", "world_for_fingerprint",
+           "resize_request"]
 
 
 class TopologyMismatchError(MXNetError):
@@ -167,6 +168,29 @@ def topology_record(trainer=None, data_iter=None, batches: int = 0,
     if resize_to is not None:
         rec["resize_to"] = int(resize_to)
     return rec
+
+
+def resize_request(meta: Optional[Dict[str, Any]]) -> Optional[int]:
+    """The world size a checkpoint ASKS to be resumed at, or None.
+
+    A chaos/operator ``resize@N:M`` run exits resumably after stamping
+    ``resize_to: M`` into its final checkpoint's topology record — this
+    is the supervisor-facing read of that request (it relaunches the
+    group at M instead of the old world). A record without a topology,
+    or one whose ``resize_to`` is absent/unparseable, is 'no request'
+    (resume at the surviving world) rather than an error: the supervisor
+    consumes checkpoints it did not write."""
+    if not isinstance(meta, dict):
+        return None
+    topo = meta.get("topology")
+    if not isinstance(topo, dict):
+        return None
+    rz = topo.get("resize_to")
+    try:
+        rz = int(rz) if rz is not None else None
+    except (TypeError, ValueError):
+        return None
+    return rz if rz and rz >= 1 else None
 
 
 def check_restore(topo: Optional[Dict[str, Any]],

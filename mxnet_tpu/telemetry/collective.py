@@ -67,7 +67,8 @@ from ..base import MXNetError, env
 __all__ = ["CollectiveLedger", "ledger", "enabled", "enter", "exit_",
            "note_waiting", "compare_digests", "health_check",
            "health_summary", "reset_health", "sync_clocks", "timeout_s",
-           "health_interval", "ring_capacity"]
+           "health_interval", "ring_capacity", "parse_flight_record",
+           "scan_flight_records"]
 
 DEFAULT_RING = 4096
 
@@ -391,6 +392,13 @@ class CollectiveLedger:
             "rank": hung[0]["rank"] if hung else 0,
             "timeout_s": timeout,
             "absent_rank": absent,
+            # when the EARLIEST still-hung collective entered (epoch
+            # seconds): with absent_rank these two top-level fields are
+            # the machine-readable contract the fleet supervisor
+            # (parallel/supervisor.py) keys its shrink decision on —
+            # everything else in the record is for humans
+            "hung_since": min(self.epoch_of(r["t_enter"])
+                              for r in overdue),
             "hung": hung,
             "ring": self.records(_TAIL),
             "thread_stacks": stacks,
@@ -450,6 +458,67 @@ def exit_(tok: int) -> None:
 
 def note_waiting(tok: int, rank) -> None:
     ledger.note_waiting(tok, rank)
+
+
+# ---------------------------------------------------------------------------
+# Flight-record consumption (the supervisor side of the watchdog)
+# ---------------------------------------------------------------------------
+
+def parse_flight_record(path: str) -> Dict[str, Any]:
+    """Parse one ``coll_flight_*.json`` dump into the stable supervisor
+    schema: ``{path, pid, rank, absent_rank, hung_since, time_unix,
+    hung}``. Tolerates pre-``hung_since`` records (PR 12 layout:
+    ``hung_since`` comes back None) — the supervisor must be able to read
+    a record written by an older surviving rank. Raises
+    :class:`MXNetError` on anything that is not a hung-collective flight
+    record; an unreadable record must fail the parse, not silently count
+    as "no hang"."""
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+    except (OSError, ValueError) as e:
+        raise MXNetError(f"flight record {path}: unreadable: {e}") from e
+    if payload.get("reason") != "hung_collective":
+        raise MXNetError(
+            f"flight record {path}: reason is "
+            f"{payload.get('reason')!r}, not 'hung_collective'")
+    absent = payload.get("absent_rank")
+    return {
+        "path": path,
+        "pid": payload.get("pid"),
+        "rank": payload.get("rank"),
+        "absent_rank": int(absent) if absent is not None else None,
+        "hung_since": payload.get("hung_since"),
+        "time_unix": payload.get("time_unix"),
+        "hung": payload.get("hung", []),
+    }
+
+
+def scan_flight_records(dump_dir: str,
+                        seen: Optional[set] = None) -> List[Dict[str, Any]]:
+    """List-and-parse every ``coll_flight_*.json`` under ``dump_dir`` not
+    already in ``seen`` (a set of paths the caller owns; updated in
+    place). The supervisor polls this between worker waits — records are
+    tmp+rename so a listed file always parses; one that still fails is
+    skipped this pass and retried on the next (never marked seen)."""
+    out: List[Dict[str, Any]] = []
+    if not os.path.isdir(dump_dir):
+        return out
+    for name in sorted(os.listdir(dump_dir)):
+        if not (name.startswith("coll_flight_")
+                and name.endswith(".json")):
+            continue
+        path = os.path.join(dump_dir, name)
+        if seen is not None and path in seen:
+            continue
+        try:
+            rec = parse_flight_record(path)
+        except MXNetError:
+            continue
+        if seen is not None:
+            seen.add(path)
+        out.append(rec)
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -77,6 +77,14 @@ def pytest_configure(config):
         "is a subprocess on the coordination-service fallback, same "
         "harness as test_dist_kvstore.")
     config.addinivalue_line(
+        "markers", "supervisor: self-healing fleet supervisor tests "
+        "(parallel/supervisor.py decide ladder, capacity models, "
+        "flight-record parsing, tools/launch.py --supervise). "
+        "Tier-1-safe: CPU — the escalation ladder is a pure function, "
+        "the crash-loop/budget drill uses jax-free stub workers, and "
+        "the chaos soak is a subprocess drill on the "
+        "coordination-service fallback, same harness as test_elastic.")
+    config.addinivalue_line(
         "markers", "efficiency: efficiency/goodput plane tests "
         "(telemetry/efficiency.py per-program FLOP/byte cost registry "
         "+ live MFU/roofline rollup, telemetry/run_report.py run "

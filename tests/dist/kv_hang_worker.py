@@ -34,7 +34,6 @@ def main():
     from mxnet_tpu import kvstore as kvs
     from mxnet_tpu import telemetry
     from mxnet_tpu.contrib import chaos
-    from mxnet_tpu.parallel import collectives as coll_mod
     from mxnet_tpu.telemetry import collective as coll
     from mxnet_tpu.telemetry.chrome_trace import dump_chrome_trace
 
@@ -42,8 +41,8 @@ def main():
     hang_ms = float(os.environ.get("KV_HANG_MS", "6000"))
     # bound phase B: the blocked get must give up soon after the flight
     # record lands, so the test finishes in seconds, not 120s
-    coll_mod._COORD_TIMEOUT_MS = int(
-        os.environ.get("KV_HANG_COORD_TIMEOUT_MS", "4000"))
+    os.environ["MXTPU_COORD_TIMEOUT_MS"] = \
+        os.environ.get("KV_HANG_COORD_TIMEOUT_MS", "4000")
 
     kv = kvs.create("dist_sync")
     rank, nw = kv.rank, kv.num_workers

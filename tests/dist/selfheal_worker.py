@@ -1,0 +1,201 @@
+"""Self-healing chaos-soak worker (run via ``tools/launch.py
+--supervise``, driven by tests/test_supervisor.py).
+
+Unlike elastic_worker.py's two scripted phases, this worker is
+GENERATION-driven: the supervisor relaunches it at whatever world it
+decided, stamping ``MXTPU_SUPERVISE_GEN``, and the worker reconstructs
+everything else from disk. Per generation it:
+
+1. syncs its per-rank checkpoint dir to the NEWEST checkpoint any rank
+   holds (checkpoints are rank-identical: params replicated, trainer
+   states gathered-on-save) — a freshly grown rank, or one whose slot
+   died generations ago, catches up by copying;
+2. installs this generation's scripted chaos (``SELFHEAL_EVENTS``, a
+   JSON dict keyed by generation) at an ABSOLUTE step derived from the
+   checkpoint: ``latest ckpt step + offset`` — deterministic no matter
+   how many steps earlier generations managed to train;
+3. trains with ``ckpt_every=1`` and logs each completed step's sample
+   ids + local loss to ``steps_r{rank}_g{gen}.jsonl`` through the
+   ``on_step_end`` hook, which fires AFTER the step's checkpoint is on
+   disk. The log stream is line-buffered, and every death mode the soak
+   injects lands either at a step BEGIN (ChaosKilled) or wedged inside
+   a collective MID-step (kv_hang -> SIGKILL) — in both cases the last
+   completed step's checkpoint AND log line are already durable, so the
+   union of logged ids across all generations is exactly the trained
+   stream: the controller proves it equals the no-failure stream with
+   zero duplicates and zero drops.
+
+Below-target generations sleep ``SELFHEAL_STEP_SLEEP_MS`` per step so
+the shrunken fleet is still mid-run when the capacity model says the
+lost slot returned — that is what makes the grow path observable.
+"""
+import json
+import os
+import shutil
+import signal
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# A SIGTERM before FitLoop installs its drain-at-step-boundary handler
+# (e.g. the supervisor growing the fleet while this process is still
+# importing jax) must still exit resumable: nothing is trained yet, so
+# an immediate exit loses nothing and the supervisor classifies it as a
+# graceful drain instead of a signal death.
+try:
+    _RESUMABLE = int(os.environ.get("MXTPU_RESUMABLE_EXIT_CODE", "75"))
+except ValueError:
+    _RESUMABLE = 75
+signal.signal(signal.SIGTERM, lambda *_: os._exit(_RESUMABLE))
+
+import numpy as np
+
+N, G, SEED, EPOCHS = 48, 12, 7, 8
+
+
+def make_data():
+    """Deterministic, id-traceable: feature column 0 IS sample_id/N."""
+    rs = np.random.RandomState(42)
+    X = rs.rand(N, 3).astype(np.float32)
+    X[:, 0] = np.arange(N, dtype=np.float32) / N
+    Y = rs.rand(N, 1).astype(np.float32)
+    return X, Y
+
+
+def batch_ids(arr):
+    return [int(round(float(v) * N)) for v in arr[:, 0]]
+
+
+def _latest_step(ck):
+    """Newest DONE-marked checkpoint step in ``ck`` (0 when none)."""
+    best = 0
+    if os.path.isdir(ck):
+        for name in os.listdir(ck):
+            if name.startswith("ckpt-") and "." not in name and \
+                    os.path.exists(os.path.join(ck, name, "DONE")):
+                try:
+                    best = max(best, int(name.split("-", 1)[1]))
+                except ValueError:
+                    pass
+    return best
+
+
+def _sync_ckpt(out_dir, rank):
+    """Bring this rank's checkpoint dir up to the newest any rank holds.
+    Safe to run concurrently across ranks: each rank only REPLACES its
+    own dir, and no rank can be writing yet — the first training step's
+    gradient exchange cannot complete until every rank is past here."""
+    ck = os.path.join(out_dir, f"ckpt_r{rank}")
+    peers = [os.path.join(out_dir, d) for d in os.listdir(out_dir)
+             if d.startswith("ckpt_r") and os.path.isdir(
+                 os.path.join(out_dir, d))]
+    best = max(peers, key=_latest_step, default=None)
+    if best and best != ck and _latest_step(best) > _latest_step(ck):
+        if os.path.isdir(ck):
+            shutil.rmtree(ck)
+        shutil.copytree(best, ck)
+    return ck
+
+
+def _install_chaos(rank, gen, base):
+    """This generation's scripted fault, anchored at ``base`` (the step
+    the checkpoint resumes from) so the schedule is deterministic
+    regardless of how far earlier generations got."""
+    events = json.loads(os.environ.get("SELFHEAL_EVENTS", "{}"))
+    ev = events.get(str(gen))
+    if not ev:
+        return
+    step = base + int(ev.get("offset", 2))
+    kind = ev["kind"]
+    if kind == "kill":
+        if rank == int(ev["rank"]):
+            os.environ["MXTPU_CHAOS"] = f"kill@{step}"
+    elif kind == "kv_hang":
+        os.environ["MXTPU_CHAOS"] = f"kv_hang:{int(ev['rank'])}@{step}"
+    elif kind == "resize":
+        os.environ["MXTPU_CHAOS"] = f"resize@{step}:{int(ev['world'])}"
+    else:
+        raise AssertionError(f"unknown scripted event kind {kind!r}")
+
+
+def main():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "..", ".."))
+    from mxnet_tpu.kvstore_server import init_distributed
+    # a shrunken-to-one generation legitimately runs non-distributed
+    # (init_distributed declines world 1); dist_sync then degrades to
+    # the single-process path with rank 0 / world 1
+    if int(os.environ.get("MXTPU_NUM_WORKERS", "1")) > 1:
+        assert init_distributed(), \
+            "MXTPU_* env missing (run via tools/launch.py)"
+    import mxnet_tpu as mx
+    from mxnet_tpu import fit, gluon, io
+    from mxnet_tpu import kvstore as kvs
+    from mxnet_tpu.contrib.chaos import ChaosKilled
+
+    out_dir = os.environ["SELFHEAL_OUT_DIR"]
+    target = int(os.environ["SELFHEAL_TARGET"])
+    gen = int(os.environ.get("MXTPU_SUPERVISE_GEN", "0"))
+    sleep_ms = float(os.environ.get("SELFHEAL_STEP_SLEEP_MS", "0"))
+    kv = kvs.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+    b = G // nw
+
+    ck = _sync_ckpt(out_dir, rank)
+    base = _latest_step(ck)
+    _install_chaos(rank, gen, base)
+
+    X, Y = make_data()
+    pending = []
+
+    class RecordingIter(io.NDArrayIter):
+        def getdata(self):
+            out = super().getdata()
+            pending.append(batch_ids(out[0].asnumpy()))
+            return out
+
+    it = RecordingIter(X, Y, batch_size=b, shuffle=True, seed=SEED,
+                       num_parts=nw, part_index=rank)
+    mx.random.seed(0)
+    net = gluon.nn.Dense(1, in_units=3)
+    net.initialize(mx.init.Constant(0.25))
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05, "momentum": 0.9},
+                       kvstore=kv)
+    loss = lambda out, y: ((out - y) ** 2).sum()
+
+    # line-buffered: each completed step's line reaches the page cache
+    # with the write() itself — a later SIGKILL cannot unwrite it
+    steps_log = open(os.path.join(out_dir,
+                                  f"steps_r{rank}_g{gen}.jsonl"),
+                     "a", buffering=1)
+
+    def on_step_end(step, loss_val):
+        ids = pending.pop(0)
+        steps_log.write(json.dumps(
+            {"step": step, "ids": ids, "loss": float(loss_val)}) + "\n")
+        if nw < target and sleep_ms > 0:
+            time.sleep(sleep_ms / 1000.0)
+
+    loop = fit.FitLoop(net, tr, loss, it, ckpt_dir=ck, ckpt_every=1,
+                       async_ckpt=False, heartbeat=False, seed=SEED,
+                       on_step_end=on_step_end)
+    try:
+        res = loop.fit(epochs=EPOCHS, batch_size=G)
+    except ChaosKilled:
+        # a real kill -9 does not unwind jax's atexit teardown (which
+        # can take seconds against a half-dead coordinator) — die NOW,
+        # so the supervisor sees the crash, not the peer's watchdog
+        # firing first
+        os._exit(1)
+    print("SELFHEAL_DONE " + json.dumps(
+        {"rank": rank, "world": nw, "gen": gen, "step": res.step,
+         "weight": net.weight.data().asnumpy().ravel().tolist()}),
+        flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -40,7 +40,7 @@ def _run_bench(deadline_s, timeout, extra_env=None):
 
 
 def test_bench_tiny_deadline_emits_full_headline_json():
-    res = _run_bench(deadline_s=240, timeout=280)
+    res = _run_bench(deadline_s=300, timeout=360)
     assert res.returncode == 0, res.stderr[-1000:]
     rows = [json.loads(l) for l in res.stdout.splitlines()
             if l.startswith("{")]
@@ -145,6 +145,19 @@ def test_bench_tiny_deadline_emits_full_headline_json():
     assert elrow["resume_s"] > 0
     assert elrow["post_resize_steps"] > 0
     assert elrow["trajectory_match"] is True
+    # the selfheal row: a REAL supervised 2-worker run with one injected
+    # rank kill must auto-shrink, auto-grow back, and finish with the
+    # no-failure trajectory — the self-healing acceptance bar, measured
+    # as wall-clock detect->shrink and capacity->grow latencies
+    srow = payload["selfheal"]
+    assert srow["restarts"] == 1
+    assert srow["grows"] == 1
+    assert srow["final_world"] == 2
+    assert srow["generations"] == 3
+    assert srow["shrink_s"] > 0
+    assert srow["grow_s"] > 0
+    assert srow["union_ok"] is True
+    assert srow["trajectory_match"] is True
 
 
 def test_bench_exhausted_deadline_still_emits_parseable_row():

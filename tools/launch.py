@@ -25,7 +25,23 @@ ssh launcher
 workers on that host (ranks assigned block-wise in file order, like
 dmlc_tracker/ssh.py). `--env KEY` forwards the local value of KEY to every
 worker; PYTHONPATH and MXNET_*/MXTPU_*/JAX_* vars forward by default.
-On any worker failing, the rest are terminated.
+On any worker failing FATALLY, the rest are terminated; a worker exiting
+with the resumable drain code (MXTPU_RESUMABLE_EXIT_CODE, default 75)
+is a graceful preemption — its peers are left to finish their own final
+checkpoint, and the group's exit code reports the drain.
+
+--supervise (self-healing fleet)
+--------------------------------
+  launch.py -n 4 --supervise --supervise-ckpt ckpt_dir python train.py ...
+
+Instead of exiting on the first failure, a supervisor
+(mxnet_tpu.parallel.supervisor) relaunches the fleet: rank death or a
+hung-collective flight record shrinks to the survivors under
+MXTPU_ELASTIC=on, a graceful drain resumes at the checkpoint's
+requested world, and the fleet grows back to -n when the capacity
+model says the lost slots returned. Bounded by
+MXTPU_SUPERVISE_MAX_RESTARTS; on budget exhaustion it fails loudly
+with a forensic bundle under --supervise-dir.
 """
 import argparse
 import os
@@ -172,11 +188,44 @@ def launch_local(args, cmd):
     return _wait_group(procs)
 
 
+def _resumable_code():
+    """MXTPU_RESUMABLE_EXIT_CODE without importing mxnet_tpu — the
+    launcher must stay stdlib-only (it runs on hosts that only ssh).
+    The strict parse lives in mxnet_tpu.fit; a malformed value here
+    falls back to the default rather than killing the launcher."""
+    try:
+        return int(os.environ.get("MXTPU_RESUMABLE_EXIT_CODE", "75"))
+    except ValueError:
+        return 75
+
+
+def _classify_exit(rc):
+    """Exit-code taxonomy (mirrors supervisor.classify_exit): ``"ok"``
+    (0), ``"resumable"`` (the drain code — graceful preemption, safe to
+    relaunch), ``"signal"`` (negative: Popen's killed-by-signal
+    convention), ``"fatal"`` (anything else)."""
+    if rc == 0:
+        return "ok"
+    if rc == _resumable_code():
+        return "resumable"
+    if rc < 0:
+        return "signal"
+    return "fatal"
+
+
 def _wait_group(procs):
-    """Wait for all workers; kill the group as soon as one fails (the
-    dmlc_tracker fail-fast behavior) so a crashed rank doesn't leave the
-    rest hanging in a collective."""
-    failed = None
+    """Wait for all workers. A FATAL or signal death kills the group at
+    once (the dmlc_tracker fail-fast behavior — a crashed rank would
+    only leave the rest wedged in a collective). A RESUMABLE exit does
+    not: the peers are draining their own final checkpoint and must be
+    allowed to finish, or the relaunch would lose their shards.
+
+    Returns the group verdict: the first fatal/signal rc if any rank
+    died, else the resumable code if any rank drained, else 0 — so a
+    caller (or ``--supervise``) can tell "relaunch me" from "debug me"
+    without re-deriving the taxonomy."""
+    failed = None      # first (rank, rc) with a fatal/signal class
+    drained = False    # any rank exited with the resumable code
     alive = dict(procs)
     try:
         while alive:
@@ -185,7 +234,13 @@ def _wait_group(procs):
                 if rc is None:
                     continue
                 del alive[rank]
-                if rc != 0 and failed is None:
+                cls = _classify_exit(rc)
+                if cls == "resumable":
+                    drained = True
+                    print(f"worker {rank} exited resumable ({rc}): "
+                          f"graceful drain, waiting for peers",
+                          file=sys.stderr, flush=True)
+                elif cls in ("fatal", "signal") and failed is None:
                     failed = (rank, rc)
                     for other in alive.values():
                         try:
@@ -200,10 +255,57 @@ def _wait_group(procs):
             p.send_signal(signal.SIGINT)
         raise
     if failed:
-        print(f"worker {failed[0]} exited with {failed[1]}",
+        rank, rc = failed
+        cls = _classify_exit(rc)
+        what = f"killed by signal {-rc}" if cls == "signal" \
+            else f"exited with {rc}"
+        print(f"worker {rank} {what} (fatal): group terminated",
               file=sys.stderr)
-        return failed[1]
+        return rc
+    if drained:
+        print(f"group drained: resumable exit "
+              f"({_resumable_code()}) — relaunch to resume",
+              file=sys.stderr)
+        return _resumable_code()
     return 0
+
+
+def launch_supervised(args, cmd):
+    """Self-healing local fleet: delegate the watch/decide/relaunch loop
+    to mxnet_tpu.parallel.supervisor.Supervisor. Each fleet generation
+    gets a FRESH coordination-service port (base + generation) — the
+    jax coordinator of a dead group cannot be rejoined — and
+    generations after the first run under MXTPU_ELASTIC=on +
+    MXNET_IS_RECOVERY=1 so workers resume from the shared checkpoint
+    stream at whatever world the supervisor chose."""
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo_root not in sys.path:
+        sys.path.insert(0, repo_root)
+    from mxnet_tpu.parallel.supervisor import Supervisor, SpotCapacityModel
+
+    host, base_port = args.coordinator.rsplit(":", 1)
+    base_port = int(base_port)
+
+    def spawn(world, gen, extra):
+        sub = argparse.Namespace(**vars(args))
+        sub.num_workers = world
+        sub.coordinator = f"{host}:{base_port + gen}"
+        procs = {}
+        for rank in range(world):
+            env = dict(os.environ)
+            env.update(_worker_env(sub, rank))
+            env.update(extra)
+            procs[rank] = subprocess.Popen(cmd, env=env)
+        return procs
+
+    sup = Supervisor(
+        spawn, args.num_workers,
+        ckpt_dir=args.supervise_ckpt,
+        state_dir=args.supervise_dir,
+        capacity=SpotCapacityModel(args.num_workers,
+                                   recovery_s=args.supervise_recovery),
+        term_grace_s=args.supervise_grace)
+    return sup.run()
 
 
 def main():
@@ -227,6 +329,22 @@ def main():
     ap.add_argument("--ssh-port", type=int, default=None)
     ap.add_argument("--ssh-cmd", default="ssh",
                     help="ssh executable (tests substitute a local stub)")
+    ap.add_argument("--supervise", action="store_true",
+                    help="self-healing fleet: watch, shrink/resume on "
+                         "failure, grow back on recovered capacity "
+                         "(local launcher only)")
+    ap.add_argument("--supervise-ckpt", default=None,
+                    help="checkpoint dir the supervisor reads resize "
+                         "requests from (the workers' rank-0 dir)")
+    ap.add_argument("--supervise-dir", default=None,
+                    help="where the forensic bundle lands on budget "
+                         "exhaustion")
+    ap.add_argument("--supervise-grace", type=float, default=5.0,
+                    help="seconds between SIGTERM (drain to checkpoint) "
+                         "and SIGKILL when retiring a generation")
+    ap.add_argument("--supervise-recovery", type=float, default=30.0,
+                    help="spot capacity model: seconds until a lost "
+                         "slot is offered again")
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args()
     if not args.command:
@@ -247,6 +365,11 @@ def main():
                            sorted(_worker_env(args, rank).items()))
             print(f"[host {rank}] {env} {' '.join(cmd)}")
         return
+
+    if args.supervise:
+        if args.launcher != "local":
+            ap.error("--supervise currently requires --launcher local")
+        sys.exit(launch_supervised(args, cmd))
 
     if args.launcher == "ssh":
         sys.exit(launch_ssh(args, cmd))
