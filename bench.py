@@ -1395,6 +1395,193 @@ def _efficiency_probe(steps=6, batch=32, width=64):
     }
 
 
+def _read_fleet_ready(proc, timeout):
+    """Block until a spawned fleet replica prints its READY json line
+    (tests/dist/fleet_worker.py); raises on death/timeout."""
+    import threading
+    info = {}
+    done = threading.Event()
+
+    def _read():
+        for line in proc.stdout:
+            if line.startswith("FLEET_REPLICA_READY "):
+                try:
+                    info.update(json.loads(line.split(" ", 1)[1]))
+                except ValueError:
+                    pass
+                done.set()
+                return
+        done.set()
+
+    threading.Thread(target=_read, daemon=True).start()
+    if not done.wait(timeout) or "port" not in info:
+        raise RuntimeError(f"fleet replica not ready after {timeout:.0f}s "
+                           f"(rc={proc.poll()})")
+    return info
+
+
+def _fleet_closed_loop(router, item, seconds, clients=4):
+    """Closed-loop QPS + client-observed latency through the router."""
+    import threading
+    stop = time.perf_counter() + seconds
+    counts = [0] * clients
+    lats, errs = [], []
+    lock = threading.Lock()
+
+    def worker(i):
+        while time.perf_counter() < stop:
+            t0 = time.perf_counter()
+            try:
+                router.predict(item, timeout=60)
+                counts[i] += 1
+                with lock:
+                    lats.append((time.perf_counter() - t0) * 1000.0)
+            except Exception as e:
+                with lock:
+                    errs.append(type(e).__name__)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    lats.sort()
+    pct = lambda q: round(lats[min(len(lats) - 1,  # noqa: E731
+                                   int(q * len(lats)))], 3) if lats else None
+    return {"qps": round(sum(counts) / dt, 1), "n": sum(counts),
+            "p50_ms": pct(0.50), "p99_ms": pct(0.99),
+            "errors": len(errs)}
+
+
+def _fleet_probe():
+    """The `fleet` row: a REAL 2-process serving fleet behind the
+    least-loaded router (serving/router.py) — aggregate QPS and p99
+    with a chaos `replica_kill` firing mid-run (`dropped_requests` MUST
+    be 0: the router retries the corpse's un-acked requests on the
+    survivor), scale-up cold-start wall seconds with 0 XLA compiles
+    (published AOT bundle + shared compile cache), and dense-vs-int8
+    per-replica QPS for the registry-published `fold_batchnorm` +
+    `quantize_net` variant — the ROADMAP item 3 acceptance bar,
+    re-measured with every artifact."""
+    import shutil
+    import signal as _signal
+    import subprocess
+    import sys as _sys
+    import tempfile
+    import numpy as np
+    from mxnet_tpu import nd
+    from mxnet_tpu.contrib import chaos
+    from mxnet_tpu.contrib.quantization import quantize_net
+    from mxnet_tpu.serving import FleetRouter, ModelRegistry
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    tmp = tempfile.mkdtemp(prefix="bench_fleet_")
+    shape = (3, 32, 32)
+    procs = []
+
+    def spawn(model, publish_aot=False):
+        env = dict(os.environ)
+        env.pop("MXTPU_CHAOS", None)  # the plan lives in the ROUTER
+        env.update({"JAX_PLATFORMS": "cpu",  # replicas must not fight
+                    #                          over a single-owner TPU
+                    "FLEET_REGISTRY": os.path.join(tmp, "registry"),
+                    "FLEET_MODEL": model,
+                    "FLEET_PUBLISH_AOT": "1" if publish_aot else "0",
+                    "MXTPU_COMPILE_CACHE": os.path.join(tmp, "cache")})
+        p = subprocess.Popen(
+            [_sys.executable,
+             os.path.join(root, "tests", "dist", "fleet_worker.py")],
+            stdout=subprocess.PIPE, text=True, bufsize=1, env=env)
+        procs.append(p)
+        info = _read_fleet_ready(
+            p, timeout=max(30, min(120, _budget_left() - 20)))
+        return p, info
+
+    router = None
+    try:
+        reg = ModelRegistry(os.path.join(tmp, "registry"))
+        sig = {"bucket_shapes": [list(shape)], "dtype": "float32"}
+        reg.publish("bench_cnn32", net=_serve_model(), signature=sig)
+        # the int8 per-replica throughput variant: fold_batchnorm +
+        # calibrated int8 rewrite, published as its own registry model
+        rs = np.random.RandomState(0)
+        calib = [nd.from_jax(rs.rand(8, *shape).astype(np.float32))]
+        qnet = quantize_net(_serve_model(), calib)
+        reg.publish("bench_cnn32_int8", net=qnet, signature=sig)
+
+        p1, i1 = spawn("bench_cnn32", publish_aot=True)
+        p2, i2 = spawn("bench_cnn32")
+        router = FleetRouter(heartbeat_ms=100)
+        router.add_replica("r1", ("127.0.0.1", i1["port"]), pid=i1["pid"])
+        router.add_replica("r2", ("127.0.0.1", i2["port"]), pid=i2["pid"])
+        router.set_kill_hook(
+            lambda name: os.kill(
+                {"r1": i1["pid"], "r2": i2["pid"]}[name], _signal.SIGKILL))
+        item = rs.rand(*shape).astype(np.float32)
+        router.predict(item, timeout=60)  # one warm round trip each side
+
+        # churn phase: kill one replica (chaos grammar) mid closed-loop
+        chaos.install("replica_kill@25")
+        churn_s = min(4.0, max(1.5, _budget_left() / 20))
+        point = _fleet_closed_loop(router, item, churn_s)
+        chaos.uninstall()
+        killed = [n for n, s in router.states().items()
+                  if not s["healthy"]]
+        dropped = point["errors"]
+
+        # scale-up: third replica must cold-start with ZERO compiles
+        t0 = time.perf_counter()
+        p3, i3 = spawn("bench_cnn32")
+        router.add_replica("r3", ("127.0.0.1", i3["port"]),
+                           pid=i3["pid"])
+        router.predict(item, timeout=60)
+        scaleup_s = time.perf_counter() - t0
+
+        # per-replica dense vs int8 closed-loop (each behind its own
+        # single-replica router: replica-level throughput, no fan-out)
+        per_s = min(2.0, max(0.8, _budget_left() / 30))
+        dense_router = FleetRouter(heartbeat_ms=200)
+        dense_router.add_replica("d", ("127.0.0.1", i3["port"]))
+        dense_point = _fleet_closed_loop(dense_router, item, per_s)
+        dense_router.close()
+        p4, i4 = spawn("bench_cnn32_int8")
+        int8_router = FleetRouter(heartbeat_ms=200)
+        int8_router.add_replica("q", ("127.0.0.1", i4["port"]))
+        int8_point = _fleet_closed_loop(int8_router, item, per_s)
+        int8_router.close()
+
+        router.stop_fleet(drain=True)
+        return {
+            "replicas": 2,
+            "aggregate_qps": point["qps"],
+            "requests": point["n"],
+            "p50_ms": point["p50_ms"],
+            "p99_ms": point["p99_ms"],
+            "killed": len(killed),
+            "dropped_requests": dropped,
+            "scaleup_s": round(scaleup_s, 3),
+            "scaleup_compiles": int(i3.get("xla_compiles", -1)),
+            "scaleup_aot_loaded": int(
+                (i3.get("warm") or {}).get("aot_loaded", 0)),
+            "dense_qps": dense_point["qps"],
+            "int8_qps": int8_point["qps"],
+        }
+    finally:
+        try:
+            chaos.uninstall()
+        except Exception:
+            pass
+        if router is not None:
+            router.close()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _run_child(mode, args_rest):
     if not _init_backend():
         os._exit(1)
@@ -1483,6 +1670,13 @@ def _run_child(mode, args_rest):
                       flush=True)
             except Exception as e:
                 log(f"selfheal probe failed: {e}")
+        if os.environ.get("MXTPU_BENCH_FLEET", "1") != "0":
+            try:
+                flrow = _fleet_probe()
+                print("EXTRA_ROW " + json.dumps({"fleet": flrow}),
+                      flush=True)
+            except Exception as e:
+                log(f"fleet probe failed: {e}")
 
 
 # global wall-clock budget: the driver kills the whole bench at some
@@ -1727,6 +1921,13 @@ def main():
                 # grow counts, shrink/grow relaunch wall seconds, and
                 # the union + trajectory verdict vs a never-failed run
                 payload["selfheal"] = _EXTRAS["selfheal"]
+            if "fleet" in _EXTRAS:
+                # the serving-fleet evidence: a real 2-process fleet
+                # behind the least-loaded router — aggregate QPS/p99
+                # with a replica_kill mid-run (dropped_requests must be
+                # 0), zero-compile scale-up wall seconds, and the
+                # dense-vs-int8 per-replica throughput ratio
+                payload["fleet"] = _EXTRAS["fleet"]
             # the train number is safe on stdout NOW; each optional row
             # that lands re-emits the extended line immediately, so a
             # truncated run keeps everything measured so far
@@ -1774,7 +1975,8 @@ def main():
                                    "MXTPU_BENCH_NUMERICS": "0",
                                    "MXTPU_BENCH_EFFICIENCY": "0",
                                    "MXTPU_BENCH_ELASTIC": "0",
-                                   "MXTPU_BENCH_SELFHEAL": "0"})
+                                   "MXTPU_BENCH_SELFHEAL": "0",
+                                   "MXTPU_BENCH_FLEET": "0"})
                     if t8:
                         payload["train_int8_imgs_per_sec"] = round(t8, 2)
                         print(json.dumps(payload), flush=True)

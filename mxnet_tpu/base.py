@@ -223,6 +223,22 @@ env.declare("MXTPU_SERVE_REPLAY", str, "",
             "padded batch) signature; new replicas prewarm from it "
             "(serving.warm_from_replay / FleetServer deploy). Empty = "
             "recording off.")
+env.declare("MXTPU_FLEET_MIN", int, 1,
+            "serving fleet (serving/autoscale.py): lower bound on live "
+            "replica processes; the autoscaler never drains below it. "
+            ">= 1.")
+env.declare("MXTPU_FLEET_MAX", int, 4,
+            "serving fleet: upper bound on replica processes; sustained "
+            "queue pressure scales up to (never past) it. >= "
+            "MXTPU_FLEET_MIN.")
+env.declare("MXTPU_FLEET_TARGET_QUEUE", int, 16,
+            "serving fleet: per-replica queue-depth target; mean depth "
+            "above it for consecutive autoscaler ticks is scale-up "
+            "pressure (serving.autoscale.decide).")
+env.declare("MXTPU_FLEET_HEARTBEAT_MS", float, 200.0,
+            "serving fleet router: interval between metrics-heartbeat "
+            "polls of each replica (queue depth / p95 / active version "
+            "drive least-loaded routing and the version floor).")
 env.declare("MXNET_HOME", str, "",
             "Root directory for datasets and model artifacts "
             "(default ~/.mxnet; ref: docs/faq/env_var.md MXNET_HOME).")

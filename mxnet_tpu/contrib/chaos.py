@@ -64,6 +64,13 @@ Grammar: comma-separated events, each ``kind[:prob][@target]``:
   its DONE marker and manifest land: a forged-complete corrupt model,
   exactly what ``ModelRegistry.resolve``'s verify + quarantine + fallback
   must catch (hook: ``serving.registry.ModelRegistry.publish``).
+- ``replica_kill@N[:R]`` — kill one serving-fleet replica process once the
+  router has dispatched ``N`` requests: replica index ``R`` of the sorted
+  live set, default the busiest. Runs on the router's *routed-request*
+  clock, not the training step clock. The zero-dropped-in-flight proof:
+  the router must retry every un-acked request of the corpse on a
+  survivor (hook: ``serving.router.FleetRouter.submit`` via
+  ``set_kill_hook``).
 
 Step-scheduled events fire on the plan's step clock, advanced exactly once
 per training step by the loop owner (``FitLoop`` and ``Trainer.step`` both
@@ -109,7 +116,7 @@ class ChaosKilled(MXNetError):
 
 _KINDS = ("nan_grad", "inf_grad", "kill", "preempt", "resize",
           "ckpt_corrupt", "kv_flake", "kv_slow", "kv_hang", "serve_slow",
-          "registry_corrupt", "mem_pressure")
+          "registry_corrupt", "mem_pressure", "replica_kill")
 
 
 class ChaosPlan:
@@ -139,6 +146,7 @@ class ChaosPlan:
         self.serve_slow_ms = 0.0
         self._kv_hang: Dict[int, tuple] = {}  # step -> (rank, delay_ms)
         self._mem_pressure: Dict[int, int] = {}  # step -> budget bytes
+        self._replica_kill: Dict[int, int] = {}  # routed count -> replica
         self._resize: Dict[int, Optional[int]] = {}  # step -> world|None
         # observability: how many of each fault actually fired
         self.injected: Dict[str, int] = {k: 0 for k in _KINDS}
@@ -240,6 +248,33 @@ class ChaosPlan:
             if world is not None and world < 1:
                 raise MXNetError(f"chaos: resize world {world} < 1")
             self._resize[step] = world
+            return
+        if kind == "replica_kill":
+            # replica_kill@N[:R] — fire once the router has dispatched N
+            # requests; R = victim index in the sorted live-replica set
+            # (omitted = -1 = busiest). The ':' slot would be a
+            # probability for other kinds; which replica dies is never
+            # random here, so the index rides the '@' target.
+            if prob is not None:
+                raise MXNetError("chaos: replica_kill takes no probability")
+            if target is None:
+                raise MXNetError("chaos: replica_kill needs a routed-count "
+                                 "target, e.g. replica_kill@40 or "
+                                 "replica_kill@40:1")
+            n_s, _, r_s = target.partition(":")
+            try:
+                n = int(n_s)
+                r = int(r_s) if r_s else -1
+            except ValueError:
+                raise MXNetError(
+                    f"chaos: bad replica_kill target {target!r} "
+                    "(expected COUNT or COUNT:REPLICA)")
+            if n < 1:
+                raise MXNetError(f"chaos: replica_kill count {n} < 1")
+            if r < -1:
+                raise MXNetError(f"chaos: replica_kill replica index {r} "
+                                 "< 0 (or -1 for busiest)")
+            self._replica_kill[n] = r
             return
         if kind == "mem_pressure":
             # mem_pressure@N[:BYTES] — synthetic budget shrink at step N:
@@ -371,6 +406,20 @@ class ChaosPlan:
         self.injected["mem_pressure"] += 1
         _count_injection("mem_pressure")
         return budget
+
+    def replica_kill_due(self, routed: int) -> Optional[int]:
+        """replica_kill@N[:R] — the victim replica index once ``routed``
+        dispatched requests have been reached (-1 = busiest), else None.
+        Runs on the router's routed-request clock (no ``begin_step``
+        needed). Consumed on read (fires once); the router feeds the
+        index to its kill hook, which destroys the process/endpoint."""
+        due = [n for n in self._replica_kill if int(routed) >= n]
+        if not due:
+            return None
+        r = self._replica_kill.pop(min(due))
+        self.injected["replica_kill"] += 1
+        _count_injection("replica_kill")
+        return r
 
     def kv_delay_s(self) -> float:
         """kv_slow:P@MS — seconds of injected wire delay for this kvstore

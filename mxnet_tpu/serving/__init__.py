@@ -23,6 +23,11 @@ Pieces (one module each):
   with atomic hot-swap deploys and rolling fleet-wide rollouts.
 - :mod:`.aot` — zero-compile cold start: persistent compile cache, AOT
   executable bundles, signature-replay warmers.
+- :mod:`.router` — the process-level fleet: ``ReplicaEndpoint`` (socket
+  front-end of one replica process), ``FleetRouter`` (least-loaded
+  dispatch, retry-on-death, rolling deploy over processes).
+- :mod:`.autoscale` — pure ``decide()`` scaling ladder + the
+  ``Autoscaler`` executor (``MXTPU_FLEET_MIN/MAX/TARGET_QUEUE``).
 
 Quick start::
 
@@ -52,8 +57,12 @@ from .batcher import (Batch, BucketTable, DeadlineExceeded,  # noqa: F401
 from .cache import SignatureCache  # noqa: F401
 from .fleet import DeployReport, Fleet, FleetServer  # noqa: F401
 from .metrics import ServerMetrics  # noqa: F401
+from .autoscale import Autoscaler, decide  # noqa: F401
 from .registry import (ModelRegistry, RegistryCorruptError,  # noqa: F401
                        ResolvedVersion)
+from .router import (FleetRouter, ReplicaClient,  # noqa: F401
+                     ReplicaDead, ReplicaEndpoint, RouterFuture,
+                     replica_main)
 from .server import ActiveModel, ModelServer  # noqa: F401
 
 __all__ = ["ModelServer", "SignatureCache", "ServerMetrics", "ServingError",
@@ -62,4 +71,6 @@ __all__ = ["ModelServer", "SignatureCache", "ServerMetrics", "ServingError",
            "ModelRegistry", "ResolvedVersion", "RegistryCorruptError",
            "FleetServer", "Fleet", "DeployReport", "ActiveModel",
            "ReplayLog", "enable_compile_cache", "runtime_fingerprint",
-           "warm_from_replay"]
+           "warm_from_replay", "FleetRouter", "ReplicaEndpoint",
+           "ReplicaClient", "ReplicaDead", "RouterFuture", "replica_main",
+           "Autoscaler", "decide"]

@@ -102,9 +102,13 @@ class FleetServer(ModelServer):
         net = self._load_net(resolved)
         super().__init__(net, **kwargs)
         self._active.version = resolved.version
+        # exposed so process-level replicas (serving/router.py) can report
+        # their cold-start compile bill (the 0-compile scale-up proof)
+        self.cold_start_stats: Dict[str, int] = {}
         if warm:
             t0 = time.perf_counter()
             stats = self._warm_active(self._active, resolved)
+            self.cold_start_stats = dict(stats)
             _LOG.info("fleet: %s/%s cold start warmed in %.2fs (%s)",
                       model, resolved.version,
                       time.perf_counter() - t0, stats)

@@ -162,6 +162,7 @@ class ModelServer:
         self._started = False
         self._closed = False        # no new admissions
         self._abort = False         # drop queued work instead of finishing
+        self._report_written = False  # one serving run report per lifetime
         self._sig_event = threading.Event()
         self._signum: Optional[int] = None
         self._old_handlers: dict = {}
@@ -212,6 +213,36 @@ class ModelServer:
         if alive:
             raise MXNetError(f"serving drain timed out after {timeout}s "
                              f"(stuck threads: {alive})")
+        if drain:
+            # serving-mode run report: the drained metrics snapshot is
+            # this replica's verdict (QPS, p50/p95/p99, sheds) — written
+            # only when the report plane is on and traffic was served,
+            # so a replica that dies before its first response leaves
+            # the directory clean for run_compare
+            self._maybe_write_run_report()
+
+    def _maybe_write_run_report(self) -> None:
+        from ..telemetry.run_report import report_dir
+        if self._report_written or report_dir() is None:
+            return
+        m = self.metrics_json()
+        if not m.get("responses_total"):
+            return
+        try:
+            self.write_run_report(metrics_json=m)
+        except Exception as e:
+            _LOG.warning("serving run report failed: %s", e)
+
+    def write_run_report(self, directory: Optional[str] = None,
+                         extra: Optional[dict] = None,
+                         metrics_json: Optional[dict] = None) -> str:
+        """Write this server's serving-mode run report (see
+        ``telemetry.run_report.write_serving_report``)."""
+        from ..telemetry.run_report import write_serving_report
+        path = write_serving_report(metrics_json or self.metrics_json(),
+                                    directory=directory, extra=extra)
+        self._report_written = True
+        return path
 
     def install_signal_handlers(self) -> None:
         """Trap SIGTERM/SIGINT (main thread only) so ``serve_forever``

@@ -35,7 +35,8 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "serving: inference-serving subsystem tests "
         "(mxnet_tpu/serving: batcher, signature cache, admission, "
-        "metrics). Tier-1-safe: CPU, in-process transport, no sockets.")
+        "metrics, fleet router/autoscaler). Tier-1-safe: CPU; loopback "
+        "sockets only (the fleet tests), never the network.")
     config.addinivalue_line(
         "markers", "telemetry: unified telemetry subsystem tests "
         "(mxnet_tpu/telemetry: tracer, chrome-trace export, metrics "

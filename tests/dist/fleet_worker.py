@@ -1,0 +1,40 @@
+"""One serving-fleet replica process for the cross-process drill
+(tests/test_fleet_router.py and bench.py's `fleet` row both spawn this).
+
+Config rides env vars (the dist-worker convention):
+
+- ``FLEET_REGISTRY``     shared ModelRegistry root (required)
+- ``FLEET_MODEL``        registry model name (default ``drill``)
+- ``FLEET_PORT``         port to bind (default 0 = ephemeral)
+- ``FLEET_VERSION``      version to serve (default ``current``)
+- ``FLEET_PUBLISH_AOT``  '1' = publish the warm AOT bundle back to the
+                         registry (the first replica does; later
+                         replicas then cold-start with 0 compiles)
+
+Prints one ``FLEET_REPLICA_READY {json}`` line (bound port, pid, active
+version, cold-start compile counts), serves until SIGTERM or a router
+``stop`` op, drains, and exits with the resumable code (75).
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import sys  # noqa: E402
+
+
+def main():
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    sys.path.insert(0, repo)
+    from mxnet_tpu.serving import replica_main
+    replica_main(
+        os.environ["FLEET_REGISTRY"],
+        os.environ.get("FLEET_MODEL", "drill"),
+        port=int(os.environ.get("FLEET_PORT", "0")),
+        version=os.environ.get("FLEET_VERSION", "current"),
+        publish_aot=os.environ.get("FLEET_PUBLISH_AOT", "0") == "1",
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -158,6 +158,21 @@ def test_bench_tiny_deadline_emits_full_headline_json():
     assert srow["grow_s"] > 0
     assert srow["union_ok"] is True
     assert srow["trajectory_match"] is True
+    # the fleet row: a REAL 2-process serving fleet behind the
+    # least-loaded router with a chaos replica_kill mid closed-loop —
+    # zero dropped requests (the router retried the corpse's un-acked
+    # in-flight on the survivor) and a ZERO-compile scale-up from the
+    # published AOT bundle + shared compile cache
+    frow = payload["fleet"]
+    assert frow["replicas"] == 2
+    assert frow["aggregate_qps"] > 0 and frow["requests"] > 0
+    assert frow["p99_ms"] > 0
+    assert frow["killed"] == 1
+    assert frow["dropped_requests"] == 0
+    assert frow["scaleup_s"] > 0
+    assert frow["scaleup_compiles"] == 0
+    assert frow["scaleup_aot_loaded"] > 0
+    assert frow["dense_qps"] > 0 and frow["int8_qps"] > 0
 
 
 def test_bench_exhausted_deadline_still_emits_parseable_row():

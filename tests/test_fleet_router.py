@@ -1,0 +1,548 @@
+"""Cross-process serving fleet: router, autoscaler, chaos drill.
+
+Tier-1-safe: CPU, loopback sockets only. The policy layer
+(:func:`autoscale.decide`) is a pure table test; the transport layer
+(:class:`ReplicaEndpoint` / :class:`FleetRouter`) is exercised against
+in-process :class:`ModelServer` replicas over real loopback sockets; the
+acceptance drill spawns REAL replica processes
+(tests/dist/fleet_worker.py) and proves the two fleet contracts:
+
+- a SIGKILL'd replica drops ZERO in-flight requests (the router retries
+  its un-acked ids on survivors; replicas are idempotent by request id),
+- a scale-up replica cold-starts with ZERO XLA compiles (published AOT
+  bundle + shared compile cache).
+"""
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.contrib import chaos
+from mxnet_tpu.contrib.chaos import ChaosPlan
+from mxnet_tpu.serving import (Autoscaler, FleetRouter, FleetServer,
+                               ModelRegistry, ModelServer, QueueFull,
+                               ReplicaEndpoint, decide)
+from mxnet_tpu.serving.autoscale import (fleet_max, fleet_min,
+                                         fleet_target_queue)
+from mxnet_tpu.serving.router import (_array_header, fleet_heartbeat_ms,
+                                      recv_frame, send_frame)
+
+pytestmark = pytest.mark.serving
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _endpoint(fn=None, name="rep", **kwargs):
+    """An in-process replica: ModelServer over a callable, behind a
+    loopback ReplicaEndpoint."""
+    srv = ModelServer(fn or (lambda x: x * 2), bucket_shapes=[(8,)],
+                      max_batch_size=kwargs.pop("max_batch_size", 4),
+                      name=name, **kwargs)
+    return ReplicaEndpoint(srv).start()
+
+
+def _obs(**replicas):
+    """One decide() observation from keyword replica states."""
+    return {"replicas": {
+        n: {"queue_depth": s[0], "inflight": s[1], "healthy": s[2]}
+        for n, s in replicas.items()}}
+
+
+def _dense_net(seed=0):
+    mx.random.seed(seed)
+    net = gluon.nn.Dense(4, in_units=8)
+    net.initialize(mx.init.Xavier())
+    with mx.autograd.pause():
+        net(nd.ones((1, 8)))
+    return net
+
+
+SIG = {"bucket_shapes": [[8]], "dtype": "float32"}
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_chaos():
+    yield
+    chaos.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# the pure policy: decide() is a table
+# ---------------------------------------------------------------------------
+
+KNOBS = dict(min_replicas=2, max_replicas=4, target_queue=8,
+             pressure_ticks=2, idle_ticks=3)
+
+IDLE = (0, 0, True)
+BUSY = (20, 3, True)
+DEAD = (0, 0, False)
+
+
+@pytest.mark.parametrize("history,op,extra", [
+    # no observations yet -> hands off
+    ([], "none", {}),
+    # rung 1: any death -> respawn, and it names every corpse
+    ([_obs(a=IDLE, b=DEAD)], "respawn", {"replicas": ["b"]}),
+    ([_obs(a=DEAD, b=DEAD)], "respawn", {"replicas": ["a", "b"]}),
+    # rung 1 preempts rung 3: a dead replica matters more than pressure
+    ([_obs(a=BUSY, b=DEAD)] * 3, "respawn", {"replicas": ["b"]}),
+    # rung 2: below the floor -> scale up TO the floor
+    ([_obs(a=IDLE)], "scale_up", {"add": 1}),
+    # rung 3: sustained pressure -> +1 (needs the full window)
+    ([_obs(a=BUSY, b=BUSY)] * 2, "scale_up", {"add": 1}),
+    ([_obs(a=IDLE, b=IDLE), _obs(a=BUSY, b=BUSY)], "none", {}),
+    # rung 3 bounded: pressure at MXTPU_FLEET_MAX is a no-op
+    ([_obs(a=BUSY, b=BUSY, c=BUSY, d=BUSY)] * 2, "none", {}),
+    # rung 4: sustained idle above the floor -> drain one (deterministic
+    # least-loaded victim, lexicographic tie-break)
+    ([_obs(a=IDLE, b=IDLE, c=IDLE)] * 3, "scale_down", {"drain": "a"}),
+    # one in-flight request ANYWHERE blocks the drain: idle means the
+    # whole fleet is quiescent, not just the victim
+    ([_obs(a=(0, 1, True), b=IDLE, c=IDLE)] * 3, "none", {}),
+    # rung 4 bounded: idle AT the floor never drains below it
+    ([_obs(a=IDLE, b=IDLE)] * 3, "none", {}),
+    # rung 4 needs the full idle window
+    ([_obs(a=IDLE, b=IDLE, c=IDLE)] * 2, "none", {}),
+    # steady state
+    ([_obs(a=(3, 1, True), b=(2, 0, True))], "none", {}),
+])
+def test_decide_table(history, op, extra):
+    action = decide(history, **KNOBS)
+    assert action["op"] == op, action
+    for k, v in extra.items():
+        assert action[k] == v, action
+    assert action["reason"]
+
+
+def test_decide_pressure_is_mean_depth_not_max():
+    # one hot replica over an idle one: mean 10 > target 8 fires; the
+    # same hot replica next to three idle ones (mean 5) does not
+    hot, idle = (20, 0, True), (0, 0, True)
+    fires = [_obs(a=hot, b=idle)] * 2
+    assert decide(fires, **KNOBS)["op"] == "scale_up"
+    spread = [_obs(a=hot, b=idle, c=idle, d=idle)] * 2
+    assert decide(spread, **KNOBS)["op"] == "none"
+
+
+def test_decide_validates_knobs():
+    with pytest.raises(MXNetError, match="max_replicas"):
+        decide([_obs(a=IDLE)], min_replicas=4, max_replicas=2,
+               target_queue=8)
+    with pytest.raises(MXNetError, match="min_replicas"):
+        decide([], min_replicas=0, max_replicas=2, target_queue=8)
+
+
+def test_fleet_env_knobs_are_strict(monkeypatch):
+    for var, fn in [("MXTPU_FLEET_MIN", fleet_min),
+                    ("MXTPU_FLEET_MAX", fleet_max),
+                    ("MXTPU_FLEET_TARGET_QUEUE", fleet_target_queue)]:
+        monkeypatch.setenv(var, "many")
+        with pytest.raises(MXNetError, match=var):
+            fn()
+        monkeypatch.setenv(var, "0")
+        with pytest.raises(MXNetError, match="must be >= 1"):
+            fn()
+        monkeypatch.setenv(var, "3")
+        assert fn() == 3
+    monkeypatch.setenv("MXTPU_FLEET_HEARTBEAT_MS", "fast")
+    with pytest.raises(MXNetError, match="MXTPU_FLEET_HEARTBEAT_MS"):
+        fleet_heartbeat_ms()
+    monkeypatch.setenv("MXTPU_FLEET_HEARTBEAT_MS", "-5")
+    with pytest.raises(MXNetError, match="must be > 0"):
+        fleet_heartbeat_ms()
+
+
+# ---------------------------------------------------------------------------
+# routing: least-loaded pick against synthetic heartbeats
+# ---------------------------------------------------------------------------
+
+class _FakeClient:
+    def __init__(self, name):
+        self.name = name
+        self.dead = threading.Event()
+        self.pid = None
+
+    def close(self):
+        pass
+
+
+def _synthetic_router(load):
+    """A router with fake clients and hand-written heartbeat state:
+    ``load`` maps name -> (inflight, queue_depth, version)."""
+    router = FleetRouter(heartbeat_ms=60000)
+    for name, (inflight, depth, version) in load.items():
+        router._replicas[name] = _FakeClient(name)
+        router._inflight[name] = inflight
+        router._state[name] = {"queue_depth": depth, "version": version}
+    return router
+
+
+def test_pick_prefers_least_loaded():
+    router = _synthetic_router({"a": (5, 0, None), "b": (0, 1, None),
+                                "c": (2, 2, None)})
+    try:
+        # score = router inflight + heartbeat queue depth: b=1, c=4, a=5
+        for _ in range(4):  # stable across the round-robin start offset
+            assert router._pick(set()).name == "b"
+        assert router._pick({"b"}).name == "c"
+        assert router._pick({"b", "c"}).name == "a"
+        assert router._pick({"a", "b", "c"}) is None
+    finally:
+        router.close()
+
+
+def test_pick_respects_version_floor():
+    router = _synthetic_router({"old": (0, 0, "v1"), "new": (9, 9, "v2"),
+                                "fresh": (0, 0, None)})
+    router._version_floor = (2, "v2")
+    try:
+        # 'old' announces v1 < floor: excluded even though it is idle;
+        # an unknown version (a replica spawned from CURRENT) passes
+        assert router._pick(set()).name == "fresh"
+        assert router._pick({"fresh"}).name == "new"
+        # the floor is a preference, not a deadlock: when every
+        # candidate is below it the filter falls back to all of them
+        assert router._pick({"fresh", "new"}).name == "old"
+    finally:
+        router.close()
+
+
+def test_states_snapshot_shapes_the_autoscaler_observation():
+    router = _synthetic_router({"a": (2, 7, "v3")})
+    router._replicas["a"].dead.set()
+    try:
+        s = router.states()["a"]
+        assert s == {"queue_depth": 7, "p95_ms": 0.0, "version": "v3",
+                     "inflight": 2, "healthy": False}
+    finally:
+        router.close()
+
+
+# ---------------------------------------------------------------------------
+# transport: endpoint idempotence, death retry, shed failover
+# ---------------------------------------------------------------------------
+
+def test_endpoint_is_idempotent_by_request_id():
+    calls = []
+
+    def fn(x):
+        calls.append(int(x.shape[0]))
+        return x * 2
+
+    ep = _endpoint(fn, name="idem")
+    try:
+        conn = socket.create_connection(ep.addr, timeout=10)
+        arr = np.ones(8, dtype=np.float32)
+        header = _array_header("predict", "rid-1", arr)
+        send_frame(conn, header, arr.tobytes())
+        h1, p1 = recv_frame(conn)
+        assert h1["op"] == "result" and h1["id"] == "rid-1"
+        computed = sum(calls)
+        # the retry double: same id again (a router re-sends a dead
+        # replica's un-acked ids; a survivor may see a duplicate) must
+        # answer from the response cache, byte-identical, no recompute
+        send_frame(conn, header, arr.tobytes())
+        h2, p2 = recv_frame(conn)
+        assert h2["op"] == "result" and h2["id"] == "rid-1"
+        assert p2 == p1
+        assert sum(calls) == computed
+        conn.close()
+    finally:
+        ep.close()
+
+
+def test_replica_death_retries_in_flight_with_zero_drops():
+    def slow(x):
+        time.sleep(0.02)
+        return x * 2
+
+    ep1 = _endpoint(slow, name="r1")
+    ep2 = _endpoint(slow, name="r2")
+    router = FleetRouter(heartbeat_ms=50)
+    try:
+        router.add_replica("r1", ep1.addr)
+        router.add_replica("r2", ep2.addr)
+        x = np.ones(8, dtype=np.float32)
+        futs = [router.submit(x) for _ in range(16)]
+        ep1.close(abort=True)  # the replica process "dies" mid-flight
+        outs = [f.result(timeout=30) for f in futs]  # ZERO dropped
+        assert len(outs) == 16
+        for out in outs:
+            np.testing.assert_allclose(out, 2 * x, rtol=1e-6)
+        states = router.states()
+        assert states["r2"]["healthy"]
+        assert not states["r1"]["healthy"]
+        assert router.live_count() == 1
+        # the corpse's share was re-dispatched, so some future retried
+        assert any(f.retries > 0 for f in futs)
+        assert all(f.replica == "r2" for f in futs if f.retries)
+    finally:
+        router.close()
+        ep1.close(abort=True)
+        ep2.close(abort=True)
+
+
+def test_saturated_fleet_sheds_with_typed_queuefull():
+    def slow(x):
+        time.sleep(0.05)
+        return x
+
+    ep = _endpoint(slow, name="tiny", max_batch_size=1, queue_depth=1)
+    router = FleetRouter(heartbeat_ms=60000)
+    try:
+        router.add_replica("tiny", ep.addr)
+        x = np.ones(8, dtype=np.float32)
+        futs = [router.submit(x) for _ in range(10)]
+        results, shed = 0, 0
+        for f in futs:
+            try:
+                f.result(timeout=30)
+                results += 1
+            except QueueFull:
+                shed += 1  # typed error crossed the wire, every
+                #            failover candidate exhausted
+        assert results >= 1 and shed >= 1
+        assert results + shed == 10
+    finally:
+        router.close()
+        ep.close(abort=True)
+
+
+# ---------------------------------------------------------------------------
+# rolling deploy: version tags stay monotone under concurrent load
+# ---------------------------------------------------------------------------
+
+def test_rolling_deploy_is_monotone_under_load(tmp_path):
+    reg = ModelRegistry(str(tmp_path / "registry"))
+    reg.publish("m", net=_dense_net(seed=1), signature=SIG)
+    reg.publish("m", net=_dense_net(seed=2), signature=SIG)
+    eps = [ReplicaEndpoint(FleetServer(reg, "m", version="v1",
+                                       max_batch_size=4,
+                                       name=f"m-{i}")).start()
+           for i in range(2)]
+    router = FleetRouter(heartbeat_ms=50)
+    tags, errs = [], []
+    stop = threading.Event()
+
+    def client():
+        x = np.ones(8, dtype=np.float32)
+        while not stop.is_set():
+            fut = router.submit(x)
+            try:
+                fut.result(timeout=30)
+                tags.append(fut.version)
+            except Exception as e:  # pragma: no cover - the assertion
+                errs.append(e)
+    try:
+        router.add_replica("m0", eps[0].addr)
+        router.add_replica("m1", eps[1].addr)
+        t = threading.Thread(target=client)
+        t.start()
+        time.sleep(0.15)
+        reports = router.rolling_deploy("v2")
+        time.sleep(0.15)
+        stop.set()
+        t.join(30)
+        assert len(reports) == 2
+        assert all(r["version"] == "v2" for r in reports)
+        assert not errs  # zero dropped/failed requests across the swap
+        # the serial client saw v1 before, v2 after, and NEVER v1 again
+        # once v2 appeared: version tags are monotone in dispatch order
+        nums = [int(t[1:]) for t in tags if t]
+        assert nums and nums == sorted(nums)
+        assert nums[0] == 1 and nums[-1] == 2
+        # the router's floor advanced: new requests only route to v2
+        assert router._version_floor[0] == 2
+    finally:
+        stop.set()
+        router.close()
+        for ep in eps:
+            ep.close(abort=True)
+
+
+# ---------------------------------------------------------------------------
+# autoscaler executor: respawn / drain against live endpoints
+# ---------------------------------------------------------------------------
+
+def test_autoscaler_respawns_dead_and_drains_idle():
+    spawned, retired = [], []
+    endpoints = {}
+
+    def spawn(name):
+        ep = _endpoint(name=name)
+        endpoints[name] = ep
+        spawned.append(name)
+        return ep.addr, None
+
+    def retire(name, pid):
+        retired.append(name)
+
+    router = FleetRouter(heartbeat_ms=50)
+    scaler = Autoscaler(router, spawn, retire, min_replicas=1,
+                        max_replicas=3, target_queue=4,
+                        pressure_ticks=2, idle_ticks=2)
+    try:
+        for _ in range(2):
+            scaler._spawn_one()
+        scaler.seed_seq(2)
+        assert router.live_count() == 2
+        assert scaler.step()["op"] == "none"  # healthy fleet: hands off
+
+        endpoints["r1"].close(abort=True)  # kill one replica
+        deadline = time.monotonic() + 10
+        while router.live_count() == 2 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        action = scaler.step()
+        assert action["op"] == "respawn" and action["replicas"] == ["r1"]
+        assert router.live_count() == 2  # capacity restored
+        assert spawned == ["r1", "r2", "r3"] and retired == ["r1"]
+
+        # sustained idle above the floor -> drain (never kill) one
+        ops = [scaler.step()["op"] for _ in range(2)]
+        assert ops == ["none", "scale_down"]
+        assert router.live_count() == 1
+        assert len(retired) == 2
+    finally:
+        router.close()
+        for ep in endpoints.values():
+            ep.close(abort=True)
+
+
+# ---------------------------------------------------------------------------
+# chaos: replica_kill grammar + the router integration
+# ---------------------------------------------------------------------------
+
+def test_replica_kill_grammar():
+    plan = ChaosPlan("replica_kill@5")
+    assert plan.replica_kill_due(4) is None
+    assert plan.replica_kill_due(5) == -1  # default victim: busiest
+    assert plan.replica_kill_due(50) is None  # consume-once
+    assert plan.injected["replica_kill"] == 1
+    assert ChaosPlan("replica_kill@3:1").replica_kill_due(3) == 1
+    for bad in ("replica_kill@0", "replica_kill@-2", "replica_kill@x",
+                "replica_kill@3:z", "replica_kill@3:-7", "replica_kill"):
+        with pytest.raises(MXNetError):
+            ChaosPlan(bad)
+
+
+def test_router_chaos_kill_fires_once_and_drops_nothing():
+    killed = []
+    eps = {"a": _endpoint(name="a"), "b": _endpoint(name="b")}
+    router = FleetRouter(heartbeat_ms=50)
+    try:
+        router.add_replica("a", eps["a"].addr)
+        router.add_replica("b", eps["b"].addr)
+        # victim index 0 in the sorted live set: deterministically 'a'
+        chaos.install("replica_kill@3:0")
+        router.set_kill_hook(
+            lambda name: (killed.append(name),
+                          eps[name].close(abort=True)))
+        x = np.ones(8, dtype=np.float32)
+        outs = [router.predict(x, timeout=30) for _ in range(8)]
+        assert len(outs) == 8  # zero dropped across the injected kill
+        assert killed == ["a"]  # fired at routed>=3, exactly once
+        assert chaos.active().injected["replica_kill"] == 1
+        assert router.live_count() == 1
+        assert router.states()["b"]["healthy"]
+    finally:
+        chaos.uninstall()
+        router.close()
+        for ep in eps.values():
+            ep.close(abort=True)
+
+
+# ---------------------------------------------------------------------------
+# the acceptance drill: REAL replica processes
+# ---------------------------------------------------------------------------
+
+def _spawn_worker(tmp_path, publish_aot=False, timeout=90):
+    env = dict(os.environ)
+    env.pop("MXTPU_CHAOS", None)
+    env.pop("XLA_FLAGS", None)
+    env.update({"JAX_PLATFORMS": "cpu",
+                "FLEET_REGISTRY": str(tmp_path / "registry"),
+                "FLEET_MODEL": "drill",
+                "FLEET_PUBLISH_AOT": "1" if publish_aot else "0",
+                "MXTPU_COMPILE_CACHE": str(tmp_path / "cache")})
+    proc = subprocess.Popen(
+        [sys.executable,
+         os.path.join(ROOT, "tests", "dist", "fleet_worker.py")],
+        stdout=subprocess.PIPE, text=True, bufsize=1, env=env)
+    info = {}
+    done = threading.Event()
+
+    def _read():
+        for line in proc.stdout:
+            if line.startswith("FLEET_REPLICA_READY "):
+                info.update(json.loads(line.split(" ", 1)[1]))
+                done.set()
+                return
+        done.set()
+
+    threading.Thread(target=_read, daemon=True).start()
+    if not done.wait(timeout) or "port" not in info:
+        proc.kill()
+        raise RuntimeError(f"worker not ready (rc={proc.poll()})")
+    return proc, info
+
+
+def test_two_process_drill_kill_zero_drop_then_zero_compile_scaleup(
+        tmp_path):
+    reg = ModelRegistry(str(tmp_path / "registry"))
+    reg.publish("drill", net=_dense_net(seed=3), signature=SIG)
+    procs = []
+    router = FleetRouter(heartbeat_ms=100)
+    try:
+        p1, i1 = _spawn_worker(tmp_path, publish_aot=True)
+        procs.append(p1)
+        p2, i2 = _spawn_worker(tmp_path)
+        procs.append(p2)
+        assert i1["aot_published"] > 0  # replica 1 seeded the bundle
+        router.add_replica("r1", ("127.0.0.1", i1["port"]),
+                           pid=i1["pid"])
+        router.add_replica("r2", ("127.0.0.1", i2["port"]),
+                           pid=i2["pid"])
+        x = np.ones(8, dtype=np.float32)
+        router.predict(x, timeout=60)  # warm round trip
+
+        # SIGKILL one replica with a burst in flight: zero drops
+        futs = [router.submit(x) for _ in range(24)]
+        os.kill(i1["pid"], signal.SIGKILL)
+        outs = [f.result(timeout=60) for f in futs]
+        assert len(outs) == 24
+        assert router.live_count() == 1
+
+        # scale up: the fresh process must cold-start with ZERO XLA
+        # compiles (AOT bundle + shared compile cache)
+        p3, i3 = _spawn_worker(tmp_path)
+        procs.append(p3)
+        assert i3["xla_compiles"] == 0, i3
+        assert i3["warm"]["aot_loaded"] > 0
+        router.add_replica("r3", ("127.0.0.1", i3["port"]),
+                           pid=i3["pid"])
+        router.predict(x, timeout=60)
+
+        # drain-stop the fleet: survivors exit RESUMABLE (the PR 15/17
+        # supervisor contract), never crash codes
+        router.stop_fleet(drain=True)
+        assert p2.wait(timeout=30) == 75
+        assert p3.wait(timeout=30) == 75
+    finally:
+        router.close()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()

@@ -61,6 +61,14 @@ METRICS: List[Tuple[str, Tuple[str, ...], str, str]] = [
     ("watchdog_fired", ("comm_health", "watchdog_fired"), "lower",
      "count"),
     ("loss_last", ("loss", "last"), "lower", "rate"),
+    # serving-mode reports (ModelServer drain writes them, see
+    # telemetry/run_report.py build_serving_payload): a serving
+    # regression — throughput drop, latency-tail growth, new shed —
+    # gates exactly like a training one
+    ("serve_qps", ("serving", "qps"), "higher", "rate"),
+    ("serve_p95_ms", ("serving", "latency_ms", "p95"), "lower", "rate"),
+    ("serve_p99_ms", ("serving", "latency_ms", "p99"), "lower", "rate"),
+    ("serve_shed", ("serving", "shed_total"), "lower", "count"),
 ]
 
 
