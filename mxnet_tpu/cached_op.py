@@ -286,6 +286,26 @@ class _RWLock:
             self._cond.notify_all()
 
 
+def trace_rw_for(block) -> "_RWLock":
+    """The block's shared trace lock, creating and stashing it on first
+    use — the SAME instance every CachedOp wrapping ``block`` guards its
+    storage-swapping traces with, so an outside tracer (the one-program
+    megastep swaps every Parameter/grad/state storage to input tracers)
+    excludes concurrent forward traces over the same Parameters by
+    taking this lock's write side. Falls back to a fresh private lock
+    for slotted/exotic blocks that refuse the attribute stash (no shared
+    Parameters can be traced concurrently through CachedOp then either —
+    it falls back identically)."""
+    rw = getattr(block, "_mxtpu_trace_rw", None)
+    if rw is None:
+        rw = _RWLock()
+        try:
+            block._mxtpu_trace_rw = rw
+        except AttributeError:
+            pass  # slotted/exotic block: fall back to a private lock
+    return rw
+
+
 class _CachedOpGrad:
     """Per-call backward closure recorded as a single tape node
     (ref: CachedOp::Backward, src/imperative/cached_op.cc:1112)."""
@@ -400,13 +420,7 @@ class CachedOp:
             cache_size = int(env.get("MXTPU_CACHEDOP_CACHE_SIZE"))
         self._cache_size = int(cache_size)
         self._cache = SignatureLRU(maxsize=self._cache_size)
-        self._trace_rw = getattr(block, "_mxtpu_trace_rw", None)
-        if self._trace_rw is None:
-            self._trace_rw = _RWLock()
-            try:
-                block._mxtpu_trace_rw = self._trace_rw
-            except AttributeError:
-                pass  # slotted/exotic block: fall back to per-op lock
+        self._trace_rw = trace_rw_for(block)
         self._param_objs: Optional[List] = None
 
     def cache_info(self) -> CacheInfo:

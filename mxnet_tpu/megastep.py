@@ -1,0 +1,559 @@
+"""One jitted, donated-buffer program per training step (``MXTPU_MEGASTEP``).
+
+The reference framework's GraphExecutor runs the entire symbolic training
+step as ONE graph (PAPER.md §6b) — that is why its symbolic path beats
+imperative dispatch. This module is the reproduction's equivalent for the
+imperative FitLoop: forward + backward + the finiteness sentinel + the
+grouped optimizer update (and, under a simulated ZeRO group, the in-graph
+loopback collectives) trace into a SINGLE jitted program per
+(signature, world), with the weight/grad/optimizer-state buffers donated
+into it. A warm step is exactly one dispatched executable: O(1) launches,
+XLA schedules the comm/compute overlap PR 16 hand-coded, and
+``unattributed_dispatches == 0`` holds by construction — the one
+program's cost resolves exactly, so MFU stops being a lower bound.
+
+How the capture works — the CachedOp discipline, widened to a whole step:
+
+- Every Parameter's weight storage, every gradient storage, and every
+  optimizer-state handle is **storage-swapped** to an input tracer
+  (restored in ``finally``), then the LITERAL composed code path runs
+  under the trace: ``net(x)`` (a hybridized block's CachedOp early-
+  returns its imperative call under a tracer, inlining cleanly), the
+  loss, ``scaled.backward()`` — the SAME tape machinery delivers grads
+  into the swapped buffers — the chaos poison site, the fused
+  ``_finite_fn`` reduction, and :func:`grouped.apply_chunk` per bucket
+  (the SAME cached bucket programs the composed path dispatches, inlined
+  by the outer trace). Bitwise parity with the composed path is the
+  acceptance contract, including the where-guarded non-finite skip and
+  loss-scale backoff.
+- Everything that changes per step WITHOUT changing the graph rides as
+  dynamic inputs: lr/wd vectors (Adam's bias-corrected lr changes every
+  step), rescale, the loss scale (×1.0 is IEEE-exact, so the
+  always-present multiply matches the composed skip-at-1.0 branch
+  bitwise), and the chaos poison (an always-present ``where(poison,
+  full(fill), g)`` on the first trainable grad — identity when off).
+- HOST bookkeeping the composed path performs between dispatches —
+  chaos event consumption, update-count bumps, state creation, lr
+  resolution (:meth:`Trainer.megastep_plan`), rollback arming, fresh-grad
+  flags — replays OUTSIDE the program every step, cold and warm alike,
+  so ``FitLoop``'s skip/rollback/backoff paths work unchanged.
+- The cold path lowers+compiles ONCE (AOT) under the block's shared
+  trace lock (:func:`cached_op.trace_rw_for` — the trace mutates shared
+  Parameter storage); warm steps call the compiled executable directly,
+  so the python body never re-runs.
+
+Strictness contract (the ZeRO plane's): every non-composable
+configuration — gradient compression, sparse params, a non-grouped
+optimizer, aggregation off, a real multi-worker group, stale-grad
+tolerance, ``skip_nonfinite=False`` — raises loudly instead of silently
+falling back to the composed path. ``MXTPU_COMM_OVERLAP`` is the one
+exception: megastep *supersedes* it (logged once), because the overlap
+it hand-codes is exactly what XLA now schedules inside the program.
+
+Known divergence (documented, not silent): in-trace random ops (dropout)
+draw from the program's trace key, not the eager stream, so nets with
+training-mode randomness match the composed path statistically, not
+bitwise — the same caveat ``CachedOp`` carries. Deterministic nets (the
+parity suite) are bitwise.
+"""
+from __future__ import annotations
+
+import functools
+import hashlib
+import warnings
+from typing import Any, List, Optional, Tuple
+
+from .base import MXNetError, check, env
+from .log import get_logger
+from .optimizer import grouped as _grouped
+from .telemetry import efficiency as _efficiency
+from .telemetry import memory as _memory
+from .telemetry import numerics as _numerics
+
+__all__ = ["megastep_requested", "Megastep", "cache_info",
+           "donation_supported"]
+
+_LOG = get_logger("mxnet_tpu.megastep")
+
+
+def megastep_requested() -> bool:
+    """Strict ``MXTPU_MEGASTEP`` parse: on/1/true | off/0/false/unset;
+    anything else raises (a typo'd knob must not silently train on the
+    composed path)."""
+    raw = str(env.get("MXTPU_MEGASTEP") or "").strip().lower()
+    if raw in ("", "0", "off", "false"):
+        return False
+    if raw in ("1", "on", "true"):
+        return True
+    raise MXNetError(
+        f"MXTPU_MEGASTEP: unknown value {raw!r} (known: on, off)")
+
+
+def cache_info(net):
+    """Megastep signature-cache counters for ``net``
+    (:class:`cached_op.CacheInfo`), or None when no megastep ever traced
+    it. The warm-step contract tests pin ``misses`` here: steps after the
+    first must be pure hits."""
+    cache = getattr(net, "_mxtpu_megastep_cache", None)
+    return cache.cache_info() if cache is not None else None
+
+
+@functools.lru_cache(maxsize=1)
+def donation_supported() -> bool:
+    """Whether this backend actually reuses donated input buffers (probed
+    once with a trivial jitted donated program). CPU jaxlib builds vary;
+    the donation tests assert buffer death only when this is True — the
+    memory-ledger parity assertion holds either way."""
+    import jax
+    import jax.numpy as jnp
+    f = jax.jit(lambda a: a + 1.0, donate_argnums=(0,))
+    x = jnp.ones((8,), jnp.float32)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        f(x)
+    try:
+        return bool(x.is_deleted())
+    except Exception:
+        return False
+
+
+class _MegaEntry:
+    """One compiled megastep per signature."""
+    __slots__ = ("compiled", "label", "cost_stats")
+
+    def __init__(self):
+        self.compiled = None
+        self.label = None
+        # efficiency-plane resolution, cached INCLUDING failures — a
+        # backend without cost analysis costs one attempt per program,
+        # never one per step (the _analyze_sig discipline)
+        self.cost_stats = None
+
+
+class Megastep:
+    """The one-program step driver ``FitLoop`` delegates to under
+    ``MXTPU_MEGASTEP=on``. Construct once per fit (every statically
+    checkable incompatibility raises here, before any step runs); call
+    :meth:`run` once per step."""
+
+    def __init__(self, net, trainer, loss_fn, skip_nonfinite: bool = True,
+                 ignore_stale_grad: bool = False):
+        from .gluon.trainer import _overlap_requested
+        check(skip_nonfinite,
+              "MXTPU_MEGASTEP=on requires skip_nonfinite=True: the traced "
+              "program guards every update behind the in-graph finiteness "
+              "sentinel (where(ok, new, old)); a host check-then-raise "
+              "flow cannot live inside one program")
+        check(not ignore_stale_grad,
+              "MXTPU_MEGASTEP=on does not compose with ignore_stale_grad: "
+              "the fused program updates a FIXED parameter set per "
+              "signature, it cannot drop stale members per step. Fix the "
+              "unused parameter (set grad_req='null') or unset "
+              "MXTPU_MEGASTEP")
+        check(trainer._compression_params is None,
+              "MXTPU_MEGASTEP=on does not compose with gradient "
+              "compression: per-key error-feedback residuals are "
+              "host-side kvstore state that cannot be traced into the "
+              "program")
+        check(not trainer._contains_sparse,
+              "MXTPU_MEGASTEP=on requires dense parameters/gradients "
+              "(row_sparse updates take the per-parameter path)")
+        rule = _grouped._rule_for(trainer._optimizer)
+        check(rule is not None,
+              f"MXTPU_MEGASTEP=on: optimizer "
+              f"{type(trainer._optimizer).__name__} has no grouped-update "
+              "rule (the fused step IS the grouped donated-buffer path)")
+        check(_grouped.aggregation_size() > 0,
+              "MXTPU_MEGASTEP=on requires MXTPU_OPTIMIZER_AGGREGATION > 0: "
+              "the in-graph update is the grouped bucket program")
+        if _overlap_requested():
+            # superseded, not incompatible: the hand-coded overlap's whole
+            # job (launch comm while compute runs) is what XLA's scheduler
+            # does inside the one program
+            _LOG.info(
+                "MXTPU_COMM_OVERLAP superseded by MXTPU_MEGASTEP: XLA "
+                "schedules the comm/compute overlap inside the one-program "
+                "step")
+        self._net = net
+        self._trainer = trainer
+        self._loss_fn = loss_fn
+        self._rule = rule
+        from .cached_op import trace_rw_for, SignatureLRU
+        # the SAME lock CachedOp guards its storage-swapping traces with:
+        # a megastep trace swaps every Parameter/grad/state storage, so it
+        # needs write-side exclusivity against any concurrent forward
+        # trace over the same block
+        self._rw = trace_rw_for(net)
+        cache = getattr(net, "_mxtpu_megastep_cache", None)
+        if cache is None:
+            cache = SignatureLRU()
+            try:
+                net._mxtpu_megastep_cache = cache
+            except AttributeError:
+                pass  # slotted/exotic block: per-instance cache
+        self._cache = cache
+        # kvstore/plane checks need materialized params — resolved at
+        # first run (right after the deferred-init priming forward)
+        self._plane = None
+        self._world = 1
+        self._resolved = False
+
+    # -- first-run resolution -------------------------------------------
+    def _resolve_runtime(self) -> None:
+        if self._resolved:
+            return
+        tr = self._trainer
+        if not tr._kv_initialized:
+            tr._init_kvstore()
+        kv = tr._kvstore
+        if kv is not None and int(getattr(kv, "num_workers", 1) or 1) > 1:
+            raise MXNetError(
+                "MXTPU_MEGASTEP=on does not compose with a real "
+                "multi-worker group: the kvstore transport is a host-side "
+                "byte channel that cannot be traced into the program. Use "
+                "a simulated group (MXTPU_ZERO_WORLD) or unset "
+                "MXTPU_MEGASTEP")
+        self._plane = tr._zero_plane()
+        if self._plane is not None and self._plane.distributed:
+            raise MXNetError(
+                "MXTPU_MEGASTEP=on composes with simulated ZeRO worlds "
+                "only: a distributed plane's reduce-scatter rides the "
+                "host kvstore transport, which cannot be traced into the "
+                "program")
+        self._world = self._plane.world if self._plane is not None else 1
+        self._resolved = True
+
+    # -- signature -------------------------------------------------------
+    def _signature(self, gparams, rank_chunks, x_nd, y_nd,
+                   collect: bool) -> Tuple:
+        from .ops.registry import _trace_time_flags
+        tr = self._trainer
+        psig = tuple((tuple(p._data._data.shape), str(p._data._data.dtype),
+                      p.grad_req) for p in tr._params)
+        gsig = tuple((i, tuple(p._grad._data.shape),
+                      str(p._grad._data.dtype)) for i, p in gparams)
+        plan_sig = tuple(
+            tuple(tuple((e[0], e[3],
+                         tuple((tuple(h._data.shape), str(h._data.dtype))
+                               for h in e[2])) for e in chunk)
+                  for chunk in chunks)
+            for chunks in rank_chunks)
+        xsig = (tuple(x_nd._data.shape), str(x_nd._data.dtype))
+        ysig = (tuple(y_nd._data.shape), str(y_nd._data.dtype)) \
+            if y_nd is not None else None
+        bucket_mb = tr._bucket_mb() if self._plane is not None else None
+        return ("megastep", self._rule.name,
+                self._rule.statics(tr._optimizer), self._world,
+                _grouped.aggregation_size(), bucket_mb, bool(collect),
+                psig, gsig, plan_sig, xsig, ysig, _trace_time_flags())
+
+    # -- the traced body -------------------------------------------------
+    def _make_pure_fn(self, live, gparams, handles, rank_chunks,
+                      comm_layout, collect: bool, has_y: bool):
+        import jax.numpy as jnp
+        from . import autograd, random as _random
+        from .ndarray.ndarray import from_jax
+        from .gluon.trainer import _flatten_fn
+        from .parallel import collectives as _coll
+
+        net, tr = self._net, self._trainer
+        loss_fn = self._loss_fn
+        all_params = list(tr._params)
+        updater = tr._updaters[0]
+        rule = self._rule
+        # chaos poison site: the FIRST trainable param with a grad buffer
+        # — the same pick chaos.Plan.poison_grads makes
+        ptarget = next((p for p in all_params
+                        if getattr(p, "grad_req", "null") != "null"
+                        and p._grad is not None), None)
+
+        def body(params, grads, states, lrs, wds, rescale, lscale,
+                 poison, fill, key, x, y):
+            from .ops import registry as _reg
+            orig_p = [p._data._data for p in all_params]
+            orig_g = [p._grad._data for _i, p in gparams]
+            orig_s = [h._data for h in handles]
+            _random.push_trace_key(key)
+            # island mode: every op traced below compiles as the same
+            # isolated fusion region it is eagerly (optimization_barrier
+            # at each op boundary), so no cross-op FMA contraction can
+            # flip last bits vs the composed trajectory — the program is
+            # the composed step's kernels minus the dispatches, and
+            # bitwise parity holds by construction
+            _reg.push_op_islands()
+            try:
+                for p, a in zip(all_params, params):
+                    p._data._data = a
+                for (_i, p), a in zip(gparams, grads):
+                    p._grad._data = a
+                for h, a in zip(handles, states):
+                    h._data = a
+                x_nd = from_jax(x)
+                y_nd = from_jax(y) if has_y else None
+                # the LITERAL FitLoop step body, recorded under the trace:
+                with autograd.record():
+                    out = net(x_nd)
+                    loss = loss_fn(out, y_nd) if has_y else loss_fn(out)
+                    # ×1.0 is IEEE-exact (and preserves NaN payloads), so
+                    # the always-present multiply matches the composed
+                    # path's skip-the-multiply-at-1.0 branch bitwise —
+                    # and a loss-scale backoff changes an input, not the
+                    # program
+                    scaled = loss * from_jax(
+                        lscale.astype(loss._data.dtype))
+                scaled.backward()
+                # chaos poison, in-graph: where-guarded so the program is
+                # one and the same whether this step injects or not
+                if ptarget is not None:
+                    gbuf = ptarget._grad
+                    pg = gbuf._data
+                    poisoned = jnp.full(pg.shape, fill.astype(pg.dtype),
+                                        pg.dtype)
+                    gbuf._data = jnp.where(poison, poisoned, pg)
+                # the simulated group's reduce-scatter, THROUGH the
+                # collective site (loopback_psum), so the comm lives
+                # structurally inside the program — same flatten/slice/
+                # reshape walk as ZeroPlane.reduce_scatter_grads
+                for _key2, bucket, parts in comm_layout:
+                    flat = _flatten_fn()(*[g._data for _i, g in bucket])
+                    flat = _coll.loopback_psum(flat)
+                    for (_i, g, lo, hi) in parts:
+                        g._data = flat[lo:hi].reshape(g.shape)
+                # grad seam: composed materializes the grads (program
+                # outputs of backward) before the sentinel/bucket
+                # programs consume them — the barrier reproduces that
+                # boundary for the inlined kernels
+                for _i, p in live:
+                    if p._grad is not None:
+                        p._grad._data = _reg._island(p._grad._data)
+                # the fused finiteness sentinel, over the SAME grads in
+                # the SAME live order as the composed paths (unsharded
+                # sentinel_grads / the sim plane's full my_set shard)
+                sgrads = tuple(p._grad._data for _i, p in live
+                               if p._grad is not None)
+                flag = _grouped._finite_fn(len(sgrads))(*sgrads)
+                # the loss leaves the program as the PER-SAMPLE vector,
+                # not the scalar: the scalar mean is host reporting, and
+                # the composed path computes it with the EAGER mean op —
+                # an in-graph reduce over the same values can pick a
+                # different summation order (XLA codegen is module-
+                # context dependent even across optimization_barrier)
+                # and flip the reported loss's last bit. run() feeds
+                # this vector through the identical eager op instead:
+                # bitwise by construction, O(batch) work
+                loss_vec = loss._data
+                # the grouped update: the SAME cached bucket programs the
+                # composed path dispatches, inlined by this trace; lr/wd
+                # arrive as slices of the dynamic per-step vectors
+                stats_sink: Optional[List] = [] if collect else None
+                off = 0
+                for chunks in rank_chunks:
+                    for chunk in chunks:
+                        n = len(chunk)
+                        _grouped.apply_chunk(
+                            updater, rule, chunk, lrs[off:off + n],
+                            wds[off:off + n], rescale, sentinel=True,
+                            flag=flag, stats_out=stats_sink,
+                            note_dispatches=False)
+                        off += n
+                new_p = tuple(p._data._data for p in all_params)
+                new_g = tuple(p._grad._data for _i, p in gparams)
+                new_s = tuple(h._data for h in handles)
+                smats = tuple(m for _n, m in stats_sink) if collect \
+                    else ()
+                return loss_vec, flag, new_p, new_g, new_s, smats
+            finally:
+                _reg.pop_op_islands()
+                _random.pop_trace_key()
+                for p, a in zip(all_params, orig_p):
+                    p._data._data = a
+                for (_i, p), a in zip(gparams, orig_g):
+                    p._grad._data = a
+                for h, a in zip(handles, orig_s):
+                    h._data = a
+
+        if has_y:
+            def fn(params, grads, states, lrs, wds, rescale, lscale,
+                   poison, fill, key, x, y):
+                return body(params, grads, states, lrs, wds, rescale,
+                            lscale, poison, fill, key, x, y)
+        else:
+            def fn(params, grads, states, lrs, wds, rescale, lscale,
+                   poison, fill, key, x):
+                return body(params, grads, states, lrs, wds, rescale,
+                            lscale, poison, fill, key, x, None)
+        return fn
+
+    def _trace(self, entry, sig, live, gparams, handles, rank_chunks,
+               collect: bool, has_y: bool, args) -> None:
+        import jax
+        tr = self._trainer
+        comm_layout = []
+        if self._plane is not None:
+            # layout resolved HOST-side, once per trace (graftcheck's
+            # no-env-reads-at-trace-time discipline: _bucket_layout reads
+            # MXTPU_GRAD_BUCKET_MB); the bucket entries hold the live
+            # grad NDArrays, whose storages the trace swaps
+            for key2, bucket in self._plane._bucket_layout(tr):
+                parts, _all = self._plane._bucket_parts(bucket)
+                comm_layout.append((key2, bucket, parts))
+        fn = self._make_pure_fn(live, gparams, handles, rank_chunks,
+                                comm_layout, collect, has_y)
+        jitted = jax.jit(fn, donate_argnums=(0, 1, 2))
+        with warnings.catch_warnings():
+            # expected, once per signature: 'write'-mode grad inputs are
+            # read by nothing in the graph (backward REPLACES them; they
+            # ride as inputs so the buffers die inside the program and
+            # the 'add'-mode accumulation reads them), so XLA reports
+            # them as unusable donations
+            warnings.filterwarnings("ignore", message=".*onat.*")
+            lowered = jitted.lower(*args)
+            # trace-time staleness check: the tape just ran under the
+            # trace, so any live param without a delivered grad is
+            # structurally unreachable from the loss — the composed
+            # path's stale decline becomes a raise-early here
+            stale = [p.name for _i, p in live if not p._fresh_grad]
+            if stale:
+                tr.rollback_step()  # undo megastep_plan's host half
+                raise MXNetError(
+                    f"MXTPU_MEGASTEP=on: parameter(s) {stale[:4]} receive "
+                    "no gradient from the loss (unused in the traced "
+                    "step). The fused program updates every live "
+                    "parameter; set grad_req='null' on unused parameters "
+                    "or unset MXTPU_MEGASTEP")
+            entry.compiled = lowered.compile()
+        digest = hashlib.md5(repr(sig).encode()).hexdigest()[:12]
+        entry.label = (f"megastep:{self._rule.name}:w{self._world}"
+                       f":{digest}")
+
+    # -- efficiency-plane resolver --------------------------------------
+    def _cost(self, entry) -> Optional[dict]:
+        stats = entry.cost_stats
+        if stats is None:
+            try:
+                stats = _efficiency.compiled_program_stats(entry.compiled)
+            except Exception:
+                stats = None
+            if stats is None:
+                stats = {"unavailable": True}
+            if "flops" not in stats:
+                stats = dict(stats, cost_unavailable=True)
+            _memory.record_program("megastep", entry.label, dict(stats))
+            entry.cost_stats = stats
+        return stats
+
+    # -- one step --------------------------------------------------------
+    def run(self, x_nd, y_nd, bs, loss_scale: float, plan, step: int):
+        """One fused training step. Returns ``(flag, loss_dev)`` — the
+        device-resident finiteness verdict and mean loss, fetched by the
+        caller in its single step transfer. All host bookkeeping the
+        composed path performs between dispatches replays here, so
+        FitLoop's skip / rollback / backoff paths work unchanged."""
+        import jax.numpy as jnp
+        from . import autograd, random as _random
+        from .gluon import trainer as _tr_mod
+
+        tr = self._trainer
+        net = self._net
+        # deferred-init priming OUTSIDE the trace: a traced deferred init
+        # would bake the (random) init values into the program as
+        # constants. Same init draws, same order, as the composed path's
+        # first recorded forward.
+        if any(p._data is None for p in tr._params):
+            with autograd.pause():
+                net(x_nd)
+        self._resolve_runtime()
+        plane = self._plane
+        if plane is not None:
+            plane.check_comm_round()
+
+        # chaos: consume the poison event HOST-side (same injected
+        # counters as Plan.poison_grads); the fill itself is applied
+        # in-graph through the always-present where-guarded inputs
+        poison, fill = False, 0.0
+        if plan is not None:
+            if plan.should("nan_grad"):
+                poison, fill = True, float("nan")
+            elif plan.should("inf_grad"):
+                poison, fill = True, float("inf")
+
+        # numerics plane: one consume-once sampling decision per step; a
+        # sampled step runs the stats VARIANT of the program (extra
+        # outputs, not extra dispatches)
+        collect = _numerics.collect_spec() is not None
+
+        # host half: counts, state creation, lr/wd resolution, rollback
+        # arming (Trainer.megastep_plan == the composed path's
+        # between-dispatch bookkeeping)
+        live, rank_chunks, lr_list, wd_list = tr.megastep_plan(
+            bs * loss_scale)
+        gparams = [(i, p) for i, p in live if p._grad is not None]
+        handles = [h for chunks in rank_chunks for chunk in chunks
+                   for e in chunk for h in e[2]]
+
+        params_in = tuple(p._data._data for p in tr._params)
+        grads_in = tuple(p._grad._data for _i, p in gparams)
+        states_in = tuple(h._data for h in handles)
+        args = (params_in, grads_in, states_in,
+                jnp.asarray(lr_list, dtype=jnp.float32),
+                jnp.asarray(wd_list, dtype=jnp.float32),
+                jnp.asarray(float(tr._optimizer.rescale_grad),
+                            dtype=jnp.float32),
+                jnp.asarray(float(loss_scale), dtype=jnp.float32),
+                jnp.asarray(bool(poison), dtype=bool),
+                jnp.asarray(float(fill), dtype=jnp.float32),
+                _random.next_key(), x_nd._data)
+        if y_nd is not None:
+            args = args + (y_nd._data,)
+
+        sig = self._signature(gparams, rank_chunks, x_nd, y_nd, collect)
+        entry = self._cache.get_or_insert(sig, _MegaEntry)
+        if entry.compiled is None:
+            # cold: trace + AOT-compile under the block's write lock (the
+            # trace swaps shared Parameter storage)
+            self._rw.acquire_write()
+            try:
+                if entry.compiled is None:
+                    self._trace(entry, sig, live, gparams, handles,
+                                rank_chunks, collect, y_nd is not None,
+                                args)
+            finally:
+                self._rw.release_write()
+        outs = entry.compiled(*args)
+        loss_vec, flag, new_p, new_g, new_s, smats = outs
+
+        # host completion: every donated buffer's successor rebinds into
+        # the live NDArrays (the old buffers died inside the program)
+        for p, a in zip(tr._params, new_p):
+            p._data._rebind(a)
+        for (_i, p), a in zip(gparams, new_g):
+            p._grad._rebind(a)
+        for h, a in zip(handles, new_s):
+            h._rebind(a)
+        for _i, p in live:
+            p._fresh_grad = False
+        if collect:
+            names = [tuple(e[1].name for e in chunk)
+                     for chunks in rank_chunks for chunk in chunks]
+            tr.last_numerics_stats = list(zip(names, smats))
+        # observability: ONE dispatched program; the in-graph collectives
+        # are not host collectives, so the host counters read 0 (the
+        # program's cost — incl. comm — resolves through the megastep
+        # record)
+        tr.last_update_dispatches = 1
+        tr.last_allreduce_collectives = 0
+        tr.last_reduce_scatter_collectives = 0
+        tr.last_allgather_collectives = 0
+        _tr_mod._update_dispatch_counter().inc(1)
+        if _efficiency.enabled():
+            _efficiency.note_dispatch(
+                ("megastep", id(entry)), "megastep", entry.label,
+                functools.partial(self._cost, entry))
+        # the reported-loss scalarization: the IDENTICAL eager mean op
+        # the composed path dispatches, over the program's per-sample
+        # loss output — bitwise by construction (see the body comment);
+        # O(batch) elements, device-resident, fetched by FitLoop in its
+        # one step transfer
+        from .ndarray.ndarray import from_jax
+        loss_dev = from_jax(loss_vec).mean()._data
+        return flag, loss_dev

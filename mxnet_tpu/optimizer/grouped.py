@@ -37,6 +37,7 @@ from ..telemetry import efficiency as _efficiency
 from ..telemetry import memory as _memory
 
 __all__ = ["aggregation_size", "eligible", "grouped_update",
+           "prepare_update", "chunk_prepared", "apply_chunk",
            "global_finite_flag", "rollback_counts", "cache_info",
            "clear_cache", "program_memory"]
 
@@ -513,6 +514,140 @@ def _devices_key(arr) -> Tuple:
         return ()
 
 
+def prepare_update(updater, items):
+    """HOST half of one aggregated step over ``items``: state creation
+    (ledger-tracked), update-count bumps, and lr/wd resolution — every
+    count bumps before any lr is resolved within the step, identical to
+    the per-param loop's order. Pure host bookkeeping, no device work,
+    so the megastep driver can run it OUTSIDE its trace and replay it
+    verbatim on warm steps while the traced program replays the device
+    half. Returns ``(prepared, created)`` where ``prepared`` entries are
+    ``(index, Parameter, state_handles, mp, lr, wd)`` and ``created``
+    lists indices whose optimizer state this call first materialized
+    (rollback must delete them again)."""
+    opt = updater.optimizer
+    rule = _rule_for(opt)
+    check(rule is not None,
+          f"optimizer {type(opt).__name__} has no grouped-update rule")
+    for _, p in items:
+        if not _is_dense(p):
+            raise MXNetError(
+                f"grouped optimizer update requires dense parameters and "
+                f"gradients; {p.name!r} (stype={p.stype!r}) must take the "
+                "per-parameter path")
+
+    is_adam = rule.name == "Adam"
+    created = []
+    for i, p in items:
+        if i not in updater.states:
+            updater.states[i] = opt.create_state_multi_precision(i, p.data())
+            created.append(i)
+            _memory.track_optimizer_state(updater, i, updater.states[i],
+                                          param=p)
+        opt._update_count(i)
+
+    prepared = []
+    for i, p in items:
+        lr, wd = opt._get_lr(i), opt._get_wd(i)
+        if is_adam:
+            t = opt._index_update_count[i]
+            lr = lr * _math.sqrt(1 - opt.beta2 ** t) / (1 - opt.beta1 ** t)
+        handles, mp = _state_handles(opt, p, updater.states[i])
+        prepared.append((i, p, handles, mp, float(lr), float(wd)))
+    return prepared, created
+
+
+def chunk_prepared(prepared, agg_size: int):
+    """Bucket ``prepared`` entries by (weight dtype, device placement,
+    mp-ness, state arity), capped at ``agg_size``, preserving parameter
+    order within a bucket. Pure function of the prepared structure —
+    the chunk layout is part of the megastep cache signature."""
+    buckets: "OrderedDict[Tuple, List]" = OrderedDict()
+    for ent in prepared:
+        i, p, handles, mp = ent[0], ent[1], ent[2], ent[3]
+        bkey = (str(p._data._data.dtype), _devices_key(p._data._data), mp,
+                len(handles))
+        buckets.setdefault(bkey, []).append(ent)
+
+    chunks = []
+    for ents in buckets.values():
+        for s in range(0, len(ents), max(1, agg_size)):
+            chunks.append(ents[s:s + max(1, agg_size)])
+    return chunks
+
+
+def apply_chunk(updater, rule, chunk, lrs, wds, rescale,
+                sentinel: bool = False, flag=None, stats_out=None,
+                note_dispatches: bool = True):
+    """DEVICE half for ONE chunk: signature → cached jitted bucket
+    program → call → rebind weights/states. ``lrs``/``wds``/``rescale``
+    arrive as arrays (f32 vectors over the chunk / an f32 scalar) rather
+    than host floats so the megastep trace can feed slices of its
+    dynamic per-step inputs (Adam's bias-corrected lr changes every
+    step; baking it would retrace) — and so can pass tracers, inlining
+    the SAME cached program the composed path dispatches.
+    ``note_dispatches=False`` suppresses the efficiency-plane note: a
+    trace-time call is not a launch, and the megastep driver notes its
+    ONE program itself. Returns the handled indices."""
+    opt = updater.optimizer
+    collect = stats_out is not None
+    statics_key = rule.statics(opt)
+    donated, grads = [], []
+    for (_i, p, handles, _mp, _lr, _wd) in chunk:
+        donated.append((p._data._data,) +
+                       tuple(h._data for h in handles))
+        grads.append(p._grad._data)
+    donated = tuple(donated)
+    grads = tuple(grads)
+    # the stats variant inserts one True element; the stats-free
+    # signature stays the historical 5-tuple, so warm caches (and
+    # program_memory consumers) are untouched
+    sig = ((rule.name, statics_key, bool(sentinel)) +
+           ((True,) if collect else ()) +
+           (tuple(tuple((tuple(a.shape), str(a.dtype))
+                        for a in bundle) for bundle in donated),
+            tuple((tuple(g.shape), str(g.dtype)) for g in grads)))
+
+    def _build(chunk=chunk, s=sentinel, c=collect):
+        # kernel closures are built ONLY on a signature-cache miss —
+        # the warm path (every step after the first) pays a key
+        # lookup, not O(params) closure allocations
+        kernels = []
+        for (_i2, _p2, handles2, mp2, _lr2, _wd2) in chunk:
+            n_inner = len(handles2) - (1 if mp2 else 0)
+            k = rule.make_kernel(opt, n_inner > 0)
+            if mp2:
+                k = _wrap_mp(k)
+            kernels.append(_with_cast(k, mp2))
+        return _build_bucket_fn(tuple(kernels), s, stats=c)
+
+    fn = _cache().get_or_build(sig, _build)
+    # efficiency plane (MXTPU_EFFICIENCY): one launch of this bucket
+    # program into the current step window — the cost resolves
+    # lazily at step end through the SAME registry record
+    # program_memory fills. One cached env check when off.
+    if note_dispatches and _efficiency.enabled():
+        _efficiency.note_dispatch(
+            ("opt", sig), "optimizer",
+            f"{rule.name}:bucket{len(chunk)}",
+            functools.partial(_analyze_sig, sig, fn, need_cost=True))
+    if sentinel:
+        outs = fn(lrs, wds, rescale, flag, donated, grads)
+    else:
+        outs = fn(lrs, wds, rescale, donated, grads)
+    if collect:
+        outs, srows = outs
+        stats_out.append(
+            (tuple(e[1].name for e in chunk), srows))
+    handled = []
+    for (i, p, handles, _mp, _lr, _wd), bundle_out in zip(chunk, outs):
+        p._data._rebind(bundle_out[0])
+        for h, arr in zip(handles, bundle_out[1:]):
+            h._rebind(arr)
+        handled.append(i)
+    return handled
+
+
 def grouped_update(updater, items, agg_size: int, sentinel: bool = False,
                    sentinel_grads=None, sentinel_flag=None,
                    stats_out=None):
@@ -549,51 +684,10 @@ def grouped_update(updater, items, agg_size: int, sentinel: bool = False,
     """
     opt = updater.optimizer
     rule = _rule_for(opt)
-    check(rule is not None,
-          f"optimizer {type(opt).__name__} has no grouped-update rule")
-    for _, p in items:
-        if not _is_dense(p):
-            raise MXNetError(
-                f"grouped optimizer update requires dense parameters and "
-                f"gradients; {p.name!r} (stype={p.stype!r}) must take the "
-                "per-parameter path")
-
     jnp = _jnp()
-    is_adam = rule.name == "Adam"
 
-    # host-side bookkeeping first (identical order to the per-param loop:
-    # every count bumps before any lr is resolved within the step)
-    created = []
-    for i, p in items:
-        if i not in updater.states:
-            updater.states[i] = opt.create_state_multi_precision(i, p.data())
-            created.append(i)
-            _memory.track_optimizer_state(updater, i, updater.states[i],
-                                          param=p)
-        opt._update_count(i)
-
-    prepared = []
-    for i, p in items:
-        lr, wd = opt._get_lr(i), opt._get_wd(i)
-        if is_adam:
-            t = opt._index_update_count[i]
-            lr = lr * _math.sqrt(1 - opt.beta2 ** t) / (1 - opt.beta1 ** t)
-        handles, mp = _state_handles(opt, p, updater.states[i])
-        prepared.append((i, p, handles, mp, float(lr), float(wd)))
-
-    # bucket by (weight dtype, device placement, mp-ness), capped at
-    # agg_size, preserving parameter order within a bucket
-    buckets: "OrderedDict[Tuple, List]" = OrderedDict()
-    for ent in prepared:
-        i, p, handles, mp = ent[0], ent[1], ent[2], ent[3]
-        bkey = (str(p._data._data.dtype), _devices_key(p._data._data), mp,
-                len(handles))
-        buckets.setdefault(bkey, []).append(ent)
-
-    chunks = []
-    for ents in buckets.values():
-        for s in range(0, len(ents), max(1, agg_size)):
-            chunks.append(ents[s:s + max(1, agg_size)])
+    prepared, created = prepare_update(updater, items)
+    chunks = chunk_prepared(prepared, agg_size)
 
     flag = None
     if sentinel:
@@ -605,66 +699,15 @@ def grouped_update(updater, items, agg_size: int, sentinel: bool = False,
             flag = global_finite_flag(tuple(sentinel_grads))
 
     rescale = jnp.asarray(float(opt.rescale_grad), dtype=jnp.float32)
-    statics_key = rule.statics(opt)
-    collect = stats_out is not None
     n_dispatch = 0
     handled = []
     for chunk in chunks:
         lrs = jnp.asarray([e[4] for e in chunk], dtype=jnp.float32)
         wds = jnp.asarray([e[5] for e in chunk], dtype=jnp.float32)
-        donated, grads = [], []
-        for (_i, p, handles, _mp, _lr, _wd) in chunk:
-            donated.append((p._data._data,) +
-                           tuple(h._data for h in handles))
-            grads.append(p._grad._data)
-        donated = tuple(donated)
-        grads = tuple(grads)
-        # the stats variant inserts one True element; the stats-free
-        # signature stays the historical 5-tuple, so warm caches (and
-        # program_memory consumers) are untouched
-        sig = ((rule.name, statics_key, bool(sentinel)) +
-               ((True,) if collect else ()) +
-               (tuple(tuple((tuple(a.shape), str(a.dtype))
-                            for a in bundle) for bundle in donated),
-                tuple((tuple(g.shape), str(g.dtype)) for g in grads)))
-
-        def _build(chunk=chunk, s=sentinel, c=collect):
-            # kernel closures are built ONLY on a signature-cache miss —
-            # the warm path (every step after the first) pays a key
-            # lookup, not O(params) closure allocations
-            kernels = []
-            for (_i2, _p2, handles2, mp2, _lr2, _wd2) in chunk:
-                n_inner = len(handles2) - (1 if mp2 else 0)
-                k = rule.make_kernel(opt, n_inner > 0)
-                if mp2:
-                    k = _wrap_mp(k)
-                kernels.append(_with_cast(k, mp2))
-            return _build_bucket_fn(tuple(kernels), s, stats=c)
-
-        fn = _cache().get_or_build(sig, _build)
-        # efficiency plane (MXTPU_EFFICIENCY): one launch of this bucket
-        # program into the current step window — the cost resolves
-        # lazily at step end through the SAME registry record
-        # program_memory fills. One cached env check when off.
-        if _efficiency.enabled():
-            _efficiency.note_dispatch(
-                ("opt", sig), "optimizer",
-                f"{rule.name}:bucket{len(chunk)}",
-                functools.partial(_analyze_sig, sig, fn, need_cost=True))
-        if sentinel:
-            outs = fn(lrs, wds, rescale, flag, donated, grads)
-        else:
-            outs = fn(lrs, wds, rescale, donated, grads)
-        if collect:
-            outs, srows = outs
-            stats_out.append(
-                (tuple(e[1].name for e in chunk), srows))
+        handled += apply_chunk(updater, rule, chunk, lrs, wds, rescale,
+                               sentinel=sentinel, flag=flag,
+                               stats_out=stats_out)
         n_dispatch += 1
-        for (i, p, handles, _mp, _lr, _wd), bundle_out in zip(chunk, outs):
-            p._data._rebind(bundle_out[0])
-            for h, arr in zip(handles, bundle_out[1:]):
-                h._rebind(arr)
-            handled.append(i)
     return handled, n_dispatch, flag, created
 
 

@@ -307,6 +307,27 @@ def _rs_tile_fn(mesh, axis):
                    donate_argnums=(0,))
 
 
+def loopback_psum(x, contributions=None):
+    """In-graph ``psum`` for a loopback (single-process simulated) group.
+
+    The one-program megastep traces the simulated world's grad reduction
+    THROUGH this site so the collective lives structurally inside the
+    step program — where a real mesh axis would run ``jax.lax.psum`` /
+    ``psum_scatter`` (:func:`_rs_tile_fn`) and XLA would schedule it
+    against compute — instead of as a host-driven kvstore transport
+    between dispatches. A simulated world plays every rank over shared
+    buffers, so there is exactly ONE local contribution and the sum over
+    it is the identity: no arithmetic node is emitted (``-0.0 + 0.0``
+    would flip sign bits and break the bitwise-parity contract).
+    ``contributions`` lets a future multi-contribution loopback (e.g. a
+    per-device split) reduce through the same site."""
+    parts = [x] if contributions is None else list(contributions)
+    out = parts[0]
+    for p in parts[1:]:
+        out = out + p
+    return out
+
+
 def _coord_segment_reduce(local, all_parts, tag: str):
     """Coordination-service reduce-scatter: each rank publishes, per
     PEER, only the segments that peer owns (one ``{src}to{dst}`` blob per

@@ -1,0 +1,344 @@
+"""Megastep (mxnet_tpu/megastep.py + fit.py): ``MXTPU_MEGASTEP=on``
+traces forward + backward + finiteness sentinel + grouped optimizer
+update (and the simulated group's collectives) into ONE jitted,
+donated-buffer program per (signature, world) — a warm step is a single
+dispatch. The acceptance bar is BITWISE: the fused trajectory must equal
+the composed path's for every grouped optimizer config, including a
+chaos-poisoned (sentinel-skipped) step with loss-scale backoff, at
+world 1 and simulated world 4.
+
+Marker ``megastep`` (tier-1-safe: CPU, simulated worlds in-process)."""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, megastep
+from mxnet_tpu import kvstore as kvs
+from mxnet_tpu import fit as fit_mod
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.io import NDArrayIter
+from mxnet_tpu.contrib import chaos
+from mxnet_tpu.telemetry import efficiency as eff
+from mxnet_tpu.telemetry import memory as mem
+
+from test_zero import OPTS, _zero_env
+
+pytestmark = pytest.mark.megastep
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mega_env(monkeypatch, mega, world=0):
+    monkeypatch.setenv("MXTPU_MEGASTEP", "on" if mega else "off")
+    monkeypatch.setenv("MXTPU_OPTIMIZER_AGGREGATION", "8")
+    monkeypatch.delenv("MXTPU_COMM_OVERLAP", raising=False)
+    _zero_env(monkeypatch, world)
+
+
+def _build_net():
+    mx.random.seed(0)
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def _flat_states(tr):
+    def flat(sts):
+        if sts is None:
+            return []
+        if isinstance(sts, (tuple, list)):
+            return [np.asarray(getattr(s, "_data", s)).copy() for s in sts]
+        return [np.asarray(getattr(sts, "_data", sts)).copy()]
+    return {i: flat(sts) for i, sts in sorted(tr._updaters[0].states.items())}
+
+
+def _fit(monkeypatch, mega, opt="adam", kw=None, world=0, steps=4,
+         chaos_spec=None, loss_scale=1.0, tmpdir=None, efficiency=False,
+         numerics=None, on_step_end=None, net_sink=None):
+    """One FitLoop run; the megastep/composed toggle is the only delta.
+    Returns the FitResult with weights/states/net/trainer stapled on for
+    bitwise comparison."""
+    _mega_env(monkeypatch, mega, world)
+    if efficiency:
+        monkeypatch.setenv("MXTPU_EFFICIENCY", "on")
+    else:
+        monkeypatch.delenv("MXTPU_EFFICIENCY", raising=False)
+    if numerics is not None:
+        monkeypatch.setenv("MXTPU_NUMERICS", numerics)
+    else:
+        monkeypatch.delenv("MXTPU_NUMERICS", raising=False)
+    if tmpdir is not None:
+        monkeypatch.setenv("MXTPU_RUN_REPORT_DIR", str(tmpdir))
+    else:
+        monkeypatch.delenv("MXTPU_RUN_REPORT_DIR", raising=False)
+    net = _build_net()
+    if net_sink is not None:
+        net_sink["net"] = net
+    kv_kw = {"kvstore": kvs.create("local")} if world else {}
+    tr = gluon.Trainer(net.collect_params(), opt,
+                       dict(kw or {"learning_rate": 1e-3}), **kv_kw)
+    rs = np.random.RandomState(0)
+    it = NDArrayIter(rs.rand(steps * 4, 16).astype(np.float32),
+                     rs.rand(steps * 4, 4).astype(np.float32),
+                     batch_size=4)
+    loop = fit_mod.FitLoop(net, tr, gluon.loss.L2Loss(), it,
+                           ckpt_dir=None, loss_scale=loss_scale,
+                           on_step_end=on_step_end)
+    if chaos_spec:
+        chaos.install(chaos_spec)
+    try:
+        res = loop.fit(epochs=1)
+    finally:
+        if chaos_spec:
+            chaos.install("")
+    res._weights = [p.data().asnumpy().copy()
+                    for p in net.collect_params().values()]
+    res._states = _flat_states(tr)
+    res._net, res._trainer = net, tr
+    return res
+
+
+def _assert_bitwise(res_c, res_m):
+    assert res_c.losses == res_m.losses, \
+        (np.asarray(res_c.losses) - np.asarray(res_m.losses))
+    for a, b in zip(res_c._weights, res_m._weights):
+        np.testing.assert_array_equal(a, b)
+    assert sorted(res_c._states) == sorted(res_m._states)
+    for i in res_c._states:
+        for a, b in zip(res_c._states[i], res_m._states[i]):
+            np.testing.assert_array_equal(a, b)
+
+
+# -- strict knob ---------------------------------------------------------
+
+def test_megastep_env_strict_parse(monkeypatch):
+    """A typo'd MXTPU_MEGASTEP must raise, not silently train composed."""
+    for raw, want in [("on", True), ("1", True), ("true", True),
+                      ("off", False), ("0", False), ("false", False)]:
+        monkeypatch.setenv("MXTPU_MEGASTEP", raw)
+        assert megastep.megastep_requested() is want
+    monkeypatch.delenv("MXTPU_MEGASTEP", raising=False)
+    assert megastep.megastep_requested() is False
+    monkeypatch.setenv("MXTPU_MEGASTEP", "fused")
+    with pytest.raises(MXNetError, match="MXTPU_MEGASTEP"):
+        megastep.megastep_requested()
+
+
+def test_megastep_incompatible_knobs_raise(monkeypatch):
+    """Every statically checkable incompatibility raises at construction
+    — before a single step runs on the wrong path."""
+    _mega_env(monkeypatch, True)
+    net = _build_net()
+    loss = gluon.loss.L2Loss()
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 1e-3})
+    with pytest.raises(MXNetError, match="skip_nonfinite"):
+        megastep.Megastep(net, tr, loss, skip_nonfinite=False)
+    with pytest.raises(MXNetError, match="ignore_stale_grad"):
+        megastep.Megastep(net, tr, loss, ignore_stale_grad=True)
+    tr_comp = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-3},
+                            compression_params={"type": "2bit",
+                                                "threshold": 0.5})
+    with pytest.raises(MXNetError, match="compression"):
+        megastep.Megastep(net, tr_comp, loss)
+    monkeypatch.setenv("MXTPU_OPTIMIZER_AGGREGATION", "0")
+    with pytest.raises(MXNetError, match="AGGREGATION"):
+        megastep.Megastep(net, tr, loss)
+
+
+# -- the one-dispatch contract -------------------------------------------
+
+def test_megastep_warm_step_is_one_dispatch(monkeypatch):
+    """The tentpole's observable: every warm step notes EXACTLY one
+    dispatched program on the efficiency plane, fully attributed
+    (unattributed_dispatches == 0), and the trainer's per-step counters
+    read one update dispatch and zero host collectives."""
+    res = _fit(monkeypatch, True, steps=6, efficiency=True)
+    recs = [r for r in eff.rollup().recent if r.get("step", 0) >= 1]
+    assert recs, "efficiency rollup recorded no warm steps"
+    for rec in recs:
+        assert rec["dispatches"] == 1, rec
+        assert rec["unattributed_dispatches"] == 0, rec
+    tr = res._trainer
+    assert tr.last_update_dispatches == 1
+    assert tr.last_allreduce_collectives == 0
+    assert tr.last_reduce_scatter_collectives == 0
+    assert tr.last_allgather_collectives == 0
+    kinds = {k[0] if isinstance(k, tuple) else k
+             for k in eff.rollup().programs}
+    assert "megastep" in kinds
+
+
+def test_megastep_cache_misses_pinned(monkeypatch):
+    """One trace, then pure hits: warm steps never re-trace (the
+    signature is stable across steps — dynamic lr/wd/scale/poison are
+    program INPUTS, not cache keys)."""
+    steps = 6
+    res = _fit(monkeypatch, True, steps=steps)
+    info = megastep.cache_info(res._net)
+    assert info is not None
+    assert info.misses == 1, info
+    assert info.hits == steps - 1, info
+    assert info.currsize == 1, info
+
+
+# -- bitwise parity ------------------------------------------------------
+
+@pytest.mark.parametrize("opt,kw", OPTS)
+def test_megastep_bitwise_parity(opt, kw, monkeypatch):
+    """megastep == composed, bitwise — losses, weights and optimizer
+    state — for all six grouped optimizer configs at world 1."""
+    res_c = _fit(monkeypatch, False, opt=opt, kw=kw)
+    res_m = _fit(monkeypatch, True, opt=opt, kw=kw)
+    _assert_bitwise(res_c, res_m)
+
+
+@pytest.mark.parametrize("opt,kw", OPTS)
+def test_megastep_zero_world4_bitwise_parity(opt, kw, monkeypatch):
+    """Same bar under simulated-world-4 ZeRO-1: the in-graph loopback
+    reduce-scatter + allgather reproduce the plane's collective round
+    bitwise for all six configs."""
+    res_c = _fit(monkeypatch, False, opt=opt, kw=kw, world=4)
+    res_m = _fit(monkeypatch, True, opt=opt, kw=kw, world=4)
+    _assert_bitwise(res_c, res_m)
+
+
+def test_megastep_sentinel_skip_and_backoff_parity(monkeypatch):
+    """A chaos-poisoned NaN step then an Inf step: the in-graph
+    where-guarded sentinel must skip BOTH inside the one program, the
+    loss scale must back off 128 -> 64 -> 32, and the whole trajectory
+    (including the skipped steps' reported losses) stays bitwise."""
+    spec = "nan_grad@1,inf_grad@2"
+    res_c = _fit(monkeypatch, False, chaos_spec=spec, loss_scale=128.0,
+                 steps=5)
+    res_m = _fit(monkeypatch, True, chaos_spec=spec, loss_scale=128.0,
+                 steps=5)
+    assert res_c.skipped_steps == [1, 2]
+    assert res_m.skipped_steps == [1, 2]
+    assert res_c.loss_scale == res_m.loss_scale == 32.0
+    _assert_bitwise(res_c, res_m)
+
+
+# -- donation ------------------------------------------------------------
+
+def test_megastep_donates_step_buffers(monkeypatch):
+    """The buffers are MOVED through the program, not copied: each warm
+    step consumes (deletes) the previous step's param/grad/state arrays,
+    and the persistent ledger bytes match the composed path exactly —
+    one resident generation, never two."""
+    captured = {}
+
+    def grab(step, _loss):
+        if step == 1:
+            net = captured["net"]
+            captured["bufs"] = [p._data._data
+                                for p in net.collect_params().values()]
+
+    def live():
+        return (mem.ledger().live_bytes("params") +
+                mem.ledger().live_bytes("grads") +
+                mem.ledger().live_bytes("optimizer"))
+
+    base = live()
+    # both results stay referenced to the end: the ledger deltas below
+    # must not be perturbed by finalizer-driven entry drops
+    res_c = _fit(monkeypatch, False, steps=4)
+    bytes_c = live() - base
+    res_m = _fit(monkeypatch, True, steps=4, on_step_end=grab,
+                 net_sink=captured)
+    bytes_m = live() - base - bytes_c
+    assert res_c is not None and res_m is not None
+    assert bytes_m == bytes_c, \
+        f"megastep holds {bytes_m} persistent bytes vs composed {bytes_c}"
+    if not megastep.donation_supported():
+        pytest.skip("backend does not reuse donated buffers")
+    assert captured["bufs"], "step-1 buffers were never captured"
+    for buf in captured["bufs"]:
+        assert buf.is_deleted(), \
+            "a warm step left the previous generation's buffer alive"
+
+
+# -- ride-alongs ---------------------------------------------------------
+
+def test_megastep_numerics_ride_along(monkeypatch):
+    """A numerics-sampled step runs the stats VARIANT of the program —
+    extra outputs, zero extra dispatches: every step still notes exactly
+    one program, and the cache holds exactly the two variants."""
+    res = _fit(monkeypatch, True, steps=6, efficiency=True,
+               numerics="on,every=2")
+    assert res.numerics is not None
+    recs = [r for r in eff.rollup().recent if r.get("step", 0) >= 1]
+    assert recs
+    for rec in recs:
+        assert rec["dispatches"] == 1, rec
+        assert rec["unattributed_dispatches"] == 0, rec
+    info = megastep.cache_info(res._net)
+    assert info.misses == 2, info  # plain + stats variant, never more
+    assert info.currsize == 2, info
+
+
+def test_megastep_breakdown_one_segment(monkeypatch):
+    """StepBreakdown attribution collapses compute/optimizer/comm into
+    the single 'megastep' segment and stays accounted (>= 0.8): one
+    program, one attributed slice of the step wall."""
+    res = _fit(monkeypatch, True, steps=6)
+    bd = res.step_breakdown
+    assert bd is not None
+    shares = bd["shares"]
+    assert shares.get("megastep", 0.0) > 0.0, shares
+    assert shares.get("compute", 0.0) == 0.0, shares
+    assert shares.get("optimizer", 0.0) == 0.0, shares
+    assert shares.get("comm", 0.0) == 0.0, shares
+    assert bd["accounted_frac"] >= 0.8, bd
+
+
+# -- the CI gate ---------------------------------------------------------
+
+def test_megastep_run_compare_direction(monkeypatch, tmp_path):
+    """The before/after grade: a composed/megastep run-report pair diffs
+    in the improving direction — warm step time (p50; the one cold trace
+    lands outside the median) drops past the fence — and the REVERSED
+    pair fails tools/run_compare.py's gate (exit 1) naming the
+    regression. The attribution side rides along: the megastep program
+    carries the WHOLE step's FLOPs, so attributed flops-per-step strictly
+    exceeds the composed path's (which can attribute only the optimizer
+    dispatches)."""
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import run_compare
+    finally:
+        sys.path.pop(0)
+    # warm the global op/jit caches so the composed leg is warm; the
+    # megastep leg still pays ONE cold trace (per-net cache), which the
+    # p50 window absorbs
+    _fit(monkeypatch, False, efficiency=True)
+    _fit(monkeypatch, True, efficiency=True)
+    res_c = _fit(monkeypatch, False, steps=16, efficiency=True,
+                 tmpdir=tmp_path)
+    res_m = _fit(monkeypatch, True, steps=16, efficiency=True,
+                 tmpdir=tmp_path)
+    assert res_c.run_report and res_m.run_report
+    a = run_compare.load_report(res_c.run_report)
+    b = run_compare.load_report(res_m.run_report)
+    verdict = run_compare.compare(a, b, fence_pct=10.0)
+    assert "step_time_p50_s" in verdict["improved"], verdict["metrics"]
+    assert "step_time_p50_s" not in verdict["regressed"]
+    row = [r for r in verdict["metrics"]
+           if r["metric"] == "step_time_p50_s"][0]
+    assert row["verdict"] == "improved"
+    # attribution completeness, straight off the reports (wall-free):
+    # one program owns forward+backward+update flops the composed path
+    # never attributes
+    assert (b["efficiency"]["flops_per_step"] >
+            a["efficiency"]["flops_per_step"])
+    # reversed pair: the regression must be caught and NAMED
+    rc = run_compare.main([res_m.run_report, res_c.run_report,
+                           "--fence", "10", "--json"])
+    assert rc == 1
+    reverse = run_compare.compare(b, a, fence_pct=10.0)
+    assert "step_time_p50_s" in reverse["regressed"]
