@@ -1666,6 +1666,97 @@ def _fleet_probe():
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _recsys_probe(rows=256, dim=16, world=4, batch=128, steps=8):
+    """The `recsys` row: the sparse embedding plane's two-tower numbers
+    (parallel/embedding_plane.py). Train: warm mask-packed row-sparse
+    steps against the ``world``-way row-sharded table -> examples/s, and
+    the per-rank ledger bytes vs a world=1 baseline trained the same way
+    (Adam state is lazy per rank, so every rank is touched first — the
+    pin is per_rank == unsharded // world EXACTLY, the ledger is exact
+    on CPU). Serve: the table + a small tower publish as one registry
+    version (serving/lookup.py) and a 2-replica LookupFleet answers a
+    closed loop -> lookup_qps."""
+    import shutil
+    import tempfile
+    import time
+
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu import optimizer as opt_mod
+    from mxnet_tpu.parallel.embedding_plane import EmbeddingPlane
+    from mxnet_tpu.serving import LookupFleet, ModelRegistry
+    from mxnet_tpu.serving.lookup import publish_embedding
+
+    saved = os.environ.get("MXTPU_SPARSE_PLANE")
+    os.environ["MXTPU_SPARSE_PLANE"] = "on"
+    tmp = tempfile.mkdtemp(prefix="bench_recsys_")
+    planes = []
+    try:
+        rs = np.random.RandomState(0)
+        grads = rs.randn(batch, dim).astype(np.float32) * 0.1
+
+        def make(w, name):
+            p = EmbeddingPlane(name, rows=rows, dim=dim, world=w,
+                               optimizer=opt_mod.Adam(learning_rate=0.05))
+            planes.append(p)
+            # touch every row once: all ranks materialize their lazy
+            # Adam state, and warm compiles leave the timed window
+            p.step(np.arange(rows),
+                   rs.randn(rows, dim).astype(np.float32) * 0.1)
+            p.step(rs.randint(0, rows, batch), grads)
+            return p
+
+        base = make(1, "bench_recsys_base")       # the unsharded ledger
+        plane = make(world, "bench_recsys")
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            plane.step(rs.randint(0, rows, batch), grads)
+        examples_per_s = steps * batch / max(time.perf_counter() - t0,
+                                             1e-9)
+        unsharded = base.rank_bytes(0)
+        per_rank = [plane.rank_bytes(r) for r in range(world)]
+
+        # serve the trained table: one published version, 2 replicas
+        tower = nn.Dense(1, in_units=dim)
+        tower.initialize(mx.init.Xavier())
+        with autograd.pause():
+            tower(plane.lookup(np.arange(4)))
+        reg = ModelRegistry(os.path.join(tmp, "registry"))
+        version = publish_embedding(
+            reg, "bench_recsys", plane, tower,
+            signature={"bucket_shapes": [[dim]], "dtype": "float32"})
+        fleet = LookupFleet(reg, "bench_recsys", replicas=2,
+                            version=version)
+        serve_s = min(1.0, max(0.4, _budget_left() / 60))
+        deadline = time.perf_counter() + serve_s
+        while time.perf_counter() < deadline:
+            fleet.lookup(rs.randint(0, rows, 32))
+        m = fleet.metrics_json()
+        return {
+            "world": world,
+            "rows": rows,
+            "dim": dim,
+            "examples_per_s": round(examples_per_s, 1),
+            "unsharded_embedding_bytes": int(unsharded),
+            "per_rank_embedding_bytes": [int(b) for b in per_rank],
+            "replicas": m["replicas"],
+            "lookup_requests": m["requests"],
+            "lookup_qps": round(m["lookup_qps"], 1),
+        }
+    finally:
+        for p in planes:
+            try:
+                p.close()
+            except Exception:
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.environ.pop("MXTPU_SPARSE_PLANE", None)
+        if saved is not None:
+            os.environ["MXTPU_SPARSE_PLANE"] = saved
+
+
 def _run_child(mode, args_rest):
     if not _init_backend():
         os._exit(1)
@@ -1768,6 +1859,13 @@ def _run_child(mode, args_rest):
                       flush=True)
             except Exception as e:
                 log(f"fleet probe failed: {e}")
+        if os.environ.get("MXTPU_BENCH_RECSYS", "1") != "0":
+            try:
+                rrow = _recsys_probe()
+                print("EXTRA_ROW " + json.dumps({"recsys": rrow}),
+                      flush=True)
+            except Exception as e:
+                log(f"recsys probe failed: {e}")
 
 
 # global wall-clock budget: the driver kills the whole bench at some
@@ -2024,6 +2122,13 @@ def main():
                 # 0), zero-compile scale-up wall seconds, and the
                 # dense-vs-int8 per-replica throughput ratio
                 payload["fleet"] = _EXTRAS["fleet"]
+            if "recsys" in _EXTRAS:
+                # the sparse-plane evidence: warm mask-packed row-sparse
+                # examples/s against the 4-way row-sharded table, the
+                # per-rank ledger bytes at exactly 1/world of the
+                # unsharded baseline, and the closed-loop lookup_qps a
+                # 2-replica LookupFleet serves from the published table
+                payload["recsys"] = _EXTRAS["recsys"]
             # the train number is safe on stdout NOW; each optional row
             # that lands re-emits the extended line immediately, so a
             # truncated run keeps everything measured so far
@@ -2074,7 +2179,8 @@ def main():
                                    "MXTPU_BENCH_EFFICIENCY": "0",
                                    "MXTPU_BENCH_ELASTIC": "0",
                                    "MXTPU_BENCH_SELFHEAL": "0",
-                                   "MXTPU_BENCH_FLEET": "0"})
+                                   "MXTPU_BENCH_FLEET": "0",
+                                   "MXTPU_BENCH_RECSYS": "0"})
                     if t8:
                         payload["train_int8_imgs_per_sec"] = round(t8, 2)
                         print(json.dumps(payload), flush=True)

@@ -471,6 +471,30 @@ env.declare("MXTPU_PROFILE_BOUND_FRAC", float, 0.4,
             "wall-clock step time reaches this fraction logs a one-line "
             "input-bound/comm-bound diagnosis. <=0 disables the "
             "detector.")
+env.declare("MXTPU_SPARSE_PLANE", str, "off",
+            "Sparse embedding plane (parallel/embedding_plane.py): '1'/"
+            "'on' opts a row-sparse embedding table into the sharded "
+            "sparse subsystem — the table is partitioned row-wise "
+            "across the (simulated or real) world, row-sparse "
+            "gradients travel dedup'd + mask-packed into fixed-shape "
+            "(max_rows, dim) gather/scatter update programs (no warm-"
+            "step retrace on varying touched-row counts), and per-row "
+            "optimizer state lives only on the rank owning the row "
+            "(1/world state bytes, ledger-exact). Off (default): sparse "
+            "parameters raise out of the grouped update path with a "
+            "message naming this flag. Unknown values raise.")
+env.declare("MXTPU_SPARSE_MAX_ROWS", int, 4096,
+            "Sparse-plane bucket ceiling: touched-row counts are padded "
+            "up to the next power of two, capped at this many rows per "
+            "fixed-shape update program. A minibatch touching more "
+            "unique rows than the cap raises (the cap IS the retrace "
+            "contract — raising it recompiles). Must be >= 1; "
+            "unparseable values raise.")
+env.declare("MXTPU_BENCH_RECSYS", str, "1",
+            "bench.py: run the recsys probe child (two-tower training "
+            "over a sharded embedding table at simulated world 4 + "
+            "registry-served lookup QPS) and fold the 'recsys' row into "
+            "the headline artifact. '0' skips the child.")
 
 
 def data_dir() -> str:

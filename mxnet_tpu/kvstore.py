@@ -458,6 +458,36 @@ class KVStoreBase:
     def _zero_all_finite_impl(self, ok: bool) -> bool:
         return bool(ok)
 
+    def sparse_plane_exchange(self, key, ids, rows):
+        """Replicate one packed row-sparse gradient buffer — the sparse
+        embedding plane's per-step grad exchange (``parallel/
+        embedding_plane.py``): a fixed-shape ``(max_rows,)`` id vector +
+        ``(max_rows, dim)`` deduped gradient rows, every rank receiving
+        the identical union buffer and updating only the rows its shard
+        owns (the mask-pack discipline: the fixed shape IS the wire
+        format, so the exchange never retraces or re-buckets).
+
+        Same per-key discipline as the ZeRO plane ops: one _traced_retry
+        + one _chaos_kv entry, and the op is a PURE read of its inputs —
+        single-worker stores echo the buffer back (the local gradient
+        already IS the group union), so a retried ``kv_flake`` replays a
+        read, never a second apply. Distributed transports override
+        ``_sparse_plane_exchange_impl`` with the real wire hop; the
+        TransientKVError point must stay ahead of any payload
+        consumption (the _retry_op contract)."""
+        out: List = []
+
+        def run():
+            out.clear()
+            _chaos_kv("push", key, self.rank)
+            out.extend(self._sparse_plane_exchange_impl(key, ids, rows))
+        nb = _coll_bytes(rows) if _coll.enabled() else 0
+        _traced_retry("push", key, run, nbytes=nb, rank=self.rank)
+        return out[0], out[1]
+
+    def _sparse_plane_exchange_impl(self, key, ids, rows):
+        return [ids, rows]
+
     # -- optimizer / updater -------------------------------------------
     def set_updater(self, updater) -> None:
         self._updater = updater

@@ -37,9 +37,9 @@ from ..telemetry import efficiency as _efficiency
 from ..telemetry import memory as _memory
 
 __all__ = ["aggregation_size", "eligible", "grouped_update",
-           "prepare_update", "chunk_prepared", "apply_chunk",
-           "global_finite_flag", "rollback_counts", "cache_info",
-           "clear_cache", "program_memory"]
+           "sparse_rows_update", "prepare_update", "chunk_prepared",
+           "apply_chunk", "global_finite_flag", "rollback_counts",
+           "cache_info", "clear_cache", "program_memory"]
 
 
 def _jnp():
@@ -533,8 +533,14 @@ def prepare_update(updater, items):
         if not _is_dense(p):
             raise MXNetError(
                 f"grouped optimizer update requires dense parameters and "
-                f"gradients; {p.name!r} (stype={p.stype!r}) must take the "
-                "per-parameter path")
+                f"gradients; {p.name!r} (stype={p.stype!r}, grad_stype="
+                f"{getattr(p, 'grad_stype', 'default')!r}) has no fused "
+                "dense bucket. Sparse tables opt into the row-gathered "
+                "grouped path with MXTPU_SPARSE_PLANE=on + "
+                "parallel.embedding_plane.EmbeddingPlane (which calls "
+                "sparse_rows_update); outside the plane, sparse "
+                "parameters take the per-parameter lazy-update loop "
+                "(Trainer routes them there automatically).")
 
     is_adam = rule.name == "Adam"
     created = []
@@ -679,8 +685,14 @@ def grouped_update(updater, items, agg_size: int, sentinel: bool = False,
     first materialized by THIS call (a sentinel-skipped step must delete
     them again — state creation is an observable side effect the
     per-param skip path never has). Raises :class:`MXNetError` if any
-    input is sparse — callers route ``stype != 'default'`` /
-    row-sparse-grad parameters through the per-parameter loop instead.
+    input is sparse — ONE documented behavior for every config: the
+    fused dense buckets never accept sparse storage. The raise names
+    ``MXTPU_SPARSE_PLANE`` as the opt-in: sparse tables ride the
+    row-gathered variant (:func:`sparse_rows_update`) through
+    ``parallel.embedding_plane.EmbeddingPlane``; everything else routes
+    sparse parameters through the per-parameter lazy-update loop (the
+    Trainer's ``eligible()`` gate does this automatically, so callers
+    only see this raise when they bypass the gate).
     """
     opt = updater.optimizer
     rule = _rule_for(opt)
@@ -709,6 +721,107 @@ def grouped_update(updater, items, agg_size: int, sentinel: bool = False,
                                stats_out=stats_out)
         n_dispatch += 1
     return handled, n_dispatch, flag, created
+
+
+def _build_rows_fn(kernel, guarded: bool):
+    """One jitted program stepping the TOUCHED rows of one row-sharded
+    table: gather ``(max_rows, dim)`` slices of weight + optimizer state
+    at ``idx``, run the SAME per-parameter rule kernel on the gathered
+    rows, scatter the results back under the validity mask. The program
+    shape depends only on (shard shape, bucket) — never on how many rows
+    a step actually touched — so warm steps with varying touched-row
+    counts replay, not retrace.
+
+    Scatter discipline: deduped valid indices are unique by construction
+    (the plane's host-side ``np.unique``); invalid lanes (padding +
+    rows another shard owns) are routed to an out-of-range pad row and
+    sliced off, the ``sharded_scatter_add`` drop idiom — ``at[].set``
+    with colliding lanes would race old row bytes against new ones.
+    With ``guarded`` the sentinel verdict ANDs into the mask, so a
+    non-finite step leaves every row's weight AND lazily-touched state
+    bytes exactly as they were (MASKED writes, not idempotent ones:
+    Adam/AdaGrad state accumulates, a replayed write would double-decay).
+    """
+    import jax
+    jnp = _jnp()
+
+    def step(lr, wd, rescale, ok, donated, grad_rows, idx, valid):
+        w, states = donated[0], tuple(donated[1:])
+        nloc = w.shape[0]
+        safe = jnp.clip(idx, 0, nloc - 1)
+        gw = jnp.take(w, safe, axis=0)
+        gs = tuple(jnp.take(s, safe, axis=0) for s in states)
+        nw, ns = kernel(gw, grad_rows, gs, lr, wd, rescale)
+        keep = valid if ok is None else valid & ok
+        dump = jnp.where(keep, safe, nloc)
+
+        def scat(full, rows):
+            padded = jnp.concatenate(
+                [full, jnp.zeros((1,) + full.shape[1:], full.dtype)])
+            return padded.at[dump].set(rows.astype(full.dtype))[:nloc]
+
+        return (scat(w, nw),) + tuple(
+            scat(s, a) for s, a in zip(states, ns))
+
+    if guarded:
+        def fn(lr, wd, rescale, ok, donated, grad_rows, idx, valid):
+            return step(lr, wd, rescale, ok, donated, grad_rows, idx,
+                        valid)
+        return jax.jit(fn, donate_argnums=(4,))
+
+    def fn(lr, wd, rescale, donated, grad_rows, idx, valid):
+        return step(lr, wd, rescale, None, donated, grad_rows, idx, valid)
+    return jax.jit(fn, donate_argnums=(3,))
+
+
+def sparse_rows_update(opt, weight, states, grad_rows, idx, valid, lr, wd,
+                       flag=None):
+    """Row-gathered grouped update for ONE shard of a row-sharded table —
+    the sparse plane's device half (``parallel/embedding_plane.py``),
+    and the variant :func:`grouped_update`'s dense buckets raise toward.
+
+    All tensor arguments are raw jax arrays: ``weight`` is the
+    ``(rows_local, dim)`` shard (donated, with its state arrays — XLA
+    updates in place), ``grad_rows`` the deduped ``(max_rows, dim)``
+    mask-packed gradient rows (NOT donated — they stay readable for the
+    sentinel and chaos hooks), ``idx``/``valid`` the shard-local row ids
+    and their in-shard+non-padding mask, ``lr``/``wd`` dynamic f32
+    scalars (Adam's bias-corrected lr changes every step; baking it
+    would retrace) and ``flag`` an optional device all-finite verdict
+    (the global sentinel). The update math is the SAME
+    :data:`_RULES` kernel the dense buckets trace, applied to gathered
+    rows — so a plane step is bitwise the dense-gather reference update
+    on the touched rows. Returns ``(new_weight, new_states)``.
+    """
+    jnp = _jnp()
+    rule = _rule_for(opt)
+    check(rule is not None,
+          f"sparse_rows_update: optimizer {type(opt).__name__} has no "
+          "grouped-update rule")
+    states = tuple(states)
+    donated = (weight,) + states
+    guarded = flag is not None
+    # 7-tuple, deliberately foreign to _sig_fields (the shared-LRU
+    # discipline: program_memory skips entries it cannot re-lower)
+    sig = ("sparse_rows", rule.name, rule.statics(opt), guarded,
+           tuple((tuple(a.shape), str(a.dtype)) for a in donated),
+           (tuple(grad_rows.shape), str(grad_rows.dtype)),
+           (tuple(idx.shape), str(idx.dtype)))
+
+    def _build(n_states=len(states), g=guarded):
+        k = rule.make_kernel(opt, n_states > 0)
+        return _build_rows_fn(_with_cast(k, False), g)
+
+    fn = _cache().get_or_build(sig, _build)
+    lr = jnp.asarray(float(lr), jnp.float32)
+    wd = jnp.asarray(float(wd), jnp.float32)
+    rescale = jnp.asarray(float(opt.rescale_grad), jnp.float32)
+    if guarded:
+        outs = fn(lr, wd, rescale, jnp.asarray(flag), donated, grad_rows,
+                  idx, valid)
+    else:
+        outs = fn(lr, wd, rescale, donated, grad_rows, idx, valid)
+    return outs[0], tuple(outs[1:])
 
 
 def rollback_counts(opt, indices: Sequence[int]) -> None:

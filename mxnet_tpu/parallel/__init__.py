@@ -12,3 +12,5 @@ from .spmd import SPMDTrainer  # noqa: F401
 from .ring_attention import attention, ring_attention  # noqa: F401
 from .moe import init_moe_params, moe_param_specs, moe_ffn  # noqa: F401
 from .pipeline import pipeline_apply  # noqa: F401
+from .embedding_plane import (EmbeddingPlane, row_partition,  # noqa: F401
+                              sparse_plane_requested, sparse_max_rows)

@@ -1,0 +1,410 @@
+"""Sparse embedding plane: sharded giant-embedding training (ROADMAP 4).
+
+The reference framework treats sparse as first-class — row_sparse NDArray
+storage, ``KVStore::PullRowSparse`` moving only touched rows, sparse-aware
+optimizers lazily updating only the rows present in the gradient
+(src/operator/optimizer_op.cc ``SGDUpdateRspImpl``/``AdamUpdateRspImpl``)
+— and its canonical consumer is the giant-embedding recommender: a table
+too big for one device, row-sharded across the server fleet
+(src/kvstore/kvstore_dist_server.h ``DataHandleRowSparse``), with lookup
+traffic at serve time.
+
+This module is that capability rebuilt for the TPU cost model, as the
+sparse analog of the ZeRO plane (``parallel/zero.py``):
+
+- **Row-wise table sharding.** The table is partitioned row-wise across
+  the world by a pure contiguous derivation (:func:`row_partition`, the
+  ``zero.partition`` discipline: every rank and every restart derives
+  identical shards from (rows, world) alone). In a real worker group each
+  rank holds its shard; in a simulated world (the ``MXTPU_ZERO_WORLD``
+  idiom) all shards live in-process, so the whole protocol — including
+  the 1/world ledger bytes — is testable on one CPU.
+- **Fixed-shape sparse gradients, end-to-end.** Touched ids are deduped
+  host-side (``np.unique``), their gradient rows segment-summed on
+  device, and the result mask-packed into a ``(max_rows, dim)`` bucket
+  (next power of two, capped by ``MXTPU_SPARSE_MAX_ROWS``) with a
+  validity mask — so warm steps never retrace on varying touched-row
+  counts; the bucket IS the retrace contract. The packed buffer is the
+  wire format too: :meth:`KVStoreBase.sparse_plane_exchange` replicates
+  it under the same ``_traced_retry`` + ``_chaos_kv`` entry as every
+  other collective, and because the exchange is a PURE read, a retried
+  ``kv_flake`` replays a read — never a second apply.
+- **Row-gathered grouped update.** Each rank's shard steps through
+  ``optimizer.grouped.sparse_rows_update`` — the row-gathered variant of
+  the fused dense buckets, tracing the SAME per-parameter rule kernels —
+  with per-row optimizer state created lazily on the first step that
+  touches the rank and co-located with the shard (the ZeRO analog:
+  ``state:`` + ``params:`` ledger bytes land at exactly 1/world per
+  rank, owners ``emb<r>/<N>:<table>`` / ``state:emb<r>/<N>:<table>``).
+- **Sentinel + rollback.** An optional device all-finite verdict guards
+  every row write (``where(ok & valid, new, old)``); a skipped step's
+  host effects — the update-count bump and any state arrays it first
+  materialized — are undone by :meth:`EmbeddingPlane.rollback_step`,
+  exactly the ``Trainer.rollback_step`` contract.
+
+The lookup kernel is the ``sharded_embedding`` mp-parity kernel's math
+(psum-of-masked-gather) with the psum unrolled over simulated ranks:
+each shard contributes its masked gather, the sum assembles the batch.
+On a real mesh the table can be served through
+``sharded_embedding.sharded_lookup`` unchanged — the shard layout is the
+same contiguous row partition.
+
+The plane deliberately lives OUTSIDE ``Trainer._params``: dense towers
+train through the Trainer (ZeRO and all), the table trains through the
+plane, and the two compose in one loop — the configuration
+``parallel/zero.py``'s sparse check points at. Benched end-to-end by the
+``recsys`` bench row (bench.py, gated by ``MXTPU_BENCH_RECSYS``) and the
+two-tower recipe (``examples/recsys/two_tower.py``); served through
+``serving/lookup.py`` from the model registry.
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Tuple
+
+import numpy as _np
+
+from ..base import MXNetError, check, env
+
+__all__ = ["sparse_plane_requested", "sparse_max_rows", "row_partition",
+           "row_bucket", "masked_gather", "EmbeddingPlane"]
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def sparse_plane_requested() -> bool:
+    """Strict ``MXTPU_SPARSE_PLANE`` parse — a typo'd opt-in must not
+    silently fall back to the dense path (the MXTPU_ZERO discipline)."""
+    raw = str(env.get("MXTPU_SPARSE_PLANE") or "").strip().lower()
+    if raw in ("", "0", "off", "false"):
+        return False
+    if raw in ("1", "on", "true"):
+        return True
+    raise MXNetError(
+        f"MXTPU_SPARSE_PLANE: unknown value {raw!r} (known: on, off)")
+
+
+def sparse_max_rows() -> int:
+    """``MXTPU_SPARSE_MAX_ROWS``: the fixed-shape bucket ceiling.
+    Unparseable values raise — a typo'd cap silently defaulting would
+    change which minibatches are admissible."""
+    try:
+        n = int(env.get("MXTPU_SPARSE_MAX_ROWS"))
+    except (TypeError, ValueError) as e:
+        raise MXNetError(
+            f"MXTPU_SPARSE_MAX_ROWS: not an integer: "
+            f"{env.raw('MXTPU_SPARSE_MAX_ROWS')!r}") from e
+    if n < 1:
+        raise MXNetError(f"MXTPU_SPARSE_MAX_ROWS must be >= 1, got {n}")
+    return n
+
+
+def row_partition(rows: int, world: int) -> List[Tuple[int, int]]:
+    """Contiguous ``[start, stop)`` row range per rank — a pure function
+    of (rows, world), the ``zero.partition`` invariant: every rank and
+    every restart derives identical shards, so checkpoints and the
+    serving artifact are topology-portable by construction."""
+    check(world >= 1, "sparse plane world size must be >= 1")
+    check(rows % world == 0,
+          f"embedding rows {rows} must divide the world {world} "
+          "(pad the vocabulary — the contiguous row partition is the "
+          "shard-layout invariant)")
+    per = rows // world
+    return [(r * per, (r + 1) * per) for r in range(world)]
+
+
+def row_bucket(n: int, cap: Optional[int] = None) -> int:
+    """Next power of two >= ``n`` (min 8), capped at ``cap`` (default
+    ``MXTPU_SPARSE_MAX_ROWS``) — the ``ops/sparse_ops._nnz_bucket``
+    policy applied to touched-row counts. ``n`` above the cap raises:
+    the cap IS the retrace contract, raising it recompiles."""
+    cap = sparse_max_rows() if cap is None else int(cap)
+    if n > cap:
+        raise MXNetError(
+            f"sparse plane: minibatch touches {n} unique rows, above the "
+            f"MXTPU_SPARSE_MAX_ROWS bucket ceiling {cap}; raise the cap "
+            "(one recompile per new bucket) or shrink the batch")
+    b = 8
+    while b < n:
+        b <<= 1
+    return min(b, cap)
+
+
+# ---------------------------------------------------------------------------
+# Compiled kernels, cached per static shape (the SignatureLRU discipline
+# via lru_cache: jit identity stable per bucket, so warm steps replay).
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _gather_fn(world: int, rows_per: int, bucket: int):
+    """Psum-of-masked-gather over the shard tuple: each simulated rank
+    contributes the rows it owns and zeros elsewhere; the sum assembles
+    the batch (``sharded_embedding._lookup_fn`` with the psum unrolled —
+    one device, no shard_map needed)."""
+    import jax
+    jnp = _jnp()
+
+    def fn(shards, ids):
+        out = None
+        for r, t in enumerate(shards):
+            local = ids - r * rows_per
+            mine = (local >= 0) & (local < rows_per)
+            safe = jnp.clip(local, 0, rows_per - 1)
+            got = jnp.take(t, safe, axis=0)
+            contrib = jnp.where(mine[:, None], got, 0)
+            out = contrib if out is None else out + contrib
+        return out
+    return jax.jit(fn)
+
+
+def masked_gather(shards, ids_np, bucket: Optional[int] = None):
+    """Gather rows ``ids_np`` from per-rank shard arrays (each
+    ``(rows/world, dim)``), padding the id vector to a power-of-two
+    bucket (pad id -1 gathers zeros) so lookups never retrace on batch
+    size within a bucket. Returns a ``(len(ids), dim)`` jax array.
+    Shared with the serving lookup path (``serving/lookup.py``)."""
+    jnp = _jnp()
+    ids_np = _np.asarray(ids_np, _np.int32).ravel()
+    n = int(ids_np.shape[0])
+    if bucket is None:
+        b = 8
+        while b < n:
+            b <<= 1
+    else:
+        b = int(bucket)
+        check(b >= n, f"lookup bucket {b} < batch {n}")
+    padded = _np.full((b,), -1, _np.int32)
+    padded[:n] = ids_np
+    rows_per = int(shards[0].shape[0])
+    out = _gather_fn(len(shards), rows_per, b)(
+        tuple(shards), jnp.asarray(padded))
+    return out[:n]
+
+
+@functools.lru_cache(maxsize=None)
+def _pack_fn(batch: int, bucket: int):
+    """Segment-sum of ``(batch, dim)`` gradient rows into ``(bucket,
+    dim)`` deduped slots (``inv`` from the host-side ``np.unique``):
+    duplicate ids within a minibatch accumulate, the reference's
+    row-sparse merge semantics. One program per (batch, bucket)."""
+    import jax
+    jnp = _jnp()
+
+    def fn(grad_rows, inv):
+        return jnp.zeros((bucket, grad_rows.shape[1]),
+                         grad_rows.dtype).at[inv].add(grad_rows)
+    return jax.jit(fn)
+
+
+class EmbeddingPlane:
+    """One row-sharded embedding table + its sharded training protocol.
+
+    >>> opt = mx.optimizer.Adam(learning_rate=0.01)
+    >>> plane = EmbeddingPlane("items", rows=4096, dim=32, world=4,
+    ...                        optimizer=opt)
+    >>> vecs = plane.lookup(ids)            # (batch, dim) NDArray
+    >>> ...backward through the dense tower...
+    >>> plane.step(ids, vecs.grad())        # sharded row-sparse update
+
+    The optimizer instance must be plane-owned (its update counters and
+    lr schedule drive THIS table's bias correction; sharing it with a
+    Trainer would double-count steps). Creation raises unless
+    ``MXTPU_SPARSE_PLANE=on`` — the grouped dense path's raise names the
+    flag, and a typo must not half-opt-in.
+    """
+
+    def __init__(self, name: str, rows: int, dim: int, world: int,
+                 optimizer, dtype="float32", seed: int = 0,
+                 init_scale: float = 0.01, kvstore=None):
+        check(sparse_plane_requested(),
+              "EmbeddingPlane requires MXTPU_SPARSE_PLANE=on (the "
+              "explicit opt-in the grouped dense path's sparse raise "
+              "names); refusing to build a sharded table behind a "
+              "disabled plane")
+        from ..optimizer import grouped as _grouped
+        check(_grouped._rule_for(optimizer) is not None,
+              f"sparse plane: optimizer {type(optimizer).__name__} has "
+              "no grouped-update rule (the plane steps shards through "
+              "the row-gathered grouped path)")
+        check(not getattr(optimizer, "multi_precision", False) or
+              str(dtype) == "float32",
+              "sparse plane: multi_precision only composes with an f32 "
+              "table (per-row f32 masters are not sharded yet)")
+        import jax
+        jnp = _jnp()
+        self.name = str(name)
+        self.rows, self.dim, self.world = int(rows), int(dim), int(world)
+        self.parts = row_partition(self.rows, self.world)
+        self.rows_per = self.rows // self.world
+        self.optimizer = optimizer
+        self._opt_index = 0
+        self._kv = kvstore
+        self._dtype = jnp.dtype(dtype)
+        # deterministic full-table init, then the pure contiguous split:
+        # plane(world=N).todense() is bitwise plane(world=1).todense(),
+        # and bitwise the dense-gather reference's start point
+        full = jax.random.normal(
+            jax.random.PRNGKey(seed), (self.rows, self.dim),
+            self._dtype) * init_scale
+        self._shards: List = [full[lo:hi] for lo, hi in self.parts]
+        self._state: List[Optional[Tuple]] = [None] * self.world
+        self._last_created: List[int] = []
+        self._last_stepped = False
+        from ..telemetry import memory as _memory
+        self._memory = _memory
+        for r, s in enumerate(self._shards):
+            _memory.track_plane_shard(self.name, r, self.world, s)
+
+    # -- lookup ---------------------------------------------------------
+    def lookup(self, ids):
+        """Gather the rows of ``ids`` into a ``(batch, dim)`` NDArray
+        (attach_grad on it to collect the row-sparse gradient from the
+        dense tower's backward)."""
+        from ..ndarray import NDArray
+        ids_np = _np.asarray(getattr(ids, "asnumpy", lambda: ids)(),
+                             _np.int64).ravel()
+        check(ids_np.size == 0 or
+              (int(ids_np.min()) >= 0 and int(ids_np.max()) < self.rows),
+              f"sparse plane {self.name!r}: lookup ids outside "
+              f"[0, {self.rows})")
+        return NDArray(masked_gather(self._shards, ids_np))
+
+    def todense(self) -> _np.ndarray:
+        """The assembled full table (parity tests, serving artifacts)."""
+        return _np.concatenate([_np.asarray(s) for s in self._shards])
+
+    # -- training -------------------------------------------------------
+    def _ensure_kv(self):
+        if self._kv is None:
+            from .. import kvstore as _kvs
+            self._kv = _kvs.create("device")
+        return self._kv
+
+    def _ensure_state(self, r: int) -> bool:
+        """Lazily materialize rank ``r``'s row optimizer state (zeros per
+        rule slot, shard-shaped: the per-rank state bytes ARE the shard's
+        1/world share). Returns True when THIS call created it."""
+        if self._state[r] is not None:
+            return False
+        from ..ndarray import NDArray
+        opt = self.optimizer
+        st = opt.create_state(self._opt_index, NDArray(self._shards[r]))
+        from ..optimizer.grouped import _flatten_inner
+        arrs = tuple(s._data for s in _flatten_inner(st))
+        self._state[r] = arrs
+        self._memory.track_plane_state(self.name, r, self.world, arrs)
+        return True
+
+    def step(self, ids, grad_rows, flag=None):
+        """One sharded row-sparse update: dedup + pack + exchange, then
+        the row-gathered grouped update on every rank whose shard owns a
+        touched row. ``grad_rows`` is the ``(batch, dim)`` gradient of
+        :meth:`lookup`'s output (NDArray or jax array); ``flag`` an
+        optional device all-finite verdict — when it lands False the
+        device state is bitwise untouched and the caller rolls the host
+        half back with :meth:`rollback_step`."""
+        from ..optimizer import grouped as _grouped
+        jnp = _jnp()
+        opt = self.optimizer
+        g = getattr(grad_rows, "_data", grad_rows)
+        ids_np = _np.asarray(getattr(ids, "asnumpy", lambda: ids)(),
+                             _np.int64).ravel()
+        check(g.shape[0] == ids_np.shape[0],
+              f"sparse plane {self.name!r}: {ids_np.shape[0]} ids vs "
+              f"{g.shape[0]} gradient rows")
+
+        # host half: dedup into the fixed-shape bucket
+        uids, inv = _np.unique(ids_np, return_inverse=True)
+        bucket = row_bucket(int(uids.shape[0]))
+        packed_ids = _np.full((bucket,), -1, _np.int64)
+        packed_ids[:uids.shape[0]] = uids
+        packed = _pack_fn(int(g.shape[0]), bucket)(
+            g, jnp.asarray(inv.astype(_np.int32)))
+
+        # the grad exchange: the union buffer every rank updates from,
+        # through the retry/chaos/ledger entry point (PURE — see
+        # kvstore.sparse_plane_exchange for the no-double-apply proof)
+        packed_ids, packed = self._ensure_kv().sparse_plane_exchange(
+            f"embplane:{self.name}", packed_ids, packed)
+
+        # host bookkeeping before any device work, the prepare_update
+        # order: count bump, then lr/wd resolution
+        opt._update_count(self._opt_index)
+        self._last_stepped = True
+        lr = opt._get_lr(self._opt_index)
+        wd = opt._get_wd(self._opt_index)
+        rule = _grouped._rule_for(opt)
+        if rule.name == "Adam":
+            import math
+            t = opt._index_update_count[self._opt_index]
+            lr = lr * math.sqrt(1 - opt.beta2 ** t) / (1 - opt.beta1 ** t)
+
+        self._last_created = []
+        for r, (lo, hi) in enumerate(self.parts):
+            mine = (packed_ids >= lo) & (packed_ids < hi)
+            if not bool(mine.any()):
+                continue  # lazy: an untouched shard costs nothing
+            if self._ensure_state(r):
+                self._last_created.append(r)
+            local = _np.where(mine, packed_ids - lo, 0).astype(_np.int32)
+            idx = jnp.asarray(local)
+            valid = jnp.asarray(mine)
+            nw, ns = _grouped.sparse_rows_update(
+                opt, self._shards[r], self._state[r], packed, idx, valid,
+                lr, wd, flag=flag)
+            self._shards[r] = nw
+            self._state[r] = ns
+            self._memory.track_plane_shard(self.name, r, self.world, nw)
+            self._memory.track_plane_state(self.name, r, self.world, ns)
+        return flag
+
+    def rollback_step(self):
+        """Undo the host-side effects of the last (sentinel-skipped)
+        step: the update-count bump, and any rank row state that step
+        first materialized — with their ledger bytes — so a skipped step
+        is indistinguishable from one that never ran (the
+        ``Trainer.rollback_step`` contract)."""
+        from ..optimizer import grouped as _grouped
+        if self._last_stepped:
+            _grouped.rollback_counts(self.optimizer, [self._opt_index])
+            self._last_stepped = False
+        for r in self._last_created:
+            self._state[r] = None
+            self._memory.drop_plane_state(self.name, r, self.world)
+        self._last_created = []
+
+    # -- accounting -----------------------------------------------------
+    def rank_bytes(self, rank: int) -> int:
+        """This rank's ``params:`` + ``state:`` ledger bytes — the number
+        the 1/world acceptance bar pins, queried, not estimated."""
+        led = self._memory.ledger()
+        own = self._memory.plane_owner
+        return (led.live_bytes("params",
+                               owner_prefix=own(rank, self.world,
+                                                self.name)) +
+                led.live_bytes("optimizer",
+                               owner_prefix=own(rank, self.world,
+                                                self.name, state=True)))
+
+    def describe(self) -> dict:
+        return {"name": self.name, "rows": self.rows, "dim": self.dim,
+                "world": self.world, "rows_per_rank": self.rows_per,
+                "ranks_with_state":
+                    sum(1 for s in self._state if s is not None)}
+
+    # -- serving handoff ------------------------------------------------
+    def save_npz(self, path: str) -> None:
+        """Write the shard set + layout meta (the serving sidecar format
+        ``serving/lookup.py`` loads — per-rank arrays, so a replica can
+        prove the table it serves is the sharded one)."""
+        arrays = {f"shard_{r}": _np.asarray(s)
+                  for r, s in enumerate(self._shards)}
+        _np.savez(path, meta=_np.array(
+            [self.rows, self.dim, self.world], _np.int64), **arrays)
+
+    def close(self) -> None:
+        """Drop the plane's ledger entries (tests re-creating planes)."""
+        self._memory.drop_plane(self.name)

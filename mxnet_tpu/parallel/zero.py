@@ -228,7 +228,13 @@ class ZeroPlane:
             check(p.stype == "default" and
                   getattr(p, "grad_stype", "default") == "default",
                   f"MXTPU_ZERO=1 requires dense parameters/gradients; "
-                  f"{p.name!r} is sparse")
+                  f"{p.name!r} is sparse. Sparse tables shard through "
+                  "the row-wise embedding plane instead "
+                  "(MXTPU_SPARSE_PLANE=on + parallel.embedding_plane."
+                  "EmbeddingPlane, state co-located with each rank's "
+                  "rows): keep the table OUT of the Trainer and the two "
+                  "planes compose in one loop — dense params ZeRO-"
+                  "sharded, embedding rows plane-sharded")
         self._kv = kv
         nw = int(kv.num_workers)
         if nw > 1:

@@ -58,6 +58,8 @@ from .cache import SignatureCache  # noqa: F401
 from .fleet import DeployReport, Fleet, FleetServer  # noqa: F401
 from .metrics import ServerMetrics  # noqa: F401
 from .autoscale import Autoscaler, decide  # noqa: F401
+from .lookup import (LookupFleet, LookupReplica,  # noqa: F401
+                     publish_embedding)
 from .registry import (ModelRegistry, RegistryCorruptError,  # noqa: F401
                        ResolvedVersion)
 from .router import (FleetRouter, ReplicaClient,  # noqa: F401
@@ -73,4 +75,5 @@ __all__ = ["ModelServer", "SignatureCache", "ServerMetrics", "ServingError",
            "ReplayLog", "enable_compile_cache", "runtime_fingerprint",
            "warm_from_replay", "FleetRouter", "ReplicaEndpoint",
            "ReplicaClient", "ReplicaDead", "RouterFuture", "replica_main",
-           "Autoscaler", "decide"]
+           "Autoscaler", "decide", "LookupFleet", "LookupReplica",
+           "publish_embedding"]

@@ -431,6 +431,62 @@ def track_ndarray(category: str, nd, owner: str = "") -> None:
 
 
 # ---------------------------------------------------------------------------
+# Sparse embedding plane attribution (parallel/embedding_plane.py): the
+# row-wise analog of the ZeRO ``state:zr<r>/<N>:`` owners. Each rank's
+# table shard lands under ``params`` as ``emb<r>/<N>:<table>`` and its
+# lazily-created row optimizer state under ``optimizer`` as
+# ``state:emb<r>/<N>:<table>`` — so "per-rank embedding bytes are exactly
+# 1/world" is a ledger query, not an estimate. Stable keys (not weakrefs):
+# the plane rebinds shard arrays every step; the entry must track the
+# logical shard, not one jax buffer's lifetime.
+# ---------------------------------------------------------------------------
+
+def plane_owner(rank: int, world: int, name: str,
+                state: bool = False) -> str:
+    """The ledger owner string of one plane shard (or its row state)."""
+    tag = f"emb{int(rank)}/{int(world)}:{name}"
+    return f"state:{tag}" if state else tag
+
+
+def track_plane_shard(name: str, rank: int, world: int, arr) -> None:
+    """Register (or resize after a rebind) one rank's table shard."""
+    try:
+        _LEDGER.set("params", ("embshard", name, int(rank)),
+                    nd_bytes(arr), owner=plane_owner(rank, world, name))
+    except Exception:
+        pass
+
+
+def track_plane_state(name: str, rank: int, world: int, arrs) -> None:
+    """Register one rank's lazily-created row optimizer state arrays."""
+    try:
+        _LEDGER.set("optimizer", ("embstate", name, int(rank)),
+                    sum(nd_bytes(a) for a in arrs),
+                    owner=plane_owner(rank, world, name, state=True))
+    except Exception:
+        pass
+
+
+def drop_plane_state(name: str, rank: int, world: int) -> None:
+    """Free one rank's row-state entry (sentinel-skip rollback of a step
+    that first materialized it)."""
+    try:
+        _LEDGER.drop("optimizer", ("embstate", name, int(rank)))
+    except Exception:
+        pass
+
+
+def drop_plane(name: str) -> None:
+    """Free every ledger entry of one plane (table close/re-create)."""
+    try:
+        _LEDGER.drop_matching(
+            lambda _cat, key, _own: isinstance(key, tuple) and len(key) == 3
+            and key[0] in ("embshard", "embstate") and key[1] == name)
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
 # Static per-program attribution
 # ---------------------------------------------------------------------------
 

@@ -98,3 +98,10 @@ def pytest_configure(config):
         "reports, tools/run_compare.py regression diff). Tier-1-safe: "
         "CPU — the XLA cost model is exact there, so hand-computed "
         "matmul FLOPs pin the numbers.")
+    config.addinivalue_line(
+        "markers", "sparse_plane: sparse embedding-plane tests "
+        "(parallel/embedding_plane.py row-wise sharded tables, "
+        "optimizer/grouped.py sparse_rows_update row-gathered updates, "
+        "serving/lookup.py registry lookup tier). Tier-1-safe: CPU, "
+        "simulated worlds in-process; 1/world per-rank byte pins are "
+        "ledger-exact by construction there.")

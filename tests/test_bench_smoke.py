@@ -187,6 +187,21 @@ def test_bench_tiny_deadline_emits_full_headline_json():
     assert frow["scaleup_compiles"] == 0
     assert frow["scaleup_aot_loaded"] > 0
     assert frow["dense_qps"] > 0 and frow["int8_qps"] > 0
+    # the recsys row: the sparse embedding plane's numbers — warm
+    # mask-packed row-sparse examples/s, closed-loop lookup_qps from the
+    # 2-replica LookupFleet, and the ledger pin: EVERY rank's bytes at
+    # exactly 1/world of the world=1 baseline trained the same way
+    # (Adam state lazy per rank; the probe touches all rows first)
+    rrow = payload["recsys"]
+    assert rrow["world"] == 4
+    assert rrow["examples_per_s"] > 0
+    assert rrow["unsharded_embedding_bytes"] > 0
+    assert len(rrow["per_rank_embedding_bytes"]) == rrow["world"]
+    assert all(
+        b == rrow["unsharded_embedding_bytes"] // rrow["world"]
+        for b in rrow["per_rank_embedding_bytes"])
+    assert rrow["replicas"] == 2
+    assert rrow["lookup_requests"] > 0 and rrow["lookup_qps"] > 0
 
 
 def test_bench_exhausted_deadline_still_emits_parseable_row():

@@ -476,6 +476,36 @@ def test_run_compare_matrix(tmp_path, capsys):
     assert rc.main([a, newer]) == 2
 
 
+def test_run_compare_grades_recsys_row(tmp_path, capsys):
+    """The recsys bench row's rates (sparse embedding plane: train
+    examples/s + LookupFleet lookup_qps) gate directionally like any
+    other rate; a report without the row stays 'missing', never a
+    false regression."""
+    from tools import run_compare as rc
+    a = _synth_report(tmp_path / "a.json",
+                      recsys={"examples_per_s": 40000.0,
+                              "lookup_qps": 3000.0})
+    good = _synth_report(tmp_path / "good.json",
+                         recsys={"examples_per_s": 41000.0,
+                                 "lookup_qps": 3050.0})
+    assert rc.main([a, good]) == 0
+    bad = _synth_report(tmp_path / "bad.json",
+                        recsys={"examples_per_s": 20000.0,
+                                "lookup_qps": 1000.0})
+    capsys.readouterr()
+    assert rc.main([a, bad, "--json"]) == 1
+    out = json.loads(capsys.readouterr().out)
+    assert "recsys_examples_per_s" in out["regressed"]
+    assert "lookup_qps" in out["regressed"]
+    plain = _synth_report(tmp_path / "plain.json")
+    capsys.readouterr()
+    assert rc.main([a, plain, "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    mrow = {r["metric"]: r["verdict"] for r in out["metrics"]}
+    assert mrow["lookup_qps"] == "missing"
+    assert mrow["recsys_examples_per_s"] == "missing"
+
+
 def test_run_compare_cli_and_kv_slow_acceptance(monkeypatch, tmp_path):
     """Acceptance: two run reports from an intentionally-slowed run pair
     (chaos kv_slow wire delay) make tools/run_compare.py exit nonzero

@@ -400,6 +400,97 @@ def test_gluon_embedding_sparse_grad_end_to_end():
     assert not np.allclose(after[[1, 2, 9]], before[[1, 2, 9]])
 
 
+# ---------------------------------------------------------------------------
+# jit trace-path round-trips (the megastep discipline: row_sparse crosses
+# into a jitted program as a FIXED-SHAPE dense packed buffer; nnz varies
+# per step, the compiled program does not)
+# ---------------------------------------------------------------------------
+
+def test_mask_pack_is_a_fixed_shape_jit_boundary():
+    """mask_pack -> jitted dense reduce -> mask_unpack: the row_sparse ->
+    dense boundary inside a jitted program. The program traces ONCE for
+    the dense packed shape while nnz varies per call, and the round-trip
+    reassembles the union row set bitwise."""
+    import jax
+    import jax.numpy as jnp
+    traces = []
+
+    @jax.jit
+    def reduce_two(a, b):
+        traces.append(1)
+        summed = a + b  # the dense cross-worker reduce
+        mask = (summed[:, -1:] > 0).astype(a.dtype)
+        return jnp.concatenate([summed[:, :-1], mask], axis=1)
+
+    shape = (10, 3)
+    for seed, (r1, r2) in enumerate([([1, 4], [4, 7]),
+                                     ([0, 2, 9], [2]),
+                                     ([5], [5])]):
+        g1 = _rsp_grad(shape, r1, seed=20 + seed)
+        g2 = _rsp_grad(shape, r2, seed=40 + seed)
+        packed = reduce_two(sparse.mask_pack(g1)._data,
+                            sparse.mask_pack(g2)._data)
+        back = sparse.mask_unpack(nd.from_jax(packed), shape)
+        assert sorted(np.asarray(back._indices)) == \
+            sorted(set(r1) | set(r2))
+        np.testing.assert_array_equal(
+            back.todense().asnumpy(),
+            g1.todense().asnumpy() + g2.todense().asnumpy())
+    assert len(traces) == 1  # nnz varied three ways, the program replayed
+
+
+def test_mask_pack_jit_reduce_keeps_cancelled_rows():
+    """A row whose reduced gradient sums to exactly zero must survive the
+    jitted reduce via the mask column (lazy updates still apply wd /
+    momentum to every pushed row — dropping it would silently skip them)."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def reduce_two(a, b):
+        summed = a + b
+        mask = (summed[:, -1:] > 0).astype(a.dtype)
+        return jnp.concatenate([summed[:, :-1], mask], axis=1)
+
+    shape = (8, 2)
+    g1 = _rsp_grad(shape, [4], seed=3)
+    g2 = sparse.RowSparseNDArray(-g1._data, np.array([4], np.int32), shape)
+    packed = reduce_two(sparse.mask_pack(g1)._data,
+                        sparse.mask_pack(g2)._data)
+    back = sparse.mask_unpack(nd.from_jax(packed), shape)
+    assert list(np.asarray(back._indices)) == [4]
+    np.testing.assert_array_equal(np.asarray(back._data),
+                                  np.zeros((1, 2), np.float32))
+
+
+def test_autograd_row_sparse_grad_through_jitted_program_matches_eager():
+    """End-to-end over the real autograd product: an Embedding
+    sparse_grad backward's row_sparse gradient rides mask_pack through a
+    jitted dense transform and unpacks to the same rows and values the
+    eager dense path computes."""
+    import jax
+
+    W = np.random.RandomState(8).randn(20, 4).astype(np.float32)
+    w = nd.array(W)
+    w.attach_grad(stype="row_sparse")
+    with autograd.record():
+        e = nd.Embedding(nd.array(np.array([3.0, 11.0, 3.0])), w,
+                         input_dim=20, output_dim=4, sparse_grad=True)
+        (e * e).sum().backward()
+    g = w.grad
+    assert isinstance(g, sparse.RowSparseNDArray)
+
+    @jax.jit
+    def halve(packed):
+        return packed.at[:, :-1].multiply(0.5)  # data halved, mask kept
+
+    back = sparse.mask_unpack(
+        nd.from_jax(halve(sparse.mask_pack(g)._data)), g.shape)
+    assert sorted(np.asarray(back._indices)) == [3, 11]
+    np.testing.assert_array_equal(back.todense().asnumpy(),
+                                  g.todense().asnumpy() * 0.5)
+
+
 def test_hybridize_sparse_grad_warns_but_correct():
     from mxnet_tpu import gluon
     layer = gluon.nn.Embedding(20, 3, sparse_grad=True)

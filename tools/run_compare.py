@@ -69,6 +69,14 @@ METRICS: List[Tuple[str, Tuple[str, ...], str, str]] = [
     ("serve_p95_ms", ("serving", "latency_ms", "p95"), "lower", "rate"),
     ("serve_p99_ms", ("serving", "latency_ms", "p99"), "lower", "rate"),
     ("serve_shed", ("serving", "shed_total"), "lower", "count"),
+    # the recsys bench row (bench.py _recsys_probe): the sparse
+    # embedding plane's train throughput and the LookupFleet's
+    # closed-loop lookup rate — both graded directionally like any
+    # other rate (the 1/world byte pin is exact and asserted in
+    # tests/test_bench_smoke.py, not fenced here)
+    ("recsys_examples_per_s", ("recsys", "examples_per_s"), "higher",
+     "rate"),
+    ("lookup_qps", ("recsys", "lookup_qps"), "higher", "rate"),
 ]
 
 
